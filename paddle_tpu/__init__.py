@@ -1,0 +1,82 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capabilities of the reference (PaddlePaddle,
+see SURVEY.md): eager tensors + tape autograd, a functional op surface, nn
+layers, optimizers, AMP, a compiled to_static path, and the distributed stack —
+all built TPU-first on JAX/XLA/Pallas (compute) with native components for the
+runtime tier. Public API names follow python/paddle/__init__.py so reference
+users can migrate.
+"""
+from __future__ import annotations
+
+# core
+from .core.tensor import CPUPlace, Parameter, Place, Tensor, TPUPlace
+from .core.dtype import (bfloat16, bool_, complex128, complex64, float16,
+                         float32, float64, get_default_dtype, int16, int32,
+                         int64, int8, set_default_dtype, uint8)
+from .core.flags import get_flags, set_flags
+from .core.random import seed
+
+# autograd
+from .autograd import (PyLayer, PyLayerContext, enable_grad, grad,
+                       is_grad_enabled, no_grad, set_grad_enabled)
+
+# ops — flat namespace like paddle.*
+from .ops import *  # noqa: F401,F403
+from .ops import (abs, all, any, max, min, pow, round, sum)  # noqa: F401
+
+# subpackages
+from . import amp
+from . import autograd
+from . import nn
+from . import optimizer
+from .nn import functional as _F
+
+# paddle.disable_static/enable_static are no-ops here (eager is the default;
+# the compiled path is paddle_tpu.jit)
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    return None
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def device_count():
+    import jax
+    return len(jax.devices())
+
+
+def get_device():
+    import jax
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device):
+    return device
+
+
+def synchronize():
+    """Block until all dispatched work completes (device sync)."""
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,105 @@
+"""Autograd public API.
+
+Mirrors python/paddle/autograd/__init__.py: backward, grad (GeneralGrad,
+eager/general_grad.h), no_grad/enable_grad guards, and PyLayer custom autograd
+(python/paddle/autograd/py_layer.py + pybind/eager_py_layer.cc).
+"""
+from __future__ import annotations
+
+from .engine import (GradNode, enable_grad, grad, is_grad_enabled, no_grad,
+                     run_backward, set_grad_enabled)
+from .hooks import register_tensor_hook
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward analog."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """ctx passed to PyLayer.forward/backward (py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd function (python/paddle/autograd/py_layer.py:PyLayer).
+
+    Subclass with @staticmethod forward(ctx, *args, **kwargs) and
+    backward(ctx, *grad_outputs); invoke with cls.apply(*args).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        import jax
+
+        from ..core.tensor import Tensor
+        from . import engine as _engine
+
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        requires = is_grad_enabled() and any(not t.stop_gradient for t in in_tensors)
+        if not requires:
+            return out
+
+        out_is_seq = isinstance(out, (list, tuple))
+        out_list = list(out) if out_is_seq else [out]
+        out_avals = [(tuple(t.shape), t.dtype) for t in out_list]
+
+        def vjp_fn(flat_cts):
+            cts = [Tensor(g) for g in flat_cts]
+            grads = cls.backward(ctx, *cts)
+            if not isinstance(grads, (list, tuple)):
+                grads = (grads,)
+            out_grads = []
+            gi = 0
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = grads[gi] if gi < len(grads) else None
+                    gi += 1
+                    out_grads.append(None if g is None else
+                                     (g._data if isinstance(g, Tensor) else g))
+            return tuple(out_grads)
+
+        needs = [not t.stop_gradient for t in in_tensors]
+        node = _engine.GradNode(cls.__name__, vjp_fn, in_tensors, needs, out_avals)
+        wrapped = []
+        for idx, t in enumerate(out_list):
+            nt = Tensor(t._data, stop_gradient=False)
+            nt._grad_node = node
+            nt._grad_out_idx = idx
+            wrapped.append(nt)
+        return tuple(wrapped) if out_is_seq else wrapped[0]
+
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext",
+           "register_tensor_hook"]

@@ -1,0 +1,264 @@
+"""Tape autograd engine.
+
+TPU-native redesign of the reference's eager autograd engine
+(paddle/fluid/eager/backward.cc:105 RunBackward — queue-driven reverse traversal
+over GradNodeBase with pending-count bookkeeping; paddle/fluid/eager/grad_node_info.h:197).
+
+Design: each differentiable op call records one GradNode holding a jax.vjp
+closure (residuals live on device as XLA buffers). backward() does a reverse
+topological sweep calling each node's vjp and accumulating input grads —
+functionally identical to the reference's GradTensorHolder flow
+(paddle/fluid/eager/grad_tensor_holder.h:27) but with XLA owning all kernel
+fusion. The whole engine is traceable: under jit capture the same code runs on
+tracers, so compiled training steps get their backward from the same tape.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import hooks
+
+_STATE = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_STATE, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _STATE.grad_enabled = mode
+
+
+class no_grad:
+    """paddle.no_grad analog (context manager + decorator)."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class GradNode:
+    """One recorded op on the tape (GradNodeBase analog, grad_node_info.h:197).
+
+    vjp_fn: closure from jax.vjp returning a tuple of input cotangents.
+    inputs: the input Tensors (edges to producer nodes).
+    out_avals: (shape, dtype) per output, to synthesize zero cotangents.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "needs_grad", "out_avals",
+                 "released", "call", "out_treedef")
+
+    def __init__(self, name, vjp_fn, inputs, needs_grad, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.needs_grad = needs_grad
+        self.out_avals = out_avals
+        self.released = False
+        self.call = None
+        self.out_treedef = None
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = ()
+        self.call = None
+        self.released = True
+
+
+def _topo_order(root_nodes: Sequence[GradNode]) -> List[GradNode]:
+    """Iterative DFS postorder (producers first); reversed gives execution order."""
+    order: List[GradNode] = []
+    visited = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            prod = t._grad_node
+            if prod is not None and not prod.released and id(prod) not in visited:
+                stack.append((prod, False))
+    return order
+
+
+def _is_float0(g) -> bool:
+    return g is None or getattr(g, "dtype", None) == jax.dtypes.float0
+
+
+def _accum(a, b):
+    return b if a is None else a + b
+
+
+def _zero_cotangent(shape, dtype):
+    import numpy as np
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.zeros(shape, dtype)
+    # Integer/bool outputs take float0 cotangents under jax.vjp.
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 inputs=None, create_graph=False, accumulate_leaf=True):
+    """Reverse sweep (backward.cc:105 analog).
+
+    tensors: list of root Tensors. grad_tensors: optional cotangents.
+    inputs: if given, also return grads for exactly these tensors
+    (GeneralGrad / paddle.grad analog, eager/general_grad.h).
+    """
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # node id -> list of output cotangent arrays (GradTensorHolder analog)
+    pending: Dict[int, List[Optional[Any]]] = {}
+    node_by_id: Dict[int, GradNode] = {}
+    # id(tensor) -> accumulated grad for requested `inputs`
+    input_ids = {id(t) for t in inputs} if inputs is not None else set()
+    input_grads: Dict[int, Any] = {}
+
+    from ..core.tensor import Tensor as _T
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones(t.shape, t.dtype)
+            if create_graph:
+                g = _T(g)
+        elif create_graph:
+            g = g if isinstance(g, _T) else _T(jnp.asarray(g))
+        else:
+            g = g._data if hasattr(g, "_data") else jnp.asarray(g)
+        node = t._grad_node
+        if node is not None and node.released:
+            raise RuntimeError(
+                "trying to backward through the graph a second time, but the "
+                "saved intermediate results have already been freed; specify "
+                "retain_graph=True on the first backward call")
+        if node is None:
+            # Leaf root: write grad directly.
+            if not t.stop_gradient:
+                if accumulate_leaf:
+                    t._accumulate_grad(g)
+                if id(t) in input_ids:
+                    input_grads[id(t)] = _accum(input_grads.get(id(t)), g)
+            continue
+        buf = pending.setdefault(id(node), [None] * len(node.out_avals))
+        idx = t._grad_out_idx
+        buf[idx] = _accum(buf[idx], g)
+        node_by_id[id(node)] = node
+        roots.append(node)
+
+    if not roots:
+        return input_grads
+
+    order = _topo_order(roots)
+    for node in reversed(order):
+        buf = pending.get(id(node))
+        if buf is None:
+            continue  # unreachable from roots
+        # Fill missing cotangents with zeros (reference zero-fills holders too).
+        if create_graph:
+            cotangents = tuple(
+                b if b is not None else _T(jnp.zeros(shape, dtype))
+                for b, (shape, dtype) in zip(buf, node.out_avals)
+            )
+            from ..ops.registry import replay_node_vjp
+            in_grads = replay_node_vjp(node, cotangents)
+            if not isinstance(in_grads, (list, tuple)):
+                in_grads = (in_grads,)
+        else:
+            cotangents = tuple(
+                b if b is not None else _zero_cotangent(shape, dtype)
+                for b, (shape, dtype) in zip(buf, node.out_avals)
+            )
+            in_grads = node.vjp_fn(cotangents)
+        for t, needs, g in zip(node.inputs, node.needs_grad, in_grads):
+            if not needs or _is_float0(g):
+                continue
+            g = hooks.apply_hooks(t, g)
+            prod = t._grad_node
+            if prod is not None and not prod.released:
+                pbuf = pending.setdefault(id(prod), [None] * len(prod.out_avals))
+                pidx = t._grad_out_idx
+                pbuf[pidx] = _accum(pbuf[pidx], g)
+            elif not t.stop_gradient:
+                if accumulate_leaf:
+                    t._accumulate_grad(g)
+                if id(t) in input_ids:
+                    input_grads[id(t)] = _accum(input_grads.get(id(t)), g)
+            if id(t) in input_ids and (prod is not None and not prod.released):
+                # Non-leaf requested input: capture its grad as it flows past.
+                input_grads[id(t)] = _accum(input_grads.get(id(t)), g)
+        pending.pop(id(node), None)
+        if not retain_graph and not create_graph:
+            node.release()
+
+    return input_grads
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """paddle.grad analog (python/paddle/autograd/__init__.py, GeneralGrad).
+
+    Returns grads for `inputs` without mutating .grad on leaves.
+    """
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    got = run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                       inputs=inputs, create_graph=create_graph,
+                       accumulate_leaf=False)
+    from ..core.tensor import Tensor
+
+    result = []
+    for t in inputs:
+        g = got.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors receives no gradient "
+                    "(pass allow_unused=True to permit this)")
+            result.append(None)
+        elif isinstance(g, Tensor):
+            result.append(g)  # create_graph mode: keep the tape history
+        else:
+            result.append(Tensor(g, stop_gradient=not create_graph))
+    return result

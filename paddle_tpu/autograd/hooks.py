@@ -1,0 +1,64 @@
+"""Gradient hooks.
+
+Analog of the reference's per-tensor grad hooks (paddle/fluid/eager/hooks.h,
+eager_method.cc register_grad_hook) used e.g. by the DP reducer to overlap
+allreduce with backward (fluid/distributed/collective/reducer.h:88).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable, Dict, List
+
+# id(tensor) -> (weakref, [hooks]). Keyed by id, NOT by the tensor itself:
+# Tensor.__eq__ is elementwise, so hash-bucket collisions in a
+# WeakKeyDictionary would trigger ambiguous array-truth errors.
+_TENSOR_HOOKS: Dict[int, tuple] = {}
+
+
+def _entry_for(tensor, create=False):
+    key = id(tensor)
+    entry = _TENSOR_HOOKS.get(key)
+    if entry is not None and entry[0]() is tensor:
+        return entry
+    if not create:
+        return None
+    ref = weakref.ref(tensor, lambda r, k=key: _TENSOR_HOOKS.pop(k, None))
+    entry = (ref, [])
+    _TENSOR_HOOKS[key] = entry
+    return entry
+
+
+class RemovableHandle:
+    def __init__(self, tensor, hook):
+        self._ref = weakref.ref(tensor)
+        self._hook = hook
+
+    def remove(self):
+        t = self._ref()
+        if t is not None:
+            entry = _entry_for(t)
+            if entry and self._hook in entry[1]:
+                entry[1].remove(self._hook)
+
+
+def register_tensor_hook(tensor, hook: Callable) -> RemovableHandle:
+    _entry_for(tensor, create=True)[1].append(hook)
+    return RemovableHandle(tensor, hook)
+
+
+def apply_hooks(tensor, grad):
+    """Called by the engine as a grad flows into `tensor`. A hook may return a
+    new grad (jax array or Tensor) or None (keep as-is)."""
+    entry = _entry_for(tensor)
+    if entry is None or not entry[1]:
+        return grad
+    for h in entry[1]:
+        out = h(_wrap(grad))
+        if out is not None:
+            grad = out._data if hasattr(out, "_data") else out
+    return grad
+
+
+def _wrap(g):
+    from ..core.tensor import Tensor
+    return Tensor(g)

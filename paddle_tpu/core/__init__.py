@@ -1,0 +1,2 @@
+from . import dtype, flags, random
+from .tensor import CPUPlace, Parameter, Place, Tensor, TPUPlace

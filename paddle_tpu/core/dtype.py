@@ -1,0 +1,85 @@
+"""Dtype utilities.
+
+TPU-native analog of the reference's dtype system (paddle/phi/common/data_type.h,
+python/paddle/framework/dtype.py). We canonicalize everything onto jnp dtypes and
+keep paddle-style string names ('float32', 'bfloat16', ...).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype aliases (paddle exposes these as paddle.float32 etc.)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [float32]
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype analog (python/paddle/framework/framework.py)."""
+    _DEFAULT_DTYPE[0] = to_jax_dtype(dtype)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def to_jax_dtype(dtype):
+    """Canonicalize a dtype spec (str / np dtype / jnp dtype / None) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR_TO_DTYPE:
+            raise ValueError(f"unknown dtype string: {dtype!r}")
+        return _STR_TO_DTYPE[key]
+    return np.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def dtype_name(dtype) -> str:
+    """Return the paddle-style string name for a dtype."""
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.integer)

@@ -1,0 +1,64 @@
+"""Runtime flag registry.
+
+Analog of the reference's unified flag system (paddle/utils/flags.h:42,
+paddle/phi/core/flags.cc — ~96 exported FLAGS_* runtime flags surfaced through
+paddle.set_flags / get_flags). Flags may also be seeded from FLAGS_* environment
+variables at import time, matching the reference's env override behavior.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, Union
+
+_LOCK = threading.Lock()
+_FLAGS: Dict[str, Any] = {}
+_DEFS: Dict[str, dict] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = "") -> None:
+    """Register a flag (PHI_DEFINE_EXPORTED_* analog)."""
+    with _LOCK:
+        _DEFS[name] = {"default": default, "help": help_str, "type": type(default)}
+        env = os.environ.get(name)
+        if env is not None:
+            _FLAGS[name] = _parse(env, type(default))
+        else:
+            _FLAGS.setdefault(name, default)
+
+
+def _parse(value: str, ty: type) -> Any:
+    if ty is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if ty in (int, float):
+        return ty(value)
+    return value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags analog (python/paddle/base/framework.py)."""
+    with _LOCK:
+        for k, v in flags.items():
+            if k not in _DEFS:
+                raise KeyError(f"unknown flag {k!r}")
+            _FLAGS[k] = v
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    with _LOCK:
+        return {k: _FLAGS[k] for k in flags}
+
+
+def get_flag(name: str) -> Any:
+    with _LOCK:
+        return _FLAGS[name]
+
+
+# Core flags mirroring the reference's most load-bearing ones.
+define_flag("FLAGS_check_nan_inf", False, "scan op outputs for NaN/Inf (phi/core/flags.cc:74)")
+define_flag("FLAGS_cudnn_deterministic", False, "deterministic kernels")
+define_flag("FLAGS_low_precision_op_list", 0, "record low precision op calls")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "host allocator strategy")
+define_flag("FLAGS_eager_op_cache", True, "cache per-op jitted executables in eager mode")

@@ -1,0 +1,57 @@
+"""Global RNG state.
+
+Analog of the reference's Generator (paddle/phi/core/generator.h) and paddle.seed
+(python/paddle/framework/random.py). We keep a splittable JAX PRNG key as the
+global generator; every consumer splits a fresh subkey. Distributed RNG trackers
+(TP rank-distinct seeds, fleet/layers/mpu/random.py:34 RNGStatesTracker) build on
+fork_rng_state below.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+        return self
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+
+_GLOBAL_GENERATOR = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _GLOBAL_GENERATOR
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed analog."""
+    return _GLOBAL_GENERATOR.manual_seed(s)
+
+
+def next_key():
+    return _GLOBAL_GENERATOR.next_key()
+
+
+def fork_rng_state(offset: int):
+    """Derive a deterministic key stream offset from the current global key
+    (used by the TP RNGStatesTracker analog)."""
+    return jax.random.fold_in(_GLOBAL_GENERATOR.get_state(), offset)

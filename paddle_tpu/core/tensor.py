@@ -1,0 +1,260 @@
+"""Eager Tensor.
+
+TPU-native redesign of the reference's eager Tensor
+(paddle/phi/core/dense_tensor.h:37 DenseTensor + paddle/fluid/eager/autograd_meta.h:61
+AutogradMeta + pybind eager_method.cc). Here a Tensor wraps a jax.Array (an XLA
+buffer on TPU, or a tracer under jit capture) plus autograd metadata; all kernels
+are XLA/Pallas, dispatched through the op layer (paddle_tpu.ops).
+
+Paddle semantics preserved: `stop_gradient` defaults to True for data tensors and
+False for Parameters; `.backward()` runs the tape engine; `.grad` accumulates on
+leaves; `.clear_grad()` zeroes it.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from ..autograd import engine as _engine
+
+
+class Place:
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self.kind, self.index) == (other.kind, other.index)
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+
+def TPUPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def _unwrap(value):
+    return value._data if isinstance(value, Tensor) else value
+
+
+class Tensor:
+    """Eager tensor over a jax.Array. dense_tensor.h:37 / eager.cc analog."""
+
+    # Populated by paddle_tpu.ops at import time (method installation mirrors
+    # the reference's math-op patch, pybind/eager_math_op_patch.cc).
+    __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "_grad_out_idx",
+                 "name", "persistable", "_dist_attr", "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True, name: Optional[str] = None):
+        dt = dtype_mod.to_jax_dtype(dtype)
+        if isinstance(data, Tensor):
+            data = data._data
+        if isinstance(data, (jax.Array, jax.core.Tracer)):
+            self._data = data.astype(dt) if dt is not None and data.dtype != np.dtype(dt) else data
+        else:
+            arr = np.asarray(data)
+            if dt is None and arr.dtype == np.float64:
+                dt = dtype_mod.get_default_dtype()
+            self._data = jnp.asarray(arr, dtype=dt)
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._grad_node = None
+        self._grad_out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._dist_attr = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = self._data.devices().pop()
+            return Place(dev.platform, dev.id)
+        except Exception:
+            return Place("traced", 0)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self._data)
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value if (value is None or isinstance(value, Tensor)) else Tensor(value)
+
+    def _accumulate_grad(self, g):
+        """AccumulationNode analog (eager/accumulation/accumulation_node.h)."""
+        if isinstance(g, Tensor):
+            # create_graph mode: keep the grad's tape history
+            self._grad = g if self._grad is None else self._grad + g
+        elif self._grad is None:
+            self._grad = Tensor(g)
+        else:
+            self._grad = Tensor(self._grad._data + g)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        """z.backward() → engine RunBackward (eager/backward.cc:429)."""
+        _engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name)
+
+    def clone(self) -> "Tensor":
+        from .. import ops
+        return ops.assign(self)
+
+    def stop_gradient_(self, flag: bool = True):
+        self.stop_gradient = flag
+        return self
+
+    # -- mutation (in-place surface; functional underneath) -----------------
+    def _set_data(self, new_data):
+        self._data = new_data
+
+    def set_value(self, value):
+        value = _unwrap(value)
+        self._data = jnp.asarray(value, dtype=self.dtype).reshape(self._data.shape)
+        return self
+
+    def copy_(self, other, blocking: bool = True):
+        return self.set_value(other)
+
+    # -- misc ---------------------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a.lower() in ("cpu", "tpu", "gpu"):
+                continue  # single-process placement is XLA's concern
+            dtype = a
+        return self.astype(dtype) if dtype is not None else self
+
+    def cpu(self):
+        return Tensor(np.asarray(self._data), stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        grad_flag = f", stop_gradient={self.stop_gradient}"
+        try:
+            data_repr = repr(np.asarray(self._data))
+        except Exception:
+            data_repr = repr(self._data)
+        return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
+                f"{grad_flag},\n       {data_repr})")
+
+    __hash__ = object.__hash__
+
+    # -- indexing (ops installs autograd-aware __getitem__/__setitem__) -----
+
+    def register_hook(self, hook):
+        """Per-tensor grad hook (eager grad hooks analog). Wraps the grad node edge."""
+        from ..autograd.hooks import register_tensor_hook
+        return register_tensor_hook(self, hook)
+
+
+class Parameter(Tensor):
+    """Trainable parameter (python/paddle/base/framework.py Parameter analog)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.persistable = True
+
+    @property
+    def trainable_(self):
+        return self.trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

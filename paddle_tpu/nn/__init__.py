@@ -1,0 +1,27 @@
+"""paddle_tpu.nn — layer library (python/paddle/nn analog)."""
+from __future__ import annotations
+
+from . import functional
+from . import initializer
+from .layer import (Layer, LayerDict, LayerList, ParamAttr, ParameterList,
+                    Sequential)
+from .common import (CosineSimilarity, Dropout, Dropout2D, Embedding, Flatten,
+                     Identity, Linear, Pad2D, PixelShuffle, Unfold, Upsample)
+from .conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
+from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
+                   InstanceNorm2D, LayerNorm, LocalResponseNorm, RMSNorm,
+                   SyncBatchNorm)
+from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+                      AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
+from .activation_layers import (CELU, ELU, GELU, Hardshrink, Hardsigmoid,
+                                Hardswish, Hardtanh, LeakyReLU, LogSigmoid,
+                                LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+                                SELU, Sigmoid, SiLU, Softmax, Softplus,
+                                Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+                                ThresholdedReLU)
+from .loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
+                   HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss,
+                   MSELoss, NLLLoss, SmoothL1Loss)
+from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
+                          TransformerDecoderLayer, TransformerEncoder,
+                          TransformerEncoderLayer)

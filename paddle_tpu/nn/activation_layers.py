@@ -1,0 +1,88 @@
+"""Activation layers (python/paddle/nn/layer/activation.py analog)."""
+from __future__ import annotations
+
+from . import functional as F
+from .layer import Layer
+
+
+def _make(name, fn, **defaults):
+    def __init__(self, name=None, **kwargs):
+        Layer.__init__(self)
+        self._kwargs = {**defaults, **{k: v for k, v in kwargs.items()
+                                       if k in defaults}}
+
+    def forward(self, x):
+        return fn(x, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _make("ReLU", F.relu)
+ReLU6 = _make("ReLU6", F.relu6)
+GELU = _make("GELU", F.gelu, approximate=False)
+SiLU = _make("SiLU", F.silu)
+Swish = _make("Swish", F.silu)
+Mish = _make("Mish", F.mish)
+Sigmoid = _make("Sigmoid", F.sigmoid)
+Tanh = _make("Tanh", F.tanh)
+Hardswish = _make("Hardswish", F.hardswish)
+Hardsigmoid = _make("Hardsigmoid", F.hardsigmoid)
+Hardtanh = _make("Hardtanh", F.hardtanh, min=-1.0, max=1.0)
+LeakyReLU = _make("LeakyReLU", F.leaky_relu, negative_slope=0.01)
+ELU = _make("ELU", F.elu, alpha=1.0)
+SELU = _make("SELU", F.selu)
+CELU = _make("CELU", F.celu, alpha=1.0)
+Softplus = _make("Softplus", F.softplus, beta=1.0, threshold=20.0)
+Softsign = _make("Softsign", F.softsign)
+Softshrink = _make("Softshrink", F.softshrink, threshold=0.5)
+Hardshrink = _make("Hardshrink", F.hardshrink, threshold=0.5)
+Tanhshrink = _make("Tanhshrink", F.tanhshrink)
+LogSigmoid = _make("LogSigmoid", lambda x: F.log_softmax(x) if False else _logsig(x))
+ThresholdedReLU = _make("ThresholdedReLU", F.thresholded_relu, threshold=1.0)
+
+
+def _logsig(x):
+    from ..ops import log, sigmoid
+    return log(sigmoid(x))
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        from . import initializer as I
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)

@@ -1,0 +1,803 @@
+"""nn functional ops.
+
+Analog of python/paddle/nn/functional/* — conv/pool/norm/embedding/loss/attention.
+Convs and attention lower to single XLA ops (conv_general_dilated, dot_general)
+so the MXU gets large fused contractions (replacing cuDNN dispatch in
+phi/kernels/gpudnn and fused kernels in phi/kernels/fusion).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..ops.registry import defop
+from ..ops import activation as _act
+
+# re-export activations into functional namespace (paddle.nn.functional.relu etc.)
+from ..ops.activation import (relu, relu6, leaky_relu, prelu, elu, selu, celu,
+                              gelu, silu, swish, mish, hardswish, hardsigmoid,
+                              hardtanh, hardshrink, softshrink, tanhshrink,
+                              softplus, softsign, softmax, log_softmax,
+                              gumbel_softmax, glu, maxout, rrelu,
+                              thresholded_relu)  # noqa: F401
+from ..ops.math import sigmoid, tanh  # noqa: F401
+from ..ops.manipulation import pad  # noqa: F401
+
+
+@defop()
+def linear(x, weight, bias=None):
+    """paddle.nn.functional.linear: weight is [in_features, out_features]."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# -- convolution ------------------------------------------------------------
+
+def _conv_padding(padding, spatial, strides=None, dilations=None):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if builtins.all(isinstance(p, int) for p in padding):
+        if len(padding) == spatial:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * spatial:
+            return [(padding[2 * i], padding[2 * i + 1]) for i in range(spatial)]
+    return [tuple(p) for p in padding]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+@defop()
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """Conv2D over XLA conv_general_dilated (ref: phi/kernels/gpudnn/conv_kernel.cu).
+    weight layout [out_c, in_c/groups, kh, kw] (paddle OIHW)."""
+    lhs_spec = data_format
+    out_spec = data_format
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (lhs_spec, "OIHW", out_spec))
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=_tuple(stride, 2),
+        padding=_conv_padding(padding, 2),
+        rhs_dilation=_tuple(dilation, 2),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop()
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    spec = {"NCL": "NCH", "NLC": "NHC"}[data_format]
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape, (spec, "OIH", spec))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=_tuple(stride, 1),
+        padding=_conv_padding(padding, 1), rhs_dilation=_tuple(dilation, 1),
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        shape = [1, -1, 1] if data_format == "NCL" else [1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop()
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (data_format, "OIDHW", data_format))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=_tuple(stride, 3),
+        padding=_conv_padding(padding, 3), rhs_dilation=_tuple(dilation, 3),
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        shape = [1, -1, 1, 1, 1] if data_format == "NCDHW" else [1, 1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop()
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW"):
+    """weight layout [in_c, out_c/groups, kh, kw] (paddle IOHW for transpose)."""
+    stride = _tuple(stride, 2)
+    dilation = _tuple(dilation, 2)
+    pad2 = _conv_padding(padding, 2)
+    if isinstance(pad2, str):
+        padcfg = pad2
+    else:
+        # transpose conv padding: XLA wants the gradient-style padding
+        kh = (weight.shape[2] - 1) * dilation[0] + 1
+        kw = (weight.shape[3] - 1) * dilation[1] + 1
+        opad = _tuple(output_padding, 2)
+        padcfg = [(kh - 1 - pad2[0][0], kh - 1 - pad2[0][1] + opad[0]),
+                  (kw - 1 - pad2[1][0], kw - 1 - pad2[1][1] + opad[1])]
+    # IOHW -> flip spatial, swap io -> use as OIHW with transposed feature dims
+    w = jnp.flip(weight, axis=(2, 3))
+    if groups > 1:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = w.reshape(groups, ic // groups, ocg, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * ocg, ic // groups, *w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (data_format, "OIHW", data_format))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=padcfg,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+# -- pooling ----------------------------------------------------------------
+
+def _pool_dims(data_format, kernel, stride, padding, nd=2, x_shape=None,
+               ceil_mode=False):
+    kernel = _tuple(kernel, nd)
+    stride = _tuple(stride if stride is not None else kernel, nd)
+    spatial_pads = list(_conv_padding(padding, nd))
+    if ceil_mode and x_shape is not None:
+        # extend the high-side padding so the last partial window is kept
+        # (padding in reduce_window fills with the reduction identity)
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            spatial_sizes = x_shape[2:2 + nd]
+        else:
+            spatial_sizes = x_shape[1:1 + nd]
+        new_pads = []
+        for size, k, s, (pl, ph) in zip(spatial_sizes, kernel, stride,
+                                        spatial_pads):
+            eff = size + pl + ph
+            out_ceil = -(-(eff - k) // s) + 1
+            need = (out_ceil - 1) * s + k - eff
+            new_pads.append((pl, ph + builtins.max(need, 0)))
+        spatial_pads = new_pads
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + spatial_pads
+    else:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + spatial_pads + [(0, 0)]
+    return window, strides, pads
+
+
+def _max_pool_mask(x, window, strides, pads):
+    """Flattened-spatial argmax indices per pooling window (paddle mask
+    semantics for return_mask=True). Static unroll over the (small) kernel
+    offsets; NC-leading layouts."""
+    import itertools
+
+    kernel = window[2:]
+    stride = strides[2:]
+    spatial_pads = pads[2:]
+    lead = x.shape[:2]
+    spatial = x.shape[2:]
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + list(spatial_pads),
+                 constant_values=-jnp.inf)
+    flat = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(
+        (1, 1) + spatial)
+    flat = jnp.broadcast_to(flat, x.shape)
+    fp = jnp.pad(flat, [(0, 0), (0, 0)] + list(spatial_pads),
+                 constant_values=-1)
+    out_spatial = tuple(
+        (s + pl + ph - k) // st + 1
+        for s, k, st, (pl, ph) in zip(spatial, kernel, stride, spatial_pads))
+    vals, idxs = [], []
+    for offs in itertools.product(*[range(k) for k in kernel]):
+        starts = (0, 0) + offs
+        limits = lead + tuple(
+            o + (os - 1) * st + 1
+            for o, os, st in zip(offs, out_spatial, stride))
+        sl_strides = (1, 1) + stride
+        vals.append(jax.lax.slice(xp, starts, limits, sl_strides))
+        idxs.append(jax.lax.slice(fp, starts, limits, sl_strides))
+    V = jnp.stack(vals, axis=-1)
+    I = jnp.stack(idxs, axis=-1)
+    am = jnp.argmax(V, axis=-1)
+    return jnp.take_along_axis(I, am[..., None], axis=-1)[..., 0]
+
+
+@defop()
+def _max_pool(x, window, strides, pads):
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, neg, jax.lax.max, window, strides, pads)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    window, strides, pads = _pool_dims(data_format, kernel_size, stride,
+                                       padding, 2, tuple(x.shape), ceil_mode)
+    out = _max_pool(x, window, strides, pads)
+    if return_mask:
+        mask = Tensor(_max_pool_mask(x._data, window, strides, pads))
+        return out, mask
+    return out
+
+
+@defop()
+def _avg_pool(x, window, strides, pads, exclusive, divisor_override):
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive:
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                       pads)
+        return summed / counts
+    return summed / float(np.prod(window))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    window, strides, pads = _pool_dims(data_format, kernel_size, stride,
+                                       padding, 2, tuple(x.shape), ceil_mode)
+    return _avg_pool(x, window, strides, pads, exclusive, divisor_override)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL"):
+    window, strides, pads = _pool_dims(data_format, kernel_size, stride,
+                                       padding, 1, tuple(x.shape), ceil_mode)
+    out = _max_pool(x, window, strides, pads)
+    if return_mask:
+        mask = Tensor(_max_pool_mask(x._data, window, strides, pads))
+        return out, mask
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    window, strides, pads = _pool_dims(data_format, kernel_size, stride,
+                                       padding, 1, tuple(x.shape), ceil_mode)
+    return _avg_pool(x, window, strides, pads, exclusive, None)
+
+
+@defop()
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _tuple(output_size, 2)
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        out = x.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+    else:
+        # general case: per-output-cell slicing with static bounds
+        rows = []
+        for i in range(oh):
+            h0, h1 = (i * h) // oh, -(-((i + 1) * h) // oh)
+            cols = []
+            for j in range(ow):
+                w0, w1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+                cols.append(x[:, :, h0:h1, w0:w1].mean(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        out = jnp.stack(rows, axis=-2)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+@defop()
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool2d(return_mask=True)")
+    oh, ow = _tuple(output_size, 2)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return x.reshape(n, c, oh, h // oh, ow, w // ow).max(axis=(3, 5))
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * h) // oh, -(-((i + 1) * h) // oh)
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+            cols.append(x[:, :, h0:h1, w0:w1].max(axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+@defop()
+def adaptive_avg_pool1d(x, output_size):
+    n, c, l = x.shape
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    if l % o == 0:
+        return x.reshape(n, c, o, l // o).mean(axis=3)
+    cols = []
+    for j in range(o):
+        w0, w1 = (j * l) // o, -(-((j + 1) * l) // o)
+        cols.append(x[:, :, w0:w1].mean(axis=2))
+    return jnp.stack(cols, axis=-1)
+
+
+# -- normalization ----------------------------------------------------------
+
+@defop()
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    # reduce in fp32 for bf16 stability (reference: layer_norm fp32 accumulators)
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop()
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """RMSNorm (llama-family; ref incubate fused_rms_norm)."""
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@defop()
+def _batch_norm_train(x, weight, bias, axes, epsilon, reduce_shape):
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    bshape = reduce_shape
+    out = (xf - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out, mean, var
+
+
+@defop()
+def _batch_norm_eval(x, running_mean, running_var, weight, bias, epsilon,
+                     reduce_shape):
+    bshape = reduce_shape
+    out = (x - running_mean.reshape(bshape)) * \
+        jax.lax.rsqrt(running_var.reshape(bshape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None):
+    """paddle.nn.functional.batch_norm. Updates running stats in-place when
+    training (reference: phi batch_norm kernel updates mean_out/variance_out)."""
+    nd = x.ndim
+    if data_format in ("NCHW", "NCL", "NCDHW", "NC"):
+        ch_axis = 1
+    else:
+        ch_axis = nd - 1
+    axes = tuple(i for i in range(nd) if i != ch_axis)
+    bshape = [1] * nd
+    bshape[ch_axis] = -1
+    use_stats = use_global_stats if use_global_stats is not None else not training
+    if use_stats:
+        return _batch_norm_eval(x, running_mean, running_var, weight, bias,
+                                epsilon, tuple(bshape))
+    out, mean, var = _batch_norm_train(x, weight, bias, axes, epsilon, tuple(bshape))
+    if running_mean is not None:
+        m = momentum
+        new_mean = m * running_mean._data + (1 - m) * mean._data.astype(running_mean.dtype)
+        n = float(np.prod([x.shape[a] for a in axes]))
+        unbiased = var._data * (n / builtins.max(n - 1.0, 1.0))
+        new_var = m * running_var._data + (1 - m) * unbiased.astype(running_var.dtype)
+        running_mean._set_data(new_mean)
+        running_var._set_data(new_var)
+    return out
+
+
+@defop()
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    if data_format == "NHWC":
+        x_t = jnp.moveaxis(x, -1, 1)
+    else:
+        x_t = x
+    n, c = x_t.shape[:2]
+    spatial = x_t.shape[2:]
+    g = num_groups
+    xf = x_t.astype(jnp.float32) if x_t.dtype in (jnp.bfloat16, jnp.float16) else x_t
+    xg = xf.reshape(n, g, c // g, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x_t.shape).astype(x_t.dtype)
+    bshape = [1, c] + [1] * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@defop()
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW"):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        bshape = [1, -1] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        bshape = [1, -1] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@defop()
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True),
+                    1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+@defop()
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    sq = jnp.square(x)
+    half = size // 2
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, size - half - 1)
+    sq = jnp.pad(sq, pads)
+    window = [1] * x.ndim
+    window[1] = size
+    summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                   (1,) * x.ndim, [(0, 0)] * x.ndim)
+    return x / jnp.power(k + alpha * summed, beta)
+
+
+# -- embedding / one-hot ----------------------------------------------------
+
+@defop()
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+@defop()
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype_mod.get_default_dtype())
+
+
+# -- dropout ----------------------------------------------------------------
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    key = random_mod.next_key()
+    return _dropout_op(x, p=float(p), axis=axis, mode=mode, key=key)
+
+
+@defop(name="dropout")
+def _dropout_op(x, p, axis, mode, key):
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(x.dtype)
+    if mode == "upscale_in_train":
+        return x * mask / keep
+    return x * mask
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+# -- losses -----------------------------------------------------------------
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop()
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    """softmax_with_cross_entropy analog (phi/kernels/gpu/cross_entropy_kernel.cu)."""
+    nclass = input.shape[axis]
+    logp = jax.nn.log_softmax(input, axis=axis) if use_softmax else jnp.log(
+        jnp.maximum(input, 1e-30))
+    if soft_label:
+        soft = label
+        loss = -jnp.sum(soft * logp, axis=axis)
+        return _reduce(loss, reduction)
+    lbl = label
+    if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = (lbl != ignore_index)
+    safe_lbl = jnp.where(valid, lbl, 0)
+    oh = jax.nn.one_hot(safe_lbl, nclass, axis=axis, dtype=logp.dtype)
+    if label_smoothing > 0.0:
+        oh = oh * (1.0 - label_smoothing) + label_smoothing / nclass
+    loss = -jnp.sum(oh * logp, axis=axis)
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, safe_lbl, axis=0) * valid.astype(logp.dtype)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+@defop()
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@defop()
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@defop()
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    lbl = label.astype(jnp.int32)
+    valid = (lbl != ignore_index)
+    safe = jnp.where(valid, lbl, 0)
+    picked = -jnp.take_along_axis(input, safe[..., None], axis=-1)[..., 0]
+    picked = jnp.where(valid, picked, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, safe, axis=0) * valid.astype(input.dtype)
+        picked = picked * w
+        if reduction == "mean":
+            # paddle divides by the sum of applied weights, not sample count
+            return jnp.sum(picked) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        return jnp.sum(picked) / jnp.maximum(jnp.sum(valid.astype(input.dtype)), 1.0)
+    return _reduce(picked, reduction)
+
+
+@defop()
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop()
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    max_val = jnp.maximum(-logit, 0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop()
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@defop()
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@defop()
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@defop()
+def log_loss(input, label, epsilon=1e-4):
+    return -(label * jnp.log(input + epsilon) +
+             (1 - label) * jnp.log(1 - input + epsilon))
+
+
+# -- attention --------------------------------------------------------------
+
+@defop(name="scaled_dot_product_attention")
+def _sdpa_op(query, key, value, attn_mask=None, dropout_p=0.0,
+             is_causal=False, dropout_key=None):
+    b, sq, h, d = query.shape
+    scale = 1.0 / np.sqrt(d)
+    q = jnp.einsum("bshd->bhsd", query)
+    k = jnp.einsum("bshd->bhsd", key)
+    v = jnp.einsum("bshd->bhsd", value)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        sk = k.shape[2]
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, jnp.finfo(scores.dtype).min)
+        else:
+            scores = scores + attn_mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(query.dtype)
+    if dropout_key is not None and dropout_p > 0.0:
+        keep = 1.0 - dropout_p
+        mask = jax.random.bernoulli(dropout_key, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.einsum("bhsd->bshd", out)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True):
+    """paddle.nn.functional.scaled_dot_product_attention
+    (python/paddle/nn/functional/flash_attention.py) — layout [B, S, H, D].
+    Single fused XLA contraction chain; Pallas flash kernel swaps in via
+    paddle_tpu.ops.pallas when shapes allow (reference: third_party/flashattn)."""
+    key_ = random_mod.next_key() if (dropout_p > 0.0 and training) else None
+    return _sdpa_op(query, key, value, attn_mask=attn_mask,
+                    dropout_p=float(dropout_p), is_causal=is_causal,
+                    dropout_key=key_)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, **kwargs):
+    """incubate flash_attention analog (phi/kernels/gpu/flash_attn_kernel.cu:128)."""
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal)
+    return (out, None) if return_softmax else (out, None)
+
+
+# -- misc -------------------------------------------------------------------
+
+@defop()
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    n, c, h, w = x.shape
+    kh, kw = _tuple(kernel_sizes, 2)
+    sh, sw = _tuple(strides, 2)
+    dh, dw = _tuple(dilations, 2)
+    ph, pw = _tuple(paddings, 2)
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(jax.lax.slice(
+                xp, (0, 0, i * dh, j * dw),
+                (n, c, i * dh + (oh - 1) * sh + 1, j * dw + (ow - 1) * sw + 1),
+                (1, 1, sh, sw)))
+    stacked = jnp.stack(patches, axis=2)  # n, c, kh*kw, oh, ow
+    return stacked.reshape(n, c * kh * kw, oh * ow)
+
+
+@defop()
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@defop()
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    n, c, h, w = x.shape
+    if size is None:
+        sf = _tuple(scale_factor, 2)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    else:
+        size = _tuple(size, 2)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "area": "linear"}[mode]
+    out = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    return out
+
+
+upsample = interpolate
+
+
+@defop()
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+@defop()
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x[:, 1:, :fold], jnp.zeros_like(x[:, :1, :fold])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]),
+                             x[:, :-1, fold:2 * fold]], axis=1)
+    rest = x[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)

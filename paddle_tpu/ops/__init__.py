@@ -1,0 +1,184 @@
+"""Functional op surface.
+
+This package is the analog of the reference's generated op API
+(paddle/phi/api + python/paddle/tensor/*): every public op is a thin pure-jax
+function registered through ops.registry (which handles Tensor unwrap, AMP,
+and autograd recording). Tensor methods are installed here, mirroring the
+reference's math-op monkey patch (paddle/fluid/pybind/eager_math_op_patch.cc
+and python/paddle/tensor/__init__.py method registration).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .registry import defop, get_op, OP_REGISTRY, tensor_method
+
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .activation import *  # noqa: F401,F403
+
+from . import math as _math
+from . import creation as _creation
+from . import manipulation as _manip
+from . import logic as _logic
+from . import linalg as _linalg
+from . import activation as _act
+
+# paddle.any / paddle.all names (logic defines any_/all_ to avoid builtins)
+any = _logic.any_
+all = _logic.all_
+
+# re-point shadowed builtins to the op versions for the public namespace
+sum = _math.sum_
+max = _math.max_
+min = _math.min_
+abs = _math.abs
+pow = _math.pow_
+round = _math.round
+
+
+# -- indexing ---------------------------------------------------------------
+
+@defop(name="slice_select")
+def _getitem_op(x, idx):
+    return x[idx if not isinstance(idx, list) else tuple(idx)]
+
+
+@defop(name="set_item")
+def _setitem_op(x, idx, value):
+    return x.at[idx if not isinstance(idx, list) else tuple(idx)].set(value)
+
+
+def _tensor_getitem(self, idx):
+    return _getitem_op(self, idx)
+
+
+def _tensor_setitem(self, idx, value):
+    # In-place semantics over a functional scatter. The tape node must
+    # reference the PRE-assignment value, so hand it a shadow tensor carrying
+    # the old data + old grad node; rebinding self's node to the scatter
+    # output then can't create a self-cycle in the backward graph.
+    old = Tensor(self._data, stop_gradient=self.stop_gradient)
+    old._grad_node = self._grad_node
+    old._grad_out_idx = self._grad_out_idx
+    out = _setitem_op(old, idx, value)
+    if old._grad_node is None and not old.stop_gradient:
+        # self was a differentiable leaf: forward the shadow's grads to it
+        from ..autograd import hooks as _hooks
+        _hooks.register_tensor_hook(
+            old, lambda g, _t=self: (_t._accumulate_grad(g._data), g)[1])
+    self._data = out._data
+    self._grad_node = out._grad_node
+    self._grad_out_idx = out._grad_out_idx
+    if not out.stop_gradient:
+        self.stop_gradient = False
+
+
+Tensor.__getitem__ = _tensor_getitem
+Tensor.__setitem__ = _tensor_setitem
+
+
+# -- operators --------------------------------------------------------------
+
+def _binop(fn, swap=False):
+    def op(self, other):
+        if other is NotImplemented or isinstance(other, (str, type(None))):
+            return NotImplemented
+        if swap:
+            if not isinstance(other, Tensor):
+                other = Tensor(other)
+            return fn(other, self)
+        return fn(self, other)
+    return op
+
+
+Tensor.__add__ = _binop(_math.add)
+Tensor.__radd__ = _binop(_math.add, swap=True)
+Tensor.__sub__ = _binop(_math.subtract)
+Tensor.__rsub__ = _binop(_math.subtract, swap=True)
+Tensor.__mul__ = _binop(_math.multiply)
+Tensor.__rmul__ = _binop(_math.multiply, swap=True)
+Tensor.__truediv__ = _binop(_math.divide)
+Tensor.__rtruediv__ = _binop(_math.divide, swap=True)
+Tensor.__floordiv__ = _binop(_math.floor_divide)
+Tensor.__rfloordiv__ = _binop(_math.floor_divide, swap=True)
+Tensor.__mod__ = _binop(_math.mod)
+Tensor.__rmod__ = _binop(_math.mod, swap=True)
+Tensor.__pow__ = _binop(_math.pow_)
+Tensor.__rpow__ = _binop(_math.pow_, swap=True)
+Tensor.__matmul__ = _binop(_linalg.matmul)
+Tensor.__rmatmul__ = _binop(_linalg.matmul, swap=True)
+Tensor.__neg__ = lambda self: _math.neg(self)
+Tensor.__abs__ = lambda self: _math.abs(self)
+Tensor.__invert__ = lambda self: _logic.logical_not(self)
+Tensor.__eq__ = _binop(_logic.equal)
+Tensor.__ne__ = _binop(_logic.not_equal)
+Tensor.__lt__ = _binop(_logic.less_than)
+Tensor.__le__ = _binop(_logic.less_equal)
+Tensor.__gt__ = _binop(_logic.greater_than)
+Tensor.__ge__ = _binop(_logic.greater_equal)
+Tensor.__and__ = _binop(_logic.logical_and)
+Tensor.__or__ = _binop(_logic.logical_or)
+Tensor.__xor__ = _binop(_logic.logical_xor)
+Tensor.__hash__ = object.__hash__
+
+
+# -- method installation ----------------------------------------------------
+
+_METHOD_SOURCES = [_math, _manip, _linalg, _act, _logic, _creation]
+_METHOD_NAMES = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "scale",
+    "maximum", "minimum", "fmax", "fmin", "lerp", "exp", "expm1", "log", "log2",
+    "log10", "log1p", "sqrt", "rsqrt", "square", "sin", "cos", "tan", "asin",
+    "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "erf",
+    "erfinv", "floor", "ceil", "trunc", "frac", "sign", "reciprocal", "sigmoid",
+    "clip", "nan_to_num", "mean", "prod", "logsumexp", "std", "var", "median",
+    "nanmean", "nansum", "cumsum", "cumprod", "trace", "diff", "kron",
+    "count_nonzero", "argmax", "argmin", "addmm", "outer", "inner", "dot",
+    "lgamma", "digamma", "angle", "conj", "real", "imag", "atan2", "increment",
+    # manipulation
+    "reshape", "flatten", "transpose", "moveaxis", "swapaxes", "squeeze",
+    "unsqueeze", "unstack", "unbind", "split", "chunk", "expand",
+    "broadcast_to", "expand_as", "tile", "repeat_interleave", "flip", "roll",
+    "rot90", "gather", "index_select", "take_along_axis", "put_along_axis",
+    "gather_nd", "scatter", "scatter_nd_add", "nonzero", "masked_select",
+    "masked_fill", "index_put", "index_add", "pad", "sort", "argsort", "topk",
+    "unique", "numel", "as_real", "as_complex",
+    # linalg
+    "matmul", "mm", "bmm", "mv", "norm", "dist", "cross", "cholesky",
+    "inverse", "pinv", "solve", "qr", "svd", "det", "slogdet", "matrix_power",
+    "matrix_rank", "cov", "corrcoef", "bincount", "histogram",
+    # activation
+    "relu", "gelu", "silu", "softmax", "log_softmax", "tanhshrink", "softplus",
+    "softsign", "hardswish", "hardsigmoid", "hardtanh",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "isnan",
+    "isinf", "isfinite", "isclose", "allclose", "equal_all",
+    # creation-style
+    "zeros_like", "ones_like", "full_like", "tril", "triu",
+]
+
+for _name in _METHOD_NAMES:
+    for _src in _METHOD_SOURCES:
+        _fn = getattr(_src, _name, None) or getattr(_src, _name + "_", None)
+        if _fn is not None:
+            tensor_method(_name, _fn)
+            break
+
+tensor_method("sum", _math.sum_)
+tensor_method("max", _math.max_)
+tensor_method("min", _math.min_)
+tensor_method("abs", _math.abs)
+tensor_method("pow", _math.pow_)
+tensor_method("any", _logic.any_)
+tensor_method("all", _logic.all_)
+tensor_method("round", _math.round)
+tensor_method("neg", _math.neg)

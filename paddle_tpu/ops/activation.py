@@ -1,0 +1,171 @@
+"""Activation ops (python/paddle/nn/functional/activation.py analog).
+
+All are single fused XLA expressions; the reference's handwritten activation
+kernels (phi/kernels/gpu/activation_kernel.cu) are subsumed by XLA fusion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+@defop()
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@defop()
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@defop()
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@defop()
+def prelu(x, weight, data_format="NCHW"):
+    if weight.size > 1:
+        ax = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ax] = -1
+        weight = weight.reshape(shape)
+    return jnp.where(x > 0, x, weight * x)
+
+
+@defop()
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@defop()
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop()
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@defop()
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@defop()
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@defop()
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@defop()
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@defop()
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop()
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@defop()
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop()
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop()
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@defop()
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(beta * x > threshold, x,
+                     jnp.log1p(jnp.exp(beta * x)) / beta)
+
+
+@defop()
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@defop()
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        x = x.astype(dtype_mod.to_jax_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@defop()
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        x = x.astype(dtype_mod.to_jax_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@defop()
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ..core import random as random_mod
+    g = jax.random.gumbel(random_mod.next_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        hard_y = jnp.zeros_like(y)
+        hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis, inplace=False)
+        y = hard_y + y - jax.lax.stop_gradient(y)
+    return y
+
+
+@defop()
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@defop()
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@defop()
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False):
+    if training:
+        from ..core import random as random_mod
+        slope = jax.random.uniform(random_mod.next_key(), x.shape, x.dtype,
+                                   lower, upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@defop()
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)

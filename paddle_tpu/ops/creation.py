@@ -1,0 +1,231 @@
+"""Tensor creation + random ops.
+
+API surface follows python/paddle/tensor/creation.py and random.py; the RNG is
+the global splittable generator (core/random.py, reference Generator analog
+phi/core/generator.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from .registry import defop
+
+
+def _dt(dtype, default_float=True):
+    d = dtype_mod.to_jax_dtype(dtype)
+    if d is None and default_float:
+        d = dtype_mod.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor analog."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+@defop()
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@defop()
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@defop()
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = dtype_mod.to_jax_dtype(dtype)
+    if d is None:
+        d = jnp.int64 if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def _scalar(x):
+    return x.item() if isinstance(x, Tensor) else x
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if arr.ndim == 1 and padding_value != 0:
+        n = arr.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, arr.dtype)
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        return Tensor(jnp.where(mask, jnp.diag(arr, k=offset), base))
+    return Tensor(jnp.diag(arr, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.diagflat(arr, k=offset))
+
+
+@defop()
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@defop()
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    from .math import assign as _assign
+    out = _assign(x if isinstance(x, Tensor) else Tensor(np.asarray(x)))
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+# -- random -----------------------------------------------------------------
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = random_mod.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = random_mod.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(key, shp, dtype_mod.get_default_dtype()))
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape),
+                                                 dtype_mod.get_default_dtype()))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    key = random_mod.next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def randn(*shape, dtype=None, name=None):
+    if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+        shape = shape[0]
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high,
+                                     dtype=dtype_mod.to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dtype = dtype or x.dtype
+    return randint(low, high, tuple(x.shape), dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = random_mod.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(dtype_mod.to_jax_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    key = random_mod.next_key()
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(key, p).astype(p.dtype))
+
+
+def poisson(x, name=None):
+    key = random_mod.next_key()
+    lam = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(key, lam).astype(lam.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = random_mod.next_key()
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples,) + p.shape[:-1])
+        if p.ndim == 1:
+            return Tensor(out.astype(jnp.int64))
+        return Tensor(jnp.moveaxis(out, 0, -1).astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, p.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))

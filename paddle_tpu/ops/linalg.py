@@ -1,0 +1,206 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py analog).
+
+matmul is the MXU workhorse — everything stays a single XLA dot_general so the
+compiler can tile it onto the systolic array (reference dispatches to cuBLAS via
+phi/kernels/impl/matmul_kernel_impl.h; SPMD rule legacy_ops.yaml:725-733).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+@defop()
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@defop()
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop()
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop()
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@defop()
+def einsum_op(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return einsum_op(equation, *operands)
+
+
+@defop()
+def norm(x, p=None, axis=None, keepdim=False):
+    if p is None:
+        p = "fro" if axis is None or not isinstance(axis, int) else 2
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim),
+                     1.0 / p)
+
+
+p_norm = norm
+
+
+@defop()
+def dist(x, y, p=2):
+    d = jnp.abs(x - y)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+
+@defop()
+def cross(x, y, axis=9):
+    axis = 0 if axis == 9 and x.shape[0] == 3 else (axis if axis != 9 else -1)
+    return jnp.cross(x, y, axis=axis)
+
+
+@defop()
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@defop()
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@defop()
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop()
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop()
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@defop()
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@defop()
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@defop()
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@defop()
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+@defop()
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@defop()
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@defop()
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@defop()
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@defop()
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@defop()
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop(differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop()
+def multi_dot(x):
+    return jnp.linalg.multi_dot(list(x))
+
+
+@defop()
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@defop()
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop()
+def cdist(x, y, p=2.0):
+    d = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == float("inf"):
+        return jnp.max(d, axis=-1)
+    return jnp.power(jnp.sum(jnp.power(d, p), axis=-1), 1.0 / p)
+
+
+@defop()
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(input, bins=bins, range=rng, weights=weight,
+                            density=density)
+    return hist
+
+
+@defop()
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)

@@ -1,0 +1,87 @@
+"""Comparison and logical ops (python/paddle/tensor/logic.py analog)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+def _cmp(name, fn):
+    @defop(name=name, differentiable=False)
+    def op(x, y):
+        return fn(x, y)
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+@defop(differentiable=False)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@defop(differentiable=False)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@defop(differentiable=False)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@defop(differentiable=False)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@defop(differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@defop(differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop(differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop(differentiable=False)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@defop(differentiable=False)
+def any_(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+@defop(differentiable=False)
+def all_(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+@defop(differentiable=False)
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+@defop(differentiable=False)
+def in1d(x, test):
+    return jnp.isin(x, test)

@@ -1,0 +1,355 @@
+"""Shape / layout / indexing manipulation ops.
+
+API follows python/paddle/tensor/manipulation.py; kernels are XLA gather/
+scatter/reshape HLOs (replacing phi/kernels/{cpu,gpu} manipulation kernels and
+the stride/view kernels in phi/kernels/stride/).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from .registry import defop
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+@defop()
+def reshape(x, shape):
+    return jnp.reshape(x, _static_shape(shape))
+
+
+@defop()
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+@defop()
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+@defop()
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@defop()
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@defop()
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a % builtins.max(x.ndim, 1) for a in axis if x.shape[a % builtins.max(x.ndim, 1)] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@defop()
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    out = x
+    for a in sorted(a % (out.ndim + 1) for a in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@defop()
+def concat(x, axis=0):
+    return jnp.concatenate(list(x), axis=int(axis) if not hasattr(axis, "item") else int(axis.item()))
+
+
+@defop()
+def stack(x, axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+@defop()
+def unstack(x, axis=0, num=None):
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, n, axis=axis))
+
+
+@defop()
+def unbind(x, axis=0):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, x.shape[axis], axis=axis))
+
+
+@defop()
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s == -1 for s in sections):
+        known = builtins.sum(s for s in sections if s != -1)
+        sections = [total - known if s == -1 else s for s in sections]
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@defop()
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+
+@defop()
+def expand(x, shape):
+    shape = _static_shape(shape)
+    # paddle allows -1 = keep dim
+    cur = (1,) * (len(shape) - x.ndim) + tuple(x.shape)
+    tgt = tuple(c if s == -1 else s for s, c in zip(shape, cur))
+    return jnp.broadcast_to(x, tgt)
+
+
+@defop()
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, _static_shape(shape))
+
+
+@defop()
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_tensors(inputs):
+    arrs = [t._data for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    from . import registry
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+@defop()
+def tile(x, repeat_times):
+    return jnp.tile(x, _static_shape(repeat_times))
+
+
+@defop()
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@defop()
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@defop()
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@defop()
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@defop()
+def gather(x, index, axis=0):
+    axis = int(axis)
+    return jnp.take(x, index.reshape(-1) if index.ndim > 1 else index, axis=axis)
+
+
+@defop()
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@defop()
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@defop()
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, values, axis=axis, inplace=False)
+    mode = {"add": "add", "multiply": "multiply", "mul": "multiply"}[reduce]
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(arr.ndim)])
+           for d, s in enumerate(indices.shape)]
+    idx[axis] = indices
+    if mode == "add":
+        return arr.at[tuple(idx)].add(values)
+    return arr.at[tuple(idx)].multiply(values)
+
+
+@defop()
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@defop()
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@defop()
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = Tensor(jnp.zeros(_static_shape(shape), updates.dtype))
+    return scatter_nd_add(zeros, index, updates)
+
+
+@defop()
+def where(condition, x=None, y=None):
+    return jnp.where(condition, x, y)
+
+
+@defop(differentiable=False)
+def nonzero(x, as_tuple=False):
+    idx = jnp.nonzero(x)  # data-dependent shape: eager only
+    if as_tuple:
+        return tuple(i for i in idx)
+    return jnp.stack(idx, axis=1).astype(jnp.int64)
+
+
+@defop()
+def masked_select(x, mask):
+    return x[mask]  # data-dependent shape: eager only
+
+
+@defop()
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@defop()
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(i for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@defop()
+def index_add(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+@defop()
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_last_axis=None):
+    """paddle.nn.functional.pad semantics: `pad` pairs apply to trailing axes
+    (or all axes when len(pad) == 2*ndim)."""
+    pad = _static_shape(pad) if not isinstance(pad, (list, tuple)) else list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        k = len(pad) // 2
+        # paddle pads the *spatial* axes: last k dims, given in reverse-last order
+        pairs = [(0, 0)] * (nd - k) + [(pad[2 * i], pad[2 * i + 1]) for i in range(k)]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pairs, mode=jmode, constant_values=value)
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+@defop(differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@defop(differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+# -- sorting / topk ---------------------------------------------------------
+
+@defop()
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=axis, stable=stable or None)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@defop(differentiable=False)
+def argsort(x, axis=-1, descending=False, stable=False):
+    idx = jnp.argsort(x, axis=axis, stable=stable or None)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.int64)
+
+
+@defop()
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if isinstance(k, jax.Array):
+        k = int(k)
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+
+
+@defop(differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64"):
+    res = jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+@defop()
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@defop()
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@defop()
+def numel(x):
+    return jnp.asarray(x.size, dtype=jnp.int64)
+
+
+@defop()
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (input >= lo) & (input < hi)
+    return jnp.where(in_shard, input - lo, ignore_value)

@@ -1,0 +1,350 @@
+"""Elementwise math + reduction ops.
+
+Kernel-library analog: phi/kernels/{cpu,gpu}/*_kernel.* and
+phi/kernels/funcs/elementwise_base.h broadcast machinery — all replaced by XLA
+emission via jnp. Op names/signatures follow python/paddle/tensor/math.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from .registry import defop
+
+# -- casting / copy ---------------------------------------------------------
+
+@defop()
+def cast(x, dtype):
+    return x.astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@defop()
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+@defop()
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@defop()
+def increment(x, value=1.0):
+    return x + value
+
+
+# -- binary elementwise -----------------------------------------------------
+
+@defop()
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@defop()
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@defop()
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@defop()
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@defop()
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@defop()
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+@defop(name="pow")
+def pow_(x, y):
+    return jnp.power(x, y)
+
+
+@defop()
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@defop()
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@defop()
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@defop()
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@defop()
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@defop()
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@defop()
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+# -- unary elementwise ------------------------------------------------------
+
+def _unary(name, fn):
+    @defop(name=name)
+    def op(x):
+        return fn(x)
+    return op
+
+
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unary("square", jnp.square)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sign = _unary("sign", jnp.sign)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logit = _unary("logit", jax.scipy.special.logit)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+i0 = _unary("i0", jax.scipy.special.i0)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+
+@defop()
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@defop()
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# -- reductions -------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop(name="sum")
+def sum_(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_norm_axis(axis),
+                   dtype=dtype_mod.to_jax_dtype(dtype), keepdims=keepdim)
+
+
+@defop()
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop(name="max")
+def max_(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop(name="min")
+def min_(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim,
+                    dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@defop()
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@defop()
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@defop()
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_norm_axis(axis),
+                      dtype=dtype_mod.to_jax_dtype(dtype), keepdims=keepdim)
+
+
+@defop()
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@defop()
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@defop()
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    return vals
+
+
+@defop()
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cummin(x, axis=axis)
+
+
+@defop()
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop()
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@defop()
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@defop(differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+# -- argmax family (non-differentiable) ------------------------------------
+
+@defop(differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@defop(differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@defop()
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@defop()
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@defop()
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@defop()
+def dot(x, y):
+    # paddle.dot: 1-D/2-D batched inner product along last dim
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop()
+def multiply_no_broadcast(x, y):
+    return x * y
+
+
+@defop()
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)

@@ -1,0 +1,161 @@
+"""Op registry and autograd-recording dispatch.
+
+TPU-native redesign of the reference's op machinery: the yaml op registry +
+generated ad_func layer (paddle/phi/api/yaml/ops.yaml, eager codegen
+eager_gen.py:251 — AMP cast -> phi API call -> GradNode creation) collapses here
+into one decorator. Each op is a pure jax function over arrays; dispatch()
+
+  1. unwraps Tensor args (KernelContext analog, phi/core/kernel_utils.h),
+  2. applies the active AMP cast policy (amp/auto_cast.py:703 analog),
+  3. runs the op — XLA is the kernel library (phi/kernels analog), and
+  4. if grad is required, records a GradNode holding the jax.vjp closure
+     (grad_node_info.h:197 analog).
+
+Double backward (paddle.grad(create_graph=True), reference double_grad ops in
+backward.yaml) is served by replay_node_vjp: the node's forward is re-executed
+under jax.vjp *at Tensor level*, so the backward computation itself lands on
+the tape and can be differentiated again.
+
+This replaces ~420k LoC of handwritten kernels (phi/kernels) and ~45k LoC of
+generated API code with XLA emission + one generic dispatch path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import engine
+from ..autograd.engine import GradNode
+from ..core.tensor import Tensor
+
+OP_REGISTRY: Dict[str, dict] = {}
+
+_ARRAY_TYPES = (jax.Array, jax.core.Tracer, np.ndarray)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _wrap_out_leaf(leaf, stop_gradient):
+    if getattr(leaf, "dtype", None) == jax.dtypes.float0:
+        return leaf
+    if isinstance(leaf, _ARRAY_TYPES) or np.isscalar(leaf):
+        return Tensor(leaf, stop_gradient=stop_gradient)
+    return leaf
+
+
+def dispatch(fn: Callable, args, kwargs, op_name: str,
+             differentiable: bool = True):
+    """Run one op with unwrap/AMP/autograd-record. The single hot path
+    (reference: steps 2-4 of SURVEY.md §3.2)."""
+    from ..amp import autocast_args  # late import; amp layers on ops
+    args, kwargs = autocast_args(op_name, args, kwargs)
+
+    flat, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    t_pos = [i for i, x in enumerate(flat) if _is_tensor(x)]
+    in_tensors = [flat[i] for i in t_pos]
+    arrays = [t._data for t in in_tensors]
+
+    requires = (differentiable and engine.is_grad_enabled()
+                and any(not t.stop_gradient for t in in_tensors))
+
+    def call(*arrs):
+        buf = list(flat)
+        for i, a in zip(t_pos, arrs):
+            buf[i] = a
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, buf)
+        return fn(*a2, **k2)
+
+    if not requires:
+        out = call(*arrays)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    out, raw_vjp = jax.vjp(call, *arrays)
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    out_avals = [(tuple(l.shape), l.dtype) for l in out_leaves]
+
+    def vjp_fn(flat_cts, _raw=raw_vjp, _td=out_treedef):
+        return _raw(jax.tree_util.tree_unflatten(_td, list(flat_cts)))
+
+    needs = [not t.stop_gradient for t in in_tensors]
+    node = GradNode(op_name, vjp_fn, in_tensors, needs, out_avals)
+    node.call = call
+    node.out_treedef = out_treedef
+    wrapped_leaves = []
+    for idx, leaf in enumerate(out_leaves):
+        t = Tensor(leaf, stop_gradient=False)
+        t._grad_node = node
+        t._grad_out_idx = idx
+        wrapped_leaves.append(t)
+    if len(wrapped_leaves) == 1 and out is out_leaves[0]:
+        return wrapped_leaves[0]
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped_leaves)
+
+
+def replay_node_vjp(node: GradNode, cotangents):
+    """Tensor-level vjp replay for create_graph (double-backward) mode.
+
+    Re-runs the node's pure forward under jax.vjp with both the original
+    inputs and the cotangents as live tensor args, so the produced grads carry
+    GradNodes and depend on the inputs (residual path) — grad-of-grad works.
+    """
+    n_in = len(node.inputs)
+    call = node.call
+    out_treedef = node.out_treedef
+
+    def fn(*arrs):
+        ins = arrs[:n_in]
+        cts = arrs[n_in:]
+        _, vjp = jax.vjp(call, *ins)
+        return tuple(vjp(jax.tree_util.tree_unflatten(out_treedef, list(cts))))
+
+    return dispatch(fn, tuple(node.inputs) + tuple(cotangents), {},
+                    op_name=node.name + "_grad")
+
+
+def defop(name: Optional[str] = None, differentiable: bool = True):
+    """Register a pure jax function `fn(*arrays, **attrs)` as a framework op.
+
+    differentiable=False ops (argmax, comparisons, ...) never record tape nodes.
+    """
+
+    def deco(fn: Callable):
+        op_name = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return dispatch(fn, args, kwargs, op_name, differentiable)
+
+        OP_REGISTRY[op_name] = {"fn": fn, "wrapper": wrapper,
+                                "differentiable": differentiable}
+        wrapper.op_name = op_name
+        wrapper.raw_fn = fn
+        return wrapper
+
+    return deco
+
+
+def _wrap_outputs(out, stop_gradient):
+    leaves, treedef = jax.tree_util.tree_flatten(out)
+    wrapped = [_wrap_out_leaf(l, stop_gradient) for l in leaves]
+    if len(wrapped) == 1 and out is leaves[0]:
+        return wrapped[0]
+    return jax.tree_util.tree_unflatten(treedef, wrapped)
+
+
+def get_op(name: str):
+    return OP_REGISTRY[name]["wrapper"]
+
+
+_TENSOR_METHOD_NAMES = []
+
+
+def tensor_method(name: str, fn: Callable):
+    """Install a method on Tensor (eager_math_op_patch analog)."""
+    setattr(Tensor, name, fn)
+    _TENSOR_METHOD_NAMES.append(name)

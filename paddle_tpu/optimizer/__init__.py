@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer (python/paddle/optimizer analog)."""
+from . import lr
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue)
+from .optimizer import Optimizer
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+                         Momentum, RMSProp)

@@ -1,0 +1,64 @@
+"""Gradient clipping (python/paddle/nn/clip.py analog: ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). The hybrid-parallel global-norm variant
+lives in distributed.fleet (hybrid_parallel_optimizer.py:44 analog)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params):
+        for p in params:
+            if p.grad is not None:
+                p.grad = Tensor(jnp.clip(p.grad._data, self.min, self.max))
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params):
+        for p in params:
+            if p.grad is not None:
+                g = p.grad._data
+                norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                p.grad = Tensor((g.astype(jnp.float32) * factor).astype(g.dtype))
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params):
+        grads = [p.grad._data for p in params if p.grad is not None]
+        if not grads:
+            return
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        global_norm = jnp.sqrt(sq)
+        factor = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        for p in params:
+            if p.grad is not None:
+                g = p.grad._data
+                p.grad = Tensor((g.astype(jnp.float32) * factor).astype(g.dtype))
+
+    # functional form for jitted paths
+    def apply_to_arrays(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in grads if g is not None)
+        global_norm = jnp.sqrt(sq)
+        factor = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return [None if g is None else
+                (g.astype(jnp.float32) * factor).astype(g.dtype) for g in grads]

@@ -1,0 +1,185 @@
+"""Optimizer base class.
+
+Analog of python/paddle/optimizer/optimizer.py: accumulator management
+(_create_accumulators / _add_accumulator), lr scheduling, grad clip, and
+multi_precision master weights (reference: multi_precision in adamw kernel,
+phi/kernels/gpu/adamw_kernel.cu).
+
+TPU design note: every optimizer exposes a *functional* update
+`_update(param_array, grad_array, state_dict) -> (new_param, new_state)` that
+is pure jax — so the same optimizer drives both the eager `step()` path and
+fully-jitted train steps (where XLA fuses the whole update into one kernel).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float):
+            self._weight_decay = weight_decay
+        else:
+            self._weight_decay = weight_decay  # None or regularizer object
+        # name -> {param_id -> jax array}
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._master_weights: Dict[int, jax.Array] = {}
+        self._step_count = 0
+        self._current_param = None  # set during step() for per-param policies
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, dtype=None):
+        store = self._accumulators.setdefault(name, {})
+        if id(param) not in store:
+            store[id(param)] = jnp.full(param._data.shape, fill_value,
+                                        dtype or jnp.float32)
+        return store[id(param)]
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][id(param)]
+
+    def _master_weight(self, param):
+        if id(param) not in self._master_weights:
+            self._master_weights[id(param)] = param._data.astype(jnp.float32)
+        return self._master_weights[id(param)]
+
+    # -- the functional core (overridden per optimizer) ---------------------
+    def _create_accumulators_for(self, param):
+        """Populate self._accumulators entries for one param."""
+        raise NotImplementedError
+
+    def _update(self, p, g, state, lr):
+        """Pure update: (param_array, grad_array, state dict, lr) ->
+        (new_param, new_state). Must be jax-pure (jit-safe)."""
+        raise NotImplementedError
+
+    def _state_names(self) -> List[str]:
+        raise NotImplementedError
+
+    # -- eager step ---------------------------------------------------------
+    @no_grad()
+    def step(self):
+        lr = self.get_lr()
+        params = [p for p in self._parameter_list
+                  if p.trainable and p.grad is not None]
+        if self._grad_clip is not None:
+            self._grad_clip(params)
+        for p in params:
+            self._current_param = p
+            self._create_accumulators_for(p)
+            use_master = self._multi_precision and p.dtype != jnp.float32
+            state = {name: self._accumulators[name][id(p)]
+                     for name in self._state_names()}
+            pdata = self._master_weight(p) if use_master else p._data
+            g = p.grad._data
+            if g.dtype != pdata.dtype:
+                g = g.astype(pdata.dtype)
+            new_p, new_state = self._update(pdata, g, state, lr)
+            if use_master:
+                self._master_weights[id(p)] = new_p
+                p._set_data(new_p.astype(p.dtype))
+            else:
+                p._set_data(new_p)
+            for name, v in new_state.items():
+                self._accumulators[name][id(p)] = v
+        self._current_param = None
+        self._step_count += 1
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self):
+        sd = OrderedDict()
+        name_of = {id(p): (p.name or f"param_{i}")
+                   for i, p in enumerate(self._parameter_list)}
+        for acc_name, store in self._accumulators.items():
+            for pid, arr in store.items():
+                sd[f"{name_of.get(pid, pid)}.{acc_name}"] = Tensor(arr)
+        for pid, arr in self._master_weights.items():
+            sd[f"{name_of.get(pid, pid)}.master_weight"] = Tensor(arr)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        name_of = {(p.name or f"param_{i}"): p
+                   for i, p in enumerate(self._parameter_list)}
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for key, value in state_dict.items():
+            if key in ("LR_Scheduler", "@step"):
+                continue
+            pname, acc_name = key.rsplit(".", 1)
+            p = name_of.get(pname)
+            if p is None:
+                continue
+            arr = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+            if acc_name == "master_weight":
+                self._master_weights[id(p)] = arr
+            else:
+                self._accumulators.setdefault(acc_name, {})[id(p)] = arr
+
+    # -- hooks for jitted training (used by paddle_tpu.jit.TrainStep) -------
+    def _functional_states(self, params):
+        """Return (state_pytree, apply_fn) for a fully-jitted train step."""
+        for p in params:
+            self._create_accumulators_for(p)
+        states = [{name: self._accumulators[name][id(p)]
+                   for name in self._state_names()} for p in params]
+        return states
+
+    def _apply_functional(self, params_data, grads_data, states, lr):
+        new_params, new_states = [], []
+        for pdata, g, st in zip(params_data, grads_data, states):
+            if g is None:
+                new_params.append(pdata)
+                new_states.append(st)
+                continue
+            if g.dtype != pdata.dtype:
+                g = g.astype(pdata.dtype)
+            np_, ns = self._update(pdata, g, st, lr)
+            new_params.append(np_)
+            new_states.append(ns)
+        return new_params, new_states

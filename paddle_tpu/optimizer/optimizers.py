@@ -1,0 +1,292 @@
+"""Concrete optimizers (python/paddle/optimizer/{sgd,momentum,adam,adamw,...}.py).
+
+Each defines the pure `_update` used by both eager step() and jitted train
+steps; phi fused kernels (fused_adam, phi/kernels/fusion) are replaced by XLA
+fusing the whole elementwise update chain.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _state_names(self):
+        return []
+
+    def _create_accumulators_for(self, param):
+        pass
+
+    def _update(self, p, g, state, lr):
+        if isinstance(self._weight_decay, float):
+            g = g + self._weight_decay * p
+        return p - lr * g, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _state_names(self):
+        return ["velocity"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("velocity", param)
+
+    def _update(self, p, g, state, lr):
+        if isinstance(self._weight_decay, float):
+            g = g + self._weight_decay * p
+        v = self._momentum * state["velocity"].astype(g.dtype) + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _state_names(self):
+        return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("moment1", param)
+        self._add_accumulator("moment2", param)
+        store1 = self._accumulators.setdefault("beta1_pow", {})
+        store2 = self._accumulators.setdefault("beta2_pow", {})
+        if id(param) not in store1:
+            store1[id(param)] = jnp.asarray(1.0, jnp.float32)
+            store2[id(param)] = jnp.asarray(1.0, jnp.float32)
+
+    def _decayed_grad(self, p, g):
+        if isinstance(self._weight_decay, float):
+            return g + self._weight_decay * p
+        return g
+
+    def _update(self, p, g, state, lr):
+        g = self._decayed_grad(p, g)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"].astype(g.dtype) + (1 - b1) * g
+        v = b2 * state["moment2"].astype(g.dtype) + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1p.astype(g.dtype))
+        vhat = v / (1 - b2p.astype(g.dtype))
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, {"moment1": m, "moment2": v,
+                       "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name=name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    @property
+    def _no_decay(self):
+        # base step() sets _current_param so the decay filter can see the name
+        p = self._current_param
+        if p is None or self._apply_decay_param_fun is None:
+            return False
+        return not self._apply_decay_param_fun(p.name or "")
+
+    def _update(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"].astype(g.dtype) + (1 - b1) * g
+        v = b2 * state["moment2"].astype(g.dtype) + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1p.astype(g.dtype))
+        vhat = v / (1 - b2p.astype(g.dtype))
+        decay = 0.0 if self._no_decay else self._coeff
+        new_p = p * (1.0 - lr * decay) - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, {"moment1": m, "moment2": v,
+                       "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _state_names(self):
+        return ["moment"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("moment", param, fill_value=self._init_value)
+
+    def _update(self, p, g, state, lr):
+        if isinstance(self._weight_decay, float):
+            g = g + self._weight_decay * p
+        mom = state["moment"].astype(g.dtype) + jnp.square(g)
+        new_p = p - lr * g / (jnp.sqrt(mom) + self._epsilon)
+        return new_p, {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _state_names(self):
+        return ["mean_square", "mean_grad", "momentum"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("mean_square", param)
+        self._add_accumulator("mean_grad", param)
+        self._add_accumulator("momentum", param)
+
+    def _update(self, p, g, state, lr):
+        if isinstance(self._weight_decay, float):
+            g = g + self._weight_decay * p
+        rho = self._rho
+        ms = rho * state["mean_square"].astype(g.dtype) + (1 - rho) * jnp.square(g)
+        if self._centered:
+            mg = rho * state["mean_grad"].astype(g.dtype) + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"].astype(g.dtype) + lr * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _state_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("avg_squared_grad", param)
+        self._add_accumulator("avg_squared_update", param)
+
+    def _update(self, p, g, state, lr):
+        if isinstance(self._weight_decay, float):
+            g = g + self._weight_decay * p
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state["avg_squared_grad"].astype(g.dtype) + (1 - rho) * jnp.square(g)
+        asu = state["avg_squared_update"].astype(g.dtype)
+        update = -jnp.sqrt(asu + eps) / jnp.sqrt(asg + eps) * g
+        asu = rho * asu + (1 - rho) * jnp.square(update)
+        return p + lr * update, {"avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _state_names(self):
+        return ["moment", "inf_norm", "beta1_pow"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("moment", param)
+        self._add_accumulator("inf_norm", param)
+        store = self._accumulators.setdefault("beta1_pow", {})
+        if id(param) not in store:
+            store[id(param)] = jnp.asarray(1.0, jnp.float32)
+
+    def _update(self, p, g, state, lr):
+        if isinstance(self._weight_decay, float):
+            g = g + self._weight_decay * p
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        m = b1 * state["moment"].astype(g.dtype) + (1 - b1) * g
+        inf = jnp.maximum(b2 * state["inf_norm"].astype(g.dtype), jnp.abs(g) + eps)
+        new_p = p - (lr / (1 - b1p.astype(g.dtype))) * m / inf
+        return new_p, {"moment": m, "inf_norm": inf, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    """LAMB (python/paddle/optimizer/lamb.py; ref kernel phi lamb_kernel)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _state_names(self):
+        return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("moment1", param)
+        self._add_accumulator("moment2", param)
+        s1 = self._accumulators.setdefault("beta1_pow", {})
+        s2 = self._accumulators.setdefault("beta2_pow", {})
+        if id(param) not in s1:
+            s1[id(param)] = jnp.asarray(1.0, jnp.float32)
+            s2[id(param)] = jnp.asarray(1.0, jnp.float32)
+
+    def _update(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"].astype(g.dtype) + (1 - b1) * g
+        v = b2 * state["moment2"].astype(g.dtype) + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1p.astype(g.dtype))
+        vhat = v / (1 - b2p.astype(g.dtype))
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._lamb_wd * p
+        w_norm = jnp.linalg.norm(p.reshape(-1))
+        r_norm = jnp.linalg.norm(r.reshape(-1))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p - lr * trust * r
+        return new_p, {"moment1": m, "moment2": v,
+                       "beta1_pow": b1p, "beta2_pow": b2p}

@@ -1,0 +1,35 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the custom_cpu-plugin analog of the
+reference's GPU-free collective tests, test/custom_runtime/ — SURVEY.md §4):
+multi-chip sharding is validated without TPU hardware. Env must be set before
+jax imports anywhere.
+"""
+import os
+
+# Force the CPU backend with 8 virtual devices. The axon TPU sitecustomize may
+# already have registered the TPU plugin, but backends initialize lazily, so
+# switching jax_platforms before first device use still lands on CPU.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# float64 for numeric-gradient checks (OpTest.check_grad runs fp64 refs too)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

@@ -1,0 +1,196 @@
+"""Autograd engine tests.
+
+Mirrors the reference's eager autograd coverage (test/legacy_test backward
+tests + test/autograd): backward correctness vs analytic grads, accumulation,
+no_grad, paddle.grad, hooks, PyLayer.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_backward():
+    x = paddle.to_tensor(np.random.randn(4, 3).astype("float32"),
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.random.randn(3, 5).astype("float32"),
+                         stop_gradient=False)
+    y = paddle.matmul(x, w)
+    z = (y * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * y.numpy() @ w.numpy().T,
+                               rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), x.numpy().T @ (2 * y.numpy()),
+                               rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach_blocks_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x          # y = x^2
+    z = y + y          # z = 2 x^2 -> dz/dx = 4x = 12
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([4.0], stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [48.0])
+    assert x.grad is None  # paddle.grad does not write .grad
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    u = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x, u])
+    y = x * 2  # first grad() consumed the graph
+    g = paddle.grad(y, [x, u], allow_unused=True)
+    assert g[1] is None
+
+
+def test_non_scalar_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_non_scalar_backward_raises():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_multi_output_op_backward():
+    x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"),
+                         stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    (a.sum() * 2 + b.sum() * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[2, 2, 2], [3, 3, 3]])
+
+
+def test_tensor_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    seen = []
+    y.register_hook(lambda g: seen.append(g.numpy()) or g * 10)
+    (y * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [60.0])
+
+
+def test_pylayer():
+    class Square(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2 * x
+
+    t = paddle.to_tensor([3.0], stop_gradient=False)
+    out = Square.apply(t)
+    out.backward()
+    np.testing.assert_allclose(t.grad.numpy(), [6.0])
+
+
+def test_pylayer_multi_io():
+    class AddMul(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a + b, a * b
+
+        @staticmethod
+        def backward(ctx, ga, gm):
+            a, b = ctx.saved_tensor()
+            return ga + gm * b, ga + gm * a
+
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = paddle.to_tensor([5.0], stop_gradient=False)
+    s, m = AddMul.apply(a, b)
+    (s + m).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [6.0])
+    np.testing.assert_allclose(b.grad.numpy(), [3.0])
+
+
+def test_numeric_gradient_check():
+    """Finite-difference check (OpTest.check_grad analog, op_test.py:420)."""
+    rng = np.random.RandomState(0)
+    xv = rng.randn(3, 4).astype("float64")
+
+    def f(v):
+        t = paddle.to_tensor(v, dtype="float64", stop_gradient=False)
+        out = paddle.tanh(paddle.matmul(t, t.T)).sum()
+        return t, out
+
+    t, out = f(xv)
+    out.backward()
+    analytic = t.grad.numpy()
+    eps = 1e-6
+    numeric = np.zeros_like(xv)
+    for i in range(xv.shape[0]):
+        for j in range(xv.shape[1]):
+            xp = xv.copy(); xp[i, j] += eps
+            xm = xv.copy(); xm[i, j] -= eps
+            _, op = f(xp)
+            _, om = f(xm)
+            numeric[i, j] = (op.item() - om.item()) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=1e-5, atol=1e-7)
+
+
+def test_double_backward_create_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [12.0])
+    (g2,) = paddle.grad(g, x)
+    np.testing.assert_allclose(g2.numpy(), [12.0])  # d2y/dx2 = 6x

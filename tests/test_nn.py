@@ -1,0 +1,237 @@
+"""nn layer tests (reference coverage model: test/legacy_test layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = layer(x)
+    assert out.shape == [2, 3]
+    np.testing.assert_allclose(
+        out.numpy(), x.numpy() @ layer.weight.numpy() + layer.bias.numpy(),
+        rtol=1e-5)
+
+
+def test_layer_parameters_and_state_dict():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params = net.parameters()
+    assert len(params) == 4
+    sd = net.state_dict()
+    assert set(sd.keys()) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    # roundtrip
+    new = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    missing, unexpected = new.set_state_dict(sd)
+    assert not missing and not unexpected
+    np.testing.assert_array_equal(new[0].weight.numpy(), net[0].weight.numpy())
+
+
+def test_buffers_in_state_dict():
+    bn = nn.BatchNorm2D(3)
+    sd = bn.state_dict()
+    assert "weight" in sd and "_mean" in sd and "_variance" in sd
+
+
+def test_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training
+
+
+def test_dropout_train_vs_eval():
+    x = paddle.ones([1000])
+    layer = nn.Dropout(0.5)
+    out = layer(x)
+    assert 0.2 < float((out.numpy() == 0).mean()) < 0.8
+    layer.eval()
+    np.testing.assert_array_equal(layer(x).numpy(), x.numpy())
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor([[1, 0, 3]])
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(4))
+
+
+def test_conv2d_shape_and_grad():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    x.stop_gradient = False
+    out = conv(x)
+    assert out.shape == [2, 8, 8, 8]
+    out.sum().backward()
+    assert x.grad.shape == [2, 3, 16, 16]
+    assert conv.weight.grad is not None
+
+
+def test_conv2d_matches_manual():
+    # 1x1 conv == pointwise matmul
+    conv = nn.Conv2D(3, 5, 1, bias_attr=False)
+    x = paddle.randn([1, 3, 4, 4])
+    out = conv(x).numpy()  # [1,5,4,4]
+    w = conv.weight.numpy().reshape(5, 3)
+    expected = np.einsum("oc,nchw->nohw", w, x.numpy())
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_batch_norm_updates_stats():
+    bn = nn.BatchNorm1D(4)
+    x = paddle.randn([16, 4]) * 3 + 1
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    m = bn._mean.numpy().copy()
+    bn(x)
+    np.testing.assert_array_equal(bn._mean.numpy(), m)  # frozen in eval
+
+
+def test_layer_norm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 5, 8]) * 4 + 2
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+
+def test_group_norm():
+    gn = nn.GroupNorm(2, 4)
+    x = paddle.randn([2, 4, 8, 8])
+    out = gn(x)
+    assert out.shape == [2, 4, 8, 8]
+
+
+def test_pools():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D(1)(x).numpy().reshape(1, 2),
+        x.numpy().mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_avg_pool_exclusive_padding():
+    x = paddle.ones([1, 1, 4, 4])
+    out = nn.AvgPool2D(3, stride=1, padding=1)(x)
+    # exclusive=True: corners average over 4 real elements -> still 1.0
+    np.testing.assert_allclose(out.numpy(), np.ones((1, 1, 4, 4)), rtol=1e-6)
+
+
+def test_losses():
+    logits = paddle.to_tensor([[2.0, 1.0, 0.1]])
+    label = paddle.to_tensor([0])
+    loss = nn.CrossEntropyLoss()(logits, label)
+    expected = -np.log(np.exp(2.0) / np.exp([2.0, 1.0, 0.1]).sum())
+    np.testing.assert_allclose(loss.item(), expected, rtol=1e-5)
+
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([1.5, 1.5])
+    np.testing.assert_allclose(nn.MSELoss()(a, b).item(), 0.25, rtol=1e-6)
+    np.testing.assert_allclose(nn.L1Loss()(a, b).item(), 0.5, rtol=1e-6)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 5])
+    label = paddle.to_tensor([0, 1, -100, 2])
+    loss = F.cross_entropy(logits, label, ignore_index=-100)
+    manual = F.cross_entropy(logits[paddle.to_tensor([0, 1, 3])],
+                             paddle.to_tensor([0, 1, 2]))
+    np.testing.assert_allclose(loss.item(), manual.item(), rtol=1e-5)
+
+
+def test_cross_entropy_soft_label():
+    logits = paddle.randn([2, 3])
+    soft = paddle.to_tensor([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+    loss = F.cross_entropy(logits, soft, soft_label=True)
+    assert loss.shape == []
+
+
+def test_multi_head_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 5, 16])
+    out = mha(q, q, q)
+    assert out.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=2, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    src = paddle.randn([2, 6, 16])
+    out = enc(src)
+    assert out.shape == [2, 6, 16]
+    # independent copies: params must not be shared
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_sequential_container():
+    net = nn.Sequential(("fc1", nn.Linear(2, 3)), ("fc2", nn.Linear(3, 4)))
+    assert net.fc1.weight.shape == [2, 3]
+    assert len(net) == 2
+
+
+def test_layerlist():
+    layers = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    layers.append(nn.Linear(2, 2))
+    assert len(layers) == 4
+    assert len(layers.parameters()) == 8
+
+
+def test_apply_and_hooks():
+    net = nn.Linear(2, 2)
+    calls = []
+    net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    net(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_to_dtype():
+    net = nn.Linear(2, 2)
+    net.to(dtype="bfloat16")
+    assert net.weight.dtype == paddle.bfloat16
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+    assert nn.GELU()(x).shape == [3]
+    np.testing.assert_allclose(nn.Sigmoid()(x).numpy(),
+                               1 / (1 + np.exp([1.0, 0.0, -2.0])), rtol=1e-5)
+    sm = nn.Softmax()(x).numpy()
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+
+
+def test_scaled_dot_product_attention_matches_naive():
+    b, s, h, d = 2, 4, 2, 8
+    q = paddle.randn([b, s, h, d])
+    k = paddle.randn([b, s, h, d])
+    v = paddle.randn([b, s, h, d])
+    out = F.scaled_dot_product_attention(q, k, v).numpy()
+    qn = q.numpy().transpose(0, 2, 1, 3)
+    kn = k.numpy().transpose(0, 2, 1, 3)
+    vn = v.numpy().transpose(0, 2, 1, 3)
+    scores = qn @ kn.transpose(0, 1, 3, 2) / np.sqrt(d)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    expected = (probs @ vn).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_causal_attention():
+    b, s, h, d = 1, 4, 1, 4
+    q = paddle.randn([b, s, h, d])
+    k = paddle.randn([b, s, h, d])
+    v = paddle.randn([b, s, h, d])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    # first position only attends to itself
+    np.testing.assert_allclose(out.numpy()[0, 0, 0], v.numpy()[0, 0, 0],
+                               rtol=1e-5)

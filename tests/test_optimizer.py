@@ -1,0 +1,127 @@
+"""Optimizer tests (reference model: test/legacy_test/test_adam*, test_sgd*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _train(opt_ctor, steps=20):
+    paddle.seed(0)
+    net = nn.Linear(4, 1, bias_attr=False)
+    opt = opt_ctor(net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(64, 4).astype("float32"))
+    target_w = np.array([[1.0], [-2.0], [0.5], [3.0]], "float32")
+    y = paddle.to_tensor(x.numpy() @ target_w)
+    losses = []
+    for _ in range(steps):
+        loss = nn.MSELoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(loss.item())
+    return losses
+
+
+@pytest.mark.parametrize("ctor", [
+    lambda p: optimizer.SGD(learning_rate=0.1, parameters=p),
+    lambda p: optimizer.Momentum(learning_rate=0.1, parameters=p),
+    lambda p: optimizer.Adam(learning_rate=0.1, parameters=p),
+    lambda p: optimizer.AdamW(learning_rate=0.1, parameters=p),
+    lambda p: optimizer.RMSProp(learning_rate=0.01, parameters=p),
+    lambda p: optimizer.Adagrad(learning_rate=0.5, parameters=p),
+    lambda p: optimizer.Adamax(learning_rate=0.1, parameters=p),
+    lambda p: optimizer.Lamb(learning_rate=0.1, parameters=p),
+    lambda p: optimizer.Adadelta(learning_rate=10.0, parameters=p),
+])
+def test_optimizers_decrease_loss(ctor):
+    losses = _train(ctor, steps=60)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_sgd_exact_update():
+    p = paddle.core.tensor.Parameter(np.array([1.0, 2.0], "float32"))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    (p * paddle.to_tensor([3.0, 4.0])).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.3, 2.0 - 0.4], rtol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    p = paddle.core.tensor.Parameter(np.array([1.0], "float32"))
+    opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[p])
+    p.sum().backward()
+    opt.step()
+    # adamw: p = p*(1 - lr*wd) - lr*mhat/(sqrt(vhat)+eps); grad=1 -> mhat/vhat^.5 ~= 1
+    expected = 1.0 * (1 - 0.1 * 0.5) - 0.1 * 1.0 / (1.0 + 1e-8)
+    np.testing.assert_allclose(p.numpy(), [expected], rtol=1e-4)
+
+
+def test_grad_clip_global_norm():
+    p1 = paddle.core.tensor.Parameter(np.zeros(3, "float32"))
+    p2 = paddle.core.tensor.Parameter(np.zeros(4, "float32"))
+    clip = optimizer.ClipGradByGlobalNorm(1.0)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p1, p2], grad_clip=clip)
+    (p1.sum() * 3.0 + p2.sum() * 4.0).backward()
+    opt.step()
+    total = np.sqrt((p1.numpy() ** 2).sum() + (p2.numpy() ** 2).sum())
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_lr_scheduler():
+    sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    p = paddle.core.tensor.Parameter(np.array([1.0], "float32"))
+    opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_cosine_schedule():
+    sched = optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    vals = []
+    for _ in range(11):
+        vals.append(sched())
+        sched.step()
+    np.testing.assert_allclose(vals[0], 1.0)
+    np.testing.assert_allclose(vals[10], 0.0, atol=1e-9)
+
+
+def test_linear_warmup():
+    sched = optimizer.lr.LinearWarmup(learning_rate=0.1, warmup_steps=5,
+                                      start_lr=0.0, end_lr=0.1)
+    vals = [sched()]
+    for _ in range(6):
+        sched.step()
+        vals.append(sched())
+    assert vals[0] == 0.0
+    np.testing.assert_allclose(vals[5], 0.1, rtol=1e-6)
+
+
+def test_optimizer_state_dict_roundtrip():
+    net = nn.Linear(2, 2)
+    opt = optimizer.Adam(learning_rate=0.1, parameters=net.parameters())
+    net(paddle.randn([4, 2])).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = optimizer.Adam(learning_rate=0.1, parameters=net.parameters())
+    opt2.set_state_dict(sd)
+    for name in ("moment1", "moment2"):
+        for pid, arr in opt._accumulators[name].items():
+            np.testing.assert_array_equal(np.asarray(arr),
+                                          np.asarray(opt2._accumulators[name][pid]))
+
+
+def test_multi_precision_master_weights():
+    p = paddle.core.tensor.Parameter(np.array([1.0], "float32"))
+    p._set_data(p._data.astype("bfloat16"))
+    opt = optimizer.AdamW(learning_rate=0.01, parameters=[p],
+                          multi_precision=True)
+    for _ in range(3):
+        p.sum().backward()
+        opt.step()
+        opt.clear_grad()
+    assert p.dtype == paddle.bfloat16
+    assert id(p) in opt._master_weights
+    assert opt._master_weights[id(p)].dtype == np.dtype("float32")

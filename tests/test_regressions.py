@@ -1,0 +1,112 @@
+"""Regression tests for review findings (round 1 code review)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+import paddle_tpu.nn.functional as F
+
+
+def test_setitem_keeps_gradient_flow():
+    # leaf case: grads must reach the mutated leaf (zeros at overwritten slots)
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    x[0] = 5.0
+    x.sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+    # non-leaf case: grads flow through to the producer
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a * 3
+    b[0] = 7.0
+    b.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [0.0, 3.0])
+
+
+def test_double_backward_without_retain_raises():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = (a * a).sum()
+    b.backward()
+    with pytest.raises(RuntimeError, match="retain_graph"):
+        b.backward()
+
+
+def test_retain_graph_allows_second_backward():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = (a * a).sum()
+    b.backward(retain_graph=True)
+    b.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [8.0])
+
+
+def test_attention_dropout_active_in_training():
+    paddle.seed(0)
+    q = paddle.randn([2, 8, 2, 4])
+    out_train = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9,
+                                               training=True)
+    out_eval = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9,
+                                              training=False)
+    # with p=0.9 the dropped output must differ from the deterministic one
+    assert not np.allclose(out_train.numpy(), out_eval.numpy())
+
+
+def test_grad_scaler_external_unscale_not_double():
+    p = paddle.core.tensor.Parameter(np.array([1.0], "float32"))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    loss = (p * 1.0).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)          # user unscales to clip
+    g_after_unscale = p.grad.numpy().copy()
+    scaler.step(opt)              # must NOT unscale again
+    np.testing.assert_allclose(g_after_unscale, [1.0])
+    np.testing.assert_allclose(p.numpy(), [0.0])  # p - lr*1.0
+
+
+def test_nll_loss_weighted_mean():
+    logp = paddle.to_tensor(np.log(np.full((2, 2), 0.5, "float32")))
+    label = paddle.to_tensor([0, 1])
+    w = paddle.to_tensor([1.0, 3.0])
+    loss = F.nll_loss(logp, label, weight=w)
+    # sum(w_i * l_i) / sum(w_i) = (1*0.693 + 3*0.693)/4 = 0.693
+    np.testing.assert_allclose(loss.item(), np.log(2.0), rtol=1e-5)
+
+
+def test_max_pool_ceil_mode():
+    x = paddle.randn([1, 1, 5, 5])
+    out = F.max_pool2d(x, 2, stride=2, ceil_mode=True)
+    assert out.shape == [1, 1, 3, 3]
+    out_floor = F.max_pool2d(x, 2, stride=2, ceil_mode=False)
+    assert out_floor.shape == [1, 1, 2, 2]
+
+
+def test_max_pool_return_mask():
+    x = paddle.to_tensor(np.arange(16).reshape(1, 1, 4, 4).astype("float32"))
+    out, mask = F.max_pool2d(x, 2, return_mask=True)
+    assert out.shape == [1, 1, 2, 2]
+    assert mask.shape == [1, 1, 2, 2]
+    np.testing.assert_array_equal(out.numpy().reshape(-1), [5, 7, 13, 15])
+    np.testing.assert_array_equal(mask.numpy().reshape(-1), [5, 7, 13, 15])
+
+
+def test_adamw_decay_param_filter():
+    p1 = paddle.core.tensor.Parameter(np.array([1.0], "float32"),
+                                      name="w_weight")
+    p2 = paddle.core.tensor.Parameter(np.array([1.0], "float32"),
+                                      name="b_bias")
+    opt = optimizer.AdamW(
+        learning_rate=0.0, weight_decay=0.5, parameters=[p1, p2],
+        apply_decay_param_fun=lambda n: "bias" not in n)
+    (p1.sum() + p2.sum()).backward()
+    opt.step()
+    # lr=0 -> only decay term would move params; but decay is multiplied by lr
+    np.testing.assert_allclose(p1.numpy(), [1.0])
+    np.testing.assert_allclose(p2.numpy(), [1.0])
+    # now with lr>0: p1 decays, p2 does not (beyond adam term which is equal)
+    opt2 = optimizer.AdamW(
+        learning_rate=0.1, weight_decay=0.5, parameters=[p1, p2],
+        apply_decay_param_fun=lambda n: "bias" not in n)
+    p1.clear_grad(); p2.clear_grad()
+    (p1.sum() + p2.sum()).backward()
+    opt2.step()
+    assert p1.item() < p2.item()

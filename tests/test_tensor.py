@@ -1,0 +1,145 @@
+"""Tensor basics: creation, properties, operators, indexing.
+
+Modeled on the reference's test/legacy_test tensor API tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_inference():
+    assert paddle.to_tensor([1, 2]).dtype == np.dtype("int64") or \
+        paddle.to_tensor([1, 2]).dtype == np.dtype("int32")
+    assert paddle.to_tensor([1.5]).dtype == paddle.float32
+    assert paddle.to_tensor(np.float64([1.5])).dtype == paddle.float32
+    assert paddle.to_tensor([1.5], dtype="float64").dtype == np.dtype("float64")
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    assert paddle.full([2, 2], 7).numpy().sum() == 28
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.eye(3).numpy().trace() == 3
+    assert paddle.linspace(0, 1, 5).shape == [5]
+
+
+def test_operators():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2.0 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    assert bool((a < b).all())
+    assert bool((a == a).all())
+
+
+def test_matmul_operator():
+    a = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    b = paddle.to_tensor(np.random.randn(4, 5).astype("float32"))
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy(),
+                               rtol=1e-5)
+
+
+def test_indexing():
+    a = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype("float32"))
+    assert a[0].shape == [3, 4]
+    assert a[:, 1].shape == [2, 4]
+    assert a[..., -1].shape == [2, 3]
+    assert a[0, 1, 2].item() == 6.0
+    mask = a > 12
+    assert a[mask].shape == [11]
+    idx = paddle.to_tensor([0, 1])
+    assert a[idx].shape == [2, 3, 4]
+
+
+def test_setitem():
+    a = paddle.zeros([3, 3])
+    a[1, :] = 5.0
+    assert a.numpy()[1].tolist() == [5, 5, 5]
+    a[0, 0] = 1.0
+    assert a.numpy()[0, 0] == 1
+
+
+def test_methods():
+    a = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    assert a.mean().shape == []
+    assert a.sum(axis=0).shape == [4]
+    assert a.reshape([4, 3]).shape == [4, 3]
+    assert a.transpose([1, 0]).shape == [4, 3]
+    assert a.T.shape == [4, 3]
+    assert a.unsqueeze(0).shape == [1, 3, 4]
+    assert a.flatten().shape == [12]
+    assert a.astype("int32").dtype == np.dtype("int32")
+    assert a.exp().shape == [3, 4]
+    assert a.clip(-1, 1).numpy().max() <= 1.0
+
+
+def test_inplace_set_value():
+    a = paddle.ones([2, 2])
+    a.set_value(np.zeros((2, 2), "float32"))
+    assert a.numpy().sum() == 0
+
+
+def test_detach_clone():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    d = a.detach()
+    assert d.stop_gradient
+    c = a.clone()
+    assert not c.stop_gradient
+
+
+def test_item_and_len():
+    assert paddle.to_tensor([42.0]).item() == 42.0
+    assert len(paddle.zeros([5, 2])) == 5
+    assert float(paddle.to_tensor(3.5)) == 3.5
+
+
+def test_manipulation_ops():
+    a = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+    b = paddle.concat([a, a], axis=0)
+    assert b.shape == [4, 3]
+    s = paddle.split(b, 2, axis=0)
+    assert len(s) == 2 and s[0].shape == [2, 3]
+    st = paddle.stack([a, a], axis=0)
+    assert st.shape == [2, 2, 3]
+    assert paddle.tile(a, [2, 2]).shape == [4, 6]
+    assert paddle.flip(a, axis=1).numpy()[0, 0] == 2
+    vals, idx = paddle.topk(paddle.to_tensor([1.0, 9.0, 3.0]), k=2)
+    np.testing.assert_array_equal(vals.numpy(), [9, 3])
+    np.testing.assert_array_equal(idx.numpy(), [1, 2])
+
+
+def test_where_and_gather():
+    cond = paddle.to_tensor([True, False, True])
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([9.0, 9.0, 9.0])
+    np.testing.assert_array_equal(paddle.where(cond, a, b).numpy(), [1, 9, 3])
+    idx = paddle.to_tensor([2, 0])
+    np.testing.assert_array_equal(paddle.gather(a, idx).numpy(), [3, 1])
+
+
+def test_random_reproducible():
+    paddle.seed(7)
+    a = paddle.randn([4])
+    paddle.seed(7)
+    b = paddle.randn([4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_cast_roundtrip():
+    a = paddle.to_tensor([1.5, 2.5])
+    assert paddle.cast(a, "bfloat16").dtype == paddle.bfloat16
+    assert paddle.cast(a, "int64").numpy().tolist() == [1, 2]
