@@ -28,8 +28,11 @@ from .ops import (abs, all, any, max, min, pow, round, sum)  # noqa: F401
 # subpackages
 from . import amp
 from . import autograd
+from . import framework
+from . import jit
 from . import nn
 from . import optimizer
+from .framework.io import async_save, load, save
 from .nn import functional as _F
 
 # paddle.disable_static/enable_static are no-ops here (eager is the default;

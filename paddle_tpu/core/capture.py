@@ -1,0 +1,63 @@
+"""Program-capture context for the compiled (to_static) path.
+
+TPU-native replacement for the reference's dynamic-to-static machinery
+(paddle/fluid/pybind/eval_frame.c frame hook + jit/sot bytecode simulation,
+SURVEY.md §2.13). Instead of simulating CPython bytecode, we run the function
+once eagerly under a capture context that records its *implicit state*:
+
+  - every pre-existing Tensor read by an op (params, buffers, constants),
+  - every Tensor mutated via _set_data (BatchNorm running stats, setitem),
+  - every Tensor receiving a gradient (leaf .grad writes),
+  - whether the global RNG was consumed.
+
+The second pass binds all recorded state as jax.jit inputs and returns the
+mutated state as outputs — a pure function XLA can compile, equivalent to the
+reference's partial_program forward+backward wrapped in a run_program op.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+_ACTIVE: List["CaptureContext"] = []
+
+
+class CaptureContext:
+    def __init__(self):
+        self.reads: Dict[int, object] = {}      # id -> Tensor (pre-existing)
+        self.mutated: Dict[int, object] = {}    # id -> Tensor (data replaced)
+        self.grad_writes: Dict[int, object] = {}  # id -> Tensor (.grad written)
+        self.created: set = set()               # ids of tensors born in-trace
+        self.rng_used = False
+
+    # -- hooks --------------------------------------------------------------
+    def record_read(self, t):
+        if id(t) not in self.created and id(t) not in self.reads:
+            self.reads[id(t)] = t
+
+    def record_created(self, t):
+        self.created.add(id(t))
+
+    def record_mutation(self, t):
+        if id(t) not in self.created:
+            self.mutated[id(t)] = t
+            # a mutated tensor is also state even if never read before
+            self.reads.setdefault(id(t), t)
+
+    def record_grad_write(self, t):
+        if id(t) not in self.created:
+            self.grad_writes[id(t)] = t
+
+    def record_rng(self):
+        self.rng_used = True
+
+    def __enter__(self):
+        _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _ACTIVE.pop()
+        return False
+
+
+def active() -> Optional[CaptureContext]:
+    return _ACTIVE[-1] if _ACTIVE else None

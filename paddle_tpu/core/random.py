@@ -22,6 +22,10 @@ class Generator:
         return self
 
     def next_key(self):
+        from . import capture
+        cap = capture.active()
+        if cap is not None:
+            cap.record_rng()
         self._key, sub = jax.random.split(self._key)
         return sub
 

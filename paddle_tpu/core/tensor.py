@@ -144,6 +144,10 @@ class Tensor:
 
     def _accumulate_grad(self, g):
         """AccumulationNode analog (eager/accumulation/accumulation_node.h)."""
+        from . import capture
+        cap = capture.active()
+        if cap is not None:
+            cap.record_grad_write(self)
         if isinstance(g, Tensor):
             # create_graph mode: keep the grad's tape history
             self._grad = g if self._grad is None else self._grad + g
@@ -174,6 +178,10 @@ class Tensor:
 
     # -- mutation (in-place surface; functional underneath) -----------------
     def _set_data(self, new_data):
+        from . import capture
+        cap = capture.active()
+        if cap is not None:
+            cap.record_mutation(self)
         self._data = new_data
 
     def set_value(self, value):

@@ -1,0 +1,86 @@
+"""paddle.save / paddle.load analog (python/paddle/framework/io.py).
+
+Pickle-based state-dict serialization with Tensors converted to numpy on save
+and restored as Tensors on load. async_save (io.py:65 analog) snapshots to host
+then writes on a background thread so the TPU isn't blocked on disk.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": obj.numpy(),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_to_storable(v) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def _from_storable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True),
+                       name=obj.get("name"))
+            return t
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_from_storable(v, return_numpy) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+import atexit
+
+_ASYNC_THREADS = []
+atexit.register(lambda: wait_async_saves())
+
+
+def async_save(obj: Any, path: str, protocol: int = 4, sync_other_task=False,
+               **configs):
+    """Snapshot now, write in background (framework/io.py async_save:65)."""
+    snapshot = _to_storable(obj)
+
+    def _write():
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(snapshot, f, protocol=protocol)
+
+    t = threading.Thread(target=_write, daemon=True)
+    t.start()
+    _ASYNC_THREADS.append(t)
+    return t
+
+
+def wait_async_saves():
+    while _ASYNC_THREADS:
+        _ASYNC_THREADS.pop().join()
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_storable(obj, return_numpy)

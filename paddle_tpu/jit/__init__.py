@@ -1,0 +1,433 @@
+"""Compiled execution path — the to_static analog.
+
+Reference architecture (SURVEY.md §2.13, §3.4): paddle.jit.to_static captures
+Python into a Program via AST transforms or the SOT frame-eval hook
+(pybind/eval_frame.c, jit/sot/opcode_translator), appends a grad program, and
+runs it on the StandaloneExecutor.
+
+TPU-native redesign: capture-by-execution (core/capture.py) discovers the
+function's implicit state in one eager pass, then the whole computation —
+forward, tape backward, optimizer update — is staged as ONE pure jax function
+and compiled by XLA into a single TPU executable (the CINN/StandaloneExecutor
+role collapses into jax.jit + the PJRT executable cache). Guards are shape/
+dtype/static-arg keys on the compile cache, the analog of SOT guards
+(sot/opcode_translator executor guards).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import engine as _engine
+from ..core import capture as _capture
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ..optimizer.clip import ClipGradByGlobalNorm
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "TrainStep",
+           "enable_to_static"]
+
+_TO_STATIC_ENABLED = [True]
+
+
+def enable_to_static(flag: bool):
+    _TO_STATIC_ENABLED[0] = flag
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _sig_of(args, kwargs):
+    """Cache key: tensor shapes/dtypes are dynamic; everything else static."""
+    flat, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                               is_leaf=_is_tensor)
+    parts = []
+    for x in flat:
+        if _is_tensor(x):
+            parts.append(("T", tuple(x.shape), str(x.dtype)))
+        elif isinstance(x, (jax.Array, np.ndarray)):
+            parts.append(("A", tuple(x.shape), str(x.dtype)))
+        else:
+            parts.append(("S", repr(x)))
+    return (treedef, tuple(parts))
+
+
+class StaticFunction:
+    """Compiled wrapper (program_translator.py:StaticFunction analog).
+
+    First call per input signature runs eagerly under a CaptureContext
+    (the real step still happens — it doubles as warmup), discovering
+    state reads/mutations/grad-writes/RNG use; subsequent calls hit a
+    jax.jit-compiled pure function with that state threaded through.
+    """
+
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._cache: Dict[Any, dict] = {}
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._fn)
+
+    def concrete_program(self, *args, **kwargs):
+        return self._cache.get(_sig_of(args, kwargs))
+
+    def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED[0]:
+            return self._fn(*args, **kwargs)
+        key = _sig_of(args, kwargs)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._trace(args, kwargs)
+            self._cache[key] = entry
+            # pop so the cache doesn't pin the first call's autograd tape
+            return entry.pop("first_out")
+        return self._run(entry, args, kwargs)
+
+    # -- pass 1: discovery --------------------------------------------------
+    def _trace(self, args, kwargs):
+        arg_ids = {id(t) for t in jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=_is_tensor) if _is_tensor(t)}
+        with _capture.CaptureContext() as cap:
+            out = self._fn(*args, **kwargs)
+
+        state = [t for i, t in cap.reads.items()
+                 if i not in arg_ids and not isinstance(t._data, jax.core.Tracer)]
+        mutated = [t for i, t in cap.mutated.items() if i not in arg_ids]
+        grad_ts = [t for i, t in cap.grad_writes.items() if i not in arg_ids]
+        rng_used = cap.rng_used
+
+        fn = self._fn
+        gen = _random.default_generator()
+
+        def pure(state_arrays, grads_in, rng_key, *flat_args):
+            saved = [(t, t._data, t._grad) for t in state]
+            saved_grads = [(t, t._grad) for t in grad_ts]
+            saved_key = gen.get_state()
+            try:
+                for t, a in zip(state, state_arrays):
+                    t._data = a
+                for t, g in zip(grad_ts, grads_in):
+                    t._grad = None if g is None else Tensor(g)
+                if rng_used:
+                    gen.set_state(rng_key)
+                a2, k2 = _rewrap_args(flat_args, self._treedef, self._tensor_pos,
+                                      self._static_flat)
+                res = fn(*a2, **k2)
+                out_arrays = jax.tree_util.tree_map(
+                    lambda x: x._data if _is_tensor(x) else x, res,
+                    is_leaf=_is_tensor)
+                new_state = [t._data for t in mutated]
+                new_grads = [None if t._grad is None else t._grad._data
+                             for t in grad_ts]
+                new_key = gen.get_state()
+                return out_arrays, new_state, new_grads, new_key
+            finally:
+                for t, d, g in saved:
+                    t._data = d
+                    t._grad = g
+                for t, g in saved_grads:
+                    t._grad = g
+                gen.set_state(saved_key)
+
+        # flatten args once to know tensor positions (static parts baked)
+        flat, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                   is_leaf=_is_tensor)
+        self._treedef = treedef
+        self._tensor_pos = [i for i, x in enumerate(flat) if _is_tensor(x)]
+        self._static_flat = [None if _is_tensor(x) else x for x in flat]
+
+        compiled = jax.jit(pure)
+        entry = {"compiled": compiled, "state": state, "mutated": mutated,
+                 "grad_ts": grad_ts, "rng_used": rng_used, "first_out": out,
+                 "treedef": treedef, "tensor_pos": self._tensor_pos,
+                 "static_flat": self._static_flat}
+        return entry
+
+    # -- pass 2+: compiled execution ----------------------------------------
+    def _run(self, entry, args, kwargs):
+        gen = _random.default_generator()
+        flat = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)[0]
+        arg_tensors = [flat[i] for i in entry["tensor_pos"]]
+        state = entry["state"]
+        grads_in = [None if t._grad is None else t._grad._data
+                    for t in entry["grad_ts"]]
+        rng_key = gen.get_state()
+        self._treedef = entry["treedef"]
+        self._tensor_pos = entry["tensor_pos"]
+        self._static_flat = entry["static_flat"]
+
+        need_grad = _engine.is_grad_enabled() and (
+            any(not t.stop_gradient for t in state)
+            or any(not t.stop_gradient for t in arg_tensors))
+
+        if not need_grad:
+            out_arrays, new_state, new_grads, new_key = entry["compiled"](
+                [t._data for t in state], grads_in, rng_key,
+                *[t._data for t in arg_tensors])
+            result = jax.tree_util.tree_map(
+                lambda x: Tensor(x) if isinstance(x, (jax.Array,)) else x,
+                out_arrays)
+        else:
+            # Differentiable compiled call: route the jitted pure function
+            # through op dispatch, so outputs carry a GradNode whose vjp
+            # differentiates through the XLA executable (partial-eval keeps
+            # forward compiled; the transpose compiles separately). This is
+            # the analog of the reference's run_program op carrying the grad
+            # program (jit/pir_partial_program.py).
+            from ..ops import registry as _registry
+            n_state = len(state)
+            compiled = entry["compiled"]
+
+            def op_fn(*xs):
+                st = list(xs[:n_state])
+                ar = list(xs[n_state:])
+                return compiled(st, grads_in, rng_key, *ar)
+
+            out_arrays, new_state_t, new_grads_t, new_key_t = \
+                _registry.dispatch(op_fn, tuple(state) + tuple(arg_tensors),
+                                   {}, op_name="static_fn")
+            result = out_arrays  # already Tensors with grad nodes
+            new_state = [t._data for t in jax.tree_util.tree_leaves(
+                new_state_t, is_leaf=_is_tensor)] if new_state_t else []
+            new_grads = [None if g is None else
+                         (g._data if _is_tensor(g) else g)
+                         for g in (new_grads_t if isinstance(new_grads_t,
+                                                             (list, tuple))
+                                   else [new_grads_t])] \
+                if entry["grad_ts"] else []
+            new_key = new_key_t._data if _is_tensor(new_key_t) else new_key_t
+
+        for t, a in zip(entry["mutated"], new_state):
+            t._data = a
+        for t, g in zip(entry["grad_ts"], new_grads):
+            t._grad = None if g is None else Tensor(g)
+        if entry["rng_used"]:
+            gen.set_state(new_key)
+        return result
+
+
+def _rewrap_args(flat_arrays, treedef, tensor_pos, static_flat):
+    buf = list(static_flat)
+    for i, a in zip(tensor_pos, flat_arrays):
+        buf[i] = Tensor(a)
+    return jax.tree_util.tree_unflatten(treedef, buf)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True):
+    """paddle.jit.to_static analog (jit/api.py:171)."""
+    def deco(fn):
+        # Layer: compile its forward, keep the layer object semantics
+        from ..nn.layer import Layer
+        if isinstance(fn, Layer):
+            layer = fn
+            static = StaticFunction(layer.forward)
+            layer.forward = static
+            return layer
+        return StaticFunction(fn, input_spec, build_strategy, backend,
+                              full_graph)
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TrainStep:
+    """Whole-train-step compilation: forward + tape backward + optimizer
+    update staged into ONE XLA executable.
+
+    This is the reference's `to_static` training path (partial_program with
+    appended backward run by the StandaloneExecutor, SURVEY.md §3.4) rebuilt
+    TPU-first: XLA sees the entire step, so it fuses the optimizer update into
+    the backward and overlaps everything on-chip.
+
+    train_fn(*batch) -> loss (closes over the model); optimizer supplies the
+    pure update (optimizer.py `_update`).
+    """
+
+    def __init__(self, train_fn: Callable, optimizer, amp=None):
+        self._fn = train_fn
+        self._opt = optimizer
+        self._amp = amp  # optional paddle_tpu.amp.auto_cast factory kwargs
+        self._cache: Dict[Any, dict] = {}
+
+    def __call__(self, *args):
+        key = _sig_of(args, {})
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(args)
+            self._cache[key] = entry
+            return entry.pop("first_loss")
+        return self._run(entry, args)
+
+    def _loss_fn(self, *args):
+        if self._amp:
+            from .. import amp as amp_mod
+            with amp_mod.auto_cast(**self._amp):
+                return self._fn(*args)
+        return self._fn(*args)
+
+    def _build(self, args):
+        opt = self._opt
+        params = [p for p in opt._parameter_list if p.trainable]
+        arg_ids = {id(t) for t in args if _is_tensor(t)}
+        param_ids = {id(p) for p in params}
+
+        # discovery pass (doubles as real step 1, eager)
+        with _capture.CaptureContext() as cap:
+            loss = self._loss_fn(*args)
+            loss.backward()
+        # extra state: buffers/constants the model read or mutated
+        extra = [t for i, t in cap.reads.items()
+                 if i not in arg_ids and i not in param_ids
+                 and not isinstance(t._data, jax.core.Tracer)]
+        extra_mut = [t for i, t in cap.mutated.items()
+                     if i not in arg_ids and i not in param_ids]
+        # trainable leaves NOT managed by the optimizer still receive grads —
+        # thread them through the compiled step like StaticFunction does
+        other_grad_ts = [t for i, t in cap.grad_writes.items()
+                         if i not in param_ids and i not in arg_ids]
+        rng_used = cap.rng_used
+        gen = _random.default_generator()
+
+        # eager optimizer update for step 1
+        opt.step()
+        for p in params:
+            p.clear_grad()
+        opt._functional_states(params)  # ensure accumulators exist per param
+
+        use_master = [opt._multi_precision and p.dtype != jnp.float32
+                      for p in params]
+        if any(use_master):
+            for p, um in zip(params, use_master):
+                if um:
+                    opt._master_weight(p)  # materialize fp32 master
+
+        clip = opt._grad_clip
+        fn = self._loss_fn
+
+        def pure(p_arrays, masters, opt_states, extra_arrays, other_grads_in,
+                 rng_key, lr, *batch):
+            saved_p = [(p, p._data, p._grad) for p in params]
+            saved_e = [(t, t._data) for t in extra]
+            saved_o = [(t, t._grad) for t in other_grad_ts]
+            saved_key = gen.get_state()
+            try:
+                for p, a in zip(params, p_arrays):
+                    p._data = a
+                    p._grad = None
+                for t, a in zip(extra, extra_arrays):
+                    t._data = a
+                for t, g in zip(other_grad_ts, other_grads_in):
+                    t._grad = None if g is None else Tensor(g)
+                if rng_used:
+                    gen.set_state(rng_key)
+                batch_t = [Tensor(b) for b in batch]
+                loss_t = fn(*batch_t)
+                _engine.run_backward([loss_t], [None])
+                grads = [None if p._grad is None else p._grad._data
+                         for p in params]
+                if clip is not None and hasattr(clip, "apply_to_arrays"):
+                    grads = clip.apply_to_arrays(grads)
+                lr_ = lr
+                new_p, new_masters, new_states = [], [], []
+                for p, pa, m, um, g, st in zip(params, p_arrays, masters,
+                                               use_master, grads, opt_states):
+                    if g is None:
+                        new_p.append(pa)
+                        new_masters.append(m)
+                        new_states.append(st)
+                        continue
+                    base = m if um else pa
+                    if g.dtype != base.dtype:
+                        g = g.astype(base.dtype)
+                    nv, ns = opt._update(base, g, st, lr_)
+                    if um:
+                        new_masters.append(nv)
+                        new_p.append(nv.astype(pa.dtype))
+                    else:
+                        new_masters.append(m)
+                        new_p.append(nv)
+                    new_states.append(ns)
+                new_extra = [t._data for t in extra_mut]
+                new_other_grads = [None if t._grad is None else t._grad._data
+                                   for t in other_grad_ts]
+                new_key = gen.get_state()
+                return (loss_t._data, new_p, new_masters, new_states,
+                        new_extra, new_other_grads, new_key)
+            finally:
+                for p, d, g in saved_p:
+                    p._data = d
+                    p._grad = g
+                for t, d in saved_e:
+                    t._data = d
+                for t, g in saved_o:
+                    t._grad = g
+                gen.set_state(saved_key)
+
+        compiled = jax.jit(pure)
+        return {"compiled": compiled, "params": params, "extra": extra,
+                "extra_mut": extra_mut, "other_grad_ts": other_grad_ts,
+                "use_master": use_master, "rng_used": rng_used,
+                "first_loss": loss.detach()}
+
+    def _run(self, entry, args):
+        opt = self._opt
+        gen = _random.default_generator()
+        params = entry["params"]
+        use_master = entry["use_master"]
+        p_arrays = [p._data for p in params]
+        masters = [opt._master_weights.get(id(p)) if um else None
+                   for p, um in zip(params, use_master)]
+        opt_states = [{name: opt._accumulators[name][id(p)]
+                       for name in opt._state_names()} for p in params]
+        extra_arrays = [t._data for t in entry["extra"]]
+        other_grads_in = [None if t._grad is None else t._grad._data
+                          for t in entry["other_grad_ts"]]
+        batch = [a._data if _is_tensor(a) else a for a in args]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        (loss, new_p, new_masters, new_states, new_extra, new_other_grads,
+         new_key) = entry["compiled"](p_arrays, masters, opt_states,
+                                      extra_arrays, other_grads_in,
+                                      gen.get_state(), lr, *batch)
+        for p, a in zip(params, new_p):
+            p._data = a
+        for p, um, m in zip(params, use_master, new_masters):
+            if um:
+                opt._master_weights[id(p)] = m
+        for p, st in zip(params, new_states):
+            for name, v in st.items():
+                opt._accumulators[name][id(p)] = v
+        for t, a in zip(entry["extra_mut"], new_extra):
+            t._data = a
+        for t, g in zip(entry["other_grad_ts"], new_other_grads):
+            t._grad = None if g is None else Tensor(g)
+        if entry["rng_used"]:
+            gen.set_state(new_key)
+        opt._step_count += 1
+        return Tensor(loss)
+
+
+def save(layer, path, input_spec=None, **kwargs):
+    """paddle.jit.save analog — serialize params + (later) exported StableHLO.
+    Round-1: params only; the AOT executable tier lands with the serving slice."""
+    from ..framework import io as fio
+    fio.save(layer.state_dict(), path + ".pdparams")
+
+
+def load(path, **kwargs):
+    from ..framework import io as fio
+    return fio.load(path + ".pdparams")

@@ -72,7 +72,7 @@ def _tensor_setitem(self, idx, value):
         from ..autograd import hooks as _hooks
         _hooks.register_tensor_hook(
             old, lambda g, _t=self: (_t._accumulate_grad(g._data), g)[1])
-    self._data = out._data
+    self._set_data(out._data)  # via _set_data so capture records the mutation
     self._grad_node = out._grad_node
     self._grad_out_idx = out._grad_out_idx
     if not out.stop_gradient:

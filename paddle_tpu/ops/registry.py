@@ -30,6 +30,7 @@ import numpy as np
 
 from ..autograd import engine
 from ..autograd.engine import GradNode
+from ..core import capture
 from ..core.tensor import Tensor
 
 OP_REGISTRY: Dict[str, dict] = {}
@@ -61,6 +62,11 @@ def dispatch(fn: Callable, args, kwargs, op_name: str,
     in_tensors = [flat[i] for i in t_pos]
     arrays = [t._data for t in in_tensors]
 
+    cap = capture.active()
+    if cap is not None:
+        for t in in_tensors:
+            cap.record_read(t)
+
     requires = (differentiable and engine.is_grad_enabled()
                 and any(not t.stop_gradient for t in in_tensors))
 
@@ -73,7 +79,12 @@ def dispatch(fn: Callable, args, kwargs, op_name: str,
 
     if not requires:
         out = call(*arrays)
-        return _wrap_outputs(out, stop_gradient=True)
+        res = _wrap_outputs(out, stop_gradient=True)
+        if cap is not None:
+            for leaf in jax.tree_util.tree_leaves(res, is_leaf=_is_tensor):
+                if _is_tensor(leaf):
+                    cap.record_created(leaf)
+        return res
 
     out, raw_vjp = jax.vjp(call, *arrays)
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
@@ -91,6 +102,8 @@ def dispatch(fn: Callable, args, kwargs, op_name: str,
         t = Tensor(leaf, stop_gradient=False)
         t._grad_node = node
         t._grad_out_idx = idx
+        if cap is not None:
+            cap.record_created(t)
         wrapped_leaves.append(t)
     if len(wrapped_leaves) == 1 and out is out_leaves[0]:
         return wrapped_leaves[0]

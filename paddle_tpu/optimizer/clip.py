@@ -23,18 +23,27 @@ class ClipGradByValue(ClipGradBase):
             if p.grad is not None:
                 p.grad = Tensor(jnp.clip(p.grad._data, self.min, self.max))
 
+    def apply_to_arrays(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max)
+                for g in grads]
+
 
 class ClipGradByNorm(ClipGradBase):
     def __init__(self, clip_norm):
         self.clip_norm = clip_norm
 
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return (g.astype(jnp.float32) * factor).astype(g.dtype)
+
     def __call__(self, params):
         for p in params:
             if p.grad is not None:
-                g = p.grad._data
-                norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
-                factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
-                p.grad = Tensor((g.astype(jnp.float32) * factor).astype(g.dtype))
+                p.grad = Tensor(self._clip_one(p.grad._data))
+
+    def apply_to_arrays(self, grads):
+        return [None if g is None else self._clip_one(g) for g in grads]
 
 
 class ClipGradByGlobalNorm(ClipGradBase):
