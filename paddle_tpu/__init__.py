@@ -28,10 +28,12 @@ from .ops import (abs, all, any, max, min, pow, round, sum)  # noqa: F401
 # subpackages
 from . import amp
 from . import autograd
+from . import distributed
 from . import framework
 from . import jit
 from . import nn
 from . import optimizer
+from .distributed.parallel import DataParallel
 from .framework.io import async_save, load, save
 from .nn import functional as _F
 

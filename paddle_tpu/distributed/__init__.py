@@ -1,0 +1,51 @@
+"""paddle_tpu.distributed — the distributed stack (python/paddle/distributed
+analog, SURVEY.md §2.7-2.12).
+
+Layers:
+  collective.py   — eager collective API over XLA collectives (ICI/DCN)
+  auto_parallel.py— ProcessMesh / placements / DistTensor over GSPMD
+  parallel.py     — DataParallel
+  sharding.py     — ZeRO stages as placement policies
+  fleet/          — hybrid parallel: topology, TP layers, recompute, facade
+"""
+from __future__ import annotations
+
+from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
+                            dtensor_from_local, dtensor_to_local, get_mesh,
+                            get_placements, reshard, shard_layer, shard_tensor,
+                            unshard_dtensor)
+from .collective import (Group, P2POp, ReduceOp, all_gather,
+                         all_gather_object, all_reduce, alltoall, barrier,
+                         batch_isend_irecv, broadcast, destroy_process_group,
+                         get_rank, get_world_size, init_parallel_env, irecv,
+                         is_initialized, isend, new_group, recv, reduce,
+                         reduce_scatter, scatter, send, wait)
+from .parallel import DataParallel, sync_params_buffers
+from . import fleet
+from . import sharding as _sharding_mod
+from .sharding import group_sharded_parallel, save_group_sharded_model
+
+# convenience namespace paddle.distributed.sharding.*
+sharding = _sharding_mod
+
+
+def shard_optimizer(optimizer, mesh=None, shard_fn=None):
+    """distributed.shard_optimizer (auto_parallel/api.py:_ShardOptimizer:552
+    analog): shard optimizer states over the mesh's first axis."""
+    from ._shard_states import shard_optimizer_states
+
+    if mesh is None:
+        from .fleet.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        mesh = hcg.mesh if hcg else init_parallel_env().mesh
+    return shard_optimizer_states(optimizer, mesh, mesh.dim_names[0])
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn analog. Single-controller SPMD drives all
+    devices from one process, so spawn degenerates to a direct call."""
+    func(*args)
+
+
+def get_group(gid=0):
+    return init_parallel_env()

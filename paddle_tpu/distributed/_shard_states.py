@@ -1,0 +1,35 @@
+"""Shared helper: shard optimizer accumulators over a mesh axis.
+
+Single implementation behind distributed.shard_optimizer,
+sharding.group_sharded_parallel, and fleet's HybridParallelOptimizer
+(DygraphShardingOptimizer analog, dygraph_sharding_optimizer.py:48).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def shard_optimizer_states(optimizer, mesh, axis: str):
+    """Monkeypatch optimizer._add_accumulator so new accumulators land
+    Shard(0) over `axis` when dim0 is divisible, else replicated.
+    Idempotent: re-sharding with the same axis is a no-op."""
+    if getattr(optimizer, "_sharded_states_axis", None) == axis:
+        return optimizer
+    degree = mesh.get_dim_size(axis)
+    orig_add = optimizer._add_accumulator
+
+    def sharded_add(name, param, fill_value=0.0, dtype=None):
+        store = optimizer._accumulators.setdefault(name, {})
+        if id(param) not in store:
+            arr = orig_add(name, param, fill_value, dtype)
+            spec = PartitionSpec(axis) if (
+                arr.ndim > 0 and arr.shape[0] % degree == 0) else PartitionSpec()
+            store[id(param)] = jax.device_put(
+                arr, NamedSharding(mesh.jax_mesh, spec))
+        return store[id(param)]
+
+    optimizer._add_accumulator = sharded_add
+    optimizer._sharded_states_axis = axis
+    optimizer._sharded_states_mesh = mesh
+    return optimizer

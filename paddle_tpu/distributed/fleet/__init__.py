@@ -1,0 +1,212 @@
+"""Fleet — hybrid-parallel facade.
+
+Reference: fleet.init (distributed/fleet/fleet.py:167) builds the
+HybridCommunicateGroup; fleet.distributed_model (fleet/model.py:32) wraps by
+mode; fleet.distributed_optimizer returns HybridParallelOptimizer
+(hybrid_parallel_optimizer.py:254).
+
+TPU-native: the strategy's hybrid degrees define the device mesh axes
+(dp, pp, sharding, sep, mp); "wrapping" a model = placing its parameters on
+the mesh; the optimizer wrapper adds hybrid-aware clipping and (stage 1+)
+sharded optimizer states. All collectives are GSPMD-emitted.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...optimizer.clip import ClipGradByGlobalNorm
+from ..auto_parallel import Replicate, Shard, shard_tensor
+from . import mp_layers, random_ctrl, recompute as _recompute_mod
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random_ctrl import get_rng_state_tracker
+from .recompute import recompute, recompute_sequential
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+
+
+class HybridConfig(dict):
+    pass
+
+
+class DistributedStrategy:
+    """fleet/base/distributed_strategy.py analog (proto
+    framework/distributed_strategy.proto:359, HybridConfig:95)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.sharding = False
+        self.sharding_configs = {}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.tensor_parallel_configs = {}
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        """fleet.init (fleet.py:167 → _init_hybrid_parallel_env fleet.py:603)."""
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        dims = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+                hc.get("mp_degree", 1)]
+        import jax
+        n = len(jax.devices())
+        specified = int(np.prod([d for d in dims if d > 0]))
+        # -1 on dp means "fill remaining devices"
+        if hc.get("dp_degree", 1) in (-1, 0) or specified != n:
+            fixed = int(np.prod(dims[1:]))
+            dims[0] = max(n // fixed, 1)
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"], dims)
+        self._hcg = HybridCommunicateGroup(topo)
+        self._initialized = True
+        return self
+
+    @property
+    def worker_num(self):
+        import jax
+        return jax.process_count()
+
+    def worker_index(self):
+        import jax
+        return jax.process_index()
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        """fleet.distributed_model (fleet/model.py:32): place params on the
+        mesh. TP layers already annotate their own params; remaining params
+        are replicated across all axes (DP/sharding placement of grads/states
+        happens in the optimizer/TrainStep tier)."""
+        if self._hcg is None:
+            raise RuntimeError("call fleet.init first")
+        mesh = self._hcg.mesh
+        repl = [Replicate()] * len(mesh.dim_names)
+        for p in model.parameters():
+            if p._dist_attr is None:
+                shard_tensor(p, mesh, repl)
+        model._fleet_hcg = self._hcg
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       strategy or self._strategy)
+
+
+fleet = _Fleet()
+
+
+# module-level API: fleet.init(...), fleet.distributed_model(...)
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    return fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def worker_num():
+    return fleet.worker_num
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+class HybridParallelClipGrad:
+    """Hybrid global-norm clip (hybrid_parallel_optimizer.py:44). Under the
+    single-controller mesh the grads are global arrays, so the norm is already
+    global — the cross-axis norm reduction of the reference is implicit."""
+
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params):
+        return self._clip(params)
+
+    def apply_to_arrays(self, grads):
+        return self._clip.apply_to_arrays(grads)
+
+
+class HybridParallelOptimizer:
+    """hybrid_parallel_optimizer.py:254 analog: wraps the inner optimizer,
+    upgrades global-norm clip to the hybrid-aware version, and applies
+    sharding-stage placement of optimizer states."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(
+                optimizer._grad_clip, self._hcg)
+        if (self._hcg is not None
+                and self._hcg.get_sharding_parallel_world_size() > 1):
+            _shard_optimizer_states(optimizer, self._hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad()
+
+    def minimize(self, loss, **kwargs):
+        self._inner_opt.minimize(loss, **kwargs)
+
+
+def _shard_optimizer_states(optimizer, hcg):
+    """ZeRO stage-1: optimizer states sharded over the 'sharding' axis
+    (DygraphShardingOptimizer analog, dygraph_sharding_optimizer.py:48)."""
+    from .._shard_states import shard_optimizer_states
+    shard_optimizer_states(optimizer, hcg.mesh, hcg.sharding_axis)
+
+
+# meta-parallel wrappers (fleet/meta_parallel analog; on TPU they are
+# placement policies rather than communication wrappers)
+class TensorParallel:
+    def __new__(cls, model, hcg=None, **kwargs):
+        return model
+
+
+class ShardingParallel:
+    def __new__(cls, model, hcg=None, **kwargs):
+        return model

@@ -1,0 +1,76 @@
+"""TP RNG state tracking (fleet/layers/mpu/random.py:34 RNGStatesTracker).
+
+The reference keeps distinct CUDA RNG states per TP rank so dropout inside
+TP regions differs across ranks while weight init matches. Single-controller
+SPMD: there is one logical RNG; per-position randomness is already distinct
+because the mask is drawn for the GLOBAL shape and sharded with the
+activations. The tracker is kept for API parity and for explicitly-seeded
+regions.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...core import random as random_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        gen = random_mod.default_generator()
+        orig = gen.get_state()
+        gen.set_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = gen.get_state()
+            gen.set_state(orig)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import paddle_tpu as paddle
+    seed = seed or 0
+    global_seed = seed
+    local_seed = seed + 1024
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    paddle.seed(global_seed)
+
+
+def determinate_seed(rng_name):
+    return 0

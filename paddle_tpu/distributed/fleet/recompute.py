@@ -1,0 +1,121 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: fleet/recompute/recompute.py — RecomputeFunction:108 (PyLayer that
+stows inputs + RNG state, replays forward in backward), recompute:404,
+recompute_sequential:542, and recompute_hybrid.py for the PP-aware variant.
+
+TPU-native: the same stow-and-replay tape node. Under TrainStep/jit tracing
+the replay unrolls into forward-without-residuals + recompute + backward, which
+is exactly jax.checkpoint/remat semantics — XLA DCEs the unused first-pass
+residuals, so compiled memory behavior matches the reference's.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from ...autograd import engine as _engine
+from ...autograd.engine import GradNode
+from ...core import random as random_mod
+from ...core.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    """fleet.recompute analog (recompute.py:404). use_reentrant semantics of
+    the reference's default (PyLayer) path."""
+    kwargs.pop("use_reentrant", None)
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+
+    kw_keys = sorted(k for k, v in kwargs.items() if isinstance(v, Tensor))
+    in_tensors = [a for a in args if isinstance(a, Tensor)] + \
+        [kwargs[k] for k in kw_keys]
+    requires = _engine.is_grad_enabled() and any(
+        not t.stop_gradient for t in in_tensors)
+
+    gen = random_mod.default_generator()
+    fwd_key = gen.get_state() if preserve_rng else None
+
+    with _engine.no_grad():
+        out = function(*args, **kwargs)
+
+    if not requires:
+        return out
+
+    out_is_seq = isinstance(out, (list, tuple))
+    out_list = [o for o in (out if out_is_seq else [out])
+                if isinstance(o, Tensor)]
+    out_avals = [(tuple(t.shape), t.dtype) for t in out_list]
+
+    def vjp_fn(flat_cts):
+        # replay forward WITH grad under the stashed RNG state
+        saved_key = gen.get_state()
+        saved_grads = [(t, t._grad) for t in in_tensors]
+        try:
+            if preserve_rng:
+                gen.set_state(fwd_key)
+            detached = []
+            for a in args:
+                if isinstance(a, Tensor):
+                    d = Tensor(a._data, stop_gradient=a.stop_gradient)
+                    detached.append(d)
+                else:
+                    detached.append(a)
+            det_kwargs = dict(kwargs)
+            for k in kw_keys:
+                v = kwargs[k]
+                det_kwargs[k] = Tensor(v._data, stop_gradient=v.stop_gradient)
+            with _engine.enable_grad():
+                re_out = function(*detached, **det_kwargs)
+            re_list = [o for o in (re_out if isinstance(re_out, (list, tuple))
+                                   else [re_out]) if isinstance(o, Tensor)]
+            det_inputs = [d for d in detached if isinstance(d, Tensor)] + \
+                [det_kwargs[k] for k in kw_keys]
+            grads_map = _engine.run_backward(
+                re_list, list(flat_cts),
+                inputs=det_inputs, accumulate_leaf=False)
+            return tuple(grads_map.get(id(d)) for d in det_inputs)
+        finally:
+            gen.set_state(saved_key)
+            for t, g in saved_grads:
+                t._grad = g
+
+    needs = [not t.stop_gradient for t in in_tensors]
+    node = GradNode("recompute", vjp_fn, in_tensors, needs, out_avals)
+    wrapped = []
+    for idx, t in enumerate(out_list):
+        nt = Tensor(t._data, stop_gradient=False)
+        nt._grad_node = node
+        nt._grad_out_idx = idx
+        wrapped.append(nt)
+    if out_is_seq:
+        it = iter(wrapped)
+        return type(out)(next(it) if isinstance(o, Tensor) else o for o in out)
+    return wrapped[0]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """recompute.py:542 analog — checkpoint a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    preserve = ctx.get("preserve_rng_state", True) if isinstance(ctx, dict) else True
+    if hasattr(functions, "_sub_layers"):
+        layers = list(functions._sub_layers.values())
+    else:
+        layers = list(functions)
+    seg_size = max(len(layers) // max(segments, 1), 1)
+
+    def run_segment(layers_seg):
+        def fn(x):
+            for l in layers_seg:
+                x = l(x)
+            return x
+        return fn
+
+    x = args[0]
+    i = 0
+    while i < len(layers):
+        seg = layers[i:i + seg_size]
+        x = recompute(run_segment(seg), x,
+                      preserve_rng_state=preserve)
+        i += seg_size
+    return x

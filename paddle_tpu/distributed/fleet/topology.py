@@ -1,0 +1,177 @@
+"""Hybrid-parallel topology.
+
+Reference: CommunicateTopology + HybridCommunicateGroup
+(fleet/base/topology.py:61,174) — axes ["dp","pp","sharding","sep","mp"] with
+per-axis NCCL process groups (topology.py:344) and p2p prev/next rings.
+
+TPU-native redesign: the topology IS a device mesh. One jax.sharding.Mesh with
+named axes (dp, pp, sharding, sep, mp) backs every axis "group"; per-axis
+collectives are XLA collectives over that axis name, and parallel layers
+consume axis names rather than communicator handles.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..auto_parallel import ProcessMesh
+from ..collective import Group
+
+_HCG: List[Optional["HybridCommunicateGroup"]] = [None]
+
+
+class CommunicateTopology:
+    """fleet/base/topology.py:61 analog."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = int(np.prod(self._dims))
+        self._coord_map = {}
+        ranks = np.arange(self._world_size).reshape(self._dims)
+        for coord in itertools.product(*[range(d) for d in self._dims]):
+            self._coord_map[coord] = int(ranks[coord])
+        self._rank_map = {v: k for k, v in self._coord_map.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord_map[coord]
+
+    def get_coord(self, rank):
+        return self._rank_map[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._rank_map.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups along `axis_name` (one per orthogonal coord)."""
+        axis = self._parallel_names.index(axis_name)
+        others = [list(range(d)) for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for coord in itertools.product(*others):
+            group = []
+            for k in range(self._dims[axis]):
+                full = list(coord)
+                full.insert(axis, k)
+                group.append(self._coord_map[tuple(full)])
+            groups.append(group)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """fleet/base/topology.py:174 analog — one mesh, five named axes."""
+
+    AXIS_NAMES = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                  "sep": "sep", "model": "mp"}
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        dims = topology._dims
+        names = [self.AXIS_NAMES[n] for n in topology._parallel_names]
+        n_dev = len(jax.devices())
+        if topology.world_size() != n_dev:
+            raise ValueError(
+                f"topology world size {topology.world_size()} != device count "
+                f"{n_dev}; on TPU every rank is a chip in the mesh")
+        self.mesh = ProcessMesh(
+            np.arange(n_dev).reshape(dims), names)
+        self._groups: Dict[str, Group] = {}
+        for pname, axis in self.AXIS_NAMES.items():
+            ranks = topology.get_comm_list(pname)[0]
+            self._groups[axis] = Group(ranks, self.mesh, axis)
+        _HCG[0] = self
+
+    # degree accessors (topology.py API parity)
+    def get_data_parallel_world_size(self):
+        return self._topo.get_dim("data")
+
+    def get_model_parallel_world_size(self):
+        return self._topo.get_dim("model")
+
+    def get_pipe_parallel_world_size(self):
+        return self._topo.get_dim("pipe")
+
+    def get_sharding_parallel_world_size(self):
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self._topo.get_dim("sep")
+
+    # single-controller: the logical program is "rank 0" on every axis
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self):
+        return self._groups["mp"]
+
+    def topology(self):
+        return self._topo
+
+    # axis names for sharding annotations
+    @property
+    def dp_axis(self):
+        return "dp"
+
+    @property
+    def mp_axis(self):
+        return "mp"
+
+    @property
+    def pp_axis(self):
+        return "pp"
+
+    @property
+    def sharding_axis(self):
+        return "sharding"
+
+    @property
+    def sep_axis(self):
+        return "sep"
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG[0]
+
+
+def set_hybrid_communicate_group(hcg):
+    _HCG[0] = hcg

@@ -1,0 +1,82 @@
+"""Data parallelism.
+
+Reference: paddle.DataParallel (python/paddle/distributed/parallel.py:202) +
+EagerReducer grad bucketing (fluid/distributed/collective/reducer.h:88) with
+backward-overlapped allreduce and the no_sync context.
+
+TPU-native: with params replicated and the batch sharded over the dp axis,
+GSPMD emits the gradient psum inside the compiled backward — bucketing,
+reduce hooks, and comm/compute overlap are the XLA scheduler's job. The
+wrapper here (1) places params, (2) shards inputs on dp, (3) keeps API parity
+(no_sync, scale_loss)."""
+from __future__ import annotations
+
+import contextlib
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .auto_parallel import ProcessMesh, Replicate, Shard, shard_tensor
+from .collective import get_world_size, init_parallel_env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        from .fleet.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            self._mesh = hcg.mesh
+            self._dp_axis = "dp"
+        else:
+            g = group or init_parallel_env()
+            self._mesh = g.mesh
+            self._dp_axis = g.axis_name
+        repl = [Replicate()] * len(self._mesh.dim_names)
+        for p in layers.parameters():
+            if p._dist_attr is None:
+                shard_tensor(p, self._mesh, repl)
+
+    def _shard_input(self, t):
+        if isinstance(t, Tensor) and t.ndim > 0 and t._dist_attr is None:
+            placements = [Shard(0) if n == self._dp_axis else Replicate()
+                          for n in self._mesh.dim_names]
+            if t.shape[0] % self._mesh.get_dim_size(self._dp_axis) == 0:
+                return shard_tensor(t, self._mesh, placements)
+        return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(i) for i in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Grad-accumulation guard. GSPMD defers the grad psum to whenever the
+        grads are consumed, so accumulation without sync is the default; this
+        context exists for API parity."""
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+def sync_params_buffers(model, comm_group=None, src_rank=0,
+                        is_model_parallel=False):
+    """parallel.py:149 analog — single-controller params are already
+    consistent; kept for API parity."""
+    return None

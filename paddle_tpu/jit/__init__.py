@@ -340,6 +340,17 @@ class TrainStep:
                 _engine.run_backward([loss_t], [None])
                 grads = [None if p._grad is None else p._grad._data
                          for p in params]
+                gs = getattr(opt, "_group_sharded", None)
+                if gs is not None:
+                    # ZeRO stage-2/3: constrain grads Shard(0) over the
+                    # sharding axis so XLA reduce-scatters the backward
+                    grads = [
+                        g if g is None else (
+                            jax.lax.with_sharding_constraint(
+                                g, gs.grad_sharding(tuple(g.shape)))
+                            if gs.grad_sharding(tuple(g.shape)) is not None
+                            else g)
+                        for g in grads]
                 if clip is not None and hasattr(clip, "apply_to_arrays"):
                     grads = clip.apply_to_arrays(grads)
                 lr_ = lr
