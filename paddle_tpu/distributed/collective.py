@@ -125,18 +125,32 @@ def destroy_process_group(group=None):
 def barrier(group: Optional[Group] = None):
     g = group or _world()
     x = jnp.zeros((g.nranks,), jnp.int32)
-    _stacked(lambda v: jax.lax.psum(v, g.axis_name), g, x).block_until_ready()
+    _stacked(lambda v: jax.lax.psum(v, g.axis_name), g, x,
+             cache_key=("barrier",)).block_until_ready()
 
 
 # -- stacked collective machinery -------------------------------------------
 
-def _stacked(body, group: Group, arr, out_sharded=True):
-    """Run `body` per-rank-shard over the group axis via shard_map."""
+_STACKED_JIT_CACHE: dict = {}
+
+
+def _stacked(body, group: Group, arr, out_sharded=True, cache_key=None):
+    """Run `body` per-rank-shard over the group axis via shard_map.
+
+    cache_key (hashable, identifying the body's semantics) lets repeat eager
+    collectives reuse one jitted callable instead of re-wrapping a fresh
+    lambda in jax.jit every call (which defeats jit's identity cache)."""
     mesh = group.mesh.jax_mesh
-    n = group.nranks
     in_spec = P(group.axis_name)
     out_spec = P(group.axis_name) if out_sharded else P()
-    fn = jax.jit(shard_map(body, mesh, (in_spec,), out_spec))
+    if cache_key is not None:
+        key = (mesh, group.axis_name, out_sharded, cache_key)
+        fn = _STACKED_JIT_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(shard_map(body, mesh, (in_spec,), out_spec))
+            _STACKED_JIT_CACHE[key] = fn
+    else:
+        fn = jax.jit(shard_map(body, mesh, (in_spec,), out_spec))
     sharding = NamedSharding(mesh, in_spec)
     if not isinstance(arr, jax.core.Tracer):
         arr = jax.device_put(arr, sharding)
@@ -169,10 +183,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     elif op == ReduceOp.AVG:
         body = lambda x: jax.lax.pmean(x, g.axis_name)
     elif op == ReduceOp.PROD:
-        body = lambda x: jnp.exp(jax.lax.psum(jnp.log(x), g.axis_name))
+        # exact product (sign-safe): gather the shards, reduce locally
+        body = lambda x: jnp.prod(jax.lax.all_gather(x, g.axis_name), axis=0)
     else:
         raise ValueError(f"unknown reduce op {op}")
-    out = _stacked(body, g, arr)
+    out = _stacked(body, g, arr, cache_key=("all_reduce", op))
     if isinstance(tensor, Tensor):
         tensor._set_data(out)
         return tensor
@@ -189,7 +204,7 @@ def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
     _check_stacked(arr, g, "all_gather")
     out = _stacked(
         lambda x: jax.lax.all_gather(x, g.axis_name, axis=0, tiled=True),
-        g, arr, out_sharded=False)
+        g, arr, out_sharded=False, cache_key=("all_gather",))
     slices = [Tensor(out[i]) for i in range(g.nranks)]
     if tensor_list is not None:
         tensor_list.extend(slices)
@@ -219,7 +234,8 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
             full, src_idx * (arr.shape[0] // g.nranks),
             arr.shape[0] // g.nranks, axis=0)
 
-    out = _stacked(body, g, arr)
+    out = _stacked(body, g, arr,
+                   cache_key=("broadcast", src_idx, arr.shape[0]))
     if isinstance(tensor, Tensor):
         tensor._set_data(out)
         return tensor
@@ -279,7 +295,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
     else:
         raise ValueError(f"reduce_scatter: unsupported op {op}")
 
-    out = _stacked(body, g, arr)
+    out = _stacked(body, g, arr, cache_key=("reduce_scatter", op))
     if out_t is not None:
         out_t._set_data(out)
         return out_t
@@ -289,12 +305,15 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
 def scatter(tensor, tensor_list=None, src: int = 0,
             group: Optional[Group] = None, sync_op=True):
     g = group or _world()
+    src_local = g.get_group_rank(src)
+    if src_local < 0:
+        raise ValueError(f"scatter: src rank {src} not in group {g.ranks}")
     if tensor_list is not None:
-        data = jnp.stack([_unwrap(t)[src] for t in tensor_list], axis=0)
+        data = jnp.stack([_unwrap(t)[src_local] for t in tensor_list], axis=0)
     else:
         arr = _unwrap(tensor)
         _check_stacked(arr, g, "scatter")
-        chunks = jnp.split(arr[src], g.nranks, axis=0)
+        chunks = jnp.split(arr[src_local], g.nranks, axis=0)
         data = jnp.stack(chunks, axis=0).reshape(
             (g.nranks,) + tuple(chunks[0].shape))
     if isinstance(tensor, Tensor):
@@ -316,13 +335,10 @@ def alltoall(in_tensor_list, out_tensor_list=None,
         _check_stacked(arr, g, "alltoall")
         arr = arr.reshape((g.nranks, g.nranks, -1) + tuple(arr.shape[2:]))
 
-    mesh = g.mesh.jax_mesh
-    fn = jax.jit(shard_map(
+    out = _stacked(
         lambda x: jax.lax.all_to_all(x, g.axis_name, split_axis=1,
                                      concat_axis=0, tiled=True),
-        mesh, (P(g.axis_name),), P(g.axis_name)))
-    sharding = NamedSharding(mesh, P(g.axis_name))
-    out = fn(jax.device_put(arr, sharding))
+        g, arr, cache_key=("alltoall",))
     if out_tensor_list is not None:
         out_tensor_list.extend(Tensor(out[:, i]) for i in range(g.nranks))
         return out_tensor_list
@@ -335,11 +351,15 @@ def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
     should use ppermute (see distributed.ppermute) instead. Matching is FIFO
     per group — ambiguous outstanding sends raise rather than mis-deliver."""
     g = group or _world()
+    if dst not in g.ranks:
+        raise ValueError(f"send: dst rank {dst} not in group {g.ranks}")
     _P2P_BUF.setdefault(g.id, []).append((dst, _unwrap(tensor)))
 
 
 def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
     g = group or _world()
+    if src not in g.ranks:
+        raise ValueError(f"recv: src rank {src} not in group {g.ranks}")
     buf = _P2P_BUF.get(g.id, [])
     if not buf:
         raise RuntimeError("recv without matching send")

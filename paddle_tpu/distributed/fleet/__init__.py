@@ -183,6 +183,16 @@ class HybridParallelOptimizer:
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
+    def __setattr__(self, name, value):
+        # Writes to inner-optimizer attrs (e.g. _step_count from TrainStep)
+        # must land on the inner optimizer, not shadow it on the wrapper.
+        if name in ("_inner_opt", "_hcg", "_strategy") \
+                or "_inner_opt" not in self.__dict__ \
+                or not hasattr(self._inner_opt, name):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._inner_opt, name, value)
+
     def step(self):
         self._inner_opt.step()
 

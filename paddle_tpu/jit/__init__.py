@@ -258,19 +258,39 @@ class TrainStep:
     pure update (optimizer.py `_update`).
     """
 
-    def __init__(self, train_fn: Callable, optimizer, amp=None):
+    def __init__(self, train_fn: Callable, optimizer, amp=None, donate=True):
+        """donate=True donates the param/master/opt-state device buffers to
+        each compiled step (XLA updates them in place — halves HBM for the
+        update). Tensors aliasing those buffers from BEFORE the step (e.g. a
+        `.detach()` snapshot of a weight) become invalid afterwards and raise
+        loudly on use; pass donate=False to keep old buffers alive."""
         self._fn = train_fn
         self._opt = optimizer
         self._amp = amp  # optional paddle_tpu.amp.auto_cast factory kwargs
+        self._donate = donate
         self._cache: Dict[Any, dict] = {}
 
     def __call__(self, *args):
         key = _sig_of(args, {})
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._build(args)
-            self._cache[key] = entry
-            return entry.pop("first_loss")
+            if self._cache:
+                # The pure step re-executes the model under tracing, so it is
+                # shape-polymorphic: a new batch shape only needs an XLA
+                # retrace (jax.jit does that), NOT a new eager discovery
+                # pass. This keeps the expensive unfused eager pass on a
+                # tiny warmup batch (TPU memory: the eager pass holds every
+                # per-op vjp residual unfused). Caveat: the state/mutation
+                # sets discovered at the first shape are reused — a model
+                # that lazily creates NEW buffers only at some shapes (e.g.
+                # a cached per-seq-len mask) must precompute them (as the
+                # model zoo does) or run one eager step per shape first.
+                entry = next(iter(self._cache.values()))
+                self._cache[key] = entry
+            else:
+                entry = self._build(args)
+                self._cache[key] = entry
+                return entry.pop("first_loss")
         return self._run(entry, args)
 
     def _loss_fn(self, *args):
@@ -389,7 +409,11 @@ class TrainStep:
                     t._grad = g
                 gen.set_state(saved_key)
 
-        compiled = jax.jit(pure)
+        # Donate params/masters/opt-state buffers: every one is fully
+        # replaced after the step, so XLA reuses their HBM in place (halves
+        # steady-state memory for the update).
+        compiled = jax.jit(
+            pure, donate_argnums=(0, 1, 2) if self._donate else ())
         return {"compiled": compiled, "params": params, "extra": extra,
                 "extra_mut": extra_mut, "other_grad_ts": other_grad_ts,
                 "use_master": use_master, "rng_used": rng_used,

@@ -1,0 +1,23 @@
+"""Model zoo.
+
+The reference keeps end-to-end model fixtures in test/ (e.g.
+test/auto_parallel/get_gpt_model.py, test/book/) and vision models in
+python/paddle/vision/models; its north-star configs (BASELINE.md) are
+ResNet-50, GPT-2 124M, and Llama-2 7B. This package provides those model
+families as first-class citizens, built TPU-first: static shapes, bf16-friendly
+compute, attention through the fused flash-attention path, and optional
+tensor-parallel variants over the hybrid mesh.
+"""
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    llama2_7b_config, llama_tiny_config, shard_llama)
+from .gpt import GPT2Config, GPT2ForCausalLM, GPT2Model, gpt2_124m_config
+from .resnet import (BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34,
+                     resnet50, resnet101, resnet152)
+
+__all__ = [
+    "LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama2_7b_config",
+    "llama_tiny_config", "shard_llama",
+    "GPT2Config", "GPT2Model", "GPT2ForCausalLM", "gpt2_124m_config",
+    "ResNet", "BasicBlock", "BottleneckBlock", "resnet18", "resnet34",
+    "resnet50", "resnet101", "resnet152",
+]

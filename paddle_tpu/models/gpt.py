@@ -1,0 +1,154 @@
+"""GPT-2 model family (BASELINE.md config #2, GPT-2 124M compiled-path bench).
+
+Reference fixture: test/auto_parallel/get_gpt_model.py and the fused
+transformer tier (phi/kernels/fusion). TPU-first: pre-norm blocks, learned
+positional embeddings, GELU MLP, attention through the fused SDPA path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.norm import LayerNorm
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dropout: float = 0.1
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def gpt2_124m_config(**overrides) -> GPT2Config:
+    cfg = GPT2Config()
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+class GPT2Attention(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        init = I.Normal(std=config.initializer_range)
+        self.c_attn = Linear(config.hidden_size, 3 * config.hidden_size,
+                             weight_attr=init)
+        self.c_proj = Linear(config.hidden_size, config.hidden_size,
+                             weight_attr=init)
+        self.config = config
+        self.resid_dropout = Dropout(config.dropout)
+
+    def forward(self, hidden):
+        b, s, _ = hidden.shape
+        h, d = self.config.num_attention_heads, self.config.head_dim
+        qkv = self.c_attn(hidden).reshape([b, s, 3, h, d])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.config.dropout if self.training else 0.0)
+        out = self.c_proj(out.reshape([b, s, h * d]))
+        return self.resid_dropout(out)
+
+
+class GPT2MLP(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        init = I.Normal(std=config.initializer_range)
+        self.c_fc = Linear(config.hidden_size, config.intermediate_size,
+                           weight_attr=init)
+        self.c_proj = Linear(config.intermediate_size, config.hidden_size,
+                             weight_attr=init)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.c_proj(F.gelu(self.c_fc(x), approximate=True)))
+
+
+class GPT2Block(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.attn = GPT2Attention(config)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.mlp = GPT2MLP(config)
+
+    def forward(self, hidden):
+        hidden = hidden + self.attn(self.ln_1(hidden))
+        return hidden + self.mlp(self.ln_2(hidden))
+
+
+class GPT2Model(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.config = config
+        init = I.Normal(std=config.initializer_range)
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=init)
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size, weight_attr=init)
+        self.drop = Dropout(config.dropout)
+        self.h = [GPT2Block(config) for _ in range(config.num_hidden_layers)]
+        for i, blk in enumerate(self.h):
+            self.add_sublayer(f"h.{i}", blk)
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        from .. import ops
+        _, s = input_ids.shape
+        pos = ops.arange(0, s, dtype="int32").unsqueeze(0)
+        hidden = self.wte(input_ids) + self.wpe(pos)
+        hidden = self.drop(hidden)
+        for blk in self.h:
+            hidden = blk(hidden)
+        return self.ln_f(hidden)
+
+
+class GPT2ForCausalLM(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.config = config
+        self.transformer = GPT2Model(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=I.Normal(
+                                      std=config.initializer_range),
+                                  bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.transformer(input_ids)
+        if self.lm_head is None:
+            from .. import ops
+            logits = ops.matmul(hidden, self.transformer.wte.weight,
+                                transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]).astype("float32"),
+            labels.reshape([-1]))
+        return logits, loss
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())

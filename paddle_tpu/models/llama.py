@@ -1,0 +1,286 @@
+"""Llama model family (flagship; BASELINE.md config #3, Llama-2-7B).
+
+The reference ships Llama through PaddleNLP on top of the fused-op tier
+(fused rope: python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py,
+flash attention: paddle/phi/kernels/gpu/flash_attn_kernel.cu:128, rmsnorm in
+fusion kernels). This is a TPU-first redesign, not a port:
+
+- static shapes end to end, single fused attention contraction (XLA fuses
+  the softmax chain; Pallas flash kernel swaps in on TPU),
+- GQA (n_kv_heads < n_heads) expressed as an einsum over grouped heads so the
+  MXU sees large batched matmuls,
+- RoPE applied as a cheap elementwise rotation fused by XLA into the
+  projection matmuls,
+- optional tensor parallelism via the mp sharded layers (GSPMD inserts the
+  Megatron collectives over ICI).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.common import Embedding, Linear
+from ..nn.norm import RMSNorm
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama2_7b_config(**overrides) -> LlamaConfig:
+    cfg = LlamaConfig()
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def llama_tiny_config(**overrides) -> LlamaConfig:
+    """Test-scale config (the reference's tiny GPT fixture analog,
+    test/auto_parallel/get_gpt_model.py)."""
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):
+    """Precompute RoPE tables: [seq, head_dim//2]."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2,
+                                          dtype=np.float32) / head_dim))
+    t = np.arange(seq_len, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)  # [seq, hd/2]
+    return (jnp.asarray(np.cos(freqs), dtype=dtype),
+            jnp.asarray(np.sin(freqs), dtype=dtype))
+
+
+def apply_rotary_pos_emb(x, cos, sin):
+    """Rotate [B, S, H, D] by the (cos, sin) tables ([S, D/2]).
+
+    Interleaved-pair convention (fused_rotary_position_embedding analog):
+    even/odd feature pairs are rotated in fp32 then cast back — elementwise,
+    so XLA fuses it into the surrounding matmuls.
+    """
+    x32 = x.astype(jnp.float32)
+    x1 = x32[..., 0::2]
+    x2 = x32[..., 1::2]
+    c = cos[None, :, None, :].astype(jnp.float32)
+    s = sin[None, :, None, :].astype(jnp.float32)
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(Layer):
+    """GQA attention with RoPE."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        h, kv = config.num_attention_heads, config.num_key_value_heads
+        d = config.head_dim
+        init = I.Normal(std=config.initializer_range)
+        self.q_proj = Linear(config.hidden_size, h * d, weight_attr=init,
+                             bias_attr=False)
+        self.k_proj = Linear(config.hidden_size, kv * d, weight_attr=init,
+                             bias_attr=False)
+        self.v_proj = Linear(config.hidden_size, kv * d, weight_attr=init,
+                             bias_attr=False)
+        self.o_proj = Linear(h * d, config.hidden_size, weight_attr=init,
+                             bias_attr=False)
+
+    def forward(self, hidden, cos, sin, attn_mask=None):
+        b, s, _ = hidden.shape
+        cfg = self.config
+        h, kv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        q = self.q_proj(hidden).reshape([b, s, h, d])
+        k = self.k_proj(hidden).reshape([b, s, kv, d])
+        v = self.v_proj(hidden).reshape([b, s, kv, d])
+        q = apply_rotary_pos_emb_t(q, cos, sin)
+        k = apply_rotary_pos_emb_t(k, cos, sin)
+        if kv != h:
+            # GQA: repeat kv heads to full head count; XLA keeps this as a
+            # broadcast feeding the batched matmul (no materialized copy).
+            rep = h // kv
+            k = k.unsqueeze(3).expand([b, s, kv, rep, d]).reshape([b, s, h, d])
+            v = v.unsqueeze(3).expand([b, s, kv, rep, d]).reshape([b, s, h, d])
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=attn_mask is None)
+        out = out.reshape([b, s, h * d])
+        return self.o_proj(out)
+
+
+def apply_rotary_pos_emb_t(x: Tensor, cos, sin) -> Tensor:
+    """Tensor-level RoPE wired through the op layer so autograd sees it."""
+    from ..ops.registry import dispatch
+    return dispatch(apply_rotary_pos_emb, (x, cos, sin), {}, "rope")
+
+
+class LlamaMLP(Layer):
+    """SwiGLU MLP: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        init = I.Normal(std=config.initializer_range)
+        self.gate_proj = Linear(config.hidden_size, config.intermediate_size,
+                                weight_attr=init, bias_attr=False)
+        self.up_proj = Linear(config.hidden_size, config.intermediate_size,
+                              weight_attr=init, bias_attr=False)
+        self.down_proj = Linear(config.intermediate_size, config.hidden_size,
+                                weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+
+    def forward(self, hidden, cos, sin, attn_mask=None):
+        residual = hidden
+        hidden = self.input_layernorm(hidden)
+        hidden = self.self_attn(hidden, cos, sin, attn_mask)
+        hidden = residual + hidden
+        residual = hidden
+        hidden = self.post_attention_layernorm(hidden)
+        hidden = self.mlp(hidden)
+        return residual + hidden
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embed_tokens = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=I.Normal(std=config.initializer_range))
+        self.layers = [LlamaDecoderLayer(config)
+                       for _ in range(config.num_hidden_layers)]
+        for i, l in enumerate(self.layers):
+            self.add_sublayer(f"layers.{i}", l)
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        jdt = dtype_mod.to_jax_dtype(config.dtype)
+        self._cos, self._sin = _rope_cos_sin(
+            config.max_position_embeddings, config.head_dim, config.rope_theta,
+            jdt)
+
+    def forward(self, input_ids, attn_mask=None):
+        _, s = input_ids.shape
+        hidden = self.embed_tokens(input_ids)
+        cos, sin = self._cos[:s], self._sin[:s]
+        for layer in self.layers:
+            hidden = layer(hidden, cos, sin, attn_mask)
+        return self.norm(hidden)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=I.Normal(
+                                      std=config.initializer_range),
+                                  bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.model(input_ids, attn_mask)
+        if self.lm_head is None:
+            from .. import ops
+            logits = ops.matmul(hidden, self.model.embed_tokens.weight,
+                                transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]).astype("float32"),
+            labels.reshape([-1]))
+        return logits, loss
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
+                fsdp_axis: Optional[str] = None):
+    """Apply Megatron-style TP (+ optional FSDP) placements to a Llama model.
+
+    The reference expresses this with dedicated parallel layer classes
+    (fleet/layers/mpu/mp_layers.py) and per-op collectives; TPU-first the same
+    plan is pure sharding metadata — GSPMD inserts the identity/allreduce/
+    allgather collectives over ICI:
+      - q/k/v/gate/up projections: column-parallel  -> Shard(out_dim) on mp
+      - o/down projections:        row-parallel     -> Shard(in_dim)  on mp
+      - token embedding:           vocab-parallel   -> Shard(vocab)   on mp
+      - lm_head:                   column-parallel  -> Shard(vocab)   on mp
+      - optional fsdp axis: every 2D weight additionally Shard on its other
+        dim (ZeRO-3-style parameter sharding as placements, SURVEY.md §7).
+    """
+    from ..distributed.auto_parallel import Replicate, Shard, shard_tensor
+
+    names = mesh.dim_names
+
+    def place(param, mp_dim=None, fsdp_dim=None):
+        placements = []
+        for ax in names:
+            if ax == mp_axis and mp_dim is not None:
+                placements.append(Shard(mp_dim))
+            elif fsdp_axis is not None and ax == fsdp_axis \
+                    and fsdp_dim is not None:
+                placements.append(Shard(fsdp_dim))
+            else:
+                placements.append(Replicate())
+        shard_tensor(param, mesh, placements)
+
+    place(model.model.embed_tokens.weight, mp_dim=0, fsdp_dim=1)
+    for layer in model.model.layers:
+        attn, mlp = layer.self_attn, layer.mlp
+        for col in (attn.q_proj, attn.k_proj, attn.v_proj,
+                    mlp.gate_proj, mlp.up_proj):
+            place(col.weight, mp_dim=1, fsdp_dim=0)
+        for row in (attn.o_proj, mlp.down_proj):
+            place(row.weight, mp_dim=0, fsdp_dim=1)
+        place(layer.input_layernorm.weight)
+        place(layer.post_attention_layernorm.weight)
+    place(model.model.norm.weight)
+    if model.lm_head is not None:
+        place(model.lm_head.weight, mp_dim=1, fsdp_dim=0)
+    return model

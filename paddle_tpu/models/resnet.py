@@ -1,0 +1,153 @@
+"""ResNet family (BASELINE.md config #1; reference
+python/paddle/vision/models/resnet.py — same block/arch structure, rebuilt on
+the XLA conv path where convs lower to single conv_general_dilated HLOs).
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..nn import functional as F
+from ..nn.common import Linear
+from ..nn.conv import Conv2D
+from ..nn.norm import BatchNorm2D
+from ..nn.pooling import AdaptiveAvgPool2D, MaxPool2D
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(inplanes, planes, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(planes)
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.bn2 = BatchNorm2D(planes)
+        self.downsample = downsample
+        if downsample is not None:
+            self.add_sublayer("downsample", downsample)
+
+    def forward(self, x):
+        identity = x
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return F.relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(planes)
+        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm2D(planes)
+        self.conv3 = Conv2D(planes, planes * self.expansion, 1,
+                            bias_attr=False)
+        self.bn3 = BatchNorm2D(planes * self.expansion)
+        self.downsample = downsample
+        if downsample is not None:
+            self.add_sublayer("downsample", downsample)
+
+    def forward(self, x):
+        identity = x
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return F.relu(out + identity)
+
+
+class _Downsample(Layer):
+    def __init__(self, inplanes, outplanes, stride):
+        super().__init__()
+        self.conv = Conv2D(inplanes, outplanes, 1, stride=stride,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(outplanes)
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+class _Sequential(Layer):
+    def __init__(self, blocks):
+        super().__init__()
+        self.blocks = blocks
+        for i, b in enumerate(blocks):
+            self.add_sublayer(str(i), b)
+
+    def forward(self, x):
+        for b in self.blocks:
+            x = b(x)
+        return x
+
+
+class ResNet(Layer):
+    """vision/models/resnet.py:ResNet analog. Input NCHW."""
+
+    def __init__(self, block, depth_layers, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
+        self.bn1 = BatchNorm2D(64)
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, depth_layers[0])
+        self.layer2 = self._make_layer(block, 128, depth_layers[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, depth_layers[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, depth_layers[3], stride=2)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = _Downsample(self.inplanes, planes * block.expansion,
+                                     stride)
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return _Sequential(layers)
+
+    def forward(self, x):
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.layer3(x)
+        x = self.layer4(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def resnet18(**kwargs):
+    return ResNet(BasicBlock, [2, 2, 2, 2], **kwargs)
+
+
+def resnet34(**kwargs):
+    return ResNet(BasicBlock, [3, 4, 6, 3], **kwargs)
+
+
+def resnet50(**kwargs):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], **kwargs)
+
+
+def resnet101(**kwargs):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], **kwargs)
+
+
+def resnet152(**kwargs):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], **kwargs)

@@ -3,6 +3,9 @@ from __future__ import annotations
 
 from . import functional
 from . import initializer
+# paddle.nn re-exports the grad-clip classes (python/paddle/nn/__init__.py)
+from ..optimizer.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                              ClipGradByValue)
 from .layer import (Layer, LayerDict, LayerList, ParamAttr, ParameterList,
                     Sequential)
 from .common import (CosineSimilarity, Dropout, Dropout2D, Embedding, Flatten,

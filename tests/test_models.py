@@ -1,0 +1,104 @@
+"""Model zoo tests (reference: test/book/ end-to-end smoke + vision model tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models import (GPT2Config, GPT2ForCausalLM, LlamaForCausalLM,
+                               llama_tiny_config, resnet18)
+
+
+def test_llama_forward_shapes():
+    cfg = llama_tiny_config()
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+    logits = model(ids)
+    assert list(logits.shape) == [2, 16, cfg.vocab_size]
+
+
+def test_llama_gqa_heads():
+    cfg = llama_tiny_config(num_attention_heads=4, num_key_value_heads=1)
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (1, 8)))
+    logits = model(ids)
+    assert list(logits.shape) == [1, 8, cfg.vocab_size]
+
+
+def test_llama_train_step_loss_decreases():
+    cfg = llama_tiny_config()
+    model = LlamaForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+    labels = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+    losses = []
+    for _ in range(5):
+        _, loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_llama_causality():
+    """Changing a future token must not change past logits (causal mask)."""
+    cfg = llama_tiny_config()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = np.random.randint(0, cfg.vocab_size, (1, 12))
+    l1 = model(paddle.to_tensor(ids)).numpy()
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % cfg.vocab_size
+    l2 = model(paddle.to_tensor(ids2)).numpy()
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=1e-5)
+
+
+def test_gpt2_forward_and_tied_head():
+    cfg = GPT2Config(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=32)
+    model = GPT2ForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(np.random.randint(0, 96, (2, 10)))
+    logits, loss = model(ids, labels=ids)
+    assert list(logits.shape) == [2, 10, 96]
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_gpt2_train_step():
+    cfg = GPT2Config(vocab_size=64, hidden_size=32, num_hidden_layers=1,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=16, dropout=0.0)
+    model = GPT2ForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=5e-3, parameters=model.parameters())
+    ids = paddle.to_tensor(np.random.randint(0, 64, (2, 8)))
+    losses = []
+    for _ in range(5):
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_resnet18_forward_train_eval():
+    model = resnet18(num_classes=10)
+    x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+    out = model(x)
+    assert list(out.shape) == [2, 10]
+    model.eval()
+    out = model(x)
+    assert list(out.shape) == [2, 10]
+
+
+def test_resnet_backward():
+    model = resnet18(num_classes=4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+    label = paddle.to_tensor(np.array([1, 2]))
+    loss = nn.functional.cross_entropy(model(x), label)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert np.isfinite(float(loss.numpy()))
