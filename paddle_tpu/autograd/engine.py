@@ -137,15 +137,42 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     inputs: if given, also return grads for exactly these tensors
     (GeneralGrad / paddle.grad analog, eager/general_grad.h).
     """
+    # id(tensor) -> accumulated grad for requested `inputs`
+    input_grads: Dict[int, Any] = {}
+
+    # Publish this sweep's context for nodes that run a NESTED backward
+    # (fleet.recompute replay): they must honor the outer accumulate_leaf
+    # mode (paddle.grad promises no .grad mutation) and route grads of
+    # requested leaves that only appear inside their region (closure params)
+    # back into this sweep's input_grads.
+    prev_ctx = getattr(_STATE, "bw_ctx", None)
+    _STATE.bw_ctx = {
+        "accumulate_leaf": accumulate_leaf,
+        "inputs": list(inputs) if inputs is not None else [],
+        "input_grads": input_grads,
+    }
+    try:
+        return _run_backward_impl(tensors, grad_tensors, retain_graph, inputs,
+                                  create_graph, accumulate_leaf, input_grads)
+    finally:
+        _STATE.bw_ctx = prev_ctx
+
+
+def outer_backward_ctx():
+    """The enclosing run_backward sweep's context, if any (read by nodes that
+    perform a nested backward, e.g. fleet.recompute)."""
+    return getattr(_STATE, "bw_ctx", None)
+
+
+def _run_backward_impl(tensors, grad_tensors, retain_graph, inputs,
+                       create_graph, accumulate_leaf, input_grads):
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
 
     # node id -> list of output cotangent arrays (GradTensorHolder analog)
     pending: Dict[int, List[Optional[Any]]] = {}
     node_by_id: Dict[int, GradNode] = {}
-    # id(tensor) -> accumulated grad for requested `inputs`
     input_ids = {id(t) for t in inputs} if inputs is not None else set()
-    input_grads: Dict[int, Any] = {}
 
     from ..core.tensor import Tensor as _T
 

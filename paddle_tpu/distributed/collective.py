@@ -228,11 +228,14 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
         raise ValueError(f"broadcast: src rank {src} not in group {g.ranks}")
     src_idx = g.get_group_rank(src)
 
-    def body(x):
+    # close over ints only — a closure over `arr` would pin the first call's
+    # device buffer inside the jit cache for process lifetime
+    per = arr.shape[0] // g.nranks
+    start = src_idx * per
+
+    def body(x, _start=start, _per=per):
         full = jax.lax.all_gather(x, g.axis_name, axis=0, tiled=True)
-        return jax.lax.dynamic_slice_in_dim(
-            full, src_idx * (arr.shape[0] // g.nranks),
-            arr.shape[0] // g.nranks, axis=0)
+        return jax.lax.dynamic_slice_in_dim(full, _start, _per, axis=0)
 
     out = _stacked(body, g, arr,
                    cache_key=("broadcast", src_idx, arr.shape[0]))

@@ -21,8 +21,12 @@ from ...core.tensor import Tensor
 from ...optimizer.clip import ClipGradByGlobalNorm
 from ..auto_parallel import Replicate, Shard, shard_tensor
 from . import mp_layers, random_ctrl, recompute as _recompute_mod
+from . import meta_parallel
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, VocabParallelEmbedding)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .pipeline_parallel import (PipelineParallel,
+                                PipelineParallelWithInterleave)
 from .random_ctrl import get_rng_state_tracker
 from .recompute import recompute, recompute_sequential
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
@@ -105,12 +109,22 @@ class _Fleet:
         return self._hcg
 
     def distributed_model(self, model):
-        """fleet.distributed_model (fleet/model.py:32): place params on the
-        mesh. TP layers already annotate their own params; remaining params
-        are replicated across all axes (DP/sharding placement of grads/states
-        happens in the optimizer/TrainStep tier)."""
+        """fleet.distributed_model (fleet/model.py:32): wrap by mode
+        (model.py:132-171). A PipelineLayer under pp>1 becomes
+        PipelineParallel (interleaved variant when the layer was built with
+        virtual stages); otherwise params are placed on the mesh. TP layers
+        already annotate their own params; remaining params are replicated
+        across all axes (DP/sharding placement of grads/states happens in the
+        optimizer/TrainStep tier)."""
         if self._hcg is None:
             raise RuntimeError("call fleet.init first")
+        if isinstance(model, PipelineLayer) and \
+                self._hcg.get_pipe_parallel_world_size() > 1:
+            cls = (PipelineParallelWithInterleave
+                   if model.get_num_virtual_stages() > 1 else PipelineParallel)
+            wrapped = cls(model, self._hcg, self._strategy)
+            wrapped._fleet_hcg = self._hcg
+            return wrapped
         mesh = self._hcg.mesh
         repl = [Replicate()] * len(mesh.dim_names)
         for p in model.parameters():

@@ -1,0 +1,21 @@
+"""fleet.meta_parallel namespace (reference: fleet/meta_parallel/__init__.py).
+
+Re-exports the hybrid-parallel wrappers and pipeline building blocks under the
+reference's import path: `from paddle.distributed.fleet.meta_parallel import
+PipelineLayer, LayerDesc, ...`.
+"""
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pipeline_parallel import (PipelineParallel,
+                                PipelineParallelWithInterleave)
+from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,
+                        SharedLayerDesc)
+from .random_ctrl import RNGStatesTracker, get_rng_state_tracker
+
+__all__ = [
+    "ColumnParallelLinear", "ParallelCrossEntropy", "RowParallelLinear",
+    "VocabParallelEmbedding", "PipelineParallel",
+    "PipelineParallelWithInterleave", "LayerDesc", "PipelineLayer",
+    "SegmentLayers", "SharedLayerDesc", "RNGStatesTracker",
+    "get_rng_state_tracker",
+]

@@ -1,0 +1,190 @@
+"""Pipeline-parallel schedules.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py — PipelineParallel:150
+(1F1B, forward_backward_pipeline:440, train_batch:657),
+PipelineParallelWithInterleave:906 (virtual-pipeline / VPP).
+
+TPU-native redesign (single controller): the host issues forward/backward
+work for every stage; XLA dispatch is asynchronous, so stage s's devices chew
+on micro-batch m while stage s+1's devices run m-1 — the hardware overlap of
+the reference's per-rank 1F1B emerges from dataflow, not from per-rank
+programs. What the host-side 1F1B ORDER still controls is liveness: backward
+of micro-batch m is issued right after warmup so its activations (vjp
+residuals on the stage meshes) release early, bounding in-flight micro-batches
+at num_stages like the reference instead of accumulate_steps like GPipe.
+
+Interleave (VPP) differs from 1F1B only in placement here: chunks are assigned
+round-robin (chunk c on stage c % num_stages, pp_layers segmentation), which
+yields the reference's shallower per-stage model and its bubble profile; the
+host issue order is unchanged because device queues, not issue order, schedule
+the hardware.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from .p2p_communication import P2pHelper
+from .pp_layers import PipelineLayer
+
+
+def _split_micro(x, n: int):
+    if isinstance(x, (list, tuple)):
+        parts = [_split_micro(e, n) for e in x]
+        return [tuple(p[i] for p in parts) for i in range(n)]
+    if isinstance(x, Tensor):
+        b = x.shape[0]
+        if b % n:
+            raise ValueError(f"batch {b} not divisible by accumulate_steps {n}")
+        step = b // n
+        return [x[i * step:(i + 1) * step] for i in range(n)]
+    return [x] * n
+
+
+class PipelineParallel(Layer):
+    """pipeline_parallel.py:150 analog."""
+
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = getattr(strategy, "pipeline_configs", {}) or {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.num_stages = layers.get_num_stages()
+        self._p2p = P2pHelper(layers._stage_meshes)
+        self.total_loss = None
+
+    # -- per-micro-batch units ---------------------------------------------
+    def _forward_step(self, inp, label):
+        """Run one micro-batch through all chunks; PipelineLayer.forward
+        moves activations between stage meshes (_forward_step:732 analog)."""
+        layers = self._layers
+        if layers.num_chunks and layers._stage_meshes[0] is not None:
+            self._p2p.meta.record(
+                inp if isinstance(inp, (list, tuple)) else [inp])
+        x = layers(inp)
+        if layers._loss_fn is not None and label is not None:
+            return layers._loss_fn(x, label)
+        return x
+
+    def _backward_step(self, loss, scaler):
+        if scaler is not None:
+            scaled = scaler.scale(loss)
+            scaled.backward()
+        else:
+            loss.backward()
+
+    # -- schedules ----------------------------------------------------------
+    def forward_backward_pipeline(self, data, scaler=None,
+                                  forward_only=False):
+        """1F1B (forward_backward_pipeline:440 analog): warmup forwards for
+        min(num_stages, m) micro-batches, then alternate B/F, then drain."""
+        inputs, labels = data if isinstance(data, (list, tuple)) and \
+            len(data) == 2 else (data, None)
+        m = self.accumulate_steps
+        micro_in = _split_micro(inputs, m)
+        micro_lb = _split_micro(labels, m) if labels is not None else [None] * m
+
+        inv = 1.0 / m
+        losses: List[Tensor] = []
+        pending: List[Tensor] = []  # forwarded, awaiting backward
+        warmup = m if forward_only else min(self.num_stages, m)
+
+        def fwd(i):
+            out = self._forward_step(micro_in[i], micro_lb[i])
+            if not forward_only and self._layers._loss_fn is not None:
+                out = out * inv
+            losses.append(out)
+            pending.append(out)
+
+        for i in range(warmup):
+            fwd(i)
+        if not forward_only:
+            for i in range(m - warmup):
+                self._backward_step(pending.pop(0), scaler)
+                fwd(warmup + i)
+            while pending:
+                self._backward_step(pending.pop(0), scaler)
+            self._sync_shared_grads()
+
+        if self._layers._loss_fn is not None:
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            self.total_loss = total if not forward_only else total * inv
+            return self.total_loss
+        return losses
+
+    def _sync_shared_grads(self):
+        """Sum gradients of shared-weight copies across their stages and
+        write the sum to EVERY copy (the reference's unconditional allreduce
+        over the shared comm group) so tied weights step identically even
+        when only one copy saw a grad."""
+        import jax
+        for key, (attr, layers) in self._layers.shared_groups().items():
+            params = [getattr(l, attr) for l in layers]
+            grads = [p.grad for p in params if p.grad is not None]
+            if not grads:
+                continue
+            total = grads[0]._data
+            for g in grads[1:]:
+                total = total + jax.device_put(g._data, total.sharding)
+            for p in params:
+                sh = p._data.sharding
+                p.grad = Tensor(jax.device_put(total, sh))
+
+    # -- public API (train_batch:657, eval_batch analogs) -------------------
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler=scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        from ...autograd import no_grad
+        with no_grad():
+            if not compute_loss:
+                saved, self._layers._loss_fn = self._layers._loss_fn, None
+                try:
+                    return self.forward_backward_pipeline(
+                        data, forward_only=True)
+                finally:
+                    self._layers._loss_fn = saved
+            return self.forward_backward_pipeline(data, forward_only=True)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """pipeline_parallel.py:906 analog. Placement (round-robin chunks) is done
+    by PipelineLayer(num_virtual_pipeline_stages>1); the host order is shared
+    with 1F1B — see module docstring for why that preserves VPP semantics."""
+
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        if layers.get_num_virtual_stages() < 2:
+            raise ValueError(
+                "PipelineParallelWithInterleave requires a PipelineLayer built "
+                "with num_virtual_pipeline_stages >= 2")

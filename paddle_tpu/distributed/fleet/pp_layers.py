@@ -1,0 +1,303 @@
+"""Pipeline model segmentation.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc:56,
+SharedLayerDesc:76, PipelineLayer:237 (segments a flat layer list across pp
+ranks, supports seg_method "uniform"/"layer:Cls", shared weights between
+stages, recompute intervals, and interleaved virtual stages).
+
+TPU-native redesign: the single-controller program owns EVERY stage. A stage
+is a contiguous segment of the layer list whose parameters are placed on that
+stage's sub-mesh (the hybrid mesh sliced at pipe=stage). There is no per-rank
+partial model build: placement — not process identity — is what localizes a
+stage to its devices, and XLA's async dispatch pipelines stages that the host
+issues back-to-back. Tensor-parallel layers inside a stage annotate over the
+stage sub-mesh, so TP collectives ride the stage's own ICI ring.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ..auto_parallel import ProcessMesh, Replicate, Shard, shard_tensor
+from . import topology as topo_mod
+from .recompute import recompute as _recompute
+
+
+class LayerDesc:
+    """Lazy layer constructor (pp_layers.py:56)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("layer_func must be a paddle_tpu.nn.Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer whose weight is shared between stages (pp_layers.py:76), e.g.
+    tied input embedding / LM head. Each holding stage gets its own copy; the
+    copies receive summed gradients after each pipeline step (the analog of
+    the reference's allreduce over the shared-comm group) and therefore stay
+    numerically identical under the optimizer."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition len(layers) into num_parts segments (pp_layers.py seg logic)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            return self._uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment on occurrences of a named layer class (the transformer
+            # block), keeping pre/post layers attached to first/last stages
+            cls_name = self.method.split(":", 1)[1]
+            weights = [0] * n
+            for i, d in enumerate(self.layers_desc):
+                name = (d.layer_func.__name__ if isinstance(d, LayerDesc)
+                        else d.__class__.__name__)
+                if re.fullmatch(cls_name, name):
+                    weights[i] = 1
+            total = sum(weights)
+            if total == 0:
+                raise ValueError(
+                    f"seg_method '{self.method}' matched no layers — check "
+                    "the class name")
+            if total % self.num_parts:
+                raise ValueError(
+                    f"number of {cls_name} layers ({total}) is not divisible "
+                    f"by num_stages ({self.num_parts})")
+            per = total // self.num_parts
+            result = [0]
+            seen = 0
+            for i, w in enumerate(weights):
+                if w and seen % per == 0 and len(result) < self.num_parts:
+                    if seen:
+                        result.append(i)
+                seen += w
+            result.append(n)
+            while len(result) < self.num_parts + 1:
+                result.insert(1, result[1])
+            return result
+        raise ValueError(f"unknown seg_method {self.method}")
+
+    @staticmethod
+    def _uniform(num_items, num_parts) -> List[int]:
+        result = [0] * (num_parts + 1)
+        base, extra = divmod(num_items, num_parts)
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + base + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """pp_layers.py:237 analog.
+
+    layers: list of Layer / LayerDesc / SharedLayerDesc (a flat module list).
+    num_stages: pipeline depth (defaults to the topology's pp degree).
+    num_virtual_pipeline_stages: >1 enables interleaved (VPP) scheduling —
+        the layer list is cut into num_stages*vpp chunks assigned round-robin
+        (chunk c lives on stage c % num_stages), matching the reference's
+        interleave semantics (pp_layers.py _interleave segmentation).
+    loss_fn: optional callable(output, labels) used by train_batch.
+    seg_method: "uniform" or "layer:ClassName".
+    recompute_interval: re-materialize every k layers inside a stage.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        hcg = topo_mod.get_hybrid_communicate_group()
+        if num_stages is None:
+            if hcg is None:
+                raise ValueError("num_stages or an initialized fleet topology "
+                                 "is required")
+            num_stages = hcg.get_pipe_parallel_world_size()
+        self._hcg = hcg
+        self._num_stages = num_stages
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._vpp = num_virtual_pipeline_stages or 1
+        if self._vpp > 1 and seg_method != "uniform":
+            raise ValueError("interleave requires uniform segmentation")
+
+        self._layers_desc = list(layers)
+        num_chunks = num_stages * self._vpp
+        self.segment_parts = SegmentLayers(
+            self._layers_desc, num_chunks, seg_method).do_segment()
+
+        # chunk k spans layers [parts[k], parts[k+1]) and lives on stage
+        # k % num_stages (round-robin for interleave; identity when vpp==1)
+        self._chunk_to_stage = [k % num_stages for k in range(num_chunks)]
+        self._stage_meshes = self._build_stage_meshes()
+
+        self._shared_groups: Dict[str, List[Layer]] = {}
+        self._shared_attrs: Dict[str, str] = {}
+        self._chunks: List[List] = []  # entries: (idx, layer-or-callable, desc)
+        run_list = []
+        for k in range(num_chunks):
+            built = []
+            for i in range(self.segment_parts[k], self.segment_parts[k + 1]):
+                desc = self._layers_desc[i]
+                layer = desc.build_layer() if isinstance(desc, LayerDesc) else desc
+                if isinstance(desc, SharedLayerDesc):
+                    self._shared_groups.setdefault(desc.layer_name, []).append(layer)
+                    self._shared_attrs[desc.layer_name] = desc.shared_weight_attr
+                self.add_sublayer(f"chunk_{k}_layer_{i}", layer)
+                fwd = desc.forward_func if isinstance(desc, SharedLayerDesc) \
+                    else None
+                built.append((i, layer, fwd))
+            self._chunks.append(built)
+            run_list.extend(built)
+        self._run_list = run_list
+        self._place_stage_params()
+        self._sync_shared_weights()
+
+    # -- placement ----------------------------------------------------------
+    def _build_stage_meshes(self) -> List[Optional[ProcessMesh]]:
+        if self._hcg is None:
+            return [None] * self._num_stages
+        mesh = self._hcg.mesh
+        pp_axis = self._hcg.pp_axis  # e.g. "pipe"
+        return [mesh.get_mesh_with_dim(pp_axis, s)
+                for s in range(self._num_stages)]
+
+    def _place_stage_params(self):
+        """Pin each chunk's parameters to its stage sub-mesh. A param already
+        annotated over the full hybrid mesh (TP layers) keeps its non-pipe
+        placements, re-expressed on the stage mesh."""
+        if self._hcg is None:
+            return
+        pp_axis = self._hcg.pp_axis
+        full_names = self._hcg.mesh.dim_names
+        for k, built in enumerate(self._chunks):
+            smesh = self._stage_meshes[self._chunk_to_stage[k]]
+            for _, layer, _ in built:
+                for p in layer.parameters():
+                    if p._dist_attr is not None and \
+                            p._dist_attr["mesh"].dim_names == full_names:
+                        placements = [
+                            pl for name, pl in zip(
+                                full_names, p._dist_attr["placements"])
+                            if name != pp_axis]
+                    elif p._dist_attr is not None and \
+                            p._dist_attr["mesh"].dim_names == smesh.dim_names:
+                        placements = p._dist_attr["placements"]
+                    else:
+                        placements = [Replicate()] * len(smesh.dim_names)
+                    shard_tensor(p, smesh, placements)
+
+    # -- topology accessors (pp_layers API parity) --------------------------
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_num_virtual_stages(self):
+        return self._vpp
+
+    def get_stage_mesh(self, stage: int):
+        return self._stage_meshes[stage]
+
+    def stage_of_chunk(self, chunk: int) -> int:
+        return self._chunk_to_stage[chunk]
+
+    @property
+    def num_chunks(self):
+        return len(self._chunks)
+
+    # -- shared weights -----------------------------------------------------
+    def _sync_shared_weights(self):
+        """Initialize every copy of a shared weight to the first copy's value
+        (the reference broadcasts from the owning rank at init)."""
+        import jax
+        for key, layers in self._shared_groups.items():
+            attr = self._shared_attrs[key]
+            src = getattr(layers[0], attr)
+            for other in layers[1:]:
+                dst = getattr(other, attr)
+                dst._set_data(jax.device_put(src._data, dst._data.sharding))
+
+    def shared_groups(self):
+        return {k: (self._shared_attrs[k], v)
+                for k, v in self._shared_groups.items()}
+
+    # -- forward ------------------------------------------------------------
+    @staticmethod
+    def _apply(layer_fn, x):
+        """Feed an activation to a layer; a tuple activation becomes
+        positional args (the reference's multi-output chaining semantics)."""
+        return layer_fn(*x) if isinstance(x, tuple) else layer_fn(x)
+
+    def forward_chunk(self, x, chunk: int):
+        """Run one chunk's layers (with recompute intervals)."""
+        built = self._chunks[chunk]
+        interval = self._recompute_interval
+        i = 0
+        while i < len(built):
+            if interval > 0:
+                seg = built[i:i + interval]
+                funcs = [b[1] for b in seg]
+
+                def run_seg(*inp, _funcs=funcs):
+                    h = inp if len(inp) > 1 else inp[0]
+                    for f in _funcs:
+                        h = self._apply(f, h)
+                    return h
+
+                x = _recompute(run_seg, *x) if isinstance(x, tuple) \
+                    else _recompute(run_seg, x)
+                i += len(seg)
+            else:
+                _, layer, fwd = built[i]
+                if fwd is not None:
+                    x = fwd(layer, *x) if isinstance(x, tuple) \
+                        else fwd(layer, x)
+                else:
+                    x = self._apply(layer, x)
+                i += 1
+        return x
+
+    def stage_input(self, x, stage: int, prev_stage: Optional[int]):
+        """Move an activation (Tensor or tuple of Tensors) onto `stage`'s
+        sub-mesh — the p2p hop between pipeline stages."""
+        from .p2p_communication import transfer
+        mesh = self._stage_meshes[stage]
+        if mesh is None or prev_stage == stage:
+            return x
+        src = None if prev_stage is None else self._stage_meshes[prev_stage]
+        if isinstance(x, (list, tuple)):
+            return type(x)(transfer(e, mesh, src)
+                           if isinstance(e, Tensor) else e for e in x)
+        return transfer(x, mesh, src) if isinstance(x, Tensor) else x
+
+    def forward(self, x, chunk_id=None):
+        if chunk_id is not None:
+            return self.forward_chunk(x, chunk_id)
+        prev_stage: Optional[int] = None
+        for k in range(len(self._chunks)):
+            stage = self._chunk_to_stage[k]
+            x = self.stage_input(x, stage, prev_stage)
+            x = self.forward_chunk(x, k)
+            prev_stage = stage
+        return x

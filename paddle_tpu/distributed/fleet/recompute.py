@@ -11,6 +11,7 @@ residuals, so compiled memory behavior matches the reference's.
 """
 from __future__ import annotations
 
+import functools
 from typing import Sequence
 
 import jax
@@ -19,6 +20,76 @@ from ...autograd import engine as _engine
 from ...autograd.engine import GradNode
 from ...core import random as random_mod
 from ...core.tensor import Tensor
+
+
+_HARMLESS_TYPES = (str, bytes, int, float, bool, complex, type(None))
+
+
+def _closure_requires_grad(function) -> bool:
+    """Best-effort probe: does the callable's closure/bound self/referenced
+    globals hold any trainable tensor? Used to skip taping fully frozen
+    recompute regions. ANY object the probe cannot classify counts as
+    trainable — a region is treated as frozen only when every piece of its
+    reachable state is positively recognized as non-trainable."""
+    import types
+
+    import jax
+    import numpy as np
+
+    from ...nn.layer import Layer
+
+    seen = set()
+
+    def state_of(fn):
+        """Objects reachable from a callable: partial args, bound self,
+        closure cells, referenced globals."""
+        out = []
+        if isinstance(fn, functools.partial):
+            out.extend(fn.args)
+            out.extend(fn.keywords.values())
+            fn = fn.func
+        if getattr(fn, "__self__", None) is not None:
+            out.append(fn.__self__)
+        for cell in getattr(fn, "__closure__", None) or ():
+            try:
+                out.append(cell.cell_contents)
+            except ValueError:  # empty cell
+                pass
+        code = getattr(fn, "__code__", None)
+        fglobals = getattr(fn, "__globals__", {})
+        for name in (code.co_names if code is not None else ()):
+            if name in fglobals:
+                out.append(fglobals[name])
+        return out
+
+    def probe(obj, depth=0):
+        """Returns True if obj may hold a trainable tensor (tape needed)."""
+        if id(obj) in seen:
+            return False
+        seen.add(id(obj))
+        if depth > 4:
+            return True  # too deep to prove frozen
+        if isinstance(obj, Layer):
+            return any(not p.stop_gradient for p in obj.parameters())
+        if isinstance(obj, Tensor):
+            return not obj.stop_gradient
+        if isinstance(obj, _HARMLESS_TYPES) or isinstance(
+                obj, (np.ndarray, np.generic, jax.Array, types.ModuleType)):
+            return False
+        if isinstance(obj, (list, tuple, set, frozenset)):
+            return any(probe(o, depth + 1) for o in obj)
+        if isinstance(obj, dict):
+            return any(probe(o, depth + 1) for o in obj.values())
+        if isinstance(obj, (types.FunctionType, types.MethodType,
+                            functools.partial)) or (
+                callable(obj) and isinstance(obj, type)):
+            if isinstance(obj, type):
+                return False  # a class object, not an instance
+            return any(probe(o, depth + 1) for o in state_of(obj))
+        return True  # unrecognized object: cannot prove frozen
+
+    return any(probe(o) for o in state_of(function)) if not isinstance(
+        function, Layer) else probe(function)
 
 
 def recompute(function, *args, **kwargs):
@@ -30,8 +101,14 @@ def recompute(function, *args, **kwargs):
     kw_keys = sorted(k for k, v in kwargs.items() if isinstance(v, Tensor))
     in_tensors = [a for a in args if isinstance(a, Tensor)] + \
         [kwargs[k] for k in kw_keys]
-    requires = _engine.is_grad_enabled() and any(
-        not t.stop_gradient for t in in_tensors)
+    # Record when any explicit input needs grad OR the function's closure
+    # holds trainable parameters (the usual pipeline case: activations arrive
+    # frozen but the segment's layers train — reference: RecomputeFunction is
+    # a PyLayer whose backward accumulates into leaf params). Fully frozen
+    # regions skip the tape entirely.
+    requires = _engine.is_grad_enabled() and (
+        any(not t.stop_gradient for t in in_tensors)
+        or _closure_requires_grad(function))
 
     gen = random_mod.default_generator()
     fwd_key = gen.get_state() if preserve_rng else None
@@ -71,9 +148,26 @@ def recompute(function, *args, **kwargs):
                                    else [re_out]) if isinstance(o, Tensor)]
             det_inputs = [d for d in detached if isinstance(d, Tensor)] + \
                 [det_kwargs[k] for k in kw_keys]
+            # Honor the OUTER sweep's leaf mode: under loss.backward()
+            # (accumulate_leaf=True) closure params are replay-graph leaves
+            # and their grads land directly on param.grad; under paddle.grad
+            # (accumulate_leaf=False, no .grad mutation allowed) nothing is
+            # accumulated, and grads for outer-requested tensors that only
+            # appear inside this region (closure params) are routed back into
+            # the outer sweep's result instead. Explicit inputs were detached,
+            # so their grads ride up the outer tape as cotangents.
+            octx = _engine.outer_backward_ctx()
+            acc_leaf = octx["accumulate_leaf"] if octx else True
+            outer_wanted = [t for t in (octx["inputs"] if octx else [])
+                            if not any(t is d for d in in_tensors)]
             grads_map = _engine.run_backward(
                 re_list, list(flat_cts),
-                inputs=det_inputs, accumulate_leaf=False)
+                inputs=det_inputs + outer_wanted, accumulate_leaf=acc_leaf)
+            if octx is not None:
+                for t in outer_wanted:
+                    if id(t) in grads_map:
+                        octx["input_grads"][id(t)] = _engine._accum(
+                            octx["input_grads"].get(id(t)), grads_map[id(t)])
             return tuple(grads_map.get(id(d)) for d in det_inputs)
         finally:
             gen.set_state(saved_key)

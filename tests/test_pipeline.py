@@ -1,0 +1,205 @@
+"""Pipeline-parallel tests on the 8-device CPU mesh.
+
+Reference coverage model: test/collective/fleet hybrid_parallel_pp_*.py —
+1F1B and interleave train_batch losses must match the same model trained
+without pipelining (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (LayerDesc, PipelineLayer,
+                                          PipelineParallel, SharedLayerDesc)
+from paddle_tpu.distributed.fleet.pp_layers import SegmentLayers
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    yield
+    from paddle_tpu.distributed.fleet import topology
+    topology.set_hybrid_communicate_group(None)
+
+
+HIDDEN = 16
+
+
+class Block(nn.Layer):
+    def __init__(self, seed_shift=0):
+        super().__init__()
+        self.fc = nn.Linear(HIDDEN, HIDDEN)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.fc(x))
+
+
+class Head(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(HIDDEN, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def _loss_fn(out, label):
+    return nn.functional.cross_entropy(out, label).mean()
+
+
+def _make_descs(n_blocks=4):
+    return [LayerDesc(Block) for _ in range(n_blocks)] + [LayerDesc(Head)]
+
+
+def _data(batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.randn(batch, HIDDEN).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (batch,)))
+    return x, y
+
+
+def test_segment_uniform():
+    parts = SegmentLayers(list(range(10)), 4, "uniform").do_segment()
+    assert parts == [0, 3, 6, 8, 10]
+    assert parts[-1] == 10
+
+
+def test_segment_by_layer_name():
+    descs = [LayerDesc(Head)] + [LayerDesc(Block) for _ in range(4)] + \
+        [LayerDesc(Head)]
+    parts = SegmentLayers(descs, 2, "layer:Block").do_segment()
+    # two Blocks per stage; pre/post layers attach to first/last stages
+    assert parts[0] == 0 and parts[-1] == 6
+    assert parts[1] == 3  # Head + 2 Blocks | 2 Blocks + Head
+
+
+def _train_reference(descs_builder, data, steps=2, lr=0.1):
+    """Same model, no pipelining, sequential forward."""
+    paddle.seed(42)
+    layers = [d.build_layer() for d in descs_builder()]
+    model = nn.Sequential(*layers)
+    opt = optimizer.SGD(learning_rate=lr, parameters=model.parameters())
+    losses = []
+    x, y = data
+    for _ in range(steps):
+        out = model(x)
+        loss = _loss_fn(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _train_pipeline(data, pp=4, accumulate_steps=4, vpp=None, steps=2,
+                    lr=0.1, recompute_interval=0):
+    paddle.seed(42)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": pp, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": accumulate_steps}
+    fleet.init(is_collective=True, strategy=strategy)
+    kwargs = {}
+    if vpp:
+        kwargs["num_virtual_pipeline_stages"] = vpp
+    model = PipelineLayer(layers=_make_descs(), loss_fn=_loss_fn,
+                          recompute_interval=recompute_interval, **kwargs)
+    model = fleet.distributed_model(model)
+    opt = optimizer.SGD(learning_rate=lr, parameters=model.parameters())
+    opt = fleet.distributed_optimizer(opt)
+    losses = []
+    x, y = data
+    for _ in range(steps):
+        loss = model.train_batch([x, y], opt)
+        losses.append(float(loss))
+    return losses
+
+
+def test_pipeline_1f1b_matches_sequential():
+    data = _data()
+    ref = _train_reference(_make_descs, data)
+    pp = _train_pipeline(data, pp=4, accumulate_steps=4)
+    np.testing.assert_allclose(pp, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_accumulate_gt_stages():
+    data = _data()
+    ref = _train_reference(_make_descs, data)
+    pp = _train_pipeline(data, pp=2, accumulate_steps=8)
+    np.testing.assert_allclose(pp, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_interleave_matches_sequential():
+    data = _data()
+    ref = _train_reference(_make_descs, data)
+    # 5 layers, 2 stages * 2 virtual chunks -> chunks of 2/1/1/1 round-robin
+    pp = _train_pipeline(data, pp=2, accumulate_steps=4, vpp=2)
+    np.testing.assert_allclose(pp, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_recompute_matches():
+    data = _data()
+    ref = _train_reference(_make_descs, data)
+    pp = _train_pipeline(data, pp=2, accumulate_steps=2,
+                         recompute_interval=1)
+    np.testing.assert_allclose(pp, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_eval_batch():
+    data = _data()
+    _ = _train_reference(_make_descs, data, steps=1)
+    paddle.seed(42)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 4, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(
+        PipelineLayer(layers=_make_descs(), loss_fn=_loss_fn))
+    x, y = data
+    loss = model.eval_batch([x, y])
+    assert np.isfinite(float(loss))
+
+
+class TiedEmbed(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.weight = self.create_parameter([4, HIDDEN])
+
+    def forward(self, x):
+        # as input embedding: one-hot matmul
+        return paddle.matmul(x, self.weight)
+
+
+def test_shared_layer_grads_synced():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(7)
+
+    def head_fwd(layer, x):
+        return paddle.matmul(x, layer.weight, transpose_y=True)
+
+    descs = [
+        SharedLayerDesc("embed", TiedEmbed),
+        LayerDesc(Block),
+        LayerDesc(Block),
+        SharedLayerDesc("embed", TiedEmbed, forward_func=head_fwd),
+    ]
+    model = PipelineLayer(layers=descs, num_stages=2,
+                          loss_fn=lambda out, lbl:
+                          nn.functional.cross_entropy(out, lbl).mean())
+    model = fleet.distributed_model(model)
+    groups = model._layers.shared_groups()
+    assert len(groups["embed"][1]) == 2
+
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (4,)))
+    model.train_batch([x, y], opt)
+    w0, w1 = [getattr(l, "weight") for l in groups["embed"][1]]
+    np.testing.assert_allclose(w0.numpy(), w1.numpy(), rtol=1e-6)

@@ -110,3 +110,51 @@ def test_adamw_decay_param_filter():
     (p1.sum() + p2.sum()).backward()
     opt2.step()
     assert p1.item() < p2.item()
+
+
+def test_recompute_param_grads_flow():
+    """Closure parameters must receive grads through recompute even when all
+    explicit inputs are frozen (the pipeline/recompute_interval case)."""
+    from paddle_tpu.distributed import fleet
+    lin = nn.Linear(8, 8)
+    x = paddle.randn([2, 8])  # stop_gradient=True (data)
+    y = fleet.recompute(lambda t: lin(t).tanh(), x)
+    y.sum().backward()
+    assert lin.weight.grad is not None
+    assert lin.bias.grad is not None
+    # matches the non-recompute grads
+    lin2 = nn.Linear(8, 8)
+    lin2.weight._set_data(lin.weight._data)
+    lin2.bias._set_data(lin.bias._data)
+    lin2(paddle.to_tensor(x.numpy())).tanh().sum().backward()
+    np.testing.assert_allclose(lin.weight.grad.numpy(),
+                               lin2.weight.grad.numpy(), rtol=1e-5)
+
+
+def test_recompute_respects_paddle_grad_no_mutation():
+    """paddle.grad through a recompute region must not touch .grad, and must
+    return grads for closure params when requested."""
+    from paddle_tpu.distributed import fleet
+    lin = nn.Linear(6, 6)
+    x = paddle.randn([2, 6])
+    x.stop_gradient = False
+    y = fleet.recompute(lambda t: lin(t).tanh(), x)
+    gx, gw = paddle.grad(y.sum(), [x, lin.weight])
+    assert gx is not None and gw is not None
+    assert lin.weight.grad is None  # no side effects
+    assert x.grad is None
+    # grads match a plain backward
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    lin(x2).tanh().sum().backward()
+    np.testing.assert_allclose(gw.numpy(), lin.weight.grad.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(gx.numpy(), x2.grad.numpy(), rtol=1e-5)
+
+
+def test_recompute_frozen_region_not_taped():
+    from paddle_tpu.distributed import fleet
+    lin = nn.Linear(4, 4)
+    for p in lin.parameters():
+        p.stop_gradient = True
+    x = paddle.randn([2, 4])  # frozen data
+    y = fleet.recompute(lambda t: lin(t).tanh(), x)
+    assert y.stop_gradient  # no tape node was recorded
