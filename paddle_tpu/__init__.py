@@ -30,6 +30,7 @@ from . import amp
 from . import autograd
 from . import distributed
 from . import framework
+from . import incubate
 from . import jit
 from . import nn
 from . import optimizer

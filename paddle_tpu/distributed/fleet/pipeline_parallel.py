@@ -117,7 +117,12 @@ class PipelineParallel(Layer):
                 total = total + l
             self.total_loss = total if not forward_only else total * inv
             return self.total_loss
-        return losses
+        # no loss_fn: stitch the micro-batch outputs back into the full batch
+        import paddle_tpu as paddle
+        if isinstance(losses[0], tuple):
+            return tuple(paddle.concat([o[i] for o in losses], axis=0)
+                         for i in range(len(losses[0])))
+        return paddle.concat(losses, axis=0) if len(losses) > 1 else losses[0]
 
     def _sync_shared_grads(self):
         """Sum gradients of shared-weight copies across their stages and

@@ -130,6 +130,13 @@ class PipelineLayer(Layer):
                  recompute_ctx=None, num_virtual_pipeline_stages=None):
         super().__init__()
         hcg = topo_mod.get_hybrid_communicate_group()
+        if topology is not None:
+            topo_stages = topology.get_dim("pipe")
+            if num_stages is not None and num_stages != topo_stages:
+                raise ValueError(
+                    f"num_stages ({num_stages}) conflicts with topology's "
+                    f"pipe degree ({topo_stages})")
+            num_stages = topo_stages
         if num_stages is None:
             if hcg is None:
                 raise ValueError("num_stages or an initialized fleet topology "
@@ -139,6 +146,9 @@ class PipelineLayer(Layer):
         self._num_stages = num_stages
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
+        # recompute_ctx: reference recompute_hybrid options; honored keys here:
+        # preserve_rng_state (offload_* have no host-side analog under XLA)
+        self._recompute_ctx = dict(recompute_ctx or {})
         self._vpp = num_virtual_pipeline_stages or 1
         if self._vpp > 1 and seg_method != "uniform":
             raise ValueError("interleave requires uniform segmentation")
@@ -172,6 +182,9 @@ class PipelineLayer(Layer):
             self._chunks.append(built)
             run_list.extend(built)
         self._run_list = run_list
+        # per-layer parameter lists, cached for the recompute trainability hint
+        self._param_cache = {id(l): list(l.parameters())
+                             for _, l, _ in run_list}
         self._place_stage_params()
         self._sync_shared_weights()
 
@@ -258,6 +271,10 @@ class PipelineLayer(Layer):
             if interval > 0:
                 seg = built[i:i + interval]
                 funcs = [b[1] for b in seg]
+                # cheap per-call trainability check over the cached param
+                # lists — skips the generic closure probe on the hot path
+                seg_params = [p for b in seg for p in self._param_cache[id(b[1])]]
+                hint = any(not p.stop_gradient for p in seg_params)
 
                 def run_seg(*inp, _funcs=funcs):
                     h = inp if len(inp) > 1 else inp[0]
@@ -265,8 +282,10 @@ class PipelineLayer(Layer):
                         h = self._apply(f, h)
                     return h
 
-                x = _recompute(run_seg, *x) if isinstance(x, tuple) \
-                    else _recompute(run_seg, x)
+                preserve = self._recompute_ctx.get("preserve_rng_state", True)
+                args = x if isinstance(x, tuple) else (x,)
+                x = _recompute(run_seg, *args, preserve_rng_state=preserve,
+                               _trainable_hint=hint)
                 i += len(seg)
             else:
                 _, layer, fwd = built[i]

@@ -97,6 +97,9 @@ def recompute(function, *args, **kwargs):
     the reference's default (PyLayer) path."""
     kwargs.pop("use_reentrant", None)
     preserve_rng = kwargs.pop("preserve_rng_state", True)
+    # callers that know their region's trainability (PipelineLayer caches its
+    # segment param lists) can skip the generic closure probe
+    trainable_hint = kwargs.pop("_trainable_hint", None)
 
     kw_keys = sorted(k for k, v in kwargs.items() if isinstance(v, Tensor))
     in_tensors = [a for a in args if isinstance(a, Tensor)] + \
@@ -108,7 +111,8 @@ def recompute(function, *args, **kwargs):
     # regions skip the tape entirely.
     requires = _engine.is_grad_enabled() and (
         any(not t.stop_gradient for t in in_tensors)
-        or _closure_requires_grad(function))
+        or (trainable_hint if trainable_hint is not None
+            else _closure_requires_grad(function)))
 
     gen = random_mod.default_generator()
     fwd_key = gen.get_state() if preserve_rng else None

@@ -1,0 +1,3 @@
+"""paddle.incubate analog — experimental APIs (reference: python/paddle/incubate)."""
+from . import distributed
+from . import nn

@@ -1,0 +1,9 @@
+"""MoE / expert parallelism (reference: incubate/distributed/models/moe)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .grad_clip import ClipGradForMOEByGlobalNorm
+from .moe_layer import FusedMoEFFN, MoELayer
+from .utils import global_gather, global_scatter
+
+__all__ = ["BaseGate", "GShardGate", "NaiveGate", "SwitchGate",
+           "ClipGradForMOEByGlobalNorm", "FusedMoEFFN", "MoELayer",
+           "global_gather", "global_scatter"]

@@ -1,0 +1,123 @@
+"""MoE gates.
+
+Reference: incubate/distributed/models/moe/gate/{base_gate,naive_gate,
+gshard_gate,switch_gate}.py — NaiveGate returns (top-k values, top-k indices)
+from a linear router; GShardGate adds the load-balancing auxiliary loss and
+capacity-aware routing; SwitchGate is the top-1 variant.
+
+TPU-native: identical routing math, but the gates also hand back the full
+softmax probabilities so the layer can build the dense dispatch/combine
+einsum masks (the GSPMD-friendly formulation — no scatter of ragged token
+lists; see moe_layer.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+
+
+class BaseGate(Layer):
+    """gate/base_gate.py analog."""
+
+    def __init__(self, num_expert, world_size):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def forward(self, x):
+        raise NotImplementedError("Base gate cannot be called")
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """gate/naive_gate.py analog: linear router + top-k, no aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        gate = self.gate(inp)
+        gate_top_k_val, gate_top_k_idx = _topk(gate, self.top_k)
+        if return_all_scores:
+            return gate_top_k_val, gate_top_k_idx, gate
+        return gate_top_k_val, gate_top_k_idx
+
+
+def _topk(x, k):
+    import paddle_tpu as paddle
+    return paddle.topk(x, k=k, axis=-1, largest=True, sorted=True)
+
+
+def _load_balance_loss(probs, top1_idx, num_experts):
+    """GShard aux loss: E * sum_e(mean_prob_e * frac_tokens_e). Differentiable
+    through the probabilities only (the indicator is a constant)."""
+    me = probs.mean(axis=0)  # [E] mean router prob
+    ce = nn.functional.one_hot(top1_idx, num_experts).astype(
+        probs.dtype).mean(axis=0)  # [E] fraction of tokens routed (top-1)
+    return (me * Tensor(ce._data, stop_gradient=True)).sum() * float(num_experts)
+
+
+class GShardGate(BaseGate):
+    """gate/gshard_gate.py analog: top-2 routing with the load-balancing aux
+    loss; capacity is enforced by the layer's dispatch mask."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        assert topk == 2, "gshard gate requires top_k = 2"
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def forward(self, x):
+        logits = self.gate(x)
+        probs = nn.functional.softmax(logits, axis=-1)
+        topk_val, topk_idx = _topk(probs, self.top_k)
+        self.set_loss(_load_balance_loss(
+            probs, Tensor(topk_idx._data[..., 0], stop_gradient=True),
+            self.tot_expert))
+        return topk_val, topk_idx
+
+
+class SwitchGate(BaseGate):
+    """gate/switch_gate.py analog: top-1 routing + aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "switch gate requires top_k = 1"
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, x):
+        logits = self.gate(x)
+        if self.training and self.switch_eps:
+            # multiplicative jitter (switch transformer exploration noise)
+            import paddle_tpu as paddle
+            noise = paddle.rand(logits.shape, dtype=logits.dtype)
+            logits = logits * (1.0 - self.switch_eps) + \
+                noise * (2.0 * self.switch_eps) * logits
+        probs = nn.functional.softmax(logits, axis=-1)
+        topk_val, topk_idx = _topk(probs, 1)
+        self.set_loss(_load_balance_loss(
+            probs, Tensor(topk_idx._data[..., 0], stop_gradient=True),
+            self.tot_expert))
+        return topk_val, topk_idx

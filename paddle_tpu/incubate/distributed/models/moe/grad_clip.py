@@ -1,0 +1,50 @@
+"""MoE-aware gradient clipping.
+
+Reference: incubate/distributed/models/moe/grad_clip.py —
+ClipGradForMOEByGlobalNorm computes the global norm as
+sqrt(norm(normal)^2 + norm(expert)^2) where the expert-part norm is
+allreduced over the moe group (each rank holds different experts).
+
+TPU-native: under the single-controller mesh the expert parameters are global
+arrays (sharded over the ep axis), so one pass over all grads already yields
+the correct global norm — the cross-rank expert-norm allreduce is implicit in
+GSPMD. The is_expert_param_func split is retained so the semantics (each
+expert counted exactly once) stay explicit and inspectable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.optimizer.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """grad_clip.py ClipGradForMOEByGlobalNorm analog."""
+
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group
+
+    def __call__(self, params):
+        normal, expert = [], []
+        for p in params:
+            if p.grad is None:
+                continue
+            if self.is_expert_param_func is not None and \
+                    self.is_expert_param_func(p):
+                expert.append(p)
+            else:
+                normal.append(p)
+        sq = sum(jnp.sum(jnp.square(p.grad._data.astype(jnp.float32)))
+                 for p in normal + expert)
+        if not (normal or expert):
+            return
+        global_norm = jnp.sqrt(sq)
+        factor = jnp.minimum(
+            self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        for p in normal + expert:
+            g = p.grad._data
+            p.grad = Tensor((g.astype(jnp.float32) * factor).astype(g.dtype))

@@ -1,0 +1,224 @@
+"""MoE layer with expert parallelism.
+
+Reference: incubate/distributed/models/moe/moe_layer.py — MoELayer:263 routes
+tokens to experts with global_scatter/global_gather all-to-alls over the moe
+process group, each rank holding num_expert local experts.
+
+TPU-native redesign: routing is expressed as dense one-hot dispatch/combine
+einsums over an [experts, capacity] buffer (the GSPMD MoE formulation used on
+TPU) instead of ragged per-rank token lists + manual all-to-all. Capacity
+bounds make every shape static for XLA; tokens over capacity fall out of the
+mask exactly like the reference's capacity overflow. Under expert parallelism
+the stacked expert weights are sharded Shard(0) over the moe ("ep") mesh axis
+and the dispatched activations are annotated alike — GSPMD inserts the
+all-to-all over ICI.
+
+Two expert containers:
+- MoELayer: reference-compatible (a list of arbitrary expert Layers; applies
+  each expert to its capacity slice — fine up to tens of experts).
+- FusedMoEFFN: the fast path — stacked FFN expert weights [E, d, h]/[E, h, d]
+  applied in one batched einsum, EP-shardable.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.auto_parallel import (ProcessMesh, Replicate,
+                                                  Shard, shard_tensor)
+from paddle_tpu.nn.layer import Layer, LayerList
+from paddle_tpu.ops.registry import defop
+
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+@defop("moe_dispatch_masks")
+def _moe_masks_op(topk_val, topk_idx, num_experts=1, capacity=1,
+                  norm_mode="softmax"):
+    """combine weights [N, E, C] + boolean dispatch mask from top-k routing.
+    Choice j consumes capacity before choice j+1 (GShard priority policy).
+    Differentiable in topk_val only (the routing indicator is constant).
+
+    norm_mode: how the k selected scores become combine weights —
+    "softmax" for raw router logits (NaiveGate; the reference combines raw
+    values via bmm, moe_layer.py:497, but dense masks need positive weights),
+    "sum" for probabilities (GShard p_i / (p_1+p_2) policy)."""
+    v = topk_val.astype(jnp.float32)
+    if norm_mode == "softmax":
+        v = jax.nn.softmax(v, axis=-1)
+    else:
+        v = v / jnp.maximum(v.sum(axis=-1, keepdims=True), 1e-9)
+    n, k = topk_idx.shape
+    combine = jnp.zeros((n, num_experts, capacity), dtype=jnp.float32)
+    occupancy = jnp.zeros((num_experts,), dtype=jnp.int32)
+    for j in range(k):
+        e = topk_idx[:, j]
+        onehot = jax.nn.one_hot(e, num_experts, dtype=jnp.int32)
+        pos_in_e = jnp.cumsum(onehot, axis=0) - 1 + occupancy[None, :]
+        occupancy = occupancy + onehot.sum(axis=0)
+        pos = jnp.take_along_axis(pos_in_e, e[:, None], axis=1)[:, 0]
+        keep = pos < capacity
+        w = jnp.where(keep, v[:, j], 0.0)
+        pos_c = jnp.clip(pos, 0, capacity - 1)
+        combine = combine.at[jnp.arange(n), e, pos_c].add(w)
+    dispatch = combine > 0.0
+    return combine, dispatch
+
+
+def _compute_capacity(num_tokens: int, num_experts: int, top_k: int,
+                      capacity_factor: float) -> int:
+    return max(int(math.ceil(num_tokens * top_k * capacity_factor /
+                             num_experts)), 4)
+
+
+def _make_gate(gate, d_model, num_expert, world_size):
+    if isinstance(gate, BaseGate):
+        return gate
+    cfg = dict(gate or {})
+    gtype = cfg.get("type", "gshard")
+    top_k = cfg.get("top_k", 2)
+    if gtype == "naive" or gtype is None:
+        return NaiveGate(d_model, num_expert, world_size, topk=top_k)
+    if gtype == "gshard":
+        # pass the user's top_k through so the gate's own assert surfaces a
+        # misconfig instead of silently routing top-2
+        return GShardGate(d_model, num_expert, world_size,
+                          topk=cfg.get("top_k", 2))
+    if gtype == "switch":
+        return SwitchGate(d_model, num_expert, world_size,
+                          topk=cfg.get("top_k", 1))
+    raise AssertionError(f"We only support naive/gshard/switch gate, "
+                         f"but you choose {gtype} gate.")
+
+
+class _MoEBase(Layer):
+    """Shared routing/dispatch/combine machinery."""
+
+    def __init__(self, d_model, num_expert, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, recompute_ctx=None,
+                 capacity_factor=2.0, ep_mesh: Optional[ProcessMesh] = None,
+                 ep_axis: Optional[str] = None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.group = moe_group
+        self.world_size = 1 if moe_group is None else moe_group.nranks
+        if self.world_size > 1:
+            # the reference's per-rank local experts + moe_group routing does
+            # not map onto the single-controller design; EP here = one global
+            # expert list sharded over a mesh axis
+            raise NotImplementedError(
+                "moe_group-based expert placement is not supported: pass ALL "
+                "experts and use ep_mesh=/ep_axis= to shard them over the "
+                "expert-parallel mesh axis (GSPMD inserts the all-to-all)")
+        self.recompute_interval = recompute_interval
+        self.recompute_ctx = recompute_ctx
+        self.capacity_factor = capacity_factor
+        self.gate = _make_gate(gate, d_model, num_expert, 1)
+        self.top_k = self.gate.top_k
+        self._ep_mesh = ep_mesh
+        self._ep_axis = ep_axis
+        self.l_aux: Optional[Tensor] = None
+
+    def _annotate_ep(self, t):
+        if self._ep_mesh is None or self._ep_axis is None:
+            return t
+        placements = [Shard(0) if name == self._ep_axis else Replicate()
+                      for name in self._ep_mesh.dim_names]
+        return shard_tensor(t, self._ep_mesh, placements)
+
+    def _run_experts(self, expert_in):
+        raise NotImplementedError
+
+    def forward(self, inp):
+        import paddle_tpu as paddle
+        orig_shape = inp.shape
+        x2d = inp.reshape([-1, self.d_model])
+        topk_val, topk_idx = self.gate(x2d)
+        self.l_aux = self.gate.get_loss(clear=True)
+        n = x2d.shape[0]
+        capacity = _compute_capacity(n, self.num_expert, self.top_k,
+                                     self.capacity_factor)
+        norm_mode = "sum" if isinstance(self.gate, (GShardGate, SwitchGate)) \
+            else "softmax"
+        combine, dispatch = _moe_masks_op(
+            topk_val, Tensor(topk_idx._data, stop_gradient=True),
+            num_experts=self.num_expert, capacity=capacity,
+            norm_mode=norm_mode)
+        # dispatch: [N, E, C] x [N, d] -> [E, C, d]
+        expert_in = paddle.einsum("nec,nd->ecd",
+                                  dispatch.astype(x2d.dtype), x2d)
+        expert_in = self._annotate_ep(expert_in)
+        if self.recompute_interval > 0:
+            from paddle_tpu.distributed.fleet.recompute import recompute
+            expert_out = recompute(self._run_experts, expert_in)
+        else:
+            expert_out = self._run_experts(expert_in)
+        expert_out = self._annotate_ep(expert_out)
+        # combine: [N, E, C] x [E, C, d] -> [N, d]
+        out = paddle.einsum("nec,ecd->nd",
+                            combine.astype(expert_out.dtype), expert_out)
+        return out.reshape(orig_shape)
+
+
+class MoELayer(_MoEBase):
+    """moe_layer.py:263 analog (see module docstring for the TPU routing)."""
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, recompute_ctx=None,
+                 capacity_factor=2.0, ep_mesh=None, ep_axis=None):
+        if not isinstance(experts, LayerList):
+            experts = LayerList(list(experts))
+        super().__init__(d_model, len(experts), gate=gate,
+                         moe_group=moe_group, mp_group=mp_group,
+                         recompute_interval=recompute_interval,
+                         recompute_ctx=recompute_ctx,
+                         capacity_factor=capacity_factor,
+                         ep_mesh=ep_mesh, ep_axis=ep_axis)
+        self.experts = experts
+
+    def _run_experts(self, expert_in):
+        """expert_in [E, C, d]: apply expert e to its capacity slice."""
+        import paddle_tpu as paddle
+        outs = [expert(expert_in[e]) for e, expert in enumerate(self.experts)]
+        return paddle.stack(outs, axis=0)
+
+
+class FusedMoEFFN(_MoEBase):
+    """TPU fast path: stacked FFN experts in one batched einsum, EP-sharded
+    Shard(0) over the moe mesh axis (reference's fused expert kernels live in
+    incubate/nn/functional; here the fusion is XLA's)."""
+
+    def __init__(self, d_model, d_hidden, num_expert, gate=None,
+                 activation="gelu", capacity_factor=2.0, ep_mesh=None,
+                 ep_axis=None, **kwargs):
+        super().__init__(d_model, num_expert, gate=gate,
+                         capacity_factor=capacity_factor, ep_mesh=ep_mesh,
+                         ep_axis=ep_axis, **kwargs)
+        self.w1 = self.create_parameter(
+            [num_expert, d_model, d_hidden],
+            default_initializer=nn.initializer.XavierNormal())
+        self.b1 = self.create_parameter([num_expert, 1, d_hidden],
+                                        is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_expert, d_hidden, d_model],
+            default_initializer=nn.initializer.XavierNormal())
+        self.b2 = self.create_parameter([num_expert, 1, d_model],
+                                        is_bias=True)
+        self.activation = activation
+        if ep_mesh is not None and ep_axis is not None:
+            pl = [Shard(0) if name == ep_axis else Replicate()
+                  for name in ep_mesh.dim_names]
+            for p in (self.w1, self.b1, self.w2, self.b2):
+                shard_tensor(p, ep_mesh, pl)
+
+    def _run_experts(self, expert_in):
+        import paddle_tpu as paddle
+        h = paddle.einsum("ecd,edh->ech", expert_in, self.w1) + self.b1
+        h = getattr(nn.functional, self.activation)(h)
+        return paddle.einsum("ech,ehd->ecd", h, self.w2) + self.b2

@@ -1,0 +1,82 @@
+"""MoE collectives: global_scatter / global_gather.
+
+Reference: incubate/distributed/models/moe/utils.py — global_scatter sends
+each token row to the rank owning its routed expert (counts negotiated via
+local_count/global_count all-to-alls); global_gather is the inverse.
+
+TPU-native: inside compiled programs the dispatch einsum + GSPMD sharding
+already emit the all-to-all, so these eager functions serve API parity and
+out-of-graph use. They follow the framework's single-controller convention
+for eager collectives (dim 0 = rank-stacked, see distributed/collective.py):
+x is [world, n_local, d] and counts are [world, world * num_expert].
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import collective as _coll
+
+
+def _count_matrix(count: np.ndarray, world: int) -> np.ndarray:
+    """[world, world*E] -> per (src, dst) row counts [world, world]."""
+    e = count.shape[1] // world
+    return count.reshape(world, world, e).sum(axis=2)
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Route token rows to expert-owning ranks (utils.py global_scatter).
+
+    x: [world, n_local, d] rank-stacked rows, each rank's rows sorted by
+    destination (expert-major, like the reference requires); local_count[r]
+    counts rows rank r sends to each (dst_rank, expert); global_count[r]
+    counts rows rank r receives. Returns the rank-stacked received rows.
+    Requires uniform receive counts across ranks (the static-shape TPU
+    contract; in-graph MoE uses the dense dispatch path instead)."""
+    g = group or _coll._world()
+    world = g.nranks
+    lc = np.asarray(local_count.numpy() if isinstance(local_count, Tensor)
+                    else local_count)
+    gc = np.asarray(global_count.numpy() if isinstance(global_count, Tensor)
+                    else global_count)
+    send = _count_matrix(lc, world)  # send[src, dst]
+    recv_totals = send.sum(axis=0)
+    if len(set(recv_totals.tolist())) != 1:
+        raise ValueError(
+            "eager global_scatter requires uniform per-rank receive counts "
+            "(static shapes); use the MoELayer dense dispatch path for "
+            "imbalanced routing")
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    out_rows = []
+    for dst in range(world):
+        rows = []
+        for src in range(world):
+            start = int(send[src, :dst].sum())
+            rows.append(arr[src, start:start + int(send[src, dst])])
+        out_rows.append(jnp.concatenate(rows, axis=0))
+    out = jnp.stack(out_rows, axis=0)
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter: return expert outputs to the ranks that
+    sent the tokens (utils.py global_gather)."""
+    g = group or _coll._world()
+    world = g.nranks
+    lc = np.asarray(local_count.numpy() if isinstance(local_count, Tensor)
+                    else local_count)
+    send = _count_matrix(lc, world)  # original send[src, dst]
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    back_rows = []
+    for src in range(world):
+        rows = []
+        for dst in range(world):
+            # rows from src sit in dst's buffer after all earlier srcs' rows
+            start = int(send[:src, dst].sum())
+            rows.append(arr[dst, start:start + int(send[src, dst])])
+        back_rows.append(jnp.concatenate(rows, axis=0))
+    out = jnp.stack(back_rows, axis=0)
+    return Tensor(out) if isinstance(x, Tensor) else out

@@ -1,0 +1,1 @@
+"""paddle.incubate.nn analog (fused layers land here as Pallas/XLA ops)."""

@@ -203,3 +203,17 @@ def test_shared_layer_grads_synced():
     model.train_batch([x, y], opt)
     w0, w1 = [getattr(l, "weight") for l in groups["embed"][1]]
     np.testing.assert_allclose(w0.numpy(), w1.numpy(), rtol=1e-6)
+
+
+def test_pipeline_eval_batch_outputs():
+    """eval_batch(compute_loss=False) returns the stitched full-batch output."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(
+        PipelineLayer(layers=_make_descs(), loss_fn=_loss_fn))
+    x, y = _data(batch=8)
+    out = model.eval_batch([x, y], compute_loss=False)
+    assert out.shape == [8, 4]
