@@ -22,11 +22,13 @@ from ...optimizer.clip import ClipGradByGlobalNorm
 from ..auto_parallel import Replicate, Shard, shard_tensor
 from . import mp_layers, random_ctrl, recompute as _recompute_mod
 from . import meta_parallel
+from . import utils
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, VocabParallelEmbedding)
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
 from .pipeline_parallel import (PipelineParallel,
                                 PipelineParallelWithInterleave)
+from .segment_parallel import SegmentParallel
 from .random_ctrl import get_rng_state_tracker
 from .recompute import recompute, recompute_sequential
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
@@ -120,9 +122,18 @@ class _Fleet:
             raise RuntimeError("call fleet.init first")
         if isinstance(model, PipelineLayer) and \
                 self._hcg.get_pipe_parallel_world_size() > 1:
+            if self._hcg.get_sep_parallel_world_size() > 1:
+                raise NotImplementedError(
+                    "pp_degree > 1 combined with sep_degree > 1 is not "
+                    "supported yet; shard the sequence inside the stages via "
+                    "ring_attention/sep_mesh instead")
             cls = (PipelineParallelWithInterleave
                    if model.get_num_virtual_stages() > 1 else PipelineParallel)
             wrapped = cls(model, self._hcg, self._strategy)
+            wrapped._fleet_hcg = self._hcg
+            return wrapped
+        if self._hcg.get_sep_parallel_world_size() > 1:
+            wrapped = SegmentParallel(model, self._hcg)
             wrapped._fleet_hcg = self._hcg
             return wrapped
         mesh = self._hcg.mesh

@@ -11,11 +11,12 @@ from .pipeline_parallel import (PipelineParallel,
 from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,
                         SharedLayerDesc)
 from .random_ctrl import RNGStatesTracker, get_rng_state_tracker
+from .segment_parallel import SegmentParallel
 
 __all__ = [
     "ColumnParallelLinear", "ParallelCrossEntropy", "RowParallelLinear",
     "VocabParallelEmbedding", "PipelineParallel",
     "PipelineParallelWithInterleave", "LayerDesc", "PipelineLayer",
     "SegmentLayers", "SharedLayerDesc", "RNGStatesTracker",
-    "get_rng_state_tracker",
+    "get_rng_state_tracker", "SegmentParallel",
 ]

@@ -1,0 +1,69 @@
+"""SEP — segment (sequence-axis data) parallelism.
+
+Reference: fleet/meta_parallel/segment_parallel.py:26 — SegmentParallel
+wrapper; the "sep" topology axis splits the sequence dim of the inputs across
+ranks while parameters are replicated (broadcast at init,
+hybrid_parallel_util broadcast helpers).
+
+TPU-native: inputs are annotated Shard(seq_dim) over the sep mesh axis;
+parameters replicate over sep. Attention across the split sequence uses
+ring_attention (paddle_tpu.ops.ring_attention) — the idiomatic TPU filler for
+the reference's missing context parallelism (SURVEY.md §5): the reference's
+SEP relies on attention kernels seeing the full sequence per rank, which a
+sharded mesh axis cannot do; the ring supplies exact global attention with
+neighbor-to-neighbor ICI traffic.
+"""
+from __future__ import annotations
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.auto_parallel import (Replicate, Shard,
+                                                  shard_tensor)
+from paddle_tpu.nn.layer import Layer
+
+from .topology import get_hybrid_communicate_group
+
+
+class SegmentParallel(Layer):
+    """segment_parallel.py:26 analog."""
+
+    def __init__(self, layers, hcg=None, seq_dim: int = 1, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._seq_dim = seq_dim
+        if self._hcg is not None:
+            mesh = self._hcg.mesh
+            repl = [Replicate()] * len(mesh.dim_names)
+            for p in layers.parameters():
+                if p._dist_attr is None:
+                    shard_tensor(p, mesh, repl)
+
+    def _shard_input(self, t: Tensor) -> Tensor:
+        if self._hcg is None or not isinstance(t, Tensor):
+            return t
+        mesh = self._hcg.mesh
+        placements = []
+        for name in mesh.dim_names:
+            if name == self._hcg.sep_axis:
+                placements.append(Shard(self._seq_dim))
+            elif name == self._hcg.dp_axis and t.ndim > 0 and \
+                    self._seq_dim != 0:
+                # keep the batch dim data-parallel alongside sep
+                placements.append(Shard(0))
+            else:
+                placements.append(Replicate())
+        return shard_tensor(t, mesh, placements)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(x) for x in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)

@@ -1,0 +1,12 @@
+"""fleet.utils namespace (reference: fleet/utils/__init__.py)."""
+from . import sequence_parallel_utils
+from .hybrid_parallel_util import (broadcast_dp_parameters,
+                                   broadcast_mp_parameters,
+                                   broadcast_sharding_parameters,
+                                   fused_allreduce_gradients)
+from .sequence_parallel_utils import (AllGatherOp, ColumnSequenceParallelLinear,
+                                      GatherOp, ReduceScatterOp,
+                                      RowSequenceParallelLinear, ScatterOp,
+                                      is_sequence_parallel_parameter,
+                                      mark_as_sequence_parallel_parameter,
+                                      register_sequence_parallel_allreduce_hooks)

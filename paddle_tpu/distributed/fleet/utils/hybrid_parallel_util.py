@@ -1,0 +1,46 @@
+"""Hybrid-parallel gradient/param sync helpers.
+
+Reference: fleet/utils/hybrid_parallel_util.py — fused_allreduce_gradients
+(bucketed grad allreduce over dp/sharding after backward),
+broadcast_dp_parameters / broadcast_mp_parameters /
+broadcast_sharding_parameters (param sync at wrap time).
+
+TPU-native: with global jax.Arrays the tape's gradients already ARE the
+cross-replica sums (GSPMD reduces over the batch-sharded dim in the matmul
+transpose), and parameters placed Replicate over an axis are definitionally
+identical across it — so these helpers validate/annotate rather than
+communicate. They exist so reference training scripts port unchanged.
+"""
+from __future__ import annotations
+
+from paddle_tpu.distributed.auto_parallel import Replicate, shard_tensor
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """hybrid_parallel_util.py fused_allreduce_gradients analog: grads of
+    replicated params are already globally reduced under GSPMD; no-op."""
+    return None
+
+
+def _broadcast_params(model, hcg):
+    """Place unannotated params Replicate over the full mesh (replication IS
+    the broadcast invariant; axis distinctions have no effect here)."""
+    if hcg is None:
+        return model
+    mesh = hcg.mesh
+    for p in model.parameters():
+        if p._dist_attr is None:
+            shard_tensor(p, mesh, [Replicate()] * len(mesh.dim_names))
+    return model
+
+
+def broadcast_dp_parameters(model, hcg):
+    return _broadcast_params(model, hcg)
+
+
+def broadcast_mp_parameters(model, hcg):
+    return _broadcast_params(model, hcg)
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return _broadcast_params(model, hcg)

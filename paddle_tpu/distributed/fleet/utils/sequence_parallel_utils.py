@@ -1,0 +1,189 @@
+"""Megatron-style sequence parallelism (SP inside the TP group).
+
+Reference: fleet/utils/sequence_parallel_utils.py — ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp PyLayers (:84-136), ColumnSequenceParallelLinear
+(:229), RowSequenceParallelLinear (:339), mark_as_sequence_parallel_parameter,
+register_sequence_parallel_allreduce_hooks (:191).
+
+TPU-native redesign: SP is a SHARDING of activations on the sequence dim over
+the mp mesh axis, not a choreography of collectives. The scatter/gather
+PyLayers become sharding annotations; GSPMD materializes exactly the
+reference's reduce-scatter (after row-parallel matmul) and all-gather (before
+column-parallel matmul) over ICI — including their transposes in backward.
+Layout convention matches the reference: activations are [s, b, h] with the
+sequence dim first.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.auto_parallel import (Replicate, Shard,
+                                                  shard_tensor)
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer
+
+from ..topology import get_hybrid_communicate_group
+
+
+def _placements(mesh, axis_name, shard_dim: Optional[int]):
+    return [Shard(shard_dim) if (name == axis_name and shard_dim is not None)
+            else Replicate() for name in mesh.dim_names]
+
+
+def _annotate_seq(t: Tensor, shard_dim: Optional[int]) -> Tensor:
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return t
+    return shard_tensor(t, hcg.mesh,
+                        _placements(hcg.mesh, hcg.mp_axis, shard_dim))
+
+
+class ScatterOp:
+    """sequence_parallel_utils.py:84 — split the sequence dim across the mp
+    group. Here: annotate Shard(0) over the mp axis (GSPMD slices)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        return _annotate_seq(x, axis)
+
+    def __new__(cls, x, axis=0):
+        return cls.apply(x, axis)
+
+
+class GatherOp:
+    """sequence_parallel_utils.py:104 — gather the sequence dim. Here:
+    annotate Replicate over mp (GSPMD all-gathers)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        return _annotate_seq(x, None)
+
+    def __new__(cls, x, axis=0):
+        return cls.apply(x, axis)
+
+
+class AllGatherOp:
+    """sequence_parallel_utils.py:118 — all-gather along seq (backward =
+    reduce-scatter). Same annotation as GatherOp; GSPMD derives the backward
+    collective from the sharding transpose."""
+
+    @staticmethod
+    def apply(x):
+        return _annotate_seq(x, None)
+
+    def __new__(cls, x):
+        return cls.apply(x)
+
+
+class ReduceScatterOp:
+    """sequence_parallel_utils.py:136 — reduce partial sums and scatter along
+    seq. In-graph the partial state is GSPMD-internal; annotating the output
+    Shard(0) over mp after a row-parallel matmul yields the reduce-scatter."""
+
+    @staticmethod
+    def apply(x):
+        return _annotate_seq(x, 0)
+
+    def __new__(cls, x):
+        return cls.apply(x)
+
+
+# id -> weakref; id-keyed because Tensor's __eq__ is elementwise (set/dict
+# membership on Tensors would build arrays), and Tensor is __slots__-only
+_SP_PARAMS: dict = {}
+
+
+def mark_as_sequence_parallel_parameter(parameter: Tensor):
+    """sequence_parallel_utils.py marker: the reference must allreduce these
+    params' grads over the mp group (their activations are seq-split). Under
+    the global-array tape the gradient is already the full sum; the marker is
+    kept for introspection/parity."""
+    import weakref
+    key = id(parameter)
+    _SP_PARAMS[key] = weakref.ref(parameter,
+                                  lambda _, k=key: _SP_PARAMS.pop(k, None))
+    return parameter
+
+
+def is_sequence_parallel_parameter(parameter: Tensor) -> bool:
+    ref = _SP_PARAMS.get(id(parameter))
+    return ref is not None and ref() is parameter
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """sequence_parallel_utils.py:191 analog. The reference registers grad
+    hooks that allreduce marked params over mp; with global arrays + GSPMD the
+    sum is produced by the compiler, so this is a checked no-op."""
+    return layer
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """sequence_parallel_utils.py:229 analog.
+
+    Input arrives sequence-sharded [s/mp, b, h]; the reference all-gathers s
+    then runs the column-parallel matmul. Here: weight Shard(1) over mp,
+    output annotated feature-sharded — GSPMD all-gathers the input exactly
+    once and keeps the output split on features for the next row layer."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        hcg = get_hybrid_communicate_group()
+        self._hcg = hcg
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if hcg is not None:
+            shard_tensor(self.weight, hcg.mesh,
+                         _placements(hcg.mesh, hcg.mp_axis, 1))
+            if self.bias is not None:
+                shard_tensor(self.bias, hcg.mesh,
+                             _placements(hcg.mesh, hcg.mp_axis, 0))
+
+    def forward(self, x):
+        # x: [s(sharded over mp), b, in]; output feature-sharded
+        out = F.linear(x, self.weight, self.bias)
+        if self._hcg is not None and not self.gather_output:
+            out = _annotate_seq(out, out.ndim - 1)
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """sequence_parallel_utils.py:339 analog.
+
+    Input is feature-sharded from the column layer; weight Shard(0) over mp.
+    Annotating the output Shard(0) (sequence) makes GSPMD emit the
+    reduce-scatter that replaces the reference's explicit ReduceScatterOp."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        hcg = get_hybrid_communicate_group()
+        self._hcg = hcg
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if hcg is not None:
+            shard_tensor(self.weight, hcg.mesh,
+                         _placements(hcg.mesh, hcg.mp_axis, 0))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self._hcg is not None:
+            out = _annotate_seq(out, 0)  # sequence-sharded (reduce-scatter)
+        return out

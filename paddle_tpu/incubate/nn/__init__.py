@@ -1,1 +1,2 @@
 """paddle.incubate.nn analog (fused layers land here as Pallas/XLA ops)."""
+from . import functional

@@ -1,0 +1,11 @@
+"""paddle.incubate.nn.functional analog — fused / experimental functionals.
+
+Reference: python/paddle/incubate/nn/functional (fused attention/FFN/rope
+wrappers over phi fusion kernels). Here the fused tier is XLA fusion +
+Pallas kernels; ring attention fills the reference's context-parallel gap
+(SURVEY.md §5).
+"""
+from paddle_tpu.nn.functional import flash_attention
+from paddle_tpu.ops.ring_attention import ring_attention
+
+__all__ = ["flash_attention", "ring_attention"]

@@ -46,6 +46,10 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     initializer_range: float = 0.02
     dtype: str = "float32"
+    # context parallelism: when set, attention runs as a ring over this mesh
+    # axis (sequence sharded; exact global attention via ICI ppermute)
+    sep_mesh: Optional[object] = None
+    sep_axis: str = "sep"
 
     @property
     def head_dim(self) -> int:
@@ -125,14 +129,24 @@ class LlamaAttention(Layer):
         v = self.v_proj(hidden).reshape([b, s, kv, d])
         q = apply_rotary_pos_emb_t(q, cos, sin)
         k = apply_rotary_pos_emb_t(k, cos, sin)
-        if kv != h:
-            # GQA: repeat kv heads to full head count; XLA keeps this as a
-            # broadcast feeding the batched matmul (no materialized copy).
-            rep = h // kv
-            k = k.unsqueeze(3).expand([b, s, kv, rep, d]).reshape([b, s, h, d])
-            v = v.unsqueeze(3).expand([b, s, kv, rep, d]).reshape([b, s, h, d])
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=attn_mask is None)
+        if cfg.sep_mesh is not None and attn_mask is None:
+            # context parallelism: exact global attention with K/V blocks
+            # rotating the ICI ring (SURVEY.md §5's CP gap filler). GQA kv
+            # heads stay unexpanded — the ring ships h/kv less K/V traffic.
+            from ..ops.ring_attention import ring_attention
+            out = ring_attention(q, k, v, mesh=cfg.sep_mesh,
+                                 axis_name=cfg.sep_axis, causal=True)
+        else:
+            if kv != h:
+                # GQA: repeat kv heads to full head count; XLA keeps this as
+                # a broadcast feeding the batched matmul (no copy).
+                rep = h // kv
+                k = k.unsqueeze(3).expand(
+                    [b, s, kv, rep, d]).reshape([b, s, h, d])
+                v = v.unsqueeze(3).expand(
+                    [b, s, kv, rep, d]).reshape([b, s, h, d])
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=attn_mask is None)
         out = out.reshape([b, s, h * d])
         return self.o_proj(out)
 

@@ -1,0 +1,163 @@
+"""Ring (context-parallel) attention over the device mesh.
+
+The reference snapshot has NO ring/Ulysses context parallelism (verified in
+SURVEY.md §2.8.8); long context is served there by SEP + Megatron-SP + fused
+flash attention. On TPU the idiomatic equivalent is ring attention: shard the
+sequence over a mesh axis, keep Q local, and rotate K/V blocks around the ICI
+ring with `ppermute`, accumulating the softmax streamingly (flash-attention
+style log-sum-exp), so attention memory is O(s_local^2) and the K/V traffic
+rides neighbor-to-neighbor ICI links.
+
+GQA-aware: K/V keep their (fewer) kv heads on the wire — blocks rotate
+unexpanded and the group expansion happens in the score einsum (a broadcast,
+no materialized copy, h/kv less ICI traffic). Batch and head dims can stay
+sharded over dp/mp mesh axes via the spec hints.
+
+Implementation: one shard_map whose body runs the P-step ring. Differentiable
+end-to-end (ppermute and the streaming softmax have exact transposes under
+jax.vjp); the op integrates with the tape via the standard dispatch path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from .registry import dispatch
+
+
+def _block_update(q, k, v, o, m, l, q_off, k_off, causal, scale):
+    """One streaming-softmax step with the K/V block at seq offset k_off.
+
+    q: [b, g, r, sq, d] (g = kv head groups, r = h // kv);
+    k/v: [b, g, sk, d]; o: [b, g, r, sq, d]; m/l: [b, g, r, sq].
+    Accumulation in fp32.
+    """
+    scores = jnp.einsum("bgrqd,bgkd->bgrqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[3], k.shape[2]
+        rows = q_off + jnp.arange(sq)[:, None]
+        cols = k_off + jnp.arange(sk)[None, :]
+        scores = jnp.where(cols <= rows, scores, -jnp.inf)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # fully-masked rows keep m == -inf; guard the exp against inf - inf
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(jnp.where(jnp.isneginf(scores), -jnp.inf,
+                          scores - safe_m[..., None]))
+    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l = l * alpha + p.sum(axis=-1)
+    o = o * alpha[..., None] + jnp.einsum(
+        "bgrqk,bgkd->bgrqd", p, v.astype(jnp.float32))
+    return o, m_new, l
+
+
+def _ring_body(q_blk, k_blk, v_blk, axis_name, num_blocks, causal, scale):
+    """Per-shard ring loop. q_blk [b, h, s_local, d]; k/v [b, kv, s_local, d]."""
+    i = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q_blk.shape
+    g = k_blk.shape[1]
+    r = h // g
+    q = q_blk.reshape(b, g, r, sq, d)
+    o = jnp.zeros((b, g, r, sq, d), jnp.float32)
+    m = jnp.full((b, g, r, sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, g, r, sq), jnp.float32)
+    perm = [(j, (j + 1) % num_blocks) for j in range(num_blocks)]
+    k_cur, v_cur = k_blk, v_blk
+    for t in range(num_blocks):
+        src = (i - t) % num_blocks  # owner of the kv block now held locally
+        o, m, l = _block_update(
+            q, k_cur, v_cur, o, m, l,
+            q_off=i * sq, k_off=src * sq, causal=causal, scale=scale)
+        if t + 1 < num_blocks:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return out.reshape(b, h, sq, d).astype(q_blk.dtype)
+
+
+def _ring_attention_impl(query, key, value, jax_mesh, axis_name, causal,
+                         batch_axis, head_axis):
+    """query [b, s, h, d]; key/value [b, s, kv, d]; s sharded over axis_name."""
+    num_blocks = jax_mesh.shape[axis_name]
+    s = query.shape[1]
+    if s % num_blocks:
+        raise ValueError(f"sequence length {s} not divisible by the "
+                         f"'{axis_name}' mesh axis size {num_blocks}")
+    if query.shape[2] % key.shape[2]:
+        raise ValueError("num q heads must be a multiple of kv heads")
+    scale = 1.0 / (query.shape[-1] ** 0.5)
+
+    def local_fn(q, k, v):
+        # shards arrive [b, s_local, (h|kv), d]; compute head-major
+        qt = jnp.einsum("bshd->bhsd", q)
+        kt = jnp.einsum("bshd->bhsd", k)
+        vt = jnp.einsum("bshd->bhsd", v)
+        out = _ring_body(qt, kt, vt, axis_name, num_blocks, causal, scale)
+        return jnp.einsum("bhsd->bshd", out)
+
+    # keep batch/head dims sharded over their mesh axes so hybrid dp/mp runs
+    # don't all-gather at the attention boundary
+    spec = PartitionSpec(batch_axis, axis_name, head_axis, None)
+    from ..distributed.collective import shard_map as _shard_map
+    fn = _shard_map(local_fn, jax_mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec)
+    return fn(query, key, value)
+
+
+_DP_NAMES = ("dp", "data", "fsdp", "sharding")
+_MP_NAMES = ("mp", "model", "tp")
+
+
+def _pick_axis(mesh_axes, candidates, exclude):
+    for name in mesh_axes:
+        if name in candidates and name != exclude:
+            return name
+    return None
+
+
+def ring_attention(query, key, value, mesh=None, axis_name: str = "sep",
+                   causal: bool = True, batch_axis: Optional[str] = None,
+                   head_axis: Optional[str] = None):
+    """Context-parallel attention (see module docstring).
+
+    query: [b, s, h, d]; key/value: [b, s, kv, d] with h % kv == 0 (GQA kv
+    heads stay unexpanded on the ring). mesh: a ProcessMesh containing
+    `axis_name` (defaults to the fleet hybrid mesh). batch_axis/head_axis:
+    mesh axes the batch/head dims are sharded over (auto-detected from
+    conventional names dp/data/fsdp/sharding and mp/model when present).
+    Returns the output sequence-sharded over `axis_name`.
+    """
+    from ..distributed.auto_parallel import ProcessMesh, get_default_mesh
+    if mesh is None:
+        from ..distributed.fleet.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        mesh = hcg.mesh if hcg is not None else get_default_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention needs a mesh (or initialized fleet)")
+    jmesh = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else mesh
+    axes = jmesh.axis_names
+    if batch_axis is None:
+        batch_axis = _pick_axis(axes, _DP_NAMES, axis_name)
+    if head_axis is None:
+        head_axis = _pick_axis(axes, _MP_NAMES, axis_name)
+    # auto-detected axes must evenly divide their dims; drop them otherwise
+    if batch_axis is not None and query.shape[0] % jmesh.shape[batch_axis]:
+        batch_axis = None
+    if head_axis is not None and (query.shape[2] % jmesh.shape[head_axis] or
+                                  key.shape[2] % jmesh.shape[head_axis]):
+        head_axis = None
+
+    impl = _cached_impl(jmesh, axis_name, bool(causal), batch_axis, head_axis)
+    return dispatch(impl, (query, key, value), {}, "ring_attention")
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_impl(jax_mesh, axis_name, causal, batch_axis, head_axis):
+    """Bounded cache (a jax Mesh is hashable); avoids re-closing over the
+    mesh per call without growing an unbounded registry."""
+    return functools.partial(_ring_attention_impl, jax_mesh=jax_mesh,
+                             axis_name=axis_name, causal=causal,
+                             batch_axis=batch_axis, head_axis=head_axis)
