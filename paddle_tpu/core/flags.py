@@ -62,3 +62,6 @@ define_flag("FLAGS_cudnn_deterministic", False, "deterministic kernels")
 define_flag("FLAGS_low_precision_op_list", 0, "record low precision op calls")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "host allocator strategy")
 define_flag("FLAGS_eager_op_cache", True, "cache per-op jitted executables in eager mode")
+define_flag("FLAGS_use_pallas_attention", True,
+            "route attention to the Pallas flash kernel on TPU when shapes "
+            "allow (reference: dynloaded flashattn, N27)")

@@ -41,6 +41,8 @@ class SegmentParallel(Layer):
     def _shard_input(self, t: Tensor) -> Tensor:
         if self._hcg is None or not isinstance(t, Tensor):
             return t
+        if t.ndim <= self._seq_dim:
+            return t  # no sequence dim (0-d scales, per-example lengths)
         mesh = self._hcg.mesh
         placements = []
         for name in mesh.dim_names:

@@ -712,12 +712,38 @@ def _sdpa_op(query, key, value, attn_mask=None, dropout_p=0.0,
     return jnp.einsum("bhsd->bshd", out)
 
 
+@defop(name="flash_attention_pallas")
+def _flash_pallas_op(query, key, value, is_causal=False, interpret=False):
+    from ..ops.pallas.flash_attention import flash_attention_pallas
+    return flash_attention_pallas(query, key, value, causal=is_causal,
+                                  interpret=interpret)
+
+
+def _pallas_attention_eligible(query, key, attn_mask, dropout_p) -> bool:
+    from ..ops import pallas as _pl
+    from ..ops.pallas.flash_attention import supported
+    from ..core.flags import get_flag
+    if not get_flag("FLAGS_use_pallas_attention"):
+        return False
+    if attn_mask is not None or dropout_p > 0.0:
+        return False
+    if query.shape[2] != key.shape[2]:
+        return False  # GQA callers expand first
+    if query.shape[1] != key.shape[1]:
+        return False  # cross-attention / kv-cache: XLA path
+    return _pl.on_tpu() and supported(int(query.shape[1]),
+                                      int(query.shape[-1]))
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True):
     """paddle.nn.functional.scaled_dot_product_attention
     (python/paddle/nn/functional/flash_attention.py) — layout [B, S, H, D].
-    Single fused XLA contraction chain; Pallas flash kernel swaps in via
-    paddle_tpu.ops.pallas when shapes allow (reference: third_party/flashattn)."""
+    Routes to the Pallas flash kernel on TPU when shapes allow (the
+    reference's third_party/flashattn tier); otherwise a fused XLA
+    contraction chain."""
+    if _pallas_attention_eligible(query, key, attn_mask, dropout_p):
+        return _flash_pallas_op(query, key, value, is_causal=is_causal)
     key_ = random_mod.next_key() if (dropout_p > 0.0 and training) else None
     return _sdpa_op(query, key, value, attn_mask=attn_mask,
                     dropout_p=float(dropout_p), is_causal=is_causal,

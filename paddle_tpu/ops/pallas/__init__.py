@@ -1,0 +1,36 @@
+"""Pallas TPU kernel tier.
+
+Reference disposition (SURVEY.md N27): the reference dynloads a vendored
+flashattn library (third_party/flashattn, phi/backends/dynload/flashattn.cc)
+and carries 66k LoC of fused CUDA kernels (phi/kernels/fusion). Here the
+fused tier is a small set of Pallas TPU kernels behind availability gates —
+XLA's fusion covers the long tail, Pallas covers the blockwise-softmax
+attention family where XLA's dataflow fusion cannot restructure the
+computation.
+
+Every kernel has an XLA fallback; `available()` gates on the backend so the
+same code runs on the CPU test mesh (interpret mode) and real TPUs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=1)
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels run interpreted off-TPU (CPU test mesh)."""
+    return not on_tpu()
+
+
+from .flash_attention import flash_attention_pallas  # noqa: E402
+
+__all__ = ["flash_attention_pallas", "on_tpu", "interpret_mode"]

@@ -1,0 +1,274 @@
+"""Pallas flash attention (TPU).
+
+The reference's fused attention tier: third_party/flashattn dynloaded by
+phi/backends/dynload/flashattn.cc, used via phi/kernels/gpu/
+flash_attn_kernel.cu:128. TPU-native equivalent: a blockwise streaming-softmax
+kernel in Pallas — Q blocks stay resident in VMEM while K/V blocks stream
+through, so attention never materializes the [s, s] score matrix in HBM.
+
+Forward saves only (out, logsumexp); backward recomputes scores blockwise
+(flash-attention-2 style) in a second Pallas kernel. Both kernels grid over
+(batch*heads, q_blocks) with an inner fori over K/V blocks; causal masking
+skips fully-masked K/V blocks via the grid bound.
+
+Layout: [b, h, s, d] head-major inside the kernels (callers transpose from
+the framework's [b, s, h, d]).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
+                causal, scale):
+    """One (batch*head, q_block) program: stream K/V blocks, accumulate o."""
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    if causal:
+        # only K/V blocks with k_start <= q_end participate
+        num_k = (q_start + block_q + block_k - 1) // block_k
+    else:
+        num_k = seq_len // block_k
+
+    def body(ki, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    d = q_ref.shape[-1]
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k, seq_len, causal, scale):
+    """dq for one (batch*head, q_block): dq = sum_k (ds @ k) * scale."""
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    num_k = ((q_start + block_q + block_k - 1) // block_k) if causal \
+        else seq_len // block_k
+
+    def body(ki, dq):
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(0, num_k, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, *, block_q, seq_len, causal, scale):
+    """dk/dv for one (batch*head, k_block): loop over the q blocks that can
+    attend to this k block (flash-attention-2 two-pass structure)."""
+    k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    block_k = k.shape[0]
+    ki = pl.program_id(1)
+    k_start = ki * block_k
+    num_q = seq_len // block_q
+    first_q = (k_start // block_q) if causal else 0
+
+    def body(qj, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qj * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do = do_ref[0, pl.ds(qj * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qj * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qj * block_q, block_q)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = qj * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k_ref.shape[-1]
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_q, num_q, body, (zeros, zeros))
+    # q was pre-scaled in the body, so ds.T @ q already carries `scale`
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    grid = (bh, s // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, seq_len=s,
+                               causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            # [bh, 1, s] layout keeps the trailing dims TPU-tileable
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_call(q, k, v, o, do, lse, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
+        axis=-1)[:, None, :]
+    lse3 = lse  # already [bh, 1, s]
+
+    blk_q = lambda b, i: (b, i, 0)
+    blk_row = lambda b, i: (b, 0, i)
+    full = lambda b, i: (b, 0, 0)
+    full_row = lambda b, i: (b, 0, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, seq_len=s,
+                          causal=causal, scale=scale),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), blk_q),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, block_q, d), blk_q),
+            pl.BlockSpec((1, 1, block_q), blk_row),
+            pl.BlockSpec((1, 1, block_q), blk_row),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), blk_q),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, seq_len=s,
+                          causal=causal, scale=scale),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, block_k, d), blk_q),
+            pl.BlockSpec((1, block_k, d), blk_q),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, 1, s), full_row),
+            pl.BlockSpec((1, 1, s), full_row),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), blk_q),
+            pl.BlockSpec((1, block_k, d), blk_q),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, out, g, lse, causal, block_q, block_k,
+                           interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def supported(seq_len: int, head_dim: int, block_q: int = DEFAULT_BLOCK_Q,
+              block_k: int = DEFAULT_BLOCK_K) -> bool:
+    return (seq_len % block_q == 0 and seq_len % block_k == 0
+            and seq_len >= block_q and head_dim % 8 == 0)
+
+
+def flash_attention_pallas(q, k, v, causal: bool = True,
+                           block_q: int = DEFAULT_BLOCK_Q,
+                           block_k: int = DEFAULT_BLOCK_K,
+                           interpret: bool = False):
+    """q/k/v: [b, s, h, d] (equal head counts). Returns [b, s, h, d]."""
+    b, s, h, d = q.shape
+    if not supported(s, d, block_q, block_k):
+        raise ValueError(f"flash_attention_pallas: unsupported shape "
+                         f"s={s}, d={d} for blocks ({block_q},{block_k})")
+    bq = min(block_q, s)
+
+    def to_bh(x):
+        return jnp.einsum("bshd->bhsd", x).reshape(b * h, s, d)
+
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), causal, bq, block_k, interpret)
+    return jnp.einsum("bhsd->bshd", out.reshape(b, h, s, d))

@@ -86,6 +86,10 @@ def _ring_attention_impl(query, key, value, jax_mesh, axis_name, causal,
     if s % num_blocks:
         raise ValueError(f"sequence length {s} not divisible by the "
                          f"'{axis_name}' mesh axis size {num_blocks}")
+    if key.shape[1] != s or value.shape[1] != s:
+        raise ValueError("ring_attention requires equal q/k/v sequence "
+                         f"lengths, got q={s}, k={key.shape[1]}, "
+                         f"v={value.shape[1]}")
     if query.shape[2] % key.shape[2]:
         raise ValueError("num q heads must be a multiple of kv heads")
     scale = 1.0 / (query.shape[-1] ** 0.5)

@@ -1,0 +1,83 @@
+"""Pallas kernel tier tests (interpret mode on the CPU mesh).
+
+Reference coverage model: the fused-kernel unit tests under
+test/legacy_test/test_flash_attention.py etc. (SURVEY.md §4); kernels run
+interpreted off-TPU so the same suite gates both backends.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.flash_attention import (flash_attention_pallas,
+                                                   supported)
+
+
+def _dense(q, k, v, causal):
+    d = q.shape[-1]
+    qt = jnp.einsum("bshd->bhsd", q)
+    kt = jnp.einsum("bshd->bhsd", k)
+    vt = jnp.einsum("bshd->bhsd", v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
+    if causal:
+        n = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((n, n), bool)), s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.einsum("bhsd->bshd", out)
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape), jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_dense(causal):
+    b, s, h, d = 2, 256, 2, 64
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), \
+        _rand((b, s, h, d), 2)
+    out = flash_attention_pallas(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_dense(q, k, v, causal)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_dense(causal):
+    b, s, h, d = 1, 256, 1, 32
+    q, k, v = _rand((b, s, h, d), 3), _rand((b, s, h, d), 4), \
+        _rand((b, s, h, d), 5)
+
+    def f(q, k, v):
+        return flash_attention_pallas(q, k, v, causal=causal,
+                                      interpret=True).sum()
+
+    def g(q, k, v):
+        return _dense(q, k, v, causal).sum()
+
+    got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_supported_gate():
+    assert supported(1024, 64)
+    assert not supported(1000, 64)   # seq not divisible by blocks
+    assert not supported(1024, 63)   # head dim not 8-aligned
+    assert not supported(64, 64)     # seq below one q block
+
+
+def test_sdpa_routes_by_flag():
+    """CPU backend never routes to pallas; the flag gate is honored."""
+    from paddle_tpu.nn.functional import _pallas_attention_eligible
+    q = paddle.randn([1, 128, 2, 64])
+    assert not _pallas_attention_eligible(q, q, None, 0.0)  # cpu backend
+    paddle.set_flags({"FLAGS_use_pallas_attention": False})
+    try:
+        assert not _pallas_attention_eligible(q, q, None, 0.0)
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_attention": True})
