@@ -31,6 +31,7 @@ from . import autograd
 from . import distributed
 from . import framework
 from . import incubate
+from . import io
 from . import jit
 from . import nn
 from . import optimizer

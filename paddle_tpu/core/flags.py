@@ -65,3 +65,6 @@ define_flag("FLAGS_eager_op_cache", True, "cache per-op jitted executables in ea
 define_flag("FLAGS_use_pallas_attention", True,
             "route attention to the Pallas flash kernel on TPU when shapes "
             "allow (reference: dynloaded flashattn, N27)")
+define_flag("FLAGS_dataloader_mp_context", "fork",
+            "multiprocessing start method for DataLoader workers ('fork' is "
+            "fast but workers must not touch jax; 'spawn' is always safe)")

@@ -11,9 +11,10 @@ Layers:
 from __future__ import annotations
 
 from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
-                            dtensor_from_local, dtensor_to_local, get_mesh,
-                            get_placements, reshard, shard_layer, shard_tensor,
-                            unshard_dtensor)
+                            ShardDataloader, dtensor_from_local,
+                            dtensor_to_local, get_mesh, get_placements,
+                            reshard, shard_dataloader, shard_layer,
+                            shard_tensor, unshard_dtensor)
 from .collective import (Group, P2POp, ReduceOp, all_gather,
                          all_gather_object, all_reduce, alltoall, barrier,
                          batch_isend_irecv, broadcast, destroy_process_group,

@@ -300,3 +300,66 @@ def get_mesh(t: Tensor):
     if t._dist_attr:
         return t._dist_attr["mesh"]
     return None
+
+
+class ShardDataloader:
+    """auto_parallel/api.py shard_dataloader:1792 analog: wrap a DataLoader
+    so every batch lands sharded over the mesh — batch dim over the data
+    axes (shard_dims), everything else replicated. Single-controller: the
+    loader yields GLOBAL batches; sharding is one device_put per field."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted=False):
+        self._loader = dataloader
+        if isinstance(meshes, (list, tuple)):
+            if len({id(m) for m in meshes}) > 1:
+                raise NotImplementedError(
+                    "per-input meshes (pipeline-stage dataloaders) are not "
+                    "supported yet; pass one mesh")
+            meshes = meshes[0]
+        self._mesh = meshes
+        if shard_dims is None:
+            shard_dims = self._mesh.dim_names[0]
+        self._shard_axes = [shard_dims] if isinstance(shard_dims, str) \
+            else list(shard_dims)
+        self._input_keys = set(input_keys) if input_keys else None
+        # is_dataset_splitted=True: the loader yields this PROCESS's local
+        # shard (DistributedBatchSampler-style) — assemble the global
+        # DistTensor from it instead of resharding it as a global batch
+        self._splitted = bool(is_dataset_splitted)
+
+    def _placements(self):
+        return [Shard(0) if name in self._shard_axes else Replicate()
+                for name in self._mesh.dim_names]
+
+    def _shard(self, t):
+        if not isinstance(t, Tensor):
+            return t
+        if self._splitted:
+            return dtensor_from_local(t, self._mesh, self._placements())
+        return shard_tensor(t, self._mesh, self._placements())
+
+    def _shard_tree(self, batch, key=None):
+        if isinstance(batch, dict):
+            return {k: self._shard_tree(v, key=k) for k, v in batch.items()}
+        if isinstance(batch, (list, tuple)):
+            return type(batch)(self._shard_tree(v, key=key) for v in batch)
+        if self._input_keys is not None and key is not None and \
+                key not in self._input_keys:
+            return batch
+        return self._shard(batch)
+
+    def __iter__(self):
+        for batch in self._loader:
+            yield self._shard_tree(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    """distributed.shard_dataloader (auto_parallel/api.py:1792)."""
+    return ShardDataloader(dataloader, meshes, input_keys=input_keys,
+                           shard_dims=shard_dims,
+                           is_dataset_splitted=is_dataset_splitted)

@@ -1,0 +1,15 @@
+"""paddle.io analog (reference: python/paddle/io — SURVEY.md §2.17)."""
+from .collate import default_collate_fn, default_convert_fn
+from .dataloader import DataLoader, WorkerInfo, get_worker_info
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, WeightedRandomSampler)
+
+__all__ = [
+    "default_collate_fn", "default_convert_fn", "DataLoader", "WorkerInfo",
+    "get_worker_info", "ChainDataset", "ComposeDataset", "ConcatDataset",
+    "Dataset", "IterableDataset", "Subset", "TensorDataset", "random_split",
+    "BatchSampler", "DistributedBatchSampler", "RandomSampler", "Sampler",
+    "SequenceSampler", "WeightedRandomSampler",
+]

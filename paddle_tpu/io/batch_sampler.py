@@ -1,0 +1,5 @@
+"""Batch samplers (reference: python/paddle/io/dataloader/batch_sampler.py).
+Implementations live in sampler.py; this module mirrors the reference layout."""
+from .sampler import BatchSampler, DistributedBatchSampler
+
+__all__ = ["BatchSampler", "DistributedBatchSampler"]

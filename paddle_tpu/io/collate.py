@@ -1,0 +1,61 @@
+"""Batch collation.
+
+Reference: python/paddle/io/dataloader/collate.py — default_collate_fn
+(stack samples into batched tensors field-wise), default_convert_fn.
+
+TPU note: workers collate to NUMPY (picklable, shared-memory friendly); the
+main process converts to device tensors in one host-to-device transfer per
+field — minimizing H2D round trips is the TPU analog of the reference's
+pinned-memory fast path.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples field-wise (collate.py analog)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, numbers.Number):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn(fields) for fields in zip(*batch)]
+    # Tensor samples (TensorDataset): stack on host
+    from ..core.tensor import Tensor
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s.numpy()) for s in batch], axis=0)
+    raise TypeError(f"batch data can only contains: tensor, numpy.ndarray, "
+                    f"dict, list, number, but got {type(sample)}")
+
+
+def default_convert_fn(batch):
+    from ..core.tensor import Tensor
+    if isinstance(batch, (Tensor, np.ndarray)):
+        return batch
+    if isinstance(batch, (str, bytes)):
+        return batch
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        return [default_convert_fn(d) for d in batch]
+    return batch
+
+
+def to_tensor_tree(batch):
+    """numpy tree -> Tensor tree (one H2D per leaf)."""
+    from ..core.tensor import Tensor
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, dict):
+        return {k: to_tensor_tree(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        return [to_tensor_tree(v) for v in batch]
+    return batch

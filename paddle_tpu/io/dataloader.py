@@ -1,0 +1,315 @@
+"""DataLoader.
+
+Reference: python/paddle/io/ — DataLoader over Dataset/BatchSampler with
+single-process iteration (dataloader_iter.py:150) and multi-process workers
+feeding shared-memory queues (dataloader_iter.py:358, worker.py), backed by
+C++ blocking queues (fluid/imperative/data_loader.cc).
+
+TPU-native redesign: workers are OS processes producing NUMPY batches over
+multiprocessing queues (pickle/shm transport); the main process performs one
+host-to-device transfer per field. The reference's C++ blocking-queue +
+mmap-allocator tier exists to feed GPUs at high rate from CPython — here the
+device feed is XLA's async transfer engine, so the host tier stays lean
+(ordered reassembly + prefetch window, same semantics as worker.py).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+import traceback
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .batch_sampler import BatchSampler, DistributedBatchSampler  # noqa: F401
+from .collate import default_collate_fn, default_convert_fn, to_tensor_tree
+from .dataset import Dataset, IterableDataset
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """io get_worker_info analog (valid inside worker processes)."""
+    return getattr(_worker_info, "info", None)
+
+
+class _WorkerEnd:
+    pass
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
+                 worker_id, num_workers, base_seed, iterable, drop_last):
+    try:
+        np.random.seed((base_seed + worker_id) % (2 ** 31))
+        _worker_info.info = WorkerInfo(worker_id, num_workers, dataset,
+                                       base_seed + worker_id)
+        if init_fn is not None:
+            init_fn(worker_id)
+        if iterable:
+            it = iter(dataset)
+        while True:
+            task = index_queue.get()
+            if task is None:
+                break
+            seq, indices = task
+            try:
+                if iterable:
+                    samples = []
+                    for _ in indices:
+                        try:
+                            samples.append(next(it))
+                        except StopIteration:
+                            break
+                    if not samples or (drop_last and
+                                       len(samples) < len(indices)):
+                        result_queue.put((seq, _WorkerEnd(), None))
+                        continue
+                else:
+                    samples = [dataset[i] for i in indices]
+                batch = collate_fn(samples)
+                result_queue.put((seq, batch, None))
+            except Exception:  # noqa: BLE001 — forwarded to the main process
+                result_queue.put((seq, None, traceback.format_exc()))
+    except KeyboardInterrupt:
+        pass
+
+
+class _MultiprocessIter:
+    """Ordered multi-worker iterator (dataloader_iter.py:358 analog)."""
+
+    def __init__(self, loader, batches):
+        self._loader = loader
+        self._batches = iter(batches)
+        self._iterable = isinstance(loader.dataset, IterableDataset)
+        # fork matches the reference's worker model and is fast, but a forked
+        # child must not touch jax (JAX threads + fork can deadlock) — keep
+        # worker datasets numpy-only, or set FLAGS_dataloader_mp_context=spawn
+        from ..core.flags import get_flag
+        ctx = mp.get_context(get_flag("FLAGS_dataloader_mp_context"))
+        self._result_queue = ctx.Queue()
+        self._workers = []
+        self._index_queues = []
+        from ..core import random as random_mod
+        base_seed = random_mod.default_generator().initial_seed() + 1
+
+        n = loader.num_workers
+        for wid in range(n):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, iq, self._result_queue,
+                      loader.collate_fn or default_collate_fn,
+                      loader.worker_init_fn, wid, n, base_seed,
+                      self._iterable, loader.drop_last),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+            self._index_queues.append(iq)
+
+        self._seq_send = 0
+        self._seq_recv = 0
+        self._cache = {}
+        self._seq_wid = {}
+        self._alive = list(range(n))
+        self._rr = 0
+        self._outstanding = 0
+        self._shutdown = False
+        # prefetch window
+        for _ in range(n * loader.prefetch_factor):
+            self._dispatch()
+
+    def _dispatch(self):
+        if not self._alive:
+            return False
+        try:
+            indices = next(self._batches)
+        except StopIteration:
+            return False
+        wid = self._alive[self._rr % len(self._alive)]
+        self._rr += 1
+        self._index_queues[wid].put((self._seq_send, indices))
+        self._seq_wid[self._seq_send] = wid
+        self._seq_send += 1
+        self._outstanding += 1
+        return True
+
+    def _get_result(self):
+        """Poll the result queue, watching worker liveness so a crashed
+        worker (OOM-kill, segfault) surfaces as an error instead of a hang
+        (the reference watches worker exit codes the same way, worker.py)."""
+        deadline = None
+        if self._loader.timeout:
+            import time
+            deadline = time.monotonic() + self._loader.timeout
+        while True:
+            try:
+                return self._result_queue.get(timeout=1.0)
+            except queue_mod.Empty:
+                dead = [w.pid for w in self._workers if not w.is_alive()]
+                if dead and self._outstanding > 0:
+                    self._stop()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited unexpectedly")
+                if deadline is not None:
+                    import time
+                    if time.monotonic() > deadline:
+                        self._stop()
+                        raise RuntimeError(
+                            f"DataLoader timed out after "
+                            f"{self._loader.timeout}s waiting for a batch")
+
+    def __next__(self):
+        while True:
+            if self._outstanding == 0:
+                self._stop()
+                raise StopIteration
+            while self._seq_recv not in self._cache:
+                seq, batch, err = self._get_result()
+                self._cache[seq] = (batch, err)
+            batch, err = self._cache.pop(self._seq_recv)
+            wid = self._seq_wid.pop(self._seq_recv)
+            self._seq_recv += 1
+            self._outstanding -= 1
+            if err is not None:
+                self._stop()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            if isinstance(batch, _WorkerEnd):
+                # this worker's stream is exhausted: stop feeding it but keep
+                # the remaining workers' pipelines full
+                if wid in self._alive:
+                    self._alive.remove(wid)
+                self._dispatch()
+                continue
+            self._dispatch()
+            return self._loader._postprocess(batch)
+
+    def _stop(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for iq in self._index_queues:
+            try:
+                iq.put(None)
+            except Exception:  # noqa: BLE001
+                pass
+        for w in self._workers:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+
+    def __del__(self):
+        self._stop()
+
+
+class _SingleProcessIter:
+    """dataloader_iter.py:150 analog."""
+
+    def __init__(self, loader, batches):
+        self._loader = loader
+        self._batches = iter(batches)
+        self._dataset = loader.dataset
+        self._collate = loader.collate_fn or default_collate_fn
+        self._iterable = isinstance(loader.dataset, IterableDataset)
+        if self._iterable:
+            self._stream = iter(self._dataset)
+
+    def __next__(self):
+        indices = next(self._batches)
+        if self._iterable:
+            samples = list(itertools.islice(self._stream, len(indices)))
+            if not samples or (self._loader.drop_last and
+                               len(samples) < len(indices)):
+                raise StopIteration
+        else:
+            samples = [self._dataset[i] for i in indices]
+        return self._loader._postprocess(self._collate(samples))
+
+
+class _InfiniteCounter:
+    """Index stream for IterableDataset (indices are just batch sizes)."""
+
+    def __init__(self, batch_size):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        while True:
+            yield list(range(self.batch_size))
+
+
+class DataLoader:
+    """paddle.io.DataLoader analog."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = int(prefetch_factor)
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.return_list = return_list
+        self.return_numpy = False
+
+        self.drop_last = bool(drop_last)
+        if isinstance(dataset, IterableDataset):
+            if batch_sampler is not None or shuffle:
+                raise ValueError("IterableDataset does not support "
+                                 "batch_sampler or shuffle")
+            self.batch_sampler = None
+            self.batch_size = batch_size
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            if batch_size is None:
+                raise ValueError("batch_size should be given")
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+            self.batch_size = batch_size
+
+    def _batches(self):
+        if self.batch_sampler is None:
+            return _InfiniteCounter(self.batch_size)
+        return self.batch_sampler
+
+    def _postprocess(self, batch):
+        if self.return_numpy:
+            return batch
+        out = to_tensor_tree(batch)
+        return out
+
+    def __iter__(self):
+        batches = self._batches()
+        if self.num_workers == 0:
+            it = _SingleProcessIter(self, batches)
+        else:
+            it = _MultiprocessIter(self, batches)
+
+        class _Iter:
+            def __iter__(self_i):
+                return self_i
+
+            def __next__(self_i):
+                return next(it)
+
+        return _Iter()
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)

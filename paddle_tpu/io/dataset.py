@@ -1,0 +1,170 @@
+"""Datasets.
+
+Reference: python/paddle/io/dataset.py + io/dataloader/dataset.py — Dataset,
+IterableDataset, TensorDataset, ComposeDataset, ChainDataset, Subset,
+random_split, ConcatDataset.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+
+class Dataset:
+    """Map-style dataset (io/dataloader/dataset.py Dataset analog)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError(
+            "'{}' not implement in class {}".format(
+                "__getitem__", self.__class__.__name__))
+
+    def __len__(self):
+        raise NotImplementedError(
+            "'{}' not implement in class {}".format(
+                "__len__", self.__class__.__name__))
+
+
+class IterableDataset(Dataset):
+    """Stream-style dataset (IterableDataset analog)."""
+
+    def __iter__(self):
+        raise NotImplementedError(
+            "'{}' not implement in class {}".format(
+                "__iter__", self.__class__.__name__))
+
+    def __getitem__(self, idx):
+        raise RuntimeError("'__getitem__' not available for IterableDataset")
+
+    def __len__(self):
+        raise RuntimeError("'__len__' not available for IterableDataset")
+
+
+class TensorDataset(Dataset):
+    """Wrap a list of tensors; sample i = tuple of tensor[i] slices.
+
+    Samples are materialized to host numpy once at construction so that
+    multiprocess workers (fork start method) never touch jax — forked
+    children deadlock on JAX's internal threads. The main process still
+    receives Tensor samples for API parity; workers get numpy (which the
+    default collate produces Tensors from anyway)."""
+
+    def __init__(self, tensors):
+        from ..core.tensor import Tensor
+        self.tensors = [t if isinstance(t, Tensor) else Tensor(t)
+                        for t in tensors]
+        n = self.tensors[0].shape[0]
+        for t in self.tensors:
+            if t.shape[0] != n:
+                raise ValueError("all tensors must share dim 0")
+        self._np = [np.asarray(t.numpy()) for t in self.tensors]
+
+    def __getitem__(self, idx):
+        from .dataloader import get_worker_info
+        rows = tuple(a[idx] for a in self._np)
+        if get_worker_info() is not None:
+            return rows  # numpy inside workers: fork-safe
+        from ..core.tensor import Tensor
+        return tuple(Tensor(r) for r in rows)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    """Zip datasets: sample i = flattened fields of every dataset's sample i."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("datasets should not be empty")
+        n = len(self.datasets[0])
+        for d in self.datasets:
+            if isinstance(d, IterableDataset):
+                raise TypeError("ComposeDataset does not support "
+                                "IterableDataset")
+            if len(d) != n:
+                raise ValueError("lengths of datasets should be same")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        sample = []
+        for d in self.datasets:
+            s = d[idx]
+            sample.extend(s if isinstance(s, (list, tuple)) else [s])
+        return tuple(sample)
+
+
+class ChainDataset(IterableDataset):
+    """Concatenate stream datasets."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        for d in self.datasets:
+            if not isinstance(d, IterableDataset):
+                raise TypeError("ChainDataset only supports IterableDataset")
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    """Concatenate map datasets (io/dataloader/dataset.py ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("datasets should not be empty")
+        for d in self.datasets:
+            if isinstance(d, IterableDataset):
+                raise TypeError("ConcatDataset does not support "
+                                "IterableDataset")
+        self.cumulative_sizes = np.cumsum(
+            [len(d) for d in self.datasets]).tolist()
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        ds_idx = int(np.searchsorted(self.cumulative_sizes, idx, side="right"))
+        prev = 0 if ds_idx == 0 else self.cumulative_sizes[ds_idx - 1]
+        return self.datasets[ds_idx][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices: Sequence[int]):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None) -> List[Subset]:
+    """io/dataset.py random_split analog. lengths: sizes or fractions."""
+    n = len(dataset)
+    if all(0.0 < l < 1.0 for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6:
+        sizes = [int(np.floor(n * l)) for l in lengths]
+        for i in range(n - sum(sizes)):
+            sizes[i % len(sizes)] += 1
+        lengths = sizes
+    if sum(lengths) != n:
+        raise ValueError("Sum of input lengths does not equal the length of "
+                         "the input dataset!")
+    from ..core import random as random_mod
+    rng = np.random.RandomState(random_mod.default_generator().initial_seed()
+                                % (2 ** 31))
+    perm = rng.permutation(n).tolist()
+    out, offset = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset:offset + l]))
+        offset += l
+    return out

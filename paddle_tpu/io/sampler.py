@@ -1,0 +1,180 @@
+"""Samplers.
+
+Reference: python/paddle/io/dataloader/sampler.py — Sampler, SequenceSampler,
+RandomSampler, WeightedRandomSampler; batch_sampler.py — BatchSampler,
+DistributedBatchSampler.
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def _rng(self):
+        if self.generator is not None:
+            # reference accepts a generator callable yielding indices
+            return None
+        from ..core import random as random_mod
+        key = random_mod.default_generator().next_key()
+        return np.random.RandomState(int(np.asarray(key)[-1]) % (2 ** 31))
+
+    def __iter__(self):
+        if self.generator is not None:
+            for _ in range(self.num_samples):
+                try:
+                    yield next(self.generator)
+                except StopIteration:
+                    return
+            return
+        rng = self._rng()
+        n = len(self.data_source)
+        if self.replacement:
+            yield from rng.randint(0, n, self.num_samples).tolist()
+        else:
+            yield from rng.permutation(n)[:self.num_samples].tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        super().__init__()
+        self.weights = np.asarray(weights, dtype=np.float64)
+        if self.weights.ndim != 1:
+            raise ValueError("weights should be a 1-d sequence")
+        self.num_samples = num_samples
+        self.replacement = replacement
+        if not replacement and num_samples > len(self.weights):
+            raise ValueError("num_samples should not be greater than the "
+                             "number of weights when replacement is False")
+
+    def __iter__(self):
+        from ..core import random as random_mod
+        key = random_mod.default_generator().next_key()
+        rng = np.random.RandomState(int(np.asarray(key)[-1]) % (2 ** 31))
+        p = self.weights / self.weights.sum()
+        idx = rng.choice(len(self.weights), size=self.num_samples,
+                         replace=self.replacement, p=p)
+        yield from idx.tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+def _chunk_indices(indices, batch_size, drop_last):
+    """Shared batching loop for all batch samplers."""
+    batch = []
+    for idx in indices:
+        batch.append(idx)
+        if len(batch) == batch_size:
+            yield batch
+            batch = []
+    if batch and not drop_last:
+        yield batch
+
+
+class BatchSampler(Sampler):
+    """io/dataloader/batch_sampler.py BatchSampler analog."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        super().__init__()
+        if sampler is None:
+            sampler = RandomSampler(dataset) if shuffle \
+                else SequenceSampler(dataset)
+        elif dataset is not None:
+            raise ValueError("dataset should not be set when sampler is given")
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = bool(drop_last)
+        self.shuffle = shuffle
+
+    def __iter__(self):
+        return _chunk_indices(self.sampler, self.batch_size, self.drop_last)
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """io/dataloader/batch_sampler.py DistributedBatchSampler analog: each
+    rank samples its 1/nranks slice; set_epoch reseeds the shuffle.
+
+    Single-controller note: with a global mesh the DataLoader usually feeds
+    the full global batch and shards it over dp; this sampler serves the
+    per-process (multi-host DCN) case where every host loads its own slice.
+    """
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.drop_last = bool(drop_last)
+        self.shuffle = bool(shuffle)
+        if num_replicas is None:
+            import jax
+            num_replicas = jax.process_count()
+        if rank is None:
+            import jax
+            rank = jax.process_index()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = list(range(n))
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(indices)
+        # pad to make evenly divisible, then take this rank's slice
+        indices += indices[:(self.total_size - n)]
+        indices = indices[self.local_rank:self.total_size:self.nranks]
+        yield from _chunk_indices(indices, self.batch_size, self.drop_last)
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch

@@ -1,0 +1,199 @@
+"""paddle.io DataLoader stack tests.
+
+Reference coverage model: test/legacy_test/test_dataloader_*.py,
+test_batch_sampler.py, test_dataset*.py (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, ChainDataset, ComposeDataset,
+                           ConcatDataset, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, SequenceSampler, Subset,
+                           TensorDataset, WeightedRandomSampler,
+                           default_collate_fn, get_worker_info, random_split)
+
+
+class SquareDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.array([i], dtype=np.float32), np.array(i * i,
+                                                         dtype=np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class CountStream(IterableDataset):
+    def __init__(self, n=17):
+        self.n = n
+
+    def __iter__(self):
+        info = get_worker_info()
+        lo, hi = 0, self.n
+        if info is not None and info.num_workers > 1:
+            per = (self.n + info.num_workers - 1) // info.num_workers
+            lo, hi = info.id * per, min((info.id + 1) * per, self.n)
+        for i in range(lo, hi):
+            yield np.array([i], dtype=np.float32)
+
+
+def test_tensor_dataset_and_subset():
+    xs = paddle.to_tensor(np.arange(12, dtype="float32").reshape(6, 2))
+    ys = paddle.to_tensor(np.arange(6))
+    ds = TensorDataset([xs, ys])
+    assert len(ds) == 6
+    x0, y0 = ds[2]
+    assert float(y0) == 2
+    sub = Subset(ds, [0, 5])
+    assert len(sub) == 2 and float(sub[1][1]) == 5
+
+
+def test_compose_chain_concat():
+    d1, d2 = SquareDataset(4), SquareDataset(4)
+    comp = ComposeDataset([d1, d2])
+    assert len(comp[0]) == 4
+    cat = ConcatDataset([d1, d2])
+    assert len(cat) == 8
+    np.testing.assert_allclose(cat[5][0], d2[1][0])
+    chain = ChainDataset([CountStream(3), CountStream(2)])
+    assert sum(1 for _ in chain) == 5
+
+
+def test_random_split():
+    a, b = random_split(SquareDataset(10), [7, 3])
+    assert len(a) == 7 and len(b) == 3
+    ids = sorted([a.indices[i] for i in range(7)] +
+                 [b.indices[i] for i in range(3)])
+    assert ids == list(range(10))
+
+
+def test_samplers():
+    ds = SquareDataset(10)
+    assert list(SequenceSampler(ds)) == list(range(10))
+    rs = list(RandomSampler(ds))
+    assert sorted(rs) == list(range(10))
+    ws = list(WeightedRandomSampler([0.0, 0.0, 1.0], 5))
+    assert ws == [2] * 5
+
+
+def test_batch_sampler():
+    ds = SquareDataset(10)
+    bs = BatchSampler(ds, batch_size=3, drop_last=False)
+    batches = list(bs)
+    assert len(bs) == 4 and len(batches) == 4
+    assert batches[-1] == [9]
+    bs2 = BatchSampler(ds, batch_size=3, drop_last=True)
+    assert len(list(bs2)) == 3
+
+
+def test_distributed_batch_sampler_partitions():
+    ds = SquareDataset(20)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                    rank=rank)
+        for b in s:
+            seen.extend(b)
+    assert sorted(seen) == list(range(20))
+    # set_epoch changes shuffle order
+    s = DistributedBatchSampler(ds, batch_size=5, num_replicas=1, rank=0,
+                                shuffle=True)
+    s.set_epoch(0)
+    e0 = [i for b in s for i in b]
+    s.set_epoch(1)
+    e1 = [i for b in s for i in b]
+    assert e0 != e1 and sorted(e0) == sorted(e1)
+
+
+def test_dataloader_single_process():
+    loader = DataLoader(SquareDataset(10), batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 1] and y.shape == [4]
+    np.testing.assert_allclose(y.numpy(), [0, 1, 4, 9])
+
+
+def test_dataloader_collate_dict():
+    class DictDs(Dataset):
+        def __getitem__(self, i):
+            return {"x": np.float32(i), "y": np.array([i, i])}
+
+        def __len__(self):
+            return 4
+
+    batch = next(iter(DataLoader(DictDs(), batch_size=4)))
+    assert batch["x"].shape == [4]
+    assert batch["y"].shape == [4, 2]
+
+
+def test_dataloader_multiprocess_ordered():
+    loader = DataLoader(SquareDataset(32), batch_size=4, num_workers=2)
+    got = [b[1].numpy() for b in loader]
+    expect = [np.arange(i, i + 4) ** 2 for i in range(0, 32, 4)]
+    assert len(got) == 8
+    for g, e in zip(got, expect):
+        np.testing.assert_allclose(g, e)
+
+
+def test_dataloader_multiprocess_worker_error():
+    class Bad(Dataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom")
+            return np.float32(i)
+
+        def __len__(self):
+            return 8
+
+    loader = DataLoader(Bad(), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader)
+
+
+def test_dataloader_iterable_dataset():
+    loader = DataLoader(CountStream(10), batch_size=4)
+    batches = list(loader)
+    assert sum(b.shape[0] for b in batches) == 10
+
+
+def test_dataloader_iterable_multiworker():
+    loader = DataLoader(CountStream(16), batch_size=4, num_workers=2)
+    vals = sorted(int(v) for b in loader for v in b.numpy().ravel())
+    assert vals == list(range(16))
+
+
+def test_shard_dataloader():
+    from paddle_tpu.distributed import ProcessMesh, shard_dataloader
+    mesh = ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+    loader = DataLoader(SquareDataset(16), batch_size=8)
+    sharded = shard_dataloader(loader, mesh, shard_dims="dp")
+    x, y = next(iter(sharded))
+    assert len(x._data.sharding.device_set) == 8
+    assert len(sharded) == 2
+
+
+def test_dataloader_iterable_drop_last():
+    loader = DataLoader(CountStream(10), batch_size=4, drop_last=True)
+    batches = list(loader)
+    assert all(b.shape[0] == 4 for b in batches)
+    assert sum(b.shape[0] for b in batches) == 8
+
+
+def test_random_sampler_generator_exhausts_cleanly():
+    got = list(RandomSampler(SquareDataset(10), generator=iter([1, 2]),
+                             num_samples=5))
+    assert got == [1, 2]
+
+
+def test_tensor_dataset_multiworker():
+    xs = paddle.to_tensor(np.arange(16, dtype="float32").reshape(8, 2))
+    ys = paddle.to_tensor(np.arange(8))
+    loader = DataLoader(TensorDataset([xs, ys]), batch_size=4, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[1][1].numpy(), [4, 5, 6, 7])
