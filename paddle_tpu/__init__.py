@@ -35,6 +35,7 @@ from . import io
 from . import jit
 from . import nn
 from . import optimizer
+from . import profiler
 from .distributed.parallel import DataParallel
 from .framework.io import async_save, load, save
 from .nn import functional as _F

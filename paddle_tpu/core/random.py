@@ -12,13 +12,21 @@ import jax
 
 
 class Generator:
+    """Lazy: the PRNG key materializes on first use so that merely importing
+    the framework never initializes the jax backend (device discovery at
+    import time breaks launcher/tooling processes that only need the API)."""
+
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
 
     def manual_seed(self, seed: int):
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None  # stays lazy: seeding must not touch the backend
         return self
 
     def next_key(self):
@@ -26,10 +34,12 @@ class Generator:
         cap = capture.active()
         if cap is not None:
             cap.record_rng()
+        self._ensure()
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
+        self._ensure()
         return self._key
 
     def set_state(self, key):

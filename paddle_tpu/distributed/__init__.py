@@ -50,3 +50,7 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
 
 def get_group(gid=0):
     return init_parallel_env()
+
+
+from . import checkpoint
+from .checkpoint import load_state_dict, save_state_dict

@@ -312,7 +312,7 @@ class ShardDataloader:
                  is_dataset_splitted=False):
         self._loader = dataloader
         if isinstance(meshes, (list, tuple)):
-            if len({id(m) for m in meshes}) > 1:
+            if any(m != meshes[0] for m in meshes[1:]):
                 raise NotImplementedError(
                     "per-input meshes (pipeline-stage dataloaders) are not "
                     "supported yet; pass one mesh")

@@ -1,0 +1,8 @@
+"""Distributed checkpoint (reference: python/paddle/distributed/checkpoint —
+SURVEY.md §2.19): sharded save + overlap-resolving reshard-on-load."""
+from .metadata import (LocalTensorIndex, LocalTensorMetadata, Metadata,
+                       TensorMetadata)
+from .save_load import load_state_dict, save_state_dict
+
+__all__ = ["LocalTensorIndex", "LocalTensorMetadata", "Metadata",
+           "TensorMetadata", "load_state_dict", "save_state_dict"]

@@ -1,0 +1,47 @@
+"""Checkpoint metadata.
+
+Reference: distributed/checkpoint/metadata.py:20-40 — LocalTensorMetadata
+(global_offset + local_shape of one stored chunk), LocalTensorIndex, Metadata
+(per-key chunk lists + storage mapping).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class LocalTensorMetadata:
+    """One stored chunk of a tensor (metadata.py LocalTensorMetadata)."""
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass
+class LocalTensorIndex:
+    """Where a chunk lives (metadata.py LocalTensorIndex)."""
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class TensorMetadata:
+    global_shape: Tuple[int, ...]
+    dtype: str
+    chunks: List[LocalTensorMetadata] = field(default_factory=list)
+
+
+@dataclass
+class Metadata:
+    """metadata.py Metadata analog: state-dict layout + chunk -> file map."""
+    state_dict_metadata: Dict[str, TensorMetadata] = field(
+        default_factory=dict)
+    storage_metadata: Dict[str, str] = field(default_factory=dict)
+    # non-tensor entries (python scalars, nested dict scaffolding)
+    extra_state: Dict[str, object] = field(default_factory=dict)
+    flat_mapping: Dict[str, object] = field(default_factory=dict)
+
+    @staticmethod
+    def chunk_id(key: str, global_offset) -> str:
+        return f"{key}@{'_'.join(map(str, global_offset))}"

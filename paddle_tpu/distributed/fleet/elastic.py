@@ -1,0 +1,170 @@
+"""Elastic training manager.
+
+Reference: fleet/elastic/ — enable_elastic (__init__.py:30), launch_elastic
+(:51), ElasticManager (manager.py:126): registers ranks in etcd
+(manager.py:192-197), watches membership, decides scale-in/out, restarts
+trainers through the CollectiveLauncher; fault-level (restart in place) vs
+elastic-level (re-form at a new world size).
+
+TPU-native: membership lives in the launcher's rank-0 HTTP KV (the etcd
+analog, launch/controllers.py KVServer) keyed by job id; hosts heartbeat and
+the manager re-forms the jax.distributed world when membership settles at a
+different size. Scale units are HOSTS — a TPU slice's chip set per host is
+fixed, so elasticity = host set changes over DCN.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..launch.controllers import KVClient, Watcher
+
+ELASTIC_EXIT_CODE = 101  # reference's elastic restart exit code
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """fleet/elastic/manager.py:126 analog."""
+
+    def __init__(self, master_endpoint: str, job_id: str, rank: int,
+                 np: int, min_np: Optional[int] = None,
+                 max_np: Optional[int] = None, heartbeat_ttl: float = 30.0):
+        self.client = KVClient(master_endpoint)
+        self.job_id = job_id
+        self.rank = rank
+        self.np = np
+        self.min_np = min_np or np
+        self.max_np = max_np or np
+        self.ttl = heartbeat_ttl
+        self.enable = True
+        self._prefix = f"elastic/{job_id}"
+
+    # -- membership (manager.py:192-197 register path) ----------------------
+    def register(self, endpoint: str):
+        self.client.put(f"{self._prefix}/nodes/{self.rank}", endpoint)
+        self.heartbeat()
+
+    def deregister(self):
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self.client.endpoint}/{self._prefix}/nodes/{self.rank}",
+            method="DELETE")
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def heartbeat(self):
+        self.client.put(f"{self._prefix}/heartbeat/{self.rank}",
+                        str(time.time()))
+
+    def alive_nodes(self) -> List[int]:
+        now = time.time()
+        alive = []
+        for key, val in self.client.get_all().items():
+            if key.startswith(f"{self._prefix}/heartbeat/"):
+                rank = int(key.rsplit("/", 1)[1])
+                if now - float(val) <= self.ttl:
+                    alive.append(rank)
+        return sorted(alive)
+
+    # -- scale decisions (manager.py watch loop) ----------------------------
+    def need_scale(self) -> bool:
+        return len(self.alive_nodes()) != self.np
+
+    def status(self) -> str:
+        n = len(self.alive_nodes())
+        if n == self.np:
+            return ElasticStatus.HOLD
+        if n < self.min_np:
+            # below quorum: hold for peers to come back (fault level)
+            return ElasticStatus.HOLD
+        if n != self.np and self.min_np <= n <= self.max_np:
+            return ElasticStatus.RESTART  # re-form at the new world size
+        return ElasticStatus.EXIT
+
+    def wait_for_np(self, np: Optional[int] = None,
+                    timeout: float = 120.0) -> bool:
+        """Block until `np` members are alive (manager.py wait path)."""
+        want = np or self.np
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.alive_nodes()) >= want:
+                return True
+            self.heartbeat()
+            time.sleep(0.2)
+        return False
+
+
+def enable_elastic(ctx, distribute_mode=None) -> bool:
+    """fleet/elastic/__init__.py:30 analog: elastic is on when a master KV
+    and a restart budget are configured."""
+    return bool(getattr(ctx, "master", None)) and \
+        int(getattr(ctx, "max_restarts", 0)) > 0
+
+
+def launch_elastic(ctx, manager: Optional[ElasticManager] = None):
+    """fleet/elastic/__init__.py:51 analog: run the trainer pod under the
+    manager — register + heartbeat this host, restart the pod on elastic
+    exits or membership changes (re-forming at the surviving world size),
+    surface plain failures once the restart budget is spent.
+
+    ctx: a launch.main.Context (the launcher builds it)."""
+    import socket
+    import threading
+
+    from ..launch.controllers import CollectiveController
+
+    if manager is None:
+        manager = ElasticManager(ctx.master, ctx.job_id, ctx.node_rank,
+                                 np=ctx.nnodes)
+    manager.register(socket.gethostname())
+
+    stop = threading.Event()
+
+    def beat():
+        while not stop.wait(manager.ttl / 3):
+            try:
+                manager.heartbeat()
+            except Exception:  # noqa: BLE001 — master may be re-forming
+                pass
+
+    beater = threading.Thread(target=beat, daemon=True)
+    beater.start()
+    # THIS loop owns the restart budget: hand the controller a zero-restart
+    # context so elastic exits surface immediately (the controller's own
+    # retry loop would redeploy in place without the membership wait)
+    import copy
+    run_ctx = copy.copy(ctx)
+    run_ctx.max_restarts = 0
+    restarts = 0
+    try:
+        while True:
+            controller = CollectiveController(run_ctx).build_pod()
+            code = controller.run()
+            if code == 0:
+                return 0
+            elastic_exit = (code == ELASTIC_EXIT_CODE or manager.need_scale())
+            if not elastic_exit or restarts >= ctx.max_restarts:
+                return code
+            restarts += 1
+            manager.wait_for_np(manager.min_np)
+            alive = manager.alive_nodes()
+            if manager.rank not in alive:
+                alive = sorted(alive + [manager.rank])
+            # re-form at the surviving world size: compact ranks and update
+            # the envs the next pod will receive
+            manager.np = len(alive)
+            run_ctx.nnodes = len(alive)
+            run_ctx.node_rank = alive.index(manager.rank)
+            run_ctx.world_size = run_ctx.nnodes * run_ctx.nproc_per_node
+    finally:
+        stop.set()
+        try:
+            manager.deregister()
+        except Exception:  # noqa: BLE001
+            pass

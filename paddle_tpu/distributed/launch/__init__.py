@@ -1,0 +1,7 @@
+"""Launcher (reference: python/paddle/distributed/launch — SURVEY.md §2.12)."""
+from .controllers import (CollectiveController, KVClient, KVServer, Watcher)
+from .job import Container, Job, Pod
+from .main import launch
+
+__all__ = ["CollectiveController", "KVClient", "KVServer", "Watcher",
+           "Container", "Job", "Pod", "launch"]

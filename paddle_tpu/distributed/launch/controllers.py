@@ -1,0 +1,213 @@
+"""Launch controllers + rank-0 master KV.
+
+Reference: distributed/launch/controllers/ — CollectiveController (spawns the
+per-rank procs with PADDLE_* envs, watches them, restarts on failure up to a
+limit), master.py HTTPMaster:73 (rank-0 key-value server for peer discovery)
+/ ETCDMaster:186, watcher.py (peer failure detection),
+CollectiveElasticController:254.
+
+TPU-native notes: a TPU "rank" is a HOST (jax process), not a chip — one
+process per host drives all its local chips, and JAX's own coordination
+service (coordinator_address) plays the role the TCPStore plays in the
+reference. The master KV here serves the launcher-level discovery/elastic
+protocol over DCN, exactly like HTTPMaster.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from .job import Container, Job, Pod
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class KVServer:
+    """HTTPMaster's KV store (launch/controllers/master.py:73 analog):
+    PUT /kv/<key>, GET /kv/<key>, GET /kv  — rank-0 hosts it, peers register
+    their endpoints under a job-scoped prefix."""
+
+    def __init__(self, port: Optional[int] = None):
+        self.port = port or _free_port()
+        self._kv: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        kv = self._kv
+        lock = self._lock
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                val = self.rfile.read(n).decode()
+                with lock:
+                    kv[self.path.lstrip("/")] = val
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):
+                key = self.path.lstrip("/")
+                with lock:
+                    if key == "":
+                        body = json.dumps(kv).encode()
+                    elif key in kv:
+                        body = kv[key].encode()
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_DELETE(self):
+                with lock:
+                    kv.pop(self.path.lstrip("/"), None)
+                self.send_response(200)
+                self.end_headers()
+
+        self._server = http.server.ThreadingHTTPServer(("", self.port),
+                                                       Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self.port}"
+
+
+class KVClient:
+    """Peer side of the master KV."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint.rstrip("/")
+
+    def put(self, key: str, value: str):
+        req = urllib.request.Request(f"{self.endpoint}/{key}",
+                                     data=value.encode(), method="PUT")
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with urllib.request.urlopen(f"{self.endpoint}/{key}",
+                                        timeout=5) as r:
+                return r.read().decode()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def get_all(self) -> Dict[str, str]:
+        with urllib.request.urlopen(self.endpoint + "/", timeout=5) as r:
+            return json.loads(r.read().decode())
+
+    def wait(self, key: str, timeout: float = 60.0,
+             interval: float = 0.5) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        raise TimeoutError(f"KV key {key!r} not published within {timeout}s")
+
+
+class Watcher:
+    """launch/controllers/watcher.py analog: poll peer heartbeats in the KV
+    and report missing peers."""
+
+    def __init__(self, client: KVClient, my_rank: int, nnodes: int,
+                 ttl: float = 30.0):
+        self.client = client
+        self.rank = my_rank
+        self.nnodes = nnodes
+        self.ttl = ttl
+
+    def heartbeat(self):
+        self.client.put(f"heartbeat/{self.rank}", str(time.time()))
+
+    def dead_peers(self) -> List[int]:
+        now = time.time()
+        dead = []
+        for r in range(self.nnodes):
+            v = self.client.get(f"heartbeat/{r}")
+            if v is None or now - float(v) > self.ttl:
+                dead.append(r)
+        return dead
+
+
+class CollectiveController:
+    """launch/controllers/collective.py analog: build the pod, deploy,
+    watch, restart up to max_restarts (the reference's replicas/elastic
+    levels)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.pod = Pod()
+
+    def build_pod(self):
+        ctx = self.ctx
+        n = ctx.nproc_per_node
+        for local_rank in range(n):
+            rank = ctx.node_rank * n + local_rank
+            env = {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_TRAINERS_NUM": str(ctx.world_size),
+                "PADDLE_RANK_IN_NODE": str(local_rank),
+                "PADDLE_MASTER": ctx.master or "",
+                "PADDLE_JOB_ID": ctx.job_id,
+                # jax multi-host coordination (the TCPStore analog)
+                "JAX_COORDINATOR_ADDRESS": ctx.coordinator or "",
+                "JAX_PROCESS_ID": str(rank),
+                "JAX_NUM_PROCESSES": str(ctx.world_size),
+            }
+            log_path = os.path.join(ctx.log_dir,
+                                    f"workerlog.{rank}") if ctx.log_dir \
+                else None
+            self.pod.add_container(Container(
+                entrypoint=[sys.executable] + ctx.training_script_args,
+                env=env, log_path=log_path, rank=rank))
+        return self
+
+    def run(self) -> int:
+        ctx = self.ctx
+        restarts = 0
+        while True:
+            self.pod.deploy()
+            code = self.pod.join()
+            if code == 0:
+                return 0
+            restarts += 1
+            if restarts > ctx.max_restarts:
+                if ctx.log_dir:
+                    for c in self.pod.failed_containers():
+                        sys.stderr.write(
+                            f"---- rank {c.rank} (exit {c.exit_code}) "
+                            f"last log ----\n{c.logs()}\n")
+                return code
+            sys.stderr.write(f"restarting pod (attempt {restarts}/"
+                             f"{ctx.max_restarts}) after exit {code}\n")
+            self.pod = Pod()
+            self.build_pod()

@@ -1,0 +1,134 @@
+"""Job / Pod / Container model.
+
+Reference: distributed/launch/job/ — a Job is the whole distributed run, a
+Pod is one node's set of processes, a Container wraps one spawned process
+with env + log capture (launch/job/{job,pod,container}.py).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class Container:
+    """launch/job/container.py analog: one process + env + log file."""
+
+    def __init__(self, entrypoint: List[str], env: Dict[str, str],
+                 log_path: Optional[str] = None, rank: int = -1):
+        self.entrypoint = entrypoint
+        self.env = env
+        self.log_path = log_path
+        self.rank = rank
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_file = None
+
+    def start(self):
+        full_env = dict(os.environ)
+        full_env.update(self.env)
+        out = sys.stdout
+        if self.log_path:
+            log_dir = os.path.dirname(self.log_path)
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+            self._log_file = open(self.log_path, "w")
+            out = self._log_file
+        self.proc = subprocess.Popen(self.entrypoint, env=full_env,
+                                     stdout=out, stderr=subprocess.STDOUT)
+        return self
+
+    @property
+    def exit_code(self) -> Optional[int]:
+        return None if self.proc is None else self.proc.poll()
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def terminate(self, timeout: float = 10.0):
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self._log_file:
+            self._log_file.close()
+            self._log_file = None
+
+    def wait(self, timeout=None) -> Optional[int]:
+        if self.proc is None:
+            return None
+        try:
+            code = self.proc.wait(timeout=timeout)
+            if self._log_file:
+                self._log_file.close()
+                self._log_file = None
+            return code
+        except subprocess.TimeoutExpired:
+            return None
+
+    def logs(self, tail: int = 200) -> str:
+        if not self.log_path or not os.path.exists(self.log_path):
+            return ""
+        with open(self.log_path, "r", errors="replace") as f:
+            return "".join(f.readlines()[-tail:])
+
+
+class Pod:
+    """launch/job/pod.py analog: the containers of one node."""
+
+    def __init__(self, name: str = "pod"):
+        self.name = name
+        self.containers: List[Container] = []
+        self.restarts = 0
+
+    def add_container(self, container: Container):
+        self.containers.append(container)
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def is_running(self) -> bool:
+        return any(c.alive() for c in self.containers)
+
+    def failed_containers(self) -> List[Container]:
+        return [c for c in self.containers
+                if c.exit_code is not None and c.exit_code != 0]
+
+    def exit_codes(self) -> List[Optional[int]]:
+        return [c.exit_code for c in self.containers]
+
+    def join(self, poll_interval: float = 1.0) -> int:
+        """Wait for all containers; on any failure stop the rest and return
+        the first non-zero code (controllers/collective.py watch loop)."""
+        while True:
+            failed = self.failed_containers()
+            if failed:
+                self.stop()
+                return failed[0].exit_code
+            if not self.is_running():
+                for c in self.containers:  # reap + close log handles
+                    c.wait(timeout=5)
+                return 0
+            time.sleep(poll_interval)
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+
+
+class Job:
+    """launch/job/job.py analog."""
+
+    def __init__(self, jid: str = "default", mode: str = "collective",
+                 nnodes: int = 1):
+        self.id = jid
+        self.mode = mode
+        self.nnodes = nnodes
+        self.pods: List[Pod] = []

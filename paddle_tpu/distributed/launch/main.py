@@ -1,0 +1,88 @@
+"""python -m paddle_tpu.distributed.launch — the launcher CLI.
+
+Reference: distributed/launch/main.py:20 launch() — parse env/args into a
+context, pick a controller by mode, spawn per-rank processes with PADDLE_*
+envs and per-rank logs.
+
+TPU-native: one process per HOST (each drives its local chips through one
+jax runtime); --nproc_per_node therefore defaults to 1, and multi-host jobs
+pass --master (rank-0 KV) + --nnodes, with JAX coordination envs set for
+jax.distributed.initialize inside the trainer.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .controllers import CollectiveController, KVServer
+
+
+class Context:
+    def __init__(self, args, script_args):
+        self.nnodes = int(args.nnodes)
+        self.nproc_per_node = int(args.nproc_per_node)
+        self.node_rank = int(args.node_rank)
+        self.world_size = self.nnodes * self.nproc_per_node
+        self.master = args.master
+        self.coordinator = args.master
+        self.job_id = args.job_id
+        self.log_dir = args.log_dir
+        self.max_restarts = int(args.max_restarts)
+        self.training_script_args = script_args
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="distributed launcher (launch/main.py:20 analog)")
+    p.add_argument("--nnodes", default=os.environ.get("PADDLE_NNODES", "1"))
+    p.add_argument("--nproc_per_node",
+                   default=os.environ.get("PADDLE_NPROC_PER_NODE", "1"),
+                   help="processes per host (1 per host drives all chips)")
+    p.add_argument("--node_rank",
+                   default=os.environ.get("PADDLE_NODE_RANK", "0"))
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="rank-0 KV endpoint host:port (multi-host)")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--max_restarts", default="0",
+                   help="restart budget on failure (elastic fault level)")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective"])
+    p.add_argument("script", help="training script")
+    args, script_args = p.parse_known_args(argv)
+    return args, [args.script] + script_args
+
+
+def launch(argv=None) -> int:
+    args, script_args = _parse(argv if argv is not None else sys.argv[1:])
+    ctx = Context(args, script_args)
+    server = None
+    if ctx.nnodes > 1:
+        if not ctx.master:
+            raise SystemExit(
+                "--master host:port is required for multi-node jobs "
+                "(rank 0 binds it; peers connect to it)")
+        host, _, port = ctx.master.replace("http://", "").rpartition(":")
+        if ctx.node_rank == 0:
+            # rank 0 BINDS the advertised master port (HTTPMaster:73)
+            server = KVServer(port=int(port)).start()
+        ctx.master = f"http://{host}:{port}"
+        # jax.distributed's gRPC coordination service needs its own bare
+        # host:port, one above the KV port by convention (rank-0 trainer
+        # binds it at initialize())
+        ctx.coordinator = f"{host}:{int(port) + 1}"
+    try:
+        from ..fleet.elastic import enable_elastic, launch_elastic
+        if enable_elastic(ctx):
+            return launch_elastic(ctx)
+        controller = CollectiveController(ctx).build_pod()
+        return controller.run()
+    finally:
+        if server is not None:
+            server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

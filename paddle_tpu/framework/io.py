@@ -69,10 +69,7 @@ def async_save(obj: Any, path: str, protocol: int = 4, sync_other_task=False,
         with open(path, "wb") as f:
             pickle.dump(snapshot, f, protocol=protocol)
 
-    t = threading.Thread(target=_write, daemon=True)
-    t.start()
-    _ASYNC_THREADS.append(t)
-    return t
+    return _submit_async_save(_write)
 
 
 def wait_async_saves():
@@ -84,3 +81,12 @@ def load(path: str, return_numpy: bool = False, **configs):
     with open(path, "rb") as f:
         obj = pickle.load(f)
     return _from_storable(obj, return_numpy)
+
+
+def _submit_async_save(write_fn):
+    """Run a prepared writer on the async-save thread pool (shared with
+    async_save; wait with wait_async_saves)."""
+    t = threading.Thread(target=write_fn, daemon=True)
+    t.start()
+    _ASYNC_THREADS.append(t)
+    return t

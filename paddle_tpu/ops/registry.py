@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..autograd import engine
+from ..profiler import _ACTIVE as _PROF_ACTIVE  # module-level list; mutated
+                                                # in place by the profiler
 from ..autograd.engine import GradNode
 from ..core import capture
 from ..core.tensor import Tensor
@@ -53,7 +55,18 @@ def _wrap_out_leaf(leaf, stop_gradient):
 def dispatch(fn: Callable, args, kwargs, op_name: str,
              differentiable: bool = True):
     """Run one op with unwrap/AMP/autograd-record. The single hot path
-    (reference: steps 2-4 of SURVEY.md §3.2)."""
+    (reference: steps 2-4 of SURVEY.md §3.2). Profiler instrumentation
+    mirrors the reference's per-ad_func RecordEvent (eager_gen.py:251):
+    one list check when idle, a host span per op while recording."""
+    if _PROF_ACTIVE:
+        from ..profiler import RecordEvent
+        with RecordEvent(op_name, event_type="Operator"):
+            return _dispatch_impl(fn, args, kwargs, op_name, differentiable)
+    return _dispatch_impl(fn, args, kwargs, op_name, differentiable)
+
+
+def _dispatch_impl(fn: Callable, args, kwargs, op_name: str,
+                   differentiable: bool = True):
     from ..amp import autocast_args  # late import; amp layers on ops
     args, kwargs = autocast_args(op_name, args, kwargs)
 

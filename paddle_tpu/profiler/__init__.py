@@ -1,0 +1,253 @@
+"""Profiler.
+
+Reference: python/paddle/profiler/profiler.py — Profiler:346 with scheduler
+(make_scheduler:117), chrome-trace export (export_chrome_tracing:215), over
+C++ platform/profiler (HostTracer RecordEvent instrumentation, CUPTI device
+tracer, event tree + statistics, chrometracing_logger.cc).
+
+TPU-native redesign: the host tier is a lightweight in-process event recorder
+(RecordEvent spans + the op-dispatch hook), and the device tier is JAX/XLA's
+own profiler (xplane traces viewable in TensorBoard/Perfetto) started and
+stopped by the same scheduler — CUPTI's role belongs to the TPU runtime.
+Chrome-trace export and the summary table keep the reference's UX.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+from .statistics import SummaryView, build_summary
+
+_ACTIVE = []  # active Profiler instances (the op-dispatch hook reads this)
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1      # accepted for API parity; no-op on this stack
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int],
+                                                                     ProfilerState]:
+    """profiler.py make_scheduler:117 analog: step -> ProfilerState."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """profiler.py export_chrome_tracing:215 analog: on_trace_ready handler
+    writing <dir>/<worker>_<time>.json."""
+
+    counter = [0]
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        counter[0] += 1
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time())}_"
+                      f"{counter[0]}.paddle_trace.json")
+        prof.export(path)
+
+    return handler
+
+
+class HostEvent:
+    __slots__ = ("name", "start_ns", "end_ns", "tid", "event_type")
+
+    def __init__(self, name, start_ns, end_ns, tid, event_type="UserDefined"):
+        self.name = name
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.tid = tid
+        self.event_type = event_type
+
+
+class RecordEvent:
+    """paddle.profiler.RecordEvent analog (host span; no-op when no profiler
+    is recording)."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._start = None
+
+    def begin(self):
+        if _ACTIVE:
+            self._start = time.perf_counter_ns()
+
+    def end(self):
+        if self._start is None:
+            return
+        end = time.perf_counter_ns()
+        ev = HostEvent(self.name, self._start, end,
+                       threading.get_ident(), self.event_type)
+        for prof in _ACTIVE:
+            prof._events.append(ev)
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """profiler.py Profiler:346 analog."""
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        if scheduler is None:
+            self._scheduler = _default_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0,
+                                             record=hi - lo, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._events = []
+        self._device_tracing = False
+        self._device_trace_dir = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_record()
+        return self
+
+    def stop(self):
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        prev = self.current_state
+        self.step_num += 1
+        new = self._scheduler(self.step_num)
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if prev not in recording and new in recording:
+            self._start_record()
+        elif prev in recording and new not in recording:
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        elif prev == ProfilerState.RECORD_AND_RETURN and new in recording:
+            # window boundary: flush and keep going
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+            self._start_record()
+        self.current_state = new
+
+    def _start_record(self):
+        self._events = []  # fresh window: exports/summary cover ONE window
+        if self not in _ACTIVE:
+            _ACTIVE.append(self)
+        if ProfilerTarget.TPU in self.targets and not self.timer_only:
+            import tempfile
+
+            import jax
+            self._device_trace_dir = tempfile.mkdtemp(prefix="xplane_")
+            try:
+                jax.profiler.start_trace(self._device_trace_dir)
+                self._device_tracing = True
+            except Exception:  # noqa: BLE001 — device tracing is best-effort
+                self._device_tracing = False
+
+    def _stop_record(self):
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
+        if self._device_tracing:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._device_tracing = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- output -------------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        """Chrome-trace JSON of the host events (chrometracing_logger.cc
+        analog); the device xplane lives under the trace dir for TensorBoard."""
+        events = []
+        for ev in self._events:
+            events.append({
+                "name": ev.name,
+                "ph": "X",
+                "ts": ev.start_ns / 1e3,
+                "dur": (ev.end_ns - ev.start_ns) / 1e3,
+                "pid": os.getpid(),
+                "tid": ev.tid,
+                "cat": ev.event_type,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "devicePlaneDir": self._device_trace_dir}, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms") -> str:
+        view = build_summary(self._events, time_unit=time_unit)
+        return view.render()
+
+    @property
+    def events(self):
+        return list(self._events)
+
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "SummaryView"]

@@ -1,0 +1,66 @@
+"""Profiler statistics.
+
+Reference: python/paddle/profiler/profiler_statistic.py — aggregates the
+event tree into per-name tables (calls, total/avg/max/min, ratio).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+_UNIT_DIV = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+
+
+class _Row:
+    __slots__ = ("name", "calls", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self, name):
+        self.name = name
+        self.calls = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = None
+
+    def add(self, dur_ns):
+        self.calls += 1
+        self.total_ns += dur_ns
+        self.max_ns = max(self.max_ns, dur_ns)
+        self.min_ns = dur_ns if self.min_ns is None else min(self.min_ns,
+                                                             dur_ns)
+
+
+class SummaryView:
+    def __init__(self, rows: List[_Row], wall_ns: int, time_unit: str):
+        self.rows = rows
+        self.wall_ns = max(wall_ns, 1)
+        self.time_unit = time_unit
+
+    def render(self) -> str:
+        div = _UNIT_DIV[self.time_unit]
+        header = (f"{'Name':<40} {'Calls':>7} {'Total(' + self.time_unit + ')':>12} "
+                  f"{'Avg':>10} {'Max':>10} {'Min':>10} {'Ratio(%)':>9}")
+        lines = [header, "-" * len(header)]
+        for r in sorted(self.rows, key=lambda r: -r.total_ns):
+            lines.append(
+                f"{r.name[:40]:<40} {r.calls:>7} {r.total_ns / div:>12.4f} "
+                f"{r.total_ns / r.calls / div:>10.4f} {r.max_ns / div:>10.4f} "
+                f"{(r.min_ns or 0) / div:>10.4f} "
+                f"{100.0 * r.total_ns / self.wall_ns:>9.2f}")
+        return "\n".join(lines)
+
+    def row(self, name):
+        for r in self.rows:
+            if r.name == name:
+                return r
+        return None
+
+
+def build_summary(events, time_unit="ms") -> SummaryView:
+    rows: Dict[str, _Row] = {}
+    lo, hi = None, 0
+    for ev in events:
+        rows.setdefault(ev.name, _Row(ev.name)).add(ev.end_ns - ev.start_ns)
+        lo = ev.start_ns if lo is None else min(lo, ev.start_ns)
+        hi = max(hi, ev.end_ns)
+    wall = (hi - lo) if lo is not None else 0
+    return SummaryView(list(rows.values()), wall, time_unit)

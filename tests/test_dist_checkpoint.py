@@ -1,0 +1,114 @@
+"""Distributed checkpoint tests: sharded save + reshard-on-load.
+
+Reference coverage model: test/auto_parallel reshard/converter tests and
+distributed/checkpoint unit tests (SURVEY.md §2.19, §4) on the 8-device CPU
+mesh.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import (ProcessMesh, Replicate, Shard,
+                                    load_state_dict, save_state_dict,
+                                    shard_tensor)
+from paddle_tpu.distributed.checkpoint import Metadata
+
+
+def _mesh(shape, names):
+    return ProcessMesh(np.arange(int(np.prod(shape))).reshape(shape), names)
+
+
+def test_save_load_replicated(tmp_path):
+    w = paddle.to_tensor(np.arange(24, dtype="float32").reshape(4, 6))
+    sd = {"w": w}
+    save_state_dict(sd, str(tmp_path))
+    w2 = paddle.zeros([4, 6])
+    target = {"w": w2}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(target["w"].numpy(), w.numpy())
+
+
+def test_save_sharded_load_differently_sharded(tmp_path):
+    mesh_a = _mesh((8,), ["x"])
+    mesh_b = _mesh((4, 2), ["a", "b"])
+    src = shard_tensor(
+        paddle.to_tensor(np.arange(64, dtype="float32").reshape(8, 8)),
+        mesh_a, [Shard(0)])
+    save_state_dict({"w": src}, str(tmp_path))
+
+    dst = shard_tensor(paddle.zeros([8, 8]), mesh_b, [Shard(1), Shard(0)])
+    target = {"w": dst}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(target["w"].numpy(),
+                               np.arange(64, dtype="float32").reshape(8, 8))
+    # sharding of the target is preserved
+    assert len(target["w"]._data.sharding.device_set) == 8
+
+
+def test_save_sharded_load_replicated_and_back(tmp_path):
+    mesh = _mesh((8,), ["x"])
+    w = shard_tensor(
+        paddle.to_tensor(np.arange(32, dtype="float32").reshape(8, 4)),
+        mesh, [Shard(0)])
+    save_state_dict({"w": w}, str(tmp_path))
+    repl = {"w": paddle.zeros([8, 4])}
+    load_state_dict(repl, str(tmp_path))
+    np.testing.assert_allclose(repl["w"].numpy(), w.numpy())
+
+
+def test_nested_state_dict_and_extra_state(tmp_path):
+    model = nn.Linear(4, 4)
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=model.parameters())
+    model(paddle.randn([2, 4])).sum().backward()
+    opt.step()
+    sd = {"model": model.state_dict(), "opt": opt.state_dict(),
+          "epoch": 7}
+    save_state_dict(sd, str(tmp_path))
+
+    model2 = nn.Linear(4, 4)
+    opt2 = optimizer.AdamW(learning_rate=0.1,
+                           parameters=model2.parameters())
+    model2(paddle.randn([2, 4])).sum().backward()
+    opt2.step()
+    target = {"model": model2.state_dict(), "opt": opt2.state_dict(),
+              "epoch": 0}
+    load_state_dict(target, str(tmp_path))
+    assert target["epoch"] == 7
+    np.testing.assert_allclose(target["model"]["weight"].numpy(),
+                               model.weight.numpy())
+
+
+def test_missing_key_raises(tmp_path):
+    save_state_dict({"w": paddle.ones([2, 2])}, str(tmp_path))
+    with pytest.raises(KeyError):
+        load_state_dict({"v": paddle.zeros([2, 2])}, str(tmp_path))
+
+
+def test_shape_mismatch_raises(tmp_path):
+    save_state_dict({"w": paddle.ones([2, 2])}, str(tmp_path))
+    with pytest.raises(ValueError):
+        load_state_dict({"w": paddle.zeros([4, 2])}, str(tmp_path))
+
+
+def test_async_save(tmp_path):
+    from paddle_tpu.framework.io import wait_async_saves
+    w = paddle.to_tensor(np.ones((4, 4), dtype="float32"))
+    save_state_dict({"w": w}, str(tmp_path), async_save=True)
+    wait_async_saves()
+    target = {"w": paddle.zeros([4, 4])}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(target["w"].numpy(), 1.0)
+
+
+def test_bf16_roundtrip(tmp_path):
+    w = paddle.to_tensor(np.arange(16, dtype="float32").reshape(4, 4)).astype(
+        "bfloat16")
+    save_state_dict({"w": w}, str(tmp_path))
+    target = {"w": paddle.zeros([4, 4]).astype("bfloat16")}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(
+        target["w"].astype("float32").numpy(),
+        np.arange(16, dtype="float32").reshape(4, 4))
