@@ -1,0 +1,997 @@
+// paddle_tpu native runtime tier (C++).
+//
+// TPU-native equivalents of the reference's native runtime components
+// (SURVEY.md §2.1):
+//   - TCPStore        — phi/core/distributed/store/tcp_store.h:121 analog:
+//                       rank-0 TCP key/value server with blocking get/wait,
+//                       atomic add, used for multi-host bootstrap, barriers,
+//                       and elastic membership (control plane over DCN).
+//   - BlockingQueue   — fluid/imperative/data_loader.cc blocking-queue analog:
+//                       bounded producer/consumer queue that releases the GIL
+//                       while waiting (dataloader prefetch, pipeline p2p).
+//   - HostTracer      — platform/profiler/host_tracer.cc analog: nanosecond
+//                       RecordEvent spans with thread ids, drained to Python
+//                       for chrome-trace export.
+//   - Stat registry   — fluid/memory/stats.h DEVICE_MEMORY_STAT analog:
+//                       named current/peak counters.
+//
+// Exposed as flat functions + integer handles; the Python-facing classes live
+// in paddle_tpu/core/native.py. Built with plain g++ (no pybind11 in image).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+static int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// wire helpers (length-prefixed protocol, all little-endian on x86)
+// ---------------------------------------------------------------------------
+
+enum Cmd : uint8_t {
+  kSet = 1,
+  kGet = 2,   // blocking until key exists or client timeout
+  kAdd = 3,   // atomic add, creates key at 0
+  kCheck = 4, // non-blocking existence check
+  kDel = 5,
+  kList = 6,  // list keys with a prefix
+};
+
+enum Status : uint8_t { kOk = 0, kTimeout = 1, kMissing = 2, kError = 3 };
+
+static bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+static bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool send_str(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  return send_all(fd, &len, 4) && (len == 0 || send_all(fd, s.data(), len));
+}
+
+static bool recv_str(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!recv_all(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || recv_all(fd, &(*out)[0], len);
+}
+
+// ---------------------------------------------------------------------------
+// TCPStore server
+// ---------------------------------------------------------------------------
+
+struct StoreServer {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::map<std::string, int64_t> counters;
+
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;  // open client connections (for shutdown wakeup)
+
+  ~StoreServer() { shutdown(); }
+
+  void shutdown() {
+    bool expected = false;
+    if (!stop.compare_exchange_strong(expected, true)) return;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    cv.notify_all();
+    {
+      // wake worker threads blocked in recv() on live client connections —
+      // otherwise join below hangs until every remote client disconnects
+      std::lock_guard<std::mutex> lk(conn_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+  bool start(const std::string& host, int port, std::string* err) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) {
+      *err = "socket() failed";
+      return false;
+    }
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    addr.sin_addr.s_addr =
+        host.empty() ? INADDR_ANY : inet_addr(host.c_str());
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      *err = std::string("bind() failed: ") + strerror(errno);
+      ::close(listen_fd);
+      listen_fd = -1;
+      return false;
+    }
+    if (::listen(listen_fd, 128) < 0) {
+      *err = "listen() failed";
+      ::close(listen_fd);
+      listen_fd = -1;
+      return false;
+    }
+    accept_thread = std::thread([this] { accept_loop(); });
+    return true;
+  }
+
+  void accept_loop() {
+    while (!stop.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) break;
+        continue;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        conn_fds.push_back(fd);
+      }
+      workers.emplace_back([this, fd] { serve(fd); });
+    }
+  }
+
+  void serve(int fd) {
+    while (!stop.load()) {
+      uint8_t cmd = 0;
+      if (!recv_all(fd, &cmd, 1)) break;
+      std::string key;
+      if (!recv_str(fd, &key)) break;
+      switch (cmd) {
+        case kSet: {
+          std::string val;
+          if (!recv_str(fd, &val)) goto done;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            kv[key] = std::move(val);
+          }
+          cv.notify_all();
+          uint8_t st = kOk;
+          if (!send_all(fd, &st, 1)) goto done;
+          break;
+        }
+        case kGet: {
+          int64_t timeout_ms = 0;
+          if (!recv_all(fd, &timeout_ms, 8)) goto done;
+          std::string val;
+          uint8_t st = kOk;
+          {
+            std::unique_lock<std::mutex> lk(mu);
+            auto deadline =
+                Clock::now() + std::chrono::milliseconds(timeout_ms);
+            while (!stop.load()) {
+              auto it = kv.find(key);
+              if (it != kv.end()) {
+                val = it->second;
+                break;
+              }
+              if (timeout_ms >= 0 &&
+                  cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+                st = kTimeout;
+                break;
+              }
+              if (timeout_ms < 0) cv.wait(lk);
+            }
+          }
+          if (!send_all(fd, &st, 1)) goto done;
+          if (st == kOk && !send_str(fd, val)) goto done;
+          break;
+        }
+        case kAdd: {
+          int64_t delta = 0;
+          if (!recv_all(fd, &delta, 8)) goto done;
+          int64_t result;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            result = (counters[key] += delta);
+            kv[key] = std::to_string(result);
+          }
+          cv.notify_all();
+          uint8_t st = kOk;
+          if (!send_all(fd, &st, 1) || !send_all(fd, &result, 8)) goto done;
+          break;
+        }
+        case kCheck: {
+          uint8_t st;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            st = kv.count(key) ? kOk : kMissing;
+          }
+          if (!send_all(fd, &st, 1)) goto done;
+          break;
+        }
+        case kDel: {
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            kv.erase(key);
+            counters.erase(key);
+          }
+          uint8_t st = kOk;
+          if (!send_all(fd, &st, 1)) goto done;
+          break;
+        }
+        case kList: {
+          std::string joined;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            for (auto& p : kv) {
+              if (p.first.rfind(key, 0) == 0) {
+                joined += p.first;
+                joined.push_back('\n');
+              }
+            }
+          }
+          uint8_t st = kOk;
+          if (!send_all(fd, &st, 1) || !send_str(fd, joined)) goto done;
+          break;
+        }
+        default:
+          goto done;
+      }
+    }
+  done:
+    ::close(fd);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// TCPStore client
+// ---------------------------------------------------------------------------
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client
+
+  ~StoreClient() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  bool connect_to(const std::string& host, int port, int64_t timeout_ms,
+                  std::string* err) {
+    auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+    while (true) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) {
+        *err = "socket() failed";
+        return false;
+      }
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      hostent* he = ::gethostbyname(host.c_str());
+      if (he != nullptr) {
+        memcpy(&addr.sin_addr, he->h_addr, he->h_length);
+      } else {
+        addr.sin_addr.s_addr = inet_addr(host.c_str());
+      }
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd);
+      fd = -1;
+      if (Clock::now() >= deadline) {
+        *err = "connect timeout to " + host + ":" + std::to_string(port);
+        return false;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// handle registries
+// ---------------------------------------------------------------------------
+
+static std::mutex g_reg_mu;
+static int64_t g_next_handle = 1;
+static std::unordered_map<int64_t, std::unique_ptr<StoreServer>> g_servers;
+static std::unordered_map<int64_t, std::unique_ptr<StoreClient>> g_clients;
+
+struct QueueObj {
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<PyObject*> items;
+  size_t capacity;
+  bool closed = false;
+};
+static std::unordered_map<int64_t, std::unique_ptr<QueueObj>> g_queues;
+
+struct TraceEvent {
+  std::string name;
+  uint64_t tid;
+  int64_t start_ns;
+  int64_t end_ns;
+  int64_t corr_id;
+};
+static std::mutex g_trace_mu;
+static std::atomic<bool> g_trace_enabled{false};
+static std::atomic<int64_t> g_trace_next_id{1};
+static std::vector<TraceEvent> g_trace_done;
+static std::unordered_map<int64_t, TraceEvent> g_trace_open;
+
+struct StatEntry {
+  int64_t current = 0;
+  int64_t peak = 0;
+};
+static std::mutex g_stat_mu;
+static std::map<std::string, StatEntry> g_stats;
+
+static uint64_t this_tid() {
+  return static_cast<uint64_t>(
+      std::hash<std::thread::id>{}(std::this_thread::get_id()));
+}
+
+// ---------------------------------------------------------------------------
+// Python: TCPStore
+// ---------------------------------------------------------------------------
+
+static PyObject* py_store_server_start(PyObject*, PyObject* args) {
+  const char* host;
+  int port;
+  if (!PyArg_ParseTuple(args, "si", &host, &port)) return nullptr;
+  auto srv = std::make_unique<StoreServer>();
+  std::string err;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS;
+  ok = srv->start(host, port, &err);
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(PyExc_OSError, err.c_str());
+    return nullptr;
+  }
+  std::lock_guard<std::mutex> lk(g_reg_mu);
+  int64_t h = g_next_handle++;
+  g_servers[h] = std::move(srv);
+  return PyLong_FromLongLong(h);
+}
+
+static PyObject* py_store_server_stop(PyObject*, PyObject* args) {
+  long long h;
+  if (!PyArg_ParseTuple(args, "L", &h)) return nullptr;
+  std::unique_ptr<StoreServer> srv;
+  {
+    std::lock_guard<std::mutex> lk(g_reg_mu);
+    auto it = g_servers.find(h);
+    if (it != g_servers.end()) {
+      srv = std::move(it->second);
+      g_servers.erase(it);
+    }
+  }
+  if (srv) {
+    Py_BEGIN_ALLOW_THREADS;
+    srv->shutdown();
+    srv.reset();
+    Py_END_ALLOW_THREADS;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_store_connect(PyObject*, PyObject* args) {
+  const char* host;
+  int port;
+  long long timeout_ms;
+  if (!PyArg_ParseTuple(args, "siL", &host, &port, &timeout_ms)) return nullptr;
+  auto cli = std::make_unique<StoreClient>();
+  std::string err;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS;
+  ok = cli->connect_to(host, port, timeout_ms, &err);
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(PyExc_TimeoutError, err.c_str());
+    return nullptr;
+  }
+  std::lock_guard<std::mutex> lk(g_reg_mu);
+  int64_t h = g_next_handle++;
+  g_clients[h] = std::move(cli);
+  return PyLong_FromLongLong(h);
+}
+
+static StoreClient* get_client(long long h) {
+  std::lock_guard<std::mutex> lk(g_reg_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second.get();
+}
+
+static PyObject* py_store_close(PyObject*, PyObject* args) {
+  long long h;
+  if (!PyArg_ParseTuple(args, "L", &h)) return nullptr;
+  std::lock_guard<std::mutex> lk(g_reg_mu);
+  g_clients.erase(h);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_store_set(PyObject*, PyObject* args) {
+  long long h;
+  const char* key;
+  Py_buffer val;
+  if (!PyArg_ParseTuple(args, "Lsy*", &h, &key, &val)) return nullptr;
+  StoreClient* c = get_client(h);
+  if (!c) {
+    PyBuffer_Release(&val);
+    PyErr_SetString(PyExc_ValueError, "bad store handle");
+    return nullptr;
+  }
+  bool ok = false;
+  uint8_t st = kError;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    uint8_t cmd = kSet;
+    std::string k(key);
+    std::string v(static_cast<const char*>(val.buf),
+                  static_cast<size_t>(val.len));
+    ok = send_all(c->fd, &cmd, 1) && send_str(c->fd, k) &&
+         send_str(c->fd, v) && recv_all(c->fd, &st, 1);
+  }
+  Py_END_ALLOW_THREADS;
+  PyBuffer_Release(&val);
+  if (!ok || st != kOk) {
+    PyErr_SetString(PyExc_ConnectionError, "store set failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_store_get(PyObject*, PyObject* args) {
+  long long h;
+  const char* key;
+  long long timeout_ms;
+  if (!PyArg_ParseTuple(args, "LsL", &h, &key, &timeout_ms)) return nullptr;
+  StoreClient* c = get_client(h);
+  if (!c) {
+    PyErr_SetString(PyExc_ValueError, "bad store handle");
+    return nullptr;
+  }
+  bool ok = false;
+  uint8_t st = kError;
+  std::string val;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    uint8_t cmd = kGet;
+    std::string k(key);
+    int64_t t = timeout_ms;
+    ok = send_all(c->fd, &cmd, 1) && send_str(c->fd, k) &&
+         send_all(c->fd, &t, 8) && recv_all(c->fd, &st, 1);
+    if (ok && st == kOk) ok = recv_str(c->fd, &val);
+  }
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "store get failed");
+    return nullptr;
+  }
+  if (st == kTimeout) {
+    PyErr_SetString(PyExc_TimeoutError, key);
+    return nullptr;
+  }
+  if (st != kOk) {
+    PyErr_SetString(PyExc_KeyError, key);
+    return nullptr;
+  }
+  return PyBytes_FromStringAndSize(val.data(),
+                                   static_cast<Py_ssize_t>(val.size()));
+}
+
+static PyObject* py_store_add(PyObject*, PyObject* args) {
+  long long h;
+  const char* key;
+  long long delta;
+  if (!PyArg_ParseTuple(args, "LsL", &h, &key, &delta)) return nullptr;
+  StoreClient* c = get_client(h);
+  if (!c) {
+    PyErr_SetString(PyExc_ValueError, "bad store handle");
+    return nullptr;
+  }
+  bool ok = false;
+  uint8_t st = kError;
+  int64_t result = 0;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    uint8_t cmd = kAdd;
+    std::string k(key);
+    int64_t d = delta;
+    ok = send_all(c->fd, &cmd, 1) && send_str(c->fd, k) &&
+         send_all(c->fd, &d, 8) && recv_all(c->fd, &st, 1) &&
+         recv_all(c->fd, &result, 8);
+  }
+  Py_END_ALLOW_THREADS;
+  if (!ok || st != kOk) {
+    PyErr_SetString(PyExc_ConnectionError, "store add failed");
+    return nullptr;
+  }
+  return PyLong_FromLongLong(result);
+}
+
+static PyObject* py_store_check(PyObject*, PyObject* args) {
+  long long h;
+  const char* key;
+  if (!PyArg_ParseTuple(args, "Ls", &h, &key)) return nullptr;
+  StoreClient* c = get_client(h);
+  if (!c) {
+    PyErr_SetString(PyExc_ValueError, "bad store handle");
+    return nullptr;
+  }
+  bool ok = false;
+  uint8_t st = kError;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    uint8_t cmd = kCheck;
+    std::string k(key);
+    ok = send_all(c->fd, &cmd, 1) && send_str(c->fd, k) &&
+         recv_all(c->fd, &st, 1);
+  }
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "store check failed");
+    return nullptr;
+  }
+  return PyBool_FromLong(st == kOk);
+}
+
+static PyObject* py_store_delete(PyObject*, PyObject* args) {
+  long long h;
+  const char* key;
+  if (!PyArg_ParseTuple(args, "Ls", &h, &key)) return nullptr;
+  StoreClient* c = get_client(h);
+  if (!c) {
+    PyErr_SetString(PyExc_ValueError, "bad store handle");
+    return nullptr;
+  }
+  bool ok = false;
+  uint8_t st = kError;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    uint8_t cmd = kDel;
+    std::string k(key);
+    ok = send_all(c->fd, &cmd, 1) && send_str(c->fd, k) &&
+         recv_all(c->fd, &st, 1);
+  }
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "store delete failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_store_list(PyObject*, PyObject* args) {
+  long long h;
+  const char* prefix;
+  if (!PyArg_ParseTuple(args, "Ls", &h, &prefix)) return nullptr;
+  StoreClient* c = get_client(h);
+  if (!c) {
+    PyErr_SetString(PyExc_ValueError, "bad store handle");
+    return nullptr;
+  }
+  bool ok = false;
+  uint8_t st = kError;
+  std::string joined;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    uint8_t cmd = kList;
+    std::string k(prefix);
+    ok = send_all(c->fd, &cmd, 1) && send_str(c->fd, k) &&
+         recv_all(c->fd, &st, 1) && recv_str(c->fd, &joined);
+  }
+  Py_END_ALLOW_THREADS;
+  if (!ok || st != kOk) {
+    PyErr_SetString(PyExc_ConnectionError, "store list failed");
+    return nullptr;
+  }
+  PyObject* lst = PyList_New(0);
+  size_t pos = 0;
+  while (pos < joined.size()) {
+    size_t nl = joined.find('\n', pos);
+    if (nl == std::string::npos) break;
+    PyObject* s = PyUnicode_FromStringAndSize(joined.data() + pos,
+                                              static_cast<Py_ssize_t>(nl - pos));
+    PyList_Append(lst, s);
+    Py_DECREF(s);
+    pos = nl + 1;
+  }
+  return lst;
+}
+
+// ---------------------------------------------------------------------------
+// Python: BlockingQueue
+// ---------------------------------------------------------------------------
+
+static PyObject* py_queue_create(PyObject*, PyObject* args) {
+  long long capacity;
+  if (!PyArg_ParseTuple(args, "L", &capacity)) return nullptr;
+  auto q = std::make_unique<QueueObj>();
+  q->capacity = static_cast<size_t>(capacity > 0 ? capacity : 1);
+  std::lock_guard<std::mutex> lk(g_reg_mu);
+  int64_t h = g_next_handle++;
+  g_queues[h] = std::move(q);
+  return PyLong_FromLongLong(h);
+}
+
+static QueueObj* get_queue(long long h) {
+  std::lock_guard<std::mutex> lk(g_reg_mu);
+  auto it = g_queues.find(h);
+  return it == g_queues.end() ? nullptr : it->second.get();
+}
+
+static PyObject* py_queue_push(PyObject*, PyObject* args) {
+  long long h;
+  PyObject* obj;
+  long long timeout_ms;
+  if (!PyArg_ParseTuple(args, "LOL", &h, &obj, &timeout_ms)) return nullptr;
+  QueueObj* q = get_queue(h);
+  if (!q) {
+    PyErr_SetString(PyExc_ValueError, "bad queue handle");
+    return nullptr;
+  }
+  bool pushed = false, closed = false;
+  Py_INCREF(obj);
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::unique_lock<std::mutex> lk(q->mu);
+    auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+    while (!q->closed && q->items.size() >= q->capacity) {
+      if (timeout_ms < 0) {
+        q->cv_push.wait(lk);
+      } else if (q->cv_push.wait_until(lk, deadline) ==
+                 std::cv_status::timeout) {
+        break;
+      }
+    }
+    if (q->closed) {
+      closed = true;
+    } else if (q->items.size() < q->capacity) {
+      q->items.push_back(obj);
+      pushed = true;
+      q->cv_pop.notify_one();
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  if (!pushed) Py_DECREF(obj);
+  if (closed) {
+    PyErr_SetString(PyExc_BrokenPipeError, "queue closed");
+    return nullptr;
+  }
+  return PyBool_FromLong(pushed);
+}
+
+static PyObject* py_queue_pop(PyObject*, PyObject* args) {
+  long long h;
+  long long timeout_ms;
+  if (!PyArg_ParseTuple(args, "LL", &h, &timeout_ms)) return nullptr;
+  QueueObj* q = get_queue(h);
+  if (!q) {
+    PyErr_SetString(PyExc_ValueError, "bad queue handle");
+    return nullptr;
+  }
+  PyObject* obj = nullptr;
+  bool closed_empty = false, timed_out = false;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::unique_lock<std::mutex> lk(q->mu);
+    auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+    while (q->items.empty() && !q->closed) {
+      if (timeout_ms < 0) {
+        q->cv_pop.wait(lk);
+      } else if (q->cv_pop.wait_until(lk, deadline) ==
+                 std::cv_status::timeout) {
+        timed_out = true;
+        break;
+      }
+    }
+    if (!q->items.empty()) {
+      obj = q->items.front();
+      q->items.pop_front();
+      q->cv_push.notify_one();
+    } else if (q->closed) {
+      closed_empty = true;
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  if (obj != nullptr) return obj;  // ref transferred
+  if (closed_empty) {
+    PyErr_SetString(PyExc_StopIteration, "queue closed");
+    return nullptr;
+  }
+  if (timed_out) {
+    PyErr_SetString(PyExc_TimeoutError, "queue pop timeout");
+    return nullptr;
+  }
+  PyErr_SetString(PyExc_RuntimeError, "queue pop failed");
+  return nullptr;
+}
+
+static PyObject* py_queue_close(PyObject*, PyObject* args) {
+  long long h;
+  if (!PyArg_ParseTuple(args, "L", &h)) return nullptr;
+  QueueObj* q = get_queue(h);
+  if (!q) Py_RETURN_NONE;
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->cv_pop.notify_all();
+  q->cv_push.notify_all();
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_queue_size(PyObject*, PyObject* args) {
+  long long h;
+  if (!PyArg_ParseTuple(args, "L", &h)) return nullptr;
+  QueueObj* q = get_queue(h);
+  if (!q) {
+    PyErr_SetString(PyExc_ValueError, "bad queue handle");
+    return nullptr;
+  }
+  std::lock_guard<std::mutex> lk(q->mu);
+  return PyLong_FromSize_t(q->items.size());
+}
+
+static PyObject* py_queue_destroy(PyObject*, PyObject* args) {
+  long long h;
+  if (!PyArg_ParseTuple(args, "L", &h)) return nullptr;
+  std::unique_ptr<QueueObj> q;
+  {
+    std::lock_guard<std::mutex> lk(g_reg_mu);
+    auto it = g_queues.find(h);
+    if (it != g_queues.end()) {
+      q = std::move(it->second);
+      g_queues.erase(it);
+    }
+  }
+  if (q) {
+    // drop remaining refs under the GIL
+    for (PyObject* o : q->items) Py_DECREF(o);
+    q->items.clear();
+  }
+  Py_RETURN_NONE;
+}
+
+// ---------------------------------------------------------------------------
+// Python: host tracer
+// ---------------------------------------------------------------------------
+
+static PyObject* py_tracer_enable(PyObject*, PyObject* args) {
+  int flag;
+  if (!PyArg_ParseTuple(args, "p", &flag)) return nullptr;
+  g_trace_enabled.store(flag != 0);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_tracer_enabled(PyObject*, PyObject*) {
+  return PyBool_FromLong(g_trace_enabled.load());
+}
+
+static PyObject* py_tracer_begin(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  if (!g_trace_enabled.load()) return PyLong_FromLongLong(0);
+  int64_t id = g_trace_next_id.fetch_add(1);
+  TraceEvent ev;
+  ev.name = name;
+  ev.tid = this_tid();
+  ev.start_ns = now_ns();
+  ev.end_ns = 0;
+  ev.corr_id = id;
+  {
+    std::lock_guard<std::mutex> lk(g_trace_mu);
+    g_trace_open.emplace(id, std::move(ev));
+  }
+  return PyLong_FromLongLong(id);
+}
+
+static PyObject* py_tracer_end(PyObject*, PyObject* args) {
+  long long id;
+  if (!PyArg_ParseTuple(args, "L", &id)) return nullptr;
+  if (id == 0) Py_RETURN_NONE;
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  auto it = g_trace_open.find(id);
+  if (it != g_trace_open.end()) {
+    it->second.end_ns = now_ns();
+    g_trace_done.push_back(std::move(it->second));
+    g_trace_open.erase(it);
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_tracer_instant(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  if (!g_trace_enabled.load()) Py_RETURN_NONE;
+  TraceEvent ev;
+  ev.name = name;
+  ev.tid = this_tid();
+  ev.start_ns = now_ns();
+  ev.end_ns = ev.start_ns;
+  ev.corr_id = g_trace_next_id.fetch_add(1);
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  g_trace_done.push_back(std::move(ev));
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_tracer_drain(PyObject*, PyObject*) {
+  std::vector<TraceEvent> evs;
+  {
+    std::lock_guard<std::mutex> lk(g_trace_mu);
+    evs.swap(g_trace_done);
+  }
+  PyObject* lst = PyList_New(static_cast<Py_ssize_t>(evs.size()));
+  for (size_t i = 0; i < evs.size(); ++i) {
+    PyObject* t = Py_BuildValue("(sKLL)", evs[i].name.c_str(),
+                                static_cast<unsigned long long>(evs[i].tid),
+                                static_cast<long long>(evs[i].start_ns),
+                                static_cast<long long>(evs[i].end_ns));
+    PyList_SET_ITEM(lst, static_cast<Py_ssize_t>(i), t);
+  }
+  return lst;
+}
+
+static PyObject* py_tracer_clear(PyObject*, PyObject*) {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  g_trace_done.clear();
+  g_trace_open.clear();
+  Py_RETURN_NONE;
+}
+
+// ---------------------------------------------------------------------------
+// Python: stat registry
+// ---------------------------------------------------------------------------
+
+static PyObject* py_stat_update(PyObject*, PyObject* args) {
+  const char* name;
+  long long delta;
+  if (!PyArg_ParseTuple(args, "sL", &name, &delta)) return nullptr;
+  std::lock_guard<std::mutex> lk(g_stat_mu);
+  StatEntry& e = g_stats[name];
+  e.current += delta;
+  if (e.current > e.peak) e.peak = e.current;
+  return PyLong_FromLongLong(e.current);
+}
+
+static PyObject* py_stat_get(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  std::lock_guard<std::mutex> lk(g_stat_mu);
+  StatEntry& e = g_stats[name];
+  return Py_BuildValue("(LL)", static_cast<long long>(e.current),
+                       static_cast<long long>(e.peak));
+}
+
+static PyObject* py_stat_reset(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  std::lock_guard<std::mutex> lk(g_stat_mu);
+  g_stats.erase(name);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_stat_all(PyObject*, PyObject*) {
+  std::lock_guard<std::mutex> lk(g_stat_mu);
+  PyObject* d = PyDict_New();
+  for (auto& p : g_stats) {
+    PyObject* v = Py_BuildValue("(LL)", static_cast<long long>(p.second.current),
+                                static_cast<long long>(p.second.peak));
+    PyDict_SetItemString(d, p.first.c_str(), v);
+    Py_DECREF(v);
+  }
+  return d;
+}
+
+static PyObject* py_monotonic_ns(PyObject*, PyObject*) {
+  return PyLong_FromLongLong(now_ns());
+}
+
+// ---------------------------------------------------------------------------
+// module
+// ---------------------------------------------------------------------------
+
+static PyMethodDef kMethods[] = {
+    {"store_server_start", py_store_server_start, METH_VARARGS, nullptr},
+    {"store_server_stop", py_store_server_stop, METH_VARARGS, nullptr},
+    {"store_connect", py_store_connect, METH_VARARGS, nullptr},
+    {"store_close", py_store_close, METH_VARARGS, nullptr},
+    {"store_set", py_store_set, METH_VARARGS, nullptr},
+    {"store_get", py_store_get, METH_VARARGS, nullptr},
+    {"store_add", py_store_add, METH_VARARGS, nullptr},
+    {"store_check", py_store_check, METH_VARARGS, nullptr},
+    {"store_delete", py_store_delete, METH_VARARGS, nullptr},
+    {"store_list", py_store_list, METH_VARARGS, nullptr},
+    {"queue_create", py_queue_create, METH_VARARGS, nullptr},
+    {"queue_push", py_queue_push, METH_VARARGS, nullptr},
+    {"queue_pop", py_queue_pop, METH_VARARGS, nullptr},
+    {"queue_close", py_queue_close, METH_VARARGS, nullptr},
+    {"queue_size", py_queue_size, METH_VARARGS, nullptr},
+    {"queue_destroy", py_queue_destroy, METH_VARARGS, nullptr},
+    {"tracer_enable", py_tracer_enable, METH_VARARGS, nullptr},
+    {"tracer_enabled", py_tracer_enabled, METH_NOARGS, nullptr},
+    {"tracer_begin", py_tracer_begin, METH_VARARGS, nullptr},
+    {"tracer_end", py_tracer_end, METH_VARARGS, nullptr},
+    {"tracer_instant", py_tracer_instant, METH_VARARGS, nullptr},
+    {"tracer_drain", py_tracer_drain, METH_NOARGS, nullptr},
+    {"tracer_clear", py_tracer_clear, METH_NOARGS, nullptr},
+    {"stat_update", py_stat_update, METH_VARARGS, nullptr},
+    {"stat_get", py_stat_get, METH_VARARGS, nullptr},
+    {"stat_reset", py_stat_reset, METH_VARARGS, nullptr},
+    {"stat_all", py_stat_all, METH_NOARGS, nullptr},
+    {"monotonic_ns", py_monotonic_ns, METH_NOARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "paddle_tpu native runtime tier (store/queue/tracer/stats)", -1, kMethods,
+    nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__native(void) { return PyModule_Create(&kModule); }

@@ -1,0 +1,537 @@
+"""Loader + Python facade for the native runtime tier (csrc/native.cc).
+
+The C++ extension provides the infra-critical host-side components the
+reference implements natively (SURVEY.md §2.1 dispositions):
+
+- ``TCPStore``      — phi/core/distributed/store/tcp_store.h:121 analog
+- ``BlockingQueue`` — fluid/imperative/data_loader.cc blocking-queue analog
+- host tracer       — platform/profiler/host_tracer.cc analog
+- stat registry     — fluid/memory/stats.h analog
+
+The extension is compiled on first use with g++ straight from csrc/ (the
+image has no pybind11; the module uses the raw CPython C API). If the
+toolchain is unavailable the pure-Python fallback below provides identical
+semantics so the framework never hard-fails.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+import time
+from typing import List, Optional
+
+_native = None
+_native_err: Optional[str] = None
+_load_lock = threading.Lock()
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_and_load():
+    """Compile csrc/native.cc into paddle_tpu/_native*.so if needed, import it."""
+    global _native, _native_err
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(_repo_root(), "csrc", "native.cc")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so_path = os.path.join(pkg_dir, "_native" + suffix)
+    try:
+        need_build = (not os.path.exists(so_path)
+                      or (os.path.exists(src)
+                          and os.path.getmtime(src) > os.path.getmtime(so_path)))
+        if need_build:
+            if not os.path.exists(src):
+                raise FileNotFoundError(src)
+            include = sysconfig.get_paths()["include"]
+            lock_path = so_path + ".lock"
+            # crude cross-process build lock (parallel pytest workers)
+            for _ in range(600):
+                try:
+                    fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.close(fd)
+                    break
+                except FileExistsError:
+                    time.sleep(0.1)
+            else:
+                raise TimeoutError("native build lock timeout")
+            try:
+                if (not os.path.exists(so_path)
+                        or os.path.getmtime(src) > os.path.getmtime(so_path)):
+                    tmp = so_path + ".tmp.so"
+                    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                           "-I", include, src, "-o", tmp, "-lpthread"]
+                    subprocess.run(cmd, check=True, capture_output=True,
+                                   timeout=300)
+                    os.replace(tmp, so_path)
+            finally:
+                try:
+                    os.remove(lock_path)
+                except OSError:
+                    pass
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("paddle_tpu._native",
+                                                      so_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _native = mod
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        _native_err = f"{type(e).__name__}: {e}"
+        _native = None
+
+
+def get_native():
+    """The compiled extension module, or None if unavailable."""
+    global _native
+    if _native is None and _native_err is None:
+        with _load_lock:
+            if _native is None and _native_err is None:
+                _build_and_load()
+    return _native
+
+
+def native_available() -> bool:
+    return get_native() is not None
+
+
+def native_error() -> Optional[str]:
+    get_native()
+    return _native_err
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+# ---------------------------------------------------------------------------
+
+class TCPStore:
+    """Rank-0-hosted TCP key/value store for multi-host bootstrap.
+
+    Mirrors the reference's TCPStore semantics
+    (phi/core/distributed/store/tcp_store.h:121): ``set``/blocking ``get``/
+    atomic ``add``/``wait``/``delete_key``, plus a prefix ``list_keys``.
+    The master rank starts the in-process server; every rank (including the
+    master) talks to it through a client socket.
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.host = host
+        self.port = port
+        self.is_master = is_master
+        self.world_size = world_size
+        self.timeout = timeout
+        self._n = get_native()
+        self._server = None
+        self._py = None
+        if self._n is not None:
+            if is_master:
+                self._server = self._n.store_server_start("", port)
+            self._client = self._n.store_connect(host, port,
+                                                 int(timeout * 1000))
+        else:  # pure-python fallback
+            self._py = _PyStoreBackend(host, port, is_master, timeout)
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        if self._py is not None:
+            return self._py.set(key, value)
+        self._n.store_set(self._client, key, value)
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        t = self.timeout if timeout is None else timeout
+        if self._py is not None:
+            return self._py.get(key, t)
+        return self._n.store_get(self._client, key, int(t * 1000))
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._py is not None:
+            return self._py.add(key, amount)
+        return self._n.store_add(self._client, key, amount)
+
+    def check(self, key: str) -> bool:
+        if self._py is not None:
+            return self._py.check(key)
+        return self._n.store_check(self._client, key)
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None) -> None:
+        deadline = time.monotonic() + (timeout or self.timeout)
+        for k in keys:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"wait timed out on {k}")
+            self.get(k, timeout=remaining)
+
+    def delete_key(self, key: str) -> None:
+        if self._py is not None:
+            return self._py.delete_key(key)
+        self._n.store_delete(self._client, key)
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        if self._py is not None:
+            return self._py.list_keys(prefix)
+        return self._n.store_list(self._client, prefix)
+
+    def barrier(self, name: str, world_size: Optional[int] = None,
+                timeout: Optional[float] = None) -> None:
+        """Store-based barrier: everyone adds, then waits for the count."""
+        n = world_size or self.world_size
+        arrived = self.add(f"__barrier__/{name}/count", 1)
+        if arrived == n:
+            self.set(f"__barrier__/{name}/done", b"1")
+        self.get(f"__barrier__/{name}/done", timeout=timeout)
+
+    def close(self) -> None:
+        if self._py is not None:
+            self._py.close()
+            return
+        if self._n is not None:
+            try:
+                self._n.store_close(self._client)
+            except Exception:
+                pass
+            if self._server is not None:
+                self._n.store_server_stop(self._server)
+                self._server = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _PyStoreBackend:
+    """socket-based fallback with the same wire protocol semantics (but its
+    own implementation — only used when the C++ extension cannot build)."""
+
+    def __init__(self, host, port, is_master, timeout):
+        import socket
+        import socketserver
+        self._kv = {}
+        self._cv = threading.Condition()
+        self._server = None
+        store = self
+
+        if is_master:
+            class Handler(socketserver.StreamRequestHandler):
+                def handle(self):
+                    import pickle
+                    while True:
+                        try:
+                            req = pickle.load(self.rfile)
+                        except EOFError:
+                            return
+                        op = req[0]
+                        if op == "set":
+                            with store._cv:
+                                store._kv[req[1]] = req[2]
+                                store._cv.notify_all()
+                            resp = None
+                        elif op == "get":
+                            deadline = time.monotonic() + req[2]
+                            with store._cv:
+                                while req[1] not in store._kv:
+                                    rem = deadline - time.monotonic()
+                                    if rem <= 0 or not store._cv.wait(rem):
+                                        break
+                                resp = store._kv.get(req[1], _TIMEOUT_SENTINEL)
+                        elif op == "add":
+                            with store._cv:
+                                cur = int(store._kv.get(req[1], b"0")) + req[2]
+                                store._kv[req[1]] = str(cur).encode()
+                                store._cv.notify_all()
+                            resp = cur
+                        elif op == "check":
+                            with store._cv:
+                                resp = req[1] in store._kv
+                        elif op == "del":
+                            with store._cv:
+                                store._kv.pop(req[1], None)
+                            resp = None
+                        elif op == "list":
+                            with store._cv:
+                                resp = [k for k in store._kv
+                                        if k.startswith(req[1])]
+                        else:
+                            resp = None
+                        pickle.dump(resp, self.wfile)
+                        self.wfile.flush()
+
+            class Server(socketserver.ThreadingTCPServer):
+                allow_reuse_address = True
+                daemon_threads = True
+
+            self._server = Server(("", port), Handler)
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True).start()
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"connect timeout {host}:{port}")
+                time.sleep(0.05)
+        self._sock_lock = threading.Lock()
+        self._rfile = self._sock.makefile("rb")
+        self._wfile = self._sock.makefile("wb")
+
+    def _rpc(self, *req):
+        import pickle
+        with self._sock_lock:
+            pickle.dump(req, self._wfile)
+            self._wfile.flush()
+            return pickle.load(self._rfile)
+
+    def set(self, key, value):
+        self._rpc("set", key, value)
+
+    def get(self, key, timeout):
+        r = self._rpc("get", key, timeout)
+        if r is _TIMEOUT_SENTINEL or (isinstance(r, str)
+                                      and r == "__timeout__"):
+            raise TimeoutError(key)
+        return r
+
+    def add(self, key, amount):
+        return self._rpc("add", key, amount)
+
+    def check(self, key):
+        return self._rpc("check", key)
+
+    def delete_key(self, key):
+        self._rpc("del", key)
+
+    def list_keys(self, prefix):
+        return self._rpc("list", prefix)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+        if self._server is not None:
+            self._server.shutdown()
+
+
+_TIMEOUT_SENTINEL = "__timeout__"
+
+
+# ---------------------------------------------------------------------------
+# BlockingQueue
+# ---------------------------------------------------------------------------
+
+class BlockingQueue:
+    """Bounded blocking queue over the native condvar queue; the prefetch
+    buffer of the DataLoader (fluid/imperative/data_loader.cc analog).
+
+    ``pop`` raises StopIteration once closed and drained — matching the
+    reference blocking queue's end-of-epoch signal.
+    """
+
+    def __init__(self, capacity: int = 8):
+        self._n = get_native()
+        if self._n is not None:
+            self._h = self._n.queue_create(capacity)
+            self._q = None
+        else:
+            import queue
+            self._q = queue.Queue(maxsize=capacity)
+            self._closed = threading.Event()
+            self._h = None
+
+    def push(self, item, timeout: float = -1.0) -> bool:
+        if self._h is not None:
+            return self._n.queue_push(self._h, item,
+                                      int(timeout * 1000) if timeout >= 0 else -1)
+        import queue as _q
+        if self._closed.is_set():
+            raise BrokenPipeError("queue closed")
+        try:
+            self._q.put(item, timeout=None if timeout < 0 else timeout)
+            return True
+        except _q.Full:
+            return False
+
+    def pop(self, timeout: float = -1.0):
+        if self._h is not None:
+            return self._n.queue_pop(self._h,
+                                     int(timeout * 1000) if timeout >= 0 else -1)
+        import queue as _q
+        deadline = None if timeout < 0 else time.monotonic() + timeout
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except _q.Empty:
+                if self._closed.is_set() and self._q.empty():
+                    raise StopIteration("queue closed")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("queue pop timeout")
+
+    def close(self):
+        if self._h is not None:
+            self._n.queue_close(self._h)
+        else:
+            self._closed.set()
+
+    def size(self) -> int:
+        if self._h is not None:
+            return self._n.queue_size(self._h)
+        return self._q.qsize()
+
+    def release(self):
+        if self._h is not None:
+            self._n.queue_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# tracer + stats facade (used by paddle_tpu.profiler / memory stats)
+# ---------------------------------------------------------------------------
+
+class _PyTracer:
+    def __init__(self):
+        self.enabled = False
+        self._events = []
+        self._open = {}
+        self._next = 1
+        self._lock = threading.Lock()
+
+    def begin(self, name):
+        if not self.enabled:
+            return 0
+        with self._lock:
+            i = self._next
+            self._next += 1
+            self._open[i] = (name, threading.get_ident(),
+                             time.monotonic_ns())
+        return i
+
+    def end(self, i):
+        if i == 0:
+            return
+        with self._lock:
+            ev = self._open.pop(i, None)
+            if ev is not None:
+                self._events.append((ev[0], ev[1], ev[2],
+                                     time.monotonic_ns()))
+
+    def instant(self, name):
+        if not self.enabled:
+            return
+        t = time.monotonic_ns()
+        with self._lock:
+            self._events.append((name, threading.get_ident(), t, t))
+
+    def drain(self):
+        with self._lock:
+            evs, self._events = self._events, []
+        return evs
+
+    def clear(self):
+        with self._lock:
+            self._events = []
+            self._open = {}
+
+
+_py_tracer = _PyTracer()
+_py_stats = {}
+_py_stats_lock = threading.Lock()
+
+
+def tracer_enable(flag: bool) -> None:
+    n = get_native()
+    if n is not None:
+        n.tracer_enable(bool(flag))
+    else:
+        _py_tracer.enabled = bool(flag)
+
+
+def tracer_enabled() -> bool:
+    n = get_native()
+    return n.tracer_enabled() if n is not None else _py_tracer.enabled
+
+
+def tracer_begin(name: str) -> int:
+    n = get_native()
+    return n.tracer_begin(name) if n is not None else _py_tracer.begin(name)
+
+
+def tracer_end(ident: int) -> None:
+    n = get_native()
+    if n is not None:
+        n.tracer_end(ident)
+    else:
+        _py_tracer.end(ident)
+
+
+def tracer_instant(name: str) -> None:
+    n = get_native()
+    if n is not None:
+        n.tracer_instant(name)
+    else:
+        _py_tracer.instant(name)
+
+
+def tracer_drain():
+    """-> list of (name, tid, start_ns, end_ns)."""
+    n = get_native()
+    return n.tracer_drain() if n is not None else _py_tracer.drain()
+
+
+def tracer_clear() -> None:
+    n = get_native()
+    if n is not None:
+        n.tracer_clear()
+    else:
+        _py_tracer.clear()
+
+
+def stat_update(name: str, delta: int) -> int:
+    """DEVICE_MEMORY_STAT-style named counter update; returns current."""
+    n = get_native()
+    if n is not None:
+        return n.stat_update(name, int(delta))
+    with _py_stats_lock:
+        cur, peak = _py_stats.get(name, (0, 0))
+        cur += int(delta)
+        _py_stats[name] = (cur, max(peak, cur))
+        return cur
+
+
+def stat_get(name: str):
+    """-> (current, peak)."""
+    n = get_native()
+    if n is not None:
+        return n.stat_get(name)
+    with _py_stats_lock:
+        return _py_stats.get(name, (0, 0))
+
+
+def stat_reset(name: str) -> None:
+    n = get_native()
+    if n is not None:
+        n.stat_reset(name)
+    else:
+        with _py_stats_lock:
+            _py_stats.pop(name, None)
+
+
+def stat_all():
+    n = get_native()
+    if n is not None:
+        return n.stat_all()
+    with _py_stats_lock:
+        return dict(_py_stats)

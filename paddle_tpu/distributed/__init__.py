@@ -21,6 +21,7 @@ from .collective import (Group, P2POp, ReduceOp, all_gather,
                          get_rank, get_world_size, init_parallel_env, irecv,
                          is_initialized, isend, new_group, recv, reduce,
                          reduce_scatter, scatter, send, wait)
+from ..core.native import TCPStore
 from .parallel import DataParallel, sync_params_buffers
 from . import fleet
 from . import sharding as _sharding_mod

@@ -246,6 +246,64 @@ class _InfiniteCounter:
             yield list(range(self.batch_size))
 
 
+class _BufferReader:
+    """Device-side prefetch buffer (the reference's use_buffer_reader: C++
+    blocking queue fed by a reader thread, fluid/imperative/data_loader.cc).
+
+    A daemon thread drives the underlying iterator — including the
+    host-to-device transfer in DataLoader._postprocess — and pushes finished
+    batches into a native BlockingQueue (csrc/native.cc), so transfer and
+    Python-side decode overlap with the training step consuming batches.
+    """
+
+    def __init__(self, it, depth=2):
+        from ..core.native import BlockingQueue, stat_update
+        self._q = BlockingQueue(depth)
+        self._err = None
+        self._stat_update = stat_update
+
+        def _feed():
+            try:
+                while True:
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    self._stat_update("dataloader_buffered_batches", 1)
+                    try:
+                        self._q.push(batch)
+                    except BrokenPipeError:
+                        break  # consumer dropped the iterator
+            except BaseException as e:  # noqa: BLE001 — surfaced on pop
+                self._err = e
+            finally:
+                self._q.close()
+
+        self._thread = threading.Thread(target=_feed, daemon=True,
+                                        name="paddle_tpu_buffer_reader")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = self._q.pop()
+        except StopIteration:
+            if self._err is not None:
+                raise self._err from None
+            raise
+        self._stat_update("dataloader_buffered_batches", -1)
+        return batch
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self._q.close()
+            self._q.release()
+        except Exception:
+            pass
+
+
 class DataLoader:
     """paddle.io.DataLoader analog."""
 
@@ -263,6 +321,7 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.return_list = return_list
         self.return_numpy = False
+        self.use_buffer_reader = bool(use_buffer_reader)
 
         self.drop_last = bool(drop_last)
         if isinstance(dataset, IterableDataset):
@@ -299,6 +358,8 @@ class DataLoader:
             it = _SingleProcessIter(self, batches)
         else:
             it = _MultiprocessIter(self, batches)
+        if self.use_buffer_reader:
+            return _BufferReader(it, depth=max(2, self.prefetch_factor))
 
         class _Iter:
             def __iter__(self_i):

@@ -20,6 +20,7 @@ import time
 from enum import Enum
 from typing import Callable, Iterable, Optional
 
+from ..core import native as _native
 from .statistics import SummaryView, build_summary
 
 _ACTIVE = []  # active Profiler instances (the op-dispatch hook reads this)
@@ -98,18 +99,29 @@ class HostEvent:
 
 class RecordEvent:
     """paddle.profiler.RecordEvent analog (host span; no-op when no profiler
-    is recording)."""
+    is recording). When the native tier is available, the span is timestamped
+    and buffered in C++ (platform/profiler/host_tracer.cc analog) and drained
+    into the profiler at window close."""
 
     def __init__(self, name: str, event_type: str = "UserDefined"):
         self.name = name
         self.event_type = event_type
         self._start = None
+        self._native_id = 0
 
     def begin(self):
-        if _ACTIVE:
+        if not _ACTIVE:
+            return
+        if _native.native_available():
+            self._native_id = _native.tracer_begin(self.name)
+        else:
             self._start = time.perf_counter_ns()
 
     def end(self):
+        if self._native_id:
+            _native.tracer_end(self._native_id)
+            self._native_id = 0
+            return
         if self._start is None:
             return
         end = time.perf_counter_ns()
@@ -189,6 +201,9 @@ class Profiler:
 
     def _start_record(self):
         self._events = []  # fresh window: exports/summary cover ONE window
+        if not _ACTIVE and _native.native_available():
+            _native.tracer_clear()
+            _native.tracer_enable(True)
         if self not in _ACTIVE:
             _ACTIVE.append(self)
         if ProfilerTarget.TPU in self.targets and not self.timer_only:
@@ -203,8 +218,18 @@ class Profiler:
                 self._device_tracing = False
 
     def _stop_record(self):
+        if _native.native_available():
+            drained = [HostEvent(name, start, end, tid)
+                       for name, tid, start, end in _native.tracer_drain()]
+            if drained:
+                self._events.extend(drained)
+                for prof in _ACTIVE:
+                    if prof is not self:
+                        prof._events.extend(drained)
         if self in _ACTIVE:
             _ACTIVE.remove(self)
+        if not _ACTIVE and _native.native_available():
+            _native.tracer_enable(False)
         if self._device_tracing:
             import jax
             try:

@@ -1,0 +1,246 @@
+"""Tests for the native C++ runtime tier (csrc/native.cc).
+
+Covers the TCPStore (tcp_store.h:121 analog) incl. cross-process use, the
+blocking queue (data_loader.cc analog), the host tracer, and the stat
+registry — plus their integration points (profiler RecordEvent, DataLoader
+buffer reader).
+"""
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_native_extension_builds():
+    # the C++ extension must actually be present in this image (the pure-
+    # Python fallback exists for degraded environments only)
+    assert native.native_available(), native.native_error()
+
+
+def test_store_set_get_add():
+    port = _free_port()
+    s = native.TCPStore("127.0.0.1", port, is_master=True, world_size=1)
+    s.set("k", b"v1")
+    assert s.get("k") == b"v1"
+    s.set("k", "v2")  # str coerced to bytes
+    assert s.get("k") == b"v2"
+    assert s.add("ctr", 3) == 3
+    assert s.add("ctr", -1) == 2
+    assert s.check("ctr")
+    assert not s.check("nope")
+    assert sorted(s.list_keys("")) == ["ctr", "k"]
+    s.delete_key("k")
+    assert not s.check("k")
+    s.close()
+
+
+def test_store_blocking_get_timeout():
+    port = _free_port()
+    s = native.TCPStore("127.0.0.1", port, is_master=True, world_size=1)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        s.get("missing", timeout=0.3)
+    assert time.monotonic() - t0 >= 0.25
+    s.close()
+
+
+def test_store_blocking_get_wakes_on_set():
+    port = _free_port()
+    s = native.TCPStore("127.0.0.1", port, is_master=True, world_size=1)
+    s2 = native.TCPStore("127.0.0.1", port, is_master=False, world_size=1)
+    result = {}
+
+    def waiter():
+        result["v"] = s2.get("late", timeout=5.0)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.2)
+    s.set("late", b"arrived")
+    th.join(timeout=5)
+    assert result.get("v") == b"arrived"
+    s2.close()
+    s.close()
+
+
+def _store_child(port, rank, out_q):
+    try:
+        st = native.TCPStore("127.0.0.1", port, is_master=False,
+                             world_size=3, timeout=10.0)
+        st.set(f"rank{rank}", str(rank).encode())
+        st.barrier("init", world_size=3, timeout=10.0)
+        got = sorted(st.get(f"rank{r}") for r in range(3))
+        out_q.put((rank, got))
+        st.close()
+    except Exception as e:  # pragma: no cover
+        out_q.put((rank, repr(e)))
+
+
+def test_store_cross_process_barrier():
+    """Rank-0 hosts the store; two child processes rendezvous through it —
+    the bootstrap pattern of init_parallel_env (parallel.py:943 analog)."""
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True, world_size=3)
+    master.set("rank0", b"0")
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = [ctx.Process(target=_store_child, args=(port, r, out_q))
+             for r in (1, 2)]
+    for p in procs:
+        p.start()
+    master.barrier("init", world_size=3, timeout=30.0)
+    results = [out_q.get(timeout=30) for _ in procs]
+    for p in procs:
+        p.join(timeout=10)
+    for rank, got in results:
+        assert got == [b"0", b"1", b"2"], (rank, got)
+    master.close()
+
+
+def test_blocking_queue_fifo_and_close():
+    q = native.BlockingQueue(4)
+    for i in range(4):
+        q.push(i)
+    assert q.size() == 4
+    assert [q.pop() for _ in range(4)] == [0, 1, 2, 3]
+    q.close()
+    with pytest.raises(StopIteration):
+        q.pop(timeout=0.5)
+    q.release()
+
+
+def test_blocking_queue_capacity_blocks_producer():
+    q = native.BlockingQueue(1)
+    q.push("a")
+    assert q.push("b", timeout=0.2) is False  # full
+    assert q.pop() == "a"
+    assert q.push("b", timeout=0.2) is True
+    q.close()
+    q.release()
+
+
+def test_blocking_queue_threaded_producer_consumer():
+    q = native.BlockingQueue(2)
+    n = 50
+
+    def produce():
+        for i in range(n):
+            q.push(np.full((4,), i))
+        q.close()
+
+    th = threading.Thread(target=produce)
+    th.start()
+    got = []
+    while True:
+        try:
+            got.append(int(q.pop(timeout=10.0)[0]))
+        except StopIteration:
+            break
+    th.join()
+    assert got == list(range(n))
+    q.release()
+
+
+def test_tracer_spans():
+    native.tracer_clear()
+    native.tracer_enable(True)
+    try:
+        i = native.tracer_begin("outer")
+        j = native.tracer_begin("inner")
+        native.tracer_end(j)
+        native.tracer_end(i)
+        native.tracer_instant("mark")
+        evs = native.tracer_drain()
+    finally:
+        native.tracer_enable(False)
+    names = [e[0] for e in evs]
+    assert set(names) == {"outer", "inner", "mark"}
+    by = {e[0]: e for e in evs}
+    assert by["inner"][2] >= by["outer"][2]          # starts nested
+    assert by["inner"][3] <= by["outer"][3]          # ends nested
+    assert by["mark"][2] == by["mark"][3]            # instant
+    assert native.tracer_drain() == []               # drained
+
+
+def test_tracer_disabled_is_noop():
+    native.tracer_enable(False)
+    i = native.tracer_begin("skipped")
+    native.tracer_end(i)
+    assert native.tracer_drain() == []
+
+
+def test_stats_current_and_peak():
+    native.stat_reset("test_mem")
+    assert native.stat_update("test_mem", 100) == 100
+    assert native.stat_update("test_mem", 50) == 150
+    assert native.stat_update("test_mem", -120) == 30
+    cur, peak = native.stat_get("test_mem")
+    assert (cur, peak) == (30, 150)
+    assert "test_mem" in native.stat_all()
+    native.stat_reset("test_mem")
+    assert native.stat_get("test_mem") == (0, 0)
+
+
+def test_profiler_uses_native_tracer():
+    import paddle_tpu.profiler as profiler
+    with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU]) as prof:
+        with profiler.RecordEvent("native_span"):
+            time.sleep(0.01)
+    names = [e.name for e in prof.events]
+    assert "native_span" in names
+    ev = next(e for e in prof.events if e.name == "native_span")
+    assert ev.end_ns - ev.start_ns >= 5_000_000  # >= 5ms
+
+
+def test_dataloader_buffer_reader():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.full((3,), i, dtype=np.float32), np.int64(i)
+
+        def __len__(self):
+            return 12
+
+    loader = DataLoader(DS(), batch_size=4, shuffle=False, num_workers=0,
+                        use_buffer_reader=True)
+    seen = []
+    for x, y in loader:
+        assert isinstance(x, paddle.Tensor)
+        seen.extend(np.asarray(y._data).tolist())
+    assert seen == list(range(12))
+    # second epoch works (fresh buffer thread)
+    assert sum(1 for _ in loader) == 3
+
+
+def test_dataloader_buffer_reader_propagates_worker_error():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Bad(Dataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom")
+            return np.zeros(2)
+
+        def __len__(self):
+            return 8
+
+    loader = DataLoader(Bad(), batch_size=2, num_workers=0,
+                        use_buffer_reader=True)
+    with pytest.raises(ValueError, match="boom"):
+        for _ in loader:
+            pass
