@@ -348,10 +348,15 @@ struct StoreClient {
 // handle registries
 // ---------------------------------------------------------------------------
 
+// The handle registries are heap-allocated and intentionally leaked: running
+// their destructors at process exit would join server threads / destroy
+// condvars that may still have waiters (blocked daemon threads), hanging exit.
 static std::mutex g_reg_mu;
 static int64_t g_next_handle = 1;
-static std::unordered_map<int64_t, std::unique_ptr<StoreServer>> g_servers;
-static std::unordered_map<int64_t, std::unique_ptr<StoreClient>> g_clients;
+static auto& g_servers =
+    *new std::unordered_map<int64_t, std::unique_ptr<StoreServer>>();
+static auto& g_clients =
+    *new std::unordered_map<int64_t, std::unique_ptr<StoreClient>>();
 
 struct QueueObj {
   std::mutex mu;
@@ -360,7 +365,11 @@ struct QueueObj {
   size_t capacity;
   bool closed = false;
 };
-static std::unordered_map<int64_t, std::unique_ptr<QueueObj>> g_queues;
+// shared_ptr: in-flight push/pop keep the object alive after queue_destroy —
+// destroying a condition_variable with live waiters blocks forever in glibc,
+// so the destructor must only run once the last waiter is gone.
+static auto& g_queues =
+    *new std::unordered_map<int64_t, std::shared_ptr<QueueObj>>();
 
 struct TraceEvent {
   std::string name;
@@ -670,7 +679,7 @@ static PyObject* py_store_list(PyObject*, PyObject* args) {
 static PyObject* py_queue_create(PyObject*, PyObject* args) {
   long long capacity;
   if (!PyArg_ParseTuple(args, "L", &capacity)) return nullptr;
-  auto q = std::make_unique<QueueObj>();
+  auto q = std::make_shared<QueueObj>();
   q->capacity = static_cast<size_t>(capacity > 0 ? capacity : 1);
   std::lock_guard<std::mutex> lk(g_reg_mu);
   int64_t h = g_next_handle++;
@@ -678,10 +687,10 @@ static PyObject* py_queue_create(PyObject*, PyObject* args) {
   return PyLong_FromLongLong(h);
 }
 
-static QueueObj* get_queue(long long h) {
+static std::shared_ptr<QueueObj> get_queue(long long h) {
   std::lock_guard<std::mutex> lk(g_reg_mu);
   auto it = g_queues.find(h);
-  return it == g_queues.end() ? nullptr : it->second.get();
+  return it == g_queues.end() ? nullptr : it->second;
 }
 
 static PyObject* py_queue_push(PyObject*, PyObject* args) {
@@ -689,7 +698,7 @@ static PyObject* py_queue_push(PyObject*, PyObject* args) {
   PyObject* obj;
   long long timeout_ms;
   if (!PyArg_ParseTuple(args, "LOL", &h, &obj, &timeout_ms)) return nullptr;
-  QueueObj* q = get_queue(h);
+  std::shared_ptr<QueueObj> q = get_queue(h);
   if (!q) {
     PyErr_SetString(PyExc_ValueError, "bad queue handle");
     return nullptr;
@@ -729,7 +738,7 @@ static PyObject* py_queue_pop(PyObject*, PyObject* args) {
   long long h;
   long long timeout_ms;
   if (!PyArg_ParseTuple(args, "LL", &h, &timeout_ms)) return nullptr;
-  QueueObj* q = get_queue(h);
+  std::shared_ptr<QueueObj> q = get_queue(h);
   if (!q) {
     PyErr_SetString(PyExc_ValueError, "bad queue handle");
     return nullptr;
@@ -774,7 +783,7 @@ static PyObject* py_queue_pop(PyObject*, PyObject* args) {
 static PyObject* py_queue_close(PyObject*, PyObject* args) {
   long long h;
   if (!PyArg_ParseTuple(args, "L", &h)) return nullptr;
-  QueueObj* q = get_queue(h);
+  std::shared_ptr<QueueObj> q = get_queue(h);
   if (!q) Py_RETURN_NONE;
   {
     std::lock_guard<std::mutex> lk(q->mu);
@@ -788,7 +797,7 @@ static PyObject* py_queue_close(PyObject*, PyObject* args) {
 static PyObject* py_queue_size(PyObject*, PyObject* args) {
   long long h;
   if (!PyArg_ParseTuple(args, "L", &h)) return nullptr;
-  QueueObj* q = get_queue(h);
+  std::shared_ptr<QueueObj> q = get_queue(h);
   if (!q) {
     PyErr_SetString(PyExc_ValueError, "bad queue handle");
     return nullptr;
@@ -800,19 +809,28 @@ static PyObject* py_queue_size(PyObject*, PyObject* args) {
 static PyObject* py_queue_destroy(PyObject*, PyObject* args) {
   long long h;
   if (!PyArg_ParseTuple(args, "L", &h)) return nullptr;
-  std::unique_ptr<QueueObj> q;
+  std::shared_ptr<QueueObj> q;
   {
     std::lock_guard<std::mutex> lk(g_reg_mu);
     auto it = g_queues.find(h);
     if (it != g_queues.end()) {
-      q = std::move(it->second);
+      q = it->second;
       g_queues.erase(it);
     }
   }
   if (q) {
-    // drop remaining refs under the GIL
-    for (PyObject* o : q->items) Py_DECREF(o);
-    q->items.clear();
+    // close + wake waiters, then drain item refs under the GIL; the QueueObj
+    // itself is freed by whichever thread drops the LAST shared_ptr, after
+    // every in-flight push/pop has left the condvars
+    std::deque<PyObject*> leftovers;
+    {
+      std::lock_guard<std::mutex> lk(q->mu);
+      q->closed = true;
+      leftovers.swap(q->items);
+    }
+    q->cv_pop.notify_all();
+    q->cv_push.notify_all();
+    for (PyObject* o : leftovers) Py_DECREF(o);
   }
   Py_RETURN_NONE;
 }

@@ -30,12 +30,16 @@ from . import amp
 from . import autograd
 from . import distributed
 from . import framework
+from . import hapi
 from . import incubate
 from . import io
 from . import jit
+from . import metric
 from . import nn
 from . import optimizer
 from . import profiler
+from . import static
+from .hapi import Model, callbacks, summary
 from .distributed.parallel import DataParallel
 from .framework.io import async_save, load, save
 from .nn import functional as _F

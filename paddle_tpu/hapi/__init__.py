@@ -1,0 +1,12 @@
+"""High-level training API (paddle.hapi analog): Model.fit/evaluate/predict,
+callbacks, and paddle.summary."""
+from __future__ import annotations
+
+from . import callbacks
+from .callbacks import (Callback, EarlyStopping, LRScheduler, ModelCheckpoint,
+                        ProgBarLogger)
+from .model import Model
+from .model_summary import summary
+
+__all__ = ["Model", "summary", "callbacks", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "LRScheduler", "EarlyStopping"]

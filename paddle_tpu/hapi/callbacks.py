@@ -1,0 +1,258 @@
+"""hapi training callbacks.
+
+Reference: python/paddle/hapi/callbacks.py — Callback base with the
+train/eval/predict begin/end + epoch/batch hooks, config_callbacks assembly,
+and the stock ProgBarLogger / ModelCheckpoint / LRScheduler / EarlyStopping.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class Callback:
+    """callbacks.py Callback analog: all hooks are optional overrides."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    # train
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    # eval
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    # predict
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def _call(self, name, *args):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+def _fmt(v):
+    if isinstance(v, numbers.Number):
+        return f"{v:.4f}"
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return "[" + ", ".join(_fmt(x) for x in np.ravel(np.asarray(v))) + "]"
+    return str(v)
+
+
+class ProgBarLogger(Callback):
+    """callbacks.py ProgBarLogger analog: per-log_freq step lines + epoch
+    summaries (plain lines rather than a terminal progress bar — logs must
+    stay readable when collated across ranks by the launcher)."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._step = 0
+        self._t0 = time.time()
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self.verbose == 0 or not logs:
+            return
+        if self._step % self.log_freq == 0 or (
+                self.steps and self._step == self.steps):
+            msg = " - ".join(f"{k}: {_fmt(v)}" for k, v in logs.items())
+            print(f"step {self._step}/{self.steps or '?'} - {msg}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose and logs:
+            dt = time.time() - self._t0
+            msg = " - ".join(f"{k}: {_fmt(v)}" for k, v in logs.items())
+            print(f"Epoch {epoch + 1} done ({dt:.1f}s) - {msg}")
+
+    def on_eval_begin(self, logs=None):
+        self._eval_t0 = time.time()
+        if self.verbose:
+            n = (logs or {}).get("steps")
+            print(f"Eval begin ({n or '?'} steps)")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose and logs:
+            dt = time.time() - self._eval_t0
+            msg = " - ".join(f"{k}: {_fmt(v)}" for k, v in logs.items()
+                             if k != "batch_size")
+            print(f"Eval done ({dt:.1f}s) - {msg}")
+
+
+class ModelCheckpoint(Callback):
+    """callbacks.py ModelCheckpoint analog: save every save_freq epochs into
+    save_dir/{epoch}, and save_dir/final at train end."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            print(f"save checkpoint at {os.path.abspath(path)}")
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            path = os.path.join(self.save_dir, "final")
+            print(f"save checkpoint at {os.path.abspath(path)}")
+            self.model.save(path)
+
+
+class LRScheduler(Callback):
+    """callbacks.py LRScheduler analog: steps the optimizer's lr scheduler
+    per epoch (default) or per batch."""
+
+    def __init__(self, by_step: bool = False, by_epoch: bool = True):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as _Sched
+        opt = getattr(self.model, "_optimizer", None)
+        sched = getattr(opt, "_learning_rate", None)
+        return sched if isinstance(sched, _Sched) else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            sched = self._sched()
+            if sched is not None:
+                sched.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            sched = self._sched()
+            if sched is not None:
+                sched.step()
+
+
+class EarlyStopping(Callback):
+    """callbacks.py EarlyStopping analog: monitors an eval metric; stops
+    training (model.stop_training) after `patience` evals without
+    min_delta improvement; optionally restores/keeps best weights."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = np.less
+        elif mode == "max":
+            self.monitor_op = np.greater
+        else:
+            self.monitor_op = (np.greater if ("acc" in monitor
+                                              or monitor.startswith("fmeasure"))
+                               else np.less)
+        self.min_delta *= 1 if self.monitor_op == np.greater else -1
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = np.inf if self.monitor_op == np.less else -np.inf
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple, np.ndarray)):
+            current = float(np.ravel(np.asarray(current))[0])
+        if self.monitor_op(current - self.min_delta, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.model is not None:
+                self.best_weights = {
+                    k: np.asarray(v._data).copy()
+                    for k, v in self.model.network.state_dict().items()}
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            if self.verbose:
+                print(f"Epoch early stopped: best {self.monitor} = "
+                      f"{self.best_value:.5f}")
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    """callbacks.py config_callbacks analog."""
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks = cbks + [LRScheduler()]
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({"batch_size": batch_size, "epochs": epochs,
+                    "steps": steps, "verbose": verbose,
+                    "metrics": metrics or []})
+    return lst

@@ -1,0 +1,327 @@
+"""hapi high-level Model API.
+
+Reference: python/paddle/hapi/model.py — ``Model`` (``:1054``) wrapping a
+Layer with prepare/fit/evaluate/predict/save/load, driven by the callbacks
+in callbacks.py; distributed data parallel handled inside
+(prepare_distributed_context, model.py:225).
+
+TPU-native: the dygraph path runs the eager tape; under a hybrid topology
+the network is wrapped in paddle_tpu.DataParallel so inputs shard over the
+dp mesh axis and GSPMD emits the gradient reductions.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import warnings
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.dataloader import DataLoader
+from ..io.dataset import Dataset
+from ..metric import Metric
+from ..nn.layer import Layer
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _np(x):
+    return np.asarray(x._data) if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Model:
+    """hapi/model.py Model:1054 analog."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._prepared = False
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer)
+                                     or callable(loss)):
+            raise TypeError("loss must be a Layer or a callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle_tpu.metric.Metric")
+        self._amp_configs = amp_configs
+        self._prepared = True
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    # -- single-batch entry points -------------------------------------------
+    def _forward(self, inputs):
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in _to_list(inputs)]
+        outputs = self.network(*ins)
+        return _to_list(outputs)
+
+    def _compute_loss(self, outputs, labels):
+        labels = [y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+                  for y in _to_list(labels)]
+        loss = self._loss(*(outputs + labels))
+        return loss, labels
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """model.py train_batch analog: one eager forward/backward/(step)."""
+        assert self._prepared, "call prepare() first"
+        self.network.train()
+        outputs = self._forward(inputs)
+        loss, labels_t = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels_t)
+        return self._wrap_loss(loss, metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        assert self._prepared, "call prepare() first"
+        self.network.eval()
+        from ..autograd import no_grad
+        with no_grad():
+            outputs = self._forward(inputs)
+            if self._loss is not None and labels is not None:
+                loss, labels_t = self._compute_loss(outputs, labels)
+            else:
+                loss, labels_t = None, [
+                    y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+                    for y in _to_list(labels)]
+        metrics = self._update_metrics(outputs, labels_t)
+        return self._wrap_loss(loss, metrics)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd import no_grad
+        with no_grad():
+            outputs = self._forward(inputs)
+        return [_np(o) for o in outputs]
+
+    def _update_metrics(self, outputs, labels):
+        vals = []
+        for m in self._metrics:
+            computed = m.compute(*(outputs + labels))
+            if not isinstance(computed, (list, tuple)):
+                computed = [computed]
+            vals.append(m.update(*computed))
+        return vals
+
+    def _wrap_loss(self, loss, metrics):
+        loss_np = [float(loss)] if loss is not None else []
+        if self._metrics:
+            return loss_np, metrics
+        return loss_np
+
+    # -- loops ----------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset) or hasattr(data, "__getitem__"):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # any iterable of batches
+
+    def _split_batch(self, batch, has_labels=True):
+        """Split a loader batch into (inputs, labels) by declared arity."""
+        batch = _to_list(batch)
+        if self._labels:
+            n_lbl = len(self._labels)
+        elif self._loss is not None:
+            n_lbl = 1
+        else:
+            n_lbl = 0
+        if not has_labels and len(batch) <= n_lbl:
+            # predict path with an unlabeled dataset: the whole batch is input
+            return batch, []
+        n_in = len(self._inputs) or max(len(batch) - n_lbl, 1)
+        ins, lbls = batch[:n_in], batch[n_in:]
+        return ins, lbls if has_labels else []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """model.py fit analog."""
+        assert self._prepared, "call prepare() first"
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                batch_size=batch_size, steps=steps,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=self._metrics_name())
+        self.stop_training = False
+        cbks.on_train_begin({})
+        iters_done = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, {})
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step, {})
+                ins, lbls = self._split_batch(batch)
+                # force the update on the epoch's final batch so tail
+                # gradients never leak into the next accumulation window
+                last = steps is not None and step == steps - 1
+                update = last or ((step + 1) % accumulate_grad_batches == 0)
+                res = self.train_batch(ins, lbls, update=update)
+                logs = self._merge_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                iters_done += 1
+                if num_iters is not None and iters_done >= num_iters:
+                    self.stop_training = True
+                if self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+
+    def _run_eval(self, loader, cbks):
+        for m in self._metrics:
+            m.reset()
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks.on_eval_begin({"steps": steps})
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step, {})
+            ins, lbls = self._split_batch(batch)
+            res = self.eval_batch(ins, lbls)
+            logs = self._merge_logs(res)
+            cbks.on_eval_batch_end(step, logs)
+        final = self._finalize_logs(logs)
+        cbks.on_eval_end(final)
+        return final
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        """model.py evaluate analog: returns {'loss': [...], metric: value}."""
+        assert self._prepared, "call prepare() first"
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                log_freq=log_freq, verbose=verbose,
+                                metrics=self._metrics_name(), mode="eval")
+        return self._run_eval(loader, cbks)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """model.py predict analog: list (per output) of per-batch arrays,
+        or stacked along batch when stack_outputs=True."""
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                verbose=verbose, mode="predict")
+        cbks.on_predict_begin({})
+        outputs: Optional[List[list]] = None
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step, {})
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outs = self.predict_batch(ins)
+            if outputs is None:
+                outputs = [[] for _ in outs]
+            for slot, o in zip(outputs, outs):
+                slot.append(o)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end({})
+        if outputs is None:
+            return []
+        if stack_outputs:
+            return [np.concatenate(slot, axis=0) for slot in outputs]
+        return outputs
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            names.extend(_to_list(m.name()))
+        return names
+
+    def _merge_logs(self, res):
+        logs = {}
+        if self._metrics:
+            loss_np, _ = res
+        else:
+            loss_np = res
+        if loss_np:
+            logs["loss"] = loss_np[0] if len(loss_np) == 1 else loss_np
+        for m in self._metrics:
+            names = _to_list(m.name())
+            vals = _to_list(m.accumulate())
+            for n, v in zip(names, vals):
+                logs[n] = v
+        return logs
+
+    def _finalize_logs(self, logs):
+        return dict(logs)
+
+    # -- persistence -----------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        """model.py save analog: <path>.pdparams (+ .pdopt). training=False
+        exports the inference program via paddle_tpu.jit.save."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if not training:
+            from .. import jit
+            spec = self._inputs or None
+            jit.save(self.network, path, input_spec=spec)
+            return
+        from ..framework.io import save as fw_save
+        fw_save(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            fw_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False,
+             reset_optimizer: bool = False):
+        """model.py load analog."""
+        from ..framework.io import load as fw_load
+        params = fw_load(path + ".pdparams")
+        state = self.network.state_dict()
+        if skip_mismatch:
+            matched = {}
+            for k, v in params.items():
+                if k in state and tuple(state[k].shape) == tuple(
+                        np.asarray(v._data if isinstance(v, Tensor) else v)
+                        .shape):
+                    matched[k] = v
+                else:
+                    warnings.warn(f"skip loading {k} (mismatch)")
+            params = matched
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(fw_load(opt_path))
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        if input_size is None and self._inputs:
+            input_size = [tuple(s.shape) for s in self._inputs]
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+__all__ = ["Model"]

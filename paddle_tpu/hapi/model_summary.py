@@ -1,0 +1,103 @@
+"""paddle.summary analog.
+
+Reference: python/paddle/hapi/model_summary.py — per-layer table of output
+shapes + parameter counts via forward hooks, and total/trainable counts +
+memory estimate.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _dtype_size(dtype_str: str) -> int:
+    if "64" in dtype_str:
+        return 8
+    if "16" in dtype_str or "bfloat16" in dtype_str:
+        return 2
+    if "8" in dtype_str or "bool" in dtype_str:
+        return 1
+    return 4
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Prints the layer table; returns {'total_params': n, 'trainable_params': n}."""
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or a concrete input")
+        sizes = (list(input_size) if isinstance(input_size, list)
+                 else [input_size])
+        if sizes and isinstance(sizes[0], int):
+            sizes = [tuple(sizes)]
+        dtypes = dtypes or ["float32"] * len(sizes)
+        if isinstance(dtypes, str):
+            dtypes = [dtypes] * len(sizes)
+        inputs = []
+        for shape, dt in zip(sizes, dtypes):
+            shape = tuple(2 if (d is None or d < 0) else d for d in shape)
+            np_dt = np.dtype("float32" if dt == "bfloat16" else dt)
+            t = Tensor(np.zeros(shape, dtype=np_dt))
+            if dt == "bfloat16":
+                t = t.astype("bfloat16")
+            inputs.append(t)
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, ins, outs):
+            out = outs[0] if isinstance(outs, (list, tuple)) else outs
+            shape = tuple(out.shape) if hasattr(out, "shape") else ()
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr.parameters(include_sublayers=False))
+            rows.append((f"{type(lyr).__name__}-{len(rows) + 1}",
+                         str(shape), n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if next(iter(sub.children()), None) is None:  # leaf layers only
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    was_training = net.training if hasattr(net, "training") else None
+    net.eval()
+    from ..autograd import no_grad
+    try:
+        with no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = 0
+    trainable = 0
+    for p in net.parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+
+    w1, w2, w3 = 28, 24, 14
+    line = "-" * (w1 + w2 + w3 + 4)
+    out = [line,
+           f" {'Layer (type)':<{w1}} {'Output Shape':<{w2}} {'Param #':>{w3}}",
+           "=" * (w1 + w2 + w3 + 4)]
+    for name, shape, n in rows:
+        out.append(f" {name:<{w1}} {shape:<{w2}} {n:>{w3},}")
+    out.append("=" * (w1 + w2 + w3 + 4))
+    out.append(f"Total params: {total:,}")
+    out.append(f"Trainable params: {trainable:,}")
+    out.append(f"Non-trainable params: {total - trainable:,}")
+    param_bytes = sum(int(np.prod(p.shape)) * _dtype_size(str(p.dtype))
+                      for p in net.parameters())
+    out.append(f"Params size (MB): {param_bytes / 1024 / 1024:.2f}")
+    out.append(line)
+    print("\n".join(out))
+    return {"total_params": total, "trainable_params": trainable}

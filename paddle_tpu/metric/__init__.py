@@ -1,0 +1,205 @@
+"""Evaluation metrics.
+
+Reference: python/paddle/metric/metrics.py — ``Metric`` base (reset/update/
+accumulate/name + the optional ``compute`` preprocessing stage that runs on
+device outputs before ``update`` sees numpy), and the stock metrics
+Accuracy / Precision / Recall / Auc.
+
+TPU-native: ``compute`` stays in jax-land (so topk etc. fuse into the eval
+step), ``update`` accumulates in numpy on host.
+"""
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_numpy(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._data)
+    return np.asarray(x)
+
+
+class Metric(metaclass=abc.ABCMeta):
+    """metrics.py Metric analog."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Optional device-side preprocessing: (pred, label, ...) -> the
+        tensors handed to update. Default: identity."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (metrics.py Accuracy analog)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = _to_numpy(pred)
+        label_np = _to_numpy(label)
+        # top-maxk indices along the last dim
+        idx = np.argsort(-pred_np, axis=-1)[..., :self.maxk]
+        if label_np.ndim == pred_np.ndim:
+            if label_np.shape[-1] == 1:      # [N, 1] integer labels
+                label_np = label_np[..., 0]
+            else:                            # one-hot / soft labels
+                label_np = np.argmax(label_np, axis=-1)
+        correct = (idx == label_np[..., None]).astype(np.float32)
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_numpy(correct)
+        num = int(np.prod(correct.shape[:-1]))
+        accs = []
+        for k in self.topk:
+            c = correct[..., :k].sum()
+            self.total[self.topk.index(k)] += float(c)
+            accs.append(float(c) / max(num, 1))
+        self.count += num
+        return np.array(accs[0] if len(self.topk) == 1 else accs)
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = 0
+
+    def accumulate(self):
+        res = [t / self.count if self.count > 0 else 0.0 for t in self.total]
+        return res[0] if len(self.topk) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision = tp / (tp + fp) (metrics.py Precision analog).
+    ``update(preds, labels)``: preds are probabilities of the positive class."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_numpy(preds)).astype(np.int64).reshape(-1)
+        labels = _to_numpy(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom > 0 else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall = tp / (tp + fn) (metrics.py Recall analog)."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_numpy(preds)).astype(np.int64).reshape(-1)
+        labels = _to_numpy(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom > 0 else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC-AUC via threshold bucketing (metrics.py Auc analog).
+
+    ``update(preds, labels)``: preds [N, 2] class probabilities (or [N]
+    positive-class scores), labels [N] in {0, 1}.
+    """
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self.curve = curve
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_numpy(preds)
+        labels = _to_numpy(labels).reshape(-1).astype(np.int64)
+        if preds.ndim == 2:
+            scores = preds[:, 1]
+        else:
+            scores = preds.reshape(-1)
+        buckets = np.clip((scores * self.num_thresholds).astype(np.int64), 0,
+                          self.num_thresholds)
+        pos = buckets[labels == 1]
+        neg = buckets[labels == 0]
+        np.add.at(self._stat_pos, pos, 1)
+        np.add.at(self._stat_neg, neg, 1)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+
+    def accumulate(self):
+        # integrate the ROC curve over descending thresholds (trapezoid),
+        # vectorized — accumulate() runs after every logged batch
+        tot_pos = float(self._stat_pos.sum())
+        tot_neg = float(self._stat_neg.sum())
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        pos = self._stat_pos[::-1].astype(np.float64)
+        neg = self._stat_neg[::-1].astype(np.float64)
+        cum_pos = np.cumsum(pos)
+        area = float(np.sum(neg * (cum_pos - pos / 2.0)))
+        return area / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name
+
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]

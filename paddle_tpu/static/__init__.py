@@ -1,0 +1,12 @@
+"""Static-graph style API.
+
+Reference: python/paddle/static — on TPU the "static graph" is a captured,
+jit-compiled XLA program (paddle_tpu.jit), so this namespace provides the
+declarative pieces the high-level APIs need (InputSpec today; the Program/
+Executor facade lives on the jit path).
+"""
+from __future__ import annotations
+
+from .input_spec import InputSpec
+
+__all__ = ["InputSpec"]

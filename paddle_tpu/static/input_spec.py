@@ -1,0 +1,62 @@
+"""InputSpec — declarative tensor signature.
+
+Reference: python/paddle/static/input_spec.py (shape with None for dynamic
+dims, dtype, name). Used by hapi Model, jit.to_static input_spec, and the
+serving export path.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class InputSpec:
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None, stop_gradient: bool = True):
+        self.shape = tuple(shape)
+        self.dtype = str(dtype).replace("paddle.", "")
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor: Tensor, name: Optional[str] = None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray: np.ndarray, name: Optional[str] = None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size: int) -> "InputSpec":
+        self.shape = (batch_size,) + tuple(self.shape)
+        return self
+
+    def unbatch(self) -> "InputSpec":
+        self.shape = tuple(self.shape[1:])
+        return self
+
+    def _zeros(self, batch_size: int = 2) -> Tensor:
+        """A concrete zero tensor with dynamic dims replaced (for tracing)."""
+        shape = tuple(batch_size if d is None or d < 0 else d
+                      for d in self.shape)
+        np_dtype = {"float32": np.float32, "float64": np.float64,
+                    "float16": np.float16, "bfloat16": np.float32,
+                    "int32": np.int32, "int64": np.int64,
+                    "bool": np.bool_}.get(self.dtype, np.float32)
+        t = Tensor(np.zeros(shape, dtype=np_dtype))
+        if self.dtype == "bfloat16":
+            t = t.astype("bfloat16")
+        return t
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    def __eq__(self, other):
+        return (isinstance(other, InputSpec) and self.shape == other.shape
+                and self.dtype == other.dtype and self.name == other.name)
+
+    def __hash__(self):
+        return hash((self.shape, self.dtype, self.name))

@@ -1,0 +1,241 @@
+"""Tests for the hapi Model API (hapi/model.py:1054 analog), metrics
+(paddle.metric), callbacks, and paddle.summary."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import Model, nn, optimizer
+from paddle_tpu.hapi.callbacks import EarlyStopping, ModelCheckpoint
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+class XorDS(Dataset):
+    """Tiny separable problem: label = x0 > x1."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        self.y = (self.x[:, 0] > self.x[:, 1]).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+
+
+def _model():
+    m = Model(_mlp())
+    m.prepare(optimizer=optimizer.Adam(learning_rate=0.05,
+                                       parameters=m.parameters()),
+              loss=nn.CrossEntropyLoss(),
+              metrics=Accuracy())
+    return m
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_accuracy_metric():
+    m = Accuracy()
+    pred = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]])
+    label = np.array([0, 1, 1, 1])
+    correct = m.compute(pred, label)
+    m.update(correct)
+    assert m.accumulate() == pytest.approx(0.75)
+    m.reset()
+    assert m.accumulate() == 0.0
+
+
+def test_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.5, 0.4], [0.1, 0.2, 0.7]])
+    label = np.array([2, 1])
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert top1 == pytest.approx(0.0)
+    assert top2 == pytest.approx(1.0)
+    assert m.name() == ["acc_top1", "acc_top2"]
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.7])   # rint -> 1,1,0,1
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)   # tp=2 fp=1
+    assert r.accumulate() == pytest.approx(2 / 3)   # tp=2 fn=1
+
+
+def test_auc_perfect_and_random():
+    auc = Auc()
+    scores = np.array([[0.1, 0.9]] * 50 + [[0.9, 0.1]] * 50)
+    labels = np.array([1] * 50 + [0] * 50)
+    auc.update(scores, labels)
+    assert auc.accumulate() == pytest.approx(1.0, abs=1e-3)
+    auc.reset()
+    auc.update(np.array([[0.5, 0.5]] * 10), np.array([0, 1] * 5))
+    assert auc.accumulate() == pytest.approx(0.5, abs=1e-6)
+
+
+# -- Model -------------------------------------------------------------------
+
+def test_model_fit_reduces_loss_and_reports_acc(capsys):
+    m = _model()
+    ds = XorDS(64)
+    m.fit(ds, batch_size=16, epochs=8, verbose=0)
+    res = m.evaluate(ds, batch_size=16, verbose=0)
+    assert res["acc"] > 0.9
+    loss_val = res["loss"][0] if isinstance(res["loss"], list) else res["loss"]
+    assert loss_val is not None and np.isfinite(loss_val)
+
+
+def test_model_fit_with_dataloader_and_eval_data():
+    m = _model()
+    train = DataLoader(XorDS(48, seed=1), batch_size=12)
+    val = DataLoader(XorDS(24, seed=2), batch_size=12)
+    m.fit(train, val, epochs=3, verbose=0)
+    out = m.evaluate(val, verbose=0)
+    assert "acc" in out and "loss" in out
+
+
+def test_model_train_eval_predict_batch():
+    m = _model()
+    x = np.random.randn(4, 8).astype(np.float32)
+    y = np.array([0, 1, 0, 1])
+    loss1, _ = m.train_batch([x], [y])
+    loss2, _ = m.eval_batch([x], [y])
+    assert np.isfinite(loss1[0]) and np.isfinite(loss2[0])
+    preds = m.predict_batch([x])
+    assert preds[0].shape == (4, 2)
+
+
+def test_model_predict_stacked():
+    m = _model()
+    ds = XorDS(20, seed=3)
+    outs = m.predict(ds, batch_size=8, stack_outputs=True, verbose=0)
+    assert len(outs) == 1 and outs[0].shape == (20, 2)
+
+
+def test_model_save_load(tmp_path):
+    m = _model()
+    ds = XorDS(32)
+    m.fit(ds, batch_size=16, epochs=2, verbose=0)
+    path = str(tmp_path / "ckpt" / "model")
+    m.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    m2 = _model()
+    m2.load(path)
+    x = np.random.randn(4, 8).astype(np.float32)
+    np.testing.assert_allclose(m.predict_batch([x])[0],
+                               m2.predict_batch([x])[0], rtol=1e-6)
+
+
+def test_model_checkpoint_callback(tmp_path):
+    m = _model()
+    save_dir = str(tmp_path / "cbk")
+    m.fit(XorDS(16), batch_size=8, epochs=2, verbose=0,
+          callbacks=[ModelCheckpoint(save_freq=1, save_dir=save_dir)])
+    assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+
+
+def test_early_stopping_stops():
+    m = _model()
+    es = EarlyStopping(monitor="acc", mode="max", patience=0, verbose=0)
+    # with patience=0 and a metric that stops improving, training halts early
+    m.fit(XorDS(64), eval_data=XorDS(16, seed=9), batch_size=16, epochs=50,
+          eval_freq=1, verbose=0, callbacks=[es])
+    assert m.stop_training
+
+
+def test_num_iters_limits_training():
+    m = _model()
+    seen = []
+
+    class Counter(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            seen.append(step)
+
+    m.fit(XorDS(64), batch_size=8, epochs=10, num_iters=3, verbose=0,
+          callbacks=[Counter()])
+    assert len(seen) == 3
+
+
+def test_summary_counts_params(capsys):
+    net = _mlp()
+    info = paddle.summary(net, (4, 8))
+    captured = capsys.readouterr().out
+    expected = 8 * 32 + 32 + 32 * 2 + 2
+    assert info["total_params"] == expected
+    assert "Linear" in captured and f"{expected:,}" in captured
+
+
+def test_summary_via_model():
+    m = _model()
+    info = m.summary(input_size=(2, 8))
+    assert info["trainable_params"] == info["total_params"]
+
+
+def test_accuracy_column_labels():
+    # [N, 1] integer labels (canonical shape) must not be argmaxed away
+    m = Accuracy()
+    pred = np.array([[0.1, 0.9], [0.8, 0.2]])
+    label = np.array([[1], [0]])
+    m.update(m.compute(pred, label))
+    assert m.accumulate() == pytest.approx(1.0)
+
+
+def test_lr_scheduler_callback_steps_fit():
+    from paddle_tpu.optimizer.lr import StepDecay
+    sched = StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+    net = _mlp()
+    m = Model(net)
+    m.prepare(optimizer=optimizer.Adam(learning_rate=sched,
+                                       parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    m.fit(XorDS(16), batch_size=8, epochs=3, verbose=0)
+    # stepped once per epoch: 0.1 -> 0.05 -> 0.025 -> 0.0125
+    assert m._optimizer.get_lr() == pytest.approx(0.1 * 0.5 ** 3)
+
+
+def test_predict_unlabeled_dataset():
+    class TestDS(Dataset):
+        def __getitem__(self, i):
+            return np.zeros(8, dtype=np.float32)  # inputs only, no label
+
+        def __len__(self):
+            return 6
+
+    m = _model()  # loss prepared, but predict data has no labels
+    outs = m.predict(TestDS(), batch_size=3, stack_outputs=True, verbose=0)
+    assert outs[0].shape == (6, 2)
+
+
+def test_grad_accumulation_flushes_epoch_tail():
+    m = _model()
+    # 4 steps/epoch with accumulate=3: the final step must still update
+    m.fit(XorDS(32), batch_size=8, epochs=1, verbose=0,
+          accumulate_grad_batches=3)
+    for p in m.parameters():
+        assert p._grad is None  # cleared by the forced tail update
+
+
+def test_input_spec():
+    from paddle_tpu.static import InputSpec
+    s = InputSpec([None, 8], "float32", name="x")
+    t = s._zeros(4)
+    assert tuple(t.shape) == (4, 8)
+    s2 = InputSpec.from_tensor(t)
+    assert s2.shape == (4, 8)
+    assert s.batch(3).shape == (3, None, 8)
+    assert s.unbatch().shape == (None, 8)
