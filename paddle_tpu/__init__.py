@@ -29,8 +29,15 @@ from .ops import (abs, all, any, max, min, pow, round, sum)  # noqa: F401
 from . import amp
 from . import autograd
 from . import distributed
+from . import distribution
+from . import fft
 from . import framework
 from . import hapi
+from . import signal
+# `from .ops import *` above bound paddle_tpu.linalg to ops.linalg (wildcard
+# re-exports submodule names); force the real namespace package over it
+import importlib as _importlib
+linalg = _importlib.import_module(__name__ + ".linalg")
 from . import incubate
 from . import io
 from . import jit

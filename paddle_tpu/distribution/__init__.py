@@ -1,0 +1,37 @@
+"""paddle.distribution analog.
+
+Reference: python/paddle/distribution/__init__.py. Distributions compute
+through the op registry, so log_prob/rsample land on the autograd tape as
+single fused XLA ops, and sampling threads the framework RNG (compiled-step
+capture tracks the key state).
+"""
+from __future__ import annotations
+
+from .continuous import (Beta, Cauchy, Dirichlet, Exponential, Gamma, Gumbel,
+                         Laplace, LogNormal, MultivariateNormal, Normal,
+                         StudentT, Uniform)
+from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
+                       Multinomial, Poisson)
+from .distribution import (Distribution, ExponentialFamily, Independent,
+                           TransformedDistribution)
+from .kl import kl_divergence, register_kl
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+                        StackTransform, StickBreakingTransform, TanhTransform,
+                        Transform)
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Independent",
+    "TransformedDistribution",
+    "Normal", "Uniform", "Beta", "Gamma", "Dirichlet", "Exponential",
+    "Laplace", "Gumbel", "LogNormal", "Cauchy", "StudentT",
+    "MultivariateNormal",
+    "Bernoulli", "Categorical", "Geometric", "Multinomial", "Poisson",
+    "Binomial",
+    "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]

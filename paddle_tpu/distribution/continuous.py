@@ -1,0 +1,701 @@
+"""Continuous distributions.
+
+Reference: python/paddle/distribution/{normal,uniform,beta,gamma,dirichlet,
+exponential,laplace,gumbel,lognormal,cauchy,student_t,multivariate_normal}.py.
+Each method compiles to one fused XLA op via the registry dispatch.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..ops.registry import dispatch
+from .distribution import Distribution, ExponentialFamily, _shape, _t
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+def _bshape(*ts):
+    return tuple(np.broadcast_shapes(*[tuple(t.shape) for t in ts]))
+
+
+class Normal(ExponentialFamily):
+    """normal.py Normal analog (loc/scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return dispatch(jnp.square, (self.scale,), {}, op_name="normal_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(loc, scale):
+            eps = jax.random.normal(key, out_shape, dtype=loc.dtype)
+            return loc + scale * eps
+
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="normal_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, loc, scale):
+            return (-0.5 * jnp.square((v - loc) / scale)
+                    - jnp.log(scale) - _HALF_LOG_2PI)
+
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="normal_log_prob")
+
+    def entropy(self):
+        def _impl(loc, scale):
+            return jnp.broadcast_to(0.5 + _HALF_LOG_2PI + jnp.log(scale),
+                                    jnp.broadcast_shapes(loc.shape,
+                                                         scale.shape))
+
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="normal_entropy")
+
+    def cdf(self, value):
+        def _impl(v, loc, scale):
+            return 0.5 * (1 + jax.scipy.special.erf(
+                (v - loc) / (scale * math.sqrt(2.0))))
+
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="normal_cdf")
+
+    def icdf(self, value):
+        def _impl(p, loc, scale):
+            return loc + scale * math.sqrt(2.0) * jax.scipy.special.erfinv(
+                2 * p - 1)
+
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="normal_icdf")
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class LogNormal(Normal):
+    """lognormal.py analog: exp(Normal(loc, scale))."""
+
+    @property
+    def mean(self):
+        def _impl(loc, scale):
+            return jnp.exp(loc + 0.5 * jnp.square(scale))
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="lognormal_mean")
+
+    @property
+    def variance(self):
+        def _impl(loc, scale):
+            s2 = jnp.square(scale)
+            return (jnp.exp(s2) - 1) * jnp.exp(2 * loc + s2)
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="lognormal_var")
+
+    def rsample(self, shape=()):
+        z = Normal.rsample(self, shape)
+        return dispatch(jnp.exp, (z,), {}, op_name="lognormal_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, loc, scale):
+            lv = jnp.log(v)
+            return (-0.5 * jnp.square((lv - loc) / scale)
+                    - jnp.log(scale) - _HALF_LOG_2PI - lv)
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="lognormal_log_prob")
+
+    def entropy(self):
+        def _impl(loc, scale):
+            return jnp.broadcast_to(
+                0.5 + _HALF_LOG_2PI + jnp.log(scale) + loc,
+                jnp.broadcast_shapes(loc.shape, scale.shape))
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="lognormal_entropy")
+
+    def cdf(self, value):
+        def _impl(v, loc, scale):
+            return 0.5 * (1 + jax.scipy.special.erf(
+                (jnp.log(v) - loc) / (scale * math.sqrt(2.0))))
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="lognormal_cdf")
+
+
+class Uniform(Distribution):
+    """uniform.py Uniform analog (low/high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(_bshape(self.low, self.high))
+
+    @property
+    def mean(self):
+        def _impl(lo, hi):
+            return (lo + hi) / 2
+        return dispatch(_impl, (self.low, self.high), {},
+                        op_name="uniform_mean")
+
+    @property
+    def variance(self):
+        def _impl(lo, hi):
+            return jnp.square(hi - lo) / 12
+        return dispatch(_impl, (self.low, self.high), {},
+                        op_name="uniform_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(lo, hi):
+            u = jax.random.uniform(key, out_shape, dtype=lo.dtype)
+            return lo + (hi - lo) * u
+
+        return dispatch(_impl, (self.low, self.high), {},
+                        op_name="uniform_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, lo, hi):
+            inside = jnp.logical_and(v >= lo, v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return dispatch(_impl, (_t(value), self.low, self.high), {},
+                        op_name="uniform_log_prob")
+
+    def entropy(self):
+        def _impl(lo, hi):
+            return jnp.log(hi - lo)
+        return dispatch(_impl, (self.low, self.high), {},
+                        op_name="uniform_entropy")
+
+    def cdf(self, value):
+        def _impl(v, lo, hi):
+            return jnp.clip((v - lo) / (hi - lo), 0.0, 1.0)
+        return dispatch(_impl, (_t(value), self.low, self.high), {},
+                        op_name="uniform_cdf")
+
+
+class Exponential(ExponentialFamily):
+    """exponential.py analog (rate)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return dispatch(jnp.reciprocal, (self.rate,), {}, op_name="exp_mean")
+
+    @property
+    def variance(self):
+        def _impl(r):
+            return 1.0 / jnp.square(r)
+        return dispatch(_impl, (self.rate,), {}, op_name="exp_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(r):
+            u = jax.random.uniform(key, out_shape, dtype=r.dtype,
+                                   minval=jnp.finfo(r.dtype).tiny)
+            return -jnp.log(u) / r
+
+        return dispatch(_impl, (self.rate,), {}, op_name="exp_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, r):
+            return jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf)
+        return dispatch(_impl, (_t(value), self.rate), {},
+                        op_name="exp_log_prob")
+
+    def entropy(self):
+        def _impl(r):
+            return 1.0 - jnp.log(r)
+        return dispatch(_impl, (self.rate,), {}, op_name="exp_entropy")
+
+    def cdf(self, value):
+        def _impl(v, r):
+            return jnp.where(v >= 0, 1 - jnp.exp(-r * v), 0.0)
+        return dispatch(_impl, (_t(value), self.rate), {}, op_name="exp_cdf")
+
+
+class Laplace(Distribution):
+    """laplace.py analog (loc/scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def _impl(s):
+            return 2.0 * jnp.square(s)
+        return dispatch(_impl, (self.scale,), {}, op_name="laplace_var")
+
+    @property
+    def stddev(self):
+        def _impl(s):
+            return math.sqrt(2.0) * s
+        return dispatch(_impl, (self.scale,), {}, op_name="laplace_std")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(loc, scale):
+            u = jax.random.uniform(key, out_shape, dtype=loc.dtype,
+                                   minval=-0.5 + 1e-7, maxval=0.5)
+            return loc - scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="laplace_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, loc, scale):
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="laplace_log_prob")
+
+    def entropy(self):
+        def _impl(loc, scale):
+            return jnp.broadcast_to(1 + jnp.log(2 * scale),
+                                    jnp.broadcast_shapes(loc.shape,
+                                                         scale.shape))
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="laplace_entropy")
+
+    def cdf(self, value):
+        def _impl(v, loc, scale):
+            z = (v - loc) / scale
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="laplace_cdf")
+
+    def icdf(self, value):
+        def _impl(p, loc, scale):
+            a = p - 0.5
+            return loc - scale * jnp.sign(a) * jnp.log1p(-2 * jnp.abs(a))
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="laplace_icdf")
+
+
+class Gumbel(Distribution):
+    """gumbel.py analog (loc/scale)."""
+
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        def _impl(loc, scale):
+            return loc + self._EULER * scale
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="gumbel_mean")
+
+    @property
+    def variance(self):
+        def _impl(s):
+            return (math.pi ** 2 / 6.0) * jnp.square(s)
+        return dispatch(_impl, (self.scale,), {}, op_name="gumbel_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(loc, scale):
+            g = jax.random.gumbel(key, out_shape, dtype=loc.dtype)
+            return loc + scale * g
+
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="gumbel_log_prob")
+
+    def entropy(self):
+        def _impl(loc, scale):
+            return jnp.broadcast_to(jnp.log(scale) + 1 + self._EULER,
+                                    jnp.broadcast_shapes(loc.shape,
+                                                         scale.shape))
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="gumbel_entropy")
+
+    def cdf(self, value):
+        def _impl(v, loc, scale):
+            return jnp.exp(-jnp.exp(-(v - loc) / scale))
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="gumbel_cdf")
+
+
+class Cauchy(Distribution):
+    """cauchy.py analog (loc/scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(loc, scale):
+            u = jax.random.uniform(key, out_shape, dtype=loc.dtype,
+                                   minval=1e-7, maxval=1.0 - 1e-7)
+            return loc + scale * jnp.tan(math.pi * (u - 0.5))
+
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="cauchy_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, loc, scale):
+            z = (v - loc) / scale
+            return -math.log(math.pi) - jnp.log(scale) - jnp.log1p(
+                jnp.square(z))
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="cauchy_log_prob")
+
+    def entropy(self):
+        def _impl(loc, scale):
+            return jnp.broadcast_to(math.log(4 * math.pi) + jnp.log(scale),
+                                    jnp.broadcast_shapes(loc.shape,
+                                                         scale.shape))
+        return dispatch(_impl, (self.loc, self.scale), {},
+                        op_name="cauchy_entropy")
+
+    def cdf(self, value):
+        def _impl(v, loc, scale):
+            return jnp.arctan((v - loc) / scale) / math.pi + 0.5
+        return dispatch(_impl, (_t(value), self.loc, self.scale), {},
+                        op_name="cauchy_cdf")
+
+
+class Gamma(ExponentialFamily):
+    """gamma.py analog (concentration/rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(_bshape(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        def _impl(a, r):
+            return a / r
+        return dispatch(_impl, (self.concentration, self.rate), {},
+                        op_name="gamma_mean")
+
+    @property
+    def variance(self):
+        def _impl(a, r):
+            return a / jnp.square(r)
+        return dispatch(_impl, (self.concentration, self.rate), {},
+                        op_name="gamma_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(a, r):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, out_shape),
+                                 dtype=a.dtype)
+            return g / r
+
+        return dispatch(_impl, (self.concentration, self.rate), {},
+                        op_name="gamma_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, a, r):
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(a))
+        return dispatch(_impl, (_t(value), self.concentration, self.rate), {},
+                        op_name="gamma_log_prob")
+
+    def entropy(self):
+        def _impl(a, r):
+            return (a - jnp.log(r) + jax.scipy.special.gammaln(a)
+                    + (1 - a) * jax.scipy.special.digamma(a))
+        return dispatch(_impl, (self.concentration, self.rate), {},
+                        op_name="gamma_entropy")
+
+
+class Beta(ExponentialFamily):
+    """beta.py analog (alpha/beta)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(_bshape(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        def _impl(a, b):
+            return a / (a + b)
+        return dispatch(_impl, (self.alpha, self.beta), {},
+                        op_name="beta_mean")
+
+    @property
+    def variance(self):
+        def _impl(a, b):
+            s = a + b
+            return a * b / (jnp.square(s) * (s + 1))
+        return dispatch(_impl, (self.alpha, self.beta), {},
+                        op_name="beta_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(a, b):
+            return jax.random.beta(key, jnp.broadcast_to(a, out_shape),
+                                   jnp.broadcast_to(b, out_shape))
+
+        return dispatch(_impl, (self.alpha, self.beta), {},
+                        op_name="beta_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.gammaln(a)
+                       + jax.scipy.special.gammaln(b)
+                       - jax.scipy.special.gammaln(a + b)))
+        return dispatch(_impl, (_t(value), self.alpha, self.beta), {},
+                        op_name="beta_log_prob")
+
+    def entropy(self):
+        def _impl(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return dispatch(_impl, (self.alpha, self.beta), {},
+                        op_name="beta_entropy")
+
+
+class Dirichlet(ExponentialFamily):
+    """dirichlet.py analog (concentration)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shp = tuple(self.concentration.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        def _impl(a):
+            return a / jnp.sum(a, axis=-1, keepdims=True)
+        return dispatch(_impl, (self.concentration,), {},
+                        op_name="dirichlet_mean")
+
+    @property
+    def variance(self):
+        def _impl(a):
+            a0 = jnp.sum(a, axis=-1, keepdims=True)
+            m = a / a0
+            return m * (1 - m) / (a0 + 1)
+        return dispatch(_impl, (self.concentration,), {},
+                        op_name="dirichlet_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape + self.event_shape
+
+        def _impl(a):
+            return jax.random.dirichlet(
+                key, jnp.broadcast_to(a, out_shape), dtype=a.dtype)
+
+        return dispatch(_impl, (self.concentration,), {},
+                        op_name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, a):
+            lbeta = (jnp.sum(jax.scipy.special.gammaln(a), axis=-1)
+                     - jax.scipy.special.gammaln(jnp.sum(a, axis=-1)))
+            return jnp.sum((a - 1) * jnp.log(v), axis=-1) - lbeta
+        return dispatch(_impl, (_t(value), self.concentration), {},
+                        op_name="dirichlet_log_prob")
+
+    def entropy(self):
+        def _impl(a):
+            dg = jax.scipy.special.digamma
+            k = a.shape[-1]
+            a0 = jnp.sum(a, axis=-1)
+            lbeta = (jnp.sum(jax.scipy.special.gammaln(a), axis=-1)
+                     - jax.scipy.special.gammaln(a0))
+            return (lbeta + (a0 - k) * dg(a0)
+                    - jnp.sum((a - 1) * dg(a), axis=-1))
+        return dispatch(_impl, (self.concentration,), {},
+                        op_name="dirichlet_entropy")
+
+
+class StudentT(Distribution):
+    """student_t.py analog (df/loc/scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        def _impl(df, loc):
+            return jnp.where(df > 1, loc, jnp.nan)
+        return dispatch(_impl, (self.df, self.loc), {},
+                        op_name="studentt_mean")
+
+    @property
+    def variance(self):
+        def _impl(df, scale):
+            v = jnp.square(scale) * df / (df - 2)
+            return jnp.where(df > 2, v,
+                             jnp.where(df > 1, jnp.inf, jnp.nan))
+        return dispatch(_impl, (self.df, self.scale), {},
+                        op_name="studentt_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(df, loc, scale):
+            t = jax.random.t(key, jnp.broadcast_to(df, out_shape),
+                             dtype=loc.dtype)
+            return loc + scale * t
+
+        return dispatch(_impl, (self.df, self.loc, self.scale), {},
+                        op_name="studentt_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, df, loc, scale):
+            z = (v - loc) / scale
+            return (jax.scipy.special.gammaln((df + 1) / 2)
+                    - jax.scipy.special.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+                    - (df + 1) / 2 * jnp.log1p(jnp.square(z) / df))
+        return dispatch(_impl, (_t(value), self.df, self.loc, self.scale), {},
+                        op_name="studentt_log_prob")
+
+    def entropy(self):
+        def _impl(df, scale):
+            dg = jax.scipy.special.digamma
+            return ((df + 1) / 2 * (dg((df + 1) / 2) - dg(df / 2))
+                    + 0.5 * jnp.log(df)
+                    + jax.scipy.special.betaln(df / 2, 0.5)
+                    + jnp.log(scale))
+        return dispatch(_impl, (self.df, self.scale), {},
+                        op_name="studentt_entropy")
+
+
+class MultivariateNormal(Distribution):
+    """multivariate_normal.py analog (loc + covariance_matrix)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _t(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError("give exactly one of covariance_matrix / "
+                             "scale_tril")
+        if covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self._scale_tril = dispatch(
+                jnp.linalg.cholesky, (self.covariance_matrix,), {},
+                op_name="mvn_chol")
+        else:
+            self._scale_tril = _t(scale_tril)
+
+            def _cov(L):
+                return L @ jnp.swapaxes(L, -1, -2)
+            self.covariance_matrix = dispatch(
+                _cov, (self._scale_tril,), {}, op_name="mvn_cov")
+        d = tuple(self.loc.shape)[-1]
+        batch = tuple(np.broadcast_shapes(
+            tuple(self.loc.shape)[:-1],
+            tuple(self._scale_tril.shape)[:-2]))
+        super().__init__(batch, (d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def _impl(cov):
+            return jnp.diagonal(cov, axis1=-2, axis2=-1)
+        return dispatch(_impl, (self.covariance_matrix,), {},
+                        op_name="mvn_var")
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape + self.event_shape
+
+        def _impl(loc, L):
+            eps = jax.random.normal(key, out_shape, dtype=loc.dtype)
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return dispatch(_impl, (self.loc, self._scale_tril), {},
+                        op_name="mvn_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, loc, L):
+            d = loc.shape[-1]
+            diff = v - loc
+            sol = jax.scipy.linalg.solve_triangular(L, diff[..., None],
+                                                    lower=True)[..., 0]
+            maha = jnp.sum(jnp.square(sol), axis=-1)
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
+                             axis=-1)
+            return -0.5 * maha - logdet - 0.5 * d * math.log(2 * math.pi)
+        return dispatch(_impl, (_t(value), self.loc, self._scale_tril), {},
+                        op_name="mvn_log_prob")
+
+    def entropy(self):
+        def _impl(L):
+            d = L.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
+                             axis=-1)
+            return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+        return dispatch(_impl, (self._scale_tril,), {},
+                        op_name="mvn_entropy")

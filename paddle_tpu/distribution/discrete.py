@@ -1,0 +1,360 @@
+"""Discrete distributions.
+
+Reference: python/paddle/distribution/{bernoulli,categorical,geometric,
+multinomial,poisson,binomial}.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..ops.registry import dispatch
+from .distribution import Distribution, ExponentialFamily, _shape, _t
+
+
+class Bernoulli(ExponentialFamily):
+    """bernoulli.py analog (probs)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        def _impl(p):
+            return p * (1 - p)
+        return dispatch(_impl, (self.probs,), {}, op_name="bernoulli_var")
+
+    def sample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(p):
+            return jax.random.bernoulli(
+                key, jnp.broadcast_to(p, out_shape)).astype(p.dtype)
+
+        return dispatch(_impl, (self.probs,), {},
+                        op_name="bernoulli_sample").detach()
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (paddle's rsample w/ temperature)."""
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(p):
+            logits = jnp.log(p) - jnp.log1p(-p)
+            u = jax.random.uniform(key, out_shape, dtype=p.dtype,
+                                   minval=1e-7, maxval=1 - 1e-7)
+            lg = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((logits + lg) / temperature)
+
+        return dispatch(_impl, (self.probs,), {},
+                        op_name="bernoulli_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, p):
+            eps = 1e-8
+            return v * jnp.log(p + eps) + (1 - v) * jnp.log1p(-p + eps)
+        return dispatch(_impl, (_t(value), self.probs), {},
+                        op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        def _impl(p):
+            eps = 1e-8
+            return -(p * jnp.log(p + eps) + (1 - p) * jnp.log1p(-p + eps))
+        return dispatch(_impl, (self.probs,), {},
+                        op_name="bernoulli_entropy")
+
+    def cdf(self, value):
+        def _impl(v, p):
+            return jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - p, 1.0))
+        return dispatch(_impl, (_t(value), self.probs), {},
+                        op_name="bernoulli_cdf")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Categorical(Distribution):
+    """categorical.py analog (logits; paddle's Categorical takes logits that
+    are unnormalized log-probabilities OR positive weights)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        shp = tuple(self.logits.shape)
+        super().__init__(shp[:-1])
+        self._num_events = shp[-1]
+
+    @property
+    def probs_tensor(self):
+        def _impl(l):
+            return jax.nn.softmax(l, axis=-1)
+        return dispatch(_impl, (self.logits,), {}, op_name="categorical_probs")
+
+    def sample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(l):
+            return jax.random.categorical(
+                key, jnp.broadcast_to(l, out_shape + (l.shape[-1],)), axis=-1)
+
+        return dispatch(_impl, (self.logits,), {},
+                        op_name="categorical_sample").detach()
+
+    def log_prob(self, value):
+        def _impl(v, l):
+            logp = jax.nn.log_softmax(l, axis=-1)
+            v = v.astype(jnp.int32)
+            # broadcast sample dims of v against the batch dims of logits
+            tgt = jnp.broadcast_shapes(v.shape, logp.shape[:-1])
+            logp_b = jnp.broadcast_to(logp, tgt + logp.shape[-1:])
+            v_b = jnp.broadcast_to(v, tgt)
+            return jnp.take_along_axis(logp_b, v_b[..., None], axis=-1)[..., 0]
+        return dispatch(_impl, (_t(value, dtype="int64"), self.logits), {},
+                        op_name="categorical_log_prob")
+
+    def probs(self, value):
+        lp = self.log_prob(value)
+        return dispatch(jnp.exp, (lp,), {}, op_name="categorical_prob")
+
+    def entropy(self):
+        def _impl(l):
+            logp = jax.nn.log_softmax(l, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return dispatch(_impl, (self.logits,), {},
+                        op_name="categorical_entropy")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Geometric(Distribution):
+    """geometric.py analog (probs; support {0, 1, 2, ...})."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        def _impl(p):
+            return (1 - p) / p
+        return dispatch(_impl, (self.probs,), {}, op_name="geometric_mean")
+
+    @property
+    def variance(self):
+        def _impl(p):
+            return (1 - p) / jnp.square(p)
+        return dispatch(_impl, (self.probs,), {}, op_name="geometric_var")
+
+    def sample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(p):
+            u = jax.random.uniform(key, out_shape, dtype=p.dtype,
+                                   minval=jnp.finfo(p.dtype).tiny)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        return dispatch(_impl, (self.probs,), {},
+                        op_name="geometric_sample").detach()
+
+    def log_prob(self, value):
+        def _impl(v, p):
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return dispatch(_impl, (_t(value), self.probs), {},
+                        op_name="geometric_log_prob")
+
+    def entropy(self):
+        def _impl(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return dispatch(_impl, (self.probs,), {},
+                        op_name="geometric_entropy")
+
+    def cdf(self, value):
+        def _impl(v, p):
+            return 1 - jnp.power(1 - p, jnp.floor(v) + 1)
+        return dispatch(_impl, (_t(value), self.probs), {},
+                        op_name="geometric_cdf")
+
+
+class Multinomial(Distribution):
+    """multinomial.py analog (total_count + probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shp = tuple(self.probs.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        n = self.total_count
+
+        def _impl(p):
+            return n * p
+        return dispatch(_impl, (self.probs,), {}, op_name="multinomial_mean")
+
+    @property
+    def variance(self):
+        n = self.total_count
+
+        def _impl(p):
+            return n * p * (1 - p)
+        return dispatch(_impl, (self.probs,), {}, op_name="multinomial_var")
+
+    def sample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        n = self.total_count
+        out_batch = shape + self.batch_shape
+        k = self.event_shape[0]
+
+        def _impl(p):
+            logits = jnp.log(jnp.broadcast_to(p, out_batch + (k,)))
+            draws = jax.random.categorical(key, logits[..., None, :],
+                                           axis=-1,
+                                           shape=out_batch + (n,))
+            return jnp.sum(jax.nn.one_hot(draws, k, dtype=p.dtype), axis=-2)
+
+        return dispatch(_impl, (self.probs,), {},
+                        op_name="multinomial_sample").detach()
+
+    def log_prob(self, value):
+        n = self.total_count
+
+        def _impl(v, p):
+            logp = jnp.log(p / jnp.sum(p, axis=-1, keepdims=True))
+            coeff = (jax.scipy.special.gammaln(jnp.asarray(n + 1.0))
+                     - jnp.sum(jax.scipy.special.gammaln(v + 1.0), axis=-1))
+            return coeff + jnp.sum(v * logp, axis=-1)
+        return dispatch(_impl, (_t(value), self.probs), {},
+                        op_name="multinomial_log_prob")
+
+    def entropy(self):
+        """Exact entropy has no closed form; paddle uses the sum of the
+        binomial marginal entropies bound — we use a 2nd-order Stirling
+        approximation of E[-log P(X)]."""
+        n = self.total_count
+
+        def _impl(p):
+            # 0.5*log(2 pi e n p (1-p)) per component, Gaussian approx
+            return 0.5 * jnp.sum(
+                jnp.log(2 * math.pi * math.e * n * p * (1 - p) + 1e-8),
+                axis=-1)
+        return dispatch(_impl, (self.probs,), {},
+                        op_name="multinomial_entropy")
+
+
+class Poisson(ExponentialFamily):
+    """poisson.py analog (rate)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(r):
+            return jax.random.poisson(
+                key, jnp.broadcast_to(r, out_shape)).astype(r.dtype)
+
+        return dispatch(_impl, (self.rate,), {},
+                        op_name="poisson_sample").detach()
+
+    def log_prob(self, value):
+        def _impl(v, r):
+            return (v * jnp.log(r) - r
+                    - jax.scipy.special.gammaln(v + 1.0))
+        return dispatch(_impl, (_t(value), self.rate), {},
+                        op_name="poisson_log_prob")
+
+    def entropy(self):
+        """Series approximation (matches paddle's approach for large rate)."""
+        def _impl(r):
+            return (0.5 * jnp.log(2 * math.pi * math.e * r)
+                    - 1 / (12 * r) - 1 / (24 * jnp.square(r)))
+        return dispatch(_impl, (self.rate,), {}, op_name="poisson_entropy")
+
+
+class Binomial(Distribution):
+    """binomial.py analog (total_count + probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        n = self.total_count
+
+        def _impl(p):
+            return n * p
+        return dispatch(_impl, (self.probs,), {}, op_name="binomial_mean")
+
+    @property
+    def variance(self):
+        n = self.total_count
+
+        def _impl(p):
+            return n * p * (1 - p)
+        return dispatch(_impl, (self.probs,), {}, op_name="binomial_var")
+
+    def sample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        n = self.total_count
+        out_shape = shape + self.batch_shape
+
+        def _impl(p):
+            u = jax.random.uniform(key, (n,) + out_shape, dtype=p.dtype)
+            return jnp.sum((u < p).astype(p.dtype), axis=0)
+
+        return dispatch(_impl, (self.probs,), {},
+                        op_name="binomial_sample").detach()
+
+    def log_prob(self, value):
+        n = self.total_count
+
+        def _impl(v, p):
+            lg = jax.scipy.special.gammaln
+            coeff = lg(jnp.asarray(n + 1.0)) - lg(v + 1) - lg(n - v + 1)
+            return coeff + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return dispatch(_impl, (_t(value), self.probs), {},
+                        op_name="binomial_log_prob")
+
+    def entropy(self):
+        n = self.total_count
+
+        def _impl(p):
+            return 0.5 * jnp.log(2 * math.pi * math.e * n * p * (1 - p)
+                                 + 1e-8)
+        return dispatch(_impl, (self.probs,), {}, op_name="binomial_entropy")

@@ -1,0 +1,198 @@
+"""Distribution base classes.
+
+Reference: python/paddle/distribution/distribution.py (Distribution base with
+sample/rsample/log_prob/prob/entropy + batch_shape), exponential_family.py,
+independent.py, transformed_distribution.py.
+
+TPU-native: every method body is ONE dispatched op (a fused jnp closure), so
+a log_prob or entropy lands on the autograd tape as a single node and XLA
+fuses the arithmetic; sampling draws keys from the framework generator so
+compiled-step capture tracks RNG state.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..ops.registry import dispatch
+
+
+def _t(x, dtype=None):
+    """Coerce arg to Tensor (floating by default)."""
+    if isinstance(x, Tensor):
+        return x
+    arr = np.asarray(x)
+    if dtype is None and arr.dtype.kind in "iub":
+        arr = arr.astype("float32")
+    elif dtype is not None:
+        arr = arr.astype(dtype)
+    return Tensor(arr)
+
+
+def _shape(s):
+    if s is None:
+        return ()
+    if isinstance(s, (int, np.integer)):
+        return (int(s),)
+    return tuple(int(d) for d in s)
+
+
+class Distribution:
+    """distribution.py Distribution analog."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        v = self.variance
+        return dispatch(jnp.sqrt, (v,), {}, op_name="dist_stddev")
+
+    def sample(self, shape=()):
+        """Draw (no grad through the sample)."""
+        s = self.rsample(shape)
+        return s.detach() if hasattr(s, "detach") else s
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return dispatch(jnp.exp, (lp,), {}, op_name="dist_prob")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return _shape(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self._batch_shape}, "
+                f"event_shape={self._event_shape})")
+
+
+class ExponentialFamily(Distribution):
+    """exponential_family.py analog (marker base; entropy via the Bregman
+    identity is specialized per subclass here rather than generically)."""
+
+
+class Independent(Distribution):
+    """independent.py analog: reinterprets trailing batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        b = base.batch_shape
+        k = self.reinterpreted_batch_rank
+        if k > len(b):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        super().__init__(b[:len(b) - k], b[len(b) - k:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+
+        def _impl(a):
+            return jnp.sum(a, axis=axes)
+
+        return dispatch(_impl, (lp,), {}, op_name="independent_log_prob")
+
+    def entropy(self):
+        ent = self.base.entropy()
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+
+        def _impl(a):
+            return jnp.sum(a, axis=axes)
+
+        return dispatch(_impl, (ent,), {}, op_name="independent_entropy")
+
+
+class TransformedDistribution(Distribution):
+    """transformed_distribution.py analog: push base samples through a chain
+    of bijective transforms; log_prob uses the change-of-variables formula."""
+
+    def __init__(self, base, transforms):
+        from .transform import ChainTransform, Transform
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = (transforms[0] if len(transforms) == 1
+                       else ChainTransform(self.transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        s = self.rsample(shape)
+        return s.detach()
+
+    def log_prob(self, value):
+        value = _t(value)
+        lp = None
+        y = value
+        # walk the chain backwards, accumulating -log|det J|
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ladj = t.forward_log_det_jacobian(x)
+            lp = ladj if lp is None else dispatch(
+                jnp.add, (lp, ladj), {}, op_name="td_ladj_sum")
+            y = x
+        base_lp = self.base.log_prob(y)
+
+        def _impl(b, l):
+            return b - l
+
+        return dispatch(_impl, (base_lp, lp), {}, op_name="td_log_prob")

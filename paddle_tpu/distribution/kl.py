@@ -1,0 +1,187 @@
+"""KL divergence registry.
+
+Reference: python/paddle/distribution/kl.py — ``kl_divergence(p, q)``
+dispatching on a (type(p), type(q)) registry built with ``@register_kl``,
+with MRO-aware lookup.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import dispatch
+from .continuous import (Beta, Dirichlet, Exponential, Gamma, Gumbel, Laplace,
+                         LogNormal, Normal, Uniform)
+from .discrete import Bernoulli, Categorical, Geometric, Poisson
+from .distribution import Distribution
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """kl.py register_kl analog."""
+
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def _dispatch_kl(cls_p, cls_q):
+    matches = []
+    for (p, q), fn in _KL_REGISTRY.items():
+        if issubclass(cls_p, p) and issubclass(cls_q, q):
+            matches.append((cls_p.__mro__.index(p) + cls_q.__mro__.index(q),
+                            fn))
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({cls_p.__name__}, {cls_q.__name__})")
+    return min(matches, key=lambda t: t[0])[1]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """kl.py kl_divergence analog."""
+    return _dispatch_kl(type(p), type(q))(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def _impl(lp, sp, lq, sq):
+        var_ratio = jnp.square(sp / sq)
+        t1 = jnp.square((lp - lq) / sq)
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return dispatch(_impl, (p.loc, p.scale, q.loc, q.scale), {},
+                    op_name="kl_normal_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def _impl(plo, phi, qlo, qhi):
+        res = jnp.log((qhi - qlo) / (phi - plo))
+        return jnp.where(jnp.logical_and(qlo <= plo, phi <= qhi), res,
+                         jnp.inf)
+    return dispatch(_impl, (p.low, p.high, q.low, q.high), {},
+                    op_name="kl_uniform_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def _impl(pp, pq):
+        eps = 1e-8
+        t1 = pp * (jnp.log(pp + eps) - jnp.log(pq + eps))
+        t2 = (1 - pp) * (jnp.log1p(-pp + eps) - jnp.log1p(-pq + eps))
+        return t1 + t2
+    return dispatch(_impl, (p.probs, q.probs), {},
+                    op_name="kl_bernoulli_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    def _impl(lp, lq):
+        logp = jax.nn.log_softmax(lp, axis=-1)
+        logq = jax.nn.log_softmax(lq, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+    return dispatch(_impl, (p.logits, q.logits), {},
+                    op_name="kl_categorical_categorical")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def _impl(pa, pb, qa, qb):
+        lg = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+
+        def lbeta(a, b):
+            return lg(a) + lg(b) - lg(a + b)
+        return (lbeta(qa, qb) - lbeta(pa, pb)
+                + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+    return dispatch(_impl, (p.alpha, p.beta, q.alpha, q.beta), {},
+                    op_name="kl_beta_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def _impl(pa, qa):
+        lg = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        pa0 = jnp.sum(pa, axis=-1)
+        qa0 = jnp.sum(qa, axis=-1)
+        return (lg(pa0) - jnp.sum(lg(pa), axis=-1)
+                - lg(qa0) + jnp.sum(lg(qa), axis=-1)
+                + jnp.sum((pa - qa) * (dg(pa) - dg(pa0)[..., None]),
+                          axis=-1))
+    return dispatch(_impl, (p.concentration, q.concentration), {},
+                    op_name="kl_dirichlet_dirichlet")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def _impl(pa, pr, qa, qr):
+        lg = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        return ((pa - qa) * dg(pa) - lg(pa) + lg(qa)
+                + qa * (jnp.log(pr) - jnp.log(qr))
+                + pa * (qr / pr - 1))
+    return dispatch(_impl, (p.concentration, p.rate, q.concentration,
+                            q.rate), {}, op_name="kl_gamma_gamma")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    def _impl(pr, qr):
+        ratio = qr / pr
+        return ratio - 1 - jnp.log(ratio)
+    return dispatch(_impl, (p.rate, q.rate), {}, op_name="kl_exp_exp")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def _impl(lp, sp, lq, sq):
+        ratio = sp / sq
+        d = jnp.abs(lp - lq)
+        return (-jnp.log(ratio) + ratio - 1
+                + d / sq
+                + ratio * jnp.expm1(-d / sp))
+    return dispatch(_impl, (p.loc, p.scale, q.loc, q.scale), {},
+                    op_name="kl_laplace_laplace")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    def _impl(pp, pq):
+        return (-(1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-pq))
+                + jnp.log(pp) - jnp.log(pq))
+    return dispatch(_impl, (p.probs, q.probs), {}, op_name="kl_geo_geo")
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    def _impl(pr, qr):
+        return pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr
+    return dispatch(_impl, (p.rate, q.rate), {},
+                    op_name="kl_poisson_poisson")
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    """Closed form for equal-family Gumbel KL (via expectations)."""
+    _E = 0.57721566490153286060
+
+    def _impl(lp, sp, lq, sq):
+        ratio = sp / sq
+        # E_p[(x - lq)/sq] = (lp - lq)/sq + E*sp/sq ; E_p[e^{-(x-lq)/sq}] below
+        t = (lp - lq) / sq
+        expterm = jnp.exp(-t + jax.scipy.special.gammaln(1 + ratio))
+        return (jnp.log(sq) - jnp.log(sp) + _E * (ratio - 1)
+                + t + expterm - (1 + _E))
+    return dispatch(_impl, (p.loc, p.scale, q.loc, q.scale), {},
+                    op_name="kl_gumbel_gumbel")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p, q)

@@ -1,0 +1,251 @@
+"""Bijective transforms for TransformedDistribution.
+
+Reference: python/paddle/distribution/transform.py (Transform base with
+forward/inverse/forward_log_det_jacobian and the stock transforms).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import dispatch
+from .distribution import _t
+
+
+class Transform:
+    """transform.py Transform analog."""
+
+    def forward(self, x):
+        return dispatch(self._forward, (_t(x),), {},
+                        op_name=f"{type(self).__name__}_fwd")
+
+    def inverse(self, y):
+        return dispatch(self._inverse, (_t(y),), {},
+                        op_name=f"{type(self).__name__}_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return dispatch(self._fldj, (_t(x),), {},
+                        op_name=f"{type(self).__name__}_fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        def _impl(v):
+            return -self._fldj(self._inverse(v))
+        return dispatch(_impl, (_t(y),), {},
+                        op_name=f"{type(self).__name__}_ildj")
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks (pure jnp)
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return self.loc._data + self.scale._data * x
+
+    def _inverse(self, y):
+        return (y - self.loc._data) / self.scale._data
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale._data)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power._data)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power._data)
+
+    def _fldj(self, x):
+        p = self.power._data
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2), numerically stable
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    """Non-bijective |x|; inverse picks the positive branch (as reference)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    """Maps unconstrained vectors to the simplex (not bijective; inverse is
+    log, as in the reference)."""
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("SoftmaxTransform has no scalar ldj")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex interior via stick breaking."""
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,), z.dtype)],
+                               axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,), z.dtype), 1 - z], axis=-1)
+        return zpad * jnp.cumprod(one_minus, axis=-1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        rem = 1 - jnp.cumsum(y_crop, axis=-1)
+        offset = y_crop.shape[-1] - jnp.arange(y_crop.shape[-1],
+                                               dtype=y.dtype)
+        z = y_crop / jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), rem[..., :-1]], axis=-1)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        rem_log = jnp.cumsum(jnp.log1p(-z), axis=-1)
+        shifted = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype), rem_log[..., :-1]],
+            axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + shifted, axis=-1)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        return jnp.zeros(x.shape[:x.ndim - len(self.in_event_shape)],
+                         x.dtype)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = None
+        for t in self.transforms:
+            l = t._fldj(x)
+            total = l if total is None else total + l
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Sums the log-det over reinterpreted trailing dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.k = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        l = self.base._fldj(x)
+        return jnp.sum(l, axis=tuple(range(-self.k, 0)))
+
+
+class StackTransform(Transform):
+    """Applies a list of transforms along a stacked axis."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)

@@ -1,0 +1,185 @@
+"""paddle.fft namespace.
+
+Reference: python/paddle/fft.py (fft_c2c/r2c/c2r kernels under
+phi/kernels/funcs/fft.cc). Here each transform is one XLA fft HLO emitted
+through the op registry, so it records on the autograd tape like any op.
+
+Norm convention matches the reference: "backward" (scale on inverse),
+"forward" (scale on forward), "ortho" (sqrt split).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.registry import defop
+
+
+def _norm(norm):
+    if norm not in ("backward", "forward", "ortho"):
+        raise ValueError(f"unsupported norm: {norm}")
+    return norm
+
+
+@defop(name="fft_c2c")
+def _fft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@defop(name="ifft_c2c")
+def _ifft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@defop(name="fft_r2c")
+def _rfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@defop(name="fft_c2r")
+def _irfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@defop(name="hfft_op")
+def _hfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@defop(name="ihfft_op")
+def _ihfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@defop(name="fft2_op")
+def _fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@defop(name="ifft2_op")
+def _ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@defop(name="rfft2_op")
+def _rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@defop(name="irfft2_op")
+def _irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@defop(name="fftn_op")
+def _fftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@defop(name="ifftn_op")
+def _ifftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@defop(name="rfftn_op")
+def _rfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@defop(name="irfftn_op")
+def _irfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@defop(name="fftshift_op")
+def _fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@defop(name="ifftshift_op")
+def _ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft(x, n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _ifft(x, n=n, axis=axis, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _rfft(x, n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _irfft(x, n=n, axis=axis, norm=norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _hfft(x, n=n, axis=axis, norm=norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _ihfft(x, n=n, axis=axis, norm=norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _fft2(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _ifft2(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _rfft2(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _irfft2(x, s=s, axes=axes, norm=norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftshift(x, axes=None, name=None):
+    return _fftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    return _ifftshift(x, axes=axes)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from ..core.tensor import Tensor
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        out = out.astype(str(dtype).replace("paddle.", ""))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from ..core.tensor import Tensor
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        out = out.astype(str(dtype).replace("paddle.", ""))
+    return Tensor(out)
+
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftshift",
+           "ifftshift", "fftfreq", "rfftfreq"]

@@ -238,6 +238,13 @@ class EarlyStopping(Callback):
                 print(f"Epoch early stopped: best {self.monitor} = "
                       f"{self.best_value:.5f}")
 
+    def on_train_end(self, logs=None):
+        # restore the best snapshot so training ends at the best eval point
+        if self.save_best_model and self.best_weights is not None:
+            from ..core.tensor import Tensor
+            self.model.network.set_state_dict(
+                {k: Tensor(v) for k, v in self.best_weights.items()})
+
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
                      steps=None, log_freq=2, verbose=2, save_freq=1,

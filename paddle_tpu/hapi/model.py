@@ -133,12 +133,13 @@ class Model:
         return loss_np
 
     # -- loops ----------------------------------------------------------------
-    def _make_loader(self, data, batch_size, shuffle, num_workers):
+    def _make_loader(self, data, batch_size, shuffle, num_workers,
+                     drop_last=False):
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset) or hasattr(data, "__getitem__"):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              num_workers=num_workers)
+                              num_workers=num_workers, drop_last=drop_last)
         return data  # any iterable of batches
 
     def _split_batch(self, batch, has_labels=True):
@@ -164,7 +165,7 @@ class Model:
         """model.py fit analog."""
         assert self._prepared, "call prepare() first"
         loader = self._make_loader(train_data, batch_size, shuffle,
-                                   num_workers)
+                                   num_workers, drop_last=drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,
                                         num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
@@ -176,19 +177,18 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin({})
         iters_done = 0
+        logs = {}
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch, {})
             for m in self._metrics:
                 m.reset()
-            logs = {}
+            pending_grads = False
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step, {})
                 ins, lbls = self._split_batch(batch)
-                # force the update on the epoch's final batch so tail
-                # gradients never leak into the next accumulation window
-                last = steps is not None and step == steps - 1
-                update = last or ((step + 1) % accumulate_grad_batches == 0)
+                update = ((step + 1) % accumulate_grad_batches == 0)
                 res = self.train_batch(ins, lbls, update=update)
+                pending_grads = not update
                 logs = self._merge_logs(res)
                 cbks.on_train_batch_end(step, logs)
                 iters_done += 1
@@ -196,6 +196,11 @@ class Model:
                     self.stop_training = True
                 if self.stop_training:
                     break
+            if pending_grads:
+                # flush the accumulation tail so gradients never leak into
+                # the next epoch's window (works for len-less loaders too)
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self._run_eval(eval_loader, cbks)
@@ -203,7 +208,7 @@ class Model:
                 break
         cbks.on_train_end(logs)
 
-    def _run_eval(self, loader, cbks):
+    def _run_eval(self, loader, cbks, num_iters=None):
         for m in self._metrics:
             m.reset()
         steps = len(loader) if hasattr(loader, "__len__") else None
@@ -215,6 +220,8 @@ class Model:
             res = self.eval_batch(ins, lbls)
             logs = self._merge_logs(res)
             cbks.on_eval_batch_end(step, logs)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
         final = self._finalize_logs(logs)
         cbks.on_eval_end(final)
         return final
@@ -227,7 +234,7 @@ class Model:
         cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
                                 log_freq=log_freq, verbose=verbose,
                                 metrics=self._metrics_name(), mode="eval")
-        return self._run_eval(loader, cbks)
+        return self._run_eval(loader, cbks, num_iters=num_iters)
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, verbose=1, callbacks=None):

@@ -1,0 +1,24 @@
+"""paddle.linalg namespace.
+
+Reference: python/paddle/linalg.py — re-exports the linear-algebra op family
+(implemented here in ops/linalg.py as jax/XLA emissions; on TPU these lower
+to MXU matmuls + the XLA decomposition library).
+"""
+from __future__ import annotations
+
+from ..ops.linalg import (cholesky, cond, corrcoef, cov, det, eig, eigh,
+                          eigvals, eigvalsh, householder_product, inverse,
+                          lstsq, lu, matrix_exp, matrix_norm, matrix_power,
+                          matrix_rank, multi_dot, norm, ormqr, pca_lowrank,
+                          pinv, qr, slogdet, solve, svd, svd_lowrank,
+                          svdvals, triangular_solve, vector_norm)
+from ..ops.linalg import cholesky_solve, lu_unpack
+
+inv = inverse
+
+__all__ = ["cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det",
+           "eig", "eigh", "eigvals", "eigvalsh", "householder_product",
+           "inv", "inverse", "lstsq", "lu", "lu_unpack", "matrix_exp",
+           "matrix_norm", "matrix_power", "matrix_rank", "multi_dot", "norm",
+           "ormqr", "pca_lowrank", "pinv", "qr", "slogdet", "solve", "svd",
+           "svd_lowrank", "svdvals", "triangular_solve", "vector_norm"]

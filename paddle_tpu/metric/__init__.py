@@ -79,9 +79,9 @@ class Accuracy(Metric):
         correct = _to_numpy(correct)
         num = int(np.prod(correct.shape[:-1]))
         accs = []
-        for k in self.topk:
+        for idx, k in enumerate(self.topk):
             c = correct[..., :k].sum()
-            self.total[self.topk.index(k)] += float(c)
+            self.total[idx] += float(c)
             accs.append(float(c) / max(num, 1))
         self.count += num
         return np.array(accs[0] if len(self.topk) == 1 else accs)

@@ -194,6 +194,150 @@ def cdist(x, y, p=2.0):
 
 
 @defop()
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+@defop()
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+@defop()
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@defop()
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@defop()
+def lu(x, pivot=True, get_infos=False):
+    """LU with compact pivots (paddle returns LU matrix + 1-based pivots)."""
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    piv = piv.astype(jnp.int32) + 1
+    if get_infos:
+        info = jnp.zeros(x.shape[:-2], dtype=jnp.int32)
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+@defop()
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack a 2-D LU factorization into P, L, U (batched inputs: vmap)."""
+    m = lu_data.shape[-2]
+    n = lu_data.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_data[:, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[:k, :])
+    # rebuild the permutation from sequential row swaps (pivots are 1-based)
+    piv = lu_pivots - 1
+
+    def swap(i, perm):
+        j = piv[i]
+        pi, pj = perm[i], perm[j]
+        return perm.at[i].set(pj).at[j].set(pi)
+
+    perm = jax.lax.fori_loop(0, piv.shape[0], swap, jnp.arange(m))
+    P = jnp.eye(m, dtype=lu_data.dtype)[perm].T
+    return P, L, U
+
+
+@defop()
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@defop(differentiable=False)
+def cond(x, p=None):
+    if p is None or p == 2:
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return s[..., 0] / s[..., -1]
+    return jnp.linalg.norm(x, ord=p, axis=(-2, -1)) * jnp.linalg.norm(
+        jnp.linalg.inv(x), ord=p, axis=(-2, -1))
+
+
+def _accumulate_reflectors(x, tau, ncols):
+    """Q[:, :ncols] = H_0 H_1 ... H_{k-1} @ I (geqrf reflector convention)."""
+    m = x.shape[-2]
+    k = tau.shape[-1]
+    Q = jnp.eye(m, ncols, dtype=x.dtype)
+    Q = jnp.broadcast_to(Q, x.shape[:-2] + (m, ncols)).copy()
+    for i in range(k - 1, -1, -1):
+        v = x[..., :, i]
+        v = jnp.where(jnp.arange(m) < i, 0.0, v)
+        v = v.at[..., i].set(1.0)
+        # Q = (I - tau v v^T) Q
+        vQ = jnp.einsum("...m,...mn->...n", v, Q)
+        Q = Q - tau[..., i, None, None] * v[..., :, None] * vQ[..., None, :]
+    return Q
+
+
+@defop()
+def householder_product(x, tau):
+    """Accumulate Householder reflectors (geqrf convention) into thin Q."""
+    return _accumulate_reflectors(x, tau, x.shape[-1])
+
+
+@defop()
+def ormqr(x, tau, y, left=True, transpose=False):
+    """Multiply y by Q (from geqrf reflectors in x): op(Q) @ y or y @ op(Q).
+    The FULL m-by-m Q is accumulated (its trailing columns are reflector
+    products, not identity columns)."""
+    Qfull = _accumulate_reflectors(x, tau, x.shape[-2])
+    Qop = jnp.swapaxes(Qfull, -1, -2) if transpose else Qfull
+    return jnp.matmul(Qop, y) if left else jnp.matmul(y, Qop)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None):
+    """Randomized low-rank SVD (paddle.linalg.svd_lowrank analog)."""
+    from ..core import random as _random
+    if M is not None:
+        x = x - M
+    key = _random.default_generator().next_key()
+    n = x.shape[-1]
+    q = min(q, x.shape[-2], n)
+    from .registry import dispatch
+
+    def _impl(a):
+        omega = jax.random.normal(key, a.shape[:-2] + (n, q), dtype=a.dtype)
+        Y = jnp.matmul(a, omega)
+        Q_, _ = jnp.linalg.qr(Y)
+        for _ in range(niter):
+            Z = jnp.matmul(jnp.swapaxes(a, -1, -2), Q_)
+            Q_, _ = jnp.linalg.qr(Z)
+            Y = jnp.matmul(a, Q_)
+            Q_, _ = jnp.linalg.qr(Y)
+        B = jnp.matmul(jnp.swapaxes(Q_, -1, -2), a)
+        u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return jnp.matmul(Q_, u), s, jnp.swapaxes(vh, -1, -2)
+
+    return dispatch(_impl, (x,), {}, op_name="svd_lowrank")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """Randomized PCA (paddle.linalg.pca_lowrank analog)."""
+    if q is None:
+        q = min(6, x.shape[-2], x.shape[-1])
+    if center:
+        from .registry import dispatch
+        x = dispatch(lambda a: a - jnp.mean(a, axis=-2, keepdims=True),
+                     (x,), {}, op_name="center")
+    return svd_lowrank(x, q=q, niter=niter)
+
+
+@defop()
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False):
     rng = None if (min == 0 and max == 0) else (min, max)
     hist, _ = jnp.histogram(input, bins=bins, range=rng, weights=weight,

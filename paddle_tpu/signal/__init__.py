@@ -1,0 +1,117 @@
+"""paddle.signal namespace — STFT/ISTFT.
+
+Reference: python/paddle/signal.py (frame/overlap_add kernels in
+phi/kernels/funcs/frame_functor.h). TPU-native: framing is a gather that XLA
+turns into strided slices, the FFT is a batched fft HLO, and overlap-add is a
+segment-sum scatter — the whole transform stays on-device.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.registry import defop
+
+
+@defop(name="frame_op")
+def _frame(x, frame_length, hop_length, axis=-1):
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("frame: only axis=-1 supported")
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]  # [..., num_frames, frame_length]
+
+
+@defop(name="overlap_add_op")
+def _overlap_add(frames, hop_length, axis=-1):
+    # frames [..., num_frames, frame_length] -> [..., output_len]
+    num_frames, frame_length = frames.shape[-2], frames.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = (starts[:, None] + jnp.arange(frame_length)[None, :]).reshape(-1)
+    flat = frames.reshape(frames.shape[:-2] + (-1,))
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), dtype=frames.dtype)
+    return out.at[..., idx].add(flat)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    return _frame(x, frame_length, hop_length, axis=axis)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    return _overlap_add(x, hop_length, axis=axis)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """signal.py stft analog: [B, T] -> [B, n_fft//2+1 (or n_fft), frames]."""
+    from .. import fft as fft_mod
+    from ..core.tensor import Tensor
+    from ..ops.registry import dispatch
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def _impl(sig, win):
+        s = sig
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (s.ndim - 1) + [(pad, pad)]
+            s = jnp.pad(s, cfg, mode=pad_mode)
+        frames = _frame.raw_fn(s, n_fft, hop_length)
+        if win is not None:
+            w = win
+            if win_length < n_fft:  # center the window in the frame
+                lp = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lp, n_fft - win_length - lp))
+            frames = frames * w
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, dtype=spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+
+    win_arr = window if window is not None else None
+    return dispatch(_impl, (x, win_arr), {}, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """signal.py istft analog (least-squares overlap-add inversion)."""
+    from ..ops.registry import dispatch
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def _impl(spec, win):
+        s = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, dtype=s.real.dtype))
+        frames = (jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(s, axis=-1).real)
+        if win is not None:
+            w = win
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        else:
+            w = jnp.ones((n_fft,), dtype=frames.dtype)
+        sig = _overlap_add.raw_fn(frames * w, hop_length)
+        wsq = _overlap_add.raw_fn(
+            jnp.broadcast_to(w * w, frames.shape), hop_length)
+        sig = sig / jnp.maximum(wsq, 1e-11)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:sig.shape[-1] - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    win_arr = window if window is not None else None
+    return dispatch(_impl, (x, win_arr), {}, op_name="istft")
+
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]

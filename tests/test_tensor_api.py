@@ -1,0 +1,313 @@
+"""Tests for the breadth namespaces: paddle.linalg, paddle.fft,
+paddle.signal, and paddle.distribution (reference test dirs: test/fft,
+test/distribution, test/legacy_test linalg op tests)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+# -- linalg ------------------------------------------------------------------
+
+def test_linalg_namespace_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 4).astype(np.float32)
+    spd = (a @ a.T + 4 * np.eye(4)).astype(np.float32)
+    x = paddle.to_tensor(spd)
+
+    np.testing.assert_allclose(_np(paddle.linalg.inv(x)), np.linalg.inv(spd),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(paddle.linalg.det(x)), np.linalg.det(spd),
+                               rtol=1e-4)
+    L = _np(paddle.linalg.cholesky(x))
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    s = _np(paddle.linalg.svdvals(x))
+    np.testing.assert_allclose(s, np.linalg.svd(spd, compute_uv=False),
+                               rtol=1e-4)
+
+
+def test_linalg_lu_roundtrip():
+    rng = np.random.RandomState(1)
+    a = rng.randn(5, 5).astype(np.float32)
+    lu_mat, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu_mat, piv)
+    np.testing.assert_allclose(_np(P) @ _np(L) @ _np(U), a, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_linalg_matrix_exp():
+    a = np.array([[0.0, 1.0], [-1.0, 0.0]], dtype=np.float32)  # rotation gen
+    E = _np(paddle.linalg.matrix_exp(paddle.to_tensor(a)))
+    expect = np.array([[math.cos(1), math.sin(1)],
+                       [-math.sin(1), math.cos(1)]], dtype=np.float32)
+    np.testing.assert_allclose(E, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_linalg_householder_product_matches_explicit():
+    # explicit product of (I - tau v v^T) against the accumulated version
+    rng = np.random.RandomState(2)
+    m, n = 4, 3
+    a = rng.randn(m, n).astype(np.float64)
+    tau = rng.rand(n).astype(np.float64)
+    Q = _np(paddle.linalg.householder_product(paddle.to_tensor(a),
+                                              paddle.to_tensor(tau)))
+    ref = np.eye(m)
+    for i in range(n):
+        v = a[:, i].copy()
+        v[:i] = 0.0
+        v[i] = 1.0
+        ref = ref @ (np.eye(m) - tau[i] * np.outer(v, v))
+    np.testing.assert_allclose(Q, ref[:, :n], rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_svd_lowrank():
+    rng = np.random.RandomState(3)
+    base = rng.randn(20, 3).astype(np.float32)
+    a = base @ rng.randn(3, 15).astype(np.float32)  # rank 3
+    U, S, V = paddle.linalg.svd_lowrank(paddle.to_tensor(a), q=3)
+    approx = _np(U) @ np.diag(_np(S)) @ _np(V).T
+    np.testing.assert_allclose(approx, a, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_cond_vector_matrix_norm():
+    a = np.diag([4.0, 2.0]).astype(np.float32)
+    assert float(paddle.linalg.cond(paddle.to_tensor(a))) == pytest.approx(2.0)
+    v = paddle.to_tensor(np.array([3.0, 4.0], dtype=np.float32))
+    assert float(paddle.linalg.vector_norm(v)) == pytest.approx(5.0)
+    m = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+    assert float(paddle.linalg.matrix_norm(m, "fro")) == pytest.approx(
+        math.sqrt(12), rel=1e-5)
+
+
+# -- fft ---------------------------------------------------------------------
+
+def test_fft_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(_np(paddle.fft.fft(t)), np.fft.fft(x),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(_np(paddle.fft.rfft(t)), np.fft.rfft(x),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(_np(paddle.fft.fft2(t)), np.fft.fft2(x),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_fft_roundtrip_and_norms():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 32).astype(np.float32)
+    t = paddle.to_tensor(x)
+    for norm in ("backward", "forward", "ortho"):
+        back = paddle.fft.ifft(paddle.fft.fft(t, norm=norm), norm=norm)
+        np.testing.assert_allclose(_np(back).real, x, rtol=1e-4, atol=1e-4)
+    back_r = paddle.fft.irfft(paddle.fft.rfft(t), n=32)
+    np.testing.assert_allclose(_np(back_r), x, rtol=1e-4, atol=1e-4)
+
+
+def test_fft_helpers():
+    np.testing.assert_allclose(_np(paddle.fft.fftfreq(8, d=0.5)),
+                               np.fft.fftfreq(8, d=0.5))
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(_np(paddle.fft.fftshift(x)),
+                               np.fft.fftshift(np.arange(8)))
+
+
+def test_fft_differentiable():
+    x = paddle.to_tensor(np.random.RandomState(2).randn(16).astype(np.float32),
+                         stop_gradient=False)
+    y = paddle.fft.rfft(x)
+    energy = (y.abs() ** 2).sum()
+    energy.backward()
+    g = _np(x.grad)
+    # Parseval: d/dx sum|X|^2 = 2*N*x for rfft of real signal (approximately,
+    # accounting for one/two-sided bins) — just check finite and nonzero
+    assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0
+
+
+# -- signal ------------------------------------------------------------------
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(0)
+    sig = rng.randn(2, 256).astype(np.float32)
+    t = paddle.to_tensor(sig)
+    n_fft = 64
+    win = paddle.to_tensor(np.hanning(n_fft).astype(np.float32))
+    spec = paddle.signal.stft(t, n_fft=n_fft, hop_length=16, window=win)
+    assert tuple(spec.shape) == (2, n_fft // 2 + 1, 256 // 16 + 1)
+    rec = paddle.signal.istft(spec, n_fft=n_fft, hop_length=16, window=win,
+                              length=256)
+    np.testing.assert_allclose(_np(rec), sig, rtol=1e-3, atol=1e-3)
+
+
+def test_frame_overlap_add():
+    x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+    f = paddle.signal.frame(x, frame_length=4, hop_length=2)
+    assert tuple(f.shape) == (4, 4)
+    np.testing.assert_allclose(_np(f)[0], [0, 1, 2, 3])
+    np.testing.assert_allclose(_np(f)[1], [2, 3, 4, 5])
+    y = paddle.signal.overlap_add(f, hop_length=2)
+    # middle samples are double-counted by the 50% overlap
+    assert _np(y).shape == (10,)
+
+
+# -- distribution -------------------------------------------------------------
+
+def test_normal_moments_and_log_prob():
+    d = D.Normal(loc=1.0, scale=2.0)
+    assert float(d.mean) == pytest.approx(1.0)
+    assert float(d.variance) == pytest.approx(4.0)
+    lp = float(d.log_prob(paddle.to_tensor(1.0)))
+    assert lp == pytest.approx(-math.log(2.0 * math.sqrt(2 * math.pi)))
+    assert float(d.cdf(paddle.to_tensor(1.0))) == pytest.approx(0.5)
+    s = d.sample((5000,))
+    assert abs(float(s.mean()) - 1.0) < 0.15
+    assert abs(float(s.std()) - 2.0) < 0.15
+
+
+def test_normal_rsample_reparameterized_grad():
+    loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+    d = D.Normal(loc=loc, scale=scale)
+    s = d.rsample((64,))
+    s.sum().backward()
+    assert float(loc.grad) == pytest.approx(64.0)  # d(loc + scale*eps)/dloc
+    assert np.isfinite(float(scale.grad))
+
+
+def test_uniform_and_entropy():
+    d = D.Uniform(low=0.0, high=4.0)
+    assert float(d.entropy()) == pytest.approx(math.log(4.0))
+    assert float(d.log_prob(paddle.to_tensor(2.0))) == pytest.approx(
+        -math.log(4.0))
+    s = _np(d.sample((2000,)))
+    assert s.min() >= 0 and s.max() < 4
+
+
+def test_categorical_sample_logprob_entropy():
+    logits = paddle.to_tensor(np.log(np.array([0.1, 0.2, 0.7],
+                                              dtype=np.float32)))
+    d = D.Categorical(logits)
+    lp = _np(d.log_prob(paddle.to_tensor(np.array([2]))))
+    assert lp[0] == pytest.approx(math.log(0.7), rel=1e-4)
+    ent = float(d.entropy())
+    expect = -(0.1 * math.log(0.1) + 0.2 * math.log(0.2)
+               + 0.7 * math.log(0.7))
+    assert ent == pytest.approx(expect, rel=1e-4)
+    paddle.seed(0)
+    s = _np(d.sample((4000,)))
+    assert abs((s == 2).mean() - 0.7) < 0.05
+
+
+def test_bernoulli_and_kl():
+    p = D.Bernoulli(paddle.to_tensor(np.float32(0.3)))
+    q = D.Bernoulli(paddle.to_tensor(np.float32(0.5)))
+    kl = float(D.kl_divergence(p, q))
+    expect = 0.3 * math.log(0.3 / 0.5) + 0.7 * math.log(0.7 / 0.5)
+    assert kl == pytest.approx(expect, rel=1e-3)
+
+
+def test_kl_normal_closed_form():
+    p = D.Normal(0.0, 1.0)
+    q = D.Normal(1.0, 2.0)
+    kl = float(D.kl_divergence(p, q))
+    expect = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    assert kl == pytest.approx(expect, rel=1e-5)
+
+
+def test_gamma_beta_dirichlet_moments():
+    g = D.Gamma(concentration=3.0, rate=2.0)
+    assert float(g.mean) == pytest.approx(1.5)
+    assert float(g.variance) == pytest.approx(0.75)
+    b = D.Beta(2.0, 3.0)
+    assert float(b.mean) == pytest.approx(0.4)
+    dd = D.Dirichlet(paddle.to_tensor(np.array([1.0, 2.0, 3.0],
+                                               dtype=np.float32)))
+    np.testing.assert_allclose(_np(dd.mean), [1 / 6, 2 / 6, 3 / 6],
+                               rtol=1e-5)
+    s = dd.sample((100,))
+    np.testing.assert_allclose(_np(s.sum(axis=-1)), np.ones(100), rtol=1e-4)
+
+
+def test_lognormal_and_exponential():
+    ln = D.LogNormal(0.0, 0.5)
+    assert float(ln.mean) == pytest.approx(math.exp(0.125), rel=1e-5)
+    ex = D.Exponential(rate=2.0)
+    assert float(ex.mean) == pytest.approx(0.5)
+    assert float(ex.cdf(paddle.to_tensor(1.0))) == pytest.approx(
+        1 - math.exp(-2.0), rel=1e-5)
+
+
+def test_multivariate_normal():
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], dtype=np.float32)
+    d = D.MultivariateNormal(paddle.to_tensor(np.zeros(2, np.float32)),
+                             covariance_matrix=paddle.to_tensor(cov))
+    np.testing.assert_allclose(_np(d.variance), np.diag(cov), rtol=1e-5)
+    import scipy.stats as st
+    v = np.array([0.3, -0.2], dtype=np.float32)
+    lp = float(d.log_prob(paddle.to_tensor(v)))
+    assert lp == pytest.approx(
+        st.multivariate_normal(np.zeros(2), cov).logpdf(v), rel=1e-4)
+
+
+def test_transformed_distribution_lognormal_equiv():
+    base = D.Normal(0.0, 1.0)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ref = D.LogNormal(0.0, 1.0)
+    v = paddle.to_tensor(np.float32(1.7))
+    assert float(td.log_prob(v)) == pytest.approx(float(ref.log_prob(v)),
+                                                  rel=1e-5)
+
+
+def test_transform_forward_inverse():
+    t = D.ChainTransform([D.AffineTransform(1.0, 2.0), D.TanhTransform()])
+    x = paddle.to_tensor(np.array([0.1, -0.3], dtype=np.float32))
+    y = t.forward(x)
+    back = t.inverse(y)
+    np.testing.assert_allclose(_np(back), _np(x), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(y), np.tanh(1 + 2 * _np(x)), rtol=1e-5)
+
+
+def test_stickbreaking_simplex():
+    t = D.StickBreakingTransform()
+    x = paddle.to_tensor(np.array([0.5, -1.0, 2.0], dtype=np.float32))
+    y = _np(t.forward(x))
+    assert y.shape == (4,)
+    assert y.sum() == pytest.approx(1.0, rel=1e-5)
+    assert (y > 0).all()
+    back = _np(t.inverse(paddle.to_tensor(y)))
+    np.testing.assert_allclose(back, _np(x), rtol=1e-4, atol=1e-4)
+
+
+def test_independent_reinterprets_batch():
+    base = D.Normal(paddle.to_tensor(np.zeros((3, 4), np.float32)),
+                    paddle.to_tensor(np.ones((3, 4), np.float32)))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,)
+    assert ind.event_shape == (4,)
+    v = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    lp = _np(ind.log_prob(v))
+    assert lp.shape == (3,)
+    unit = D.Normal(0.0, 1.0)
+    assert lp[0] == pytest.approx(
+        4 * float(unit.log_prob(paddle.to_tensor(0.0))), rel=1e-5)
+
+
+def test_poisson_and_geometric():
+    po = D.Poisson(rate=3.0)
+    assert float(po.mean) == 3.0
+    lp = float(po.log_prob(paddle.to_tensor(2.0)))
+    assert lp == pytest.approx(2 * math.log(3) - 3 - math.log(2), rel=1e-4)
+    ge = D.Geometric(probs=0.25)
+    assert float(ge.mean) == pytest.approx(3.0)
+
+
+def test_kl_unregistered_raises():
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0.0, 1.0), D.Uniform(0.0, 1.0))
