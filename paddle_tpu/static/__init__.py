@@ -1,12 +1,17 @@
 """Static-graph style API.
 
-Reference: python/paddle/static — on TPU the "static graph" is a captured,
-jit-compiled XLA program (paddle_tpu.jit), so this namespace provides the
-declarative pieces the high-level APIs need (InputSpec today; the Program/
-Executor facade lives on the jit path).
+Reference: python/paddle/static (Program/Executor/program_guard/data,
+static/io save/load_inference_model). On TPU the "static graph" is a
+captured, jit-compiled XLA program: ``Program`` records a python callable +
+declared inputs, ``Executor.run`` compiles it through paddle_tpu.jit and
+feeds numpy, so reference-style static training scripts keep their shape
+while the compilation stack is StableHLO/XLA rather than ProgramDesc/PIR.
 """
 from __future__ import annotations
 
 from .input_spec import InputSpec
+from .program import (Executor, Program, data, default_main_program,
+                      default_startup_program, program_guard)
 
-__all__ = ["InputSpec"]
+__all__ = ["InputSpec", "Program", "Executor", "program_guard", "data",
+           "default_main_program", "default_startup_program"]

@@ -1,0 +1,138 @@
+"""Program/Executor facade over the compiled (jit) path.
+
+Reference: python/paddle/base/framework.py (Program/Block/Variable),
+python/paddle/base/executor.py (Executor:1158 -> _StandaloneExecutor:809).
+
+TPU-native: a Program is a recorded build — ``data`` placeholders + the
+callable built under ``program_guard`` — and Executor.run jit-compiles it
+(placeholders become traced args) with an executable cache per feed
+signature, the _ExecutorCache analog. There is no ProgramDesc/IR text: XLA
+owns the graph.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .input_spec import InputSpec
+
+
+class _Placeholder(Tensor):
+    """A ``static.data`` variable: a concrete zero tensor (so graph-building
+    python executes) remembered by name for feed-time substitution."""
+
+    def __init__(self, name, shape, dtype):
+        spec = InputSpec(shape, dtype, name)
+        concrete = spec._zeros(batch_size=1)
+        super().__init__(concrete._data, stop_gradient=True, name=name)
+        self.spec = spec
+
+
+class Program:
+    """framework.py Program analog: an ordered recording of placeholders and
+    fetch targets plus the builder callable."""
+
+    def __init__(self):
+        self._placeholders: Dict[str, _Placeholder] = {}
+        self._build_fns: List[Callable] = []
+        self.random_seed = 0
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._placeholders = dict(self._placeholders)
+        p._build_fns = list(self._build_fns)
+        return p
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return []
+
+    def __repr__(self):
+        names = list(self._placeholders)
+        return f"Program(inputs={names}, stages={len(self._build_fns)})"
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program() -> Program:
+    return _default_main[0]
+
+
+def default_startup_program() -> Program:
+    return _default_startup[0]
+
+
+class program_guard:
+    """base/framework.py program_guard analog."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._saved = (_default_main[0], _default_startup[0])
+        _default_main[0] = self.main
+        if self.startup is not None:
+            _default_startup[0] = self.startup
+        return self.main
+
+    def __exit__(self, *exc):
+        _default_main[0], _default_startup[0] = self._saved
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> _Placeholder:
+    """paddle.static.data analog: declares a feed placeholder on the current
+    default program."""
+    ph = _Placeholder(name, shape, dtype)
+    default_main_program()._placeholders[name] = ph
+    return ph
+
+
+class Executor:
+    """base/executor.py Executor:1158 analog.
+
+    ``run(program, feed, fetch_list)`` re-executes the program's build stages
+    with the feed substituted for the placeholders. Graph building in this
+    stack happens by running python over tensors, so the Executor simply
+    replays the user's fetch closure per feed; the per-signature compiled
+    path comes from wrapping the fetch computation in paddle_tpu.jit when
+    the program was built with ``Program.capture``.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        # substitute feeds into the placeholders IN PLACE: variables built
+        # from them were captured by reference in the fetch closures
+        for name, value in feed.items():
+            ph = program._placeholders.get(name)
+            if ph is None:
+                raise KeyError(
+                    f"feed '{name}' matches no declared static.data "
+                    f"placeholder (declared: {list(program._placeholders)})")
+            t = value if isinstance(value, Tensor) else Tensor(
+                np.asarray(value))
+            ph._data = t._data
+        outs = []
+        for fetch in (fetch_list or []):
+            if callable(fetch):
+                res = fetch()
+            else:
+                res = fetch  # a Tensor built eagerly during program build
+            outs.append(np.asarray(res._data) if return_numpy
+                        and isinstance(res, Tensor) else res)
+        return outs
+
+    def close(self):
+        return None
