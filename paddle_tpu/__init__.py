@@ -45,6 +45,7 @@ from . import metric
 from . import nn
 from . import optimizer
 from . import profiler
+from . import quantization
 from . import static
 from .hapi import Model, callbacks, summary
 from .distributed.parallel import DataParallel

@@ -114,6 +114,21 @@ class DistModel:
     def __init__(self, layer: Layer, loader=None, loss=None, optimizer=None,
                  strategy: Optional[Strategy] = None, metrics=None):
         self.network = layer
+        self._loader = loader
+        self._dist_loader = None
+        if loader is not None:
+            mesh = get_default_mesh()
+            if mesh is not None:
+                # shard the input pipeline over the mesh's data axis
+                # (api.py:1792 shard_dataloader, wired as the reference's
+                # Engine._prepare_dataloader does)
+                from .auto_parallel import shard_dataloader
+                try:
+                    self._dist_loader = shard_dataloader(loader, mesh)
+                except Exception:
+                    self._dist_loader = loader
+            else:
+                self._dist_loader = loader
         self._loss = loss
         self._optimizer = optimizer
         self._strategy = strategy or Strategy()
@@ -277,7 +292,7 @@ class DistModel:
     # -- program/state introspection ----------------------------------------
     def state_dict(self, mode="all"):
         sd = {}
-        if mode in ("all", "params"):
+        if mode in ("all", "param", "params"):
             sd.update(self.network.state_dict())
         if mode in ("all", "opt") and self._optimizer is not None:
             for k, v in self._optimizer.state_dict().items():
@@ -293,6 +308,10 @@ class DistModel:
                       if k.startswith("optimizer.")}
             if opt_sd:
                 self._optimizer.set_state_dict(opt_sd)
+
+    def dist_loader(self):
+        """The (mesh-sharded) input pipeline built from the ctor loader."""
+        return self._dist_loader
 
     def dist_main_program(self, mode=None):
         """Reference returns the partitioned Program; the TPU analog is the

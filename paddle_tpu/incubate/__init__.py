@@ -1,3 +1,4 @@
 """paddle.incubate analog — experimental APIs (reference: python/paddle/incubate)."""
+from . import asp
 from . import distributed
 from . import nn

@@ -1,0 +1,159 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp — ``prune_model`` (n:m magnitude
+masks per weight), ``decorate`` (optimizer wrapper re-applying masks after
+every step so pruned slots stay zero through training),
+``set_excluded_layers``/``reset_excluded_layers``, and mask checkers
+(``check_sparsity``). The reference targets cuSPARSELt 2:4 kernels; on TPU
+the win is model-size/bandwidth (masked weights stay dense for the MXU),
+so the masks are plain elementwise multiplies XLA folds into the matmul.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+_EXCLUDED: Dict[int, List[str]] = {}
+_MASKS: Dict[int, np.ndarray] = {}  # id(param) -> mask
+
+
+def set_excluded_layers(param_names, main_program=None, model=None):
+    """asp.set_excluded_layers analog (by parameter/layer name prefix)."""
+    key = id(main_program) if main_program is not None else 0
+    _EXCLUDED.setdefault(key, []).extend(list(param_names))
+
+
+def reset_excluded_layers(main_program=None):
+    key = id(main_program) if main_program is not None else 0
+    _EXCLUDED.pop(key, None)
+
+
+def _excluded(name: str) -> bool:
+    for names in _EXCLUDED.values():
+        for pat in names:
+            if pat in name:
+                return True
+    return False
+
+
+def compute_mask_1d(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m mask along the last axis: keep the n largest |w| in each group of
+    m (supported_layers/sparsity utils analog: get_mask_1d)."""
+    w = np.asarray(weight)
+    k = w.shape[-1]
+    if k % m != 0:
+        return np.ones_like(w, dtype=w.dtype)
+    grouped = np.abs(w).reshape(-1, m)
+    # indices of the (m - n) smallest per group -> zero them
+    drop = np.argpartition(grouped, m - n, axis=-1)[:, :m - n]
+    mask = np.ones_like(grouped)
+    np.put_along_axis(mask, drop, 0.0, axis=-1)
+    return mask.reshape(w.shape).astype(w.dtype)
+
+
+def compute_mask_2d(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Greedy 2-D n:m mask over m x m tiles (get_mask_2d_greedy analog):
+    each row AND each column of every tile keeps at most n entries."""
+    w = np.asarray(weight)
+    if w.ndim < 2 or w.shape[-1] % m or w.shape[-2] % m:
+        return compute_mask_1d(w, n, m)
+    mask = np.zeros_like(w)
+    flat = w.reshape(-1, w.shape[-2], w.shape[-1])
+    maskf = mask.reshape(flat.shape)
+    for b in range(flat.shape[0]):
+        for i0 in range(0, flat.shape[1], m):
+            for j0 in range(0, flat.shape[2], m):
+                tile = np.abs(flat[b, i0:i0 + m, j0:j0 + m])
+                order = np.dstack(np.unravel_index(
+                    np.argsort(-tile, axis=None), tile.shape))[0]
+                rows = np.zeros(m, dtype=int)
+                cols = np.zeros(m, dtype=int)
+                sel = np.zeros((m, m))
+                for r, c in order:
+                    if rows[r] < n and cols[c] < n:
+                        sel[r, c] = 1.0
+                        rows[r] += 1
+                        cols[c] += 1
+                maskf[b, i0:i0 + m, j0:j0 + m] = sel
+    return mask.astype(w.dtype)
+
+
+def calculate_density(mat) -> float:
+    """asp.calculate_density analog."""
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    return float(np.count_nonzero(arr)) / arr.size
+
+
+def check_sparsity(mat, n=2, m=4, mask_algo="mask_1d") -> bool:
+    """True if every m-group along the last axis has <= n nonzeros."""
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    if arr.shape[-1] % m:
+        return False
+    groups = (arr.reshape(-1, m) != 0).sum(axis=-1)
+    return bool((groups <= n).all())
+
+
+def _prunable(name: str, param) -> bool:
+    # 2-D weights of matmul-bearing layers; skip biases/norms/embeddings by
+    # dimensionality and excluded names (reference prunes Linear/Conv weights)
+    return param.ndim >= 2 and not _excluded(name)
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo: str =
+                "mask_1d", with_mask: bool = True) -> Dict[str, float]:
+    """asp.prune_model analog: apply n:m masks to every prunable weight.
+    Returns {param_name: density}."""
+    algo = compute_mask_2d if mask_algo in ("mask_2d", "mask_2d_greedy") \
+        else compute_mask_1d
+    out = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        w = np.asarray(p._data)
+        mask = algo(w, n, m)
+        p.set_value(Tensor((w * mask).astype(w.dtype)))
+        if with_mask:
+            _MASKS[id(p)] = mask
+        out[name] = calculate_density(p)
+    return out
+
+
+class ASPOptimizerWrapper:
+    """asp.decorate analog: re-applies the pruning masks after every
+    optimizer step so pruned coordinates stay exactly zero."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        for p in self._inner._parameter_list:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p.set_value(Tensor(np.asarray(p._data) * mask))
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner.set_state_dict(sd)
+
+
+def decorate(optimizer) -> ASPOptimizerWrapper:
+    """asp.decorate analog."""
+    return ASPOptimizerWrapper(optimizer)
+
+
+__all__ = ["prune_model", "decorate", "calculate_density", "check_sparsity",
+           "compute_mask_1d", "compute_mask_2d", "set_excluded_layers",
+           "reset_excluded_layers", "ASPOptimizerWrapper"]

@@ -1,0 +1,373 @@
+"""Quantization framework (QAT + PTQ).
+
+Reference: python/paddle/quantization — ``QuantConfig`` (config.py:
+add_layer_config/add_type_config), ``QAT`` (qat.py: quantize -> swap layers
+for quantized counterparts with fake quanters), ``PTQ`` (ptq.py: insert
+observers, then convert), observers/quanters under observers/ + quanters/.
+
+TPU-native: fake quantization is a quantize-dequantize pair emitted inline
+(XLA fuses it into the surrounding matmul), and the straight-through
+estimator is expressed as ``x + stop_gradient(q(x) - x)`` so the eager tape
+differentiates it with no custom-grad machinery. int8 simulation keeps
+tensors in float on the MXU — the TPU serving path consumes the scales.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..ops.registry import dispatch
+
+
+# ---------------------------------------------------------------------------
+# fake-quant primitives
+# ---------------------------------------------------------------------------
+
+def _fake_quant_ste(x, scale, bit_length=8):
+    """Quantize-dequantize with straight-through gradient (pure jnp)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def quant_dequant(x, scale, bit_length=8):
+    """Public fake-quant op (tape-recorded; STE gradient)."""
+    return dispatch(_fake_quant_ste, (x, scale), {"bit_length": bit_length},
+                    op_name="fake_quant_dequant")
+
+
+# ---------------------------------------------------------------------------
+# observers / quanters (factory objects in the config, instances per layer)
+# ---------------------------------------------------------------------------
+
+class BaseObserver:
+    """observers/base_observer.py analog: tracks a scale from data."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+
+    def _instance(self):
+        return copy.deepcopy(self)
+
+    def observe(self, x: Tensor) -> None:
+        raise NotImplementedError
+
+    def scales(self) -> np.ndarray:
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """observers/abs_max.py analog: running max of |x|."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max = 0.0
+
+    def observe(self, x):
+        arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+        self._max = max(self._max, float(np.max(np.abs(arr), initial=0.0)))
+
+    def scales(self):
+        return np.float32(self._max if self._max > 0 else 1.0)
+
+
+class EMAObserver(BaseObserver):
+    """Exponential-moving-average absmax (observers/ema.py analog)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._val = None
+
+    def observe(self, x):
+        arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+        cur = float(np.max(np.abs(arr), initial=0.0))
+        if self._val is None:
+            self._val = cur
+        else:
+            self._val = (self.moving_rate * self._val
+                         + (1 - self.moving_rate) * cur)
+
+    def scales(self):
+        return np.float32(self._val if self._val else 1.0)
+
+
+class HistObserver(BaseObserver):
+    """Percentile-of-histogram observer (observers/hist.py analog)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, percent=0.999):
+        super().__init__(quant_bits)
+        self.bins_count = bins_count
+        self.percent = percent
+        self._hist = None
+        self._edges = None
+
+    def observe(self, x):
+        arr = np.abs(np.asarray(x._data if isinstance(x, Tensor) else x))
+        hi = float(arr.max(initial=0.0))
+        if self._hist is None:
+            self._edges = np.linspace(0, max(hi, 1e-8), self.bins_count + 1)
+            self._hist = np.histogram(arr, bins=self._edges)[0].astype(
+                np.float64)
+        else:
+            if hi > self._edges[-1]:  # re-bin into a wider range
+                new_edges = np.linspace(0, hi, self.bins_count + 1)
+                centers = (self._edges[:-1] + self._edges[1:]) / 2
+                new_hist = np.histogram(centers, bins=new_edges,
+                                        weights=self._hist)[0]
+                self._edges, self._hist = new_edges, new_hist
+            self._hist += np.histogram(arr, bins=self._edges)[0]
+
+    def scales(self):
+        if self._hist is None or self._hist.sum() == 0:
+            return np.float32(1.0)
+        cdf = np.cumsum(self._hist) / self._hist.sum()
+        idx = int(np.searchsorted(cdf, self.percent))
+        return np.float32(self._edges[min(idx + 1, len(self._edges) - 1)])
+
+
+class FakeQuanterWithAbsMaxObserver(BaseObserver):
+    """quanters/abs_max.py analog — QAT quanter: observes a moving absmax
+    while fake-quantizing every forward."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9, name=None, dtype=None):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._obs = EMAObserver(quant_bits, moving_rate)
+
+    def observe(self, x):
+        self._obs.observe(x)
+
+    def scales(self):
+        return self._obs.scales()
+
+    def quantize(self, x: Tensor) -> Tensor:
+        self.observe(x)
+        return quant_dequant(x, Tensor(self.scales()), self.quant_bits)
+
+
+FakeQuanterWithAbsMaxObserverLayer = FakeQuanterWithAbsMaxObserver
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    """config.py QuantConfig analog: maps layers/types -> (activation,
+    weight) quanter factories."""
+
+    def __init__(self, activation: Optional[BaseObserver] = None,
+                 weight: Optional[BaseObserver] = None):
+        self._default = (activation, weight)
+        self._layer_cfg: Dict[int, tuple] = {}
+        self._type_cfg: Dict[type, tuple] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._default != (None, None):
+            from ..nn.common import Linear
+            from ..nn.conv import Conv2D
+            if isinstance(layer, (Linear, Conv2D)):
+                return self._default
+        return None
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+
+class QuantedLinear(Layer):
+    """qat-swapped Linear: fake-quants activation + weight around matmul."""
+
+    def __init__(self, base, act_quanter, wt_quanter):
+        super().__init__()
+        self._base = base
+        self.weight = base.weight
+        self.bias = base.bias
+        self.activation_quanter = (act_quanter._instance()
+                                   if act_quanter else None)
+        self.weight_quanter = (wt_quanter._instance() if wt_quanter else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            if hasattr(self.activation_quanter, "quantize"):
+                x = self.activation_quanter.quantize(x)
+            else:
+                self.activation_quanter.observe(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            if hasattr(self.weight_quanter, "quantize"):
+                w = self.weight_quanter.quantize(w)
+            else:
+                self.weight_quanter.observe(w)
+        from ..ops.linalg import matmul
+        out = matmul(x, w)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class QuantedConv2D(Layer):
+    """qat-swapped Conv2D."""
+
+    def __init__(self, base, act_quanter, wt_quanter):
+        super().__init__()
+        self._base = base
+        self.weight = base.weight
+        self.bias = base.bias
+        self.activation_quanter = (act_quanter._instance()
+                                   if act_quanter else None)
+        self.weight_quanter = (wt_quanter._instance() if wt_quanter else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            if hasattr(self.activation_quanter, "quantize"):
+                x = self.activation_quanter.quantize(x)
+            else:
+                self.activation_quanter.observe(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            if hasattr(self.weight_quanter, "quantize"):
+                w = self.weight_quanter.quantize(w)
+            else:
+                self.weight_quanter.observe(w)
+        b = self._base
+        return F.conv2d(x, w, self.bias, stride=b.stride, padding=b.padding,
+                        dilation=b.dilation, groups=b.groups,
+                        data_format=b.data_format)
+
+
+_SWAP = {}
+
+
+def _swap_table():
+    if not _SWAP:
+        from ..nn.common import Linear
+        from ..nn.conv import Conv2D
+        _SWAP[Linear] = QuantedLinear
+        _SWAP[Conv2D] = QuantedConv2D
+    return _SWAP
+
+
+def _walk_and_swap(model: Layer, config: QuantConfig, make):
+    for name, child in list(model.named_children()):
+        cfg = config._config_for(child)
+        swapped = None
+        if cfg is not None:
+            for base_t, quant_t in _swap_table().items():
+                if isinstance(child, base_t):
+                    swapped = make(quant_t, child, cfg)
+                    break
+        if swapped is not None:
+            model.add_sublayer(name, swapped)
+        else:
+            _walk_and_swap(child, config, make)
+    return model
+
+
+class QAT:
+    """qat.py QAT analog: swap layers for fake-quantizing counterparts."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        return _walk_and_swap(model, self._config,
+                             lambda qt, child, cfg: qt(child, cfg[0], cfg[1]))
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        return convert(model, inplace=inplace)
+
+
+class PTQ:
+    """ptq.py PTQ analog: insert pure observers; calibrate by running eval
+    batches; then ``convert`` bakes the collected scales."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        return _walk_and_swap(model, self._config,
+                             lambda qt, child, cfg: qt(child, cfg[0], cfg[1]))
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        return convert(model, inplace=inplace)
+
+
+class _ConvertedLinear(Layer):
+    """Inference-form layer: weights pre-quantized to int8 + scale, computed
+    as dequantized float matmul (MXU path); serving exports (w_int8, scale)."""
+
+    def __init__(self, qlayer: QuantedLinear):
+        super().__init__()
+        bits = (qlayer.weight_quanter.quant_bits
+                if qlayer.weight_quanter else 8)
+        qmax = float(2 ** (bits - 1) - 1)
+        w = np.asarray(qlayer.weight._data)
+        scale = (float(qlayer.weight_quanter.scales())
+                 if qlayer.weight_quanter else float(np.abs(w).max() or 1.0))
+        self.w_int8 = Tensor(np.clip(np.round(w / scale * qmax), -qmax,
+                                     qmax).astype(np.int8))
+        self.scale = float(scale)
+        self._qmax = qmax
+        self.bias = qlayer.bias
+        self.act_scale = (float(qlayer.activation_quanter.scales())
+                          if qlayer.activation_quanter else None)
+
+    def forward(self, x):
+        from ..ops.linalg import matmul
+        w = self.w_int8.astype("float32") * (self.scale / self._qmax)
+        out = matmul(x, w)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def convert(model: Layer, inplace=False) -> Layer:
+    """Bake observed scales into inference-form layers."""
+    if not inplace:
+        model = copy.deepcopy(model)
+
+    def _walk(m):
+        for name, child in list(m.named_children()):
+            if isinstance(child, QuantedLinear):
+                m.add_sublayer(name, _ConvertedLinear(child))
+            else:
+                _walk(child)
+    _walk(model)
+    return model
+
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "convert", "quant_dequant",
+           "BaseObserver", "AbsmaxObserver", "EMAObserver", "HistObserver",
+           "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer", "QuantedLinear",
+           "QuantedConv2D"]

@@ -3,11 +3,12 @@
 Reference: python/paddle/base/framework.py (Program/Block/Variable),
 python/paddle/base/executor.py (Executor:1158 -> _StandaloneExecutor:809).
 
-TPU-native: a Program is a recorded build — ``data`` placeholders + the
-callable built under ``program_guard`` — and Executor.run jit-compiles it
-(placeholders become traced args) with an executable cache per feed
-signature, the _ExecutorCache analog. There is no ProgramDesc/IR text: XLA
-owns the graph.
+TPU-native: ``data`` placeholders participate in the normal op tape (every
+dispatched op records a replayable closure — the GradNode graph doubles as
+the Program), so ``Executor.run(feed=..., fetch_list=[var])`` re-evaluates
+the recorded DAG from the placeholders to each fetched variable with the
+feed substituted. There is no ProgramDesc/IR text: XLA owns the compiled
+graph, the tape owns the topology.
 """
 from __future__ import annotations
 
@@ -21,28 +22,30 @@ from .input_spec import InputSpec
 
 class _Placeholder(Tensor):
     """A ``static.data`` variable: a concrete zero tensor (so graph-building
-    python executes) remembered by name for feed-time substitution."""
+    python executes) remembered by name for feed-time substitution.
+
+    stop_gradient=False so every op consuming it records a tape node — the
+    recorded closure graph is what Executor.run replays per feed.
+    """
 
     def __init__(self, name, shape, dtype):
         spec = InputSpec(shape, dtype, name)
         concrete = spec._zeros(batch_size=1)
-        super().__init__(concrete._data, stop_gradient=True, name=name)
+        super().__init__(concrete._data, stop_gradient=False, name=name)
         self.spec = spec
 
 
 class Program:
-    """framework.py Program analog: an ordered recording of placeholders and
-    fetch targets plus the builder callable."""
+    """framework.py Program analog: the named feed placeholders; the op
+    topology lives on the tensors' tape nodes."""
 
     def __init__(self):
         self._placeholders: Dict[str, _Placeholder] = {}
-        self._build_fns: List[Callable] = []
         self.random_seed = 0
 
     def clone(self, for_test=False):
         p = Program()
         p._placeholders = dict(self._placeholders)
-        p._build_fns = list(self._build_fns)
         return p
 
     def global_block(self):
@@ -52,8 +55,7 @@ class Program:
         return []
 
     def __repr__(self):
-        names = list(self._placeholders)
-        return f"Program(inputs={names}, stages={len(self._build_fns)})"
+        return f"Program(inputs={list(self._placeholders)})"
 
 
 _default_main = [Program()]
@@ -95,15 +97,40 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> _Placeholder:
     return ph
 
 
+def _replay(t: Tensor, subst: Dict[int, np.ndarray], memo: Dict[int, object]):
+    """Re-evaluate the tape DAG producing `t` with substituted leaf values.
+
+    value(leaf) = feed if substituted else its current array;
+    value(op output) = node.call(*input values)[out_idx].
+    """
+    tid = id(t)
+    if tid in memo:
+        return memo[tid]
+    if tid in subst:
+        memo[tid] = subst[tid]
+        return subst[tid]
+    node = getattr(t, "_grad_node", None)
+    if node is None or getattr(node, "call", None) is None:
+        memo[tid] = t._data
+        return t._data
+    in_vals = [_replay(inp, subst, memo) for inp in node.inputs]
+    out = node.call(*in_vals)
+    import jax
+    leaves = jax.tree_util.tree_leaves(out)
+    val = leaves[t._grad_out_idx or 0]
+    memo[tid] = val
+    return val
+
+
 class Executor:
     """base/executor.py Executor:1158 analog.
 
-    ``run(program, feed, fetch_list)`` re-executes the program's build stages
-    with the feed substituted for the placeholders. Graph building in this
-    stack happens by running python over tensors, so the Executor simply
-    replays the user's fetch closure per feed; the per-signature compiled
-    path comes from wrapping the fetch computation in paddle_tpu.jit when
-    the program was built with ``Program.capture``.
+    ``run(program, feed, fetch_list)`` replays each fetched variable's
+    recorded op DAG with the feed substituted for the placeholders. Fetch
+    entries may be Tensors (canonical static usage) or zero-arg callables
+    (recomputed imperatively). Ops that do not record tape nodes
+    (differentiable=False ops under no_grad) are replayed from their cached
+    values.
     """
 
     def __init__(self, place=None):
@@ -113,8 +140,7 @@ class Executor:
             fetch_list=None, return_numpy=True):
         program = program or default_main_program()
         feed = feed or {}
-        # substitute feeds into the placeholders IN PLACE: variables built
-        # from them were captured by reference in the fetch closures
+        subst: Dict[int, np.ndarray] = {}
         for name, value in feed.items():
             ph = program._placeholders.get(name)
             if ph is None:
@@ -123,15 +149,20 @@ class Executor:
                     f"placeholder (declared: {list(program._placeholders)})")
             t = value if isinstance(value, Tensor) else Tensor(
                 np.asarray(value))
+            subst[id(ph)] = t._data
+            # also substitute in place for callable fetches
             ph._data = t._data
+        memo: Dict[int, object] = {}
         outs = []
         for fetch in (fetch_list or []):
-            if callable(fetch):
+            if callable(fetch) and not isinstance(fetch, Tensor):
                 res = fetch()
+                val = res._data if isinstance(res, Tensor) else res
+            elif isinstance(fetch, Tensor):
+                val = _replay(fetch, subst, memo)
             else:
-                res = fetch  # a Tensor built eagerly during program build
-            outs.append(np.asarray(res._data) if return_numpy
-                        and isinstance(res, Tensor) else res)
+                val = fetch
+            outs.append(np.asarray(val) if return_numpy else Tensor(val))
         return outs
 
     def close(self):

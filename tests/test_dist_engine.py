@@ -173,16 +173,50 @@ def test_static_program_facade():
     with static.program_guard(prog):
         x = static.data("x", [None, 4], "float32")
         w = paddle.to_tensor(np.ones((4, 2), np.float32))
-        fetch = lambda: paddle.matmul(x, w)  # noqa: E731 — re-run per feed
+        y = paddle.matmul(x, w)          # canonical: fetch the VARIABLE
+        z = paddle.nn.functional.relu(y - 6.0)
     exe = static.Executor()
-    out, = exe.run(prog, feed={"x": np.full((3, 4), 2.0, np.float32)},
-                   fetch_list=[fetch])
+    out, z_out = exe.run(prog, feed={"x": np.full((3, 4), 2.0, np.float32)},
+                         fetch_list=[y, z])
     np.testing.assert_allclose(out, np.full((3, 2), 8.0), rtol=1e-6)
+    np.testing.assert_allclose(z_out, np.full((3, 2), 2.0), rtol=1e-6)
     out2, = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
-                    fetch_list=[fetch])
+                    fetch_list=[y])
     np.testing.assert_allclose(out2, np.full((2, 2), 4.0), rtol=1e-6)
     assert "x" in repr(prog)
     assert static.default_main_program() is not prog  # guard restored
+
+
+def test_static_executor_callable_fetch():
+    from paddle_tpu import static
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 4], "float32")
+        fetch = lambda: x * 3.0  # noqa: E731
+    out, = static.Executor().run(
+        prog, feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[fetch])
+    np.testing.assert_allclose(out, np.full((2, 4), 3.0), rtol=1e-6)
+
+
+def test_dist_model_wraps_loader(mesh):
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return (np.zeros(16, np.float32), np.int64(i % 4))
+
+        def __len__(self):
+            return 8
+
+    net = _mlp()
+    opt = optimizer.AdamW(learning_rate=0.01, parameters=net.parameters())
+    loader = DataLoader(DS(), batch_size=8)
+    model = DistModel(net, loader=loader, loss=nn.CrossEntropyLoss(),
+                      optimizer=opt)
+    assert model.dist_loader() is not None
+    assert model.state_dict(mode="param")  # reference spelling accepted
+    assert all(k.startswith("optimizer.")
+               for k in model.state_dict(mode="opt"))
 
 
 def test_dist_model_requires_loss_for_train(mesh):

@@ -1,0 +1,191 @@
+"""Tests for quantization (QAT/PTQ, reference python/paddle/quantization)
+and ASP n:m sparsity (reference python/paddle/incubate/asp)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+from paddle_tpu.quantization import (QAT, PTQ, AbsmaxObserver, EMAObserver,
+                                     FakeQuanterWithAbsMaxObserver,
+                                     HistObserver, QuantConfig, QuantedConv2D,
+                                     QuantedLinear, convert, quant_dequant)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+# -- fake quant primitive -----------------------------------------------------
+
+def test_quant_dequant_rounds_to_grid():
+    x = paddle.to_tensor(np.array([0.0, 0.1, 0.5, -1.0], dtype=np.float32))
+    scale = paddle.to_tensor(np.float32(1.0))
+    q = _np(quant_dequant(x, scale, bit_length=8))
+    grid = 1.0 / 127
+    np.testing.assert_allclose(q, np.round(_np(x) / grid) * grid, rtol=1e-6)
+
+
+def test_quant_dequant_ste_gradient_is_identity():
+    x = paddle.to_tensor(np.array([0.3, -0.7], dtype=np.float32),
+                         stop_gradient=False)
+    q = quant_dequant(x, paddle.to_tensor(np.float32(1.0)))
+    q.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), [1.0, 1.0], rtol=1e-6)
+
+
+# -- observers ----------------------------------------------------------------
+
+def test_observers_track_scale():
+    a = AbsmaxObserver()
+    a.observe(np.array([1.0, -3.0]))
+    a.observe(np.array([2.0]))
+    assert float(a.scales()) == 3.0
+
+    e = EMAObserver(moving_rate=0.5)
+    e.observe(np.array([4.0]))
+    e.observe(np.array([2.0]))
+    assert float(e.scales()) == pytest.approx(3.0)
+
+    h = HistObserver(bins_count=64, percent=1.0)
+    h.observe(np.linspace(-1, 1, 100))
+    assert 0.9 <= float(h.scales()) <= 1.1
+
+
+# -- QAT ----------------------------------------------------------------------
+
+def test_qat_swaps_and_trains():
+    net = _mlp()
+    q_config = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                           weight=FakeQuanterWithAbsMaxObserver())
+    qat = QAT(q_config)
+    qnet = qat.quantize(net, inplace=False)
+    kinds = [type(l).__name__ for l in qnet.sublayers()]
+    assert kinds.count("QuantedLinear") == 2
+    # original model untouched
+    assert not any(isinstance(l, QuantedLinear) for l in net.sublayers())
+
+    opt = optimizer.Adam(learning_rate=0.05, parameters=qnet.parameters())
+    lossf = nn.CrossEntropyLoss()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor((np.random.RandomState(1).rand(16) * 4)
+                         .astype(np.int64))
+    losses = []
+    for _ in range(8):
+        loss = lossf(qnet(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]  # trains through fake-quant (STE)
+
+
+def test_qat_type_config_limits_swap():
+    net = _mlp()
+    cfg = QuantConfig()
+    cfg.add_type_config(nn.Linear, weight=FakeQuanterWithAbsMaxObserver())
+    qnet = QAT(cfg).quantize(net)
+    quanted = [l for l in qnet.sublayers() if isinstance(l, QuantedLinear)]
+    assert len(quanted) == 2
+    assert all(l.activation_quanter is None for l in quanted)
+
+
+def test_qat_conv_swap():
+    net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU())
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                      weight=FakeQuanterWithAbsMaxObserver())
+    qnet = QAT(cfg).quantize(net)
+    assert any(isinstance(l, QuantedConv2D) for l in qnet.sublayers())
+    x = paddle.to_tensor(np.random.randn(2, 1, 8, 8).astype(np.float32))
+    assert tuple(qnet(x).shape) == (2, 4, 8, 8)
+
+
+# -- PTQ ----------------------------------------------------------------------
+
+def test_ptq_calibrate_and_convert():
+    net = _mlp()
+    cfg = QuantConfig(activation=AbsmaxObserver(), weight=AbsmaxObserver())
+    ptq = PTQ(cfg)
+    pnet = ptq.quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(2).randn(32, 8)
+                         .astype(np.float32))
+    ref = _np(pnet(x))  # calibration pass (observers only: exact output)
+    np.testing.assert_allclose(ref, _np(net(x)), rtol=1e-5, atol=1e-6)
+
+    inet = ptq.convert(pnet)
+    kinds = [type(l).__name__ for l in inet.sublayers()]
+    assert kinds.count("_ConvertedLinear") == 2
+    out = _np(inet(x))
+    # int8 weights: close to the float output
+    assert np.abs(out - ref).max() < 0.1 * (np.abs(ref).max() + 1)
+    # int8 storage really is int8
+    lin = [l for l in inet.sublayers()
+           if type(l).__name__ == "_ConvertedLinear"][0]
+    assert str(lin.w_int8.dtype) in ("int8", "paddle.int8")
+
+
+# -- ASP ----------------------------------------------------------------------
+
+def test_mask_1d_2of4():
+    w = np.array([[0.1, -0.9, 0.5, 0.2, 1.0, 0.05, -0.3, 0.01]],
+                 dtype=np.float32)
+    mask = asp.compute_mask_1d(w, 2, 4)
+    assert mask.shape == w.shape
+    groups = mask.reshape(-1, 4).sum(axis=-1)
+    np.testing.assert_array_equal(groups, [2, 2])
+    # the kept entries are the two largest magnitudes per group
+    assert mask[0, 1] == 1 and mask[0, 2] == 1
+    assert mask[0, 4] == 1 and mask[0, 6] == 1
+
+
+def test_mask_2d_row_and_col_budget():
+    w = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    mask = asp.compute_mask_2d(w, 2, 4)
+    for i0 in range(0, 8, 4):
+        for j0 in range(0, 8, 4):
+            tile = mask[i0:i0 + 4, j0:j0 + 4]
+            assert (tile.sum(axis=0) <= 2).all()
+            assert (tile.sum(axis=1) <= 2).all()
+
+
+def test_prune_model_and_decorate():
+    net = _mlp()
+    densities = asp.prune_model(net, n=2, m=4)
+    assert densities  # at least the two Linear weights
+    for name, d in densities.items():
+        assert d == pytest.approx(0.5, abs=0.01)
+    w0 = net[0].weight
+    assert asp.check_sparsity(w0, 2, 4)
+
+    opt = asp.decorate(optimizer.Adam(learning_rate=0.05,
+                                      parameters=net.parameters()))
+    lossf = nn.CrossEntropyLoss()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor((np.random.RandomState(1).rand(16) * 4)
+                         .astype(np.int64))
+    for _ in range(3):
+        loss = lossf(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # masks survived training steps
+    assert asp.check_sparsity(net[0].weight, 2, 4)
+    assert asp.calculate_density(net[0].weight) == pytest.approx(0.5,
+                                                                 abs=0.01)
+
+
+def test_asp_excluded_layers():
+    net = _mlp()
+    asp.set_excluded_layers(["0.weight"])
+    try:
+        densities = asp.prune_model(net, 2, 4)
+        assert all("0.weight" not in k for k in densities)
+        assert asp.calculate_density(net[0].weight) == 1.0
+    finally:
+        asp.reset_excluded_layers()
