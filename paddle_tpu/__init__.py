@@ -27,7 +27,9 @@ from .ops import (abs, all, any, max, min, pow, round, sum)  # noqa: F401
 
 # subpackages
 from . import amp
+from . import audio
 from . import autograd
+from . import device
 from . import distributed
 from . import distribution
 from . import fft
@@ -46,6 +48,8 @@ from . import nn
 from . import optimizer
 from . import profiler
 from . import quantization
+from . import sparse
+from . import vision
 from . import static
 from .hapi import Model, callbacks, summary
 from .distributed.parallel import DataParallel

@@ -1,0 +1,7 @@
+"""paddle.audio analog: spectral features over the fft/signal stack."""
+from __future__ import annotations
+
+from . import features
+from . import functional
+
+__all__ = ["features", "functional"]

@@ -1,0 +1,103 @@
+"""Audio feature layers.
+
+Reference: python/paddle/audio/features/layers.py — Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC as nn Layers. Composed from
+signal.stft + the functional filterbank; everything after the window is
+one fused XLA computation.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..ops.linalg import matmul
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    """features Spectrogram analog: |STFT|^power, [B, freq, frames]."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", F.get_window(window, self.win_length, dtype=dtype))
+
+    def forward(self, x):
+        from ..signal import stft
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        mag = spec.abs()
+        return mag ** self.power if self.power != 1.0 else mag
+
+
+class MelSpectrogram(Layer):
+    """features MelSpectrogram analog."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.register_buffer("fbank_matrix", F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)                     # [B, freq, frames]
+        return matmul(self.fbank_matrix, spec)          # [B, mel, frames]
+
+
+class LogMelSpectrogram(Layer):
+    """features LogMelSpectrogram analog."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """features MFCC analog: DCT-II over the log-mel spectrogram."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct_matrix",
+                             F.create_dct(n_mfcc, n_mels, dtype=dtype))
+        self.n_mfcc = n_mfcc
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)            # [B, mel, frames]
+        # DCT along the mel axis: [n_mels, n_mfcc]^T @ mel
+        return matmul(self.dct_matrix.transpose([1, 0]), logmel)
+
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,133 @@
+"""Audio functional ops.
+
+Reference: python/paddle/audio/functional (hz_to_mel/mel_to_hz/
+mel_frequencies/fft_frequencies/compute_fbank_matrix/power_to_db/
+create_dct, window functions). Pure array math over jnp via the op
+registry — the mel filterbank matmul rides the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.registry import dispatch
+
+
+def hz_to_mel(freq, htk=False):
+    """functional/functional.py hz_to_mel analog (slaney default)."""
+    scalar = isinstance(freq, (int, float))
+    f = np.asarray(freq._data if isinstance(freq, Tensor) else freq,
+                   dtype=np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, mel)
+    return float(mel) if scalar else (Tensor(mel.astype(np.float32))
+                                      if isinstance(freq, Tensor) else mel)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = isinstance(mel, (int, float))
+    m = np.asarray(mel._data if isinstance(mel, Tensor) else mel,
+                   dtype=np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else (Tensor(hz.astype(np.float32))
+                                     if isinstance(mel, Tensor) else hz)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(mel_to_hz(mels, htk).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.asarray(fft_frequencies(sr, n_fft)._data, dtype=np.float64)
+    melfreqs = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk)._data,
+        dtype=np.float64)
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10 with clamping (functional power_to_db analog)."""
+    def _impl(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    x = spect if isinstance(spect, Tensor) else Tensor(np.asarray(spect))
+    return dispatch(_impl, (x,), {}, op_name="power_to_db")
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc]."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return Tensor(dct.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window functions (functional/window.py analog)."""
+    n = win_length
+    if isinstance(window, (tuple, list)):
+        window, _ = window[0], window[1:]
+    denom = n if fftbins else n - 1
+    t = np.arange(n, dtype=np.float64)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * t / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * t / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * t / denom)
+             + 0.08 * np.cos(4 * math.pi * t / denom))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window: {window}")
+    return Tensor(w.astype(dtype))
+
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]

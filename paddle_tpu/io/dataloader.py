@@ -246,6 +246,28 @@ class _InfiniteCounter:
             yield list(range(self.batch_size))
 
 
+import atexit as _atexit
+import weakref as _weakref
+
+_LIVE_READERS = _weakref.WeakSet()  # active _BufferReaders
+
+
+def _drain_readers_at_exit():
+    """Close every live buffer queue before interpreter finalization: a
+    feeder thread parked inside the native condvar at exit would otherwise
+    be force-unwound through C++ frames (pthread_exit during take_gil),
+    aborting with 'FATAL: exception not rethrown'."""
+    for reader in list(_LIVE_READERS):
+        try:
+            reader._q.close()
+            reader._thread.join(timeout=2.0)
+        except Exception:  # noqa: BLE001 — best-effort shutdown
+            pass
+
+
+_atexit.register(_drain_readers_at_exit)
+
+
 class _BufferReader:
     """Device-side prefetch buffer (the reference's use_buffer_reader: C++
     blocking queue fed by a reader thread, fluid/imperative/data_loader.cc).
@@ -261,6 +283,7 @@ class _BufferReader:
         self._q = BlockingQueue(depth)
         self._err = None
         self._stat_update = stat_update
+        _LIVE_READERS.add(self)
 
         def _feed():
             try:

@@ -1,0 +1,200 @@
+"""paddle.sparse analog.
+
+Reference: python/paddle/sparse (SparseCooTensor/SparseCsrTensor creation,
+to_dense/to_sparse conversions, sparse matmul/add/mul, unary op family;
+C++ kernels under phi/kernels/sparse/).
+
+TPU-native: backed by jax.experimental.sparse BCOO — XLA lowers sparse
+contractions to gather/scatter + dense dot segments, which is the right
+trade on an MXU machine (the reference's cuSPARSE role).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from . import nn  # noqa: F401  (sparse.nn.ReLU etc.)
+
+
+class SparseTensor:
+    """Wrapper over a BCOO array with the reference's surface."""
+
+    def __init__(self, bcoo, fmt="coo"):
+        self._bcoo = bcoo
+        self._fmt = fmt
+
+    @property
+    def shape(self):
+        return tuple(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(jnp.swapaxes(self._bcoo.indices, -1, -2))
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self):
+        return self._fmt == "csr"
+
+    # crs accessors (csr-format views)
+    def crows(self) -> Tensor:
+        n_rows = self.shape[0]
+        rows = np.asarray(self._bcoo.indices)[:, 0]
+        counts = np.bincount(rows, minlength=n_rows)
+        return Tensor(np.concatenate([[0], np.cumsum(counts)])
+                      .astype(np.int64))
+
+    def cols(self) -> Tensor:
+        return Tensor(np.asarray(self._bcoo.indices)[:, 1].astype(np.int64))
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"format={self._fmt})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseTensor:
+    """paddle.sparse.sparse_coo_tensor analog: indices [ndim, nnz]."""
+    idx = np.asarray(indices._data if isinstance(indices, Tensor)
+                     else indices)
+    val = np.asarray(values._data if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        val = val.astype(str(dtype).replace("paddle.", ""))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((jnp.asarray(val), jnp.asarray(idx.T)),
+                        shape=tuple(shape))
+    return SparseTensor(bcoo, "coo")
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseTensor:
+    """paddle.sparse.sparse_csr_tensor analog (stored as BCOO internally)."""
+    crows_np = np.asarray(crows._data if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols._data if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    t = sparse_coo_tensor(idx, values, shape, dtype)
+    t._fmt = "csr"
+    return t
+
+
+def _dense_to_sparse(x: Tensor, fmt="coo") -> SparseTensor:
+    bcoo = jsparse.BCOO.fromdense(x._data if isinstance(x, Tensor)
+                                  else jnp.asarray(x))
+    return SparseTensor(bcoo, fmt)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    return _dense_to_sparse(x, "coo")
+
+
+def to_sparse_csr(x):
+    return _dense_to_sparse(x, "csr")
+
+
+def _unwrap(x):
+    if isinstance(x, SparseTensor):
+        return x._bcoo
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def matmul(x, y):
+    """sparse @ dense (or sparse @ sparse -> dense result)."""
+    a, b = _unwrap(x), _unwrap(y)
+    out = a @ b
+    if isinstance(out, jsparse.BCOO):
+        return SparseTensor(out)
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask: SparseTensor):
+    """Dense@dense evaluated only at mask's nonzero positions (SDDMM)."""
+    a, b = _unwrap(x), _unwrap(y)
+    idx = mask._bcoo.indices  # [nnz, 2]
+    rows = a[idx[:, 0], :]
+    cols = b[:, idx[:, 1]].T
+    vals = jnp.sum(rows * cols, axis=-1)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask.shape), "coo")
+
+
+def add(x, y):
+    a, b = _unwrap(x), _unwrap(y)
+    out = a + b
+    if isinstance(out, jsparse.BCOO):
+        return SparseTensor(out)
+    return Tensor(out)
+
+
+def multiply(x, y):
+    if isinstance(x, SparseTensor) and not isinstance(y, SparseTensor):
+        # elementwise scale of stored values
+        y_arr = _unwrap(y)
+        vals = x._bcoo.data * (y_arr if jnp.ndim(y_arr) == 0 else
+                               y_arr[tuple(x._bcoo.indices.T)])
+        return SparseTensor(jsparse.BCOO((vals, x._bcoo.indices),
+                                         shape=x.shape), x._fmt)
+    return add(x, 0) if y is None else Tensor(_unwrap(x) * _unwrap(y))
+
+
+def _unary(fn):
+    def op(x: SparseTensor) -> SparseTensor:
+        vals = fn(x._bcoo.data)
+        return SparseTensor(jsparse.BCOO((vals, x._bcoo.indices),
+                                         shape=x.shape), x._fmt)
+    return op
+
+
+abs = _unary(jnp.abs)
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+expm1 = _unary(jnp.expm1)
+neg = _unary(jnp.negative)
+relu = _unary(jax.nn.relu)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+__all__ = ["SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+           "to_sparse_coo", "to_sparse_csr", "matmul", "masked_matmul",
+           "add", "multiply", "abs", "sin", "tan", "asin", "atan", "sinh",
+           "tanh", "asinh", "atanh", "sqrt", "square", "log1p", "expm1",
+           "neg", "relu", "is_same_shape", "nn"]

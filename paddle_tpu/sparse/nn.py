@@ -1,0 +1,40 @@
+"""paddle.sparse.nn analog (activation layers over sparse values)."""
+from __future__ import annotations
+
+
+class ReLU:
+    def __call__(self, x):
+        from . import relu
+        return relu(x)
+
+
+class Softmax:
+    """Row-wise softmax over the stored values of a 2-D sparse tensor."""
+
+    def __init__(self, axis=-1):
+        if axis not in (-1, 1):
+            raise NotImplementedError(
+                "sparse.nn.Softmax supports the last axis only (2-D row-"
+                f"wise); got axis={axis}")
+        self.axis = axis
+
+    def __call__(self, x):
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+        from . import SparseTensor
+        idx = np.asarray(x._bcoo.indices)
+        vals = np.asarray(x._bcoo.data, dtype=np.float64)
+        rows = idx[:, 0]
+        out = np.empty_like(vals)
+        for r in np.unique(rows):
+            m = rows == r
+            v = vals[m]
+            e = np.exp(v - v.max())
+            out[m] = e / e.sum()
+        return SparseTensor(jsparse.BCOO(
+            (jnp.asarray(out.astype(np.float32)), x._bcoo.indices),
+            shape=x.shape), x._fmt)
+
+
+__all__ = ["ReLU", "Softmax"]

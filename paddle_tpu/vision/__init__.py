@@ -1,0 +1,19 @@
+"""paddle.vision analog: transforms, datasets, models, ops."""
+from __future__ import annotations
+
+from . import datasets
+from . import models
+from . import ops
+from . import transforms
+from .models import LeNet, MobileNetV1, MobileNetV2, ResNet
+
+__all__ = ["transforms", "datasets", "models", "ops", "LeNet", "ResNet",
+           "MobileNetV1", "MobileNetV2"]
+
+
+def set_image_backend(backend):
+    return None
+
+
+def get_image_backend():
+    return "numpy"

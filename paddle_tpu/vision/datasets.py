@@ -1,0 +1,161 @@
+"""Vision datasets.
+
+Reference: python/paddle/vision/datasets (MNIST/FashionMNIST idx parsing,
+Cifar10/100 pickle parsing, DatasetFolder). This environment has no
+network egress, so ``download=True`` raises with instructions; local files
+parse identically to the reference's readers.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable in this environment "
+        f"(no egress). Pass image_path/label_path (or data_file) pointing "
+        f"at locally available files.")
+
+
+def _parse_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad idx image magic {magic}"
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _parse_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad idx label magic {magic}"
+        return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+
+class MNIST(Dataset):
+    """datasets/mnist.py analog (idx file parsing)."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = True,
+                 backend: str = "cv2"):
+        if image_path is None or label_path is None:
+            _no_download(self.NAME)
+        self.images = _parse_idx_images(image_path)
+        self.labels = _parse_idx_labels(label_path)
+        assert len(self.images) == len(self.labels)
+        self.transform = transform
+        self.mode = mode
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class Cifar10(Dataset):
+    """datasets/cifar.py analog (python-pickle batch parsing from the
+    distribution tarball or extracted batch files)."""
+
+    _TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_FILES = ["test_batch"]
+    _LABEL_KEY = b"labels"
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = True,
+                 backend: str = "cv2"):
+        if data_file is None:
+            _no_download(type(self).__name__)
+        names = self._TRAIN_FILES if mode == "train" else self._TEST_FILES
+        imgs, labels = [], []
+        if data_file.endswith((".tar.gz", ".tgz", ".tar")):
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    if os.path.basename(m.name) in names:
+                        d = pickle.load(tf.extractfile(m), encoding="bytes")
+                        imgs.append(d[b"data"])
+                        labels.extend(d[self._LABEL_KEY])
+        else:
+            for n in names:
+                with open(os.path.join(data_file, n), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                imgs.append(d[b"data"])
+                labels.extend(d[self._LABEL_KEY])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)  # HWC
+        self.labels = np.asarray(labels, dtype=np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _TRAIN_FILES = ["train"]
+    _TEST_FILES = ["test"]
+    _LABEL_KEY = b"fine_labels"
+
+
+class DatasetFolder(Dataset):
+    """datasets/folder.py analog: class-per-subdirectory layout. Images are
+    loaded with numpy (`.npy`) or raw-bytes decoders registered by
+    extension; PIL-style decoders can be passed via ``loader``."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=(".npy",), transform=None, is_valid_file=None):
+        self.root = root
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.loader = loader or (lambda p: np.load(p))
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]

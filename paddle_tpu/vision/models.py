@@ -1,0 +1,218 @@
+"""Vision model zoo.
+
+Reference: python/paddle/vision/models (LeNet, VGG, ResNet, MobileNetV1/V2,
+...). ResNet lives in paddle_tpu.models.resnet (the flagship CNN); this
+module adds the rest in the same NCHW/conv2d idiom and re-exports resnet.
+Pretrained=True is unavailable offline (raises with a clear message).
+"""
+from __future__ import annotations
+
+from ..models.resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                             resnet152)
+from ..nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Flatten,
+                  Layer, Linear, MaxPool2D, ReLU, ReLU6, Sequential)
+
+
+def _no_pretrained(name):
+    raise RuntimeError(f"{name}(pretrained=True): pretrained weights are not "
+                       f"available in this environment (no egress); pass "
+                       f"pretrained=False and load a local state dict.")
+
+
+class LeNet(Layer):
+    """models/lenet.py analog."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(),
+            MaxPool2D(2, 2))
+        self.fc = Sequential(
+            Linear(400, 120), Linear(120, 84), Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.reshape([x.shape[0], -1])
+        return self.fc(x)
+
+
+_VGG_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512,
+         "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512,
+         512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    """models/vgg.py analog."""
+
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((7, 7))
+        self.classifier = Sequential(
+            Linear(512 * 7 * 7, 4096), ReLU(), Dropout(),
+            Linear(4096, 4096), ReLU(), Dropout(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        x = x.reshape([x.shape[0], -1])
+        return self.classifier(x)
+
+
+def _vgg_features(cfg, batch_norm=False):
+    layers = []
+    in_c = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(MaxPool2D(2, 2))
+        else:
+            layers.append(Conv2D(in_c, v, 3, padding=1))
+            if batch_norm:
+                layers.append(BatchNorm2D(v))
+            layers.append(ReLU())
+            in_c = v
+    return Sequential(*layers)
+
+
+def _make_vgg(depth, batch_norm, pretrained, **kwargs):
+    if pretrained:
+        _no_pretrained(f"vgg{depth}")
+    return VGG(_vgg_features(_VGG_CFGS[depth], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return _make_vgg(11, batch_norm, pretrained, **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return _make_vgg(13, batch_norm, pretrained, **kwargs)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kwargs):
+    return _make_vgg(16, batch_norm, pretrained, **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    return _make_vgg(19, batch_norm, pretrained, **kwargs)
+
+
+class _DepthwiseSeparable(Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.conv = Sequential(
+            Conv2D(in_c, in_c, 3, stride=stride, padding=1, groups=in_c),
+            BatchNorm2D(in_c), ReLU(),
+            Conv2D(in_c, out_c, 1), BatchNorm2D(out_c), ReLU())
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class MobileNetV1(Layer):
+    """models/mobilenetv1.py analog."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: max(8, int(c * scale))  # noqa: E731
+        cfg = [(s(32), s(64), 1), (s(64), s(128), 2), (s(128), s(128), 1),
+               (s(128), s(256), 2), (s(256), s(256), 1), (s(256), s(512), 2),
+               *[(s(512), s(512), 1)] * 5,
+               (s(512), s(1024), 2), (s(1024), s(1024), 1)]
+        layers = [Conv2D(3, s(32), 3, stride=2, padding=1),
+                  BatchNorm2D(s(32)), ReLU()]
+        for in_c, out_c, stride in cfg:
+            layers.append(_DepthwiseSeparable(in_c, out_c, stride))
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        x = x.reshape([x.shape[0], -1])
+        return self.fc(x)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        _no_pretrained("mobilenet_v1")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers += [Conv2D(in_c, hidden, 1), BatchNorm2D(hidden), ReLU6()]
+        layers += [Conv2D(hidden, hidden, 3, stride=stride, padding=1,
+                          groups=hidden),
+                   BatchNorm2D(hidden), ReLU6(),
+                   Conv2D(hidden, out_c, 1), BatchNorm2D(out_c)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """models/mobilenetv2.py analog."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: max(8, int(c * scale))  # noqa: E731
+        cfg = [(1, s(16), 1, 1), (6, s(24), 2, 2), (6, s(32), 3, 2),
+               (6, s(64), 4, 2), (6, s(96), 3, 1), (6, s(160), 3, 2),
+               (6, s(320), 1, 1)]
+        layers = [Conv2D(3, s(32), 3, stride=2, padding=1),
+                  BatchNorm2D(s(32)), ReLU6()]
+        in_c = s(32)
+        for t, c, n, stride in cfg:
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    in_c, c, stride if i == 0 else 1, t))
+                in_c = c
+        last = s(1280)
+        layers += [Conv2D(in_c, last, 1), BatchNorm2D(last), ReLU6()]
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        self.fc = Linear(last, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        x = x.reshape([x.shape[0], -1])
+        return self.fc(x)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        _no_pretrained("mobilenet_v2")
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+__all__ = ["LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+           "MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
+           "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152"]

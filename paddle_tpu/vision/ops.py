@@ -1,0 +1,66 @@
+"""Vision ops — boxes/NMS.
+
+Reference: python/paddle/vision/ops.py (nms, box_coder, distribute-style
+ops; CUDA kernels under phi/kernels/gpu/nms_kernel.cu). TPU-native: IoU is
+a broadcast matrix op; NMS's sequential suppression runs as a host-side
+loop over a device-computed IoU matrix (data-dependent control flow stays
+out of XLA).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x._data) if isinstance(x, Tensor) else np.asarray(x)
+
+
+def box_area(boxes):
+    b = _np(boxes)
+    return Tensor(((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+                  .astype(np.float32))
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU for [N,4] and [M,4] xyxy boxes -> [N,M]."""
+    a = _np(boxes1).astype(np.float32)
+    b = _np(boxes2).astype(np.float32)
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return Tensor((inter / np.maximum(union, 1e-9)).astype(np.float32))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """vision/ops.py nms analog: returns kept indices (descending score)."""
+    b = _np(boxes).astype(np.float32)
+    n = b.shape[0]
+    s = (_np(scores).astype(np.float32) if scores is not None
+         else np.arange(n, 0, -1, dtype=np.float32))
+    cats = (_np(category_idxs) if category_idxs is not None
+            else np.zeros(n, dtype=np.int64))
+    iou = np.asarray(box_iou(b, b)._data)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(n, dtype=bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        overlap = (iou[i] > iou_threshold) & (cats == cats[i])
+        overlap[i] = False
+        suppressed |= overlap
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+__all__ = ["nms", "box_iou", "box_area"]

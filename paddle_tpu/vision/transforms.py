@@ -1,0 +1,301 @@
+"""Image transforms.
+
+Reference: python/paddle/vision/transforms — functional ops + the Compose
+class-transform zoo. Host-side (numpy) preprocessing like the reference's
+(transforms run in dataloader workers on CPU); tensors come out the far end
+via ToTensor.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _as_hwc(img):
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+# -- functional ----------------------------------------------------------------
+
+def to_tensor(img, data_format="CHW") -> Tensor:
+    """transforms/functional.py to_tensor analog: HWC uint8 -> CHW float/255."""
+    arr = _as_hwc(img).astype(np.float32)
+    if np.asarray(img).dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img._data if isinstance(img, Tensor) else img,
+                     dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Nearest/bilinear resize in numpy (PIL-free)."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        # shorter side -> size, keep aspect
+        if h < w:
+            nh, nw = size, max(1, int(round(w * size / h)))
+        else:
+            nh, nw = max(1, int(round(h * size / w))), size
+    else:
+        nh, nw = size
+    if interpolation == "nearest":
+        ri = (np.arange(nh) * h / nh).astype(int).clip(0, h - 1)
+        ci = (np.arange(nw) * w / nw).astype(int).clip(0, w - 1)
+        return arr[ri][:, ci]
+    # bilinear
+    ry = (np.arange(nh) + 0.5) * h / nh - 0.5
+    rx = (np.arange(nw) + 0.5) * w / nw - 0.5
+    y0 = np.clip(np.floor(ry).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(rx).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ry - y0, 0, 1)[:, None, None]
+    wx = np.clip(rx - x0, 0, 1)[None, :, None]
+    a = arr.astype(np.float32)
+    out = ((a[y0][:, x0] * (1 - wy) * (1 - wx))
+           + (a[y1][:, x0] * wy * (1 - wx))
+           + (a[y0][:, x1] * (1 - wy) * wx)
+           + (a[y1][:, x1] * wy * wx))
+    if np.issubdtype(arr.dtype, np.floating):
+        return out.astype(arr.dtype)
+    return np.clip(np.round(out), 0, 255).astype(arr.dtype)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _as_hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    th, tw = output_size
+    h, w = arr.shape[:2]
+    return crop(arr, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _as_hwc(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kwargs = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kwargs)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _as_hwc(img).astype(np.float32) * brightness_factor
+    return np.clip(arr, 0, 255 if _as_hwc(img).dtype == np.uint8 else
+                   np.inf).astype(_as_hwc(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _as_hwc(img).astype(np.float32)
+    mean = arr.mean()
+    out = (arr - mean) * contrast_factor + mean
+    return np.clip(out, 0, 255 if _as_hwc(img).dtype == np.uint8 else
+                   np.inf).astype(_as_hwc(img).dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Nearest-neighbor rotation about the center."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else center
+    theta = np.deg2rad(angle)
+    yy, xx = np.mgrid[0:h, 0:w]
+    ys = cy + (yy - cy) * np.cos(theta) - (xx - cx) * np.sin(theta)
+    xs = cx + (yy - cy) * np.sin(theta) + (xx - cx) * np.cos(theta)
+    yi = np.round(ys).astype(int)
+    xi = np.round(xs).astype(int)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(arr, fill)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+# -- class transforms ----------------------------------------------------------
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        if self.padding is not None:
+            arr = pad(arr, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            # pad() 4-tuple order is (left, top, right, bottom)
+            arr = pad(arr, (0, 0, max(0, tw - w), max(0, th - h)),
+                      self.fill, self.padding_mode)
+            h, w = arr.shape[:2]
+        top = random.randint(0, max(0, h - th))
+        left = random.randint(0, max(0, w - tw))
+        return crop(arr, top, left, th, tw)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, center=self.center, fill=self.fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, factor)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, factor)
+
+
+__all__ = ["to_tensor", "normalize", "resize", "hflip", "vflip", "crop",
+           "center_crop", "pad", "rotate", "adjust_brightness",
+           "adjust_contrast", "Compose", "BaseTransform", "ToTensor",
+           "Normalize", "Resize", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "CenterCrop", "RandomCrop", "Pad",
+           "RandomRotation", "BrightnessTransform", "ContrastTransform"]

@@ -1,0 +1,289 @@
+"""Tests for paddle.vision / paddle.audio / paddle.sparse / paddle.device
+(reference: python/paddle/{vision,audio,sparse,device})."""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import vision
+from paddle_tpu.vision import transforms as T
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+# -- vision.transforms ---------------------------------------------------------
+
+def test_to_tensor_scales_and_chw():
+    img = (np.ones((4, 6, 3)) * 255).astype(np.uint8)
+    t = T.to_tensor(img)
+    assert tuple(t.shape) == (3, 4, 6)
+    np.testing.assert_allclose(_np(t), 1.0)
+
+
+def test_normalize():
+    img = np.ones((3, 2, 2), dtype=np.float32)
+    out = T.normalize(img, mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_resize_shapes():
+    img = np.arange(64, dtype=np.uint8).reshape(8, 8, 1)
+    assert T.resize(img, (4, 4)).shape == (4, 4, 1)
+    assert T.resize(img, 4).shape == (4, 4, 1)
+    tall = np.zeros((16, 8, 1), dtype=np.uint8)
+    assert T.resize(tall, 4).shape == (8, 4, 1)  # shorter side -> 4
+
+
+def test_flip_crop_pad():
+    img = np.arange(16, dtype=np.float32).reshape(4, 4, 1)
+    np.testing.assert_array_equal(T.hflip(img)[:, :, 0], img[:, ::-1, 0])
+    np.testing.assert_array_equal(T.vflip(img)[:, :, 0], img[::-1, :, 0])
+    c = T.center_crop(img, 2)
+    np.testing.assert_array_equal(c[:, :, 0], img[1:3, 1:3, 0])
+    p = T.pad(img, 1)
+    assert p.shape == (6, 6, 1)
+
+
+def test_compose_pipeline():
+    pipe = T.Compose([T.Resize((8, 8)), T.CenterCrop(4), T.ToTensor(),
+                      T.Normalize(mean=0.5, std=0.5)])
+    img = (np.random.RandomState(0).rand(16, 16, 3) * 255).astype(np.uint8)
+    out = pipe(img)
+    assert tuple(out.shape) == (3, 4, 4)
+
+
+def test_random_crop_pad_if_needed_widens():
+    img = np.zeros((32, 20, 3), dtype=np.uint8)
+    out = T.RandomCrop(32, pad_if_needed=True)(img)
+    assert out.shape == (32, 32, 3)
+
+
+def test_resize_preserves_float64_values():
+    img = np.random.RandomState(0).rand(8, 8, 1)  # float64 in [0, 1]
+    out = T.resize(img, (4, 4))
+    assert out.dtype == np.float64
+    assert 0.2 < out.mean() < 0.8  # not quantized to {0, 1}
+
+
+# -- vision.models --------------------------------------------------------------
+
+def test_lenet_forward():
+    net = vision.LeNet(num_classes=10)
+    x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype(np.float32))
+    assert tuple(net(x).shape) == (2, 10)
+
+
+def test_mobilenet_v2_forward():
+    net = vision.models.mobilenet_v2(scale=0.25, num_classes=7)
+    x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype(np.float32))
+    assert tuple(net(x).shape) == (1, 7)
+
+
+def test_vgg11_tiny_forward():
+    net = vision.models.vgg11(num_classes=5)
+    x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype(np.float32))
+    assert tuple(net(x).shape) == (1, 5)
+
+
+def test_pretrained_raises():
+    with pytest.raises(RuntimeError, match="pretrained"):
+        vision.models.vgg11(pretrained=True)
+
+
+# -- vision.datasets -------------------------------------------------------------
+
+def test_mnist_idx_parsing(tmp_path):
+    import struct
+    imgs = (np.arange(2 * 28 * 28) % 256).astype(np.uint8)
+    ip = tmp_path / "images.idx"
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 2, 28, 28))
+        f.write(imgs.tobytes())
+    lp = tmp_path / "labels.idx"
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 2))
+        f.write(np.array([3, 7], dtype=np.uint8).tobytes())
+    ds = vision.datasets.MNIST(image_path=str(ip), label_path=str(lp))
+    assert len(ds) == 2
+    img, label = ds[1]
+    assert img.shape == (28, 28) and label == 7
+
+
+def test_dataset_folder(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        np.save(d / "a.npy", np.zeros((4, 4)))
+    ds = vision.datasets.DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 2
+    _, y = ds[1]
+    assert y == 1
+
+
+def test_dataset_download_unavailable():
+    with pytest.raises(RuntimeError, match="egress"):
+        vision.datasets.MNIST()
+
+
+# -- vision.ops ------------------------------------------------------------------
+
+def test_box_iou_and_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     dtype=np.float32)
+    iou = _np(vision.ops.box_iou(boxes, boxes))
+    assert iou[0, 0] == pytest.approx(1.0)
+    assert iou[0, 2] == 0.0
+    assert 0.5 < iou[0, 1] < 0.8
+    scores = np.array([0.9, 0.8, 0.7], dtype=np.float32)
+    keep = _np(vision.ops.nms(boxes, iou_threshold=0.5, scores=scores))
+    np.testing.assert_array_equal(keep, [0, 2])  # box 1 suppressed by 0
+
+
+def test_nms_respects_categories():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], dtype=np.float32)
+    scores = np.array([0.9, 0.8], dtype=np.float32)
+    keep = _np(vision.ops.nms(boxes, 0.5, scores,
+                              category_idxs=np.array([0, 1]),
+                              categories=[0, 1]))
+    assert len(keep) == 2  # different classes: no suppression
+
+
+# -- audio -----------------------------------------------------------------------
+
+def test_mel_scale_roundtrip():
+    from paddle_tpu.audio import functional as AF
+    for htk in (False, True):
+        hz = AF.mel_to_hz(AF.hz_to_mel(440.0, htk), htk)
+        assert hz == pytest.approx(440.0, rel=1e-6)
+
+
+def test_fbank_matrix_shape_and_coverage():
+    from paddle_tpu.audio import functional as AF
+    fb = _np(AF.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40))
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    assert (fb.sum(axis=1) > 0).all()  # every filter has support
+
+
+def test_spectrogram_and_mfcc():
+    from paddle_tpu.audio.features import MFCC, LogMelSpectrogram, Spectrogram
+    sig = paddle.to_tensor(
+        np.sin(2 * math.pi * 440 * np.arange(4000) / 16000)
+        .astype(np.float32)[None, :])
+    spec = Spectrogram(n_fft=256, hop_length=128)(sig)
+    assert spec.shape[1] == 129
+    logmel = LogMelSpectrogram(sr=16000, n_fft=256, hop_length=128,
+                               n_mels=32)(sig)
+    assert logmel.shape[1] == 32
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=256, hop_length=128,
+                n_mels=32)(sig)
+    assert mfcc.shape[1] == 13
+    # 440 Hz peak lands in the right fft bin
+    power = _np(spec)[0].mean(axis=-1)
+    peak_hz = power.argmax() * 16000 / 256
+    assert abs(peak_hz - 440) < 65
+
+
+def test_power_to_db():
+    from paddle_tpu.audio import functional as AF
+    x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], dtype=np.float32))
+    db = _np(AF.power_to_db(x, top_db=None))
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+
+# -- sparse ----------------------------------------------------------------------
+
+def test_sparse_coo_roundtrip():
+    from paddle_tpu import sparse
+    idx = np.array([[0, 1, 2], [1, 2, 0]])
+    vals = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    st = sparse.sparse_coo_tensor(idx, vals, shape=(3, 3))
+    assert st.nnz == 3
+    dense = _np(st.to_dense())
+    assert dense[0, 1] == 1.0 and dense[1, 2] == 2.0 and dense[2, 0] == 3.0
+    back = sparse.to_sparse_coo(paddle.to_tensor(dense))
+    np.testing.assert_allclose(_np(back.to_dense()), dense)
+
+
+def test_sparse_csr_and_crows():
+    from paddle_tpu import sparse
+    crows = np.array([0, 1, 3])
+    cols = np.array([1, 0, 2])
+    vals = np.array([5.0, 6.0, 7.0], dtype=np.float32)
+    st = sparse.sparse_csr_tensor(crows, cols, vals, shape=(2, 3))
+    dense = _np(st.to_dense())
+    assert dense[0, 1] == 5.0 and dense[1, 0] == 6.0 and dense[1, 2] == 7.0
+    np.testing.assert_array_equal(_np(st.crows()), crows)
+
+
+def test_sparse_matmul_matches_dense():
+    from paddle_tpu import sparse
+    rng = np.random.RandomState(0)
+    dense = rng.randn(4, 5).astype(np.float32) * (rng.rand(4, 5) > 0.5)
+    other = rng.randn(5, 3).astype(np.float32)
+    st = sparse.to_sparse_coo(paddle.to_tensor(dense))
+    out = sparse.matmul(st, paddle.to_tensor(other))
+    np.testing.assert_allclose(_np(out), dense @ other, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sparse_unary_and_nn():
+    from paddle_tpu import sparse
+    dense = np.array([[0.0, -2.0], [3.0, 0.0]], dtype=np.float32)
+    st = sparse.to_sparse_coo(paddle.to_tensor(dense))
+    np.testing.assert_allclose(_np(sparse.abs(st).to_dense()),
+                               np.abs(dense))
+    relu_out = sparse.nn.ReLU()(st)
+    np.testing.assert_allclose(_np(relu_out.to_dense()),
+                               np.maximum(dense, 0))
+
+
+def test_masked_matmul_sddmm():
+    from paddle_tpu import sparse
+    rng = np.random.RandomState(1)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 3).astype(np.float32)
+    mask_dense = np.array([[1, 0, 0], [0, 1, 0], [0, 0, 1]],
+                          dtype=np.float32)
+    mask = sparse.to_sparse_coo(paddle.to_tensor(mask_dense))
+    out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                               mask)
+    full = a @ b
+    np.testing.assert_allclose(_np(out.to_dense()), full * mask_dense,
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- device ----------------------------------------------------------------------
+
+def test_device_api():
+    from paddle_tpu import device
+    assert device.device_count() >= 1
+    assert ":" in device.get_device()
+    assert device.get_all_device_type()
+    device.synchronize()
+
+
+def test_stream_event_ordering():
+    from paddle_tpu import device
+    s = device.current_stream()
+    ev = s.record_event()
+    x = paddle.to_tensor(np.ones(128, np.float32)) * 2
+    ev2 = device.Event()
+    ev2.record()
+    ev2.synchronize()
+    assert ev2.query()
+    with device.stream_guard(device.Stream()):
+        assert device.current_stream() is not s
+    assert device.current_stream() is s
+
+
+def test_device_memory_stats_nonnegative():
+    from paddle_tpu import device
+    assert device.memory_allocated() >= 0
+    assert device.max_memory_allocated() >= device.memory_allocated() - 1
+    assert device.cuda.device_count() >= 1
