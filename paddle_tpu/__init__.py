@@ -47,6 +47,9 @@ from . import metric
 from . import nn
 from . import optimizer
 from . import profiler
+from . import hub
+from . import inference
+from . import onnx
 from . import quantization
 from . import sparse
 from . import vision

@@ -22,6 +22,9 @@ from .collective import (Group, P2POp, ReduceOp, all_gather,
                          is_initialized, isend, new_group, recv, reduce,
                          reduce_scatter, scatter, send, wait)
 from ..core.native import TCPStore
+from . import auto_tuner
+from . import ps
+from . import rpc
 from .engine import DistModel, Strategy, to_static
 from .parallel import DataParallel, sync_params_buffers
 from . import fleet

@@ -1,0 +1,166 @@
+"""Parallel-config auto tuner.
+
+Reference: python/paddle/distributed/auto_tuner (tuner.py:21 AutoTuner —
+candidate generation over dp/mp/pp/sharding/micro-batch space, prune
+rules, history-guided search; trials launched as real runs).
+
+TPU-native: the same search skeleton with an analytic TPU cost model as
+the default evaluator (MXU-bound compute time + ICI collective time +
+HBM capacity feasibility), and optional measured trials via a user-passed
+``run_fn(config) -> metric``.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class TuneConfig:
+    """Search space + model/hardware facts."""
+
+    world_size: int = 8
+    # model facts (defaults ~ Llama-7B)
+    num_layers: int = 32
+    hidden_size: int = 4096
+    num_heads: int = 32
+    vocab_size: int = 32000
+    seq_length: int = 4096
+    global_batch_size: int = 64
+    dtype_bytes: int = 2           # bf16
+    # hardware facts (defaults ~ v5e chip)
+    hbm_bytes: float = 16e9
+    flops_per_sec: float = 197e12  # bf16 MXU
+    ici_bw_bytes: float = 4.5e10   # per-link, one direction
+    # search space (None -> all divisors of world_size)
+    dp_degree: Optional[List[int]] = None
+    mp_degree: Optional[List[int]] = None
+    pp_degree: Optional[List[int]] = None
+    sharding_degree: Optional[List[int]] = None
+    sharding_stage: List[int] = field(default_factory=lambda: [1, 2, 3])
+    micro_batch_size: Optional[List[int]] = None
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    """auto_tuner/tuner.py AutoTuner analog."""
+
+    def __init__(self, config: TuneConfig,
+                 run_fn: Optional[Callable[[Dict], float]] = None):
+        self.cfg = config
+        self.run_fn = run_fn
+        self.history: List[Dict] = []
+
+    # -- candidate generation + pruning (prune rules analog) ----------------
+    def candidates(self) -> List[Dict]:
+        c = self.cfg
+        dps = c.dp_degree or _divisors(c.world_size)
+        mps = c.mp_degree or _divisors(c.world_size)
+        pps = c.pp_degree or _divisors(c.world_size)
+        shs = c.sharding_degree or _divisors(c.world_size)
+        mbs = c.micro_batch_size or _divisors(
+            max(1, c.global_batch_size))
+        out = []
+        for dp, mp, pp, sh, stage, mb in itertools.product(
+                dps, mps, pps, shs, c.sharding_stage, mbs):
+            cand = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                    "sharding_degree": sh, "sharding_stage": stage,
+                    "micro_batch_size": mb}
+            if not self.prune(cand):
+                out.append(cand)
+        return out
+
+    def prune(self, cand: Dict) -> bool:
+        """True = discard. The reference's rule set adapted to TPU:
+        degrees must tile the slice; mp must divide heads/hidden; batch
+        must tile dp*micro; sharding rides the dp axis."""
+        c = self.cfg
+        dp, mp, pp = (cand["dp_degree"], cand["mp_degree"],
+                      cand["pp_degree"])
+        sh, mb = cand["sharding_degree"], cand["micro_batch_size"]
+        if dp * mp * pp != c.world_size:
+            return True
+        if c.num_heads % mp or c.hidden_size % mp:
+            return True
+        if c.num_layers % pp:
+            return True
+        if sh > dp or dp % sh:
+            return True  # sharding subdivides the dp axis
+        if cand["sharding_stage"] > 1 and sh == 1:
+            return True  # stage 2/3 need a sharding group
+        per_dp_batch = c.global_batch_size // dp if \
+            c.global_batch_size % dp == 0 else 0
+        if per_dp_batch == 0 or per_dp_batch % mb:
+            return True
+        if not self._fits_memory(cand):
+            return True
+        return False
+
+    # -- analytic model ------------------------------------------------------
+    def _param_count(self) -> float:
+        c = self.cfg
+        per_layer = 12 * c.hidden_size ** 2  # qkvo + mlp(4h) roughly
+        return c.num_layers * per_layer + c.vocab_size * c.hidden_size * 2
+
+    def _fits_memory(self, cand) -> bool:
+        c = self.cfg
+        mp, pp, sh = (cand["mp_degree"], cand["pp_degree"],
+                      cand["sharding_degree"])
+        stage = cand["sharding_stage"]
+        params = self._param_count() / mp / pp
+        p_bytes = params * c.dtype_bytes
+        # adam moments in fp32 + master weights
+        opt_bytes = params * 12.0
+        if stage >= 1:
+            opt_bytes /= sh
+        if stage >= 2:
+            pass  # grads sharded too: transient, ignored here
+        if stage >= 3:
+            p_bytes /= sh
+        act_bytes = (cand["micro_batch_size"] * c.seq_length * c.hidden_size
+                     * c.dtype_bytes * c.num_layers / pp / mp
+                     * 4)  # ~4 live activations/layer w/ remat
+        return p_bytes + opt_bytes + act_bytes < c.hbm_bytes * 0.9
+
+    def estimate(self, cand: Dict) -> float:
+        """Predicted tokens/sec/chip (higher better)."""
+        c = self.cfg
+        mp, pp, dp = (cand["mp_degree"], cand["pp_degree"],
+                      cand["dp_degree"])
+        mb = cand["micro_batch_size"]
+        tokens = mb * c.seq_length
+        flops = 6 * self._param_count() * tokens  # fwd+bwd per micro-batch
+        compute_t = flops / (c.flops_per_sec * mp * pp)
+        # TP collectives: 4 allreduce of (tokens x hidden) per layer
+        comm_bytes = (0 if mp == 1 else
+                      4 * tokens * c.hidden_size * c.dtype_bytes
+                      * c.num_layers / pp * 2 * (mp - 1) / mp)
+        comm_t = comm_bytes / c.ici_bw_bytes
+        # pipeline bubble factor
+        micro_steps = max(1, c.global_batch_size // dp // mb)
+        bubble = (pp - 1) / micro_steps if pp > 1 else 0.0
+        step_t = (compute_t + comm_t) * (1 + bubble)
+        return tokens / step_t / c.world_size * dp
+
+    # -- search --------------------------------------------------------------
+    def search(self, top_k: int = 1) -> List[Dict]:
+        """Rank candidates by measured metric (run_fn) or the cost model."""
+        scored = []
+        for cand in self.candidates():
+            metric = (self.run_fn(cand) if self.run_fn
+                      else self.estimate(cand))
+            entry = dict(cand, metric=metric)
+            self.history.append(entry)
+            scored.append(entry)
+        scored.sort(key=lambda e: -e["metric"])
+        return scored[:top_k]
+
+    def best(self) -> Optional[Dict]:
+        return max(self.history, key=lambda e: e["metric"], default=None)
+
+
+__all__ = ["AutoTuner", "TuneConfig"]

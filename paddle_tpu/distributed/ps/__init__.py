@@ -1,0 +1,50 @@
+"""Parameter-server training — documented out-of-scope stub.
+
+Reference: paddle/fluid/distributed/ps (34.8k LoC: brpc BrpcPsServer/
+Client, memory/SSD sparse tables, GEO/async/sync modes, heter PS) +
+fleet.runtime.the_one_ps. SURVEY.md §2.1 N17 disposition: the PS stack
+serves CPU-cluster sparse-recommendation workloads (billion-slot
+embeddings on commodity hosts); the TPU north star is collective SPMD
+training, where huge embeddings are sharded DistTensors over the mesh
+(see fleet mp VocabParallelEmbedding and the MoE EP path).
+
+Migration path for reference PS users:
+- sparse embedding tables  -> nn.Embedding sharded Shard(0) over the mesh
+  (vocab-parallel), optionally MoE/EP all-to-all for capacity
+- async/GEO SGD            -> synchronous data parallel (the TPU ICI makes
+  sync steps faster than the PS's async staleness trade)
+- distributed serving      -> paddle_tpu.inference AOT executables
+
+The entry points below exist so reference code paths fail loudly with
+that guidance instead of AttributeError.
+"""
+from __future__ import annotations
+
+_MSG = ("parameter-server mode is not part of the TPU build (SURVEY.md "
+        "§2.1 N17): use collective SPMD training — sharded embeddings via "
+        "shard_tensor/VocabParallelEmbedding replace PS sparse tables. ")
+
+
+class PSCore:  # fluid.core PS handle analog
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+def init_server(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+def init_worker(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+def run_server(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+def stop_worker(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+__all__ = ["init_server", "init_worker", "run_server", "stop_worker",
+           "PSCore"]

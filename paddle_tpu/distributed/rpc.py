@@ -1,0 +1,169 @@
+"""paddle.distributed.rpc analog.
+
+Reference: python/paddle/distributed/rpc + C++ fluid/distributed/rpc
+(brpc-based send/recv of python callables). TPU-native: a lightweight
+TCP/pickle RPC over the native TCPStore rendezvous (csrc/native.cc) — the
+control plane the reference runs over brpc; tensor traffic belongs on
+ICI/DCN collectives, not here.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+from ..core.native import TCPStore
+
+_state: Dict[str, Any] = {"store": None, "name": None, "rank": None,
+                          "server": None, "peers": {}, "world_size": None}
+
+
+class WorkerInfo:
+    def __init__(self, name: str, rank: int, ip: str, port: int):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+class _RPCHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        try:
+            req = pickle.load(self.rfile)
+        except EOFError:
+            return
+        fn, args, kwargs = req
+        try:
+            result = ("ok", fn(*args, **kwargs))
+        except Exception as e:  # noqa: BLE001 — marshalled to caller
+            result = ("err", e)
+        pickle.dump(result, self.wfile)
+        self.wfile.flush()
+
+
+class _RPCServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """rpc.init_rpc analog: rendezvous through the TCPStore at
+    master_endpoint (default env PADDLE_MASTER_ENDPOINT / 127.0.0.1)."""
+    rank = rank if rank is not None else int(os.environ.get(
+        "PADDLE_TRAINER_ID", 0))
+    world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:29650")
+    host, port = endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size, timeout=60.0)
+
+    server = _RPCServer(("", 0), _RPCHandler)
+    sport = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    my_ip = os.environ.get("PADDLE_LOCAL_IP")
+    if my_ip is None:
+        if host in ("127.0.0.1", "localhost"):
+            my_ip = "127.0.0.1"
+        else:
+            # the address this host uses to reach the master — robust on
+            # multi-NIC hosts and /etc/hosts loopback-mapped hostnames
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect((host, int(port)))
+                my_ip = probe.getsockname()[0]
+            finally:
+                probe.close()
+    store.set(f"rpc/worker/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, my_ip, sport)))
+    store.barrier("rpc_init", world_size=world_size)
+
+    peers = {}
+    for r in range(world_size):
+        info: WorkerInfo = pickle.loads(store.get(f"rpc/worker/{r}"))
+        peers[info.name] = info
+        peers[r] = info
+    _state.update(store=store, name=name, rank=rank, server=server,
+                  peers=peers, world_size=world_size)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    if name is None:
+        name = _state["name"]
+    return _state["peers"][name]
+
+
+def get_all_worker_infos():
+    return [v for k, v in _state["peers"].items() if isinstance(k, int)]
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return get_worker_info(_state["name"])
+
+
+def _call(to, fn, args, kwargs, timeout):
+    info = _state["peers"][to]
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout or None) as s:
+        wfile = s.makefile("wb")
+        rfile = s.makefile("rb")
+        pickle.dump((fn, args or (), kwargs or {}), wfile)
+        wfile.flush()
+        status, payload = pickle.load(rfile)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    """rpc.rpc_sync analog: run fn(*args, **kwargs) on worker `to`."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None) -> Future:
+    """rpc.rpc_async analog: returns a Future (``.wait()`` parity alias)."""
+    fut: Future = Future()
+
+    def runner():
+        try:
+            fut.set_result(_call(to, fn, args, kwargs, timeout))
+        except Exception as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=runner, daemon=True).start()
+    fut.wait = lambda timeout=None: fut.result(timeout)  # type: ignore
+    return fut
+
+
+def shutdown(graceful: bool = True):
+    """rpc.shutdown analog."""
+    if graceful and _state.get("store") is not None:
+        try:
+            _state["store"].barrier("rpc_shutdown",
+                                    world_size=_state["world_size"])
+        except Exception:  # noqa: BLE001 — peers may already be gone
+            pass
+    server = _state.get("server")
+    if server is not None:
+        server.shutdown()
+    store = _state.get("store")
+    if store is not None:
+        store.close()
+    _state.update(store=None, name=None, rank=None, server=None, peers={},
+                  world_size=None)
+
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]

@@ -306,7 +306,11 @@ class Model:
              reset_optimizer: bool = False):
         """model.py load analog."""
         from ..framework.io import load as fw_load
-        params = fw_load(path + ".pdparams")
+        params_path = path + ".pdparams"
+        if not os.path.exists(params_path) and os.path.exists(
+                path + ".pdiparams"):
+            params_path = path + ".pdiparams"  # jit.save inference layout
+        params = fw_load(params_path)
         state = self.network.state_dict()
         if skip_mismatch:
             matched = {}

@@ -1,0 +1,67 @@
+"""paddle.hub analog.
+
+Reference: python/paddle/hapi/hub.py — list/help/load over a repo
+containing ``hubconf.py``. Offline environment: only ``source='local'``
+works; github/gitee sources raise with a clear message.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise RuntimeError(
+            f"hub source '{source}' needs network access, unavailable in "
+            f"this environment; use source='local' with a checked-out repo")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False
+         ) -> List[str]:  # noqa: A001 — paddle.hub.list name
+    """Entrypoints exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"{model} not found in {repo_dir}/{MODULE_HUBCONF}")
+    return fn.__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"{model} not found in {repo_dir}/{MODULE_HUBCONF}")
+    return fn(**kwargs)
+
+
+__all__ = ["list", "help", "load"]

@@ -1,0 +1,131 @@
+"""Inference/serving API.
+
+Reference: paddle/fluid/inference (AnalysisPredictor, analysis_predictor.h:
+100) + the paddle.inference python surface (Config, create_predictor,
+named input/output handles). TPU-native: the "analysis + optimized
+program" stage is the AOT-compiled StableHLO executable written by
+paddle_tpu.jit.save; the Predictor is a thin runner over the deserialized
+export (XLA did the graph optimization the reference's 250-pass zoo does).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    """paddle.inference.Config analog (prog_file/params_file prefix form)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            self._prefix = prog_file[:-len(".pdmodel")]
+        else:
+            self._prefix = prog_file
+        self._memory_pool_mb = 0
+        self._enable_profile = False
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self._prefix = (prog_file[:-len(".pdmodel")]
+                        if prog_file.endswith(".pdmodel") else prog_file)
+
+    def model_dir(self):
+        return self._prefix
+
+    # knob parity (XLA owns these decisions on TPU)
+    def enable_use_gpu(self, *a, **k):
+        return None
+
+    def enable_memory_optim(self, *a, **k):
+        return None
+
+    def switch_ir_optim(self, *a, **k):
+        return None
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def disable_glog_info(self):
+        return None
+
+
+class _IOHandle:
+    """paddle.inference input/output handle analog (copy_from_cpu /
+    copy_to_cpu)."""
+
+    def __init__(self, predictor: "Predictor", name: str, is_input: bool):
+        self._p = predictor
+        self.name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        assert self._is_input
+        self._p._inputs[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        return None
+
+    def copy_to_cpu(self) -> np.ndarray:
+        assert not self._is_input
+        return self._p._outputs[self.name]
+
+    def shape(self):
+        src = self._p._inputs if self._is_input else self._p._outputs
+        v = src.get(self.name)
+        return list(v.shape) if v is not None else None
+
+
+class Predictor:
+    """AnalysisPredictor analog over an AOT export."""
+
+    def __init__(self, config: Config):
+        from .. import jit
+        self._config = config
+        self._layer = jit.load(config.model_dir())
+        if not hasattr(self._layer, "_exported"):
+            raise ValueError(
+                f"{config.model_dir()} is a params-only save; export with "
+                f"jit.save(layer, path, input_spec=[...]) for serving")
+        specs = self._layer.input_specs()
+        self._input_names = [s.get("name") or f"x{i}"
+                             for i, s in enumerate(specs)]
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        self._output_names: List[str] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return _IOHandle(self, name, is_input=True)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for n, arr in zip(self._input_names, inputs):
+                self._inputs[n] = np.asarray(arr)
+        args = [self._inputs[n] for n in self._input_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {n: np.asarray(o._data)
+                         for n, o in zip(self._output_names, outs)}
+        if inputs is not None:
+            return [self._outputs[n] for n in self._output_names]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names) or ["out0"]
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return _IOHandle(self, name, is_input=False)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """paddle.inference.create_predictor analog."""
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "create_predictor"]

@@ -16,6 +16,7 @@ dtype/static-arg keys on the compile cache, the analog of SOT guards
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -456,13 +457,152 @@ class TrainStep:
         return Tensor(loss)
 
 
+def _pure_layer_forward(layer):
+    """Stage layer.__call__ as a pure fn(param_arrays, *input_arrays):
+    the state-threading trick TrainStep uses, for inference export."""
+    named = list(layer.state_dict().items())  # params + buffers
+
+    def fn(param_arrays, *input_arrays):
+        saved = [(t, t._data) for _, t in named]
+        try:
+            for (_, t), a in zip(named, param_arrays):
+                t._data = a
+            with _engine.no_grad():
+                out = layer(*[Tensor(a) for a in input_arrays])
+            leaves = jax.tree_util.tree_leaves(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            return tuple(l._data if isinstance(l, Tensor) else l
+                         for l in leaves)
+        finally:
+            for t, d in saved:
+                t._data = d
+
+    return fn, named
+
+
 def save(layer, path, input_spec=None, **kwargs):
-    """paddle.jit.save analog — serialize params + (later) exported StableHLO.
-    Round-1: params only; the AOT executable tier lands with the serving slice."""
+    """paddle.jit.save analog (jit/api.py save -> TranslatedLayer format).
+
+    Serializes THREE artifacts, the reference's program+params split mapped
+    to the XLA world (N25 C++ jit loader / N22 inference input format):
+      <path>.pdmodel   — jax.export-serialized StableHLO of the forward
+      <path>.pdiparams — the state dict (params + buffers)
+      <path>.json      — input specs + metadata
+    Layers whose forward can't be staged (data-dependent python) still get
+    params saved; load() then requires the original class.
+    """
+    import json
+
     from ..framework import io as fio
-    fio.save(layer.state_dict(), path + ".pdparams")
+    from ..static import InputSpec
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    fio.save(layer.state_dict(), path + ".pdiparams")
+
+    specs = None
+    if input_spec is not None:
+        specs = [s if isinstance(s, InputSpec)
+                 else InputSpec.from_tensor(s) if _is_tensor(s)
+                 else InputSpec(s) for s in input_spec]
+    if specs is None:
+        # no spec: params-only save (reference allows this for Layers
+        # loaded back as code + state dict)
+        with open(path + ".json", "w") as f:
+            json.dump({"format": "params_only"}, f)
+        return
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        example = [s._zeros(batch_size=s.shape[0] if s.shape
+                            and s.shape[0] not in (None, -1) else 1)
+                   for s in specs]
+        fn, named = _pure_layer_forward(layer)
+        param_arrays = [t._data for _, t in named]
+        from jax import export as jexport
+        # dynamic dims (None/-1 in the spec) export as symbolic sizes so the
+        # serialized program serves ANY batch/seq length. Dims at the SAME
+        # axis position share one symbol across inputs (paddle semantics:
+        # axis 0 is the common batch dim, axis 1 the common seq dim), so
+        # multi-input models like (input_ids, attention_mask) export.
+        scope = jexport.SymbolicScope()
+        sym_by_axis = {}
+        arg_shapes = []
+        for s, ex in zip(specs, example):
+            dims = []
+            for axis, d in enumerate(s.shape):
+                if d in (None, -1):
+                    if axis not in sym_by_axis:
+                        (sym_by_axis[axis],) = jexport.symbolic_shape(
+                            f"d{axis}", scope=scope)
+                    dims.append(sym_by_axis[axis])
+                else:
+                    dims.append(int(d))
+            arg_shapes.append(jax.ShapeDtypeStruct(tuple(dims),
+                                                   ex._data.dtype))
+        param_structs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                         for a in param_arrays]
+        exported = jexport.export(jax.jit(fn))(param_structs, *arg_shapes)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        with open(path + ".json", "w") as f:
+            json.dump({"format": "stablehlo",
+                       "param_names": [n for n, _ in named],
+                       "input_specs": [{"shape": list(s.shape),
+                                        "dtype": s.dtype,
+                                        "name": s.name} for s in specs]}, f)
+    finally:
+        if was_training:
+            layer.train()
+
+
+class TranslatedLayer:
+    """jit/translated_layer.py analog: a loaded AOT program + params,
+    callable like the original Layer (inference only)."""
+
+    def __init__(self, exported, param_arrays, meta):
+        self._exported = exported
+        self._params = param_arrays
+        self._meta = meta
+
+    def __call__(self, *inputs):
+        arrs = [i._data if _is_tensor(i) else jnp.asarray(i) for i in inputs]
+        outs = self._exported.call(self._params, *arrs)
+        wrapped = [Tensor(o) for o in outs]
+        return wrapped[0] if len(wrapped) == 1 else wrapped
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def input_specs(self):
+        return self._meta.get("input_specs", [])
 
 
 def load(path, **kwargs):
+    """paddle.jit.load analog: returns a TranslatedLayer for stablehlo
+    saves, or the raw state dict for params-only saves."""
+    import json
+
     from ..framework import io as fio
-    return fio.load(path + ".pdparams")
+
+    meta = {}
+    if os.path.exists(path + ".json"):
+        with open(path + ".json") as f:
+            meta = json.load(f)
+    if meta.get("format") == "stablehlo":
+        from jax import export as jexport
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jexport.deserialize(f.read())
+        state = fio.load(path + ".pdiparams")
+        params = [state[n]._data if _is_tensor(state[n])
+                  else jnp.asarray(state[n]) for n in meta["param_names"]]
+        return TranslatedLayer(exported, params, meta)
+    # params-only (or legacy .pdparams) save
+    for suffix in (".pdiparams", ".pdparams"):
+        if os.path.exists(path + suffix):
+            return fio.load(path + suffix)
+    raise FileNotFoundError(f"no saved model at {path}")

@@ -1,0 +1,28 @@
+"""paddle.onnx export shim.
+
+Reference: python/paddle/onnx/export.py delegates to the external
+``paddle2onnx`` converter. No onnx runtime/converter ships in this
+environment, so ``export`` saves the model in the native AOT format
+(StableHLO via jit.save — itself an open interchange format) and raises
+only if an actual ``.onnx`` protobuf is demanded.
+"""
+from __future__ import annotations
+
+import os
+
+
+def export(layer, path: str, input_spec=None, opset_version=9, **configs):
+    """paddle.onnx.export analog: always writes <path>.pdmodel/.pdiparams
+    (the portable StableHLO export), then raises — a true ONNX protobuf
+    would need the paddle2onnx converter, which has no TPU-stack analog."""
+    from . import jit
+    base = path[:-5] if path.endswith(".onnx") else path
+    jit.save(layer, base, input_spec=input_spec)
+    raise RuntimeError(
+        f"ONNX protobuf conversion is not available on this stack; "
+        f"exported the portable StableHLO program to {base}.pdmodel "
+        f"instead (load with paddle_tpu.jit.load or any StableHLO "
+        f"consumer)")
+
+
+__all__ = ["export"]

@@ -1,0 +1,230 @@
+"""Tests for the serving slice (jit AOT save/load + inference Predictor),
+rpc, auto_tuner, hub, onnx shim, and the PS stub."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.static import InputSpec
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+# -- AOT save/load -------------------------------------------------------------
+
+def test_jit_save_load_stablehlo_roundtrip(tmp_path):
+    net = _mlp()
+    net.eval()
+    path = str(tmp_path / "model")
+    jit.save(net, path, input_spec=[InputSpec([None, 8], "float32", "x")])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+
+    loaded = jit.load(path)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 8)
+                         .astype(np.float32))
+    ref = np.asarray(net(x)._data)
+    out = np.asarray(loaded(x)._data)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_params_only(tmp_path):
+    net = _mlp()
+    path = str(tmp_path / "params_model")
+    jit.save(net, path)  # no input_spec
+    state = jit.load(path)
+    assert isinstance(state, dict)
+    assert set(state) == set(net.state_dict())
+
+
+def test_inference_predictor(tmp_path):
+    from paddle_tpu import inference
+
+    net = _mlp()
+    net.eval()
+    path = str(tmp_path / "serve")
+    jit.save(net, path, input_spec=[InputSpec([None, 8], "float32", "x")])
+
+    config = inference.Config(path + ".pdmodel")
+    predictor = inference.create_predictor(config)
+    names = predictor.get_input_names()
+    assert names == ["x"]
+    x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, np.asarray(net(paddle.to_tensor(x))._data),
+                               rtol=1e-5, atol=1e-6)
+    # list-style run
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], out, rtol=1e-6)
+
+
+def test_predictor_rejects_params_only(tmp_path):
+    from paddle_tpu import inference
+    net = _mlp()
+    path = str(tmp_path / "noexport")
+    jit.save(net, path)
+    with pytest.raises(ValueError, match="params-only"):
+        inference.create_predictor(inference.Config(path))
+
+
+class _TwoInput(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, x, mask):
+        return self.fc(x) * mask
+
+
+def test_jit_save_multi_input_shared_batch_dim(tmp_path):
+    net = _TwoInput()
+    net.eval()
+    path = str(tmp_path / "two_in")
+    jit.save(net, path, input_spec=[InputSpec([None, 8], "float32", "x"),
+                                    InputSpec([None, 4], "float32", "mask")])
+    loaded = jit.load(path)
+    x = np.random.RandomState(0).randn(5, 8).astype(np.float32)
+    m = np.ones((5, 4), np.float32)
+    out = loaded(paddle.to_tensor(x), paddle.to_tensor(m))
+    np.testing.assert_allclose(
+        np.asarray(out._data),
+        np.asarray(net(paddle.to_tensor(x), paddle.to_tensor(m))._data),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_hapi_inference_save_load_roundtrip(tmp_path):
+    from paddle_tpu import Model, optimizer as opt_mod
+    m = Model(_mlp())
+    m.prepare(optimizer=opt_mod.Adam(learning_rate=0.01,
+                                     parameters=m.parameters()),
+              loss=nn.CrossEntropyLoss())
+    path = str(tmp_path / "hapi_infer")
+    m.save(path, training=False)  # jit.save layout (.pdiparams)
+    m2 = Model(_mlp())
+    m2.prepare(loss=nn.CrossEntropyLoss())
+    m2.load(path, reset_optimizer=True)  # falls back to .pdiparams
+    x = np.random.RandomState(2).randn(3, 8).astype(np.float32)
+    np.testing.assert_allclose(m.predict_batch([x])[0],
+                               m2.predict_batch([x])[0], rtol=1e-5)
+
+
+# -- rpc -----------------------------------------------------------------------
+
+def _rpc_child(port, out_q):
+    try:
+        from paddle_tpu.distributed import rpc
+        rpc.init_rpc("worker1", rank=1, world_size=2,
+                     master_endpoint=f"127.0.0.1:{port}")
+        # workers stay up until shutdown barrier
+        rpc.shutdown()
+        out_q.put(("ok", None))
+    except Exception as e:  # pragma: no cover
+        out_q.put(("err", repr(e)))
+
+
+def _double(x):
+    return x * 2
+
+
+def test_rpc_two_workers():
+    import socket
+    from paddle_tpu.distributed import rpc
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    child = ctx.Process(target=_rpc_child, args=(port, out_q))
+    child.start()
+    try:
+        rpc.init_rpc("worker0", rank=0, world_size=2,
+                     master_endpoint=f"127.0.0.1:{port}")
+        info = rpc.get_worker_info("worker1")
+        assert info.rank == 1
+        assert rpc.rpc_sync("worker1", _double, args=(21,)) == 42
+        fut = rpc.rpc_async("worker1", _double, args=(5,))
+        assert fut.wait(timeout=30) == 10
+        assert len(rpc.get_all_worker_infos()) == 2
+    finally:
+        rpc.shutdown()
+        child.join(timeout=30)
+    status, err = out_q.get(timeout=10)
+    assert status == "ok", err
+
+
+# -- auto_tuner ----------------------------------------------------------------
+
+def test_auto_tuner_prunes_and_ranks():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, TuneConfig
+    cfg = TuneConfig(world_size=8, num_layers=8, hidden_size=1024,
+                     num_heads=16, vocab_size=32000, seq_length=2048,
+                     global_batch_size=32, hbm_bytes=16e9)
+    tuner = AutoTuner(cfg)
+    cands = tuner.candidates()
+    assert cands, "search space should not be empty"
+    for c in cands:
+        assert c["dp_degree"] * c["mp_degree"] * c["pp_degree"] == 8
+        assert 16 % c["mp_degree"] == 0
+        assert c["sharding_degree"] <= c["dp_degree"]
+    best = tuner.search(top_k=3)
+    assert len(best) == 3
+    assert best[0]["metric"] >= best[1]["metric"] >= best[2]["metric"]
+
+
+def test_auto_tuner_measured_trials():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, TuneConfig
+    cfg = TuneConfig(world_size=4, num_layers=4, hidden_size=256,
+                     num_heads=8, vocab_size=1000, seq_length=128,
+                     global_batch_size=8)
+    # fake measurement: prefer pure dp
+    tuner = AutoTuner(cfg, run_fn=lambda c: float(c["dp_degree"]))
+    top = tuner.search(top_k=1)[0]
+    assert top["dp_degree"] == 4
+    assert tuner.best()["metric"] == 4.0
+
+
+def test_auto_tuner_memory_prune():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, TuneConfig
+    tiny_mem = TuneConfig(world_size=8, hbm_bytes=1e6)  # nothing fits
+    assert AutoTuner(tiny_mem).candidates() == []
+
+
+# -- hub / onnx / ps ------------------------------------------------------------
+
+def test_hub_local_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        "    'builds the tiny model'\n"
+        "    return ('model', scale)\n")
+    from paddle_tpu import hub
+    assert "tiny_model" in hub.list(str(tmp_path))
+    assert "tiny" in hub.help(str(tmp_path), "tiny_model")
+    assert hub.load(str(tmp_path), "tiny_model", scale=3) == ("model", 3)
+    with pytest.raises(RuntimeError, match="network"):
+        hub.list("any", source="github")
+
+
+def test_onnx_export_falls_back_to_stablehlo(tmp_path):
+    net = _mlp()
+    path = str(tmp_path / "m.onnx")
+    with pytest.raises(RuntimeError, match="StableHLO"):
+        paddle.onnx.export(net, path,
+                           input_spec=[InputSpec([None, 8], "float32")])
+    assert os.path.exists(str(tmp_path / "m") + ".pdmodel")
+
+
+def test_ps_stub_raises_with_guidance():
+    from paddle_tpu.distributed import ps
+    with pytest.raises(NotImplementedError, match="SPMD"):
+        ps.init_server()
