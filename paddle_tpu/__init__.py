@@ -47,9 +47,11 @@ from . import metric
 from . import nn
 from . import optimizer
 from . import profiler
+from . import geometric
 from . import hub
 from . import inference
 from . import onnx
+from . import text
 from . import quantization
 from . import sparse
 from . import vision

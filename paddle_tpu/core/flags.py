@@ -65,6 +65,13 @@ define_flag("FLAGS_eager_op_cache", True, "cache per-op jitted executables in ea
 define_flag("FLAGS_use_pallas_attention", True,
             "route attention to the Pallas flash kernel on TPU when shapes "
             "allow (reference: dynloaded flashattn, N27)")
+define_flag("FLAGS_use_pallas_rmsnorm", True,
+            "route weighted rms_norm to the fused Pallas kernel on TPU "
+            "(reference: fused_rms_norm in phi/kernels/fusion)")
+define_flag("FLAGS_use_pallas_adamw", False,
+            "route the AdamW update to the single-pass Pallas kernel on TPU "
+            "(reference: fused_adam, phi/kernels/fusion/gpu); default off — "
+            "XLA's fused elementwise chain is equivalent for most shapes")
 define_flag("FLAGS_dataloader_mp_context", "fork",
             "multiprocessing start method for DataLoader workers ('fork' is "
             "fast but workers must not touch jax; 'spawn' is always safe)")

@@ -1,0 +1,147 @@
+"""paddle.geometric analog — graph message passing + segment ops.
+
+Reference: python/paddle/geometric (send_u_recv/send_ue_recv message
+passing over graph_send_recv kernels, segment_{sum,mean,max,min}).
+TPU-native: gathers + jax segment reductions — XLA lowers them to sorted
+scatter-adds, the right shape for the TPU's vector unit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import defop
+
+
+def _segment(reduce_op, data, segment_ids, num_segments):
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(data, segment_ids,
+                                   num_segments=num_segments)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(data, segment_ids,
+                                num_segments=num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones_like(segment_ids,
+                                                dtype=data.dtype),
+                                  segment_ids, num_segments=num_segments)
+        cnt = cnt.reshape((-1,) + (1,) * (data.ndim - 1))
+        return s / jnp.maximum(cnt, 1)
+    if reduce_op == "max":
+        return jax.ops.segment_max(data, segment_ids,
+                                   num_segments=num_segments)
+    if reduce_op == "min":
+        return jax.ops.segment_min(data, segment_ids,
+                                   num_segments=num_segments)
+    raise ValueError(f"unsupported reduce_op {reduce_op}")
+
+
+def _finite(x):
+    """segment_max/min yield +-inf for empty segments; reference yields 0."""
+    return jnp.where(jnp.isfinite(x), x, 0)
+
+
+@defop(name="segment_sum_op")
+def _seg_sum(data, segment_ids, num_segments):
+    return _segment("sum", data, segment_ids, num_segments)
+
+
+@defop(name="segment_mean_op")
+def _seg_mean(data, segment_ids, num_segments):
+    return _segment("mean", data, segment_ids, num_segments)
+
+
+@defop(name="segment_max_op")
+def _seg_max(data, segment_ids, num_segments):
+    return _finite(_segment("max", data, segment_ids, num_segments))
+
+
+@defop(name="segment_min_op")
+def _seg_min(data, segment_ids, num_segments):
+    return _finite(_segment("min", data, segment_ids, num_segments))
+
+
+def _num_segments(segment_ids, given=None):
+    if given is not None:
+        return int(given)
+    ids = segment_ids._data if hasattr(segment_ids, "_data") else segment_ids
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _seg_sum(data, segment_ids, _num_segments(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _seg_mean(data, segment_ids, _num_segments(segment_ids))
+
+
+def segment_max(data, segment_ids, name=None):
+    return _seg_max(data, segment_ids, _num_segments(segment_ids))
+
+
+def segment_min(data, segment_ids, name=None):
+    return _seg_min(data, segment_ids, _num_segments(segment_ids))
+
+
+@defop(name="send_u_recv_op")
+def _send_u_recv(x, src_index, dst_index, reduce_op, out_size):
+    msgs = x[src_index]
+    out = _segment(reduce_op, msgs, dst_index, out_size)
+    return _finite(out) if reduce_op in ("max", "min") else out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """geometric.send_u_recv analog: gather x at src, reduce onto dst."""
+    n = out_size if out_size is not None else (
+        x.shape[0] if hasattr(x, "shape") else None)
+    return _send_u_recv(x, src_index, dst_index, reduce_op, int(n))
+
+
+@defop(name="send_ue_recv_op")
+def _send_ue_recv(x, y, src_index, dst_index, message_op, reduce_op,
+                  out_size):
+    msgs = x[src_index]
+    if message_op == "add":
+        msgs = msgs + y
+    elif message_op == "sub":
+        msgs = msgs - y
+    elif message_op == "mul":
+        msgs = msgs * y
+    elif message_op == "div":
+        msgs = msgs / y
+    else:
+        raise ValueError(f"unsupported message_op {message_op}")
+    out = _segment(reduce_op, msgs, dst_index, out_size)
+    return _finite(out) if reduce_op in ("max", "min") else out
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """geometric.send_ue_recv analog: node+edge message passing."""
+    n = out_size if out_size is not None else x.shape[0]
+    return _send_ue_recv(x, y, src_index, dst_index, message_op, reduce_op,
+                         int(n))
+
+
+@defop(name="send_uv_op")
+def _send_uv(x, y, src_index, dst_index, message_op):
+    xs = x[src_index]
+    yd = y[dst_index]
+    if message_op == "add":
+        return xs + yd
+    if message_op == "sub":
+        return xs - yd
+    if message_op == "mul":
+        return xs * yd
+    if message_op == "div":
+        return xs / yd
+    raise ValueError(f"unsupported message_op {message_op}")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """geometric.send_uv analog: per-edge combination of endpoints."""
+    return _send_uv(x, y, src_index, dst_index, message_op)
+
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]

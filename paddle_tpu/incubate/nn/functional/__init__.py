@@ -8,4 +8,100 @@ Pallas kernels; ring attention fills the reference's context-parallel gap
 from paddle_tpu.nn.functional import flash_attention
 from paddle_tpu.ops.ring_attention import ring_attention
 
-__all__ = ["flash_attention", "ring_attention"]
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    rotary_base=10000.0):
+    """incubate fused_rotary_position_embedding analog (SPMD rule
+    spmd_rules/fused_rope.cc; CUDA kernel fused_rope).
+
+    q/k/v: [B, S, H, D]; sin/cos: [1, S, 1, D] (reference layout) or
+    [S, D/2] tables, or None to compute default RoPE tables from
+    ``rotary_base``. position_ids may be [S] or [B, S]. Elementwise rotation
+    in fp32 — XLA fuses it into the surrounding projections, which is the
+    fused kernel's win on TPU. Returns a tuple matching the passed tensors
+    (None slots preserved).
+    """
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.llama import _rope_cos_sin
+    from paddle_tpu.ops.registry import dispatch
+
+    if (sin is None) != (cos is None):
+        raise ValueError("pass both sin and cos, or neither")
+
+    def _tables(sin_a, cos_a, needed_len, head_dim):
+        if cos_a is None:  # default tables, reference behavior
+            c_full, s_full = _rope_cos_sin(needed_len, head_dim, rotary_base,
+                                           jnp.float32)
+            return s_full, c_full
+        # accept [1, S, 1, D] (reference layout) or [S, D/2] tables
+        if cos_a.ndim == 4:
+            if use_neox_rotary_style:
+                # interleaved layout duplicates each freq pairwise: take evens
+                cos_a = cos_a[0, :, 0, 0::2]
+                sin_a = sin_a[0, :, 0, 0::2]
+            else:
+                # half layout concatenates the freqs twice: take first half
+                d2 = cos_a.shape[-1] // 2
+                cos_a = cos_a[0, :, 0, :d2]
+                sin_a = sin_a[0, :, 0, :d2]
+        if cos_a.shape[0] < needed_len:
+            raise ValueError(
+                f"rope tables cover {cos_a.shape[0]} positions but "
+                f"position {needed_len - 1} was requested")
+        return sin_a[:needed_len], cos_a[:needed_len]
+
+    def _rotate(x, c, s):
+        """c/s are [S, D/2] or [B, S, D/2]; x is [B, S, H, D]."""
+        x32 = x.astype(jnp.float32)
+        if c.ndim == 2:
+            c = c[None, :, None, :].astype(jnp.float32)
+            s = s[None, :, None, :].astype(jnp.float32)
+        else:  # per-batch tables from [B, S] position_ids
+            c = c[:, :, None, :].astype(jnp.float32)
+            s = s[:, :, None, :].astype(jnp.float32)
+        if use_neox_rotary_style:  # interleaved pairs
+            x1 = x32[..., 0::2]
+            x2 = x32[..., 1::2]
+            out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s],
+                            axis=-1).reshape(x.shape)
+        else:  # rotate halves
+            d2 = x32.shape[-1] // 2
+            x1, x2 = x32[..., :d2], x32[..., d2:]
+            out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                                  axis=-1)
+        return out.astype(x.dtype)
+
+    def _impl(q_a, k_a, v_a, sin_a, cos_a):
+        seq_len = q_a.shape[1]
+        head_dim = q_a.shape[-1]
+        # tables must cover the LARGEST referenced position, not just the
+        # local seq_len — the kv-cache decode step passes q of length 1 with
+        # position_ids like [[17]]
+        import numpy as _onp
+        needed = seq_len
+        if position_ids is not None:
+            pid_np = _onp.asarray(position_ids)
+            needed = max(needed, int(pid_np.max()) + 1)
+        s_t, c_t = _tables(sin_a, cos_a, needed, head_dim)
+        if position_ids is not None:
+            pid = jnp.asarray(position_ids)
+            c_t = c_t[pid]  # [S, D/2] or [B, S, D/2]
+            s_t = s_t[pid]
+        outs = []
+        for x in (q_a, k_a, v_a):
+            outs.append(None if x is None else _rotate(x, c_t, s_t))
+        return tuple(o for o in outs if o is not None)
+
+    res = dispatch(_impl, (q, k, v, sin, cos), {}, op_name="fused_rope")
+    res = list(res) if isinstance(res, (list, tuple)) else [res]
+    out = []
+    for x in (q, k, v):
+        out.append(res.pop(0) if x is not None else None)
+    return tuple(out)
+
+
+__all__ = ["flash_attention", "ring_attention",
+           "fused_rotary_position_embedding"]

@@ -1,0 +1,143 @@
+"""paddle.incubate.optimizer analog — LookAhead and ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/{lookahead,modelaverage}.py.
+Both wrap an inner optimizer and keep auxiliary parameter copies on host
+trees (jax arrays), composing with the eager step() and TrainStep paths.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """lookahead.py LookAhead analog: every k inner steps, slow weights move
+    alpha of the way toward the fast weights and the fast weights reset to
+    the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.alpha = alpha
+        self.k = int(k)
+        self._parameter_list = inner_optimizer._parameter_list
+        self._grad_clip = inner_optimizer._grad_clip
+        self._multi_precision = getattr(inner_optimizer, "_multi_precision",
+                                        False)
+        self._k_count = 0
+        self._slow = {id(p): jnp.asarray(p._data)
+                      for p in self._parameter_list}
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for p in self._parameter_list:
+                slow = self._slow[id(p)]
+                new_slow = slow + self.alpha * (
+                    p._data.astype(slow.dtype) - slow)
+                self._slow[id(p)] = new_slow
+                p._data = new_slow.astype(p._data.dtype)
+
+    def clear_grad(self, *a, **k):
+        return self.inner_optimizer.clear_grad(*a, **k)
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_k_count"] = self._k_count
+        # slow weights keyed by parameter position (stable across runs)
+        for i, p in enumerate(self._parameter_list):
+            sd[f"@lookahead_slow_{i}"] = np.asarray(self._slow[id(p)])
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        self._k_count = int(sd.pop("@lookahead_k_count", 0))
+        for i, p in enumerate(self._parameter_list):
+            slow = sd.pop(f"@lookahead_slow_{i}", None)
+            if slow is not None:
+                arr = slow._data if isinstance(slow, Tensor) else slow
+                self._slow[id(p)] = jnp.asarray(arr)
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage(Optimizer):
+    """modelaverage.py ModelAverage analog: maintains a running average of
+    parameters; apply()/restore() swap the averaged weights in and out for
+    evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided")
+        self._parameter_list = list(parameters)
+        self._grad_clip = None
+        self._multi_precision = False
+        self.avg_rate = average_window_rate
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._num_updates = 0
+        self._sum = {id(p): jnp.zeros_like(p._data.astype(jnp.float32))
+                     for p in self._parameter_list}
+        self._window_updates = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the CURRENT weights into the average (call after the
+        inner optimizer's step, as the reference does)."""
+        self._num_updates += 1
+        self._window_updates += 1
+        restart = (self._window_updates >
+                   max(self.min_window,
+                       min(self.max_window,
+                           int(self._num_updates * self.avg_rate))))
+        for p in self._parameter_list:
+            s = self._sum[id(p)]
+            if restart:
+                s = jnp.zeros_like(s)
+            self._sum[id(p)] = s + p._data.astype(jnp.float32)
+        if restart:
+            self._window_updates = 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager friendly)."""
+        self._backup = {id(p): p._data for p in self._parameter_list}
+        n = max(self._window_updates, 1)
+        for p in self._parameter_list:
+            p._data = (self._sum[id(p)] / n).astype(p._data.dtype)
+        if not need_restore:
+            self._backup = None
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._parameter_list:
+                p._data = self._backup[id(p)]
+            self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False
+
+    def clear_grad(self, *a, **k):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+
+__all__ = ["LookAhead", "ModelAverage"]

@@ -516,9 +516,6 @@ def save(layer, path, input_spec=None, **kwargs):
     was_training = layer.training
     layer.eval()
     try:
-        example = [s._zeros(batch_size=s.shape[0] if s.shape
-                            and s.shape[0] not in (None, -1) else 1)
-                   for s in specs]
         fn, named = _pure_layer_forward(layer)
         param_arrays = [t._data for _, t in named]
         from jax import export as jexport
@@ -530,7 +527,7 @@ def save(layer, path, input_spec=None, **kwargs):
         scope = jexport.SymbolicScope()
         sym_by_axis = {}
         arg_shapes = []
-        for s, ex in zip(specs, example):
+        for s in specs:
             dims = []
             for axis, d in enumerate(s.shape):
                 if d in (None, -1):
@@ -541,7 +538,7 @@ def save(layer, path, input_spec=None, **kwargs):
                 else:
                     dims.append(int(d))
             arg_shapes.append(jax.ShapeDtypeStruct(tuple(dims),
-                                                   ex._data.dtype))
+                                                   s.np_dtype()))
         param_structs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
                          for a in param_arrays]
         exported = jexport.export(jax.jit(fn))(param_structs, *arg_shapes)

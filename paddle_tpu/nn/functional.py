@@ -354,7 +354,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
 
 @defop()
 def rms_norm(x, weight=None, epsilon=1e-6):
-    """RMSNorm (llama-family; ref incubate fused_rms_norm)."""
+    """RMSNorm (llama-family; ref incubate fused_rms_norm). On TPU with a
+    weight, routes to the fused Pallas kernel (ops/pallas/fused_ops.py:
+    single VMEM pass fwd, fused dx/dw bwd via custom_vjp); elsewhere XLA
+    fuses the decomposed form."""
+    if weight is not None:
+        from ..core.flags import get_flag
+        from ..ops import pallas as _pl
+        if (_pl.on_tpu() and get_flag("FLAGS_use_pallas_rmsnorm")
+                and x.shape[-1] % 128 == 0):
+            from ..ops.pallas.fused_ops import rms_norm_pallas
+            return rms_norm_pallas(x, weight, epsilon)
     xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     out = (xf * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)

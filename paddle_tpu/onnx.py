@@ -18,11 +18,17 @@ def export(layer, path: str, input_spec=None, opset_version=9, **configs):
     from . import jit
     base = path[:-5] if path.endswith(".onnx") else path
     jit.save(layer, base, input_spec=input_spec)
+    if input_spec is not None:
+        raise RuntimeError(
+            f"ONNX protobuf conversion is not available on this stack; "
+            f"exported the portable StableHLO program to {base}.pdmodel "
+            f"instead (load with paddle_tpu.jit.load or any StableHLO "
+            f"consumer)")
     raise RuntimeError(
-        f"ONNX protobuf conversion is not available on this stack; "
-        f"exported the portable StableHLO program to {base}.pdmodel "
-        f"instead (load with paddle_tpu.jit.load or any StableHLO "
-        f"consumer)")
+        f"ONNX protobuf conversion is not available on this stack, and no "
+        f"input_spec was given so only parameters were saved to "
+        f"{base}.pdiparams; pass input_spec=[InputSpec(...)] to export the "
+        f"full StableHLO program")
 
 
 __all__ = ["export"]

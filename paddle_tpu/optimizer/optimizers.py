@@ -122,14 +122,33 @@ class AdamW(Adam):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         b1p = state["beta1_pow"] * b1
         b2p = state["beta2_pow"] * b2
+        decay = 0.0 if self._no_decay else self._coeff
+        if self._use_pallas_update(p):
+            from ..ops.pallas.fused_ops import adamw_pallas
+            new_p, m, v = adamw_pallas(
+                p, state["moment1"], state["moment2"], g,
+                lr=lr, beta1=b1, beta2=b2, eps=eps, weight_decay=decay,
+                beta1_pow=b1p, beta2_pow=b2p)
+            # keep accumulator dtype identical to the XLA path so toggling
+            # the flag / checkpoint round-trips don't flip state dtypes
+            m = m.astype(state["moment1"].dtype)
+            v = v.astype(state["moment2"].dtype)
+            return new_p, {"moment1": m, "moment2": v,
+                           "beta1_pow": b1p, "beta2_pow": b2p}
         m = b1 * state["moment1"].astype(g.dtype) + (1 - b1) * g
         v = b2 * state["moment2"].astype(g.dtype) + (1 - b2) * jnp.square(g)
         mhat = m / (1 - b1p.astype(g.dtype))
         vhat = v / (1 - b2p.astype(g.dtype))
-        decay = 0.0 if self._no_decay else self._coeff
         new_p = p * (1.0 - lr * decay) - lr * mhat / (jnp.sqrt(vhat) + eps)
         return new_p, {"moment1": m, "moment2": v,
                        "beta1_pow": b1p, "beta2_pow": b2p}
+
+    @staticmethod
+    def _use_pallas_update(p) -> bool:
+        from ..core.flags import get_flag
+        from ..ops import pallas as _pl
+        return bool(get_flag("FLAGS_use_pallas_adamw")) and _pl.on_tpu() \
+            and p.size >= 1024
 
 
 class Adagrad(Optimizer):

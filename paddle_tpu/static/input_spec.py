@@ -37,18 +37,21 @@ class InputSpec:
         self.shape = tuple(self.shape[1:])
         return self
 
+    def np_dtype(self):
+        """Concrete array dtype for this spec (bfloat16 via ml_dtypes)."""
+        if self.dtype == "bfloat16":
+            import jax.numpy as jnp
+            return jnp.bfloat16
+        return np.dtype(self.dtype)
+
     def _zeros(self, batch_size: int = 2) -> Tensor:
         """A concrete zero tensor with dynamic dims replaced (for tracing)."""
         shape = tuple(batch_size if d is None or d < 0 else d
                       for d in self.shape)
-        np_dtype = {"float32": np.float32, "float64": np.float64,
-                    "float16": np.float16, "bfloat16": np.float32,
-                    "int32": np.int32, "int64": np.int64,
-                    "bool": np.bool_}.get(self.dtype, np.float32)
-        t = Tensor(np.zeros(shape, dtype=np_dtype))
         if self.dtype == "bfloat16":
-            t = t.astype("bfloat16")
-        return t
+            t = Tensor(np.zeros(shape, dtype=np.float32))
+            return t.astype("bfloat16")
+        return Tensor(np.zeros(shape, dtype=self.np_dtype()))
 
     def __repr__(self):
         return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "

@@ -1,0 +1,128 @@
+"""paddle.text analog.
+
+Reference: python/paddle/text (NLP datasets + ViterbiDecoder/viterbi_decode
+over the viterbi_decode kernel). Datasets need downloads (unavailable
+offline — they raise with guidance); the Viterbi decoder is implemented as
+a lax.scan over the sequence — compiler-friendly dynamic programming.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..ops.registry import defop
+
+
+@defop(name="viterbi_decode_op")
+def _viterbi(potentials, transition, lengths, include_bos_eos_tag):
+    """potentials [B, T, N], transition [N, N] (or [N+2, N+2] with BOS/EOS
+    when include_bos_eos_tag), lengths [B] -> (scores [B], paths [B, T])."""
+    b, t, n = potentials.shape
+    if include_bos_eos_tag:
+        # reference convention: the TAG SET includes BOS at index n-2 and
+        # EOS at n-1 of the SAME [N, N] transition — start scores come from
+        # the BOS row, stop scores from the EOS column
+        trans = transition
+        bos = transition[n - 2, :]
+        eos = transition[:, n - 1]
+    else:
+        trans = transition
+        bos = 0.0
+        eos = 0.0
+
+    alpha0 = potentials[:, 0, :] + bos  # [B, N]
+    emits = jnp.moveaxis(potentials[:, 1:, :], 1, 0)      # [T-1, B, N]
+
+    def step(alpha, inp):
+        emit_t, t_idx = inp
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        alpha_new = jnp.max(scores, axis=1) + emit_t      # [B, N]
+        # freeze alpha once a sequence's length is exhausted
+        valid = (t_idx < lengths)[:, None]
+        return jnp.where(valid, alpha_new, alpha), best_prev
+
+    alpha_fin, backptrs = jax.lax.scan(step, alpha0,
+                                       (emits, jnp.arange(1, t)))
+    alpha_fin = alpha_fin + eos
+    scores = jnp.max(alpha_fin, axis=-1)                  # [B]
+    last_tag = jnp.argmax(alpha_fin, axis=-1)             # [B]
+
+    # backtrack (in reverse over backptrs), respecting lengths
+    def back(carry, inp):
+        tag, t_idx = carry
+        ptrs, step_idx = inp                              # ptrs [B, N]
+        prev = jnp.take_along_axis(ptrs, tag[:, None], axis=1)[:, 0]
+        valid = (step_idx < lengths)                      # step t active?
+        new_tag = jnp.where(valid, prev, tag)
+        return (new_tag, t_idx - 1), new_tag
+
+    rev_ptrs = backptrs[::-1]                             # [T-1, B, N]
+    rev_steps = jnp.arange(t - 1, 0, -1)
+    (first_tag, _), rev_path = jax.lax.scan(
+        back, (last_tag, t - 2), (rev_ptrs, rev_steps))
+    path = jnp.concatenate([rev_path[::-1],
+                            last_tag[None, :]], axis=0)   # [T, B]
+    return scores, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """paddle.text.viterbi_decode analog: returns (scores, best paths)."""
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag)
+
+
+class ViterbiDecoder(Layer):
+    """paddle.text.ViterbiDecoder analog."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"paddle.text dataset {name}: downloads are unavailable in this "
+        f"environment (no egress); construct an io.Dataset over local files")
+
+
+class Imdb:
+    def __init__(self, *a, **k):
+        _no_download("Imdb")
+
+
+class Conll05st:
+    def __init__(self, *a, **k):
+        _no_download("Conll05st")
+
+
+class Movielens:
+    def __init__(self, *a, **k):
+        _no_download("Movielens")
+
+
+class UCIHousing:
+    def __init__(self, *a, **k):
+        _no_download("UCIHousing")
+
+
+class WMT14:
+    def __init__(self, *a, **k):
+        _no_download("WMT14")
+
+
+class WMT16:
+    def __init__(self, *a, **k):
+        _no_download("WMT16")
+
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Conll05st",
+           "Movielens", "UCIHousing", "WMT14", "WMT16"]

@@ -1,0 +1,201 @@
+"""Tests for paddle.text (viterbi), paddle.geometric (segment/message
+passing), and incubate.optimizer (LookAhead/ModelAverage)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, nn, optimizer, text
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+# -- geometric -----------------------------------------------------------------
+
+def test_segment_ops():
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]],
+                                     dtype=np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(_np(geometric.segment_sum(data, seg)),
+                               [[4, 6], [12, 14]])
+    np.testing.assert_allclose(_np(geometric.segment_mean(data, seg)),
+                               [[2, 3], [6, 7]])
+    np.testing.assert_allclose(_np(geometric.segment_max(data, seg)),
+                               [[3, 4], [7, 8]])
+    np.testing.assert_allclose(_np(geometric.segment_min(data, seg)),
+                               [[1, 2], [5, 6]])
+
+
+def test_segment_empty_segment_is_zero():
+    data = paddle.to_tensor(np.ones((2, 3), np.float32))
+    seg = paddle.to_tensor(np.array([0, 2]))  # segment 1 empty
+    out = _np(geometric.segment_max(data, seg))
+    np.testing.assert_allclose(out[1], 0.0)
+
+
+def test_send_u_recv():
+    x = paddle.to_tensor(np.array([[1.], [2.], [4.]], dtype=np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    out = _np(geometric.send_u_recv(x, src, dst, reduce_op="sum"))
+    # dst0 <- x[0]; dst1 <- x[0]+x[2]; dst2 <- x[1]
+    np.testing.assert_allclose(out, [[1.], [5.], [2.]])
+    out_max = _np(geometric.send_u_recv(x, src, dst, reduce_op="max"))
+    np.testing.assert_allclose(out_max, [[1.], [4.], [2.]])
+
+
+def test_send_ue_recv_and_send_uv():
+    x = paddle.to_tensor(np.array([[1.], [2.]], dtype=np.float32))
+    e = paddle.to_tensor(np.array([[10.], [20.]], dtype=np.float32))
+    src = paddle.to_tensor(np.array([0, 1]))
+    dst = paddle.to_tensor(np.array([1, 0]))
+    out = _np(geometric.send_ue_recv(x, e, src, dst, "add", "sum"))
+    np.testing.assert_allclose(out, [[22.], [11.]])
+    uv = _np(geometric.send_uv(x, x, src, dst, "mul"))
+    np.testing.assert_allclose(uv, [[2.], [2.]])
+
+
+def test_send_u_recv_gradient():
+    x = paddle.to_tensor(np.array([[1.], [2.], [3.]], dtype=np.float32),
+                         stop_gradient=False)
+    src = paddle.to_tensor(np.array([0, 1]))
+    dst = paddle.to_tensor(np.array([1, 1]))
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    out.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), [[1.], [1.], [0.]])
+
+
+# -- text.viterbi --------------------------------------------------------------
+
+def _brute_force_viterbi(pot, trans, length):
+    """All-paths max over the first `length` steps (no bos/eos)."""
+    import itertools
+    n = pot.shape[-1]
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(n), repeat=length):
+        s = pot[0, path[0]]
+        for i in range(1, length):
+            s += trans[path[i - 1], path[i]] + pot[i, path[i]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_matches_brute_force():
+    rng = np.random.RandomState(0)
+    b, t, n = 2, 5, 3
+    pot = rng.randn(b, t, n).astype(np.float32)
+    trans = rng.randn(n, n).astype(np.float32)
+    lengths = np.array([5, 3])
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=False)
+    for i in range(b):
+        ref_s, ref_p = _brute_force_viterbi(pot[i], trans, lengths[i])
+        assert float(_np(scores)[i]) == pytest.approx(ref_s, rel=1e-5)
+        got = _np(paths)[i][:lengths[i]].tolist()
+        assert got == ref_p
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(1)
+    pot = paddle.to_tensor(rng.randn(1, 4, 2).astype(np.float32))
+    trans = paddle.to_tensor(rng.randn(2, 2).astype(np.float32))
+    dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    scores, paths = dec(pot, paddle.to_tensor(np.array([4])))
+    assert _np(paths).shape == (1, 4)
+    assert np.isfinite(float(_np(scores)[0]))
+
+
+def test_text_datasets_raise_offline():
+    with pytest.raises(RuntimeError, match="egress"):
+        text.Imdb()
+
+
+# -- incubate.optimizer --------------------------------------------------------
+
+def test_lookahead_syncs_slow_weights():
+    from paddle_tpu.incubate.optimizer import LookAhead
+    net = nn.Linear(4, 4)
+    inner = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    w0 = _np(net.weight).copy()
+    for i in range(2):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after k=2 steps, weights = slow + 0.5*(fast - slow): between w0 and fast
+    w = _np(net.weight)
+    assert not np.allclose(w, w0)
+    sd = opt.state_dict()
+    assert "@lookahead_k_count" in sd
+
+
+def test_viterbi_bos_eos_convention():
+    # reference convention: last two tags of the SAME [N, N] transition are
+    # BOS (n-2) / EOS (n-1); start scores = BOS row, stop = EOS column
+    n = 4  # 2 real tags + bos + eos
+    pot = np.zeros((1, 2, n), dtype=np.float32)
+    trans = np.zeros((n, n), dtype=np.float32)
+    trans[n - 2, 1] = 5.0  # BOS strongly prefers starting at tag 1
+    trans[0, n - 1] = 5.0  # ending at tag 0 is strongly rewarded
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([2])), include_bos_eos_tag=True)
+    p = _np(paths)[0]
+    assert p[0] == 1   # start steered by BOS row
+    assert p[-1] == 0  # end steered by EOS column
+
+
+def test_lookahead_state_roundtrip_preserves_slow_weights():
+    from paddle_tpu.incubate.optimizer import LookAhead
+    net = nn.Linear(3, 3)
+    opt = LookAhead(optimizer.SGD(learning_rate=0.1,
+                                  parameters=net.parameters()),
+                    alpha=0.5, k=5)
+    x = paddle.to_tensor(np.ones((1, 3), np.float32))
+    for _ in range(3):  # mid-window
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    slow_before = {i: np.asarray(opt._slow[id(p)]).copy()
+                   for i, p in enumerate(opt._parameter_list)}
+    sd = opt.state_dict()
+
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(net.state_dict())
+    opt2 = LookAhead(optimizer.SGD(learning_rate=0.1,
+                                   parameters=net2.parameters()),
+                     alpha=0.5, k=5)
+    opt2.set_state_dict(sd)
+    assert opt2._k_count == 3
+    for i, p in enumerate(opt2._parameter_list):
+        np.testing.assert_allclose(np.asarray(opt2._slow[id(p)]),
+                                   slow_before[i], rtol=1e-7)
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+    net = nn.Linear(2, 2)
+    inner = optimizer.SGD(learning_rate=0.5, parameters=net.parameters())
+    avg = ModelAverage(0.15, parameters=net.parameters(),
+                       min_average_window=10, max_average_window=20)
+    x = paddle.to_tensor(np.ones((1, 2), np.float32))
+    snapshots = []
+    for _ in range(4):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        inner.step()
+        inner.clear_grad()
+        avg.step()
+        snapshots.append(_np(net.weight).copy())
+    current = _np(net.weight).copy()
+    with avg.apply():
+        averaged = _np(net.weight).copy()
+        expect = np.mean(snapshots, axis=0)
+        np.testing.assert_allclose(averaged, expect, rtol=1e-5)
+    np.testing.assert_allclose(_np(net.weight), current, rtol=1e-7)
