@@ -54,6 +54,7 @@ from . import onnx
 from . import text
 from . import quantization
 from . import sparse
+from . import utils
 from . import vision
 from . import static
 from .hapi import Model, callbacks, summary

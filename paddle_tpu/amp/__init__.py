@@ -228,3 +228,8 @@ class GradScaler:
         self._scale = state["scale"]
         self._good_steps = state["good_steps"]
         self._bad_steps = state["bad_steps"]
+
+
+# paddle.amp.debugging (op stats + NaN/Inf checker); imported late so the
+# dispatch hook only pays when enabled
+from . import debugging  # noqa: E402,F401

@@ -1,0 +1,229 @@
+"""AMP debugging tools.
+
+Reference: python/paddle/amp/debugging.py — operator stats collection
+(enable/disable_operator_stats_collection, collect_operator_stats),
+check_numerics / TensorCheckerConfig (FLAGS_check_nan_inf,
+eager/nan_inf_utils.cc), and compare_accuracy.
+
+TPU-native: both hooks ride the single op-dispatch path (ops/registry.py)
+— stats count (op, dtype) pairs per call; the numerics checker runs a
+device-side isfinite reduction on op outputs (synchronizing, so debug
+only — the reference's nan_inf scan has the same cost profile).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+from enum import Enum
+from typing import Dict, Optional
+
+import numpy as np
+
+_op_stats: Optional[Dict[str, Dict[str, int]]] = None
+_checker = {"enabled": False, "debug_mode": None, "stack": True}
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    """debugging.py TensorCheckerConfig analog. When ``output_dir`` is set,
+    every checked op's outputs are accumulated and written as one .npz per
+    process on disable_tensor_checker() — the input compare_accuracy
+    consumes."""
+
+    def __init__(self, enable: bool,
+                 debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step  # (start, end) step window or None
+        self.stack_height_limit = stack_height_limit
+        self._dump: Dict[str, np.ndarray] = {}
+        self._step = 0
+
+    def _should_check(self, op_name: str) -> bool:
+        if self.debug_step is not None:
+            lo, hi = self.debug_step
+            if not (lo <= self._step < hi):
+                return False
+        if self.checked_op_list and op_name not in self.checked_op_list:
+            return False
+        return op_name not in self.skipped_op_list
+
+
+_active_config: Optional[TensorCheckerConfig] = None
+
+
+def _sync_hook():
+    from ..ops.registry import set_debug_hook
+    active = _active_config is not None or _op_stats is not None
+    set_debug_hook(_dispatch_post_hook if active else None)
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """debugging.py enable_tensor_checker analog (also flips
+    FLAGS_check_nan_inf so the dispatch hook activates)."""
+    global _active_config
+    _active_config = checker_config if checker_config.enable else None
+    from ..core.flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": bool(_active_config)})
+    _sync_hook()
+
+
+def disable_tensor_checker():
+    global _active_config
+    cfg = _active_config
+    _active_config = None
+    if cfg is not None and cfg.output_dir and cfg._dump:
+        import os
+        os.makedirs(cfg.output_dir, exist_ok=True)
+        np.savez(os.path.join(cfg.output_dir,
+                              f"worker_{os.getpid()}.npz"), **cfg._dump)
+        cfg._dump = {}
+    from ..core.flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": False})
+    _sync_hook()
+
+
+def _on_nan_inf_flag(value):
+    """core.flags observer: paddle.set_flags({'FLAGS_check_nan_inf': True})
+    activates a default checker (reference flag behavior)."""
+    global _active_config
+    if value and _active_config is None:
+        _active_config = TensorCheckerConfig(enable=True)
+    elif not value:
+        _active_config = None
+    _sync_hook()
+
+
+from ..core.flags import observe_flag as _observe  # noqa: E402
+
+_observe("FLAGS_check_nan_inf", _on_nan_inf_flag)
+
+
+def check_numerics(tensor, op_name: str = "tensor", debug_mode=None):
+    """Raise (or warn) if tensor contains NaN/Inf (check_numerics analog)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        return True
+    finite = bool(jnp.all(jnp.isfinite(arr)))
+    if not finite:
+        n_nan = int(jnp.sum(jnp.isnan(arr)))
+        n_inf = int(jnp.sum(jnp.isinf(arr)))
+        msg = (f"[check_numerics] op={op_name}: {n_nan} NaN, {n_inf} Inf in "
+               f"tensor shape {tuple(arr.shape)} dtype {arr.dtype}")
+        mode = debug_mode or (
+            _active_config.debug_mode if _active_config
+            else DebugMode.CHECK_NAN_INF_AND_ABORT)
+        if mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        import warnings
+        warnings.warn(msg)
+    return finite
+
+
+def _dispatch_post_hook(op_name: str, out_arrays):
+    """Called from ops.registry dispatch when FLAGS_check_nan_inf or stats
+    collection is on."""
+    if _op_stats is not None:
+        for a in out_arrays:
+            dt = str(getattr(a, "dtype", "other"))
+            _op_stats[op_name][dt] += 1
+    if _active_config is not None and _active_config._should_check(op_name):
+        import jax.numpy as jnp
+        for i, a in enumerate(out_arrays):
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+                if _active_config.output_dir is not None:
+                    key = f"{op_name}.{len(_active_config._dump)}"
+                    _active_config._dump[key] = np.asarray(a)
+                check_numerics(a, op_name,
+                               debug_mode=_active_config.debug_mode)
+
+
+def enable_operator_stats_collection():
+    """debugging.py enable_operator_stats_collection analog."""
+    global _op_stats
+    _op_stats = defaultdict(lambda: defaultdict(int))
+    _sync_hook()
+
+
+def disable_operator_stats_collection():
+    """Prints the collected table (reference behavior) and stops."""
+    global _op_stats
+    if _op_stats is None:
+        return
+    stats = {k: dict(v) for k, v in _op_stats.items()}
+    _op_stats = None
+    _sync_hook()
+    _print_table(stats)
+    return stats
+
+
+def _print_table(stats):
+    dtypes = sorted({dt for per_op in stats.values() for dt in per_op})
+    w = max([len(k) for k in stats] + [8])
+    header = " " * 2 + "op".ljust(w) + "".join(dt.rjust(12) for dt in dtypes)
+    print("<------------------------------ op list "
+          "------------------------------->")
+    print(header)
+    for op in sorted(stats):
+        row = " " * 2 + op.ljust(w)
+        for dt in dtypes:
+            row += str(stats[op].get(dt, 0)).rjust(12)
+        print(row)
+    print("<------------------------------------------------------------"
+          "--------->")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """debugging.py collect_operator_stats analog (context form)."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def stats_active() -> bool:
+    return _op_stats is not None
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """debugging.py compare_accuracy analog: compares two run dumps written
+    by check_numerics output_dir mode. Minimal offline form: compares two
+    .npz dumps tensor-by-tensor and writes a CSV of max abs/rel errors."""
+    import csv
+
+    a = np.load(dump_path)
+    b = np.load(another_dump_path)
+    keys = sorted(set(a.files) & set(b.files))
+    with open(output_filename, "w", newline="") as f:
+        wr = csv.writer(f)
+        wr.writerow(["tensor", "max_abs_err", "max_rel_err"])
+        for k in keys:
+            x, y = a[k].astype(np.float64), b[k].astype(np.float64)
+            abs_err = float(np.max(np.abs(x - y))) if x.shape == y.shape \
+                else float("nan")
+            rel = abs_err / (float(np.max(np.abs(x))) + 1e-12)
+            wr.writerow([k, abs_err, rel])
+    return output_filename
+
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "compare_accuracy"]

@@ -35,13 +35,27 @@ def _parse(value: str, ty: type) -> Any:
     return value
 
 
+_FLAG_OBSERVERS: Dict[str, Any] = {}  # flag name -> callback(value)
+
+
+def observe_flag(name: str, callback) -> None:
+    """Register a callback fired when set_flags changes `name` (used by
+    amp.debugging so FLAGS_check_nan_inf activates the dispatch hook)."""
+    _FLAG_OBSERVERS[name] = callback
+
+
 def set_flags(flags: Dict[str, Any]) -> None:
     """paddle.set_flags analog (python/paddle/base/framework.py)."""
+    notify = []
     with _LOCK:
         for k, v in flags.items():
             if k not in _DEFS:
                 raise KeyError(f"unknown flag {k!r}")
             _FLAGS[k] = v
+            if k in _FLAG_OBSERVERS:
+                notify.append((k, v))
+    for k, v in notify:  # outside the lock: callbacks may read flags
+        _FLAG_OBSERVERS[k](v)
 
 
 def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:

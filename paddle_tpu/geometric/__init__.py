@@ -89,11 +89,21 @@ def _send_u_recv(x, src_index, dst_index, reduce_op, out_size):
     return _finite(out) if reduce_op in ("max", "min") else out
 
 
+def _default_out_size(x, dst_index):
+    """Cover every dst node: max(x rows, max(dst)+1) — dropping messages to
+    indices >= x.shape[0] would be silent (segment-sum out-of-range)."""
+    if not hasattr(x, "shape"):
+        raise ValueError("send_*_recv needs an array x or explicit out_size")
+    import numpy as _onp
+    dst = dst_index._data if hasattr(dst_index, "_data") else dst_index
+    max_dst = int(_onp.asarray(dst).max()) + 1 if _onp.size(dst) else 0
+    return max(int(x.shape[0]), max_dst)
+
+
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
                 name=None):
     """geometric.send_u_recv analog: gather x at src, reduce onto dst."""
-    n = out_size if out_size is not None else (
-        x.shape[0] if hasattr(x, "shape") else None)
+    n = out_size if out_size is not None else _default_out_size(x, dst_index)
     return _send_u_recv(x, src_index, dst_index, reduce_op, int(n))
 
 
@@ -118,7 +128,7 @@ def _send_ue_recv(x, y, src_index, dst_index, message_op, reduce_op,
 def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                  reduce_op="sum", out_size=None, name=None):
     """geometric.send_ue_recv analog: node+edge message passing."""
-    n = out_size if out_size is not None else x.shape[0]
+    n = out_size if out_size is not None else _default_out_size(x, dst_index)
     return _send_ue_recv(x, y, src_index, dst_index, message_op, reduce_op,
                          int(n))
 

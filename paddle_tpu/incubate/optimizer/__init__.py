@@ -83,9 +83,8 @@ class ModelAverage(Optimizer):
                  name=None):
         if parameters is None:
             raise ValueError("parameters must be provided")
-        self._parameter_list = list(parameters)
-        self._grad_clip = None
-        self._multi_precision = False
+        # base init gives the inherited surface (get_lr/state accumulators)
+        super().__init__(learning_rate=0.0, parameters=parameters)
         self.avg_rate = average_window_rate
         self.min_window = int(min_average_window)
         self.max_window = int(max_average_window)
@@ -114,8 +113,12 @@ class ModelAverage(Optimizer):
 
     def apply(self, executor=None, need_restore=True):
         """Swap averaged weights in (context-manager friendly)."""
+        if self._window_updates == 0:
+            raise RuntimeError(
+                "ModelAverage.apply() before any step(): no averaged "
+                "weights have been accumulated yet")
         self._backup = {id(p): p._data for p in self._parameter_list}
-        n = max(self._window_updates, 1)
+        n = self._window_updates
         for p in self._parameter_list:
             p._data = (self._sum[id(p)] / n).astype(p._data.dtype)
         if not need_restore:
@@ -138,6 +141,22 @@ class ModelAverage(Optimizer):
     def clear_grad(self, *a, **k):
         for p in self._parameter_list:
             p.clear_grad()
+
+    def state_dict(self):
+        sd = {"@avg_num_updates": self._num_updates,
+              "@avg_window_updates": self._window_updates}
+        for i, p in enumerate(self._parameter_list):
+            sd[f"@avg_sum_{i}"] = np.asarray(self._sum[id(p)])
+        return sd
+
+    def set_state_dict(self, sd):
+        self._num_updates = int(sd.get("@avg_num_updates", 0))
+        self._window_updates = int(sd.get("@avg_window_updates", 0))
+        for i, p in enumerate(self._parameter_list):
+            s = sd.get(f"@avg_sum_{i}")
+            if s is not None:
+                arr = s._data if isinstance(s, Tensor) else s
+                self._sum[id(p)] = jnp.asarray(arr)
 
 
 __all__ = ["LookAhead", "ModelAverage"]

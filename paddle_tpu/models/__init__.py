@@ -8,6 +8,9 @@ families as first-class citizens, built TPU-first: static shapes, bf16-friendly
 compute, attention through the fused flash-attention path, and optional
 tensor-parallel variants over the hybrid mesh.
 """
+from .bert import (BertConfig, BertForMaskedLM,
+                   BertForSequenceClassification, BertModel,
+                   bert_base_config, bert_tiny_config, shard_bert)
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     llama2_7b_config, llama_tiny_config, shard_llama)
 from .gpt import GPT2Config, GPT2ForCausalLM, GPT2Model, gpt2_124m_config
@@ -18,6 +21,8 @@ __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama2_7b_config",
     "llama_tiny_config", "shard_llama",
     "GPT2Config", "GPT2Model", "GPT2ForCausalLM", "gpt2_124m_config",
+    "BertConfig", "BertModel", "BertForSequenceClassification",
+    "BertForMaskedLM", "bert_base_config", "bert_tiny_config", "shard_bert",
     "ResNet", "BasicBlock", "BottleneckBlock", "resnet18", "resnet34",
     "resnet50", "resnet101", "resnet152",
 ]

@@ -1,0 +1,257 @@
+"""BERT model family (encoder-only).
+
+Reference: PaddleNLP-style BERT served by the framework's layer stack
+(python/paddle/nn: MultiHeadAttention/TransformerEncoder are the building
+blocks; test fixtures like test/legacy_test/test_transformer_api.py
+exercise the same architecture). TPU-first: post-LN encoder blocks whose
+attention runs through F.scaled_dot_product_attention (Pallas flash path
+on TPU), bidirectional (is_causal=False) with an additive padding mask.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def bert_base_config(**overrides) -> BertConfig:
+    return BertConfig(**overrides)
+
+
+def bert_tiny_config(**overrides) -> BertConfig:
+    base = dict(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                num_attention_heads=2, intermediate_size=512,
+                max_position_embeddings=128, hidden_dropout_prob=0.0,
+                attention_probs_dropout_prob=0.0)
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+class BertEmbeddings(Layer):
+    """word + position + token-type embeddings, post-LN."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(std=config.initializer_range)
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=init)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=init)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        from .. import ops
+        _, s = input_ids.shape
+        pos = ops.arange(0, s, dtype="int32").unsqueeze(0)
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(std=config.initializer_range)
+        h = config.hidden_size
+        self.qkv = Linear(h, 3 * h, weight_attr=init)
+        self.out = Linear(h, h, weight_attr=init)
+        self.config = config
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, hidden, attn_mask=None):
+        b, s, _ = hidden.shape
+        h, d = self.config.num_attention_heads, self.config.head_dim
+        qkv = self.qkv(hidden).reshape([b, s, 3, h, d])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=(self.config.attention_probs_dropout_prob
+                       if self.training else 0.0))
+        return self.dropout(self.out(out.reshape([b, s, h * d])))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (original BERT residual ordering)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(std=config.initializer_range)
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = LayerNorm(config.hidden_size,
+                                   epsilon=config.layer_norm_eps)
+        self.intermediate = Linear(config.hidden_size,
+                                   config.intermediate_size,
+                                   weight_attr=init)
+        self.output = Linear(config.intermediate_size, config.hidden_size,
+                             weight_attr=init)
+        self.out_norm = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, hidden, attn_mask=None):
+        hidden = self.attn_norm(hidden + self.attention(hidden, attn_mask))
+        ffn = self.dropout(self.output(F.gelu(self.intermediate(hidden))))
+        return self.out_norm(hidden + ffn)
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size,
+                            weight_attr=I.Normal(
+                                std=config.initializer_range))
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """Encoder backbone: (sequence_output, pooled_output)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = [BertLayer(config)
+                       for _ in range(config.num_hidden_layers)]
+        for i, l in enumerate(self.layers):
+            self.add_sublayer(f"layer.{i}", l)
+        self.pooler = BertPooler(config)
+
+    def _extend_mask(self, attention_mask):
+        """[B, S] 1/0 padding mask -> additive [B, 1, S, S] bias."""
+        if attention_mask is None:
+            return None
+
+        def _impl(m):
+            bias = (1.0 - m.astype(jnp.float32)) * -1e9
+            return bias[:, None, None, :]
+
+        from ..ops.registry import dispatch
+        return dispatch(_impl, (attention_mask,), {}, op_name="bert_mask")
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        hidden = self.embeddings(input_ids, token_type_ids)
+        mask = self._extend_mask(attention_mask)
+        for layer in self.layers:
+            hidden = layer(hidden, mask)
+        return hidden, self.pooler(hidden)
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes,
+                                 weight_attr=I.Normal(
+                                     std=config.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return logits, loss
+        return logits
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size,
+                                weight_attr=I.Normal(
+                                    std=config.initializer_range))
+        self.norm = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_eps)
+        self.decoder = Linear(config.hidden_size, config.vocab_size,
+                              weight_attr=I.Normal(
+                                  std=config.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None, ignore_index=-100):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        hidden = self.norm(F.gelu(self.transform(seq)))
+        logits = self.decoder(hidden)
+        if labels is not None:
+            b, s, v = logits.shape
+            loss = F.cross_entropy(logits.reshape([b * s, v]),
+                                   labels.reshape([b * s]),
+                                   ignore_index=ignore_index)
+            return logits, loss
+        return logits
+
+
+def shard_bert(model: BertModel, mesh, mp_axis: str = "mp",
+               fsdp_axis=None):
+    """Megatron placements for the encoder: qkv/intermediate column-split,
+    out/output row-split, embeddings vocab-split (shard_llama analog)."""
+    from ..distributed.auto_parallel import Replicate, Shard, shard_tensor
+
+    def repl():
+        return [Replicate() for _ in mesh.dim_names]
+
+    def shard_on(axis_name, dim):
+        return [Shard(dim) if n == axis_name else Replicate()
+                for n in mesh.dim_names]
+
+    bert = model.bert if hasattr(model, "bert") else model
+    shard_tensor(bert.embeddings.word_embeddings.weight, mesh,
+                 shard_on(mp_axis, 0))
+    for layer in bert.layers:
+        shard_tensor(layer.attention.qkv.weight, mesh, shard_on(mp_axis, 1))
+        shard_tensor(layer.attention.out.weight, mesh, shard_on(mp_axis, 0))
+        shard_tensor(layer.intermediate.weight, mesh, shard_on(mp_axis, 1))
+        shard_tensor(layer.output.weight, mesh, shard_on(mp_axis, 0))
+    if fsdp_axis:
+        for p in bert.parameters():
+            if p._dist_attr is None and p.ndim > 0 and \
+                    p.shape[0] % mesh.get_dim_size(fsdp_axis) == 0:
+                shard_tensor(p, mesh, shard_on(fsdp_axis, 0))
+    return model
+
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForMaskedLM", "bert_base_config", "bert_tiny_config",
+           "shard_bert"]

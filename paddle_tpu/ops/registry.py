@@ -52,6 +52,14 @@ def _wrap_out_leaf(leaf, stop_gradient):
     return leaf
 
 
+_DEBUG_HOOK = [None]  # set by amp.debugging when stats/nan-check are active
+
+
+def set_debug_hook(hook):
+    """amp.debugging installs its post-op hook here (None to clear)."""
+    _DEBUG_HOOK[0] = hook
+
+
 def dispatch(fn: Callable, args, kwargs, op_name: str,
              differentiable: bool = True):
     """Run one op with unwrap/AMP/autograd-record. The single hot path
@@ -61,8 +69,15 @@ def dispatch(fn: Callable, args, kwargs, op_name: str,
     if _PROF_ACTIVE:
         from ..profiler import RecordEvent
         with RecordEvent(op_name, event_type="Operator"):
-            return _dispatch_impl(fn, args, kwargs, op_name, differentiable)
-    return _dispatch_impl(fn, args, kwargs, op_name, differentiable)
+            out = _dispatch_impl(fn, args, kwargs, op_name, differentiable)
+    else:
+        out = _dispatch_impl(fn, args, kwargs, op_name, differentiable)
+    hook = _DEBUG_HOOK[0]
+    if hook is not None:
+        arrays = [l._data for l in jax.tree_util.tree_leaves(
+            out, is_leaf=_is_tensor) if _is_tensor(l)]
+        hook(op_name, arrays)
+    return out
 
 
 def _dispatch_impl(fn: Callable, args, kwargs, op_name: str,

@@ -1,0 +1,85 @@
+"""paddle.utils analog.
+
+Reference: python/paddle/utils (unique_name generator/guard, deprecated
+decorator, try_import/require_version, flops). The download helpers are
+offline-stubbed.
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+from . import unique_name
+from .flops import flops
+
+__all__ = ["unique_name", "deprecated", "try_import", "require_version",
+           "flops", "run_check"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """utils/deprecated.py analog: warn (level<=1) or raise (level==2)."""
+
+    def deco(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level < 2:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        wrapper.__doc__ = (f"(deprecated) {fn.__doc__ or ''}").strip()
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """utils/lazy_import.py try_import analog."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"{module_name} is required but not installed "
+                          f"(and cannot be installed in this offline "
+                          f"environment)")
+
+
+def require_version(min_version: str, max_version: str = None):
+    """utils/install_check-style version gate against paddle_tpu.__version__."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in v.split(".")[:3])
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(f"paddle_tpu>={min_version} required, got "
+                        f"{__version__}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(f"paddle_tpu<={max_version} required, got "
+                        f"{__version__}")
+    return True
+
+
+def run_check():
+    """paddle.utils.run_check analog: one tiny compute on each device."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((8, 8))
+    y = (x @ x).sum()
+    y.block_until_ready()
+    print(f"paddle_tpu is installed successfully! "
+          f"{len(devs)} {devs[0].platform} device(s) available.")
+    return True

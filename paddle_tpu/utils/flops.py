@@ -1,0 +1,78 @@
+"""paddle.utils.flops analog — per-layer FLOPs estimation.
+
+Reference: hapi/model_summary flops + utils/flops.py: walks the network
+with forward hooks recording per-layer multiply-accumulate counts.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _layer_flops(layer, ins, outs):
+    from ..nn.common import Linear
+    from ..nn.conv import _ConvNd
+    from ..nn.norm import LayerNorm, _BatchNormBase
+    x = ins[0] if isinstance(ins, (list, tuple)) else ins
+    out = outs[0] if isinstance(outs, (list, tuple)) else outs
+    if isinstance(layer, Linear):
+        batch = int(np.prod(x.shape[:-1]))
+        return 2 * batch * layer.in_features * layer.out_features
+    if isinstance(layer, _ConvNd):
+        out_elems = int(np.prod(out.shape))
+        k_elems = int(np.prod(layer.weight.shape[1:]))  # cin/groups*k*k
+        return 2 * out_elems * k_elems
+    if isinstance(layer, (_BatchNormBase, LayerNorm)):
+        return 2 * int(np.prod(x.shape))
+    return 0
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Total forward FLOPs for one batch of `input_size`."""
+    from ..autograd import no_grad
+    from ..static import InputSpec
+
+    sizes = input_size if isinstance(input_size, list) else [input_size]
+    if sizes and isinstance(sizes[0], int):
+        sizes = [tuple(sizes)]
+    inputs = [InputSpec(s, "float32")._zeros(
+        batch_size=s[0] if s and s[0] not in (None, -1) else 1)
+        for s in sizes]
+
+    total = [0]
+    rows = []
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def make_hook(lyr):
+        def hook(layer, ins, outs):
+            fn = custom_ops.get(type(layer))
+            n = fn(layer, ins, outs) if fn else _layer_flops(layer, ins, outs)
+            total[0] += n
+            if n and print_detail:
+                rows.append((type(layer).__name__, n))
+        return hook
+
+    for _, sub in net.named_sublayers():
+        if next(iter(sub.children()), None) is None:
+            hooks.append(sub.register_forward_post_hook(make_hook(sub)))
+    was_training = net.training
+    net.eval()
+    try:
+        with no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        for name, n in rows:
+            print(f"  {name:<24} {n:,}")
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]
+
+
+__all__ = ["flops"]

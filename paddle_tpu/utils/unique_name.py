@@ -1,0 +1,37 @@
+"""paddle.utils.unique_name analog (base/unique_name.py: generate/guard/
+switch over per-prefix counters)."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+_generators = [defaultdict(int)]
+
+
+def generate(key: str) -> str:
+    counters = _generators[-1]
+    idx = counters[key]
+    counters[key] += 1
+    return f"{key}_{idx}"
+
+
+def switch(new_generator=None):
+    """Replace the current counter namespace; returns the old one."""
+    old = _generators[-1]
+    _generators[-1] = new_generator if new_generator is not None \
+        else defaultdict(int)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Fresh (or given) name namespace inside the context."""
+    _generators.append(new_generator if new_generator is not None
+                       else defaultdict(int))
+    try:
+        yield
+    finally:
+        _generators.pop()
+
+
+__all__ = ["generate", "switch", "guard"]
