@@ -110,11 +110,15 @@ _observe("FLAGS_check_nan_inf", _on_nan_inf_flag)
 
 
 def check_numerics(tensor, op_name: str = "tensor", debug_mode=None):
-    """Raise (or warn) if tensor contains NaN/Inf (check_numerics analog)."""
+    """Raise (or warn) if tensor contains NaN/Inf (check_numerics analog).
+    No-op (returns True) on traced values — value checks are eager-only."""
+    import jax
     import jax.numpy as jnp
 
     from ..core.tensor import Tensor
     arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if isinstance(arr, jax.core.Tracer):
+        return True
     if not jnp.issubdtype(arr.dtype, jnp.floating):
         return True
     finite = bool(jnp.all(jnp.isfinite(arr)))
@@ -133,16 +137,29 @@ def check_numerics(tensor, op_name: str = "tensor", debug_mode=None):
     return finite
 
 
+def advance_step():
+    """Advance the checker's step counter (drives debug_step windows).
+    Called automatically by Optimizer.step(); harmless no-op otherwise."""
+    if _active_config is not None:
+        _active_config._step += 1
+
+
 def _dispatch_post_hook(op_name: str, out_arrays):
     """Called from ops.registry dispatch when FLAGS_check_nan_inf or stats
-    collection is on."""
+    collection is on. Tracer outputs (ops running inside a jit trace) are
+    counted but never concretized — value checks are an eager-mode tool
+    (matching the reference's eager nan_inf scan)."""
+    import jax
+
     if _op_stats is not None:
         for a in out_arrays:
             dt = str(getattr(a, "dtype", "other"))
             _op_stats[op_name][dt] += 1
     if _active_config is not None and _active_config._should_check(op_name):
         import jax.numpy as jnp
-        for i, a in enumerate(out_arrays):
+        for a in out_arrays:
+            if isinstance(a, jax.core.Tracer):
+                continue  # inside jit: cannot (and must not) concretize
             if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
                 if _active_config.output_dir is not None:
                     key = f"{op_name}.{len(_active_config._dump)}"

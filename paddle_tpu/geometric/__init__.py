@@ -91,11 +91,16 @@ def _send_u_recv(x, src_index, dst_index, reduce_op, out_size):
 
 def _default_out_size(x, dst_index):
     """Cover every dst node: max(x rows, max(dst)+1) — dropping messages to
-    indices >= x.shape[0] would be silent (segment-sum out-of-range)."""
+    indices >= x.shape[0] would be silent (segment-sum out-of-range).
+
+    Under jit tracing the dst values are abstract, so the default falls back
+    to x rows — pass out_size explicitly inside compiled functions."""
     if not hasattr(x, "shape"):
         raise ValueError("send_*_recv needs an array x or explicit out_size")
     import numpy as _onp
     dst = dst_index._data if hasattr(dst_index, "_data") else dst_index
+    if isinstance(dst, jax.core.Tracer):
+        return int(x.shape[0])
     max_dst = int(_onp.asarray(dst).max()) + 1 if _onp.size(dst) else 0
     return max(int(x.shape[0]), max_dst)
 

@@ -72,6 +72,7 @@ class StaticFunction:
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._cache: Dict[Any, dict] = {}
+        self._full_graph = full_graph
 
     @property
     def code(self):
@@ -154,7 +155,41 @@ class StaticFunction:
         return entry
 
     # -- pass 2+: compiled execution ----------------------------------------
+    _BREAK_ERRORS = ()  # populated lazily (jax.errors import)
+
+    @classmethod
+    def _graph_break_errors(cls):
+        if not cls._BREAK_ERRORS:
+            import jax.errors
+            cls._BREAK_ERRORS = (
+                jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError)
+        return cls._BREAK_ERRORS
+
     def _run(self, entry, args, kwargs):
+        if entry.get("fallback"):
+            # graph broke on a previous call: this signature runs eagerly
+            return self._fn(*args, **kwargs)
+        try:
+            return self._run_compiled(entry, args, kwargs)
+        except self._graph_break_errors() as e:
+            # Data-dependent python control flow (bool()/int()/float() of a
+            # traced tensor) — the SOT graph-break case
+            # (sot/opcode_translator: BreakGraphError -> eager fallback).
+            # full_graph=True mirrors the reference: hard error.
+            if self._full_graph:
+                raise RuntimeError(
+                    f"to_static(full_graph=True): {self._fn.__name__} has "
+                    f"data-dependent python control flow that cannot be "
+                    f"compiled; use lax-style ops (paddle.where, masking) "
+                    f"or full_graph=False for eager fallback") from e
+            entry["fallback"] = True
+            entry.pop("compiled", None)  # free the trace
+            return self._fn(*args, **kwargs)
+
+    def _run_compiled(self, entry, args, kwargs):
         gen = _random.default_generator()
         flat = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)[0]
         arg_tensors = [flat[i] for i in entry["tensor_pos"]]
@@ -231,7 +266,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         from ..nn.layer import Layer
         if isinstance(fn, Layer):
             layer = fn
-            static = StaticFunction(layer.forward)
+            static = StaticFunction(layer.forward, input_spec,
+                                    build_strategy, backend, full_graph)
             layer.forward = static
             return layer
         return StaticFunction(fn, input_spec, build_strategy, backend,

@@ -353,3 +353,76 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     hi = lo + shard_size
     in_shard = (input >= lo) & (input < hi)
     return jnp.where(in_shard, input - lo, ignore_value)
+
+
+@defop()
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop()
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    # reshape's built-in single -1 inference covers the inferred-dim case
+    return x.reshape(x.shape[:axis] + tuple(int(s) for s in shape)
+                     + x.shape[axis + 1:])
+
+
+@defop()
+def take(x, index, mode="raise"):
+    flat = x.reshape(-1)
+    idx = index
+    if mode == "wrap":
+        idx = idx % flat.shape[0]
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    else:  # jax gathers clamp; emulate "raise" semantics statically
+        idx = jnp.clip(idx, -flat.shape[0], flat.shape[0] - 1)
+    return flat[idx]
+
+
+@defop()
+def select_scatter(x, values, axis, index):
+    return x.at[(slice(None),) * (axis % x.ndim) + (index,)].set(values)
+
+
+@defop()
+def slice_scatter(x, value, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x.at[tuple(idx)].set(value)
+
+
+def view(x, shape_or_dtype, name=None):
+    """paddle.view analog: reshape view, or dtype reinterpret-view with the
+    reference's last-dim scaling (f32 [2,4] viewed as f16 -> [2,8])."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(list(shape_or_dtype))
+    from ..core.dtype import to_jax_dtype
+    from .registry import dispatch
+    dt = to_jax_dtype(shape_or_dtype)
+
+    def _impl(a):
+        old = jnp.dtype(a.dtype).itemsize
+        new = jnp.dtype(dt).itemsize
+        if new == old:
+            return jax.lax.bitcast_convert_type(a, dt)
+        if new < old:  # smaller dtype: bitcast appends a factor dim; fold it
+            out = jax.lax.bitcast_convert_type(a, dt)
+            return out.reshape(out.shape[:-2] + (out.shape[-2]
+                                                 * out.shape[-1],))
+        # larger dtype: expose the ratio as a trailing dim, bitcast eats it
+        ratio = new // old
+        if a.shape[-1] % ratio:
+            raise ValueError(
+                f"view: last dim {a.shape[-1]} not divisible by the dtype "
+                f"size ratio {ratio}")
+        split = a.reshape(a.shape[:-1] + (a.shape[-1] // ratio, ratio))
+        return jax.lax.bitcast_convert_type(split, dt)
+
+    return dispatch(_impl, (x,), {}, op_name="view_dtype")
+
+
+def view_as(x, other, name=None):
+    return x.reshape(list(other.shape))

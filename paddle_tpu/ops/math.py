@@ -348,3 +348,81 @@ def multiply_no_broadcast(x, y):
 @defop()
 def stanh(x, scale_a=0.67, scale_b=1.7159):
     return scale_b * jnp.tanh(scale_a * x)
+
+
+@defop()
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@defop(differentiable=False)
+def kthvalue(x, k, axis=-1, keepdim=False):
+    dim = x.shape[axis]
+    if not 1 <= k <= dim:
+        raise ValueError(f"kthvalue: k={k} out of range [1, {dim}]")
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis).astype(jnp.int64)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i
+
+
+@defop(differentiable=False)
+def mode(x, axis=-1, keepdim=False):
+    """Most frequent value along axis (ties -> smallest, paddle semantics:
+    last occurrence index of the chosen value)."""
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    n = moved.shape[-1]
+
+    def row_mode(row):
+        svals = jnp.sort(row)
+        # count occurrences of each sorted value
+        eq = svals[:, None] == svals[None, :]
+        counts = eq.sum(axis=1)
+        best = jnp.argmax(counts)  # first max -> smallest value on ties
+        val = svals[best]
+        idx = jnp.max(jnp.where(row == val, jnp.arange(n), -1))
+        return val, idx.astype(jnp.int64)
+
+    flat = moved.reshape(-1, n)
+    vals, idxs = jax.vmap(row_mode)(flat)
+    out_shape = moved.shape[:-1]
+    v = vals.reshape(out_shape)
+    i = idxs.reshape(out_shape)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i
+
+
+@defop()
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+@defop()
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+@defop()
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@defop()
+def index_fill(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(value)

@@ -88,6 +88,8 @@ class Optimizer:
     # -- eager step ---------------------------------------------------------
     @no_grad()
     def step(self):
+        from ..amp import debugging as _dbg
+        _dbg.advance_step()  # drives TensorCheckerConfig debug_step windows
         lr = self.get_lr()
         params = [p for p in self._parameter_list
                   if p.trainable and p.grad is not None]

@@ -201,3 +201,59 @@ def test_train_step_multi_precision_masters():
         step(paddle.ones([1]))
     assert opt._master_weights[id(p)].dtype == jnp.float32
     assert p.dtype == paddle.bfloat16
+
+
+def test_to_static_graph_break_fallback():
+    """Data-dependent python control flow: full_graph=False falls back to
+    eager per signature (the SOT graph-break semantics); full_graph=True
+    raises with guidance (reference full-graph mode)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+
+    calls = {"n": 0}
+
+    @jit.to_static(full_graph=False)
+    def branchy(x):
+        calls["n"] += 1
+        if float(x.sum()) > 0:       # concretizes a tensor -> graph break
+            return x * 2
+        return x - 1
+
+    pos = paddle.to_tensor(np.ones(4, np.float32))
+    neg = paddle.to_tensor(-np.ones(4, np.float32))
+    # call 1: eager discovery (works); call 2: compiled trace raises ->
+    # falls back to eager and keeps working, with correct branch per value
+    np.testing.assert_allclose(np.asarray(branchy(pos)._data), 2.0)
+    np.testing.assert_allclose(np.asarray(branchy(pos)._data), 2.0)
+    np.testing.assert_allclose(np.asarray(branchy(neg)._data), -2.0)
+    np.testing.assert_allclose(np.asarray(branchy(pos)._data), 2.0)
+    assert calls["n"] >= 4  # every call ran the python (eager fallback)
+
+    @jit.to_static(full_graph=True)
+    def branchy_full(x):
+        if float(x.sum()) > 0:
+            return x * 2
+        return x - 1
+
+    branchy_full(pos)  # discovery pass is eager: fine
+    import pytest
+    with pytest.raises(RuntimeError, match="data-dependent"):
+        branchy_full(pos)  # compiled pass: hard error with guidance
+
+
+def test_to_static_no_fallback_for_clean_functions():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+
+    @jit.to_static(full_graph=False)
+    def clean(x):
+        return (x * 3).sum()
+
+    x = paddle.to_tensor(np.ones(8, np.float32))
+    assert float(clean(x)) == 24.0
+    assert float(clean(x)) == 24.0
+    # stayed compiled: no fallback flag on the cache entry
+    entry = clean.concrete_program(x)
+    assert entry is not None and not entry.get("fallback")

@@ -311,3 +311,132 @@ def test_poisson_and_geometric():
 def test_kl_unregistered_raises():
     with pytest.raises(NotImplementedError):
         D.kl_divergence(D.Normal(0.0, 1.0), D.Uniform(0.0, 1.0))
+
+
+# -- breadth ops (round-1 additions) ------------------------------------------
+
+def test_diagonal_unflatten_take():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(_np(paddle.diagonal(x)), [0, 5, 10])
+    u = paddle.unflatten(paddle.to_tensor(np.zeros(24, np.float32)), 0,
+                         [4, -1])
+    assert tuple(u.shape) == (4, 6)
+    t = paddle.take(x, paddle.to_tensor(np.array([0, 5, 11])))
+    np.testing.assert_allclose(_np(t), [0, 5, 11])
+    tw = paddle.take(x, paddle.to_tensor(np.array([12])), mode="wrap")
+    np.testing.assert_allclose(_np(tw), [0])
+
+
+def test_tensordot_and_trapezoid():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+    np.testing.assert_allclose(_np(paddle.tensordot(a, b, axes=1)),
+                               _np(a) @ _np(b))
+    y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    assert float(paddle.trapezoid(y)) == pytest.approx(4.0)
+    x = paddle.to_tensor(np.array([0.0, 1.0, 3.0], np.float32))
+    assert float(paddle.trapezoid(y, x=x)) == pytest.approx(
+        np.trapezoid([1, 2, 3], [0, 1, 3]))
+
+
+def test_kthvalue_mode_quantile():
+    x = paddle.to_tensor(np.array([[3.0, 1.0, 2.0],
+                                   [5.0, 5.0, 4.0]], np.float32))
+    v, i = paddle.kthvalue(x, 2)
+    np.testing.assert_allclose(_np(v), [2.0, 5.0])
+    mv, mi = paddle.mode(x)
+    np.testing.assert_allclose(_np(mv)[1], 5.0)
+    assert int(_np(mi)[1]) == 1  # last occurrence of the mode value
+    q = paddle.quantile(paddle.to_tensor(
+        np.arange(5, dtype=np.float32)), 0.5)
+    assert float(q) == pytest.approx(2.0)
+    nx = paddle.to_tensor(np.array([1.0, np.nan, 3.0], np.float32))
+    assert float(paddle.nanquantile(nx, 0.5)) == pytest.approx(2.0)
+
+
+def test_scatter_view_family():
+    x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    out = paddle.select_scatter(x, paddle.to_tensor(
+        np.ones(4, np.float32)), axis=0, index=1)
+    np.testing.assert_allclose(_np(out)[1], 1.0)
+    np.testing.assert_allclose(_np(out)[0], 0.0)
+
+    out2 = paddle.slice_scatter(x, paddle.to_tensor(
+        np.ones((3, 2), np.float32)), axes=[1], starts=[1], ends=[3],
+        strides=[1])
+    np.testing.assert_allclose(_np(out2)[:, 1:3], 1.0)
+    np.testing.assert_allclose(_np(out2)[:, 0], 0.0)
+
+    v = paddle.view(x, [4, 3])
+    assert tuple(v.shape) == (4, 3)
+    va = paddle.view_as(x, paddle.to_tensor(np.zeros((2, 6))))
+    assert tuple(va.shape) == (2, 6)
+
+    filled = paddle.index_fill(x, paddle.to_tensor(np.array([0, 2])), 0, 7.0)
+    np.testing.assert_allclose(_np(filled)[0], 7.0)
+    np.testing.assert_allclose(_np(filled)[1], 0.0)
+
+
+def test_new_ops_differentiable():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4)
+                         .astype(np.float32), stop_gradient=False)
+    y = paddle.diagonal(x).sum() + paddle.tensordot(x, x, axes=[[0, 1],
+                                                                [0, 1]])
+    y.backward()
+    g = _np(x.grad)
+    expect = np.eye(3, 4) + 2 * _np(x)
+    np.testing.assert_allclose(g, expect, rtol=1e-5)
+
+
+def test_view_dtype_scales_last_dim():
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    half = paddle.view(x, "float16")
+    assert tuple(half.shape) == (2, 8)
+    back = paddle.view(half, "float32")
+    assert tuple(back.shape) == (2, 4)
+    np.testing.assert_allclose(_np(back), 1.0)
+
+
+def test_kthvalue_validates_k():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        paddle.kthvalue(x, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        paddle.kthvalue(x, 5)
+
+
+def test_nan_checker_does_not_break_jit():
+    from paddle_tpu import jit
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        @jit.to_static  # full_graph=True default: must NOT raise
+        def f(x):
+            return (x * 2).sum()
+
+        x = paddle.to_tensor(np.ones(8, np.float32))
+        assert float(f(x)) == 16.0
+        assert float(f(x)) == 16.0  # compiled pass with hook active
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_debug_step_window_gates_checks():
+    from paddle_tpu.amp import debugging as dbg
+    from paddle_tpu import nn, optimizer
+    cfg = dbg.TensorCheckerConfig(enable=True, debug_step=(2, 100))
+    dbg.enable_tensor_checker(cfg)
+    try:
+        net = nn.Linear(2, 2)
+        opt = optimizer.SGD(learning_rate=1e9,  # guarantees overflow later
+                            parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((1, 2), np.float32) * 1e20)
+        # steps 0-1: window closed, nan outputs pass silently
+        bad = paddle.to_tensor(np.array([np.inf], np.float32))
+        _ = bad - bad  # nan, but step 0 < window start -> unchecked
+        opt.step(); opt.clear_grad()
+        opt.step(); opt.clear_grad()
+        # now inside the window: checking active
+        with pytest.raises(FloatingPointError):
+            _ = bad - bad
+    finally:
+        dbg.disable_tensor_checker()
