@@ -80,10 +80,62 @@ class Group:
 _WORLD: List[Optional[Group]] = [None]
 
 
+_BOOTSTRAP = {"store": None}
+
+
+def _maybe_init_multihost():
+    """Multi-host bootstrap (parallel.py:943's TCPStore + comm-context
+    creation, TPU-shaped): when the launcher's env says this is a
+    multi-process job, initialize the PJRT distributed runtime (ICI/DCN
+    plane) and open the TCPStore control plane (barriers, elastic,
+    checkpoint coordination) against rank 0."""
+    import os
+    nnodes = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    coord = os.environ.get("PADDLE_MASTER",
+                           os.environ.get("MASTER_ENDPOINT"))
+    if nnodes <= 1 or not coord or _BOOTSTRAP["store"] is not None:
+        return
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    # the launcher normalizes PADDLE_MASTER to an http:// KV endpoint and
+    # publishes the real gRPC coordinator as JAX_COORDINATOR_ADDRESS
+    # (launch/controllers.py) — strip the scheme for our own parsing
+    coord = coord.split("://", 1)[-1]
+    if ":" not in coord:
+        raise ValueError(f"PADDLE_MASTER must be host:port, got {coord!r}")
+    host, port = coord.rsplit(":", 1)
+    try:
+        if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+            jax.distributed.initialize()  # picks up the JAX_* env triple
+        else:
+            jax.distributed.initialize(
+                coordinator_address=f"{host}:{int(port) + 1}",
+                num_processes=nnodes, process_id=rank)
+    except RuntimeError as e:
+        if "already" not in str(e).lower():
+            raise  # real failure: do NOT proceed as N separate jobs
+    from ..core.native import TCPStore
+    # control plane: master+2 (master = launcher KV, master+1 = PJRT
+    # coordinator, see launch/main.py port layout)
+    store = TCPStore(host, int(port) + 2, is_master=(rank == 0),
+                     world_size=nnodes)
+    # publish only once the whole world has arrived — a failed barrier must
+    # not leave a half-initialized bootstrap behind
+    store.barrier("init_parallel_env", world_size=nnodes)
+    _BOOTSTRAP["store"] = store
+
+
+def get_bootstrap_store():
+    """The job-wide TCPStore (None in single-process runs)."""
+    return _BOOTSTRAP["store"]
+
+
 def init_parallel_env(strategy=None) -> Optional[Group]:
     """distributed.init_parallel_env (parallel.py:943 analog). Builds the
-    world group over all visible devices (ICI-connected on a TPU slice)."""
+    world group over all visible devices (ICI-connected on a TPU slice);
+    multi-host jobs additionally bootstrap the PJRT distributed runtime and
+    the TCPStore control plane from the launcher's env."""
     if _WORLD[0] is None:
+        _maybe_init_multihost()
         n = len(jax.devices())
         mesh = ProcessMesh(np.arange(n), ["world"])
         _WORLD[0] = Group(list(range(n)), mesh, "world")

@@ -50,6 +50,10 @@ class LlamaConfig:
     # axis (sequence sharded; exact global attention via ICI ppermute)
     sep_mesh: Optional[object] = None
     sep_axis: str = "sep"
+    # activation recompute: re-run each decoder layer's forward in the
+    # backward instead of keeping its residuals (fleet/recompute analog —
+    # trades ~30% step FLOPs for O(layers) less activation HBM)
+    use_recompute: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -216,8 +220,16 @@ class LlamaModel(Layer):
         _, s = input_ids.shape
         hidden = self.embed_tokens(input_ids)
         cos, sin = self._cos[:s], self._sin[:s]
-        for layer in self.layers:
-            hidden = layer(hidden, cos, sin, attn_mask)
+        if self.config.use_recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+            for layer in self.layers:
+                trainable = any(not p.stop_gradient
+                                for p in layer.parameters())
+                hidden = recompute(layer, hidden, cos, sin, attn_mask,
+                                   _trainable_hint=trainable)
+        else:
+            for layer in self.layers:
+                hidden = layer(hidden, cos, sin, attn_mask)
         return self.norm(hidden)
 
 

@@ -351,3 +351,13 @@ def test_stage2_grad_sharding_consumed():
     assert losses[-1] < losses[0]
     gs = opt._group_sharded
     assert gs.grad_sharding((64, 8)) is not None  # policy active for div dims
+
+
+def test_init_parallel_env_multihost_env_gating(monkeypatch):
+    """Single-process: multi-host bootstrap must not trigger; with the
+    launcher env set but nnodes=1 it stays inert too."""
+    from paddle_tpu.distributed import collective as C
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:29999")
+    C._maybe_init_multihost()
+    assert C.get_bootstrap_store() is None

@@ -102,3 +102,30 @@ def test_resnet_backward():
     opt.step()
     opt.clear_grad()
     assert np.isfinite(float(loss.numpy()))
+
+
+def test_llama_recompute_matches_baseline_trajectory():
+    """use_recompute=True re-runs decoder layers in backward; the training
+    trajectory through the compiled TrainStep must match exactly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+    def run(use_rc):
+        paddle.seed(0)
+        cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                                num_attention_heads=4, num_key_value_heads=2,
+                                vocab_size=128, max_position_embeddings=64,
+                                use_recompute=use_rc)
+        m = LlamaForCausalLM(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        step = jit.TrainStep(lambda i, l: m(i, labels=l)[1], opt)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 128, (2, 16)))
+        lbl = paddle.to_tensor(rng.randint(0, 128, (2, 16)))
+        return [float(step(ids, lbl)) for _ in range(3)]
+
+    base = run(False)
+    rc = run(True)
+    assert all(abs(a - b) < 2e-3 for a, b in zip(base, rc)), (base, rc)
