@@ -103,13 +103,13 @@ def _maybe_init_multihost():
     if ":" not in coord:
         raise ValueError(f"PADDLE_MASTER must be host:port, got {coord!r}")
     host, port = coord.rsplit(":", 1)
+    coord_addr = os.environ.get("JAX_COORDINATOR_ADDRESS",
+                                f"{host}:{int(port) + 1}")
     try:
-        if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-            jax.distributed.initialize()  # picks up the JAX_* env triple
-        else:
-            jax.distributed.initialize(
-                coordinator_address=f"{host}:{int(port) + 1}",
-                num_processes=nnodes, process_id=rank)
+        # num_processes/process_id must be explicit: jax only reads the
+        # coordinator address from env, not the process counts
+        jax.distributed.initialize(coordinator_address=coord_addr,
+                                   num_processes=nnodes, process_id=rank)
     except RuntimeError as e:
         if "already" not in str(e).lower():
             raise  # real failure: do NOT proceed as N separate jobs

@@ -8,14 +8,18 @@ from ..optimizer.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
                               ClipGradByValue)
 from .layer import (Layer, LayerDict, LayerList, ParamAttr, ParameterList,
                     Sequential)
-from .common import (CosineSimilarity, Dropout, Dropout2D, Embedding, Flatten,
-                     Identity, Linear, Pad2D, PixelShuffle, Unfold, Upsample)
+from .common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
+                     Dropout2D, Embedding, Flatten, Fold, Identity, Linear,
+                     Pad2D, PairwiseDistance, PixelShuffle, Unfold, Upsample,
+                     ZeroPad2D)
 from .conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
                    InstanceNorm2D, LayerNorm, LocalResponseNorm, RMSNorm,
                    SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
                       AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
+from .rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNNCellBase,
+                  SimpleRNN, SimpleRNNCell)
 from .activation_layers import (CELU, ELU, GELU, Hardshrink, Hardsigmoid,
                                 Hardswish, Hardtanh, LeakyReLU, LogSigmoid,
                                 LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,

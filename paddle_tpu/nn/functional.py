@@ -837,3 +837,68 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
                              x[:, :-1, fold:2 * fold]], axis=1)
     rest = x[:, :, 2 * fold:]
     return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+@defop()
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """Inverse of unfold: [N, C*kh*kw, L] -> [N, C, H, W] with overlap-add."""
+    oh_img, ow_img = _tuple(output_sizes, 2)
+    kh, kw = _tuple(kernel_sizes, 2)
+    sh, sw = _tuple(strides, 2)
+    dh, dw = _tuple(dilations, 2)
+    ph, pw = _tuple(paddings, 2)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    oh = (oh_img + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (ow_img + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    patches = x.reshape(n, c, kh * kw, oh, ow)
+    out = jnp.zeros((n, c, oh_img + 2 * ph, ow_img + 2 * pw), x.dtype)
+    idx = 0
+    for i in range(kh):
+        for j in range(kw):
+            rows = i * dh + jnp.arange(oh) * sh
+            cols = j * dw + jnp.arange(ow) * sw
+            out = out.at[:, :, rows[:, None], cols[None, :]].add(
+                patches[:, :, idx])
+            idx += 1
+    return out[:, :, ph:ph + oh_img, pw:pw + ow_img]
+
+
+@defop()
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = jnp.abs(x - y) + epsilon
+    if p == float("inf"):
+        return jnp.max(d, axis=-1, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(d, p), axis=-1, keepdims=keepdim),
+                     1.0 / p)
+
+
+@defop()
+def bilinear(x1, x2, weight, bias=None):
+    """out[b, o] = x1[b, :] W[o] x2[b, :] (+ bias); W: [out, in1, in2]."""
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop(name="alpha_dropout_op")
+def _alpha_dropout(x, key, p):
+    """SELU-preserving dropout (nn/functional/common.py alpha_dropout)."""
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    # variance-preserving affine (reference formula): for unit-variance
+    # input the output stays unit-variance
+    a = (keep * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    return a * jnp.where(mask, x, alpha_p) + b
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    from ..core import random as random_mod
+    return _alpha_dropout(x, random_mod.next_key(), p)

@@ -1,0 +1,408 @@
+"""Recurrent layers.
+
+Reference: python/paddle/nn/layer/rnn.py — SimpleRNNCell/LSTMCell/GRUCell,
+the RNN/BiRNN sequence wrappers, and the multi-layer SimpleRNN/LSTM/GRU
+(cudnn-backed kernels in phi/kernels/gpu/rnn_kernel.cu).
+
+TPU-native: a cell step is a couple of MXU matmuls + VPU gates; the time
+loop is ONE ``lax.scan`` inside a single dispatched op, so the whole
+unrolled sequence (and its backward) compiles into one XLA while-loop —
+the cudnn-fused-RNN role. Gate conventions follow the reference:
+LSTM gates ordered (i, f, g, o); GRU ordered (u, r, c) with
+``h = u * h_prev + (1 - u) * c``.
+
+Sequence lengths: padded steps beyond each sample's length carry the last
+valid state forward and zero the output (reference mask semantics).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.registry import dispatch
+from . import initializer as I
+from .layer import Layer
+
+
+def _uniform_attr(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+def _affine(x, w, b):
+    """x @ w.T (+ b when the bias exists — bias attrs may be False)."""
+    out = x @ w.T
+    return out if b is None else out + b
+
+
+class RNNCellBase(Layer):
+    """rnn.py RNNCellBase analog."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ..core.tensor import Tensor
+        batch = batch_ref.shape[batch_dim_idx]
+        n = self.hidden_size
+        mk = lambda: Tensor(jnp.full((batch, n), init_value,  # noqa: E731
+                                     dtype=jnp.float32))
+        if getattr(self, "state_shape", None) and len(self.state_shape) == 2:
+            return (mk(), mk())
+        return mk()
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(x W_ih^T + b_ih + h W_hh^T + b_hh)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        u = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _step(self, x, h, wih, whh, bih, bhh):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        return act(_affine(x, wih, bih) + _affine(h, whh, bhh))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _impl(x, h, wih, whh, bih, bhh):
+            h2 = self._step(x, h, wih, whh, bih, bhh)
+            return h2, h2
+
+        out, h = dispatch(_impl, (inputs, states, self.weight_ih,
+                                  self.weight_hh, self.bias_ih,
+                                  self.bias_hh), {}, op_name="rnn_cell")
+        return out, h
+
+
+class LSTMCell(RNNCellBase):
+    """Gates (i, f, g, o); returns (h, (h, c))."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        u = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def _step(self, x, h, c, wih, whh, bih, bhh):
+        gates = _affine(x, wih, bih) + _affine(h, whh, bhh)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return h2, c2
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+
+        def _impl(x, h, c, wih, whh, bih, bhh):
+            h2, c2 = self._step(x, h, c, wih, whh, bih, bhh)
+            return h2, h2, c2
+
+        out, h, c = dispatch(_impl, (inputs, h0, c0, self.weight_ih,
+                                     self.weight_hh, self.bias_ih,
+                                     self.bias_hh), {}, op_name="lstm_cell")
+        return out, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    """Gates (u, r, c): h' = u * h + (1 - u) * c~ (reference convention)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        u = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _step(self, x, h, wih, whh, bih, bhh):
+        xu, xr, xc = jnp.split(_affine(x, wih, bih), 3, axis=-1)
+        hu, hr, hc = jnp.split(_affine(h, whh, bhh), 3, axis=-1)
+        u = jax.nn.sigmoid(xu + hu)
+        r = jax.nn.sigmoid(xr + hr)
+        c = jnp.tanh(xc + r * hc)
+        return u * h + (1.0 - u) * c
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _impl(x, h, wih, whh, bih, bhh):
+            h2 = self._step(x, h, wih, whh, bih, bhh)
+            return h2, h2
+
+        out, h = dispatch(_impl, (inputs, states, self.weight_ih,
+                                  self.weight_hh, self.bias_ih,
+                                  self.bias_hh), {}, op_name="gru_cell")
+        return out, h
+
+
+def _scan_cell(cell, x_arr, init_states, weights, seq_lens, is_reverse):
+    """One lax.scan over time for any cell (pure; runs inside dispatch).
+
+    x_arr: [B, T, I]; init_states: tuple of [B, H]; weights: flat tuple.
+    Returns (outputs [B, T, H], final_states tuple).
+    """
+    T = x_arr.shape[1]
+    xs = jnp.moveaxis(x_arr, 1, 0)                    # [T, B, I]
+    if is_reverse:
+        xs = xs[::-1]
+
+    def step(states, inp):
+        x_t, t_idx = inp
+        if len(init_states) == 2:
+            h2, c2 = cell._step(x_t, states[0], states[1], *weights)
+            new = (h2, c2)
+        else:
+            h2 = cell._step(x_t, states[0], *weights)
+            new = (h2,)
+        if seq_lens is not None:
+            # time index in ORIGINAL order for this step
+            real_t = (T - 1 - t_idx) if is_reverse else t_idx
+            valid = (real_t < seq_lens)[:, None]
+            new = tuple(jnp.where(valid, n, s)
+                        for n, s in zip(new, states))
+            out_t = jnp.where(valid, new[0], 0.0)
+        else:
+            out_t = new[0]
+        return new, out_t
+
+    final, outs = jax.lax.scan(step, tuple(init_states),
+                               (xs, jnp.arange(T)))
+    if is_reverse:
+        outs = outs[::-1]
+    return jnp.moveaxis(outs, 0, 1), final
+
+
+class RNN(Layer):
+    """rnn.py RNN analog: wraps a cell over the time axis."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            ref = inputs.transpose([1, 0, 2]) if self.time_major else inputs
+            initial_states = self.cell.get_initial_states(ref)
+        states = (initial_states if isinstance(initial_states, (tuple, list))
+                  else (initial_states,))
+        cell = self.cell
+        weights = (cell.weight_ih, cell.weight_hh, cell.bias_ih,
+                   cell.bias_hh)
+        time_major = self.time_major
+        is_reverse = self.is_reverse
+        n_states = len(states)
+
+        def _impl(x, *rest):
+            st = rest[:n_states]
+            ws = rest[n_states:n_states + 4]
+            lens = rest[n_states + 4] if sequence_length is not None else None
+            if time_major:
+                x = jnp.moveaxis(x, 0, 1)
+            outs, final = _scan_cell(cell, x, st, ws, lens, is_reverse)
+            if time_major:
+                outs = jnp.moveaxis(outs, 1, 0)
+            return (outs,) + final
+
+        args = (inputs,) + tuple(states) + weights
+        if sequence_length is not None:
+            args = args + (sequence_length,)
+        res = dispatch(_impl, args, {}, op_name="rnn_scan")
+        outs = res[0]
+        final = tuple(res[1:])
+        return outs, (final if n_states == 2 else final[0])
+
+
+class BiRNN(Layer):
+    """rnn.py BiRNN analog: concatenated fw/bw outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        from ..ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) stack of scan-RNNs."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unsupported direction {direction}")
+
+        kwargs = dict(weight_ih_attr=weight_ih_attr,
+                      weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        if activation is not None:
+            kwargs["activation"] = activation
+
+        self._layers_list = []
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else hidden_size * \
+                self.num_directions
+            if bidirect:
+                wrap = BiRNN(self.CELL(in_sz, hidden_size, **kwargs),
+                             self.CELL(in_sz, hidden_size, **kwargs),
+                             time_major=time_major)
+            else:
+                wrap = RNN(self.CELL(in_sz, hidden_size, **kwargs),
+                           time_major=time_major)
+            self.add_sublayer(f"{i}", wrap)
+            self._layers_list.append(wrap)
+
+    def _slice_states(self, initial_states, layer_idx):
+        """Paddle layout: h0 (and c0 for LSTM) are stacked
+        [num_layers * num_directions, B, H]; slice this layer's share."""
+        if initial_states is None:
+            return None
+        is_lstm = isinstance(initial_states, (tuple, list))
+        D = self.num_directions
+        lo = layer_idx * D
+
+        def pick(t, i):
+            return t[lo + i]
+
+        if D == 1:
+            if is_lstm:
+                h0, c0 = initial_states
+                return (pick(h0, 0), pick(c0, 0))
+            return pick(initial_states, 0)
+        if is_lstm:
+            h0, c0 = initial_states
+            return ((pick(h0, 0), pick(c0, 0)), (pick(h0, 1), pick(c0, 1)))
+        return (pick(initial_states, 0), pick(initial_states, 1))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..nn import functional as F
+        from ..ops.manipulation import stack
+        out = inputs
+        h_parts = []
+        c_parts = []
+        for i, layer in enumerate(self._layers_list):
+            out, fin = layer(out, self._slice_states(initial_states, i),
+                             sequence_length)
+            # normalize this layer's finals to lists of per-direction states
+            dirs = fin if self.num_directions == 2 else (fin,)
+            for d in dirs:
+                if isinstance(d, (tuple, list)):  # LSTM (h, c)
+                    h_parts.append(d[0])
+                    c_parts.append(d[1])
+                else:
+                    h_parts.append(d)
+            if self.dropout and i < self.num_layers - 1 and self.training:
+                out = F.dropout(out, p=self.dropout, training=True)
+        h_n = stack(h_parts, axis=0)  # [L * D, B, H] (reference layout)
+        if c_parts:
+            return out, (h_n, stack(c_parts, axis=0))
+        return out, h_n
+
+
+class SimpleRNN(_RNNBase):
+    """nn.SimpleRNN analog."""
+    CELL = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    """nn.LSTM analog."""
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    """nn.GRU analog."""
+    CELL = GRUCell
+
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]

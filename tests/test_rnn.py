@@ -1,0 +1,245 @@
+"""Tests for the recurrent layer family (nn/layer/rnn.py analog):
+cells, RNN/BiRNN wrappers, multi-layer SimpleRNN/LSTM/GRU."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _x(b=3, t=5, i=4, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(b, t, i).astype(np.float32))
+
+
+# -- cells ---------------------------------------------------------------------
+
+def test_simple_rnn_cell_matches_numpy():
+    cell = nn.SimpleRNNCell(4, 6)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 4)
+                         .astype(np.float32))
+    h = paddle.to_tensor(np.random.RandomState(2).randn(2, 6)
+                         .astype(np.float32))
+    out, h2 = cell(x, h)
+    expect = np.tanh(_np(x) @ _np(cell.weight_ih).T + _np(cell.bias_ih)
+                     + _np(h) @ _np(cell.weight_hh).T + _np(cell.bias_hh))
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-5, atol=1e-6)
+    assert out is h2 or np.allclose(_np(out), _np(h2))
+
+
+def test_lstm_cell_gate_math():
+    cell = nn.LSTMCell(4, 6)
+    x = paddle.to_tensor(np.random.RandomState(3).randn(2, 4)
+                         .astype(np.float32))
+    h0 = np.random.RandomState(4).randn(2, 6).astype(np.float32)
+    c0 = np.random.RandomState(5).randn(2, 6).astype(np.float32)
+    out, (h, c) = cell(x, (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    gates = (_np(x) @ _np(cell.weight_ih).T + _np(cell.bias_ih)
+             + h0 @ _np(cell.weight_hh).T + _np(cell.bias_hh))
+    i, f, g, o = np.split(gates, 4, axis=-1)
+    c_ref = sig(f) * c0 + sig(i) * np.tanh(g)
+    h_ref = sig(o) * np.tanh(c_ref)
+    np.testing.assert_allclose(_np(c), c_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(h), h_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_cell_interpolates_state():
+    cell = nn.GRUCell(3, 5)
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    h0 = paddle.to_tensor(np.random.RandomState(0).randn(2, 5)
+                          .astype(np.float32))
+    _, h = cell(x, h0)
+    # h' = u*h + (1-u)*c is a convex combination: bounded by [min, max] of
+    # (h0, c) with c in (-1, 1)
+    assert np.all(np.abs(_np(h)) <= np.maximum(np.abs(_np(h0)), 1.0) + 1e-6)
+
+
+# -- RNN wrapper ---------------------------------------------------------------
+
+def test_rnn_unrolls_cell():
+    cell = nn.SimpleRNNCell(4, 6)
+    rnn = nn.RNN(cell)
+    x = _x()
+    out, h = rnn(x)
+    assert tuple(out.shape) == (3, 5, 6)
+    assert tuple(h.shape) == (3, 6)
+    # manual unroll must match
+    hh = paddle.to_tensor(np.zeros((3, 6), np.float32))
+    for t in range(5):
+        _, hh = cell(x[:, t], hh)
+    np.testing.assert_allclose(_np(h), _np(hh), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(out)[:, -1], _np(hh), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_rnn_reverse_and_time_major():
+    cell = nn.SimpleRNNCell(4, 6)
+    fw = nn.RNN(cell)
+    bw = nn.RNN(cell, is_reverse=True)
+    x = _x()
+    x_rev = paddle.to_tensor(np.asarray(x._data)[:, ::-1].copy())
+    out_bw, _ = bw(x)
+    out_fw_on_rev, _ = fw(x_rev)
+    np.testing.assert_allclose(_np(out_bw), _np(out_fw_on_rev)[:, ::-1],
+                               rtol=1e-4, atol=1e-5)
+
+    tm = nn.RNN(cell, time_major=True)
+    out_tm, _ = tm(paddle.to_tensor(np.moveaxis(np.asarray(x._data), 1, 0)))
+    out_ref, _ = fw(x)
+    np.testing.assert_allclose(np.moveaxis(_np(out_tm), 0, 1), _np(out_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_sequence_length_masks():
+    cell = nn.SimpleRNNCell(2, 3)
+    rnn = nn.RNN(cell)
+    x = _x(b=2, t=4, i=2)
+    lens = paddle.to_tensor(np.array([4, 2]))
+    out, h = rnn(x, sequence_length=lens)
+    # sample 1: outputs at t>=2 are zero, final state = state at t=1
+    np.testing.assert_allclose(_np(out)[1, 2:], 0.0)
+    out_full, _ = rnn(x)
+    np.testing.assert_allclose(_np(h)[1], _np(out_full)[1, 1], rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(_np(h)[0], _np(out_full)[0, 3], rtol=1e-4,
+                               atol=1e-5)
+
+
+# -- multi-layer nets ----------------------------------------------------------
+
+def test_lstm_shapes_and_training():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = _x()
+    out, (h_n, c_n) = lstm(x)
+    assert tuple(out.shape) == (3, 5, 8)
+    # stacked reference layout: [num_layers * num_directions, B, H]
+    assert tuple(h_n.shape) == (2, 3, 8)
+    assert tuple(c_n.shape) == (2, 3, 8)
+    # last layer's final h equals the last output step
+    np.testing.assert_allclose(_np(h_n)[-1], _np(out)[:, -1], rtol=1e-4,
+                               atol=1e-5)
+
+    opt = optimizer.Adam(learning_rate=0.01, parameters=lstm.parameters())
+    tgt = paddle.to_tensor(np.random.RandomState(9).randn(3, 8)
+                           .astype(np.float32))
+    losses = []
+    for _ in range(6):
+        out, _ = lstm(x)
+        loss = ((out[:, -1] - tgt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_gru_bidirectional():
+    gru = nn.GRU(4, 8, num_layers=1, direction="bidirect")
+    x = _x()
+    out, h_n = gru(x)
+    assert tuple(out.shape) == (3, 5, 16)  # fw + bw concat
+    assert tuple(h_n.shape) == (2, 3, 8)   # [L * D, B, H]
+
+
+def test_lstm_accepts_stacked_initial_states():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = _x()
+    h0 = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 8)
+                          .astype(np.float32))
+    c0 = paddle.to_tensor(np.random.RandomState(1).randn(2, 3, 8)
+                          .astype(np.float32))
+    out, (h_n, c_n) = lstm(x, (h0, c0))
+    assert tuple(h_n.shape) == (2, 3, 8)
+    # nonzero initial state must change the outcome vs zero init
+    out0, _ = lstm(x)
+    assert not np.allclose(_np(out), _np(out0))
+
+
+def test_rnn_cell_without_biases():
+    cell = nn.SimpleRNNCell(4, 6, bias_ih_attr=False, bias_hh_attr=False)
+    assert cell.bias_ih is None
+    out, _ = cell(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert tuple(out.shape) == (2, 6)
+    lstm_cell = nn.LSTMCell(4, 6, bias_ih_attr=False, bias_hh_attr=False)
+    out2, (h, c) = lstm_cell(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert tuple(h.shape) == (2, 6)
+
+
+def test_simple_rnn_relu_activation():
+    rnn = nn.SimpleRNN(4, 8, activation="relu")
+    out, _ = rnn(_x())
+    assert tuple(out.shape) == (3, 5, 8)
+
+
+def test_rnn_in_compiled_trainstep():
+    from paddle_tpu import jit
+    lstm = nn.LSTM(4, 8)
+    opt = optimizer.Adam(learning_rate=0.01, parameters=lstm.parameters())
+
+    def loss_fn(x, y):
+        out, _ = lstm(x)
+        return ((out[:, -1] - y) ** 2).mean()
+
+    step = jit.TrainStep(loss_fn, opt)
+    x = _x()
+    y = paddle.to_tensor(np.zeros((3, 8), np.float32))
+    l0 = float(step(x, y))
+    l1 = float(step(x, y))  # compiled pass (scan inside one executable)
+    l2 = float(step(x, y))
+    assert l2 < l0 and np.isfinite(l1)
+
+
+# -- new misc layers -----------------------------------------------------------
+
+def test_fold_inverts_unfold_with_overlap():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 2, 6, 6)
+                         .astype(np.float32))
+    unf = nn.Unfold(kernel_sizes=2, strides=2)
+    folded = nn.Fold(output_sizes=(6, 6), kernel_sizes=2, strides=2)
+    # non-overlapping stride=kernel: fold(unfold(x)) == x exactly
+    np.testing.assert_allclose(_np(folded(unf(x))), _np(x), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_zeropad2d_and_pairwise_distance():
+    x = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+    padded = nn.ZeroPad2D([1, 1, 1, 1])(x)
+    assert tuple(padded.shape) == (1, 1, 4, 4)
+    assert float(padded[0, 0, 0, 0]) == 0.0
+
+    a = paddle.to_tensor(np.array([[0.0, 0.0]], np.float32))
+    b = paddle.to_tensor(np.array([[3.0, 4.0]], np.float32))
+    d = nn.PairwiseDistance()(a, b)
+    assert float(d) == pytest.approx(5.0, rel=1e-4)
+
+
+def test_bilinear_and_alpha_dropout():
+    bl = nn.Bilinear(3, 4, 2)
+    x1 = paddle.to_tensor(np.random.RandomState(0).randn(5, 3)
+                          .astype(np.float32))
+    x2 = paddle.to_tensor(np.random.RandomState(1).randn(5, 4)
+                          .astype(np.float32))
+    out = bl(x1, x2)
+    assert tuple(out.shape) == (5, 2)
+    ref = np.einsum("bi,oij,bj->bo", _np(x1), _np(bl.weight), _np(x2)) \
+        + _np(bl.bias)
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-5)
+
+    ad = nn.AlphaDropout(p=0.3)
+    ad.train()
+    big = paddle.to_tensor(np.random.RandomState(2).randn(10000)
+                           .astype(np.float32))
+    out = ad(big)
+    # mean/std approximately preserved (the point of alpha dropout)
+    assert abs(float(out.mean()) - float(big.mean())) < 0.1
+    assert abs(float(out.std()) - float(big.std())) < 0.15
+    ad.eval()
+    np.testing.assert_allclose(_np(ad(big)), _np(big))
