@@ -1,2 +1,16 @@
-"""paddle.incubate.nn analog (fused layers land here as Pallas/XLA ops)."""
+"""paddle.incubate.nn analog (fused layers land here as Pallas/XLA ops).
+
+Reference: python/paddle/incubate/nn/__init__.py exports the fused layer
+zoo; memory_efficient_attention lives beside it.
+"""
 from . import functional
+from .layer import (FusedBiasDropoutResidualLayerNorm, FusedDropout,
+                    FusedDropoutAdd, FusedEcMoe, FusedFeedForward,
+                    FusedLinear, FusedMultiHeadAttention,
+                    FusedMultiTransformer, FusedTransformerEncoderLayer)
+from .memory_efficient_attention import memory_efficient_attention
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedLinear", "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe",
+           "FusedDropoutAdd", "FusedDropout", "memory_efficient_attention"]

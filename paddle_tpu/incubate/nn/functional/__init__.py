@@ -8,6 +8,17 @@ Pallas kernels; ring attention fills the reference's context-parallel gap
 from paddle_tpu.nn.functional import flash_attention
 from paddle_tpu.ops.ring_attention import ring_attention
 
+from .decode_attention import (block_multihead_attention,
+                               masked_multihead_attention,
+                               variable_length_memory_efficient_attention)
+from .fused_ops import (fused_dot_product_attention, fused_dropout_add,
+                        fused_ec_moe, fused_layer_norm, fused_linear,
+                        fused_linear_activation, fused_matmul_bias,
+                        fused_rms_norm)
+from .fused_transformer import (fused_bias_dropout_residual_layer_norm,
+                                fused_feedforward, fused_multi_head_attention,
+                                fused_multi_transformer)
+
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None,
@@ -104,4 +115,11 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
 
 __all__ = ["flash_attention", "ring_attention",
-           "fused_rotary_position_embedding"]
+           "fused_rotary_position_embedding",
+           "fused_feedforward", "fused_bias_dropout_residual_layer_norm",
+           "fused_multi_head_attention", "fused_multi_transformer",
+           "fused_dropout_add", "fused_matmul_bias", "fused_linear",
+           "fused_linear_activation", "fused_layer_norm", "fused_rms_norm",
+           "fused_dot_product_attention", "fused_ec_moe",
+           "masked_multihead_attention", "block_multihead_attention",
+           "variable_length_memory_efficient_attention"]

@@ -1,0 +1,36 @@
+"""Shared raw-array helpers for the fused functional impls.
+
+One home for the fp32-accumulating LayerNorm and the bernoulli-mask dropout
+used by the dispatched bodies in fused_transformer.py and fused_ops.py —
+the Tensor-level versions live in nn/functional.py; these operate on jnp
+arrays inside dispatch() impls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm_arr(x, scale, bias, eps):
+    """LayerNorm over the last dim with optional affine params (fp32 math)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def dropout_arr(x, rate, training, mode, key):
+    """Reference dropout semantics: upscale_in_train scales kept values by
+    1/keep at train time; downscale_in_infer scales by keep at eval time."""
+    if not training or rate == 0.0:
+        return x if mode == "upscale_in_train" else x * (1.0 - rate)
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)

@@ -1,0 +1,256 @@
+"""Inference-serving attention functionals.
+
+Reference surface:
+- masked_multihead_attention
+  (python/paddle/incubate/nn/functional/masked_multihead_attention.py:19,
+   CUDA kernel phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu)
+- block_multihead_attention (paged KV cache)
+  (python/paddle/incubate/nn/functional/block_multihead_attention.py:19)
+- variable_length_memory_efficient_attention
+  (python/paddle/incubate/nn/functional/
+   variable_length_memory_efficient_attention.py:28)
+
+TPU design: these are the serving-side attention kernels. The paged-cache
+read is a gather over the block table (jnp.take lowers to an XLA gather
+that rides HBM efficiently); cache writes are scatters at static positions
+per decode step. Quantized-cache args (qkv_out_scale, cache_k_quant_scales,
+...) are gated — the quantization tier on TPU lives in paddle_tpu.quantization.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+
+def _arr(x):
+    if x is None:
+        return None
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+_NEG = -1e9
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """One-token decode attention over a dense KV cache.
+
+    x: [B, 3*H*D] (this step's fused qkv). cache_kv: [2, B, H, S_max, D].
+    sequence_lengths: [B, 1] current lengths (timestep per sequence);
+    defaults to 0 (first step). Returns (out [B, H*D], cache_kv_out).
+    """
+    if qkv_out_scale is not None or out_scale != -1:
+        raise NotImplementedError(
+            "quantized decode path: use paddle_tpu.quantization")
+    xq = _arr(x)
+    cache = _arr(cache_kv)
+    if cache is None:
+        raise ValueError("cache_kv is required")
+    _, bsz, nh, s_max, hd = cache.shape
+    qkv = xq.reshape(bsz, 3, nh, hd)
+    if bias is not None:
+        qkv = qkv + _arr(bias)[None]
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, H, D]
+
+    if sequence_lengths is not None:
+        t = _arr(sequence_lengths).reshape(bsz).astype(jnp.int32)
+    else:
+        t = jnp.zeros((bsz,), jnp.int32)
+
+    if rotary_tensor is not None and rotary_emb_dims > 0:
+        # rotary_tensor [B, 1, 1, S, D]: cos/sin interleaved table; apply to
+        # q and k at position t (reference decode rope)
+        rot = _arr(rotary_tensor)[:, 0, 0]              # [B, S, D]
+        rt = jnp.take_along_axis(rot, t[:, None, None], axis=1)[:, 0]  # [B,D]
+        cos, sin = rt[..., 0::2], rt[..., 1::2]
+
+        def _rope(u):
+            u1, u2 = u[..., 0::2], u[..., 1::2]
+            c, s = cos[:, None, :], sin[:, None, :]
+            return jnp.stack([u1 * c - u2 * s, u2 * c + u1 * s],
+                             axis=-1).reshape(u.shape)
+        q, k = _rope(q), _rope(k)
+
+    # scatter this step's k/v at row t of each sequence
+    b_idx = jnp.arange(bsz)
+    ck = cache[0].at[b_idx, :, t].set(k)
+    cv = cache[1].at[b_idx, :, t].set(v)
+    new_cache = jnp.stack([ck, cv])
+
+    scores = jnp.einsum("bhd,bhsd->bhs", q, ck) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(q.dtype)
+    pos = jnp.arange(s_max)[None, None, :]
+    scores = jnp.where(pos <= t[:, None, None], scores,
+                       jnp.asarray(_NEG, scores.dtype))
+    if src_mask is not None:
+        m = _arr(src_mask)[:, 0, 0]                     # [B, S_mask]
+        s_mask = m.shape[-1]
+        scores = scores.at[:, :, :s_mask].add(m[:, None, :].astype(scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhs,bhsd->bhd", probs.astype(q.dtype), cv)
+    return Tensor(out.reshape(bsz, nh * hd)), Tensor(new_cache)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets, cum_offsets, cu_seqlens_q,
+                              cu_seqlens_k, block_tables, pre_key_cache=None,
+                              pre_value_cache=None, cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None, qkv_out_scale=None,
+                              qkv_bias=None, out_shift=None, out_smooth=None,
+                              rope_emb=None, mask=None, tgt_mask=None,
+                              max_seq_len=-1, block_size=64,
+                              use_neox_style=False,
+                              use_dynamic_cachekv_quant=False,
+                              quant_round_type=1, quant_max_bound=127.0,
+                              quant_min_bound=-127.0, out_scale=-1,
+                              compute_dtype="default"):
+    """Paged-KV attention (vLLM-style block cache; ref
+    block_multihead_attention.py:19).
+
+    qkv: [token_num, 3*H*D] packed unpadded tokens (sequences concatenated,
+    boundaries in cu_seqlens_q). key_cache/value_cache:
+    [max_block_num, H, block_size, D]. block_tables: [B, blocks_per_seq]
+    maps sequence-local block index -> physical cache block. Per sequence,
+    mode is prefill when seq_lens_encoder[i] > 0 (writes the whole prompt
+    into its blocks, causal attention over it) or decode when
+    seq_lens_this_time[i] == 1 (appends at seq_lens_decoder[i], attends to
+    the full prefix through the block table).
+
+    Returns (out [token_num, H*D], qkv, key_cache_out, value_cache_out).
+    """
+    if qkv_out_scale is not None or out_scale != -1 \
+            or cache_k_quant_scales is not None:
+        raise NotImplementedError(
+            "quantized cache path: use paddle_tpu.quantization")
+    qkv_a = _arr(qkv)
+    kc, vc = _arr(key_cache), _arr(value_cache)
+    enc = _arr(seq_lens_encoder).reshape(-1).astype(jnp.int32)
+    dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
+    this = _arr(seq_lens_this_time).reshape(-1).astype(jnp.int32)
+    cu_q = _arr(cu_seqlens_q).reshape(-1).astype(jnp.int32)
+    bt = _arr(block_tables).astype(jnp.int32)
+    bsz, blocks_per_seq = bt.shape
+    nh, bs_, hd = kc.shape[1], kc.shape[2], kc.shape[3]
+    token_num = qkv_a.shape[0]
+
+    qkv3 = qkv_a.reshape(token_num, 3, nh, hd)
+    if qkv_bias is not None:
+        qkv3 = qkv3 + _arr(qkv_bias).reshape(1, 3, nh, hd)
+    qt, kt, vt = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]    # [T, H, D]
+
+    # token -> (sequence, position-in-kv-timeline)
+    tok = jnp.arange(token_num)
+    seq_of = jnp.searchsorted(cu_q, tok, side="right") - 1     # [T]
+    local = tok - cu_q[seq_of]                                 # pos in this call
+    start = dec[seq_of]          # decode appends after the existing prefix
+    pos = start + local                                        # kv row
+    if rope_emb is not None:
+        # rope_emb [2, B, 1, S, D/...]: cos at [0], sin at [1]
+        re = _arr(rope_emb)
+        cos_t = re[0][seq_of, 0, pos]                          # [T, Dr]
+        sin_t = re[1][seq_of, 0, pos]
+
+        def _rope(u):
+            if use_neox_style:
+                d2 = u.shape[-1] // 2
+                u1, u2 = u[..., :d2], u[..., d2:]
+                c = cos_t[:, None, :d2]
+                s = sin_t[:, None, :d2]
+                return jnp.concatenate([u1 * c - u2 * s, u2 * c + u1 * s],
+                                       axis=-1).astype(u.dtype)
+            u1, u2 = u[..., 0::2], u[..., 1::2]
+            c = cos_t[:, None, 0::2]
+            s = sin_t[:, None, 0::2]
+            return jnp.stack([u1 * c - u2 * s, u2 * c + u1 * s],
+                             axis=-1).reshape(u.shape).astype(u.dtype)
+        qt, kt = _rope(qt), _rope(kt)
+
+    # scatter k/v into the paged cache at (block_tables[seq, pos//bs], pos%bs)
+    phys = bt[seq_of, pos // bs_]                              # [T]
+    off = pos % bs_
+    kc = kc.at[phys, :, off].set(kt)
+    vc = vc.at[phys, :, off].set(vt)
+
+    # gather each sequence's full kv timeline [B, H, S_kv, D]
+    kv_len = jnp.where(enc > 0, enc, dec + this)               # [B]
+    s_kv = blocks_per_seq * bs_
+    gk = kc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, nh, bs_, hd)
+    gv = vc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, nh, bs_, hd)
+    gk = jnp.moveaxis(gk, 2, 1).reshape(bsz, nh, s_kv, hd)
+    gv = jnp.moveaxis(gv, 2, 1).reshape(bsz, nh, s_kv, hd)
+
+    # dense scores per token over its sequence's timeline
+    scores = jnp.einsum("thd,tshd->ths", qt,
+                        jnp.moveaxis(gk[seq_of], 1, 2)) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(qt.dtype)
+    kv_pos = jnp.arange(s_kv)[None, None, :]
+    causal_ok = kv_pos <= pos[:, None, None]
+    in_len = kv_pos < kv_len[seq_of][:, None, None]
+    scores = jnp.where(causal_ok & in_len, scores,
+                       jnp.asarray(_NEG, scores.dtype))
+    # caller-supplied additive masks: `mask` [B, 1, S_q, S_k] indexed by each
+    # token's (sequence, local query row); `tgt_mask` [B, 1, 1, S_k] for the
+    # decode step
+    for m in (mask, tgt_mask):
+        if m is None:
+            continue
+        m_a = _arr(m)
+        rows = (m_a[seq_of, 0, jnp.minimum(local, m_a.shape[2] - 1)]
+                .astype(scores.dtype))                       # [T, S_mask]
+        s_m = min(rows.shape[-1], s_kv)
+        scores = scores.at[:, :, :s_m].add(rows[:, None, :s_m])
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("ths,tshd->thd", probs.astype(qt.dtype),
+                     jnp.moveaxis(gv[seq_of], 1, 2))
+    return (Tensor(out.reshape(token_num, nh * hd)), Tensor(qkv_a),
+            Tensor(kc), Tensor(vc))
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """Variable-length attention with per-sequence lengths (ref
+    variable_length_memory_efficient_attention.py:28; CUTLASS kernel on
+    GPU — here one masked sdpa that XLA/Pallas fuses).
+
+    query/key/value: [B, H, S, D]; seq_lens/kv_seq_lens: [B, 1].
+    """
+    q, k, v = _arr(query), _arr(key), _arr(value)
+    ql = _arr(seq_lens).reshape(-1).astype(jnp.int32)
+    kl = _arr(kv_seq_lens).reshape(-1).astype(jnp.int32)
+    bsz, nh, sq, hd = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * jnp.asarray(
+        scale, jnp.float32).astype(q.dtype)
+    if mask is not None:
+        scores = scores + _arr(mask).astype(scores.dtype)
+    q_pos = jnp.arange(sq)[None, None, :, None]
+    k_pos = jnp.arange(sk)[None, None, None, :]
+    ok = (q_pos < ql[:, None, None, None]) & (k_pos < kl[:, None, None, None])
+    if causal:
+        ok = ok & (k_pos <= q_pos + pre_cache_length)
+    scores = jnp.where(ok, scores, jnp.asarray(_NEG, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+    # zero rows beyond each sequence's query length (reference zero-pads)
+    out = jnp.where(q_pos < ql[:, None, None, None], out, 0.0)
+    return Tensor(out.astype(q.dtype))
+
+
+__all__ = ["masked_multihead_attention", "block_multihead_attention",
+           "variable_length_memory_efficient_attention"]

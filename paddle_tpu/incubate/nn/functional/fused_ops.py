@@ -1,0 +1,212 @@
+"""Misc fused functionals.
+
+Reference surface (python/paddle/incubate/nn/functional/):
+- fused_dropout_add.py:22, fused_matmul_bias.py:21,76,111
+- fused_layer_norm.py:21, fused_rms_norm.py:21 (norm + bias/residual fusion)
+- fused_dot_product_attention.py:20 (cuDNN fused attention)
+- fused_ec_moe.py:18 (expert-choice MoE batched-GEMM kernel)
+
+TPU design: each entry is a single jnp composition dispatched through the op
+registry so autograd/AMP/profiling apply; XLA fuses the arithmetic into the
+neighboring GEMMs (its fusion pass is the cuDNN/CUTLASS analog here), and
+fused_rms_norm routes to the Pallas RMSNorm kernel on TPU via F.rms_norm.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....nn import functional as F
+from ....ops.registry import dispatch
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """out = dropout(x) + y (ref fused_dropout_add.py:22 — one kernel, the
+    dropout mask never materializes in HBM; XLA fuses identically)."""
+    from ....nn.functional import random_mod
+    from ._prims import dropout_arr
+    key = (random_mod.next_key() if training and p > 0.0 else None)
+
+    def _impl(x, y):
+        return dropout_arr(x, float(p), training, mode, key) + y
+
+    return dispatch(_impl, (x, y), {}, op_name="fused_dropout_add")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul(+bias) epilogue fusion (ref fused_matmul_bias.py:21, cuBLASLt
+    epilogue; XLA folds the bias add into the GEMM)."""
+    def _impl(x, y, bias):
+        out = jnp.matmul(jnp.swapaxes(x, -1, -2) if transpose_x else x,
+                         jnp.swapaxes(y, -1, -2) if transpose_y else y)
+        return out if bias is None else out + bias
+    return dispatch(_impl, (x, y, bias), {}, op_name="fused_matmul_bias")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """ref fused_matmul_bias.py:76."""
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation=None):
+    """GEMM + bias + activation epilogue (ref fused_matmul_bias.py:111)."""
+    act = {None: lambda v: v, "none": lambda v: v, "relu": jax.nn.relu,
+           "gelu": jax.nn.gelu}.get(activation)
+    if act is None:
+        raise ValueError(f"unsupported activation '{activation}'")
+
+    def _impl(x, y, bias):
+        out = jnp.matmul(jnp.swapaxes(x, -1, -2) if trans_x else x,
+                         jnp.swapaxes(y, -1, -2) if trans_y else y)
+        if bias is not None:
+            out = out + bias
+        return act(out)
+    return dispatch(_impl, (x, y, bias), {},
+                    op_name="fused_linear_activation")
+
+
+def _norm_inputs(x, bias, residual, residual_alpha):
+    """Shared bias+residual prologue: norm_in = x + bias + alpha*residual."""
+    out = x
+    if bias is not None:
+        out = out + bias
+    if residual is not None:
+        out = out + residual_alpha * residual
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon, residual_alpha=1.0,
+                     begin_norm_axis=1, bias=None, residual=None,
+                     quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                     quant_min_bound=0):
+    """LayerNorm(bias + alpha*residual + x) fusion (ref fused_layer_norm.py:21).
+
+    Returns out, or (out, residual_out) when a residual is passed —
+    residual_out is the pre-norm sum, as the reference's kernel emits it for
+    the next block's residual stream.
+    """
+    if quant_scale != -1:
+        raise NotImplementedError("quant path: use paddle_tpu.quantization")
+
+    def _impl(x, w, b, bias, residual):
+        pre = _norm_inputs(x, bias, residual, residual_alpha)
+        axes = tuple(range(begin_norm_axis, x.ndim))
+        xf = pre.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        out = out.astype(x.dtype)
+        return (out, pre) if residual is not None else out
+
+    return dispatch(_impl, (x, norm_weight, norm_bias, bias, residual), {},
+                    op_name="fused_layer_norm")
+
+
+def fused_rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
+                   bias=None, residual=None, quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0):
+    """RMSNorm(bias + residual + x) fusion (ref fused_rms_norm.py:21).
+
+    Routes through F.rms_norm — on TPU that is the Pallas fused kernel
+    (ops/pallas/fused_ops.py). Returns (out, residual_out) when residual is
+    given.
+    """
+    if quant_scale != -1:
+        raise NotImplementedError("quant path: use paddle_tpu.quantization")
+    if begin_norm_axis != x.ndim - 1:
+        # Pallas kernel normalizes the last dim; earlier axes fall back to
+        # the decomposed form over the flattened trailing dims.
+        def _impl(x, w, b, bias, residual):
+            pre = _norm_inputs(x, bias, residual, 1.0)
+            axes = tuple(range(begin_norm_axis, x.ndim))
+            xf = pre.astype(jnp.float32)
+            rstd = jax.lax.rsqrt(
+                jnp.mean(xf * xf, axis=axes, keepdims=True) + epsilon)
+            out = xf * rstd
+            if w is not None:
+                out = out * w.astype(jnp.float32)
+            if b is not None:
+                out = out + b.astype(jnp.float32)
+            out = out.astype(x.dtype)
+            return (out, pre) if residual is not None else out
+        return dispatch(_impl, (x, norm_weight, norm_bias, bias, residual),
+                        {}, op_name="fused_rms_norm")
+
+    pre = x
+    if bias is not None:
+        pre = pre + bias
+    if residual is not None:
+        pre = pre + residual
+    out = F.rms_norm(pre, weight=norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return (out, pre) if residual is not None else out
+
+
+def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
+                                dropout_prob=0.0, is_training=True,
+                                is_causal_masking=False,
+                                return_softmax=False):
+    """cuDNN fused attention analog (ref fused_dot_product_attention.py:20).
+    q/k/v: [B, S, H, D]. Routes to sdpa (Pallas flash kernel on TPU)."""
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax exposes the materialized probability matrix, "
+            "which the flash path never forms")
+    if scaling_factor is not None:
+        d = q.shape[-1]
+        default = 1.0 / (d ** 0.5)
+        if abs(scaling_factor - default) > 1e-12:
+            raise NotImplementedError(
+                "non-default scaling_factor not supported by the flash path")
+    return F.scaled_dot_product_attention(
+        q, k, v, attn_mask=mask, dropout_p=dropout_prob if is_training else 0.0,
+        is_causal=is_causal_masking, training=is_training)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type):
+    """Expert-choice MoE over batched GEMMs (ref fused_ec_moe.py:18).
+
+    x: [B, S, E]; gate: [B, S, n_exp]; bmm0: [n_exp, E, FF];
+    bmm1: [n_exp, FF, E]. Computes the softly-gated mixture
+    sum_e p_e * ffn_e(x) with a scan over experts so only one expert's
+    activation is live at a time (the batched-GEMM kernel's memory shape).
+    """
+    act = _EC_ACTS.get(act_type)
+    if act is None:
+        raise ValueError(f"unsupported act_type '{act_type}'")
+
+    def _impl(x, gate, w0, b0, w1, b1):
+        probs = jax.nn.softmax(gate.astype(jnp.float32), axis=-1).astype(
+            x.dtype)
+
+        def body(acc, packed):
+            w0e, b0e, w1e, b1e, pe = packed
+            h = act(jnp.matmul(x, w0e) + b0e)
+            y = jnp.matmul(h, w1e) + b1e
+            return acc + pe[..., None] * y, None
+
+        init = jnp.zeros_like(x)
+        pe = jnp.moveaxis(probs, -1, 0)             # [n_exp, B, S]
+        out, _ = jax.lax.scan(body, init, (w0, b0, w1, b1, pe))
+        return out
+
+    return dispatch(_impl,
+                    (x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias),
+                    {}, op_name="fused_ec_moe")
+
+
+_EC_ACTS = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}
+
+
+__all__ = ["fused_dropout_add", "fused_matmul_bias", "fused_linear",
+           "fused_linear_activation", "fused_layer_norm", "fused_rms_norm",
+           "fused_dot_product_attention", "fused_ec_moe"]

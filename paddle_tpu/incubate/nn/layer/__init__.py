@@ -1,0 +1,10 @@
+from .fused_layers import (FusedBiasDropoutResidualLayerNorm, FusedDropout,
+                           FusedDropoutAdd, FusedEcMoe, FusedFeedForward,
+                           FusedLinear, FusedMultiHeadAttention,
+                           FusedMultiTransformer,
+                           FusedTransformerEncoderLayer)
+
+__all__ = ["FusedBiasDropoutResidualLayerNorm", "FusedDropout",
+           "FusedDropoutAdd", "FusedEcMoe", "FusedFeedForward", "FusedLinear",
+           "FusedMultiHeadAttention", "FusedMultiTransformer",
+           "FusedTransformerEncoderLayer"]

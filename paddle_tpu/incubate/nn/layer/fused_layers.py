@@ -1,0 +1,402 @@
+"""incubate.nn fused layers.
+
+Reference surface: python/paddle/incubate/nn/layer/
+(fused_transformer.py: FusedBiasDropoutResidualLayerNorm:83,
+ FusedMultiHeadAttention:196, FusedFeedForward:502,
+ FusedTransformerEncoderLayer:728, FusedMultiTransformer:1025;
+ fused_linear.py:FusedLinear:71; fused_dropout_add.py:FusedDropoutAdd:60;
+ fused_dropout_nd.py:FusedDropout:76; fused_ec_moe.py:FusedEcMoe:19).
+
+Thin parameter-owning wrappers over the fused functionals — the TPU fusion
+happens in XLA/Pallas under those entry points.
+"""
+from __future__ import annotations
+
+from ....nn import functional as NF
+from ....nn import initializer as I
+from ....nn.layer import Layer
+from ..functional import (fused_bias_dropout_residual_layer_norm,
+                          fused_dropout_add, fused_ec_moe, fused_feedforward,
+                          fused_linear, fused_multi_head_attention,
+                          fused_multi_transformer)
+
+
+class FusedDropoutAdd(Layer):
+    """out = dropout(x) + y."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return fused_dropout_add(x, y, p=self.p, training=self.training,
+                                 mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedDropout(Layer):
+    """fused_dropout_nd.py FusedDropout: dropout with an optional shared-mask
+    axis (whole planes dropped together)."""
+
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        if not isinstance(p, (float, int)):
+            raise TypeError("p argument should be a number")
+        if p < 0 or p > 1:
+            raise ValueError("p argument should between 0 and 1")
+        self.p = p
+        self.axis = axis
+        self.mode = ("downscale_in_infer"
+                     if mode == "downgrade_in_infer" else mode)
+
+    def forward(self, x):
+        return NF.dropout(x, p=self.p, axis=self.axis,
+                          training=self.training, mode=self.mode)
+
+
+class FusedLinear(Layer):
+    """GEMM with fused bias epilogue (fused_linear.py:71)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        if transpose_weight:
+            weight_shape = [out_features, in_features]
+        else:
+            weight_shape = [in_features, out_features]
+        self.weight = self.create_parameter(
+            weight_shape, attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """y = layer_norm(residual + dropout(bias + x)) (fused_transformer.py:83)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        if embed_dim <= 0:
+            raise ValueError("embed_dim must be positive")
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                             is_bias=True)
+
+    def forward(self, x, residual):
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, "
+                f"dropout_rate={self.dropout_rate}, epsilon={self._epsilon}")
+
+
+class FusedMultiHeadAttention(Layer):
+    """Fused self-attention block (fused_transformer.py:196): pre/post-LN +
+    qkv proj + sdpa + out proj + bias-dropout-residual-LN, one fused call."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim <= 0 or num_heads <= 0:
+            raise ValueError("embed_dim and num_heads must be positive")
+        if embed_dim % num_heads != 0:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True materializes attention probabilities, "
+                "which the fused path never forms")
+        if (kdim is not None and kdim != embed_dim) or \
+                (vdim is not None and vdim != embed_dim):
+            raise NotImplementedError(
+                "only self-attention (kdim == vdim == embed_dim)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, head_dim, embed_dim], attr=qkv_weight_attr,
+            default_initializer=I.XavierNormal())
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, head_dim], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=I.XavierNormal())
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True)
+        if normalize_before:
+            self.pre_ln_scale = self.create_parameter(
+                [embed_dim], attr=pre_ln_scale_attr,
+                default_initializer=I.Constant(1.0))
+            self.pre_ln_bias = self.create_parameter(
+                [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+            self.ln_scale, self.ln_bias = None, None
+        else:
+            self.pre_ln_scale, self.pre_ln_bias = None, None
+            self.ln_scale = self.create_parameter(
+                [embed_dim], attr=ln_scale_attr,
+                default_initializer=I.Constant(1.0))
+            self.ln_bias = self.create_parameter([embed_dim],
+                                                 attr=ln_bias_attr,
+                                                 is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, num_heads={self.num_heads}, "
+                f"normalize_before={self.normalize_before}")
+
+
+class FusedFeedForward(Layer):
+    """Fused transformer FFN block (fused_transformer.py:502)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if d_model <= 0 or dim_feedforward <= 0:
+            raise ValueError("d_model and dim_feedforward must be positive")
+        self._d_model = d_model
+        self._dim_feedforward = dim_feedforward
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                  else act_dropout_rate)
+        self._act_method = activation
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+
+        self._linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=I.XavierNormal())
+        self._linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self._linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=I.XavierNormal())
+        self._linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True)
+        if normalize_before:
+            self._ln1_scale = self.create_parameter(
+                [d_model], attr=ln1_scale_attr,
+                default_initializer=I.Constant(1.0))
+            self._ln1_bias = self.create_parameter([d_model],
+                                                   attr=ln1_bias_attr,
+                                                   is_bias=True)
+            self._ln2_scale, self._ln2_bias = None, None
+        else:
+            self._ln1_scale, self._ln1_bias = None, None
+            self._ln2_scale = self.create_parameter(
+                [d_model], attr=ln2_scale_attr,
+                default_initializer=I.Constant(1.0))
+            self._ln2_bias = self.create_parameter([d_model],
+                                                   attr=ln2_bias_attr,
+                                                   is_bias=True)
+
+    def forward(self, src, cache=None):
+        return fused_feedforward(
+            src, self._linear1_weight, self._linear2_weight,
+            self._linear1_bias, self._linear2_bias, self._ln1_scale,
+            self._ln1_bias, self._ln2_scale, self._ln2_bias,
+            dropout1_rate=self._act_dropout_rate,
+            dropout2_rate=self._dropout_rate,
+            activation=self._act_method, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon,
+            pre_layer_norm=self._normalize_before, training=self.training)
+
+    def extra_repr(self):
+        return (f"d_model={self._d_model}, "
+                f"dim_feedforward={self._dim_feedforward}, "
+                f"activation={self._act_method}")
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Fused encoder layer = FusedMultiHeadAttention + FusedFeedForward
+    (fused_transformer.py:728)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                            else act_dropout_rate)
+        self.normalize_before = normalize_before
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            out, new_cache = self.fused_attn(src, attn_mask=src_mask,
+                                             cache=cache)
+            return self.ffn(out), new_cache
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """Whole decoder stack in one fused call (fused_transformer.py:1025);
+    serves GPT-style generation with per-layer KV caches."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None, epsilon=1e-5,
+                 num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1,
+                 name=None):
+        super().__init__()
+        if embed_dim <= 0 or num_heads <= 0 or dim_feedforward <= 0:
+            raise ValueError(
+                "embed_dim, num_heads, dim_feedforward must be positive")
+        if num_layers < 0:
+            num_layers = (len(qkv_weight_attrs)
+                          if isinstance(qkv_weight_attrs, (list, tuple))
+                          else 1)
+        self.num_layers = num_layers
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._trans_qkvw = trans_qkvw
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        head_dim = embed_dim // num_heads
+
+        def _attr(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            qkv_shape = ([3, num_heads, head_dim, embed_dim] if trans_qkvw
+                         else [embed_dim, 3, num_heads, head_dim])
+            pieces = [
+                ("ln_scales", [embed_dim], _attr(ln_scale_attrs, i),
+                 I.Constant(1.0), False),
+                ("ln_biases", [embed_dim], _attr(ln_bias_attrs, i), None,
+                 True),
+                ("qkv_weights", qkv_shape, _attr(qkv_weight_attrs, i),
+                 I.XavierNormal(), False),
+                ("qkv_biases", [3, num_heads, head_dim],
+                 _attr(qkv_bias_attrs, i), None, True),
+                ("linear_weights", [embed_dim, embed_dim],
+                 _attr(linear_weight_attrs, i), I.XavierNormal(), False),
+                ("linear_biases", [embed_dim], _attr(linear_bias_attrs, i),
+                 None, True),
+                ("ffn_ln_scales", [embed_dim], _attr(ffn_ln_scale_attrs, i),
+                 I.Constant(1.0), False),
+                ("ffn_ln_biases", [embed_dim], _attr(ffn_ln_bias_attrs, i),
+                 None, True),
+                ("ffn1_weights", [embed_dim, dim_feedforward],
+                 _attr(ffn1_weight_attrs, i), I.XavierNormal(), False),
+                ("ffn1_biases", [dim_feedforward], _attr(ffn1_bias_attrs, i),
+                 None, True),
+                ("ffn2_weights", [dim_feedforward, embed_dim],
+                 _attr(ffn2_weight_attrs, i), I.XavierNormal(), False),
+                ("ffn2_biases", [embed_dim], _attr(ffn2_bias_attrs, i), None,
+                 True),
+            ]
+            for list_name, shape, attr, init, is_bias in pieces:
+                p = self.create_parameter(shape, attr=attr, is_bias=is_bias,
+                                          default_initializer=init)
+                getattr(self, list_name).append(p)
+                self.add_parameter(f"{list_name}_{i}", p)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        return fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self._epsilon,
+            cache_kvs=caches, pre_caches=pre_caches, seq_lens=seq_lens,
+            rotary_embs=rotary_embs, time_step=time_step,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            rotary_emb_dims=rotary_emb_dims, activation=self.activation,
+            training=self.training, trans_qkvw=self._trans_qkvw)
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice MoE layer (fused_ec_moe.py:19)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError("only gelu / relu are supported")
+        self.act_type = act_type
+        self.bmm_weight0 = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bmm_bias0 = self.create_parameter(
+            [num_experts, 1, inter_size], attr=bias_attr, is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bmm_bias1 = self.create_parameter(
+            [num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        return fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
+                            self.bmm_weight1, self.bmm_bias1, self.act_type)

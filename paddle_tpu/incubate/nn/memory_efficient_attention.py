@@ -1,0 +1,68 @@
+"""memory_efficient_attention + attention-bias helpers.
+
+Reference: python/paddle/incubate/nn/memory_efficient_attention.py (xFormers
+CUTLASS kernel) and attn_bias.py (LowerTriangularMask et al).
+
+TPU design: the memory-efficient algorithm IS flash attention — the call
+routes through nn.functional.scaled_dot_product_attention, which picks the
+Pallas flash kernel on TPU and a fused XLA chain elsewhere. The attn-bias
+classes reduce to the masks they describe.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn import functional as F
+
+
+class LowerTriangularMask:
+    """attn_bias.py LowerTriangularMask: causal masking marker."""
+
+
+class LowerTriangularMaskWithTensorBias(LowerTriangularMask):
+    """Causal mask plus an additive bias tensor."""
+
+    def __init__(self, bias):
+        self.bias = bias
+
+
+def _materialize_bias(attn_bias, q, k):
+    """Return (mask_tensor_or_None, is_causal)."""
+    if attn_bias is None:
+        return None, False
+    if isinstance(attn_bias, LowerTriangularMaskWithTensorBias):
+        return attn_bias.bias, True
+    if isinstance(attn_bias, LowerTriangularMask):
+        return None, True
+    return attn_bias, False
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """query/key/value: [B, S, H, D]. Returns [B, S, H, D].
+
+    scale overrides the default 1/sqrt(D) by pre-scaling q (algebraically
+    identical, keeps the flash path's internal scaling untouched).
+    """
+    q = query
+    if scale is not None:
+        d = query.shape[-1]
+        default = 1.0 / (d ** 0.5)
+        q = query * (scale / default)
+    mask, is_causal = _materialize_bias(attn_bias, query, key)
+    if mask is not None and is_causal:
+        # fold causal into the additive bias so both apply
+        sq = query.shape[1]
+        sk = key.shape[1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+        m = jnp.where(causal, m, -1e9)
+        mask, is_causal = Tensor(m), False
+    return F.scaled_dot_product_attention(
+        q, key, value, attn_mask=mask, dropout_p=p, is_causal=is_causal,
+        training=training)
+
+
+__all__ = ["memory_efficient_attention", "LowerTriangularMask",
+           "LowerTriangularMaskWithTensorBias"]

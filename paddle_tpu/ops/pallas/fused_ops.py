@@ -52,19 +52,41 @@ def _rms_bwd_kernel(x_ref, w_ref, g_ref, rstd_ref, dx_ref, dw_ref):
     c = jnp.sum(gw * x, axis=-1, keepdims=True) / h
     dx = (gw - x * c * rstd * rstd) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
-    # per-block dw partial (summed over this block's rows)
-    dw_ref[0, :] = jnp.sum(g * x * rstd, axis=0)
+    # dw accumulates into ONE (1, h) block revisited by every grid step —
+    # TPU grid iterations run sequentially, so read-modify-write is safe,
+    # and the single-block output satisfies the (8, 128) tiling rule that a
+    # (1, h) slice of a (grid, h) array would violate.
+    part = jnp.sum(g * x * rstd, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[:] = part
+
+    @pl.when(pl.program_id(0) > 0)
+    def _acc():
+        dw_ref[:] += part
 
 
 def _pick_block_rows(n_rows: int) -> int:
-    for cand in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+    # callers pad n_rows to a multiple of 8 (TPU sublane tiling), so a
+    # multiple-of-8 block always exists
+    for cand in (256, 128, 64, 32, 16, 8):
         if n_rows % cand == 0:
             return cand
-    return 1
+    return n_rows
+
+
+def _pad_rows(a, n_pad):
+    n = a.shape[0]
+    if n_pad == n:
+        return a
+    return jnp.pad(a, ((0, n_pad - n),) + ((0, 0),) * (a.ndim - 1))
 
 
 def _rms_fwd_call(x2d, w, eps, interpret):
-    n, h = x2d.shape
+    n_orig, h = x2d.shape
+    n = _round_up(n_orig, 8)
+    x2d = _pad_rows(x2d, n)   # zero rows: rstd=rsqrt(eps), sliced off below
     br = _pick_block_rows(n)
     out, rstd = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
@@ -77,14 +99,20 @@ def _rms_fwd_call(x2d, w, eps, interpret):
                    jax.ShapeDtypeStruct((n, 1), jnp.float32)],
         interpret=interpret,
     )(x2d, w)
-    return out, rstd
+    return out[:n_orig], rstd[:n_orig]
 
 
 def _rms_bwd_call(x2d, w, g2d, rstd, interpret):
-    n, h = x2d.shape
+    n_orig, h = x2d.shape
+    n = _round_up(n_orig, 8)
+    # zero-padded rows contribute g*x*rstd = 0 to dw; their dx rows are
+    # sliced off
+    x2d = _pad_rows(x2d, n)
+    g2d = _pad_rows(g2d, n)
+    rstd = _pad_rows(rstd, n)
     br = _pick_block_rows(n)
     grid = n // br
-    dx, dw_parts = pl.pallas_call(
+    dx, dw = pl.pallas_call(
         _rms_bwd_kernel,
         grid=(grid,),
         in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
@@ -92,12 +120,12 @@ def _rms_bwd_call(x2d, w, g2d, rstd, interpret):
                   pl.BlockSpec((br, h), lambda i: (i, 0)),
                   pl.BlockSpec((br, 1), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+                   pl.BlockSpec((1, h), lambda i: (0, 0))],
         out_shape=[jax.ShapeDtypeStruct((n, h), x2d.dtype),
-                   jax.ShapeDtypeStruct((grid, h), jnp.float32)],
+                   jax.ShapeDtypeStruct((1, h), jnp.float32)],
         interpret=interpret,
     )(x2d, w, g2d, rstd)
-    return dx, dw_parts.sum(axis=0)
+    return dx[:n_orig], dw[0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))

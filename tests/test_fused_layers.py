@@ -1,0 +1,311 @@
+"""incubate.nn fused layer/functional tier.
+
+Reference test model: test/legacy_test/test_fused_attention_op.py,
+test_fused_feedforward_op.py, test_fused_bias_dropout_residual_layer_norm_op.py,
+test_fused_multi_transformer_op.py — each fused op is checked against a
+composition of unfused ops / NumPy.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn as inn
+import paddle_tpu.incubate.nn.functional as IF
+
+RNG = np.random.RandomState(1234)
+B, S, E, H = 2, 6, 16, 4
+D = E // H
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, dtype="float32"))
+
+
+def _np(x):
+    return np.asarray(x._data)
+
+
+def _softmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _layer_norm_np(x, scale, bias, eps=1e-5):
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    out = (x - mean) / np.sqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class TestFusedFunctional:
+    def test_fused_feedforward_matches_unfused(self):
+        x = RNG.randn(B, S, E).astype("float32")
+        w1 = RNG.randn(E, 32).astype("float32") * 0.1
+        w2 = RNG.randn(32, E).astype("float32") * 0.1
+        s1 = RNG.rand(E).astype("float32") + 0.5
+        b1 = RNG.randn(E).astype("float32") * 0.1
+        out = IF.fused_feedforward(
+            _t(x), _t(w1), _t(w2), ln1_scale=_t(s1), ln1_bias=_t(b1),
+            dropout1_rate=0.0, dropout2_rate=0.0, activation="relu",
+            pre_layer_norm=True)
+        h = _layer_norm_np(x, s1, b1)
+        ref = x + np.maximum(h @ w1, 0.0) @ w2
+        np.testing.assert_allclose(_np(out), ref, atol=1e-4)
+
+    def test_fused_feedforward_grad_flows(self):
+        x = _t(RNG.randn(B, S, E) * 0.1)
+        x.stop_gradient = False
+        w1 = _t(RNG.randn(E, 32) * 0.1)
+        w1.stop_gradient = False
+        w2 = _t(RNG.randn(32, E) * 0.1)
+        w2.stop_gradient = False
+        out = IF.fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                   dropout2_rate=0.0, activation="gelu",
+                                   pre_layer_norm=True)
+        out.sum().backward()
+        assert x.grad is not None and np.isfinite(_np(x.grad)).all()
+        assert w1.grad is not None and np.isfinite(_np(w1.grad)).all()
+
+    def test_fused_bias_dropout_residual_layer_norm(self):
+        x = RNG.randn(B, S, E).astype("float32")
+        res = RNG.randn(B, S, E).astype("float32")
+        bias = RNG.randn(E).astype("float32") * 0.1
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            _t(x), _t(res), bias=_t(bias), dropout_rate=0.0)
+        ref = _layer_norm_np(res + x + bias, None, None)
+        np.testing.assert_allclose(_np(out), ref, atol=1e-4)
+
+    def test_fused_multi_head_attention_matches_unfused(self):
+        x = RNG.randn(B, S, E).astype("float32")
+        qkv_w = (RNG.randn(3, H, D, E) * 0.2).astype("float32")
+        lin_w = (RNG.randn(E, E) * 0.2).astype("float32")
+        out = IF.fused_multi_head_attention(
+            _t(x), _t(qkv_w), _t(lin_w), pre_layer_norm=True,
+            dropout_rate=0.0, attn_dropout_rate=0.0)
+        h = _layer_norm_np(x, None, None)
+        qkv = np.einsum("bse,thde->bsthd", h, qkv_w)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        ctx = np.einsum("bhqk,bkhd->bqhd", _softmax(scores), v)
+        ref = x + ctx.reshape(B, S, E) @ lin_w
+        np.testing.assert_allclose(_np(out), ref, atol=1e-4)
+
+    def test_fused_mha_cache_append(self):
+        x = RNG.randn(B, 1, E).astype("float32")
+        qkv_w = (RNG.randn(3, H, D, E) * 0.2).astype("float32")
+        lin_w = np.eye(E, dtype="float32")
+        cache = RNG.randn(2, B, H, 3, D).astype("float32")
+        out, new_cache = IF.fused_multi_head_attention(
+            _t(x), _t(qkv_w), _t(lin_w), cache_kv=_t(cache),
+            dropout_rate=0.0, attn_dropout_rate=0.0, pre_layer_norm=True)
+        assert list(new_cache.shape) == [2, B, H, 4, D]
+        np.testing.assert_allclose(_np(new_cache)[:, :, :, :3], cache,
+                                   atol=1e-6)
+
+    def test_fused_multi_transformer_decode_cache(self):
+        layers = 2
+        mt = inn.FusedMultiTransformer(E, H, 32, num_layers=layers)
+        mt.eval()
+        x = _t(RNG.randn(B, 4, E) * 0.1)
+        caches = [_t(np.zeros((2, B, H, 8, D))) for _ in range(layers)]
+        out, caches = mt(x, caches=caches)
+        assert list(out.shape) == [B, 4, E]
+        # decode one token at time_step=4
+        x1 = _t(RNG.randn(B, 1, E) * 0.1)
+        out1, caches = mt(x1, caches=caches, time_step=_t(np.array(4)))
+        assert list(out1.shape) == [B, 1, E]
+        assert len(caches) == layers
+
+    def test_fused_linear_and_matmul_bias(self):
+        x = RNG.randn(5, E).astype("float32")
+        w = RNG.randn(E, 8).astype("float32")
+        b = RNG.randn(8).astype("float32")
+        out = IF.fused_linear(_t(x), _t(w), _t(b))
+        np.testing.assert_allclose(_np(out), x @ w + b, atol=1e-5)
+        out2 = IF.fused_matmul_bias(_t(x), _t(w.T), _t(b), transpose_y=True)
+        np.testing.assert_allclose(_np(out2), x @ w + b, atol=1e-5)
+        out3 = IF.fused_linear_activation(_t(x), _t(w), _t(b),
+                                          activation="relu")
+        np.testing.assert_allclose(_np(out3), np.maximum(x @ w + b, 0),
+                                   atol=1e-5)
+
+    def test_fused_layer_norm_residual(self):
+        x = RNG.randn(B, S, E).astype("float32")
+        res = RNG.randn(B, S, E).astype("float32")
+        w = RNG.rand(E).astype("float32") + 0.5
+        out, res_out = IF.fused_layer_norm(_t(x), _t(w), None, 1e-5,
+                                           begin_norm_axis=2, residual=_t(res))
+        np.testing.assert_allclose(_np(res_out), x + res, atol=1e-5)
+        np.testing.assert_allclose(_np(out), _layer_norm_np(x + res, w, None),
+                                   atol=1e-4)
+
+    def test_fused_rms_norm(self):
+        x = RNG.randn(B, S, E).astype("float32")
+        w = RNG.rand(E).astype("float32") + 0.5
+        out = IF.fused_rms_norm(_t(x), _t(w), None, 1e-6, begin_norm_axis=2)
+        rstd = 1.0 / np.sqrt((x * x).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(_np(out), x * rstd * w, atol=1e-4)
+
+    def test_fused_dropout_add(self):
+        x = RNG.randn(B, S, E).astype("float32")
+        y = RNG.randn(B, S, E).astype("float32")
+        out = IF.fused_dropout_add(_t(x), _t(y), p=0.0)
+        np.testing.assert_allclose(_np(out), x + y, atol=1e-6)
+        out_drop = IF.fused_dropout_add(_t(x), _t(y), p=1.0)
+        np.testing.assert_allclose(_np(out_drop), y, atol=1e-6)
+
+    def test_fused_ec_moe(self):
+        n_exp, ff = 3, 8
+        x = RNG.randn(B, S, E).astype("float32")
+        gate = RNG.randn(B, S, n_exp).astype("float32")
+        w0 = (RNG.randn(n_exp, E, ff) * 0.1).astype("float32")
+        b0 = np.zeros((n_exp, 1, ff), dtype="float32")
+        w1 = (RNG.randn(n_exp, ff, E) * 0.1).astype("float32")
+        b1 = np.zeros((n_exp, 1, E), dtype="float32")
+        out = IF.fused_ec_moe(_t(x), _t(gate), _t(w0), _t(b0), _t(w1),
+                              _t(b1), "relu")
+        probs = _softmax(gate)
+        ref = np.zeros_like(x)
+        for e in range(n_exp):
+            ref += probs[..., e:e + 1] * (
+                np.maximum(x @ w0[e] + b0[e], 0) @ w1[e] + b1[e])
+        np.testing.assert_allclose(_np(out), ref, atol=1e-4)
+
+    def test_fused_dot_product_attention(self):
+        q = _t(RNG.randn(B, S, H, D) * 0.3)
+        out = IF.fused_dot_product_attention(q, q, q, is_causal_masking=True,
+                                             dropout_prob=0.0)
+        assert list(out.shape) == [B, S, H, D]
+
+
+class TestDecodeAttention:
+    def test_masked_multihead_attention(self):
+        smax = 8
+        t = 2
+        cache = RNG.randn(2, B, H, smax, D).astype("float32")
+        x = RNG.randn(B, 3 * H * D).astype("float32")
+        out, new_cache = IF.masked_multihead_attention(
+            _t(x), cache_kv=_t(cache),
+            sequence_lengths=_t(np.full((B, 1), t, dtype="int32")))
+        qkv = x.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        ck, cv = cache[0].copy(), cache[1].copy()
+        ck[:, :, t], cv[:, :, t] = k, v
+        ref = np.zeros((B, H, D), dtype="float32")
+        for b in range(B):
+            for h in range(H):
+                s = ck[b, h, :t + 1] @ q[b, h] / np.sqrt(D)
+                p = _softmax(s[None])[0]
+                ref[b, h] = p @ cv[b, h, :t + 1]
+        np.testing.assert_allclose(_np(out).reshape(B, H, D), ref, atol=1e-5)
+        np.testing.assert_allclose(_np(new_cache)[0], ck, atol=1e-6)
+
+    def test_block_multihead_attention_prefill_and_decode(self):
+        bs, max_blocks = 4, 16
+        kc = np.zeros((max_blocks, H, bs, D), dtype="float32")
+        vc = np.zeros((max_blocks, H, bs, D), dtype="float32")
+        bt = np.array([[0, 1, 2, 3], [4, 5, 6, 7]], dtype="int32")
+        slens = np.array([5, 7], dtype="int32")
+        T = int(slens.sum())
+        qkv = RNG.randn(T, 3 * H * D).astype("float32")
+        cu = np.array([0, 5, 12], dtype="int32")
+        zeros = np.zeros((2, 1), dtype="int32")
+        out, _, kc2, vc2 = IF.block_multihead_attention(
+            _t(qkv), _t(kc), _t(vc),
+            seq_lens_encoder=_t(slens.reshape(-1, 1)),
+            seq_lens_decoder=_t(zeros),
+            seq_lens_this_time=_t(slens.reshape(-1, 1)),
+            padding_offsets=None, cum_offsets=None,
+            cu_seqlens_q=_t(cu.reshape(-1, 1)),
+            cu_seqlens_k=_t(cu.reshape(-1, 1)),
+            block_tables=_t(bt), block_size=bs)
+        q3 = qkv.reshape(T, 3, H, D)
+        # causal ref for the last token of sequence 1
+        ref = np.zeros((H, D), dtype="float32")
+        for h in range(H):
+            s = q3[5:12, 1][:, h] @ q3[11, 0, h] / np.sqrt(D)
+            ref[h] = _softmax(s[None])[0] @ q3[5:12, 2][:, h]
+        np.testing.assert_allclose(_np(out)[11].reshape(H, D), ref,
+                                   atol=1e-5)
+        # decode one token per sequence
+        qkv_d = RNG.randn(2, 3 * H * D).astype("float32")
+        cu_d = np.array([0, 1, 2], dtype="int32")
+        out2, _, _, _ = IF.block_multihead_attention(
+            _t(qkv_d), kc2, vc2,
+            seq_lens_encoder=_t(zeros),
+            seq_lens_decoder=_t(slens.reshape(-1, 1)),
+            seq_lens_this_time=_t(np.ones((2, 1), dtype="int32")),
+            padding_offsets=None, cum_offsets=None,
+            cu_seqlens_q=_t(cu_d.reshape(-1, 1)),
+            cu_seqlens_k=_t(cu_d.reshape(-1, 1)),
+            block_tables=_t(bt), block_size=bs)
+        d3 = qkv_d.reshape(2, 3, H, D)
+        k_all = np.concatenate([q3[:5, 1], d3[0:1, 1]], 0)
+        v_all = np.concatenate([q3[:5, 2], d3[0:1, 2]], 0)
+        ref_d = np.zeros((H, D), dtype="float32")
+        for h in range(H):
+            s = k_all[:, h] @ d3[0, 0, h] / np.sqrt(D)
+            ref_d[h] = _softmax(s[None])[0] @ v_all[:, h]
+        np.testing.assert_allclose(_np(out2)[0].reshape(H, D), ref_d,
+                                   atol=1e-5)
+
+    def test_variable_length_attention_masks_and_zero_pads(self):
+        sq = 6
+        q = RNG.randn(B, H, sq, D).astype("float32")
+        lens = np.array([[4], [6]], dtype="int32")
+        out = IF.variable_length_memory_efficient_attention(
+            _t(q), _t(q), _t(q), _t(lens), _t(lens), causal=True)
+        assert abs(_np(out)[0, :, 4:]).sum() == 0.0
+        # row 0 of seq 0 attends only to itself under causal → equals v[0]
+        np.testing.assert_allclose(_np(out)[0, :, 0], q[0, :, 0], atol=1e-5)
+
+
+class TestFusedLayers:
+    def test_encoder_layer_shapes_and_grad(self):
+        enc = inn.FusedTransformerEncoderLayer(E, H, 32, dropout_rate=0.0)
+        x = _t(RNG.randn(B, S, E) * 0.2)
+        x.stop_gradient = False
+        out = enc(x)
+        assert list(out.shape) == [B, S, E]
+        out.sum().backward()
+        grads = [p.grad for p in enc.parameters()]
+        assert any(g is not None for g in grads)
+
+    def test_fused_linear_layer(self):
+        lin = inn.FusedLinear(E, 8)
+        out = lin(_t(RNG.randn(B, E)))
+        assert list(out.shape) == [B, 8]
+
+    def test_fused_dropout_layers(self):
+        da = inn.FusedDropoutAdd(p=0.3)
+        da.eval()
+        x = _t(RNG.randn(B, E))
+        y = _t(RNG.randn(B, E))
+        np.testing.assert_allclose(_np(da(x, y)), _np(x) + _np(y), atol=1e-6)
+        d = inn.FusedDropout(p=0.5)
+        d.eval()
+        np.testing.assert_allclose(_np(d(x)), _np(x), atol=1e-6)
+        with pytest.raises(ValueError):
+            inn.FusedDropout(p=1.5)
+
+    def test_fused_ec_moe_layer(self):
+        moe = inn.FusedEcMoe(E, 32, 4, act_type="gelu")
+        x = _t(RNG.randn(B, S, E))
+        gate = _t(RNG.randn(B, S, 4))
+        assert list(moe(x, gate).shape) == [B, S, E]
+
+    def test_memory_efficient_attention_matches_sdpa(self):
+        from paddle_tpu.incubate.nn.memory_efficient_attention import (
+            LowerTriangularMask)
+        import paddle_tpu.nn.functional as F
+        q = _t(RNG.randn(B, S, H, D) * 0.3)
+        out = inn.memory_efficient_attention(q, q, q,
+                                             attn_bias=LowerTriangularMask(),
+                                             p=0.0)
+        ref = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        np.testing.assert_allclose(_np(out), _np(ref), atol=1e-5)
