@@ -42,8 +42,13 @@ from ._prims import dropout_arr as _dropout
 from ._prims import layer_norm_arr as _layer_norm
 
 
-def _keys(n):
+def _keys(n, needed=True):
+    """Draw RNG keys only when dropout will actually fire — an eval-mode or
+    rate-0 call must not advance the global stream (keeps fused and unfused
+    models bit-reproducible against each other)."""
     from ....nn.functional import random_mod
+    if not needed:
+        return [None] * n
     return [random_mod.next_key() for _ in range(n)]
 
 
@@ -63,7 +68,8 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         out = layer_norm2(out) if not pre_layer_norm
     """
     act = _act(activation)
-    k1, k2 = _keys(2)
+    k1, k2 = _keys(2, needed=training and (float(dropout1_rate) > 0.0
+                                           or float(dropout2_rate) > 0.0))
 
     def _impl(x, w1, w2, b1, b2, s1, bb1, s2, bb2):
         residual = x
@@ -96,7 +102,7 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
                                            mode="upscale_in_train", name=None):
     """y = layer_norm(residual + dropout(bias + x))
     (ref fused_transformer.py:323)."""
-    (key,) = _keys(1)
+    (key,) = _keys(1, needed=training and float(dropout_rate) > 0.0)
 
     def _impl(x, residual, bias, ln_scale, ln_bias):
         out = x if bias is None else x + bias
@@ -126,7 +132,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     Semantics: pre/post layernorm + qkv proj + scaled-dot-product attention
     (+mask, attn dropout) + out proj + bias-dropout-residual(-layernorm).
     """
-    k_attn, k_out = _keys(2)
+    k_attn, k_out = _keys(2, needed=training and (
+        float(dropout_rate) > 0.0 or float(attn_dropout_rate) > 0.0))
 
     def _impl(x, qkv_w, lin_w, pre_s, pre_b, s, b, qkv_b, lin_b, cache, mask):
         bsz, seq, embed = x.shape
@@ -300,8 +307,15 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         d = q.shape[-1]
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_full) / jnp.sqrt(
             jnp.asarray(d, jnp.float32)).astype(h.dtype)
-        if mask is not None and time_step is None:
-            scores = scores + mask
+        if mask is not None:
+            if time_step is None:
+                scores = scores + mask
+            else:
+                # decode: mask rows address the cache timeline [B,1,1,S_max]
+                m_dec = mask[..., -1:, :] if mask.ndim == 4 else mask
+                s_m = min(m_dec.shape[-1], scores.shape[-1])
+                scores = scores.at[..., :s_m].add(
+                    m_dec[..., :s_m].astype(scores.dtype))
         if valid is not None:
             scores = jnp.where(valid, scores, jnp.asarray(-1e9, scores.dtype))
         if seq_lens is not None:

@@ -1,0 +1,160 @@
+"""paddle.incubate.optimizer.functional — BFGS / L-BFGS minimizers.
+
+Reference: python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py
+(minimize_bfgs:27 / minimize_lbfgs:27 with strong-Wolfe line search in
+line_search.py).
+
+TPU design: the objective is evaluated through the framework's autograd on
+device; the quasi-Newton bookkeeping is a host loop over a single flattened
+position vector (each iteration is a handful of fused vector ops + one
+objective eval). Returns mirror the reference tuples.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...optimizer.lbfgs import _strong_wolfe, two_loop_direction
+
+
+def _value_and_grad(objective_func, x_arr, dtype):
+    x = Tensor(jnp.asarray(x_arr, dtype))
+    x.stop_gradient = False
+    y = objective_func(x)
+    y.backward()
+    g = (x.grad._data if x.grad is not None
+         else jnp.zeros_like(jnp.asarray(x_arr)))
+    return float(y), jnp.asarray(g, dtype)
+
+
+def _minimize(objective_func, initial_position, *, max_iters, tolerance_grad,
+              tolerance_change, line_search_fn, max_line_search_iters,
+              initial_step_length, dtype, mode, history_size=100,
+              initial_inverse_hessian_estimate=None):
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError("only strong_wolfe line search exists")
+    dt = jnp.dtype(dtype)
+    x = initial_position._data if isinstance(initial_position, Tensor) \
+        else jnp.asarray(initial_position)
+    x = x.astype(dt).reshape(-1)
+    n = x.shape[0]
+
+    if mode == "bfgs":
+        if initial_inverse_hessian_estimate is None:
+            H = jnp.eye(n, dtype=dt)
+        else:
+            H0 = initial_inverse_hessian_estimate
+            H = (H0._data if isinstance(H0, Tensor)
+                 else jnp.asarray(H0)).astype(dt)
+            if H.shape != (n, n):
+                raise ValueError("initial_inverse_hessian_estimate must be "
+                                 f"[{n}, {n}]")
+            if float(jnp.abs(H - H.T).max()) > 1e-6:
+                raise ValueError(
+                    "initial_inverse_hessian_estimate must be symmetric")
+    else:
+        s_hist: list = []
+        y_hist: list = []
+
+    f, g = _value_and_grad(objective_func, x, dt)
+    num_calls = 1
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.abs(g).max()) <= tolerance_grad:
+            converged = True
+            break
+        if mode == "bfgs":
+            d = -(H @ g)
+        else:
+            d = two_loop_direction(g, s_hist, y_hist)
+        dphi0 = float(jnp.dot(g, d))
+        if dphi0 >= 0:
+            d = -g
+            dphi0 = float(jnp.dot(g, d))
+            if mode == "bfgs":
+                H = jnp.eye(n, dtype=dt)
+            else:
+                s_hist.clear()
+                y_hist.clear()
+
+        evals_box = []
+
+        def phi(a):
+            fa, ga = _value_and_grad(objective_func, x + a * d, dt)
+            evals_box.append((a, fa, ga))
+            return fa, float(jnp.dot(ga, d))
+
+        alpha, evals, _ = _strong_wolfe(phi, f, dphi0,
+                                        alpha0=initial_step_length,
+                                        max_iters=max_line_search_iters)
+        num_calls += evals
+        hit = next(((fa, ga) for a, fa, ga in evals_box if a == alpha), None)
+        x_new = x + alpha * d
+        if hit is None:
+            f_new, g_new = _value_and_grad(objective_func, x_new, dt)
+            num_calls += 1
+        else:
+            f_new, g_new = hit
+
+        s = x_new - x
+        y = g_new - g
+        sy = float(jnp.dot(s, y))
+        if sy > 1e-10:
+            if mode == "bfgs":
+                rho = 1.0 / sy
+                I = jnp.eye(n, dtype=dt)
+                V = I - rho * jnp.outer(s, y)
+                H = V @ H @ V.T + rho * jnp.outer(s, s)
+            else:
+                s_hist.append(s)
+                y_hist.append(y)
+                if len(s_hist) > history_size:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+        if float(jnp.abs(s).max()) <= tolerance_change:
+            x, f, g = x_new, f_new, g_new
+            converged = float(jnp.abs(g).max()) <= tolerance_grad
+            break
+        x, f, g = x_new, f_new, g_new
+
+    res = (Tensor(jnp.asarray(converged)),
+           Tensor(jnp.asarray(num_calls, jnp.int32)), Tensor(x),
+           Tensor(jnp.asarray(f, dt)), Tensor(g))
+    if mode == "bfgs":
+        return res + (Tensor(H),)
+    return res
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """ref bfgs.py:27. Returns (is_converge, num_func_calls, position,
+    objective_value, objective_gradient, inverse_hessian_estimate)."""
+    return _minimize(
+        objective_func, initial_position, max_iters=max_iters,
+        tolerance_grad=tolerance_grad, tolerance_change=tolerance_change,
+        line_search_fn=line_search_fn,
+        max_line_search_iters=max_line_search_iters,
+        initial_step_length=initial_step_length, dtype=dtype, mode="bfgs",
+        initial_inverse_hessian_estimate=initial_inverse_hessian_estimate)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8, tolerance_change=1e-8,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """ref lbfgs.py:27. Returns (is_converge, num_func_calls, position,
+    objective_value, objective_gradient)."""
+    return _minimize(
+        objective_func, initial_position, max_iters=max_iters,
+        tolerance_grad=tolerance_grad, tolerance_change=tolerance_change,
+        line_search_fn=line_search_fn,
+        max_line_search_iters=max_line_search_iters,
+        initial_step_length=initial_step_length, dtype=dtype, mode="lbfgs",
+        history_size=history_size)
+
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]

@@ -2,5 +2,6 @@
 from . import lr
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue)
 from .optimizer import Optimizer
-from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
-                         Momentum, RMSProp)
+from .lbfgs import LBFGS
+from .optimizers import (ASGD, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
+                         Lamb, Momentum, RMSProp, Rprop)

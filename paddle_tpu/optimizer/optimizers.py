@@ -309,3 +309,79 @@ class Lamb(Optimizer):
         new_p = p - lr * trust * r
         return new_p, {"moment1": m, "moment2": v,
                        "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (python/paddle/optimizer/asgd.py:29;
+    kernel phi asgd_kernel): keeps the last ``batch_num`` per-batch gradients
+    y_i and steps along their running sum d / min(m+1, n)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        if batch_num <= 0:
+            raise ValueError("batch_num must be positive")
+        self._n = int(batch_num)
+
+    def _state_names(self):
+        return ["d", "ys", "m"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("d", param)
+        store = self._accumulators.setdefault("ys", {})
+        if id(param) not in store:
+            store[id(param)] = jnp.zeros((self._n,) + tuple(param._data.shape),
+                                         jnp.float32)
+        m = self._accumulators.setdefault("m", {})
+        if id(param) not in m:
+            m[id(param)] = jnp.asarray(0, jnp.int32)
+
+    def _update(self, p, g, state, lr):
+        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
+        m = state["m"]
+        i = (m % self._n).astype(jnp.int32)
+        gf = g.astype(jnp.float32)
+        d = state["d"].astype(jnp.float32) - state["ys"][i] + gf
+        ys = state["ys"].at[i].set(gf)
+        count = jnp.minimum(m + 1, self._n).astype(jnp.float32)
+        step_dir = (d / count).astype(g.dtype) + wd * p
+        return p - lr * step_dir, {"d": d, "ys": ys, "m": m + 1}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (python/paddle/optimizer/rprop.py; full-batch
+    sign-based per-weight step sizes)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+        self._initial_lr = learning_rate if isinstance(learning_rate, float) \
+            else 0.001
+
+    def _state_names(self):
+        return ["prev_grad", "lr_t"]
+
+    def _create_accumulators_for(self, param):
+        self._add_accumulator("prev_grad", param)
+        store = self._accumulators.setdefault("lr_t", {})
+        if id(param) not in store:
+            store[id(param)] = jnp.full(param._data.shape, self._initial_lr,
+                                        jnp.float32)
+    def _update(self, p, g, state, lr):
+        gf = g.astype(jnp.float32)
+        sign = jnp.sign(gf * state["prev_grad"])
+        lr_t = jnp.clip(
+            jnp.where(sign > 0, state["lr_t"] * self._eta_plus,
+                      jnp.where(sign < 0, state["lr_t"] * self._eta_minus,
+                                state["lr_t"])),
+            self._lr_min, self._lr_max)
+        # on sign change the step is skipped and the stored grad zeroed
+        g_eff = jnp.where(sign < 0, 0.0, gf)
+        new_p = p - (lr_t * jnp.sign(g_eff)).astype(p.dtype)
+        return new_p, {"prev_grad": g_eff, "lr_t": lr_t}

@@ -1,0 +1,195 @@
+"""ASGD / Rprop / LBFGS and incubate optimizer tier.
+
+Reference test model: test/legacy_test/test_asgd_op.py, test_rprop_op.py,
+test_lbfgs.py (closure API), test/legacy_test/test_bfgs.py (functional
+minimizers on quadratics/Rosenbrock), test_lars_momentum_op.py,
+test_distributed_fused_lamb_op* (single-rank path here).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.tensor import Parameter
+from paddle_tpu.incubate.optimizer import (DistributedFusedLamb,
+                                           GradientMergeOptimizer,
+                                           LarsMomentumOptimizer,
+                                           minimize_bfgs, minimize_lbfgs)
+
+
+def _param(a):
+    return Parameter(np.asarray(a, dtype="float32"))
+
+
+class TestNewOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (optimizer.ASGD, {"batch_num": 2}),
+        (optimizer.Rprop, {}),
+    ])
+    def test_converges_on_quadratic(self, cls, kw):
+        w = _param([3.0, -2.0])
+        opt = cls(learning_rate=0.05, parameters=[w], **kw)
+        for _ in range(200):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float((w * w).sum()._data) < 1e-2
+
+    def test_asgd_averages_last_n_grads(self):
+        # with batch_num=2 the step direction is the mean of the last 2 grads
+        w = _param([0.0])
+        opt = optimizer.ASGD(learning_rate=1.0, batch_num=2, parameters=[w])
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        for g in (2.0, 4.0):
+            w.grad = Tensor(jnp.asarray([g], jnp.float32))
+            opt.step()
+        # step1: d=2, count=1 -> w=-2; step2: d=2+4, count=2 -> w=-2-3=-5
+        np.testing.assert_allclose(np.asarray(w._data), [-5.0], atol=1e-6)
+
+    def test_rprop_grows_and_shrinks_step(self):
+        w = _param([1.0])
+        opt = optimizer.Rprop(learning_rate=0.1, parameters=[w],
+                              etas=(0.5, 1.2),
+                              learning_rate_range=(1e-5, 1.0))
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        w.grad = Tensor(jnp.asarray([1.0], jnp.float32))
+        opt.step()   # first step: lr stays 0.1 (prev grad 0 -> sign 0)
+        p1 = float(w._data[0])
+        w.grad = Tensor(jnp.asarray([1.0], jnp.float32))
+        opt.step()   # same sign: lr *= 1.2
+        p2 = float(w._data[0])
+        assert abs((p1 - p2)) > abs(1.0 - p1)
+
+    def test_lbfgs_rosenbrock(self):
+        w = _param([-1.0, 1.5])
+        opt = optimizer.LBFGS(parameters=[w], line_search_fn="strong_wolfe",
+                              max_iter=40)
+
+        def closure():
+            loss = (1 - w[0]) ** 2 + 10 * (w[1] - w[0] ** 2) ** 2
+            loss.backward()
+            return loss
+
+        f = opt.step(closure)
+        assert f < 1e-6
+        np.testing.assert_allclose(np.asarray(w._data), [1.0, 1.0], atol=1e-3)
+
+    def test_lbfgs_requires_closure(self):
+        w = _param([1.0])
+        opt = optimizer.LBFGS(parameters=[w])
+        with pytest.raises(ValueError):
+            opt.step()
+
+
+class TestFunctionalMinimizers:
+    def _target(self):
+        return paddle.to_tensor(np.array([1.0, -2.0, 3.0], dtype="float32"))
+
+    def test_minimize_bfgs(self):
+        t = self._target()
+
+        def obj(x):
+            return ((x - t) ** 2).sum()
+
+        conv, calls, pos, val, grad, hess = minimize_bfgs(
+            obj, paddle.to_tensor(np.zeros(3, dtype="float32")))
+        assert bool(np.asarray(conv._data))
+        np.testing.assert_allclose(np.asarray(pos._data),
+                                   np.asarray(t._data), atol=1e-4)
+        assert list(hess.shape) == [3, 3]
+
+    def test_minimize_lbfgs(self):
+        t = self._target()
+
+        def obj(x):
+            return ((x - t) ** 2).sum()
+
+        conv, calls, pos, val, grad = minimize_lbfgs(
+            obj, paddle.to_tensor(np.zeros(3, dtype="float32")))
+        assert bool(np.asarray(conv._data))
+        np.testing.assert_allclose(np.asarray(pos._data),
+                                   np.asarray(t._data), atol=1e-4)
+
+    def test_minimize_bfgs_rejects_bad_hessian(self):
+        def obj(x):
+            return (x ** 2).sum()
+
+        bad = paddle.to_tensor(
+            np.array([[1.0, 2.0], [0.0, 1.0]], dtype="float32"))
+        with pytest.raises(ValueError):
+            minimize_bfgs(obj, paddle.to_tensor(np.zeros(2, dtype="float32")),
+                          initial_inverse_hessian_estimate=bad)
+
+
+class TestIncubateOptimizers:
+    def _train(self, opt_factory, steps=5):
+        paddle.seed(7)
+        net = nn.Linear(6, 4)
+        opt = opt_factory(net)
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(steps):
+            x = paddle.to_tensor(rng.randn(16, 6).astype("float32"))
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss._data))
+        return losses
+
+    def test_lars_momentum_trains(self):
+        paddle.seed(7)
+        net = nn.Linear(6, 4)
+        opt = LarsMomentumOptimizer(learning_rate=0.5, lars_coeff=0.1,
+                                    parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((16, 6), dtype="float32"))
+        losses = []
+        for _ in range(10):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss._data))
+        assert losses[-1] < losses[0]
+
+    def test_distributed_fused_lamb_trains(self):
+        losses = self._train(lambda net: DistributedFusedLamb(
+            learning_rate=0.05, parameters=net.parameters()))
+        assert losses[-1] < losses[0]
+
+    def test_distributed_fused_lamb_grad_accumulation(self):
+        paddle.seed(7)
+        net = nn.Linear(4, 4)
+        opt = DistributedFusedLamb(learning_rate=0.05,
+                                   parameters=net.parameters(),
+                                   gradient_accumulation_steps=2)
+        w0 = np.asarray(net.weight._data).copy()
+        x = paddle.to_tensor(np.ones((4, 4), dtype="float32"))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()  # first micro-batch: no update yet
+        np.testing.assert_allclose(np.asarray(net.weight._data), w0)
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()  # second: applies
+        assert not np.allclose(np.asarray(net.weight._data), w0)
+
+    def test_gradient_merge(self):
+        paddle.seed(7)
+        net = nn.Linear(4, 4)
+        inner = optimizer.SGD(learning_rate=0.1,
+                              parameters=net.parameters())
+        opt = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+        w0 = np.asarray(net.weight._data).copy()
+        x = paddle.to_tensor(np.ones((4, 4), dtype="float32"))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        np.testing.assert_allclose(np.asarray(net.weight._data), w0)
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        assert not np.allclose(np.asarray(net.weight._data), w0)
