@@ -1,0 +1,29 @@
+"""Recorder hook seam for the SOT segment compiler (jit/sot.py).
+
+Lives in core so tensor.py / ops/registry.py can notify without importing
+the jit package (no import cycle, one list-indexing check when idle —
+the same cost profile as the capture/profiler hooks).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+RECORDER: List[Optional[object]] = [None]
+
+
+def notify_op(call, in_tensors, out_tensors):
+    rec = RECORDER[0]
+    if rec is not None:
+        rec.on_op(call, in_tensors, out_tensors)
+
+
+def notify_break(tensor, kind, value):
+    rec = RECORDER[0]
+    if rec is not None:
+        rec.on_break(tensor, kind, value)
+
+
+def notify_mutation(tensor, new_data):
+    rec = RECORDER[0]
+    if rec is not None:
+        rec.on_mutation(tensor, new_data)

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dtype_mod
+from . import sot_hooks
 from ..autograd import engine as _engine
 
 
@@ -111,11 +112,19 @@ class Tensor:
         return ops.transpose(self, list(range(self.ndim))[::-1])
 
     # -- conversion ---------------------------------------------------------
+    # each materialization notifies the SOT recorder: these are the graph
+    # breaks of the segment compiler (jit/sot.py)
     def numpy(self) -> np.ndarray:
-        return np.asarray(self._data)
+        a = np.asarray(self._data)
+        if sot_hooks.RECORDER[0] is not None:
+            sot_hooks.notify_break(self, "numpy", a)
+        return a
 
     def item(self):
-        return self._data.item()
+        v = self._data.item()
+        if sot_hooks.RECORDER[0] is not None:
+            sot_hooks.notify_break(self, "item", v)
+        return v
 
     def tolist(self):
         return self.numpy().tolist()
@@ -125,13 +134,22 @@ class Tensor:
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
-        return float(self.item())
+        v = float(self._data.item())
+        if sot_hooks.RECORDER[0] is not None:
+            sot_hooks.notify_break(self, "float", v)
+        return v
 
     def __int__(self):
-        return int(self.item())
+        v = int(self._data.item())
+        if sot_hooks.RECORDER[0] is not None:
+            sot_hooks.notify_break(self, "int", v)
+        return v
 
     def __bool__(self):
-        return bool(self._data)
+        v = bool(self._data)
+        if sot_hooks.RECORDER[0] is not None:
+            sot_hooks.notify_break(self, "bool", v)
+        return v
 
     # -- autograd -----------------------------------------------------------
     @property
@@ -182,11 +200,15 @@ class Tensor:
         cap = capture.active()
         if cap is not None:
             cap.record_mutation(self)
+        if sot_hooks.RECORDER[0] is not None:
+            sot_hooks.notify_mutation(self, new_data)
         self._data = new_data
 
     def set_value(self, value):
         value = _unwrap(value)
-        self._data = jnp.asarray(value, dtype=self.dtype).reshape(self._data.shape)
+        # through _set_data so capture and the SOT recorder observe it
+        self._set_data(jnp.asarray(value, dtype=self.dtype)
+                       .reshape(self._data.shape))
         return self
 
     def copy_(self, other, blocking: bool = True):

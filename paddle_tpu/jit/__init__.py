@@ -170,8 +170,15 @@ class StaticFunction:
 
     def _run(self, entry, args, kwargs):
         if entry.get("fallback"):
-            # graph broke on a previous call: this signature runs eagerly
-            return self._fn(*args, **kwargs)
+            # graph broke on a previous call: the SOT segment compiler takes
+            # over this signature — compiled sub-graphs between the breaks,
+            # guarded on the break values (jit/sot.py)
+            sot_cache = entry.get("sot")
+            if sot_cache is None:
+                from .sot import SOTCache
+                sot_cache = SOTCache(self._fn)
+                entry["sot"] = sot_cache
+            return sot_cache.run(args, kwargs)
         try:
             return self._run_compiled(entry, args, kwargs)
         except self._graph_break_errors() as e:

@@ -1,0 +1,414 @@
+"""SOT-style sub-graph compilation for graph-break functions.
+
+Reference: jit/sot (opcode_translator + symbolic compile_cache,
+SURVEY.md §2.13) — when python control flow depends on tensor values,
+SOT compiles the largest sub-graphs between breaks and guards on the
+values that drove the control flow, falling back to eager only for the
+breaking expression itself.
+
+TPU-native redesign: instead of simulating CPython bytecode, the op
+stream of ONE eager run is recorded at the dispatch layer. Tensor→python
+materializations (bool()/int()/float()/item()/numpy()) are the graph
+breaks; they split the stream into segments. Each segment compiles to one
+XLA executable; replay walks a guard trie keyed by the observed break
+values (the SOT guard analog), so stable control flow runs fully
+compiled and a novel branch re-records and extends the trie.
+
+Unsupported in a recorded trace (falls back to plain eager, like SOT's
+dynamic-shape fallbacks): RNG draws (the frozen closure would replay one
+mask forever) and in-trace backward() (the tape does not pass through
+dispatch).
+
+Semantics note (same as to_static whole-graph capture): python-level
+constants the function reads — globals, closure variables, layer python
+attributes — are baked in at record time; only Tensor values stay live
+across replays (externals resolve to their current data every call).
+Guards cover tensor materializations, not python state. Code that flips a
+python flag between calls must keep that flag in a Tensor or stay eager.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import capture as _capture
+from ..core.tensor import Tensor
+
+# hook seam lives in core/sot_hooks.py so tensor/registry can notify
+# without importing the jit package
+from ..core.sot_hooks import RECORDER as _RECORDER
+
+
+def active() -> Optional["_Recorder"]:
+    return _RECORDER[0]
+
+
+def _guard_value(kind: str, value):
+    """Canonical, hashable guard for a materialized value."""
+    if kind == "numpy":
+        return ("numpy", hashlib.sha1(value.tobytes()).hexdigest(),
+                value.shape, str(value.dtype))
+    return (kind, value if not isinstance(value, (list, tuple))
+            else tuple(value))
+
+
+class _OpRecord:
+    __slots__ = ("call", "in_refs", "n_out")
+
+    def __init__(self, call, in_refs, n_out):
+        self.call = call
+        self.in_refs = in_refs
+        self.n_out = n_out
+
+
+class _Recorder:
+    """Records one eager run: op stream + breaks + mutations + outputs."""
+
+    def __init__(self, arg_tensors):
+        self.ops: List[_OpRecord] = []
+        self.breaks: List[Tuple[int, Tuple, Any]] = []  # (op_len, src, guard)
+        self.mutations: List[Tuple[Any, Tuple]] = []    # (tensor, src_ref)
+        self.externals: List[Any] = []                  # Tensor objects
+        self._ext_index: Dict[int, int] = {}
+        self._src: Dict[int, Tuple] = {}                # id(Tensor) -> ref
+        self._arr_src: Dict[int, Tuple] = {}            # id(jax.Array) -> ref
+        self.invalid: Optional[str] = None
+        for pos, t in enumerate(arg_tensors):
+            self._src[id(t)] = ("arg", pos)
+            self._arr_src[id(t._data)] = ("arg", pos)
+
+    def _ref_of(self, t) -> Tuple:
+        ref = self._src.get(id(t))
+        if ref is None:
+            idx = self._ext_index.get(id(t))
+            if idx is None:
+                idx = len(self.externals)
+                self.externals.append(t)
+                self._ext_index[id(t)] = idx
+            ref = ("ext", idx)
+            self._src[id(t)] = ref
+        return ref
+
+    def on_op(self, call, in_tensors, out_tensors):
+        in_refs = [self._ref_of(t) for t in in_tensors]
+        k = len(self.ops)
+        self.ops.append(_OpRecord(call, in_refs, len(out_tensors)))
+        for j, t in enumerate(out_tensors):
+            self._src[id(t)] = ("op", k, j)
+            self._arr_src[id(t._data)] = ("op", k, j)
+
+    def on_break(self, tensor, kind, value):
+        src = self._src.get(id(tensor))
+        if src is None:
+            # materializing a tensor the trace never saw (e.g. created by
+            # jnp outside dispatch): treat as external constant
+            src = self._ref_of(tensor)
+        self.breaks.append((len(self.ops), src, _guard_value(kind, value)))
+
+    def on_mutation(self, tensor, new_data):
+        src = self._arr_src.get(id(new_data))
+        if src is None:
+            self.invalid = "mutation from an untracked array"
+            return
+        # target by ref when possible (an arg Tensor is fresh each call —
+        # the recorded object must not be mutated at replay)
+        target = self._src.get(id(tensor))
+        if target is None or target[0] == "op":
+            target = ("obj", tensor) if target is None else target
+        self.mutations.append((target if target[0] in ("arg", "ext")
+                               else ("obj", tensor), src))
+        # later reads of the mutated tensor must resolve to the NEW value
+        self._src[id(tensor)] = src
+
+
+# ---------------------------------------------------------------------------
+# trace -> guard trie of compiled segments
+# ---------------------------------------------------------------------------
+
+
+class _TrieNode:
+    __slots__ = ("ops_lo", "ops_hi", "seg_fn", "seg_in_refs", "seg_out_refs",
+                 "break_src", "children", "out_builder", "mutations")
+
+    def __init__(self):
+        self.ops_lo = 0
+        self.ops_hi = 0
+        self.seg_fn = None            # jitted fn(*arrays) -> tuple(arrays)
+        self.seg_in_refs: List[Tuple] = []
+        self.seg_out_refs: List[Tuple] = []
+        self.break_src: Optional[Tuple] = None   # ref whose value guards next
+        self.children: Dict[Any, "_TrieNode"] = {}
+        self.out_builder = None       # leaf: (treedef, leaf_descr list)
+        self.mutations: List[Tuple[Any, Tuple]] = []
+
+
+class SOTCache:
+    """Per-(function, signature) guard trie of compiled segments."""
+
+    # a signature whose guards never repeat (e.g. `if float(loss) > t:` on a
+    # changing loss) would re-record and re-jit every call; after this many
+    # recordings with no replay ever completing, the cache declares the
+    # guards unstable and pins the signature to plain eager
+    MAX_RECORDINGS_WITHOUT_REPLAY = 8
+    MAX_TRIE_CHILDREN = 16
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._root: Optional[_TrieNode] = None
+        self._externals: List[Any] = []
+        self._always_eager: Optional[str] = None
+        self._record_count = 0
+        self._replay_hits = 0
+
+    # -- recording ----------------------------------------------------------
+    def _record(self, args, kwargs):
+        self._record_count += 1
+        if self._record_count > self.MAX_RECORDINGS_WITHOUT_REPLAY \
+                and self._replay_hits == 0:
+            self._always_eager = "unstable guards (no replay ever hit)"
+            return self._fn(*args, **kwargs)
+        flat = jax.tree_util.tree_flatten((args, kwargs),
+                                          is_leaf=_is_tensor)[0]
+        arg_tensors = [x for x in flat if _is_tensor(x)]
+        rec = _Recorder(arg_tensors)
+        cap = _capture.CaptureContext()
+        _RECORDER[0] = rec
+        try:
+            with cap:
+                out = self._fn(*args, **kwargs)
+        finally:
+            _RECORDER[0] = None
+        if cap.rng_used:
+            self._always_eager = "rng used in trace"
+            return out
+        if cap.grad_writes:
+            self._always_eager = "backward() inside trace"
+            return out
+        if rec.invalid:
+            self._always_eager = rec.invalid
+            return out
+        self._merge(rec, out)
+        return out
+
+    def _merge(self, rec: _Recorder, out):
+        # externals are merged by object identity across recordings
+        ext_map = {}
+        for i, t in enumerate(rec.externals):
+            for j, e in enumerate(self._externals):
+                if e is t:
+                    ext_map[i] = j
+                    break
+            else:
+                ext_map[i] = len(self._externals)
+                self._externals.append(t)
+
+        def remap(ref):
+            return ("ext", ext_map[ref[1]]) if ref[0] == "ext" else ref
+
+        # escape analysis: op outputs needed beyond their own segment
+        bounds = [b[0] for b in rec.breaks] + [len(rec.ops)]
+        seg_of_op = {}
+        lo = 0
+        for si, hi in enumerate(bounds):
+            for k in range(lo, hi):
+                seg_of_op[k] = si
+            lo = hi
+        escapes: Dict[int, set] = {i: set() for i in range(len(bounds))}
+
+        def need(ref, at_seg):
+            if ref[0] == "op" and seg_of_op[ref[1]] != at_seg:
+                escapes[seg_of_op[ref[1]]].add((ref[1], ref[2]))
+
+        for k, op in enumerate(rec.ops):
+            for r in op.in_refs:
+                need(r, seg_of_op[k])
+        for pos, src, _ in rec.breaks:
+            if src[0] == "op":
+                escapes[seg_of_op[src[1]]].add((src[1], src[2]))
+        for _, src in rec.mutations:
+            if src[0] == "op":
+                escapes[seg_of_op[src[1]]].add((src[1], src[2]))
+        out_flat, out_treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=_is_tensor)
+        leaf_descr = []
+        for leaf in out_flat:
+            if _is_tensor(leaf):
+                ref = rec._src.get(id(leaf))
+                if ref is None:
+                    ref = remap(("ext", rec._ext_index[id(leaf)])) \
+                        if id(leaf) in rec._ext_index else None
+                if ref is None:
+                    leaf_descr.append(("const_tensor", leaf))
+                else:
+                    r = remap(ref)
+                    if r[0] == "op":
+                        escapes[seg_of_op[r[1]]].add((r[1], r[2]))
+                    leaf_descr.append(("ref", r))
+            else:
+                leaf_descr.append(("static", leaf))
+
+        # walk/extend the trie segment by segment
+        if self._root is None:
+            self._root = _TrieNode()
+        node = self._root
+        lo = 0
+        for si, hi in enumerate(bounds):
+            if node.seg_fn is None:
+                node.ops_lo, node.ops_hi = lo, hi
+                self._build_segment(node, rec, lo, hi,
+                                    sorted(escapes[si]), remap)
+            else:
+                # a later-recorded branch may consume prefix outputs the
+                # first compile did not export: rebuild with the union
+                have = {(k, j) for _, k, j in node.seg_out_refs}
+                if not escapes[si] <= have:
+                    self._build_segment(node, rec, lo, hi,
+                                        sorted(escapes[si] | have), remap)
+            if si < len(rec.breaks):
+                _, src, guard = rec.breaks[si]
+                node.break_src = remap(src) if src[0] != "op" else src
+                child = node.children.get(guard)
+                if child is None:
+                    child = _TrieNode()
+                    node.children[guard] = child
+                node = child
+            lo = hi
+        node.out_builder = (out_treedef, leaf_descr)
+        node.mutations = [
+            (t if t[0] == "obj" else remap(t), remap(src))
+            for t, src in rec.mutations]
+
+    def _build_segment(self, node, rec, lo, hi, escape_list, remap):
+        ops = rec.ops[lo:hi]
+        # segment inputs: every ref consumed that is not produced in-segment
+        in_refs = []
+        seen = set()
+        for op in ops:
+            for r in op.in_refs:
+                rr = remap(r)
+                if rr[0] == "op" and lo <= rr[1] < hi:
+                    continue
+                if rr not in seen:
+                    seen.add(rr)
+                    in_refs.append(rr)
+        out_refs = [("op", k, j) for k, j in escape_list]
+        in_index = {r: i for i, r in enumerate(in_refs)}
+
+        def seg(*arrays):
+            env = {}
+
+            def get(ref):
+                rr = remap(ref)
+                if rr[0] == "op" and lo <= rr[1] < hi:
+                    return env[(rr[1], rr[2])]
+                return arrays[in_index[rr]]
+
+            for k, op in enumerate(ops, start=lo):
+                res = op.call(*[get(r) for r in op.in_refs])
+                leaves = jax.tree_util.tree_leaves(res)
+                for j, leaf in enumerate(leaves):
+                    env[(k, j)] = leaf
+            return tuple(env[(k, j)] for k, j in escape_list)
+
+        node.seg_fn = jax.jit(seg)
+        node.seg_in_refs = in_refs
+        node.seg_out_refs = out_refs
+
+    # -- replay -------------------------------------------------------------
+    def run(self, args, kwargs):
+        if self._always_eager is not None:
+            return self._fn(*args, **kwargs)
+        if self._root is None:
+            return self._record(args, kwargs)
+
+        from ..ops import registry as _registry
+        flat = jax.tree_util.tree_flatten((args, kwargs),
+                                          is_leaf=_is_tensor)[0]
+        arg_tensors = [x for x in flat if _is_tensor(x)]
+        env: Dict[Tuple, Any] = {}   # ("op",k,j) -> Tensor
+
+        def resolve(ref) -> Tensor:
+            if ref[0] == "arg":
+                return arg_tensors[ref[1]]
+            if ref[0] == "ext":
+                return self._externals[ref[1]]
+            return env[ref]
+
+        node = self._root
+        while True:
+            if node.seg_fn is None:
+                # path recorded structurally but never compiled (shouldn't
+                # happen) — re-record to be safe
+                return self._record(args, kwargs)
+            if node.ops_hi > node.ops_lo:
+                ins = [resolve(r) for r in node.seg_in_refs]
+                outs = _registry.dispatch(node.seg_fn, tuple(ins), {},
+                                          op_name="sot_segment")
+                if node.seg_out_refs:
+                    if len(node.seg_out_refs) == 1 and _is_tensor(outs):
+                        outs = (outs,)
+                    for r, t in zip(node.seg_out_refs, outs):
+                        env[r] = t if _is_tensor(t) else Tensor(t)
+            if node.break_src is None:
+                self._replay_hits += 1
+                return self._finish(node, env, resolve)
+            guard_t = resolve(node.break_src)
+            child = None
+            for guard, cand in node.children.items():
+                if self._guard_matches(guard, guard_t):
+                    child = cand
+                    break
+            if child is None:
+                if len(node.children) >= self.MAX_TRIE_CHILDREN:
+                    self._always_eager = "guard fan-out exceeded cap"
+                    return self._fn(*args, **kwargs)
+                # novel branch: eager re-record extends the trie
+                return self._record(args, kwargs)
+            node = child
+
+    @staticmethod
+    def _guard_matches(guard, tensor) -> bool:
+        kind = guard[0]
+        data = tensor._data
+        try:
+            if kind == "bool":
+                return bool(data) == guard[1]
+            if kind == "int":
+                return int(data.item()) == guard[1]
+            if kind == "float":
+                return float(data.item()) == guard[1]
+            if kind == "item":
+                return data.item() == guard[1]
+            if kind == "numpy":
+                import numpy as np
+                a = np.asarray(data)
+                return (a.shape == guard[2] and str(a.dtype) == guard[3]
+                        and hashlib.sha1(a.tobytes()).hexdigest() == guard[1])
+        except Exception:
+            return False
+        return False
+
+    def _finish(self, node, env, resolve):
+        for target, src in node.mutations:
+            t = target[1] if target[0] == "obj" else resolve(target)
+            t._set_data(resolve(src)._data)
+        treedef, leaf_descr = node.out_builder
+        leaves = []
+        for kind, payload in leaf_descr:
+            if kind == "ref":
+                leaves.append(resolve(payload))
+            elif kind == "const_tensor":
+                leaves.append(payload)
+            else:
+                leaves.append(payload)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+__all__ = ["SOTCache", "active"]

@@ -33,6 +33,7 @@ from ..profiler import _ACTIVE as _PROF_ACTIVE  # module-level list; mutated
                                                 # in place by the profiler
 from ..autograd.engine import GradNode
 from ..core import capture
+from ..core import sot_hooks
 from ..core.tensor import Tensor
 
 OP_REGISTRY: Dict[str, dict] = {}
@@ -108,10 +109,14 @@ def _dispatch_impl(fn: Callable, args, kwargs, op_name: str,
     if not requires:
         out = call(*arrays)
         res = _wrap_outputs(out, stop_gradient=True)
-        if cap is not None:
-            for leaf in jax.tree_util.tree_leaves(res, is_leaf=_is_tensor):
-                if _is_tensor(leaf):
+        if cap is not None or sot_hooks.RECORDER[0] is not None:
+            out_leaves_t = [leaf for leaf in jax.tree_util.tree_leaves(
+                res, is_leaf=_is_tensor) if _is_tensor(leaf)]
+            if cap is not None:
+                for leaf in out_leaves_t:
                     cap.record_created(leaf)
+            if sot_hooks.RECORDER[0] is not None:
+                sot_hooks.notify_op(call, in_tensors, out_leaves_t)
         return res
 
     out, raw_vjp = jax.vjp(call, *arrays)
@@ -133,6 +138,8 @@ def _dispatch_impl(fn: Callable, args, kwargs, op_name: str,
         if cap is not None:
             cap.record_created(t)
         wrapped_leaves.append(t)
+    if sot_hooks.RECORDER[0] is not None:
+        sot_hooks.notify_op(call, in_tensors, wrapped_leaves)
     if len(wrapped_leaves) == 1 and out is out_leaves[0]:
         return wrapped_leaves[0]
     return jax.tree_util.tree_unflatten(out_treedef, wrapped_leaves)

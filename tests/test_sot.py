@@ -1,0 +1,183 @@
+"""SOT segment compiler (jit/sot.py).
+
+Reference test model: test/sot/* — graph-break functions keep working,
+sub-graphs before/after the break compile, guards route control flow, and
+novel branches extend the cache instead of erroring.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+
+
+def _arr(x):
+    return np.asarray(x._data)
+
+
+class TestSOTSegments:
+    def test_replay_skips_python_and_matches_eager(self):
+        calls = {"n": 0}
+
+        @jit.to_static(full_graph=False)
+        def f(x):
+            calls["n"] += 1
+            y = x * 2 + 1
+            if float(y.sum()) > 0:
+                z = y * 3
+            else:
+                z = y - 5
+            return z.sum()
+
+        xp = paddle.to_tensor(np.ones(4, dtype="float32"))
+        for _ in range(3):  # trace-attempt, eager fallback, SOT record
+            f(xp)
+        n0 = calls["n"]
+        out = f(xp)
+        assert calls["n"] == n0, "replay must not run the python body"
+        assert float(out._data) == 36.0
+
+    def test_guard_trie_routes_both_branches(self):
+        calls = {"n": 0}
+
+        @jit.to_static(full_graph=False)
+        def f(x):
+            calls["n"] += 1
+            if bool((x.sum() > 0)):
+                return (x * 3).sum()
+            return (x - 5).sum()
+
+        xp = paddle.to_tensor(np.ones(4, dtype="float32"))
+        xn = paddle.to_tensor(-np.ones(4, dtype="float32"))
+        for _ in range(3):
+            f(xp)
+        for _ in range(2):
+            f(xn)  # novel guard -> re-record extends the trie
+        n0 = calls["n"]
+        assert float(f(xn)._data) == -24.0
+        assert float(f(xp)._data) == 12.0
+        assert calls["n"] == n0, "both branches should replay compiled"
+
+    def test_gradient_through_segments(self):
+        @jit.to_static(full_graph=False)
+        def f(x):
+            y = x * 2 + 1
+            if float(y.sum()) > 0:
+                return (y * 3).sum()
+            return y.sum()
+
+        xw = paddle.to_tensor(np.ones(4, dtype="float32"))
+        for _ in range(3):
+            f(xw)
+        x = paddle.to_tensor(np.ones(4, dtype="float32"))
+        x.stop_gradient = False
+        out = f(x)
+        out.backward()
+        np.testing.assert_allclose(_arr(x.grad), np.full(4, 6.0), atol=1e-6)
+
+    def test_int_guard_and_multiple_breaks(self):
+        @jit.to_static(full_graph=False)
+        def f(x):
+            k = int(x.sum())          # break 1 (int guard)
+            y = x * k
+            if bool(y.max() > 2):     # break 2 (bool guard)
+                y = y + 10
+            return y.sum()
+
+        x2 = paddle.to_tensor(np.full(2, 2.0, dtype="float32"))
+        for _ in range(3):
+            f(x2)
+        # k = 4, y = 8 each, max(8) > 2 -> +10 -> sum = 36
+        assert float(f(x2)._data) == 36.0
+
+    def test_state_mutation_replayed(self):
+        counter = paddle.to_tensor(np.zeros(1, dtype="float32"))
+
+        @jit.to_static(full_graph=False)
+        def f(x):
+            new = counter + 1
+            counter._set_data(new._data)
+            if float(x.sum()) > 0:
+                return x * counter
+            return x
+
+        x = paddle.to_tensor(np.ones(2, dtype="float32"))
+        for _ in range(3):
+            f(x)
+        c3 = float(counter._data[0])
+        f(x)  # replay must still bump the counter
+        assert float(counter._data[0]) == c3 + 1
+
+    def test_rng_trace_falls_back_to_eager(self):
+        calls = {"n": 0}
+
+        @jit.to_static(full_graph=False)
+        def f(x):
+            calls["n"] += 1
+            import paddle_tpu.nn.functional as F
+            y = F.dropout(x, p=0.5, training=True)
+            if float(x.sum()) > 0:
+                return y.sum()
+            return x.sum()
+
+        x = paddle.to_tensor(np.ones(64, dtype="float32"))
+        outs = {float(f(x)._data) for _ in range(6)}
+        # 6 calls = 7 body executions: the aborted whole-graph compile
+        # attempt on call 2 also runs the body once before breaking
+        assert calls["n"] == 7, "rng traces must stay eager (fresh masks)"
+        assert len(outs) > 1, "dropout masks must differ call to call"
+
+    def test_arg_mutation_hits_current_call_tensor(self):
+        # mutation of an ARG tensor must apply to the tensor passed at
+        # replay time, not the recording-time object
+        @jit.to_static(full_graph=False)
+        def f(x):
+            doubled = x * 2
+            x._set_data(doubled._data)
+            if float(x.sum()) > 0:
+                return x + 1
+            return x
+
+        f(paddle.to_tensor(np.array([2.0, 1.0], dtype="float32")))
+        f(paddle.to_tensor(np.array([2.0, 1.0], dtype="float32")))
+        t_rec = paddle.to_tensor(np.array([2.0, 1.0], dtype="float32"))
+        f(t_rec)  # the SOT recording call mutates its own arg eagerly
+        np.testing.assert_allclose(_arr(t_rec), [4.0, 2.0])
+        fresh = paddle.to_tensor(np.array([2.0, 1.0], dtype="float32"))
+        out = f(fresh)  # replay
+        np.testing.assert_allclose(_arr(fresh), [4.0, 2.0])
+        np.testing.assert_allclose(_arr(out), [5.0, 3.0])
+        # the recording-time arg must NOT be re-mutated by the replay
+        np.testing.assert_allclose(_arr(t_rec), [4.0, 2.0])
+
+    def test_unstable_guards_pin_to_eager(self):
+        calls = {"n": 0}
+
+        @jit.to_static(full_graph=False)
+        def f(x):
+            calls["n"] += 1
+            if float(x.sum()) > 1e9:   # guard value varies every call
+                return x * 2
+            return x + 1
+
+        from paddle_tpu.jit.sot import SOTCache
+        cap = SOTCache.MAX_RECORDINGS_WITHOUT_REPLAY
+        # every call has a different sum -> every guard misses
+        for i in range(cap + 6):
+            f(paddle.to_tensor(np.full(2, float(i), dtype="float32")))
+        # after the cap, the signature pins to eager: python runs every call
+        n0 = calls["n"]
+        f(paddle.to_tensor(np.full(2, 777.0, dtype="float32")))
+        assert calls["n"] == n0 + 1
+
+    def test_full_graph_true_still_raises(self):
+        @jit.to_static(full_graph=True)
+        def f(x):
+            if float(x.sum()) > 0:
+                return x * 2
+            return x
+
+        x = paddle.to_tensor(np.ones(2, dtype="float32"))
+        f(x)
+        import pytest
+        with pytest.raises(RuntimeError):
+            f(x)
