@@ -268,6 +268,11 @@ class DistributedFusedLamb(Optimizer):
             self._grad_clip([p for p in self._parameter_list
                              if p.grad is not None])
         g = self._flat_grads()
+        # consume the grads now: backward() ACCUMULATES into p.grad, so
+        # leaving them in place would double-count earlier micro-batches
+        # in the accumulation path
+        for p in self._parameter_list:
+            p.clear_grad()
         if self._flat is None:
             z = jnp.zeros_like(g)
             # fp32 master copy of the params: low-precision params would

@@ -12,6 +12,7 @@ from __future__ import annotations
 from .input_spec import InputSpec
 from .program import (Executor, Program, data, default_main_program,
                       default_startup_program, program_guard)
+from . import quantization
 
 __all__ = ["InputSpec", "Program", "Executor", "program_guard", "data",
-           "default_main_program", "default_startup_program"]
+           "default_main_program", "default_startup_program", "quantization"]

@@ -1,0 +1,389 @@
+"""Static-graph quantization surface.
+
+Reference: python/paddle/static/quantization/
+(post_training_quantization.py:PostTrainingQuantization — executor-driven
+calibration inserting quant/dequant into a ProgramDesc; quantization_pass.py
+pass zoo; cal_kl_threshold.py — KL-divergence threshold search;
+utils.py WeightQuantization helpers).
+
+TPU-native redesign: the "static program" here is the captured XLA
+computation, so quantization transforms operate on the Layer tree before
+capture (the dygraph quantization framework in paddle_tpu.quantization does
+the layer swapping) and the calibrated model exports through jit.save as an
+AOT StableHLO program. The pass classes keep the reference's entry-point
+names but delegate to the swap/convert machinery — the IR-level insertion
+the reference hand-writes falls out of re-capturing the swapped model.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..quantization import (AbsmaxObserver, BaseObserver, HistObserver,
+                            PTQ, QuantConfig, convert)
+
+__all__ = ["cal_kl_threshold", "KLObserver", "PostTrainingQuantization",
+           "WeightQuantization", "QuantizationTransformPass",
+           "QuantizationFreezePass", "AddQuantDequantPass",
+           "OutScaleForTrainingPass", "OutScaleForInferencePass",
+           "quant_post_static", "quant_post_dynamic"]
+
+
+# ---------------------------------------------------------------------------
+# KL threshold (cal_kl_threshold.py)
+# ---------------------------------------------------------------------------
+
+def _expand_quantized(q_small, p, i, levels):
+    """Expand a `levels`-bin quantized view of p[:i] back to i bins,
+    distributing each quantized bin's mass over its nonzero source bins."""
+    q = np.zeros(i, dtype=np.float64)
+    step = i / levels
+    for b in range(levels):
+        lo = int(np.floor(b * step))
+        hi = int(np.ceil((b + 1) * step))
+        hi = min(hi, i)
+        src = p[lo:hi]
+        nz = src > 0
+        n_nz = int(nz.sum())
+        if n_nz:
+            q[lo:hi][nz] = q_small[b] / n_nz
+    return q
+
+
+def cal_kl_threshold(hist, bin_width, bits=8):
+    """Pick the saturation threshold minimizing KL(P||Q) between the fp32
+    activation histogram and its int-`bits` quantization (the TensorRT-style
+    calibration the reference implements in cal_kl_threshold.py)."""
+    hist = np.asarray(hist, dtype=np.float64)
+    n_bins = hist.size
+    levels = 2 ** (bits - 1)     # 128 for int8
+    if n_bins <= levels:
+        return float(n_bins * bin_width)
+    best_i, best_kl = n_bins, np.inf
+    total = hist.sum()
+    if total <= 0:
+        return float(n_bins * bin_width)
+    for i in range(levels, n_bins + 1, max(1, (n_bins - levels) // 128)):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()        # clip outliers into the edge
+        p /= p.sum()
+        # quantize the first i bins down to `levels` bins
+        q_small = np.add.reduceat(
+            hist[:i], np.floor(np.arange(levels) * i / levels).astype(int))
+        q = _expand_quantized(q_small, hist[:i], i, levels)
+        qs = q.sum()
+        if qs <= 0:
+            continue
+        q /= qs
+        mask = p > 0
+        kl = float(np.sum(p[mask] * np.log(
+            p[mask] / np.maximum(q[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return float((best_i + 0.5) * bin_width)
+
+
+class KLObserver(BaseObserver):
+    """Histogram observer whose scale is the KL-optimal threshold."""
+
+    def __init__(self, quant_bits=8, bins_count=2048):
+        super().__init__(quant_bits)
+        self._hist = HistObserver(quant_bits, bins_count=bins_count)
+
+    def observe(self, x):
+        self._hist.observe(x)
+
+    def scales(self):
+        h = self._hist
+        if h._hist is None or h._hist.sum() == 0:
+            return np.float32(1.0)
+        bin_width = float(h._edges[1] - h._edges[0])
+        return np.float32(cal_kl_threshold(h._hist, bin_width,
+                                           self.quant_bits))
+
+
+# ---------------------------------------------------------------------------
+# PostTrainingQuantization (post_training_quantization.py:PostTrainingQuantization)
+# ---------------------------------------------------------------------------
+
+_ALGO_OBSERVERS = {
+    "KL": lambda bits: KLObserver(bits),
+    "abs_max": lambda bits: AbsmaxObserver(bits),
+    "hist": lambda bits: HistObserver(bits),
+    "avg": lambda bits: AbsmaxObserver(bits),
+    "mse": lambda bits: HistObserver(bits, percent=0.9995),
+}
+
+
+class _ObserverFactory:
+    """Adapter giving QuantConfig the `_instance()` protocol per swap site."""
+
+    def __init__(self, make):
+        self._make = make
+
+    def _instance(self):
+        return self._make()
+
+
+class PostTrainingQuantization:
+    """Calibrate a float model on sample data, produce the quantized model.
+
+    Reference flow (post_training_quantization.py): load program → insert
+    observers for quantizable ops → run calibration batches on an executor →
+    compute thresholds (KL/hist/abs_max/avg/mse) → insert quant/dequant +
+    freeze weights → save. Here the model is a Layer; the executor role is
+    plain eager evaluation; freezing = `convert`; saving = jit.save (AOT).
+    """
+
+    def __init__(self, executor=None, model_dir=None, model=None,
+                 sample_generator=None, data_loader=None, batch_size=10,
+                 batch_nums=None, algo="KL", quantizable_op_type=None,
+                 weight_bits=8, activation_bits=8, is_full_quantize=False,
+                 onnx_format=False, skip_tensor_list=None, scope=None,
+                 **kwargs):
+        if model is None:
+            raise ValueError(
+                "pass the float model via `model=` (the TPU build quantizes "
+                "Layers; ProgramDesc dirs do not exist here)")
+        if algo not in _ALGO_OBSERVERS:
+            raise ValueError(f"algo must be one of {list(_ALGO_OBSERVERS)}")
+        self._model = model
+        self._algo = algo
+        self._weight_bits = weight_bits
+        self._act_bits = activation_bits
+        self._data_loader = data_loader
+        self._sample_generator = sample_generator
+        self._batch_size = batch_size
+        self._batch_nums = batch_nums
+        self._quantized: Optional[Layer] = None
+
+    def _batches(self):
+        if self._data_loader is not None:
+            yield from self._data_loader
+            return
+        if self._sample_generator is None:
+            raise ValueError("need data_loader or sample_generator")
+        batch = []
+        for sample in self._sample_generator():
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    def quantize(self) -> Layer:
+        bits = self._act_bits
+        algo = self._algo
+        cfg = QuantConfig(
+            activation=_ObserverFactory(
+                lambda: _ALGO_OBSERVERS[algo](bits)),
+            weight=_ObserverFactory(
+                lambda: AbsmaxObserver(self._weight_bits)))
+        observed = PTQ(cfg).quantize(self._model, inplace=False)
+        observed.eval()
+        n = 0
+        for batch in self._batches():
+            if isinstance(batch, (list, tuple)) and batch and \
+                    isinstance(batch[0], np.ndarray):
+                # sample_generator path: stack samples into one batch input
+                ts = [Tensor(np.stack(batch))]
+            else:
+                xs = batch if isinstance(batch, (list, tuple)) else [batch]
+                ts = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+                      for x in xs]
+            # weight observers see the weights during forward; activation
+            # observers collect input ranges
+            observed(*ts)
+            n += 1
+            if self._batch_nums is not None and n >= self._batch_nums:
+                break
+        self._quantized = convert(observed, inplace=True)
+        return self._quantized
+
+    def save_quantized_model(self, save_model_path, input_spec=None,
+                             **kwargs):
+        if self._quantized is None:
+            raise RuntimeError("call quantize() first")
+        from .. import jit as _jit
+        _jit.save(self._quantized, save_model_path, input_spec=input_spec)
+        return save_model_path
+
+
+def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
+                      model=None, sample_generator=None, data_loader=None,
+                      batch_size=10, batch_nums=None, algo="hist",
+                      input_spec=None, **kwargs):
+    """One-call PTQ (reference's paddleslim-style quant_post_static shim)."""
+    ptq = PostTrainingQuantization(
+        model=model, sample_generator=sample_generator,
+        data_loader=data_loader, batch_size=batch_size,
+        batch_nums=batch_nums, algo=algo)
+    q = ptq.quantize()
+    if quantize_model_path:
+        ptq.save_quantized_model(quantize_model_path, input_spec=input_spec)
+    return q
+
+
+# ---------------------------------------------------------------------------
+# Weight-only quantization (utils.py WeightQuantization)
+# ---------------------------------------------------------------------------
+
+class WeightQuantization:
+    """Weight-only quantization for serving size (reference
+    post_training_quantization.py WeightQuantization): abs_max or
+    channel_wise_abs_max over Linear/Conv weights, int8/int16."""
+
+    _supported = ("abs_max", "channel_wise_abs_max")
+
+    def __init__(self, model: Layer):
+        self._model = model
+
+    def quantize_weight_to_int(self, save_model_dir=None,
+                               quantizable_op_type=("conv2d", "linear"),
+                               weight_bits=8, weight_quantize_type="abs_max",
+                               generate_test_model=False, **kwargs):
+        if weight_quantize_type not in self._supported:
+            raise ValueError(
+                f"weight_quantize_type must be one of {self._supported}")
+        qmax = float(2 ** (weight_bits - 1) - 1)
+        model = copy.deepcopy(self._model)
+
+        from ..nn.common import Linear
+        from ..nn.conv import Conv2D
+
+        def _quant(w, is_conv):
+            arr = np.asarray(w._data)
+            if weight_quantize_type == "abs_max":
+                scale = np.abs(arr).max() or 1.0
+                q = np.clip(np.round(arr / scale * qmax), -qmax, qmax)
+                return (q * scale / qmax).astype(arr.dtype), scale
+            # per-output-channel: Linear weight is [in, out] (out = last
+            # dim); Conv2D weight is [out_ch, in_ch, kH, kW] (out = dim 0)
+            axis = (1, 2, 3) if is_conv else tuple(range(arr.ndim - 1))
+            scale = np.abs(arr).max(axis=axis, keepdims=True)
+            scale = np.where(scale == 0, 1.0, scale)
+            q = np.clip(np.round(arr / scale * qmax), -qmax, qmax)
+            return (q * scale / qmax).astype(arr.dtype), scale
+
+        scales = {}
+
+        def _walk(m, prefix=""):
+            for name, child in m.named_children():
+                full = f"{prefix}.{name}" if prefix else name
+                if isinstance(child, (Linear, Conv2D)):
+                    new_w, scale = _quant(child.weight,
+                                          isinstance(child, Conv2D))
+                    child.weight._set_data(jnp.asarray(new_w))
+                    scales[full] = scale
+                else:
+                    _walk(child, full)
+        _walk(model)
+        if save_model_dir:
+            from ..framework import io as fio
+            fio.save(model.state_dict(), save_model_dir + ".pdiparams")
+        model._weight_quant_scales = scales
+        return model
+
+
+# ---------------------------------------------------------------------------
+# Pass-zoo entry points (quantization_pass.py) — delegating shims
+# ---------------------------------------------------------------------------
+
+class _LayerPass:
+    """Base for the pass shims: reference passes rewrite ProgramDesc IR; the
+    TPU build applies the equivalent transform on the Layer tree and lets
+    re-capture regenerate the program."""
+
+    def __init__(self, scope=None, place=None, **kwargs):
+        self._kwargs = kwargs
+
+    def apply(self, model):
+        raise NotImplementedError
+
+
+class QuantizationTransformPass(_LayerPass):
+    """quantization_pass.py:89 — insert fake quant/dequant around weights
+    and activations of quantizable ops (training form)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", **kwargs):
+        super().__init__(scope, place, **kwargs)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+
+    def apply(self, model: Layer) -> Layer:
+        from ..quantization import (FakeQuanterWithAbsMaxObserver, QAT)
+
+        class _F:
+            def __init__(self, bits):
+                self.b = bits
+
+            def _instance(self):
+                return FakeQuanterWithAbsMaxObserver(quant_bits=self.b)
+
+        cfg = QuantConfig(activation=_F(self._abits), weight=_F(self._wbits))
+        return QAT(cfg).quantize(model, inplace=False)
+
+
+class AddQuantDequantPass(QuantizationTransformPass):
+    """quantization_pass.py:1826 — same insertion for the remaining op
+    types; one pass covers both here since swapping is type-driven."""
+
+
+class QuantizationFreezePass(_LayerPass):
+    """quantization_pass.py:1078 — fold observed scales into int8 weights
+    (inference form)."""
+
+    def apply(self, model: Layer) -> Layer:
+        return convert(model, inplace=False)
+
+
+class OutScaleForTrainingPass(_LayerPass):
+    """quantization_pass.py:1581 — attach output-scale observers."""
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9, **kwargs):
+        super().__init__(scope, place, **kwargs)
+        self._rate = moving_rate
+
+    def apply(self, model: Layer) -> Layer:
+        from ..quantization import EMAObserver
+        for _, layer in model.named_sublayers():
+            if not hasattr(layer, "_out_scale_observer"):
+                obs = EMAObserver(moving_rate=self._rate)
+                layer._out_scale_observer = obs
+
+                def _hook(lay, inputs, output, _obs=obs):
+                    if isinstance(output, Tensor):
+                        _obs.observe(output)
+                    return output
+                layer.register_forward_post_hook(_hook)
+        return model
+
+
+class OutScaleForInferencePass(_LayerPass):
+    """quantization_pass.py:1754 — read back the collected output scales."""
+
+    def apply(self, model: Layer):
+        scales = {}
+        for name, layer in model.named_sublayers():
+            obs = getattr(layer, "_out_scale_observer", None)
+            if obs is not None:
+                scales[name] = float(obs.scales())
+        model._out_threshold_scales = scales
+        return model
+
+
+def quant_post_dynamic(model=None, save_model_dir=None, weight_bits=8,
+                       quantize_type="abs_max", **kwargs):
+    """Weight-only PTQ shim (reference quant_post_dynamic)."""
+    wq = WeightQuantization(model)
+    return wq.quantize_weight_to_int(save_model_dir=save_model_dir,
+                                     weight_bits=weight_bits,
+                                     weight_quantize_type=quantize_type)

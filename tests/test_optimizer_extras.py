@@ -161,21 +161,38 @@ class TestIncubateOptimizers:
         assert losses[-1] < losses[0]
 
     def test_distributed_fused_lamb_grad_accumulation(self):
-        paddle.seed(7)
-        net = nn.Linear(4, 4)
-        opt = DistributedFusedLamb(learning_rate=0.05,
-                                   parameters=net.parameters(),
-                                   gradient_accumulation_steps=2)
-        w0 = np.asarray(net.weight._data).copy()
-        x = paddle.to_tensor(np.ones((4, 4), dtype="float32"))
-        loss = (net(x) ** 2).mean()
-        loss.backward()
-        opt.step()  # first micro-batch: no update yet
-        np.testing.assert_allclose(np.asarray(net.weight._data), w0)
-        loss = (net(x) ** 2).mean()
-        loss.backward()
-        opt.step()  # second: applies
-        assert not np.allclose(np.asarray(net.weight._data), w0)
+        # two DISTINCT micro-batches without user clear_grad must equal one
+        # big batch: catches double-counting of the first micro-batch
+        rng = np.random.RandomState(3)
+        xa = rng.randn(4, 4).astype("float32")
+        xb = rng.randn(4, 4).astype("float32")
+
+        def fresh():
+            paddle.seed(7)
+            return nn.Linear(4, 4)
+
+        net1 = fresh()
+        opt1 = DistributedFusedLamb(learning_rate=0.05,
+                                    parameters=net1.parameters(),
+                                    gradient_accumulation_steps=2)
+        w0 = np.asarray(net1.weight._data).copy()
+        for x in (xa, xb):
+            loss = (net1(paddle.to_tensor(x)) ** 2).mean()
+            loss.backward()
+            opt1.step()   # no clear_grad between micro-steps
+        assert not np.allclose(np.asarray(net1.weight._data), w0)
+
+        net2 = fresh()
+        opt2 = DistributedFusedLamb(learning_rate=0.05,
+                                    parameters=net2.parameters(),
+                                    gradient_accumulation_steps=2)
+        for x in (xa, xb):
+            loss = (net2(paddle.to_tensor(x)) ** 2).mean()
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()   # the "clean" usage
+        np.testing.assert_allclose(np.asarray(net1.weight._data),
+                                   np.asarray(net2.weight._data), atol=1e-6)
 
     def test_gradient_merge(self):
         paddle.seed(7)

@@ -189,3 +189,77 @@ def test_asp_excluded_layers():
         assert asp.calculate_density(net[0].weight) == 1.0
     finally:
         asp.reset_excluded_layers()
+
+
+class TestStaticQuantization:
+    """static/quantization.py — PTQ calibration, KL threshold, pass shims
+    (reference: test/quantization/test_post_training_quantization_*.py)."""
+
+    def _model_and_data(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        x = paddle.to_tensor(rng.randn(32, 8).astype("float32"))
+        return model, x, rng
+
+    def test_cal_kl_threshold_clips_outliers(self):
+        from paddle_tpu.static.quantization import cal_kl_threshold
+        rng = np.random.RandomState(0)
+        acts = np.concatenate([np.abs(rng.randn(100000)),
+                               [50.0]]).astype("float32")
+        hist, edges = np.histogram(acts, bins=2048)
+        thr = cal_kl_threshold(hist, float(edges[1] - edges[0]))
+        assert thr < 10.0, "KL calibration should clip the outlier tail"
+
+    def test_post_training_quantization_accuracy(self):
+        from paddle_tpu.static.quantization import PostTrainingQuantization
+        model, x, rng = self._model_and_data()
+        ref = np.asarray(model(x)._data)
+
+        def gen():
+            for _ in range(40):
+                yield rng.randn(8).astype("float32")
+
+        for algo in ("KL", "abs_max", "hist"):
+            ptq = PostTrainingQuantization(model=model, sample_generator=gen,
+                                           batch_size=8, algo=algo)
+            q = ptq.quantize()
+            out = np.asarray(q(x)._data)
+            err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+            assert err < 0.1, f"{algo}: int8 PTQ error too large ({err})"
+
+    def test_transform_then_freeze_passes(self):
+        from paddle_tpu.static.quantization import (QuantizationFreezePass,
+                                                    QuantizationTransformPass)
+        model, x, _ = self._model_and_data()
+        ref = np.asarray(model(x)._data)
+        qat_model = QuantizationTransformPass().apply(model)
+        qat_model(x)  # one observation step
+        frozen = QuantizationFreezePass().apply(qat_model)
+        out = np.asarray(frozen(x)._data)
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.1
+        # frozen form holds int8 weights
+        from paddle_tpu.quantization import QuantedLinear
+        names = [type(l).__name__ for _, l in frozen.named_sublayers()]
+        assert "_ConvertedLinear" in names
+
+    def test_out_scale_passes(self):
+        from paddle_tpu.static.quantization import (OutScaleForInferencePass,
+                                                    OutScaleForTrainingPass)
+        model, x, _ = self._model_and_data()
+        m = OutScaleForTrainingPass().apply(model)
+        m(x)
+        m = OutScaleForInferencePass().apply(m)
+        assert len(m._out_threshold_scales) > 0
+        assert all(s > 0 for s in m._out_threshold_scales.values())
+
+    def test_weight_only_quant(self):
+        from paddle_tpu.static.quantization import quant_post_dynamic
+        model, x, _ = self._model_and_data()
+        ref = np.asarray(model(x)._data)
+        for qtype in ("abs_max", "channel_wise_abs_max"):
+            q = quant_post_dynamic(model=model, quantize_type=qtype)
+            out = np.asarray(q(x)._data)
+            assert np.abs(out - ref).max() / np.abs(ref).max() < 0.05
