@@ -38,14 +38,18 @@ class BasicBlock(Layer):
 class BottleneckBlock(Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(planes)
-        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
-                            bias_attr=False)
-        self.bn2 = BatchNorm2D(planes)
-        self.conv3 = Conv2D(planes, planes * self.expansion, 1,
+        # resnext/wide_resnet widen the 3x3 stage (vision/models/resnet.py
+        # BottleneckBlock width arithmetic)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = BatchNorm2D(width)
+        self.conv3 = Conv2D(width, planes * self.expansion, 1,
                             bias_attr=False)
         self.bn3 = BatchNorm2D(planes * self.expansion)
         self.downsample = downsample
@@ -90,9 +94,16 @@ class ResNet(Layer):
     """vision/models/resnet.py:ResNet analog. Input NCHW."""
 
     def __init__(self, block, depth_layers, num_classes=1000,
-                 with_pool=True):
+                 with_pool=True, groups=1, width_per_group=64):
         super().__init__()
+        if (groups != 1 or width_per_group != 64) and \
+                not issubclass(block, BottleneckBlock):
+            raise ValueError(
+                "groups/width_per_group only apply to BottleneckBlock "
+                "ResNets (resnext/wide variants)")
         self.inplanes = 64
+        self.groups = groups
+        self.base_width = width_per_group
         self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
         self.bn1 = BatchNorm2D(64)
         self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
@@ -112,10 +123,12 @@ class ResNet(Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = _Downsample(self.inplanes, planes * block.expansion,
                                      stride)
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        extra = ({"groups": self.groups, "base_width": self.base_width}
+                 if issubclass(block, BottleneckBlock) else {})
+        layers = [block(self.inplanes, planes, stride, downsample, **extra)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **extra))
         return _Sequential(layers)
 
     def forward(self, x):
@@ -151,3 +164,43 @@ def resnet101(**kwargs):
 
 def resnet152(**kwargs):
     return ResNet(BottleneckBlock, [3, 8, 36, 3], **kwargs)
+
+
+def resnext50_32x4d(**kwargs):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], groups=32,
+                  width_per_group=4, **kwargs)
+
+
+def resnext50_64x4d(**kwargs):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], groups=64,
+                  width_per_group=4, **kwargs)
+
+
+def resnext101_32x4d(**kwargs):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], groups=32,
+                  width_per_group=4, **kwargs)
+
+
+def resnext101_64x4d(**kwargs):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], groups=64,
+                  width_per_group=4, **kwargs)
+
+
+def resnext152_32x4d(**kwargs):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], groups=32,
+                  width_per_group=4, **kwargs)
+
+
+def resnext152_64x4d(**kwargs):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], groups=64,
+                  width_per_group=4, **kwargs)
+
+
+def wide_resnet50_2(**kwargs):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], width_per_group=128,
+                  **kwargs)
+
+
+def wide_resnet101_2(**kwargs):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], width_per_group=128,
+                  **kwargs)

@@ -8,7 +8,10 @@ Pretrained=True is unavailable offline (raises with a clear message).
 from __future__ import annotations
 
 from ..models.resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
-                             resnet152)
+                             resnet152, resnext50_32x4d, resnext50_64x4d,
+                             resnext101_32x4d, resnext101_64x4d,
+                             resnext152_32x4d, resnext152_64x4d,
+                             wide_resnet50_2, wide_resnet101_2)
 from ..nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Flatten,
                   Layer, Linear, MaxPool2D, ReLU, ReLU6, Sequential)
 
@@ -212,7 +215,26 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
     return MobileNetV2(scale=scale, **kwargs)
 
 
+from .models_extra import (  # noqa: E402
+    AlexNet, DenseNet, GoogLeNet, InceptionV3, MobileNetV3Large,
+    MobileNetV3Small, ShuffleNetV2, SqueezeNet, alexnet, densenet121,
+    densenet161, densenet169, densenet201, densenet264, googlenet,
+    inception_v3, mobilenet_v3_large, mobilenet_v3_small, shufflenet_v2_swish,
+    shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    squeezenet1_0, squeezenet1_1)
+
 __all__ = ["LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
            "MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
            "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152"]
+           "resnet152", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2",
+           "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1", "DenseNet", "densenet121", "densenet161",
+           "densenet169", "densenet201", "densenet264", "GoogLeNet",
+           "googlenet", "InceptionV3", "inception_v3", "MobileNetV3Small",
+           "MobileNetV3Large", "mobilenet_v3_small", "mobilenet_v3_large",
+           "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]

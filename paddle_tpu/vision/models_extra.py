@@ -1,0 +1,790 @@
+"""Vision model zoo, part 2: AlexNet, SqueezeNet, DenseNet, GoogLeNet,
+InceptionV3, MobileNetV3, ShuffleNetV2.
+
+Reference: python/paddle/vision/models/{alexnet,squeezenet,densenet,
+googlenet,inceptionv3,mobilenetv3,shufflenetv2}.py — standard published
+architectures re-implemented in the framework's NCHW conv idiom. TPU note:
+all convs are static-shape; XLA lays them out for the MXU (channels-last
+internally), so NCHW python-side costs nothing after the first transpose.
+"""
+from __future__ import annotations
+
+from ..nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                  Flatten, Hardsigmoid, Hardswish, Layer, Linear, MaxPool2D,
+                  ReLU, Sequential, Swish)
+from ..nn import functional as F
+from .models import _no_pretrained
+
+
+def _concat(xs):
+    from ..ops import concat
+    return concat(xs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# AlexNet
+# ---------------------------------------------------------------------------
+
+class AlexNet(Layer):
+    """alexnet.py:AlexNet — 5 convs + 3 fc, ImageNet-224 input."""
+
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2))
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(dropout), Linear(256 * 6 * 6, 4096), ReLU(),
+                Dropout(dropout), Linear(4096, 4096), ReLU(),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def alexnet(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("alexnet")
+    return AlexNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet
+# ---------------------------------------------------------------------------
+
+class _Fire(Layer):
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(in_ch, squeeze, 1)
+        self.expand1 = Conv2D(squeeze, e1, 1)
+        self.expand3 = Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = F.relu(self.squeeze(x))
+        return _concat([F.relu(self.expand1(s)), F.relu(self.expand3(s))])
+
+
+class SqueezeNet(Layer):
+    """squeezenet.py:SqueezeNet (version 1.0 / 1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(512, 64, 256, 256))
+        elif version == "1.1":
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        else:
+            raise ValueError("version must be '1.0' or '1.1'")
+        if num_classes > 0:
+            self.classifier_conv = Conv2D(512, num_classes, 1)
+            self.dropout = Dropout(0.5)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = F.relu(self.classifier_conv(self.dropout(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("squeezenet1_0")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("squeezenet1_1")
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(Layer):
+    def __init__(self, in_ch, growth_rate, bn_size, dropout=0.0):
+        super().__init__()
+        inter = bn_size * growth_rate
+        self.bn1 = BatchNorm2D(in_ch)
+        self.conv1 = Conv2D(in_ch, inter, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(inter)
+        self.conv2 = Conv2D(inter, growth_rate, 3, padding=1,
+                            bias_attr=False)
+        self.dropout = Dropout(dropout) if dropout > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(F.relu(self.bn1(x)))
+        out = self.conv2(F.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return _concat([x, out])
+
+
+class _Transition(Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.bn = BatchNorm2D(in_ch)
+        self.conv = Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(F.relu(self.bn(x))))
+
+
+_DENSE_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class DenseNet(Layer):
+    """densenet.py:DenseNet — dense blocks + transitions."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _DENSE_CFG:
+            raise ValueError(f"layers must be one of {list(_DENSE_CFG)}")
+        init_ch, growth, block_cfg = _DENSE_CFG[layers]
+        self.conv0 = Conv2D(3, init_ch, 7, stride=2, padding=3,
+                            bias_attr=False)
+        self.bn0 = BatchNorm2D(init_ch)
+        self.pool0 = MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        ch = init_ch
+        for bi, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if bi != len(block_cfg) - 1:
+                blocks.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = Sequential(*blocks)
+        self.bn_final = BatchNorm2D(ch)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.pool0(F.relu(self.bn0(self.conv0(x))))
+        x = F.relu(self.bn_final(self.blocks(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet121")
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet161")
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet169")
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet201")
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet264")
+    return DenseNet(264, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet (Inception v1)
+# ---------------------------------------------------------------------------
+
+class _ConvBN(Layer):
+    def __init__(self, in_ch, out_ch, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvBN(in_ch, c1, 1)
+        self.b2 = Sequential(_ConvBN(in_ch, c3r, 1),
+                             _ConvBN(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(_ConvBN(in_ch, c5r, 1),
+                             _ConvBN(c5r, c5, 5, padding=2))
+        self.pool = MaxPool2D(3, stride=1, padding=1)
+        self.b4 = _ConvBN(in_ch, proj, 1)
+
+    def forward(self, x):
+        return _concat([self.b1(x), self.b2(x), self.b3(x),
+                        self.b4(self.pool(x))])
+
+
+class GoogLeNet(Layer):
+    """googlenet.py:GoogLeNet — returns (main, aux1, aux2) logits like the
+    reference (aux heads train-time only in spirit; both always computed)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, padding=1),
+            _ConvBN(64, 64, 1), _ConvBN(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+            # aux heads hang off 4a and 4d (reference structure)
+            self.aux1 = Sequential(AdaptiveAvgPool2D((4, 4)),
+                                   _ConvBN(512, 128, 1), Flatten(),
+                                   Linear(128 * 16, 1024), ReLU(),
+                                   Dropout(0.7), Linear(1024, num_classes))
+            self.aux2 = Sequential(AdaptiveAvgPool2D((4, 4)),
+                                   _ConvBN(528, 128, 1), Flatten(),
+                                   Linear(128 * 16, 1024), ReLU(),
+                                   Dropout(0.7), Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        a1 = x
+        x = self.i4c(self.i4b(x))
+        x = self.i4d(x)
+        a2 = x
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            out = self.fc(self.dropout(x.flatten(1)))
+            return out, self.aux1(a1), self.aux2(a2)
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("googlenet")
+    return GoogLeNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3
+# ---------------------------------------------------------------------------
+
+class _InceptionA(Layer):
+    def __init__(self, in_ch, pool_ch):
+        super().__init__()
+        self.b1 = _ConvBN(in_ch, 64, 1)
+        self.b5 = Sequential(_ConvBN(in_ch, 48, 1),
+                             _ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(_ConvBN(in_ch, 64, 1),
+                             _ConvBN(64, 96, 3, padding=1),
+                             _ConvBN(96, 96, 3, padding=1))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_ch, pool_ch, 1)
+
+    def forward(self, x):
+        return _concat([self.b1(x), self.b5(x), self.b3(x),
+                        self.bp(self.pool(x))])
+
+
+class _InceptionB(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _ConvBN(in_ch, 384, 3, stride=2)
+        self.b33 = Sequential(_ConvBN(in_ch, 64, 1),
+                              _ConvBN(64, 96, 3, padding=1),
+                              _ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _concat([self.b3(x), self.b33(x), self.pool(x)])
+
+
+class _ConvBNRect(Layer):
+    """1xN / Nx1 factorized conv."""
+
+    def __init__(self, in_ch, out_ch, kh, kw, ph, pw):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, (kh, kw), padding=(ph, pw),
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _InceptionC(Layer):
+    def __init__(self, in_ch, c7):
+        super().__init__()
+        self.b1 = _ConvBN(in_ch, 192, 1)
+        self.b7 = Sequential(_ConvBN(in_ch, c7, 1),
+                             _ConvBNRect(c7, c7, 1, 7, 0, 3),
+                             _ConvBNRect(c7, 192, 7, 1, 3, 0))
+        self.b77 = Sequential(_ConvBN(in_ch, c7, 1),
+                              _ConvBNRect(c7, c7, 7, 1, 3, 0),
+                              _ConvBNRect(c7, c7, 1, 7, 0, 3),
+                              _ConvBNRect(c7, c7, 7, 1, 3, 0),
+                              _ConvBNRect(c7, 192, 1, 7, 0, 3))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_ch, 192, 1)
+
+    def forward(self, x):
+        return _concat([self.b1(x), self.b7(x), self.b77(x),
+                        self.bp(self.pool(x))])
+
+
+class _InceptionD(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = Sequential(_ConvBN(in_ch, 192, 1),
+                             _ConvBN(192, 320, 3, stride=2))
+        self.b7 = Sequential(_ConvBN(in_ch, 192, 1),
+                             _ConvBNRect(192, 192, 1, 7, 0, 3),
+                             _ConvBNRect(192, 192, 7, 1, 3, 0),
+                             _ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _concat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class _InceptionE(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _ConvBN(in_ch, 320, 1)
+        self.b3_stem = _ConvBN(in_ch, 384, 1)
+        self.b3_a = _ConvBNRect(384, 384, 1, 3, 0, 1)
+        self.b3_b = _ConvBNRect(384, 384, 3, 1, 1, 0)
+        self.b33_stem = Sequential(_ConvBN(in_ch, 448, 1),
+                                   _ConvBN(448, 384, 3, padding=1))
+        self.b33_a = _ConvBNRect(384, 384, 1, 3, 0, 1)
+        self.b33_b = _ConvBNRect(384, 384, 3, 1, 1, 0)
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_ch, 192, 1)
+
+    def forward(self, x):
+        s3 = self.b3_stem(x)
+        s33 = self.b33_stem(x)
+        return _concat([self.b1(x),
+                        _concat([self.b3_a(s3), self.b3_b(s3)]),
+                        _concat([self.b33_a(s33), self.b33_b(s33)]),
+                        self.bp(self.pool(x))])
+
+
+class InceptionV3(Layer):
+    """inceptionv3.py:InceptionV3 — 299x299 input."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), MaxPool2D(3, stride=2))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("inception_v3")
+    return InceptionV3(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3
+# ---------------------------------------------------------------------------
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SEModule(Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(ch, _make_divisible(ch // reduction), 1)
+        self.fc2 = Conv2D(_make_divisible(ch // reduction), ch, 1)
+        self.hs = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.avgpool(x)
+        s = F.relu(self.fc1(s))
+        s = self.hs(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(Layer):
+    def __init__(self, in_ch, exp, out_ch, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        self.expand = in_ch != exp
+        act_layer = Hardswish if act == "hardswish" else ReLU
+        layers = []
+        if self.expand:
+            layers += [Conv2D(in_ch, exp, 1, bias_attr=False),
+                       BatchNorm2D(exp), act_layer()]
+        layers += [Conv2D(exp, exp, k, stride=stride, padding=k // 2,
+                          groups=exp, bias_attr=False),
+                   BatchNorm2D(exp), act_layer()]
+        if use_se:
+            layers.append(_SEModule(exp))
+        layers += [Conv2D(exp, out_ch, 1, bias_attr=False),
+                   BatchNorm2D(out_ch)]
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_MBV3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(Layer):
+    """mobilenetv3.py MobileNetV3Small/Large."""
+
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        in_ch = _make_divisible(16 * scale)
+        self.stem = Sequential(Conv2D(3, in_ch, 3, stride=2, padding=1,
+                                      bias_attr=False),
+                               BatchNorm2D(in_ch), Hardswish())
+        blocks = []
+        for k, exp, out, se, act, stride in config:
+            exp_ch = _make_divisible(exp * scale)
+            out_ch = _make_divisible(out * scale)
+            blocks.append(_MBV3Block(in_ch, exp_ch, out_ch, k, stride, se,
+                                     act))
+            in_ch = out_ch
+        self.blocks = Sequential(*blocks)
+        last_conv = _make_divisible(6 * in_ch)
+        self.head_conv = Sequential(Conv2D(in_ch, last_conv, 1,
+                                           bias_attr=False),
+                                    BatchNorm2D(last_conv), Hardswish())
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(Linear(last_conv, last_channel),
+                                         Hardswish(), Dropout(0.2),
+                                         Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        _no_pretrained("mobilenet_v3_large")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        _no_pretrained("mobilenet_v3_small")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2
+# ---------------------------------------------------------------------------
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    from ..ops import reshape, transpose
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = out_ch // 2
+        act_layer = Swish if act == "swish" else ReLU
+        if stride == 1:
+            self.branch2 = Sequential(
+                Conv2D(in_ch // 2, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), act_layer(),
+                Conv2D(branch, branch, 3, stride=1, padding=1, groups=branch,
+                       bias_attr=False),
+                BatchNorm2D(branch),
+                Conv2D(branch, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), act_layer())
+            self.branch1 = None
+        else:
+            self.branch1 = Sequential(
+                Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                       groups=in_ch, bias_attr=False),
+                BatchNorm2D(in_ch),
+                Conv2D(in_ch, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), act_layer())
+            self.branch2 = Sequential(
+                Conv2D(in_ch, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), act_layer(),
+                Conv2D(branch, branch, 3, stride=stride, padding=1,
+                       groups=branch, bias_attr=False),
+                BatchNorm2D(branch),
+                Conv2D(branch, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), act_layer())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = _concat([x1, self.branch2(x2)])
+        else:
+            out = _concat([self.branch1(x), self.branch2(x)])
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+class ShuffleNetV2(Layer):
+    """shufflenetv2.py:ShuffleNetV2."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _SHUFFLE_CFG:
+            raise ValueError(f"scale must be one of {list(_SHUFFLE_CFG)}")
+        c0, c1, c2, c3, c_last = _SHUFFLE_CFG[scale]
+        act_layer = Swish if act == "swish" else ReLU
+        self.stem = Sequential(Conv2D(3, c0, 3, stride=2, padding=1,
+                                      bias_attr=False),
+                               BatchNorm2D(c0), act_layer(),
+                               MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        in_ch = c0
+        for out_ch, repeat in ((c1, 4), (c2, 8), (c3, 4)):
+            stages.append(_ShuffleUnit(in_ch, out_ch, 2, act))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(out_ch, out_ch, 1, act))
+            in_ch = out_ch
+        self.stages = Sequential(*stages)
+        self.head = Sequential(Conv2D(in_ch, c_last, 1, bias_attr=False),
+                               BatchNorm2D(c_last), act_layer())
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.head(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x0_25")
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x0_33")
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x0_5")
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x1_0")
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x1_5")
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x2_0")
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_swish")
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+
+
+__all__ = [
+    "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264", "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
+    "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+    "mobilenet_v3_large", "ShuffleNetV2", "shufflenet_v2_x0_25",
+    "shufflenet_v2_x0_33", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+]
