@@ -16,6 +16,15 @@ from .core.dtype import (bfloat16, bool_, complex128, complex64, float16,
                          int64, int8, set_default_dtype, uint8)
 from .core.flags import get_flags, set_flags
 from .core.random import seed
+from .core.shims import (CUDAPinnedPlace, CUDAPlace, LazyGuard, XPUPlace,
+                         batch, check_shape, create_parameter,
+                         disable_signal_handler, dtype, finfo,
+                         get_cuda_rng_state, get_rng_state, iinfo,
+                         set_cuda_rng_state, set_printoptions, set_rng_state)
+
+# paddle.bool is the dtype (shadows builtins inside this namespace only,
+# matching python/paddle/__init__.py)
+bool = bool_
 
 # autograd
 from .autograd import (PyLayer, PyLayerContext, enable_grad, grad,
@@ -60,6 +69,8 @@ from . import static
 from .hapi import Model, callbacks, summary
 from .distributed.parallel import DataParallel
 from .framework.io import async_save, load, save
+from .nn.layer import ParamAttr
+from .utils.flops import flops
 from .nn import functional as _F
 
 # paddle.disable_static/enable_static are no-ops here (eager is the default;

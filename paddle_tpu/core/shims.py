@@ -1,0 +1,200 @@
+"""Small framework-level shims completing python/paddle/__init__.py parity.
+
+iinfo/finfo (paddle/fluid/pybind: bind numpy-backed dtype info), dtype,
+set_printoptions, LazyGuard (lazy parameter init), place shims, the legacy
+`paddle.batch` reader decorator, and rng-state accessors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import dtype as dtype_mod
+from .tensor import CPUPlace, Place, Tensor, TPUPlace
+
+
+class iinfo:
+    """paddle.iinfo — integer dtype limits (numpy-backed like the ref)."""
+
+    def __init__(self, dtype):
+        import jax.numpy as jnp
+        info = np.iinfo(jnp.dtype(dtype_mod.to_jax_dtype(dtype)))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class finfo:
+    """paddle.finfo — floating dtype limits."""
+
+    def __init__(self, dtype):
+        jd = dtype_mod.to_jax_dtype(dtype)
+        import jax.numpy as jnp
+        info = jnp.finfo(jd)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(getattr(info, "resolution", info.eps))
+        self.bits = int(info.bits)
+        self.dtype = str(jnp.dtype(jd))
+
+    def __repr__(self):
+        return (f"paddle.finfo(min={self.min}, max={self.max}, "
+                f"eps={self.eps}, bits={self.bits}, dtype={self.dtype})")
+
+
+def dtype(name):
+    """paddle.dtype — dtype constructor/alias (paddle.dtype('float32'))."""
+    return dtype_mod.to_jax_dtype(name)
+
+
+_PRINT_OPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+               "linewidth": 80, "sci_mode": None}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions — forwards to numpy's print options (tensor
+    repr renders through numpy here)."""
+    kw = {}
+    if precision is not None:
+        _PRINT_OPTS["precision"] = precision
+        kw["precision"] = precision
+    if threshold is not None:
+        _PRINT_OPTS["threshold"] = threshold
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        _PRINT_OPTS["edgeitems"] = edgeitems
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        _PRINT_OPTS["linewidth"] = linewidth
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        _PRINT_OPTS["sci_mode"] = sci_mode
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """paddle.LazyGuard analog (python/paddle/base/framework.py LazyGuard):
+    in the reference, layers built inside the guard defer parameter
+    initialization until explicitly materialized. Initialization here is
+    cheap host-side numpy (no device traffic until first use), so the guard
+    is a compatible no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class CUDAPlace(Place):
+    """Compatibility shim: accepted wherever a place is, maps to the TPU
+    device (there is no CUDA in this build)."""
+
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class XPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy paddle.batch reader decorator (python/paddle/reader):
+    groups a sample reader into batches."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def check_shape(shape):
+    """Validate a shape argument (paddle static helper)."""
+    if isinstance(shape, Tensor):
+        return
+    for d in shape:
+        if isinstance(d, int) and d < -1:
+            raise ValueError(f"invalid dim {d} in shape {shape}")
+
+
+def get_rng_state(device=None):
+    from . import random as _random
+    return [_random.default_generator().get_state()]
+
+
+def set_rng_state(state_list, device=None):
+    from . import random as _random
+    state = state_list[0] if isinstance(state_list, (list, tuple)) \
+        else state_list
+    _random.default_generator().set_state(state)
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list):
+    return set_rng_state(state_list)
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ signal handlers; this runtime
+    leaves python's handlers untouched."""
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter (static helper): a free-standing Parameter."""
+    import jax.numpy as jnp
+
+    from .tensor import Parameter
+    jd = dtype_mod.to_jax_dtype(dtype)
+    if default_initializer is not None:
+        from ..nn.layer import Layer
+        holder = Layer()
+        p = holder.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                    is_bias=is_bias,
+                                    default_initializer=default_initializer)
+        return p
+    if is_bias:
+        data = jnp.zeros(tuple(shape), jd)
+    else:
+        import numpy as _np
+        fan_in = shape[0] if shape else 1
+        limit = float(_np.sqrt(6.0 / max(fan_in, 1)))
+        from ..nn.functional import random_mod
+        import jax
+        data = jax.random.uniform(random_mod.next_key(), tuple(shape), jd,
+                                  -limit, limit)
+    p = Parameter(data)
+    p.name = name
+    return p
+
+
+__all__ = ["iinfo", "finfo", "dtype", "set_printoptions", "LazyGuard",
+           "CUDAPlace", "CUDAPinnedPlace", "XPUPlace", "batch",
+           "check_shape", "get_rng_state", "set_rng_state",
+           "get_cuda_rng_state", "set_cuda_rng_state",
+           "disable_signal_handler", "create_parameter"]

@@ -21,6 +21,8 @@ from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .activation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
+from .extras import complex_ as complex  # noqa: F401 (paddle.complex)
 
 from . import math as _math
 from . import creation as _creation
@@ -182,3 +184,74 @@ tensor_method("any", _logic.any_)
 tensor_method("all", _logic.all_)
 tensor_method("round", _math.round)
 tensor_method("neg", _math.neg)
+
+
+# -- paddle.t / paddle.shape / paddle.rank / paddle.tolist -------------------
+
+@defop(name="t")
+def t(x):
+    """Transpose for 0/1/2-D tensors (paddle.t)."""
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports tensors with ndim <= 2")
+    return x.T if x.ndim == 2 else x
+
+
+def shape(x):
+    """paddle.shape: the shape as an int32 Tensor (dynamic-shape API)."""
+    return Tensor(jnp.asarray(x.shape if isinstance(x, Tensor)
+                              else jnp.asarray(x).shape, jnp.int32))
+
+
+def rank(x):
+    """paddle.rank: ndim as a 0-D Tensor."""
+    return Tensor(jnp.asarray(x.ndim, jnp.int32))
+
+
+def tolist(x):
+    return x.tolist() if isinstance(x, Tensor) else list(x)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _dtype_of(x):
+    return x._data.dtype if isinstance(x, Tensor) else jnp.asarray(x).dtype
+
+
+def is_complex(x):
+    return jnp.issubdtype(_dtype_of(x), jnp.complexfloating)
+
+
+def is_integer(x):
+    d = _dtype_of(x)
+    return jnp.issubdtype(d, jnp.integer) or d == jnp.bool_
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_dtype_of(x), jnp.floating)
+
+
+# -- inplace variants (paddle.add_ / abs_ / reshape_ / ...) ------------------
+
+from . import inplace as _inplace  # noqa: E402
+
+_made_inplace = _inplace.build(globals())
+normal_ = _inplace.normal_
+where_ = _inplace.make_where_(globals()["where"])
+cauchy_ = _inplace.cauchy_
+geometric_ = _inplace.geometric_
+
+# Tensor.<op>_ methods for every generated inplace op + the random fills
+for _n in _made_inplace:
+    tensor_method(_n, globals()[_n])
+tensor_method("normal_", normal_)
+tensor_method("cauchy_", cauchy_)
+tensor_method("geometric_", geometric_)
+tensor_method("t", t)
+tensor_method("tolist", tolist)
+
+# paddle.slice / paddle.floor_mod aliases
+from .extras import slice_ as slice  # noqa: E402,F401
+floor_mod = _math.mod
+floor_mod_ = globals()["mod_"]

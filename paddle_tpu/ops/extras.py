@@ -1,0 +1,562 @@
+"""Math / manipulation breadth: the long tail of python/paddle/tensor ops.
+
+Reference: python/paddle/tensor/{math,manipulation,creation,search}.py —
+each entry mirrors the paddle signature; the kernel is one jnp/lax
+expression that XLA fuses (the reference backs these with individual phi
+kernels; on TPU they are all emission).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import defop
+
+
+def _unary(name, fn, differentiable=True):
+    @defop(name=name, differentiable=differentiable)
+    def op(x):
+        return fn(x)
+    op.__name__ = name
+    return op
+
+
+# -- special functions (jax.scipy backed) -----------------------------------
+
+gammaln = _unary("gammaln", jax.scipy.special.gammaln)
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+
+
+@defop()
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (paddle arg order)."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+@defop()
+def gammaincc(x, y):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@defop()
+def multigammaln(x, p):
+    """log multivariate gamma: sum_i gammaln(x + (1-i)/2) + c(p)."""
+    i = jnp.arange(p, dtype=jnp.float32)
+    const = 0.25 * p * (p - 1) * np.log(np.pi)
+    return jnp.sum(jax.scipy.special.gammaln(x[..., None] - i / 2.0),
+                   axis=-1) + const
+
+
+@defop()
+def polygamma(x, n):
+    if n == 0:
+        return jax.scipy.special.digamma(x)
+    return jax.scipy.special.polygamma(n, x)
+
+
+# -- elementwise math -------------------------------------------------------
+
+@defop()
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@defop()
+def logcumsumexp(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    # running log-sum-exp as ONE associative scan (logaddexp is associative;
+    # TPU-friendly, no serial loop)
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+@defop()
+def copysign(x, y):
+    return jnp.copysign(x, jnp.asarray(y, dtype=x.dtype))
+
+
+@defop()
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@defop()
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@defop()
+def ldexp(x, y):
+    return jnp.ldexp(x, y.astype(jnp.int32) if hasattr(y, "astype") else y)
+
+
+@defop(differentiable=False)
+def frexp(x):
+    return jnp.frexp(x)
+
+
+@defop(differentiable=False)
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@defop(differentiable=False)
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@defop()
+def rad2deg(x):
+    return jnp.degrees(x.astype(jnp.float32)
+                       if jnp.issubdtype(x.dtype, jnp.integer) else x)
+
+
+@defop()
+def deg2rad(x):
+    return jnp.radians(x.astype(jnp.float32)
+                       if jnp.issubdtype(x.dtype, jnp.integer) else x)
+
+
+@defop(differentiable=False)
+def bitwise_left_shift(x, y, is_arithmetic=True):
+    return jnp.left_shift(x, y)
+
+
+@defop(differentiable=False)
+def bitwise_right_shift(x, y, is_arithmetic=True):
+    if is_arithmetic:
+        return jnp.right_shift(x, y)
+    # logical shift: operate on the unsigned view
+    info = jnp.iinfo(x.dtype)
+    ux = x.view(jnp.dtype(f"uint{info.bits}"))
+    return jax.lax.shift_right_logical(ux, ux.dtype.type(0) + y.astype(
+        ux.dtype)).view(x.dtype)
+
+
+@defop(differentiable=False)
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@defop(differentiable=False)
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@defop()
+def sgn(x):
+    """sign for real; x/|x| for complex (paddle.sgn)."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+@defop()
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@defop()
+def renorm(x, p, axis, max_norm):
+    """Renormalize slices along `axis` whose p-norm exceeds max_norm."""
+    axis = axis % x.ndim
+    perm_axes = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=perm_axes, keepdims=True) ** (1 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+@defop()
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+# -- constructions / views --------------------------------------------------
+
+@defop()
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    if dim1 != -2 or dim2 != -1:
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        rest = [i for i in range(nd) if i not in (d1, d2)]
+        # perm[target_axis] = source_axis in `out` (batch dims lead, the two
+        # diag dims are last): transpose with perm moves them into place
+        perm = [0] * nd
+        for i, ax in enumerate(rest):
+            perm[ax] = i
+        perm[d1] = nd - 2
+        perm[d2] = nd - 1
+        out = jnp.transpose(out, perm)
+    return out
+
+
+@defop()
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@defop()
+def polar(abs_v, angle):
+    return (abs_v * jnp.cos(angle) + 1j * abs_v * jnp.sin(angle)).astype(
+        jnp.complex64 if abs_v.dtype == jnp.float32 else jnp.complex128)
+
+
+@defop(name="complex")
+def complex_(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+@defop(differentiable=False)
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    from ..core import dtype as dtype_mod
+    col = row if col is None else col
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@defop(differentiable=False)
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    from ..core import dtype as dtype_mod
+    col = row if col is None else col
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@defop(differentiable=False)
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+    n = x.shape[0]
+    pool = (itertools.combinations_with_replacement(range(n), r)
+            if with_replacement else itertools.combinations(range(n), r))
+    idx = np.array(list(pool), dtype=np.int32)
+    if idx.size == 0:
+        return jnp.zeros((0, r), x.dtype)
+    return x[idx]
+
+
+# -- stacking / splitting ---------------------------------------------------
+
+@defop()
+def hstack(xs):
+    return jnp.hstack(xs)
+
+
+@defop()
+def vstack(xs):
+    return jnp.vstack(xs)
+
+
+@defop()
+def dstack(xs):
+    return jnp.dstack(xs)
+
+
+@defop()
+def column_stack(xs):
+    return jnp.column_stack(xs)
+
+
+row_stack = vstack
+
+
+@defop()
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@defop()
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@defop()
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    from .manipulation import split as _split
+    from ..core.tensor import Tensor
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if isinstance(num_or_indices, int):
+        pieces = np.array_split(np.arange(arr.shape[axis]), num_or_indices)
+        sizes = [len(p) for p in pieces]
+        outs = []
+        off = 0
+        for s in sizes:
+            outs.append(jax.lax.slice_in_dim(arr, off, off + s, axis=axis))
+            off += s
+    else:
+        idx = [0] + list(num_or_indices) + [arr.shape[axis]]
+        outs = [jax.lax.slice_in_dim(arr, idx[i], idx[i + 1], axis=axis)
+                for i in range(len(idx) - 1)]
+    return [Tensor(o) for o in outs]
+
+
+def hsplit(x, num_or_indices):
+    if x.ndim < 1:
+        raise ValueError("hsplit expects ndim >= 1")
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices):
+    if x.ndim < 2:
+        raise ValueError("vsplit expects ndim >= 2")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices):
+    if x.ndim < 3:
+        raise ValueError("dsplit expects ndim >= 3")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+@defop()
+def add_n(inputs):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return out
+
+
+@defop()
+def reverse(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(x, axis=tuple(axes))
+
+
+@defop(name="slice")
+def slice_(x, axes, starts, ends):
+    out = x
+    for ax, st, en in zip(axes, starts, ends):
+        size = x.shape[ax]
+        st = int(np.clip(st + size if st < 0 else st, 0, size))
+        en = int(np.clip(en + size if en < 0 else en, 0, size))
+        out = jax.lax.slice_in_dim(out, st, max(en, st), axis=ax)
+    return out
+
+
+@defop()
+def strided_slice(x, axes, starts, ends, strides):
+    out = x
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        size = out.shape[ax]
+        st = st + size if st < 0 else st
+        en = en + size if en < 0 else en
+        slicer = [slice(None)] * out.ndim
+        slicer[ax] = slice(st, en, sd)
+        out = out[tuple(slicer)]
+    return out
+
+
+@defop()
+def crop(x, shape=None, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    shape = shape or list(x.shape)
+    shape = [x.shape[i] if s in (-1, None) else s
+             for i, s in enumerate(shape)]
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+@defop()
+def as_strided(x, shape, stride, offset=0):
+    """View with explicit strides (reference stride/ kernels): gather-based —
+    correct for any stride pattern, XLA fuses the gather."""
+    flat = x.reshape(-1)
+    idx = jnp.full(tuple(shape), offset)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s) * st
+        idx = idx + r.reshape((-1,) + (1,) * (len(shape) - d - 1))
+    return flat[idx]
+
+
+@defop()
+def unfold(x, axis, size, step):
+    """Sliding windows along `axis` (paddle.unfold/Tensor.unfold): window
+    count replaces `axis`, window size appends as the LAST dim."""
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+
+    def take(st):
+        return jax.lax.dynamic_slice_in_dim(x, st, size, axis=axis)
+
+    out = jax.vmap(take)(starts)          # [n, ..., size at axis, ...]
+    out = jnp.moveaxis(out, 0, axis)      # window count at `axis`
+    return jnp.moveaxis(out, axis + 1, -1)  # window size last
+
+
+@defop(differentiable=False)
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    arr = np.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.concatenate([[True], arr[1:] != arr[:-1]]) if arr.ndim == 1 \
+        else np.concatenate([[True],
+                             (arr[1:] != arr[:-1]).reshape(len(arr) - 1, -1)
+                             .any(axis=1)])
+    out = arr[keep]
+    res = [jnp.asarray(out)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        res.append(jnp.asarray(inv))
+    if return_counts:
+        pos = np.flatnonzero(keep)
+        counts = np.diff(np.append(pos, len(arr)))
+        res.append(jnp.asarray(counts))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+# -- search / stats ---------------------------------------------------------
+
+@defop()
+def index_sample(x, index):
+    """Per-row gather: out[i][j] = x[i][index[i][j]] (paddle.index_sample)."""
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+@defop()
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors (paddle.multiplex)."""
+    stacked = jnp.stack(inputs)            # [K, B, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[index.reshape(-1).astype(jnp.int32), rows]
+
+
+@defop(differentiable=False)
+def nanmedian(x, axis=None, keepdim=False, mode="avg"):
+    if mode == "min":
+        # lower-median semantics
+        def lower_median(a, ax):
+            valid = jnp.sort(a, axis=ax)
+            n = jnp.sum(~jnp.isnan(a), axis=ax, keepdims=True)
+            idx = jnp.maximum((n - 1) // 2, 0)
+            return jnp.take_along_axis(valid, idx, axis=ax if ax is not None
+                                       else 0)
+        if axis is None:
+            r = lower_median(x.reshape(-1), 0)
+            return r.reshape(()) if not keepdim else r
+        r = lower_median(x, axis)
+        return r if keepdim else jnp.squeeze(r, axis)
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+@defop(differentiable=False)
+def pdist(x, p=2.0):
+    """Condensed pairwise distances of rows (paddle.pdist)."""
+    n = x.shape[0]
+    diff = x[:, None, :] - x[None, :, :]
+    if p == 2.0:
+        d = jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    else:
+        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    iu = jnp.triu_indices(n, k=1)
+    return d[iu]
+
+
+@defop(differentiable=False)
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    arr = np.asarray(x)
+    w = None if weights is None else np.asarray(weights)
+    hist, edges = np.histogramdd(arr, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return (jnp.asarray(hist),
+            [jnp.asarray(e) for e in edges])
+
+
+@defop()
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1):
+    d = jnp.diff(x, axis=axis) if x is not None else dx
+    slicer1 = [slice(None)] * y.ndim
+    slicer2 = [slice(None)] * y.ndim
+    slicer1[axis] = slice(1, None)
+    slicer2[axis] = slice(None, -1)
+    avg = (y[tuple(slicer1)] + y[tuple(slicer2)]) / 2.0
+    return jnp.cumsum(avg * d, axis=axis)
+
+
+@defop()
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    n1, n2 = x.shape[axis1], x.shape[axis2]
+    k = min(n1 + min(offset, 0), n2 - max(offset, 0))
+    r = jnp.arange(k) + max(-offset, 0)
+    c = jnp.arange(k) + max(offset, 0)
+    # bring (axis1, axis2) to the front for a clean .at scatter
+    moved = jnp.moveaxis(x, (axis1, axis2), (0, 1))
+    y_moved = jnp.moveaxis(y, -1, 0) if y.ndim > 1 else y
+    moved = moved.at[r, c].set(y_moved)
+    return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+
+
+@defop()
+def masked_scatter(x, mask, value):
+    """Fill masked positions of x with consecutive values from `value`."""
+    m = mask.reshape(-1)
+    pos = jnp.cumsum(m) - 1
+    vals = value.reshape(-1)[jnp.clip(pos, 0, value.size - 1)]
+    out = jnp.where(m, vals, x.reshape(-1))
+    return out.reshape(x.shape)
+
+
+@defop(differentiable=False)
+def broadcast_shape_op(x_shape, y_shape):
+    return np.broadcast_shapes(tuple(x_shape), tuple(y_shape))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# -- random tail -------------------------------------------------------------
+
+def binomial(count, prob, name=None):
+    from ..core.tensor import Tensor
+    from ..nn.functional import random_mod
+    key = random_mod.next_key()
+    c = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    shape = np.broadcast_shapes(c.shape, p.shape)
+    out = jax.random.binomial(key, c.astype(jnp.float32),
+                              p.astype(jnp.float32), shape=shape)
+    return Tensor(out.astype(jnp.int32))
+
+
+def standard_gamma(alpha, name=None):
+    from ..core.tensor import Tensor
+    from ..nn.functional import random_mod
+    key = random_mod.next_key()
+    a = alpha._data if isinstance(alpha, Tensor) else jnp.asarray(alpha)
+    return Tensor(jax.random.gamma(key, a))
+
+
+__all__ = [
+    "gammaln", "gammainc", "gammaincc", "multigammaln", "polygamma",
+    "i0", "i0e", "i1", "i1e", "logaddexp", "logcumsumexp", "copysign",
+    "heaviside", "hypot", "ldexp", "frexp", "nextafter", "signbit",
+    "rad2deg", "deg2rad", "gcd", "lcm", "sgn", "frac", "renorm", "logit",
+    "bitwise_left_shift", "bitwise_right_shift",
+    "diag_embed", "vander", "polar", "complex_", "tril_indices",
+    "triu_indices", "combinations", "hstack", "vstack", "dstack",
+    "column_stack", "row_stack", "atleast_1d", "atleast_2d", "atleast_3d",
+    "tensor_split", "hsplit", "vsplit", "dsplit", "add_n", "reverse",
+    "slice_", "strided_slice", "crop", "as_strided", "unfold",
+    "unique_consecutive", "index_sample", "multiplex", "nanmedian", "pdist",
+    "histogramdd", "cumulative_trapezoid", "diagonal_scatter",
+    "masked_scatter", "broadcast_shape", "binomial", "standard_gamma",
+]

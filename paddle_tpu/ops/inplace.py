@@ -1,0 +1,123 @@
+"""Inplace op variants (`op_`) and small framework shims.
+
+Reference: python/paddle/tensor/* `_C_ops.*_` inplace kernels + the
+`paddle.*_` re-exports in python/paddle/__init__.py. On TPU "inplace" is
+semantic only — arrays are immutable, so each variant runs the functional
+op and rebinds the tensor's buffer via _set_data (donation in the compiled
+path gives the real memory reuse). Autograd follows the reference rule:
+inplace on a leaf that requires grad raises.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_INPLACE_NAMES = [
+    # unary math
+    "abs", "acos", "asin", "atan", "ceil", "cos", "cosh", "sinh", "exp",
+    "expm1", "floor", "log", "log2", "log10", "log1p", "neg", "reciprocal",
+    "round", "rsqrt", "sigmoid", "sin", "sqrt", "square", "tan", "tanh",
+    "erf", "trunc", "frac", "digamma", "lgamma", "gammaln", "i0",
+    "multigammaln", "polygamma", "nan_to_num", "logit",
+    # binary / ternary
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "hypot", "ldexp", "copysign", "gammainc", "gammaincc",
+    "lerp", "clip", "scale", "gcd", "lcm",
+    # logical / comparison (bool results written back)
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal",
+    # shape / indexing
+    "reshape", "squeeze", "unsqueeze", "transpose", "flatten", "cast",
+    "cumsum", "cumprod", "tril", "triu", "renorm", "index_add",
+    "index_put", "index_fill", "masked_fill", "masked_scatter", "scatter",
+    "addmm", "t",
+]
+
+
+def _check_inplace_ok(x):
+    if isinstance(x, Tensor) and not x.stop_gradient and x.is_leaf:
+        raise RuntimeError(
+            "in-place operation on a leaf Tensor that requires grad is not "
+            "allowed (matches the reference's inplace check)")
+
+
+def _make_inplace(op_fn, name):
+    def inplace(x, *args, **kwargs):
+        _check_inplace_ok(x)
+        out = op_fn(x, *args, **kwargs)
+        x._set_data(out._data if isinstance(out, Tensor) else out)
+        return x
+    inplace.__name__ = name + "_"
+    inplace.__doc__ = f"In-place variant of paddle.{name} (x is rebound)."
+    return inplace
+
+
+def build(namespace: dict):
+    """Install `op_` for every available functional op in `namespace`."""
+    made = []
+    for name in _INPLACE_NAMES:
+        fn = namespace.get(name)
+        if fn is None or not callable(fn):
+            continue
+        namespace[name + "_"] = _make_inplace(fn, name)
+        made.append(name + "_")
+    return made
+
+
+# -- the non-uniform ones ---------------------------------------------------
+
+def make_where_(where_fn):
+    """paddle.where_(condition, x, y) is inplace on X (the second arg),
+    not the condition — needs its own wrapper."""
+
+    def where_(condition, x, y):
+        _check_inplace_ok(x)
+        out = where_fn(condition, x, y)
+        x._set_data(out._data if isinstance(out, Tensor) else out)
+        return x
+
+    return where_
+
+
+
+def normal_(x, mean=0.0, std=1.0):
+    """Fill x with N(mean, std) samples (paddle.Tensor.normal_)."""
+    import jax
+    from ..nn.functional import random_mod
+    _check_inplace_ok(x)
+    key = random_mod.next_key()
+    x._set_data(mean + std * jax.random.normal(key, tuple(x.shape),
+                                               x._data.dtype))
+    return x
+
+
+def cauchy_(x, loc=0.0, scale=1.0):
+    """Fill with Cauchy(loc, scale) samples."""
+    import jax
+    from ..nn.functional import random_mod
+    _check_inplace_ok(x)
+    key = random_mod.next_key()
+    x._set_data(jax.random.cauchy(key, tuple(x.shape), x._data.dtype)
+                * scale + loc)
+    return x
+
+
+def geometric_(x, probs):
+    """Fill with Geometric(probs) samples (number of failures)."""
+    import jax
+    import jax.numpy as jnp
+    from ..nn.functional import random_mod
+    _check_inplace_ok(x)
+    key = random_mod.next_key()
+    u = jax.random.uniform(key, tuple(x.shape))
+    p = probs._data if isinstance(probs, Tensor) else probs
+    out = jnp.floor(jnp.log1p(-u) / jnp.log1p(-p)) + 1.0
+    x._set_data(out.astype(x._data.dtype))
+    return x
+
+
+__all__ = ["build", "normal_", "cauchy_", "geometric_"]

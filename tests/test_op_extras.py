@@ -1,0 +1,371 @@
+"""Long-tail op surface (ops/extras.py, ops/inplace.py, core/shims.py).
+
+Reference test model: test/legacy_test per-op tests — each op checked
+against the NumPy/SciPy reference on concrete values.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(0)
+
+
+def _t(a, dtype=None):
+    return paddle.to_tensor(np.asarray(a, dtype=dtype or "float32"))
+
+
+def _np(x):
+    return np.asarray(x._data)
+
+
+class TestSpecialFunctions:
+    def test_gammaln_and_incomplete(self):
+        from scipy import special
+        x = np.abs(RNG.rand(16).astype("float32")) * 5 + 0.1
+        np.testing.assert_allclose(_np(paddle.gammaln(_t(x))),
+                                   special.gammaln(x), rtol=1e-4, atol=1e-5)
+        y = np.abs(RNG.rand(16).astype("float32")) * 3 + 0.1
+        np.testing.assert_allclose(_np(paddle.gammainc(_t(x), _t(y))),
+                                   special.gammainc(x, y), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.gammaincc(_t(x), _t(y))),
+                                   special.gammaincc(x, y), rtol=1e-4)
+
+    def test_bessel(self):
+        from scipy import special
+        x = RNG.rand(8).astype("float32") * 3
+        np.testing.assert_allclose(_np(paddle.i0(_t(x))), special.i0(x),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.i1e(_t(x))), special.i1e(x),
+                                   rtol=1e-4)
+
+    def test_multigammaln(self):
+        from scipy import special
+        x = np.array([3.0, 4.5], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.multigammaln(_t(x), 2)),
+                                   special.multigammaln(x, 2), rtol=1e-4)
+
+    def test_polygamma(self):
+        from scipy import special
+        x = np.array([1.5, 2.5], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.polygamma(_t(x), 1)),
+                                   special.polygamma(1, x), rtol=1e-3)
+
+
+class TestElementwise:
+    def test_log_family(self):
+        x = RNG.randn(32).astype("float32")
+        y = RNG.randn(32).astype("float32")
+        np.testing.assert_allclose(_np(paddle.logaddexp(_t(x), _t(y))),
+                                   np.logaddexp(x, y), rtol=1e-5)
+        lce = _np(paddle.logcumsumexp(_t(x)))
+        ref = np.logaddexp.accumulate(x)
+        np.testing.assert_allclose(lce, ref, rtol=1e-4)
+
+    def test_sign_families(self):
+        x = RNG.randn(16).astype("float32")
+        y = RNG.randn(16).astype("float32")
+        np.testing.assert_allclose(_np(paddle.copysign(_t(x), _t(y))),
+                                   np.copysign(x, y))
+        np.testing.assert_allclose(_np(paddle.heaviside(_t(x), _t(y))),
+                                   np.heaviside(x, y))
+        assert (_np(paddle.signbit(_t(x))) == np.signbit(x)).all()
+        z = np.array([3 + 4j], dtype="complex64")
+        np.testing.assert_allclose(_np(paddle.sgn(paddle.to_tensor(z))),
+                                   z / np.abs(z), rtol=1e-6)
+
+    def test_float_decomp(self):
+        x = np.array([8.0, 0.5, -3.0], dtype="float32")
+        m, e = paddle.frexp(_t(x))
+        np.testing.assert_allclose(_np(m) * (2.0 ** _np(e)), x)
+        np.testing.assert_allclose(
+            _np(paddle.ldexp(_t(x), _t([1, 2, 3], "int32"))),
+            np.ldexp(x, [1, 2, 3]))
+
+    def test_integer_ops(self):
+        a = _t([12, 18, 7], "int32")
+        b = _t([8, 12, 21], "int32")
+        np.testing.assert_array_equal(_np(paddle.gcd(a, b)), [4, 6, 7])
+        np.testing.assert_array_equal(_np(paddle.lcm(a, b)), [24, 36, 21])
+        np.testing.assert_array_equal(
+            _np(paddle.bitwise_left_shift(_t([1, 2], "int32"),
+                                          _t([2, 3], "int32"))), [4, 16])
+
+    def test_angles(self):
+        x = np.array([0.0, np.pi / 2, np.pi], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.rad2deg(_t(x))),
+                                   [0, 90, 180], atol=1e-4)
+        np.testing.assert_allclose(_np(paddle.deg2rad(_t([180.0]))),
+                                   [np.pi], rtol=1e-6)
+
+    def test_renorm(self):
+        x = RNG.randn(4, 8).astype("float32") * 5
+        out = _np(paddle.renorm(_t(x), p=2.0, axis=0, max_norm=1.0))
+        norms = np.linalg.norm(out, axis=1)
+        assert (norms <= 1.0 + 1e-4).all()
+
+
+class TestConstructionsAndViews:
+    def test_diag_embed(self):
+        x = RNG.randn(2, 3).astype("float32")
+        out = _np(paddle.diag_embed(_t(x)))
+        assert out.shape == (2, 3, 3)
+        np.testing.assert_allclose(out[0], np.diag(x[0]))
+
+    def test_vander_polar_complex(self):
+        x = np.array([1.0, 2.0, 3.0], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.vander(_t(x))), np.vander(x))
+        r = _t([1.0, 2.0])
+        th = _t([0.0, np.pi / 2])
+        out = _np(paddle.polar(r, th))
+        np.testing.assert_allclose(out, [1 + 0j, 2j], atol=1e-6)
+        c = _np(paddle.complex(_t([1.0]), _t([2.0])))
+        assert c.dtype == np.complex64 and c[0] == 1 + 2j
+
+    def test_tri_indices_and_combinations(self):
+        out = _np(paddle.tril_indices(3, 3, 0))
+        ref = np.stack(np.tril_indices(3))
+        np.testing.assert_array_equal(out, ref)
+        x = _t([1.0, 2.0, 3.0])
+        combs = _np(paddle.combinations(x, 2))
+        np.testing.assert_allclose(combs, [[1, 2], [1, 3], [2, 3]])
+
+    def test_stacks_and_splits(self):
+        a = RNG.randn(2, 3).astype("float32")
+        np.testing.assert_allclose(_np(paddle.hstack([_t(a), _t(a)])),
+                                   np.hstack([a, a]))
+        np.testing.assert_allclose(_np(paddle.vstack([_t(a), _t(a)])),
+                                   np.vstack([a, a]))
+        np.testing.assert_allclose(_np(paddle.column_stack([_t(a), _t(a)])),
+                                   np.column_stack([a, a]))
+        parts = paddle.tensor_split(_t(np.arange(10, dtype="float32")), 3)
+        ref = np.array_split(np.arange(10), 3)
+        for p, r in zip(parts, ref):
+            np.testing.assert_allclose(_np(p), r)
+        assert len(paddle.vsplit(_t(RNG.randn(4, 2)), 2)) == 2
+
+    def test_atleast(self):
+        assert paddle.atleast_1d(_t(3.0)).shape == [1]
+        assert paddle.atleast_2d(_t([1.0, 2.0])).shape == [1, 2]
+        assert paddle.atleast_3d(_t([[1.0]])).shape == [1, 1, 1]
+
+    def test_slice_and_strided(self):
+        x = np.arange(24, dtype="float32").reshape(4, 6)
+        out = _np(paddle.slice(_t(x), [0, 1], [1, 2], [3, 5]))
+        np.testing.assert_allclose(out, x[1:3, 2:5])
+        out = _np(paddle.strided_slice(_t(x), [1], [0], [6], [2]))
+        np.testing.assert_allclose(out, x[:, 0:6:2])
+        out = _np(paddle.crop(_t(x), shape=[2, 3], offsets=[1, 1]))
+        np.testing.assert_allclose(out, x[1:3, 1:4])
+
+    def test_as_strided_and_unfold(self):
+        x = np.arange(12, dtype="float32")
+        out = _np(paddle.as_strided(_t(x), [3, 4], [4, 1]))
+        np.testing.assert_allclose(out, x.reshape(3, 4))
+        out = _np(paddle.unfold(_t(x), 0, 4, 2))
+        assert out.shape == (5, 4)
+        np.testing.assert_allclose(out[1], x[2:6])
+
+    def test_reverse_add_n(self):
+        x = RNG.randn(3, 2).astype("float32")
+        np.testing.assert_allclose(_np(paddle.reverse(_t(x), 0)), x[::-1])
+        np.testing.assert_allclose(
+            _np(paddle.add_n([_t(x), _t(x), _t(x)])), 3 * x, rtol=1e-6)
+
+    def test_diagonal_scatter_and_masked_scatter(self):
+        x = np.zeros((3, 3), dtype="float32")
+        y = np.array([1.0, 2.0, 3.0], dtype="float32")
+        out = _np(paddle.diagonal_scatter(_t(x), _t(y)))
+        np.testing.assert_allclose(out, np.diag(y))
+        m = np.array([True, False, True], dtype=bool)
+        out = _np(paddle.masked_scatter(_t([0.0, 0.0, 0.0]),
+                                        paddle.to_tensor(m),
+                                        _t([5.0, 6.0])))
+        np.testing.assert_allclose(out, [5.0, 0.0, 6.0])
+
+
+class TestSearchStats:
+    def test_index_sample_multiplex(self):
+        x = np.arange(12, dtype="float32").reshape(3, 4)
+        idx = np.array([[0, 2], [1, 3], [0, 0]], dtype="int32")
+        out = _np(paddle.index_sample(_t(x), paddle.to_tensor(idx)))
+        np.testing.assert_allclose(out, np.take_along_axis(x, idx, 1))
+        a = _t([[1.0, 1.0], [2.0, 2.0]])
+        b = _t([[3.0, 3.0], [4.0, 4.0]])
+        sel = paddle.to_tensor(np.array([[1], [0]], dtype="int32"))
+        np.testing.assert_allclose(_np(paddle.multiplex([a, b], sel)),
+                                   [[3, 3], [2, 2]])
+
+    def test_nanmedian_pdist(self):
+        x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]],
+                     dtype="float32")
+        np.testing.assert_allclose(_np(paddle.nanmedian(_t(x))), 3.5)
+        pts = RNG.randn(5, 3).astype("float32")
+        from scipy.spatial.distance import pdist as sp_pdist
+        np.testing.assert_allclose(_np(paddle.pdist(_t(pts))),
+                                   sp_pdist(pts), rtol=1e-4)
+
+    def test_unique_consecutive(self):
+        x = _t([1, 1, 2, 2, 3, 1, 1], "int32")
+        out, inv, counts = paddle.unique_consecutive(
+            x, return_inverse=True, return_counts=True)
+        np.testing.assert_array_equal(_np(out), [1, 2, 3, 1])
+        np.testing.assert_array_equal(_np(counts), [2, 2, 1, 2])
+
+    def test_histogramdd(self):
+        pts = RNG.randn(100, 2).astype("float32")
+        hist, edges = paddle.histogramdd(_t(pts), bins=4)
+        ref_h, ref_e = np.histogramdd(pts, bins=4)
+        np.testing.assert_allclose(_np(hist), ref_h)
+
+    def test_cumulative_trapezoid(self):
+        y = np.array([1.0, 2.0, 3.0], dtype="float32")
+        out = _np(paddle.cumulative_trapezoid(_t(y), dx=1.0))
+        np.testing.assert_allclose(out, [1.5, 4.0])
+
+
+class TestInplaceVariants:
+    def test_math_inplace(self):
+        x = _t([1.0, 4.0, 9.0])
+        ref_id = x
+        out = paddle.sqrt_(x)
+        assert out is ref_id
+        np.testing.assert_allclose(_np(x), [1.0, 2.0, 3.0])
+        paddle.add_(x, _t([1.0, 1.0, 1.0]))
+        np.testing.assert_allclose(_np(x), [2.0, 3.0, 4.0])
+        x.tanh_()
+        np.testing.assert_allclose(_np(x), np.tanh([2.0, 3.0, 4.0]),
+                                   rtol=1e-6)
+
+    def test_shape_inplace(self):
+        x = _t(np.arange(6, dtype="float32"))
+        x.reshape_([2, 3])
+        assert x.shape == [2, 3]
+        x.transpose_([1, 0])
+        assert x.shape == [3, 2]
+        x.squeeze_(0) if x.shape[0] == 1 else None
+        y = _t(np.arange(4, dtype="float32").reshape(2, 2))
+        paddle.t_(y)
+        assert y.shape == [2, 2]
+
+    def test_inplace_on_grad_leaf_raises(self):
+        x = _t([1.0, 2.0])
+        x.stop_gradient = False
+        with pytest.raises(RuntimeError):
+            paddle.sqrt_(x)
+
+    def test_random_fills(self):
+        paddle.seed(0)
+        x = _t(np.zeros(1000))
+        paddle.normal_(x, mean=2.0, std=0.5)
+        assert abs(float(_np(x).mean()) - 2.0) < 0.1
+        g = _t(np.zeros(1000))
+        paddle.geometric_(g, 0.5)
+        assert (_np(g) >= 1).all()
+
+    def test_floor_mod_alias(self):
+        a = _t([7.0, -7.0])
+        out = paddle.floor_mod(a, _t([3.0, 3.0]))
+        np.testing.assert_allclose(_np(out), [1.0, 2.0])
+
+
+class TestShims:
+    def test_iinfo_finfo(self):
+        ii = paddle.iinfo("int32")
+        assert ii.max == 2**31 - 1 and ii.bits == 32
+        fi = paddle.finfo(paddle.float32)
+        assert fi.bits == 32 and fi.eps > 0
+
+    def test_dtype_and_bool(self):
+        import jax.numpy as jnp
+        assert paddle.dtype("float32") == jnp.float32
+        assert paddle.bool == paddle.bool_
+
+    def test_is_predicates(self):
+        assert paddle.is_tensor(_t([1.0]))
+        assert not paddle.is_tensor([1.0])
+        assert paddle.is_floating_point(_t([1.0]))
+        assert paddle.is_integer(_t([1], "int32"))
+        assert paddle.is_complex(paddle.complex(_t([1.0]), _t([0.0])))
+
+    def test_shape_rank_t(self):
+        x = _t(np.zeros((2, 5)))
+        np.testing.assert_array_equal(_np(paddle.shape(x)), [2, 5])
+        assert int(_np(paddle.rank(x))) == 2
+        assert paddle.t(x).shape == [5, 2]
+
+    def test_batch_reader(self):
+        reader = paddle.batch(lambda: iter(range(7)), batch_size=3)
+        batches = list(reader())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+
+    def test_rng_state_roundtrip(self):
+        paddle.seed(42)
+        st = paddle.get_rng_state()
+        a = _np(paddle.rand([4]))
+        paddle.set_rng_state(st)
+        b = _np(paddle.rand([4]))
+        np.testing.assert_allclose(a, b)
+
+    def test_create_parameter(self):
+        p = paddle.create_parameter([3, 4], dtype="float32")
+        assert p.shape == [3, 4] and p.trainable
+        b = paddle.create_parameter([4], is_bias=True)
+        np.testing.assert_allclose(_np(b), 0.0)
+
+    def test_broadcast_shape(self):
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+    def test_lazy_guard_and_misc(self):
+        with paddle.LazyGuard():
+            import paddle_tpu.nn as nn
+            lin = nn.Linear(2, 2)
+        assert lin.weight.shape == [2, 2]
+        paddle.disable_signal_handler()
+        paddle.set_printoptions(precision=4)
+
+    def test_random_tail(self):
+        paddle.seed(0)
+        out = paddle.binomial(_t([10] * 200, "int32"), _t([0.5] * 200))
+        m = float(_np(out).mean())
+        assert 4.0 < m < 6.0
+        g = paddle.standard_gamma(_t([2.0] * 500))
+        assert abs(float(_np(g).mean()) - 2.0) < 0.3
+
+
+class TestReviewRegressions:
+    """Cases from code review: non-default dims/axes and inplace targets."""
+
+    def test_diag_embed_custom_dims(self):
+        x = np.arange(6, dtype="float32").reshape(2, 3)
+        out = _np(paddle.diag_embed(_t(x), dim1=0, dim2=1))
+        assert out.shape == (3, 3, 2)
+        for b in range(2):
+            for i in range(3):
+                assert out[i, i, b] == x[b, i]
+
+    def test_unfold_2d_layout(self):
+        x = np.arange(40, dtype="float32").reshape(4, 10)
+        out = _np(paddle.unfold(_t(x), 0, 2, 2))
+        assert out.shape == (2, 10, 2)          # size appended LAST
+        np.testing.assert_allclose(out[0, :, 1], x[1])
+
+    def test_renorm_negative_axis(self):
+        x = RNG.randn(4, 8).astype("float32") * 5
+        out = _np(paddle.renorm(_t(x), p=2.0, axis=-1, max_norm=1.0))
+        assert (np.linalg.norm(out, axis=0) <= 1.0 + 1e-4).all()
+
+    def test_where_inplace_targets_x(self):
+        cond = paddle.to_tensor(np.array([True, False]))
+        a = _t([1.0, 2.0])
+        b = _t([9.0, 9.0])
+        r = paddle.where_(cond, a, b)
+        assert r is a
+        np.testing.assert_allclose(_np(a), [1.0, 9.0])
+        np.testing.assert_array_equal(_np(cond), [True, False])
+
+    def test_tri_indices_dtype(self):
+        out = paddle.tril_indices(3, dtype="int64")
+        assert "int" in str(out.dtype)
+        out32 = paddle.triu_indices(3, dtype="int32")
+        assert str(out32.dtype) == "int32"
