@@ -233,3 +233,15 @@ class GradScaler:
 # paddle.amp.debugging (op stats + NaN/Inf checker); imported late so the
 # dispatch hook only pays when enabled
 from . import debugging  # noqa: E402,F401
+
+
+def is_float16_supported(device=None):
+    """ref amp.is_float16_supported: fp16 compute support. TPUs compute in
+    bf16 natively; fp16 works via XLA but without MXU benefit."""
+    import jax
+    return jax.devices()[0].platform in ("tpu", "gpu", "axon")
+
+
+def is_bfloat16_supported(device=None):
+    """ref amp.is_bfloat16_supported: always true on TPU/XLA backends."""
+    return True

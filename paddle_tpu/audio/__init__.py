@@ -5,3 +5,10 @@ from . import features
 from . import functional
 
 __all__ = ["features", "functional"]
+
+# -- paddle.audio io surface (ref audio/__init__.py backends + datasets) -----
+from . import backends  # noqa: E402
+from . import datasets  # noqa: E402
+from .backends import info, load, save  # noqa: E402
+
+__all__ += ["backends", "datasets", "info", "load", "save"]

@@ -1,0 +1,78 @@
+"""paddle.audio.backends — wave io (ref audio/backends over soundfile;
+the baked image has no soundfile, so the stdlib wave module covers the
+WAV path and other formats raise with a clear message)."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return "wave"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave":
+        raise ValueError("only the builtin 'wave' backend exists offline")
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         w.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    with _wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(count)
+    dtype = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+    arr = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
+    if normalize:
+        arr = arr.astype(np.float32) / float(2 ** (8 * width - 1))
+    data = arr.T if channels_first else arr
+    return Tensor(jnp.asarray(data)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    from ..core.tensor import Tensor
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T
+    if arr.dtype in (np.float32, np.float64):
+        arr = (np.clip(arr, -1, 1)
+               * (2 ** (bits_per_sample - 1) - 1)).astype(
+            {8: np.int8, 16: np.int16, 32: np.int32}[bits_per_sample])
+    with _wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(sample_rate)
+        w.writeframes(arr.tobytes())
+
+
+__all__ = ["info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend", "AudioInfo"]

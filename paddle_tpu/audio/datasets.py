@@ -1,0 +1,24 @@
+"""paddle.audio.datasets (ref audio/datasets: TESS, ESC50) — offline gated
+like the text datasets (archives must be pre-placed)."""
+from __future__ import annotations
+
+
+class _Gated:
+    _name = "dataset"
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"paddle.audio.datasets.{self._name} needs its archive "
+            f"downloaded; no egress in this environment — build an "
+            f"io.Dataset over local files instead")
+
+
+class TESS(_Gated):
+    _name = "TESS"
+
+
+class ESC50(_Gated):
+    _name = "ESC50"
+
+
+__all__ = ["TESS", "ESC50"]

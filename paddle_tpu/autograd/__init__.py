@@ -103,3 +103,120 @@ class PyLayer(metaclass=PyLayerMeta):
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
            "set_grad_enabled", "PyLayer", "PyLayerContext",
            "register_tensor_hook"]
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """paddle.autograd.jacobian (ref autograd/autograd.py Jacobian): the
+    full Jacobian d ys / d xs, computed with jax.jacrev over a tensor-level
+    replay — the TPU-native answer to the reference's row-by-row grad calls.
+    ys must be produced from xs; we re-run via the tape replay closure."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+
+    # Build a pure function x_arrays -> y_array by replaying the tape from
+    # xs to ys (reference computes rows by repeated backward; vjp replay
+    # here gives the same values in one jacrev).
+    from . import engine as _engine
+
+    def fn(*arrs):
+        saved = [(t, t._data) for t in xs_list]
+        try:
+            for t, a in zip(xs_list, arrs):
+                t._data = a
+            out = _replay_from(ys, xs_list)
+            return out
+        finally:
+            for t, d in saved:
+                t._data = d
+
+    jac = jax.jacrev(fn, argnums=tuple(range(len(xs_list))))(
+        *[t._data for t in xs_list])
+    if single:
+        return Tensor(jac[0])
+    return [Tensor(j) for j in jac]
+
+
+def _replay_from(ys, xs_list):
+    """Recompute ys' array from xs' current arrays by walking the tape."""
+    from ..core.tensor import Tensor
+
+    memo = {}
+    x_ids = {id(t): t for t in xs_list}
+
+    def rebuild(t):
+        if id(t) in memo:
+            return memo[id(t)]
+        if id(t) in x_ids:
+            memo[id(t)] = t._data
+            return t._data
+        node = t._grad_node
+        if node is None:
+            memo[id(t)] = t._data
+            return t._data
+        import jax
+        in_arrays = [rebuild(i) for i in node.inputs]
+        out = node.call(*in_arrays)
+        leaves = jax.tree_util.tree_leaves(out)
+        # cache every output of this node
+        for candidate in _tensors_of_node(node, t):
+            if candidate._grad_node is node:
+                memo[id(candidate)] = leaves[candidate._grad_out_idx]
+        return memo[id(t)]
+
+    def _tensors_of_node(node, t):
+        return [t]
+
+    return rebuild(ys)
+
+
+def hessian(ys, xs, batch_axis=None):
+    """paddle.autograd.hessian: d^2 ys / d xs^2 via jax.hessian over the
+    tape replay (ys must be scalar)."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+
+    def fn(*arrs):
+        saved = [(t, t._data) for t in xs_list]
+        try:
+            for t, a in zip(xs_list, arrs):
+                t._data = a
+            return _replay_from(ys, xs_list).reshape(())
+        finally:
+            for t, d in saved:
+                t._data = d
+
+    hes = jax.hessian(fn, argnums=tuple(range(len(xs_list))))(
+        *[t._data for t in xs_list])
+    if single:
+        return Tensor(hes[0][0])
+    return [[Tensor(h) for h in row] for row in hes]
+
+
+class saved_tensors_hooks:
+    """ref autograd.saved_tensors_hooks: pack/unpack hooks for tensors the
+    tape saves for backward. The tape holds jax vjp residuals internally
+    (not Tensors), so the hooks apply to PyLayer saved tensors — pack on
+    save_for_backward, unpack on retrieval."""
+
+    _active = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active.pop()
+        return False

@@ -191,3 +191,48 @@ __all__ = ["set_device", "get_device", "device_count", "synchronize",
            "Stream", "Event", "current_stream", "stream_guard",
            "memory_allocated", "max_memory_allocated", "memory_reserved",
            "max_memory_reserved", "empty_cache", "cuda"]
+
+
+from ..core.shims import XPUPlace  # noqa: E402
+
+
+def get_cudnn_version():
+    """No CUDA in this build (ref device.get_cudnn_version -> None when
+    unavailable)."""
+    return None
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """XLA plays CINN's role (SURVEY.md N23); the CINN binary is absent."""
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return device_type in ("tpu", "axon")
+
+
+def set_stream(stream=None):
+    """PJRT orders work per-device automatically; returns the prior stream
+    handle for API parity."""
+    return stream
+
+
+class IPUPlace:
+    def __init__(self, *a):
+        raise RuntimeError("IPU is not available in the TPU build")

@@ -59,3 +59,120 @@ def get_group(gid=0):
 
 from . import checkpoint
 from .checkpoint import load_state_dict, save_state_dict
+
+
+# -- remaining python/paddle/distributed surface -----------------------------
+
+from .collective import (ParallelMode, ReduceType, alltoall_single,  # noqa: E402
+                         broadcast_object_list, gather, get_backend,
+                         gloo_barrier, gloo_init_parallel_env, gloo_release,
+                         is_available, scatter_object_list)
+from . import launch  # noqa: E402
+from ..framework import io  # noqa: E402  (paddle.distributed.io alias)
+
+
+class ParallelEnv:
+    """ref parallel.py ParallelEnv: env-derived rank/world info."""
+
+    def __init__(self):
+        import os
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.device_id = int(os.environ.get("FLAGS_selected_gpus", "0"))
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+class DistAttr:
+    """ref auto_parallel DistAttr: (mesh, placement-per-dim) descriptor."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """ref auto_parallel/api.py dtensor_from_fn: build then shard."""
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """ref distributed.split (fleet/layers/mpu collective_ops.split): build
+    a row/column-parallel linear or vocab-parallel embedding on the current
+    mp group. Maps onto the fleet mpu layers."""
+    from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, has_bias=bias_attr
+                                      is not False,
+                                      input_is_parallel=not gather_out)
+        else:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+    elif operation == "embedding":
+        n_emb, dim = size
+        layer = VocabParallelEmbedding(n_emb, dim)
+    else:
+        raise ValueError("operation must be 'linear' or 'embedding'")
+    return layer(x)
+
+
+# PS-mode dataset / entry configs (ref fluid PS datasets; document-only tier
+# like the rest of the PS stack — see ps/__init__.py)
+class _PSDatasetBase:
+    def __init__(self, *args, **kwargs):
+        self._files = []
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        pass
+
+    def release_memory(self):
+        pass
+
+
+class InMemoryDataset(_PSDatasetBase):
+    """ref distributed.InMemoryDataset (PS in-memory shuffle dataset):
+    API-compatible stub — the PS training mode is out of TPU scope
+    (SURVEY.md N17)."""
+
+
+class QueueDataset(_PSDatasetBase):
+    """ref distributed.QueueDataset: streaming PS dataset stub."""
+
+
+class ProbabilityEntry:
+    def __init__(self, probability):
+        self.probability = probability
+
+
+class CountFilterEntry:
+    def __init__(self, count_filter):
+        self.count_filter = count_filter
+
+
+class ShowClickEntry:
+    def __init__(self, show_name, click_name):
+        self.show_name = show_name
+        self.click_name = click_name

@@ -469,3 +469,113 @@ def ppermute(x, axis_name, perm):
 
 def axis_index(axis_name):
     return jax.lax.axis_index(axis_name)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """paddle.distributed.gather: rank `dst` receives every slice (single
+    controller: all_gather then keep; non-dst ranks get an empty list)."""
+    g = group or _world()
+    slices = all_gather([], tensor, group=g)  # returns the per-rank list
+    if gather_list is not None:
+        gather_list.extend(slices)
+        return gather_list
+    return slices
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """paddle.distributed.alltoall_single (equal splits; ragged splits are a
+    DCN feature the stacked-mesh runner does not model)."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError("ragged alltoall_single splits")
+    g = group or _world()
+    arr = _unwrap(in_tensor)
+    _check_stacked(arr, g, "alltoall_single")
+    n = g.nranks
+    arr = arr.reshape((n, n, -1) + tuple(arr.shape[2:]))
+    out = _stacked(
+        lambda x: jax.lax.all_to_all(x, g.axis_name, split_axis=1,
+                                     concat_axis=0, tiled=True),
+        g, arr, cache_key=("alltoall_single",))
+    result = Tensor(out.reshape(_unwrap(in_tensor).shape))
+    if out_tensor is not None:
+        out_tensor._set_data(result._data)
+        return out_tensor
+    return result
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Single controller: rank i's slot is in_object_list[i] (the src list
+    is visible to all)."""
+    g = group or _world()
+    if in_object_list is None:
+        raise ValueError("in_object_list required on the src rank")
+    if len(in_object_list) != g.nranks:
+        raise ValueError("in_object_list must have one entry per rank")
+    out_object_list.append(in_object_list[g.rank_in_group])
+    return out_object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Single controller: objects are already shared; identity."""
+    return object_list
+
+
+class ReduceType:
+    """auto-parallel reduce type enum (ref ReduceType for Partial)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+
+
+class ParallelMode:
+    """fleet/base/topology.py:33 ParallelMode enum."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+def is_available():
+    """paddle.distributed.is_available."""
+    return True
+
+
+def get_backend(group=None):
+    """The communication backend name (XLA collectives over ICI/DCN)."""
+    return "XCCL"
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Host-side (gloo-analog) bootstrap: the TCPStore fills gloo's role
+    (SURVEY §2.9 'host barriers via TCPStore')."""
+    from ..core.native import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    is_master = rank_id == 0
+    store = TCPStore(host, int(port), is_master=is_master,
+                     world_size=rank_num)
+    global _GLOO_STORE
+    _GLOO_STORE = (store, rank_id, rank_num)
+
+
+_GLOO_STORE = None
+_GLOO_BARRIER_SEQ = [0]
+
+
+def gloo_barrier():
+    if _GLOO_STORE is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    store, rank, n = _GLOO_STORE
+    # per-call key: the store's done-flag is sticky, so a reused key would
+    # let later barriers pass through without synchronizing
+    _GLOO_BARRIER_SEQ[0] += 1
+    store.barrier(f"gloo_barrier_{_GLOO_BARRIER_SEQ[0]}", n)
+
+
+def gloo_release():
+    global _GLOO_STORE
+    _GLOO_STORE = None

@@ -245,3 +245,136 @@ class TensorParallel:
 class ShardingParallel:
     def __new__(cls, model, hcg=None, **kwargs):
         return model
+
+
+# -- fleet infra classes (ref fleet/__init__.py exports) ---------------------
+
+Fleet = _Fleet
+
+
+class Role:
+    """ref fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        import os
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def worker_index(self):
+        return self._rank
+
+    def worker_num(self):
+        return self._size
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._rank == 0
+
+    def role(self):
+        return Role.WORKER
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """ref PaddleCloudRoleMaker: roles from the launch env vars."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """ref UserDefinedRoleMaker: explicit rank/size."""
+
+    def __init__(self, is_collective=True, current_id=0, worker_num=1,
+                 role=Role.WORKER, **kwargs):
+        super().__init__()
+        self._rank = current_id
+        self._size = worker_num
+        self._role = role
+
+    def role(self):
+        return self._role
+
+
+class UtilBase:
+    """ref fleet/base/util_factory.py UtilBase: rank-collective helpers for
+    user code (all_reduce on python values, barriers, fs access)."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        arr = np.asarray(input)
+        return arr  # single-controller: the value is already global
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _barrier
+        try:
+            _barrier()
+        except Exception:
+            pass
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..collective import _world
+        try:
+            n = _world().nranks
+        except Exception:
+            n = 1
+        return [input] * n
+
+    def get_file_shard(self, files):
+        import os
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        return files[rank::size]
+
+
+class MultiSlotDataGenerator:
+    """ref fleet MultiSlotDataGenerator (PS data pipeline): subclass
+    implements generate_sample; run_from_stdin feeds the PS dataset. The
+    PS training mode is documentation-only in the TPU build (SURVEY N17),
+    but the generator protocol works standalone for data prep."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            g = self.generate_sample(line)
+            for sample in g():
+                sys.stdout.write(self._format(sample) + "\n")
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            g = self.generate_sample(line)
+            for sample in g():
+                out.append(self._format(sample))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)

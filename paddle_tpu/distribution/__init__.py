@@ -10,7 +10,8 @@ from __future__ import annotations
 from .continuous import (Beta, Cauchy, Dirichlet, Exponential, Gamma, Gumbel,
                          Laplace, LogNormal, MultivariateNormal, Normal,
                          StudentT, Uniform)
-from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
+from .discrete import (Bernoulli, Binomial, Categorical,
+                       ContinuousBernoulli, Geometric,
                        Multinomial, Poisson)
 from .distribution import (Distribution, ExponentialFamily, Independent,
                            TransformedDistribution)
@@ -27,7 +28,8 @@ __all__ = [
     "Normal", "Uniform", "Beta", "Gamma", "Dirichlet", "Exponential",
     "Laplace", "Gumbel", "LogNormal", "Cauchy", "StudentT",
     "MultivariateNormal",
-    "Bernoulli", "Categorical", "Geometric", "Multinomial", "Poisson",
+    "Bernoulli", "Categorical", "ContinuousBernoulli", "Geometric",
+    "Multinomial", "Poisson",
     "Binomial",
     "kl_divergence", "register_kl",
     "Transform", "AbsTransform", "AffineTransform", "ChainTransform",

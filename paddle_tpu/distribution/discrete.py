@@ -358,3 +358,86 @@ class Binomial(Distribution):
             return 0.5 * jnp.log(2 * math.pi * math.e * n * p * (1 - p)
                                  + 1e-8)
         return dispatch(_impl, (self.probs,), {}, op_name="binomial_entropy")
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """continuous_bernoulli.py analog (Loaiza-Ganem & Cunningham 2019):
+    support (0, 1), density C(p) * p^x * (1-p)^(1-x) with normalizer
+    C(p) = 2*atanh(1-2p) / (1-2p) (p != 0.5)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _log_norm(self, p):
+        # stable around p=0.5 via the taylor expansion the paper uses
+        lo, hi = self._lims
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        x = 1 - 2 * safe
+        direct = jnp.log(2 * jnp.arctanh(x) / jnp.where(
+            jnp.abs(x) < 1e-12, 1.0, x))
+        taylor = jnp.log(2.0) + 4.0 / 3.0 * x ** 2 + 104.0 / 45.0 * x ** 4
+        return jnp.where((safe < lo) | (safe > hi), direct, taylor)
+
+    @property
+    def mean(self):
+        def _impl(p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            x = 1 - 2 * safe
+            direct = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(x))
+            lo, hi = self._lims
+            return jnp.where((safe < lo) | (safe > hi), direct, 0.5)
+        return dispatch(_impl, (self.probs,), {}, op_name="cb_mean")
+
+    @property
+    def variance(self):
+        def _impl(p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            x = 1 - 2 * safe
+            m = jnp.where(jnp.abs(x) > 1e-3,
+                          safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(x)),
+                          0.5)
+            direct = safe * (safe - 1) / (1 - 2 * safe) ** 2 \
+                + 1 / (2 * jnp.arctanh(x)) ** 2
+            return jnp.where(jnp.abs(x) > 1e-3, direct, 1.0 / 12.0)
+        return dispatch(_impl, (self.probs,), {}, op_name="cb_var")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        shape = _shape(shape)
+        key = random_mod.next_key()
+        out_shape = shape + self.batch_shape
+
+        def _impl(p):
+            u = jax.random.uniform(key, out_shape, dtype=p.dtype,
+                                   minval=1e-6, maxval=1 - 1e-6)
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            # inverse cdf: x = log1p(u*((1-p)/p)^... ) stable form
+            mid = jnp.abs(safe - 0.5) < 1e-4
+            ratio = jnp.log1p(-safe) - jnp.log(safe)
+            icdf = (jnp.log1p(u * jnp.expm1(-ratio)) + 0.0) / (-ratio)
+            return jnp.where(mid, u, icdf)
+
+        return dispatch(_impl, (self.probs,), {}, op_name="cb_rsample")
+
+    def log_prob(self, value):
+        def _impl(v, p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            return (v * jnp.log(safe) + (1 - v) * jnp.log1p(-safe)
+                    + self._log_norm(safe))
+        return dispatch(_impl, (_t(value), self.probs), {},
+                        op_name="cb_log_prob")
+
+    def entropy(self):
+        def _impl(p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            x = 1 - 2 * safe
+            m = jnp.where(jnp.abs(x) > 1e-3,
+                          safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(x)),
+                          0.5)
+            return -(m * jnp.log(safe) + (1 - m) * jnp.log1p(-safe)
+                     + self._log_norm(safe))
+        return dispatch(_impl, (self.probs,), {}, op_name="cb_entropy")

@@ -183,3 +183,49 @@ def rfftfreq(n, d=1.0, dtype=None, name=None):
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
            "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftshift",
            "ifftshift", "fftfreq", "rfftfreq"]
+
+
+# -- hermitian 2-D / N-D variants (ref paddle.fft.hfft2/ihfft2/hfftn/ihfftn:
+#    hermitian FFT = real spectrum of a hermitian-symmetric signal; composed
+#    from the 1-D hermitian transform over the last axis + complex FFTs over
+#    the leading axes, matching numpy's definition)
+@defop()
+def _hfftn(x, s=None, axes=None, norm="backward"):
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    sizes = list(s) if s is not None else [None] * len(axes)
+    for a, n_ in zip(axes[:-1], sizes[:-1]):
+        x = jnp.fft.fft(x, n=n_, axis=a, norm=_norm(norm))
+    return jnp.fft.hfft(x, n=sizes[-1], axis=axes[-1], norm=_norm(norm))
+
+
+@defop()
+def _ihfftn(x, s=None, axes=None, norm="backward"):
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    sizes = list(s) if s is not None else [None] * len(axes)
+    out = jnp.fft.ihfft(x, n=sizes[-1], axis=axes[-1], norm=_norm(norm))
+    for a, n_ in zip(axes[:-1], sizes[:-1]):
+        out = jnp.fft.ifft(out, n=n_, axis=a, norm=_norm(norm))
+    return out
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

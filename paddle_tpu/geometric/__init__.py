@@ -160,3 +160,122 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
 
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
            "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """ref geometric.sample_neighbors: uniform neighbor sampling from a CSC
+    graph (row = neighbor ids, colptr = per-node offsets). Host-side
+    sampling (graph sampling is data-pipeline work, not MXU work)."""
+    import numpy as np
+
+    from ..core import random as random_mod
+    from ..core.tensor import Tensor
+    r = np.asarray(row._data if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._data
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    e = None if eids is None else np.asarray(
+        eids._data if isinstance(eids, Tensor) else eids)
+    key = random_mod.default_generator().next_key()
+    rng = np.random.RandomState(int(np.asarray(key)[-1]) % (2 ** 31))
+    out_neighbors, out_counts, out_eids = [], [], []
+    for n in nodes.reshape(-1):
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            picks = np.arange(lo, hi)
+        else:
+            picks = lo + rng.choice(deg, sample_size, replace=False)
+        out_neighbors.append(r[picks])
+        out_counts.append(len(picks))
+        if e is not None:
+            out_eids.append(e[picks])
+    neighbors = Tensor(np.concatenate(out_neighbors)
+                       if out_neighbors else np.zeros(0, r.dtype))
+    counts = Tensor(np.asarray(out_counts, np.int32))
+    if return_eids:
+        if e is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, counts, Tensor(np.concatenate(out_eids))
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """ref geometric.weighted_sample_neighbors: weight-proportional
+    sampling without replacement."""
+    import numpy as np
+
+    from ..core import random as random_mod
+    from ..core.tensor import Tensor
+    r = np.asarray(row._data if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
+    w = np.asarray(edge_weight._data
+                   if isinstance(edge_weight, Tensor) else edge_weight)
+    nodes = np.asarray(input_nodes._data
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    key = random_mod.default_generator().next_key()
+    rng = np.random.RandomState(int(np.asarray(key)[-1]) % (2 ** 31))
+    out_neighbors, out_counts = [], []
+    for n in nodes.reshape(-1):
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            picks = np.arange(lo, hi)
+        else:
+            p = w[lo:hi] / w[lo:hi].sum()
+            picks = lo + rng.choice(deg, sample_size, replace=False, p=p)
+        out_neighbors.append(r[picks])
+        out_counts.append(len(picks))
+    neighbors = Tensor(np.concatenate(out_neighbors)
+                       if out_neighbors else np.zeros(0, r.dtype))
+    return neighbors, Tensor(np.asarray(out_counts, np.int32))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """ref geometric.reindex_graph: compact global node ids to local ids
+    (x first, then unseen neighbors in order)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    xs = np.asarray(x._data if isinstance(x, Tensor) else x).reshape(-1)
+    nb = np.asarray(neighbors._data
+                    if isinstance(neighbors, Tensor) else neighbors)
+    cnt = np.asarray(count._data if isinstance(count, Tensor) else count)
+    mapping = {}
+    for v in xs:
+        mapping.setdefault(int(v), len(mapping))
+    for v in nb:
+        mapping.setdefault(int(v), len(mapping))
+    reindex_src = np.asarray([mapping[int(v)] for v in nb], np.int64)
+    # dst: each center node i repeated count[i] times
+    reindex_dst = np.repeat(np.arange(len(xs)), cnt).astype(np.int64)
+    out_nodes = np.asarray(sorted(mapping, key=mapping.get), np.int64)
+    return (Tensor(reindex_src), Tensor(reindex_dst), Tensor(out_nodes))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """ref geometric.reindex_heter_graph: reindex per edge type then share
+    one node mapping."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    srcs, dsts = [], []
+    xs = np.asarray(x._data if isinstance(x, Tensor) else x).reshape(-1)
+    mapping = {}
+    for v in xs:
+        mapping.setdefault(int(v), len(mapping))
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = np.asarray(nb_t._data if isinstance(nb_t, Tensor) else nb_t)
+        cnt = np.asarray(cnt_t._data if isinstance(cnt_t, Tensor) else cnt_t)
+        for v in nb:
+            mapping.setdefault(int(v), len(mapping))
+        srcs.append(np.asarray([mapping[int(v)] for v in nb], np.int64))
+        dsts.append(np.repeat(np.arange(len(xs)), cnt).astype(np.int64))
+    out_nodes = np.asarray(sorted(mapping, key=mapping.get), np.int64)
+    return (Tensor(np.concatenate(srcs)), Tensor(np.concatenate(dsts)),
+            Tensor(out_nodes))

@@ -3,3 +3,60 @@ from . import asp
 from . import distributed
 from . import nn
 from . import optimizer
+
+# top-level incubate surface (ref python/paddle/incubate/__init__.py)
+from .optimizer import LookAhead, ModelAverage  # noqa: E402
+from ..geometric import (segment_max, segment_mean, segment_min,  # noqa: E402
+                         segment_sum)
+from ..geometric import (sample_neighbors as graph_sample_neighbors)  # noqa: E402,F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """ref incubate.softmax_mask_fuse: softmax(x + mask) fused (XLA fuses
+    the add into the softmax chain)."""
+    import paddle_tpu.nn.functional as F
+    return F.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """ref incubate.softmax_mask_fuse_upper_triangle: causal-masked softmax."""
+    import jax.numpy as jnp
+
+    from ..ops.registry import dispatch
+
+    def _impl(x):
+        import jax
+        s = x.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(causal, x, -1e9), axis=-1)
+
+    return dispatch(_impl, (x,), {},
+                    op_name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """ref incubate.identity_loss (IPU loss marker): reduce or pass."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                    name=None):
+    """ref incubate.graph_send_recv -> geometric.send_u_recv."""
+    from .. import geometric
+    return geometric.send_u_recv(x, src_index, dst_index,
+                                 reduce_op=reduce_op, out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, **kwargs):
+    from ..geometric import sample_neighbors
+    raise NotImplementedError(
+        "khop sampling: use paddle_tpu.geometric.sample_neighbors per hop")
+
+
+def graph_reindex(x, neighbors, count, **kwargs):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)

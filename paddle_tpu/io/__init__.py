@@ -4,12 +4,14 @@ from .dataloader import DataLoader, WorkerInfo, get_worker_info
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
                       IterableDataset, Subset, TensorDataset, random_split)
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
-                      Sampler, SequenceSampler, WeightedRandomSampler)
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)
 
 __all__ = [
     "default_collate_fn", "default_convert_fn", "DataLoader", "WorkerInfo",
     "get_worker_info", "ChainDataset", "ComposeDataset", "ConcatDataset",
     "Dataset", "IterableDataset", "Subset", "TensorDataset", "random_split",
     "BatchSampler", "DistributedBatchSampler", "RandomSampler", "Sampler",
+    "SubsetRandomSampler",
     "SequenceSampler", "WeightedRandomSampler",
 ]

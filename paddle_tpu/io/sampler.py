@@ -178,3 +178,21 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+
+
+class SubsetRandomSampler(Sampler):
+    """ref io/sampler.py SubsetRandomSampler: random permutation of a fixed
+    index subset each epoch."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        from ..core import random as random_mod
+        key = random_mod.default_generator().next_key()
+        rng = np.random.RandomState(int(np.asarray(key)[-1]) % (2 ** 31))
+        for i in rng.permutation(len(self.indices)):
+            yield self.indices[i]
+
+    def __len__(self):
+        return len(self.indices)

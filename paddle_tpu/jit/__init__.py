@@ -646,3 +646,33 @@ def load(path, **kwargs):
         if os.path.exists(path + suffix):
             return fio.load(path + suffix)
     raise FileNotFoundError(f"no saved model at {path}")
+
+
+# -- dy2static logging/config shims (ref jit/dy2static/logging_utils.py) -----
+
+_IGNORED_MODULES: list = []
+
+
+def ignore_module(modules):
+    """ref jit.ignore_module: functions from these modules never capture
+    (always treated as not_to_static)."""
+    _IGNORED_MODULES.extend(modules if isinstance(modules, (list, tuple))
+                            else [modules])
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """ref set_code_level: transformed-code logging — capture-by-execution
+    has no transformed source; retained for API parity (sets verbosity)."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+__all__ += ["ignore_module", "set_code_level", "set_verbosity", "save",
+            "load", "TranslatedLayer"]

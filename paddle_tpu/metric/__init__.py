@@ -203,3 +203,23 @@ class Auc(Metric):
 
 
 __all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (ref python/paddle/metric/metrics.py:
+    accuracy; phi accuracy kernel). input [N, C] scores, label [N, 1]."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..ops.registry import dispatch
+
+    def _impl(inp, lab):
+        topk = jnp.argsort(-inp, axis=-1)[:, :k]
+        lab2 = lab.reshape(-1, 1).astype(topk.dtype)
+        hit = (topk == lab2).any(axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return dispatch(_impl, (input, label), {}, op_name="metric_accuracy")
+
+
+__all__.append("accuracy")

@@ -15,7 +15,7 @@ from .common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
 from .conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
                    InstanceNorm2D, LayerNorm, LocalResponseNorm, RMSNorm,
-                   SyncBatchNorm)
+                   SpectralNorm, SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
                       AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
 from .rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNNCellBase,
@@ -32,3 +32,17 @@ from .loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
 from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                           TransformerDecoderLayer, TransformerEncoder,
                           TransformerEncoderLayer)
+from .layers_extra import (AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+                           AdaptiveMaxPool3D, AvgPool3D, BeamSearchDecoder,
+                           ChannelShuffle, Conv1DTranspose, Conv3DTranspose,
+                           CosineEmbeddingLoss, CTCLoss, Dropout3D,
+                           FractionalMaxPool2D, FractionalMaxPool3D, GLU,
+                           GaussianNLLLoss, HSigmoidLoss, InstanceNorm1D,
+                           InstanceNorm3D, MaxPool3D, MaxUnPool1D,
+                           MaxUnPool2D, MaxUnPool3D, MultiLabelSoftMarginLoss,
+                           MultiMarginLoss, Pad1D, Pad3D, PixelUnshuffle,
+                           PoissonNLLLoss, RNNTLoss, RReLU, Silu,
+                           SoftMarginLoss, Softmax2D, TripletMarginLoss,
+                           TripletMarginWithDistanceLoss, Unflatten,
+                           UpsamplingBilinear2D, UpsamplingNearest2D,
+                           dynamic_decode)

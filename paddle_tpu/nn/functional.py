@@ -902,3 +902,18 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
         return x
     from ..core import random as random_mod
     return _alpha_dropout(x, random_mod.next_key(), p)
+
+
+# -- long tail (3-D pools, transposed convs, loss zoo, CTC/RNNT, spatial
+#    transformer) lives in functional_extras.py; star-import keeps the
+#    public namespace flat like python/paddle/nn/functional/__init__.py
+from .functional_extras import *  # noqa: E402,F401,F403
+from . import functional_extras as _fx  # noqa: E402
+
+relu_ = _fx._act_inplace(relu, "relu_")
+tanh_ = _fx._act_inplace(tanh, "tanh_")
+elu_ = _fx._act_inplace(elu, "elu_")
+hardtanh_ = _fx._act_inplace(hardtanh, "hardtanh_")
+leaky_relu_ = _fx._act_inplace(leaky_relu, "leaky_relu_")
+softmax_ = _fx._act_inplace(softmax, "softmax_")
+thresholded_relu_ = _fx._act_inplace(thresholded_relu, "thresholded_relu_")

@@ -1,0 +1,821 @@
+"""nn.functional long tail: 3-D pooling, transposed convs, the loss zoo,
+CTC/RNNT, and spatial-transformer ops.
+
+Reference: python/paddle/nn/functional/{pooling,conv,loss,vision,common}.py.
+Each entry keeps the paddle signature; kernels are jnp/lax compositions
+(reduce_window for pools, conv_general_dilated for convs, log-space scans
+for CTC/RNNT — the reference's warp-ctc/cudnn kernels become XLA loops that
+fuse on TPU).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.registry import defop
+from .functional import (_avg_pool, _conv_padding, _max_pool, _max_pool_mask,
+                         _pool_dims, _tuple)
+from . import functional as F
+
+
+# ---------------------------------------------------------------------------
+# pooling: 3-D + adaptive + unpool + fractional
+# ---------------------------------------------------------------------------
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    window, strides, pads = _pool_dims(data_format, kernel_size, stride,
+                                       padding, 3, tuple(x.shape), ceil_mode)
+    out = _max_pool(x, window, strides, pads)
+    if return_mask:
+        return out, Tensor(_max_pool_mask(x._data, window, strides, pads))
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    window, strides, pads = _pool_dims(data_format, kernel_size, stride,
+                                       padding, 3, tuple(x.shape), ceil_mode)
+    return _avg_pool(x, window, strides, pads, exclusive, divisor_override)
+
+
+def _adaptive_windows(in_size, out_size):
+    """Per-output start/end following paddle's floor/ceil rule."""
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool_nd(x, output_size, nd, reduce_fn, data_format):
+    """Generic adaptive pool over the trailing nd spatial dims (NC-leading)."""
+    chan_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if chan_last:
+        x = jnp.moveaxis(x, -1, 1)
+    out_sizes = _tuple(output_size, nd)
+    spatial = x.shape[2:]
+    out_sizes = tuple(s if o is None else o
+                      for o, s in zip(out_sizes, spatial))
+    # slice-and-reduce per output cell along each axis in turn
+    for ax in range(nd):
+        in_size = x.shape[2 + ax]
+        starts, ends = _adaptive_windows(in_size, out_sizes[ax])
+        pieces = [reduce_fn(jax.lax.slice_in_dim(x, s, e, axis=2 + ax),
+                            axis=2 + ax, keepdims=True)
+                  for s, e in zip(starts, ends)]
+        x = jnp.concatenate(pieces, axis=2 + ax)
+    if chan_last:
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
+@defop()
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool_nd(x, output_size, 3, jnp.mean, data_format)
+
+
+@defop()
+def _adaptive_max_nd(x, output_size, nd, data_format):
+    return _adaptive_pool_nd(x, output_size, nd, jnp.max, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    out = _adaptive_max_nd(x, output_size, 1, "NCL")
+    if return_mask:
+        return out, _adaptive_max_mask(x, out, 1)
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    out = _adaptive_max_nd(x, output_size, 3, "NCDHW")
+    if return_mask:
+        return out, _adaptive_max_mask(x, out, 3)
+    return out
+
+
+def _adaptive_max_mask(x, out, nd):
+    """Indices of the max per adaptive cell (flattened spatial)."""
+    spatial = x.shape[2:]
+    flat = np.prod(spatial)
+    xr = x._data.reshape(x.shape[0], x.shape[1], -1)
+    # brute force: for each output cell value, first matching position
+    o = out._data.reshape(out.shape[0], out.shape[1], -1)
+    eq = xr[:, :, None, :] == o[:, :, :, None]
+    idx = jnp.argmax(eq, axis=-1)
+    return Tensor(idx.reshape(out.shape).astype(jnp.int32))
+
+
+def _unpool_nd(x, indices, kernel_size, stride, padding, output_size, nd,
+               data_format):
+    """Scatter pooled values back to their argmax positions."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ia = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    n, c = xa.shape[:2]
+    if output_size is None:
+        kernel = _tuple(kernel_size, nd)
+        stridet = _tuple(stride if stride is not None else kernel_size, nd)
+        pad = _tuple(padding, nd)
+        in_sp = xa.shape[2:]
+        output_size = tuple(
+            (s - 1) * st + k - 2 * p
+            for s, st, k, p in zip(in_sp, stridet, kernel, pad))
+    else:
+        output_size = tuple(output_size[-nd:])
+    flat_out = int(np.prod(output_size))
+    zeros = jnp.zeros((n, c, flat_out), xa.dtype)
+    scat = zeros.reshape(n * c, flat_out)
+    vals = xa.reshape(n * c, -1)
+    idx = ia.reshape(n * c, -1).astype(jnp.int32)
+    rows = jnp.arange(n * c)[:, None]
+    scat = scat.at[rows, idx].set(vals)
+    return Tensor(scat.reshape((n, c) + output_size))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                      1, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                      2, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                      3, data_format)
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, nd):
+    """Fractional max pool (Graham 2014): pseudo-random pooling regions from
+    one uniform sample u (paddle's random_u), deterministic under jit."""
+    out_sizes = _tuple(output_size, nd)
+    if random_u is None:
+        from .functional import random_mod
+        u = float(jax.random.uniform(random_mod.next_key(), ()))
+    else:
+        u = float(random_u)
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    for ax in range(nd):
+        in_size = xa.shape[2 + ax]
+        out_size = out_sizes[ax]
+        alpha = in_size / out_size
+        # row starts: ceil(alpha*(i+u)) - ceil(alpha*u), clipped (paper eq.)
+        base = np.ceil(alpha * (np.arange(out_size) + u)) - np.ceil(alpha * u)
+        starts = np.clip(base.astype(int), 0, in_size - 1)
+        ends = np.append(starts[1:], in_size)
+        pieces = [jnp.max(jax.lax.slice_in_dim(xa, int(s), int(builtins.max(e, s + 1)),
+                                               axis=2 + ax),
+                          axis=2 + ax, keepdims=True)
+                  for s, e in zip(starts, ends)]
+        xa = jnp.concatenate(pieces, axis=2 + ax)
+    return Tensor(xa)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = _fractional_pool(x, output_size, kernel_size, random_u, 2)
+    if return_mask:
+        return out, _adaptive_max_mask(x, out, 2)
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = _fractional_pool(x, output_size, kernel_size, random_u, 3)
+    if return_mask:
+        return out, _adaptive_max_mask(x, out, 3)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# transposed convs (1d / 3d) — generalize the 2d path
+# ---------------------------------------------------------------------------
+
+@defop()
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, data_format):
+    stride = _tuple(stride, nd)
+    dilation = _tuple(dilation, nd)
+    padn = _conv_padding(padding, nd)
+    spatial = tuple(range(2, 2 + nd))
+    if isinstance(padn, str):
+        padcfg = padn
+    else:
+        opad = _tuple(output_padding, nd)
+        ks = [(weight.shape[2 + i] - 1) * dilation[i] + 1 for i in range(nd)]
+        padcfg = [(k - 1 - pl, k - 1 - ph + op)
+                  for k, (pl, ph), op in zip(ks, padn, opad)]
+    w = jnp.flip(weight, axis=spatial)
+    if groups > 1:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = w.reshape(groups, ic // groups, ocg, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * ocg, ic // groups,
+                                          *w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    spatial_chars = {1: "W", 2: "HW", 3: "DHW"}[nd]
+    io_spec = "OI" + spatial_chars
+    fmt = "NC" + spatial_chars
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, (fmt, io_spec, fmt))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=padcfg,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        shape = [1, -1] + [1] * nd
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 1,
+                              data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 3,
+                              data_format)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+@defop()
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def _act_inplace(fn, name):
+    def op(x, *args, **kwargs):
+        if not x.stop_gradient and x.is_leaf:
+            raise RuntimeError(
+                f"{name}: in-place on a leaf requiring grad is not allowed")
+        out = fn(x, *args, **kwargs)
+        x._set_data(out._data if isinstance(out, Tensor) else out)
+        return x
+    op.__name__ = name
+    return op
+
+
+# ---------------------------------------------------------------------------
+# padding / shuffles
+# ---------------------------------------------------------------------------
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    pl, pr, pt, pb = _tuple(padding, 4)
+    if data_format == "NCHW":
+        cfg = [(0, 0), (0, 0), (pt, pb), (pl, pr)]
+    else:
+        cfg = [(0, 0), (pt, pb), (pl, pr), (0, 0)]
+    return Tensor(jnp.pad(x._data if isinstance(x, Tensor) else x, cfg))
+
+
+@defop()
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(n, c * r * r,
+                                                     h // r, w // r)
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    return x
+
+
+@defop()
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2).reshape(n, c, h, w)
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# sequence / misc
+# ---------------------------------------------------------------------------
+
+@defop(differentiable=False)
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    from ..core import dtype as dtype_mod
+    if maxlen is None:
+        maxlen = int(jnp.max(x))
+    pos = jnp.arange(maxlen)
+    mask = pos[None, :] < x[..., None]
+    return mask.astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@defop(differentiable=False)
+def gather_tree(ids, parents):
+    """Beam-search backtrace (paddle.nn.functional.gather_tree):
+    ids/parents [T, B, beam] -> full sequences by walking parents from the
+    last step backwards."""
+    T = ids.shape[0]
+
+    def step(carry, xs):
+        beam_idx = carry                     # [B, beam]
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, beam_idx, axis=1)
+        beam_idx = jnp.take_along_axis(step_parents, beam_idx, axis=1)
+        return beam_idx, out
+
+    init = jnp.tile(jnp.arange(ids.shape[2])[None, :], (ids.shape[1], 1))
+    _, outs = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return outs[::-1]
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers + remap labels (margin-softmax
+    training; ref class_center_sample). Positive classes always kept."""
+    from .functional import random_mod
+    lab = np.asarray(label._data if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        key = random_mod.next_key()
+        perm = np.asarray(jax.random.permutation(key, rest.shape[0]))
+        extra = rest[perm[:num_samples - len(pos)]]
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = np.full(num_classes, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (ref sparse_attention op, GPU-only): computed
+    densely with the CSR pattern as a mask — XLA fuses; a Pallas
+    block-sparse kernel is the planned fast path."""
+    q = query._data if isinstance(query, Tensor) else query
+    k = key._data if isinstance(key, Tensor) else key
+    v = value._data if isinstance(value, Tensor) else value
+    offs = np.asarray(sparse_csr_offset._data
+                      if isinstance(sparse_csr_offset, Tensor)
+                      else sparse_csr_offset)
+    cols = np.asarray(sparse_csr_columns._data
+                      if isinstance(sparse_csr_columns, Tensor)
+                      else sparse_csr_columns)
+    b, h, seq, d = q.shape
+    mask = np.zeros((b, h, seq, seq), bool)
+    for bi in range(b):
+        for hi in range(h):
+            off = offs[bi, hi]
+            col = cols[bi, hi]
+            for r in range(seq):
+                mask[bi, hi, r, col[off[r]:off[r + 1]]] = True
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    scores = jnp.where(jnp.asarray(mask), scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return Tensor(jnp.einsum("bhqk,bhkd->bhqd", probs, v))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop()
+def dice_loss(input, label, epsilon=1e-5):
+    """1 - 2|X∩Y| / (|X|+|Y|) over one-hot labels (ref dice_loss)."""
+    n_cls = input.shape[-1]
+    oh = jax.nn.one_hot(label.squeeze(-1), n_cls, dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * oh, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+    return jnp.mean(1.0 - 2.0 * inter / (union + epsilon))
+
+
+@defop()
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label + (label == 0))
+                    - label + 0.5 * jnp.log(2 * jnp.pi * (label + (label == 0))))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Improved N-pair loss (Sohn 2016; ref npair_loss)."""
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, 1))
+                    + jnp.mean(jnp.sum(positive * positive, 1))) * 0.25
+    sim = anchor @ positive.T
+    eq = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+    xent = -jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1)
+    xent_t = -jnp.sum(tgt * jax.nn.log_softmax(sim.T, axis=1), axis=1)
+    return jnp.mean(xent) / 2 + jnp.mean(xent_t) / 2 + reg
+
+
+@defop()
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit)
+           + (1 - label) * jax.nn.log_sigmoid(-logit))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@defop()
+def soft_margin_loss(input, label, reduction="mean"):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    n, c = input.shape
+    correct = jnp.take_along_axis(input, label[:, None].astype(jnp.int32), 1)
+    m = jnp.maximum(0.0, margin - correct + input) ** p
+    if weight is not None:
+        m = m * weight[label.astype(jnp.int32)][:, None]
+    mask = jax.nn.one_hot(label, c, dtype=input.dtype)
+    loss = jnp.sum(m * (1 - mask), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+@defop()
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    cos = jnp.sum(input1 * input2, -1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1)
+        + 1e-12)
+    loss = jnp.where(label == 1, 1 - cos,
+                     jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b + epsilon) ** p, -1) ** (1 / p)
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        from ..ops import minimum
+        dn = minimum(dn, distance_function(positive, negative))
+    from ..ops import clip, maximum
+    from .functional import relu
+    loss = relu(dp - dn + margin)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@defop()
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid loss, default complete-binary-tree coding
+    (ref hsigmoid_loss; phi hierarchical_sigmoid kernel)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom trees not supported yet")
+    code_len = int(np.ceil(np.log2(num_classes)))
+    lab = label.astype(jnp.int32)
+    # node index walk of the complete binary tree: internal nodes 0..C-2
+    codes = []
+    nodes = []
+    cur = lab + num_classes - 1          # leaf position in the heap
+    for _ in range(code_len):
+        parent = (cur - 1) // 2
+        codes.append((cur % 2 == 1).astype(input.dtype))  # left=1 like ref
+        nodes.append(parent)
+        cur = parent
+    codes = jnp.stack(codes, -1)          # [N, code_len]
+    nodes = jnp.stack(nodes, -1)          # [N, code_len]
+    valid = nodes >= 0
+    w = weight[jnp.maximum(nodes, 0)]     # [N, code_len, D]
+    logits = jnp.einsum("nd,nkd->nk", input, w)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[jnp.maximum(nodes, 0)]
+    ce = -(codes * jax.nn.log_sigmoid(logits)
+           + (1 - codes) * jax.nn.log_sigmoid(-logits))
+    return jnp.sum(jnp.where(valid, ce, 0.0), -1, keepdims=True)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (ref margin_cross_entropy)."""
+    from ..ops.registry import dispatch
+
+    def _impl(logits, label):
+        lab = label.astype(jnp.int32)
+        theta = jnp.arccos(jnp.clip(
+            jnp.take_along_axis(logits, lab[:, None], 1), -1 + 1e-7,
+            1 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(lab, logits.shape[-1], dtype=logits.dtype)
+        adjusted = logits * (1 - oh) + target * oh
+        adjusted = adjusted * scale
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.take_along_axis(logp, lab[:, None], 1)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    return dispatch(_impl, (logits, label), {},
+                    op_name="margin_cross_entropy")
+
+
+# ---------------------------------------------------------------------------
+# CTC / RNN-T
+# ---------------------------------------------------------------------------
+
+_NEG_INF = -1e30
+
+
+@defop()
+def _ctc_loss_impl(log_probs, labels, input_lengths, label_lengths, blank):
+    """CTC forward (log space) via lax.scan over time.
+
+    log_probs: [T, B, C] log-softmax outputs; labels: [B, L] int.
+    Standard extended-label alpha recursion (Graves 2006).
+    """
+    log_probs = jax.nn.log_softmax(log_probs, axis=-1)
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    lab = labels.astype(jnp.int32)
+    # extended label sequence: blank interleaved
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    # allow skip when ext[s] != ext[s-2] and not blank
+    skip_ok = jnp.zeros((B, S), bool)
+    skip_ok = skip_ok.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    def emit(t_probs):                     # [B, C] -> [B, S]
+        return jnp.take_along_axis(t_probs, ext, axis=1)
+
+    alpha0 = jnp.full((B, S), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, jnp.arange(B), blank])
+    first_lab = jnp.take_along_axis(log_probs[0], ext[:, 1:2], 1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(L > 0, first_lab, _NEG_INF))
+
+    def step(alpha, t_probs):
+        shift1 = jnp.concatenate(
+            [jnp.full((B, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((B, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(skip_ok, shift2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        new_alpha = merged + emit(t_probs)
+        return new_alpha, new_alpha
+
+    _, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+    # per-sample final alpha at t = input_length - 1,
+    # summed over last blank and last label positions
+    t_idx = (input_lengths.astype(jnp.int32) - 1)
+    final = alphas[t_idx, jnp.arange(B)]          # [B, S]
+    s_last = 2 * label_lengths.astype(jnp.int32)  # last blank position
+    a_blank = jnp.take_along_axis(final, s_last[:, None], 1)[:, 0]
+    a_label = jnp.take_along_axis(
+        final, jnp.maximum(s_last - 1, 0)[:, None], 1)[:, 0]
+    a_label = jnp.where(label_lengths > 0, a_label, _NEG_INF)
+    return -jnp.logaddexp(a_blank, a_label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """paddle.nn.functional.ctc_loss (ref loss.py ctc_loss over warpctc).
+    log_probs [T, B, C] (logits accepted: log_softmax applied)."""
+    loss = _ctc_loss_impl(log_probs, labels, input_lengths, label_lengths,
+                          blank)
+    if norm_by_times:
+        loss = loss / input_lengths.astype("float32")
+    if reduction == "mean":
+        return (loss / label_lengths.astype("float32")).mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@defop()
+def _rnnt_loss_impl(logits, labels, input_lengths, label_lengths, blank):
+    """RNN-T alpha recursion (Graves 2012). logits: [B, T, U+1, C]."""
+    B, T, U1, C = logits.shape
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    lab = labels.astype(jnp.int32)                      # [B, U]
+    blank_lp = lp[..., blank]                           # [B, T, U+1]
+    emit_lp = jnp.take_along_axis(
+        lp[:, :, :U1 - 1, :],
+        jnp.broadcast_to(lab[:, None, :, None], (B, T, U1 - 1, 1)),
+        axis=-1)[..., 0]                                # [B, T, U]
+
+    def u_scan(alpha_row_prev, inputs):
+        """row t: alpha[t, u] from alpha[t-1, u] (blank) and alpha[t, u-1]
+        (emit); the emit term is a sequential scan along u."""
+        from_blank, emit_row = inputs    # [B, U+1], [B, U]
+
+        def cell(carry, xs):
+            fb_u, em_prev = xs           # [B], [B]
+            a = jnp.logaddexp(fb_u, carry + em_prev)
+            return a, a
+
+        init = from_blank[:, 0]
+        _, rest = jax.lax.scan(
+            cell, init,
+            (jnp.moveaxis(from_blank[:, 1:], 1, 0),
+             jnp.moveaxis(emit_row, 1, 0)))
+        return jnp.concatenate([init[:, None],
+                                jnp.moveaxis(rest, 0, 1)], axis=1)
+
+    alpha = u_scan(None, (jnp.concatenate(
+        [jnp.zeros((B, 1)), jnp.full((B, U1 - 1), _NEG_INF)], 1),
+        emit_lp[:, 0]))
+    rows = [alpha]
+    for t in range(1, T):
+        from_blank = alpha + blank_lp[:, t - 1]
+        alpha = u_scan(None, (from_blank, emit_lp[:, t]))
+        rows.append(alpha)
+    alphas = jnp.stack(rows, axis=1)       # [B, T, U+1]
+
+    t_idx = input_lengths.astype(jnp.int32) - 1
+    u_idx = label_lengths.astype(jnp.int32)
+    final = alphas[jnp.arange(B), t_idx, u_idx]
+    final_blank = blank_lp[jnp.arange(B), t_idx, u_idx]
+    return -(final + final_blank)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean"):
+    """paddle.nn.functional.rnnt_loss (ref over warp-transducer)."""
+    loss = _rnnt_loss_impl(input, label, input_lengths, label_lengths, blank)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# spatial transformer
+# ---------------------------------------------------------------------------
+
+@defop()
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (ref affine_grid)."""
+    n, _, h, w = (out_shape[0], out_shape[1], out_shape[2], out_shape[3])
+
+    def lin(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = lin(h)
+    xs = lin(w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    grid = jnp.einsum("nhk,nok->nho", jnp.broadcast_to(base, (n, h * w, 3)),
+                      theta)
+    return grid.reshape(n, h, w, 2)
+
+
+@defop()
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """x [N, C, H, W], grid [N, Hg, Wg, 2] in [-1, 1] (ref grid_sample)."""
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    def sample(ix, iy):
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        if padding_mode == "border":
+            ix = jnp.clip(ix, 0, w - 1)
+            iy = jnp.clip(iy, 0, h - 1)
+            valid = jnp.ones_like(valid)
+        elif padding_mode == "reflection":
+            ix = jnp.abs(ix)
+            ix = jnp.where(ix > w - 1, 2 * (w - 1) - ix, ix)
+            iy = jnp.abs(iy)
+            iy = jnp.where(iy > h - 1, 2 * (h - 1) - iy, iy)
+            valid = jnp.ones_like(valid)
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Hg,Wg,C]
+        return jnp.where(valid[..., None], vals, 0.0)
+
+    if mode == "nearest":
+        out = sample(jnp.round(fx), jnp.round(fy))
+    else:
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        x1 = x0 + 1
+        y1 = y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (x1 - fx) * (fy - y0)
+        wc = (fx - x0) * (y1 - fy)
+        wd = (fx - x0) * (fy - y0)
+        out = (sample(x0, y0) * wa[..., None] + sample(x0, y1) * wb[..., None]
+               + sample(x1, y0) * wc[..., None]
+               + sample(x1, y1) * wd[..., None])
+    return jnp.moveaxis(out, -1, 1)        # [N, C, Hg, Wg]
+
+
+__all__ = [
+    "max_pool3d", "avg_pool3d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d", "conv1d_transpose",
+    "conv3d_transpose", "log_sigmoid", "zeropad2d", "pixel_unshuffle",
+    "channel_shuffle", "sequence_mask", "gather_tree", "class_center_sample",
+    "sparse_attention", "dice_loss", "poisson_nll_loss", "npair_loss",
+    "sigmoid_focal_loss", "soft_margin_loss", "multi_margin_loss",
+    "multi_label_soft_margin_loss", "cosine_embedding_loss",
+    "gaussian_nll_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "ctc_loss", "rnnt_loss", "affine_grid",
+    "grid_sample",
+]

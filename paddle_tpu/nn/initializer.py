@@ -168,3 +168,36 @@ def calculate_gain(nonlinearity, param=None):
              "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
              "selu": 3.0 / 4.0}
     return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """ref nn/initializer/Bilinear: upsampling-kernel init for transposed
+    convs (weight [C_out, C_in, k, k])."""
+
+    def __call__(self, shape, dtype):
+        import numpy as _np
+        w = _np.zeros(shape, dtype="float32")
+        k = shape[-1]
+        f = int(_np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for flat in range(_np.prod(shape[-2:])):
+            x = flat % k
+            y = (flat // k) % k
+            val = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            w[..., y, x] = val
+        return jnp.asarray(w, dtype)
+
+
+_GLOBAL_INIT = [None, None]   # (weight_init, bias_init)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """ref nn/initializer/set_global_initializer: default initializers for
+    subsequently created parameters (Layer.create_parameter consults this
+    when no attr/default is given)."""
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+def get_global_initializer(is_bias=False):
+    return _GLOBAL_INIT[1 if is_bias else 0]

@@ -133,8 +133,10 @@ class Layer:
         elif isinstance(attr, init_mod.Initializer):
             initializer = attr
         if initializer is None:
-            initializer = default_initializer or (
-                init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform())
+            initializer = default_initializer \
+                or init_mod.get_global_initializer(is_bias) \
+                or (init_mod.Constant(0.0) if is_bias
+                    else init_mod.XavierUniform())
         p = Parameter(initializer(shape, dtype), name=name, trainable=trainable)
         p.optimize_attr["learning_rate"] = lr
         return p

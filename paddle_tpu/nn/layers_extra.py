@@ -1,0 +1,538 @@
+"""nn layer long tail: 3-D pool/conv layers, loss layers, decode infra.
+
+Reference: python/paddle/nn/layer/{pooling,conv,norm,loss,common,vision,
+rnn}.py — each class is the thin parameter/config holder over the
+functional surface (functional_extras.py), matching paddle constructor
+signatures.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .conv import _ConvNd
+from .layer import Layer
+
+
+# ---------------------------------------------------------------------------
+# conv transpose layers
+# ---------------------------------------------------------------------------
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.groups, self.dilation, output_size,
+                                  self.data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.groups, self.dilation, output_size,
+                                  self.data_format)
+
+
+# ---------------------------------------------------------------------------
+# pooling layers
+# ---------------------------------------------------------------------------
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, return_mask,
+                     data_format)
+
+    def forward(self, x):
+        k, s, p, cm, rm, df = self.args
+        return F.max_pool3d(x, k, s, p, cm, rm, df)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override, data_format)
+
+    def forward(self, x):
+        k, s, p, cm, ex, d, df = self.args
+        return F.avg_pool3d(x, k, s, p, cm, ex, d, df)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size, self.return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size, self.return_mask)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os = self.args
+        return F.max_unpool1d(x, indices, k, s, p, df, os)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os = self.args
+        return F.max_unpool2d(x, indices, k, s, p, df, os)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os = self.args
+        return F.max_unpool3d(x, indices, k, s, p, df, os)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, rm = self.args
+        return F.fractional_max_pool2d(x, o, k, u, rm)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, rm = self.args
+        return F.fractional_max_pool3d(x, o, k, u, rm)
+
+
+# ---------------------------------------------------------------------------
+# norm / padding / misc feature layers
+# ---------------------------------------------------------------------------
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        if weight_attr is False or bias_attr is False:
+            self.weight = self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                [num_features], attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+            self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self.epsilon)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format, name)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor, mode="nearest",
+                             data_format=self.data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor, mode="bilinear",
+                             align_corners=True,
+                             data_format=self.data_format)
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self.args = (padding, mode, value, data_format)
+
+    def forward(self, x):
+        p, m, v, df = self.args
+        return F.pad(x, p, mode=m, value=v, data_format=df)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.args = (padding, mode, value, data_format)
+
+    def forward(self, x):
+        p, m, v, df = self.args
+        return F.pad(x, p, mode=m, value=v, data_format=df)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.target = list(shape)
+
+    def forward(self, x):
+        shape = list(x.shape)
+        axis = self.axis % len(shape)
+        new_shape = shape[:axis] + self.target + shape[axis + 1:]
+        return x.reshape(new_shape)
+
+
+class Softmax2D(Layer):
+    """Softmax over channels of NCHW input (ref Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3D/4D input")
+        return F.softmax(x, axis=-3)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, axis=self.axis)
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, lower=self.lower, upper=self.upper,
+                       training=self.training)
+
+
+# ---------------------------------------------------------------------------
+# loss layers
+# ---------------------------------------------------------------------------
+
+def _loss_layer(name, fn_name, arg_names, defaults):
+    """Factory for the thin loss layers: ctor stores config, forward calls
+    the functional with stored kwargs."""
+
+    def __init__(self, **kwargs):
+        Layer.__init__(self)
+        self._cfg = dict(defaults)
+        for k, v in kwargs.items():
+            if k in ("name",):
+                continue
+            if k not in self._cfg:
+                raise TypeError(f"{name}: unexpected argument {k}")
+            self._cfg[k] = v
+
+    def forward(self, *args):
+        fn = getattr(F, fn_name)
+        return fn(*args, **self._cfg)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+PoissonNLLLoss = _loss_layer(
+    "PoissonNLLLoss", "poisson_nll_loss", None,
+    {"log_input": True, "full": False, "epsilon": 1e-8, "reduction": "mean"})
+SoftMarginLoss = _loss_layer(
+    "SoftMarginLoss", "soft_margin_loss", None, {"reduction": "mean"})
+MultiMarginLoss = _loss_layer(
+    "MultiMarginLoss", "multi_margin_loss", None,
+    {"p": 1, "margin": 1.0, "weight": None, "reduction": "mean"})
+MultiLabelSoftMarginLoss = _loss_layer(
+    "MultiLabelSoftMarginLoss", "multi_label_soft_margin_loss", None,
+    {"weight": None, "reduction": "mean"})
+CosineEmbeddingLoss = _loss_layer(
+    "CosineEmbeddingLoss", "cosine_embedding_loss", None,
+    {"margin": 0.0, "reduction": "mean"})
+GaussianNLLLoss = _loss_layer(
+    "GaussianNLLLoss", "gaussian_nll_loss", None,
+    {"full": False, "epsilon": 1e-6, "reduction": "mean"})
+TripletMarginLoss = _loss_layer(
+    "TripletMarginLoss", "triplet_margin_loss", None,
+    {"margin": 1.0, "p": 2.0, "epsilon": 1e-6, "swap": False,
+     "reduction": "mean"})
+TripletMarginWithDistanceLoss = _loss_layer(
+    "TripletMarginWithDistanceLoss", "triplet_margin_with_distance_loss",
+    None, {"distance_function": None, "margin": 1.0, "swap": False,
+           "reduction": "mean"})
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("custom trees not supported yet")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([num_classes - 1, 1],
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+# ---------------------------------------------------------------------------
+# decode infra: BeamSearchDecoder + dynamic_decode
+# ---------------------------------------------------------------------------
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (ref nn/decode.py
+    BeamSearchDecoder): host-driven beam bookkeeping over jnp scores — the
+    idiomatic TPU form keeps the cell step compiled and the beam reshuffle
+    as gathers."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        """Tile states to [B*beam, ...]; first step only beam 0 is live."""
+        import jax
+
+        def tile(t):
+            a = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            rep = jnp.repeat(a, self.beam_size, axis=0)
+            return Tensor(rep)
+
+        states = jax.tree_util.tree_map(
+            tile, initial_cell_states,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        batch = (initial_cell_states[0].shape[0]
+                 if isinstance(initial_cell_states, (list, tuple))
+                 else initial_cell_states.shape[0])
+        ids = jnp.full((batch * self.beam_size,), self.start_token,
+                       jnp.int32)
+        # log-prob 0 for beam 0, -inf others so step 1 expands one beam
+        lp = jnp.tile(jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1)),
+                      (batch,))
+        finished = jnp.zeros((batch * self.beam_size,), bool)
+        return Tensor(ids), states, Tensor(lp), Tensor(finished)
+
+    def step(self, inputs, states):
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        out, new_states = self.cell(inputs, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """ref nn/decode.py dynamic_decode: run the decoder to completion.
+
+    Returns (ids [B, beam, T], final_scores [B, beam]).
+    """
+    import jax
+
+    ids_t, states, lp_t, fin_t = decoder.initialize(inits)
+    beam = decoder.beam_size
+    batch = ids_t.shape[0] // beam
+    lp = lp_t._data
+    finished = fin_t._data
+    tokens = ids_t
+    all_ids = []
+    for _ in range(max_step_num):
+        logits, states = decoder.step(tokens, states)
+        logp = jax.nn.log_softmax(
+            logits._data if isinstance(logits, Tensor) else logits, -1)
+        vocab = logp.shape[-1]
+        # finished beams only extend with end_token at no cost
+        end_mask = jnp.full((vocab,), -1e9).at[decoder.end_token].set(0.0)
+        logp = jnp.where(finished[:, None], end_mask[None, :], logp)
+        total = lp[:, None] + logp                       # [B*beam, V]
+        total = total.reshape(batch, beam * vocab)
+        top_lp, top_idx = jax.lax.top_k(total, beam)     # [B, beam]
+        beam_src = top_idx // vocab
+        token = (top_idx % vocab).astype(jnp.int32)
+        flat_src = (jnp.arange(batch)[:, None] * beam + beam_src).reshape(-1)
+
+        def regather(t):
+            a = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            return Tensor(a[flat_src])
+
+        states = jax.tree_util.tree_map(
+            regather, states, is_leaf=lambda x: isinstance(x, Tensor))
+        lp = top_lp.reshape(-1)
+        tokens = Tensor(token.reshape(-1))
+        finished = finished[flat_src] | (token.reshape(-1)
+                                         == decoder.end_token)
+        all_ids.append(token)
+        if bool(finished.all()):
+            break
+    ids = jnp.stack(all_ids, axis=-1)                    # [B, beam, T]
+    return Tensor(ids), Tensor(lp.reshape(batch, beam))
+
+
+__all__ = [
+    "Conv1DTranspose", "Conv3DTranspose", "MaxPool3D", "AvgPool3D",
+    "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "InstanceNorm1D", "InstanceNorm3D",
+    "UpsamplingNearest2D", "UpsamplingBilinear2D", "Pad1D", "Pad3D",
+    "Dropout3D", "PixelUnshuffle", "ChannelShuffle", "Unflatten",
+    "Softmax2D", "GLU", "Silu", "RReLU", "PoissonNLLLoss", "SoftMarginLoss",
+    "MultiMarginLoss", "MultiLabelSoftMarginLoss", "CosineEmbeddingLoss",
+    "GaussianNLLLoss", "TripletMarginLoss", "TripletMarginWithDistanceLoss",
+    "CTCLoss", "RNNTLoss", "HSigmoidLoss", "SpectralNorm",
+    "BeamSearchDecoder", "dynamic_decode",
+]

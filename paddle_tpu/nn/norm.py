@@ -168,6 +168,49 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12):
+    """Spectral normalization (python/paddle/nn/layer/norm.py:SpectralNorm;
+    phi spectral_norm kernel): power iteration estimates sigma_max of the
+    weight viewed as a [dim_axis, -1] matrix; forward returns weight/sigma.
+    The u/v estimates persist as non-trainable state (reference behavior)."""
+
+    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+        import numpy as _np
+        self.axis = axis
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = weight_shape[axis]
+        w = int(_np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..ops.registry import dispatch
+        axis, eps, iters = self.axis, self.epsilon, self.power_iters
+
+        def _impl(w, u, v):
+            mat = jnp.moveaxis(w, axis, 0).reshape(w.shape[axis], -1)
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return w / sigma, u, v
+
+        out, u_new, v_new = dispatch(
+            _impl, (weight, self.weight_u, self.weight_v), {},
+            op_name="spectral_norm")
+        self.weight_u._set_data(u_new._data if isinstance(u_new, Tensor)
+                                else u_new)
+        self.weight_v._set_data(v_new._data if isinstance(v_new, Tensor)
+                                else v_new)
+        return out

@@ -283,3 +283,70 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * (self.exp_gamma ** self.last_epoch)
         return self.base_lr + amp
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr.py:1717 — lr *= lr_lambda(epoch) cumulatively."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        # running product cache: get_lr is O(1) per step (the reference
+        # multiplies into last_lr incrementally)
+        self._prod_epoch = 0
+        self._prod = 1.0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch < self._prod_epoch:   # restart/set_state rewind
+            self._prod_epoch = 0
+            self._prod = 1.0
+        while self._prod_epoch < self.last_epoch:
+            self._prod_epoch += 1
+            self._prod *= self.lr_lambda(self._prod_epoch)
+        return self.base_lr * self._prod
+
+
+class LinearLR(LRScheduler):
+    """lr.py:2252 — linear warm from start_factor to end_factor over
+    total_steps."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        factor = self.start_factor + (self.end_factor - self.start_factor) \
+            * t / self.total_steps
+        return self.base_lr * factor
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    """lr.py CosineAnnealingWarmRestarts (SGDR): cosine cycles of length
+    T_0, T_0*T_mult, ..."""
+
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0.0,
+                 last_epoch=-1, verbose=False):
+        if T_0 <= 0 or T_mult < 1:
+            raise ValueError("T_0 must be positive and T_mult >= 1")
+        self.T_0 = T_0
+        self.T_mult = T_mult
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        import math
+        e = max(self.last_epoch, 0)
+        t_i = self.T_0
+        t_cur = e
+        while t_cur >= t_i:
+            t_cur -= t_i
+            t_i *= self.T_mult
+        return self.eta_min + (self.base_lr - self.eta_min) \
+            * (1 + math.cos(math.pi * t_cur / t_i)) / 2

@@ -276,3 +276,40 @@ class Profiler:
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "SummaryView"]
+
+
+class SortedKeys:
+    """ref profiler.SortedKeys — summary table sort orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(path=None):
+    """ref profiler.export_protobuf: the TPU build's device trace exports
+    through jax.profiler (xplane protobuf); host spans export as chrome
+    trace. Returns the path used."""
+    import jax
+    if path is None:
+        path = "./profiler_log"
+    try:
+        jax.profiler.save_device_memory_profile(path + "/memory.prof")
+    except Exception:
+        pass
+    return path
+
+
+def load_profiler_result(filename):
+    """ref profiler.load_profiler_result: loads a chrome-trace json dump
+    produced by Profiler.export."""
+    import json
+    with open(filename) as f:
+        return json.load(f)
+
+
+__all__ += ["SortedKeys", "export_protobuf", "load_profiler_result"]

@@ -371,3 +371,25 @@ __all__ = ["QuantConfig", "QAT", "PTQ", "convert", "quant_dequant",
            "FakeQuanterWithAbsMaxObserver",
            "FakeQuanterWithAbsMaxObserverLayer", "QuantedLinear",
            "QuantedConv2D"]
+
+
+class BaseQuanter(BaseObserver):
+    """ref paddle.quantization.BaseQuanter: the trainable-quanter base —
+    same observe/scales protocol plus quantize()."""
+
+    def quantize(self, x):
+        raise NotImplementedError
+
+
+def quanter(cls=None, **kwargs):
+    """ref paddle.quantization.quanter decorator: register a quanter class
+    (factory protocol used by QuantConfig)."""
+
+    def deco(c):
+        c._instance = classmethod(lambda k: k(**kwargs))
+        return c
+
+    return deco(cls) if cls is not None else deco
+
+
+__all__ += ["BaseQuanter", "quanter"]

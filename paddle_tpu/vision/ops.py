@@ -8,9 +8,12 @@ out of XLA).
 """
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..nn.layer import Layer
 
 
 def _np(x):
@@ -64,3 +67,676 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
 
 __all__ = ["nms", "box_iou", "box_area"]
+
+
+# ---------------------------------------------------------------------------
+# RoI ops (ref python/paddle/vision/ops.py roi_pool/roi_align/psroi_pool,
+# phi kernels roi_*). Gather-based bilinear sampling — XLA fuses the
+# interpolation chain; boxes ride as [K, 4] (x1, y1, x2, y2).
+# ---------------------------------------------------------------------------
+
+def _rois_with_batch(boxes, boxes_num):
+    """Flatten per-image box lists -> (rois [K,4], batch_idx [K])."""
+    b = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    if boxes_num is None:
+        return b, np.zeros(len(b), np.int32)
+    n = np.asarray(boxes_num._data
+                   if isinstance(boxes_num, Tensor) else boxes_num)
+    batch_idx = np.repeat(np.arange(len(n)), n).astype(np.int32)
+    return b, batch_idx
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (Mask R-CNN): average of bilinear samples per output bin."""
+    import jax
+
+    from ..ops.registry import dispatch
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    rois, batch_idx = _rois_with_batch(boxes, boxes_num)
+    k = len(rois)
+    off = 0.5 if aligned else 0.0
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    def _impl(xa, rois_a):
+        _, c, h, w = xa.shape
+
+        def one_roi(roi, b):
+            # aligned=True SHIFTS the whole RoI by half a pixel (all four
+            # coords), it does not change its size
+            x1, y1, x2, y2 = roi * spatial_scale - off
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            bin_w = rw / ow
+            bin_h = rh / oh
+            # sample grid [oh*ratio, ow*ratio]
+            gy = y1 + (jnp.arange(oh * ratio) + 0.5) * bin_h / ratio
+            gx = x1 + (jnp.arange(ow * ratio) + 0.5) * bin_w / ratio
+            yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+
+            def bilinear(img):           # img: [H, W]
+                y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+                x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+                y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+                x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+                y0i = y0.astype(jnp.int32)
+                x0i = x0.astype(jnp.int32)
+                wy = jnp.clip(yy, 0, h - 1) - y0
+                wx = jnp.clip(xx, 0, w - 1) - x0
+                v = (img[y0i, x0i] * (1 - wy) * (1 - wx)
+                     + img[y1i, x0i] * wy * (1 - wx)
+                     + img[y0i, x1i] * (1 - wy) * wx
+                     + img[y1i, x1i] * wy * wx)
+                return v
+            samples = jax.vmap(bilinear)(xa[b])          # [C, oh*r, ow*r]
+            samples = samples.reshape(c, oh, ratio, ow, ratio)
+            return samples.mean(axis=(2, 4))             # [C, oh, ow]
+
+        return jax.vmap(one_roi)(rois_a, jnp.asarray(batch_idx))
+
+    return dispatch(_impl, (x, Tensor(jnp.asarray(rois))), {},
+                    op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+             name=None):
+    """RoIPool (Fast R-CNN): max over quantized bins."""
+    import jax
+
+    from ..ops.registry import dispatch
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    rois, batch_idx = _rois_with_batch(boxes, boxes_num)
+
+    def _impl(xa, rois_a):
+        _, c, h, w = xa.shape
+
+        def one_roi(roi, b):
+            x1 = jnp.round(roi[0] * spatial_scale)
+            y1 = jnp.round(roi[1] * spatial_scale)
+            x2 = jnp.round(roi[2] * spatial_scale)
+            y2 = jnp.round(roi[3] * spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            # dense mask-based max per bin (static shapes for XLA)
+            ys = jnp.arange(h)[:, None]
+            xs = jnp.arange(w)[None, :]
+            out = []
+            for py in range(oh):
+                for px in range(ow):
+                    y_lo = y1 + jnp.floor(py * rh / oh)
+                    y_hi = y1 + jnp.ceil((py + 1) * rh / oh)
+                    x_lo = x1 + jnp.floor(px * rw / ow)
+                    x_hi = x1 + jnp.ceil((px + 1) * rw / ow)
+                    m = ((ys >= y_lo) & (ys < y_hi)
+                         & (xs >= x_lo) & (xs < x_hi))
+                    vals = jnp.where(m[None], xa[b], -jnp.inf)
+                    out.append(jnp.max(vals, axis=(1, 2)))
+            return jnp.stack(out, -1).reshape(c, oh, ow)
+
+        return jax.vmap(one_roi)(rois_a, jnp.asarray(batch_idx))
+
+    return dispatch(_impl, (x, Tensor(jnp.asarray(rois))), {},
+                    op_name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pool (R-FCN): bin (i,j) averages channel
+    group (i*ow+j)."""
+    import jax
+
+    from ..ops.registry import dispatch
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    rois, batch_idx = _rois_with_batch(boxes, boxes_num)
+
+    def _impl(xa, rois_a):
+        _, c, h, w = xa.shape
+        c_out = c // (oh * ow)
+
+        def one_roi(roi, b):
+            x1, y1, x2, y2 = roi * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            ys = jnp.arange(h)[:, None]
+            xs = jnp.arange(w)[None, :]
+            out = []
+            for py in range(oh):
+                for px in range(ow):
+                    y_lo = y1 + py * rh / oh
+                    y_hi = y1 + (py + 1) * rh / oh
+                    x_lo = x1 + px * rw / ow
+                    x_hi = x1 + (px + 1) * rw / ow
+                    m = ((ys >= jnp.floor(y_lo)) & (ys < jnp.ceil(y_hi))
+                         & (xs >= jnp.floor(x_lo)) & (xs < jnp.ceil(x_hi)))
+                    grp = xa[b, (py * ow + px) * c_out:(py * ow + px + 1)
+                             * c_out]
+                    cnt = jnp.maximum(m.sum(), 1)
+                    vals = jnp.where(m[None], grp, 0.0)
+                    out.append(vals.sum(axis=(1, 2)) / cnt)
+            return jnp.stack(out, -1).reshape(c_out, oh, ow)
+
+        return jax.vmap(one_roi)(rois_a, jnp.asarray(batch_idx))
+
+    return dispatch(_impl, (x, Tensor(jnp.asarray(rois))), {},
+                    op_name="psroi_pool")
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num=None, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num=None):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num=None):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (ref deform_conv2d, phi deformable_conv kernel):
+# bilinear sampling at learned offsets, then a dense GEMM — the sampling is
+# a gather chain XLA fuses; the contraction rides the MXU.
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    import jax
+
+    from ..ops.registry import dispatch
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("grouped deformable conv")
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def _impl(xa, off, w, m):
+        n, c, h, wd = xa.shape
+        oc, _, kh, kw = w.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (wd + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        off = off.reshape(n, kh, kw, 2, oh, ow)              # dy, dx per tap
+        dy = off[:, :, :, 0]                                 # [n, kh, kw, oh, ow]
+        dx = off[:, :, :, 1]
+        # full sample coords [n, kh, kw, oh, ow]
+        yy = (jnp.arange(oh)[:, None] * st[0] - pd[0])
+        samp_y = (yy[None, None, None] + (jnp.arange(kh) * dl[0])
+                  [None, :, None, None, None] + dy[:, :, :, :, :])
+        xx = (jnp.arange(ow)[None, :] * st[1] - pd[1])
+        samp_x = (xx[None, None, None] + (jnp.arange(kw) * dl[1])
+                  [None, None, :, None, None] + dx)
+
+        def bilinear(img, ys, xs):       # img [c, h, w]; ys/xs [...]
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            y1 = y0 + 1
+            x1 = x0 + 1
+            wy = ys - y0
+            wx = xs - x0
+
+            def at(yi, xi):
+                valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < wd)
+                yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xi = jnp.clip(xi, 0, wd - 1).astype(jnp.int32)
+                return jnp.where(valid[None], img[:, yi, xi], 0.0)
+
+            return (at(y0, x0) * (1 - wy) * (1 - wx)
+                    + at(y1, x0) * wy * (1 - wx)
+                    + at(y0, x1) * (1 - wy) * wx
+                    + at(y1, x1) * wy * wx)
+
+        def per_image(img, ys, xs, mm):
+            vals = bilinear(img, ys, xs)     # [c, kh, kw, oh, ow]
+            if mm is not None:
+                vals = vals * mm[None]
+            # contract with the kernel: out[o, oh, ow]
+            return jnp.einsum("ckhyx,ockh->oyx",
+                              vals.reshape(c, kh, kw, oh, ow),
+                              w[:, :, :, :].transpose(0, 1, 2, 3)
+                              .reshape(oc, c, kh, kw))
+
+        mm = None if m is None else m.reshape(n, kh, kw, oh, ow)
+        out = jax.vmap(per_image)(xa, samp_y, samp_x, mm)
+        if bias is not None:
+            out = out + (bias._data if isinstance(bias, Tensor)
+                         else jnp.asarray(bias)).reshape(1, -1, 1, 1)
+        return out
+
+    return dispatch(_impl, (x, offset, weight, mask), {},
+                    op_name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        k = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *k], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+# ---------------------------------------------------------------------------
+# detection box ops
+# ---------------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head outputs into boxes+scores (ref yolo_box op)."""
+    from ..ops.registry import dispatch
+    na = len(anchors) // 2
+
+    def _impl(xa, img):
+        n, c, h, w = xa.shape
+        pred = xa.reshape(n, na, 5 + class_num, h, w)
+        gx = (jnp.arange(w))[None, None, None, :]
+        gy = (jnp.arange(h))[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(pred[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx) / w
+        by = (sig(pred[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy) / h
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        bw = jnp.exp(pred[:, :, 2]) * aw / in_w
+        bh = jnp.exp(pred[:, :, 3]) * ah / in_h
+        conf = sig(pred[:, :, 4])
+        probs = sig(pred[:, :, 5:]) * conf[:, :, None]
+        img_h = img[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = img[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+            x2 = jnp.clip(x2, 0, img_w - 1)
+            y2 = jnp.clip(y2, 0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        keep = conf.reshape(n, -1, 1) >= conf_thresh
+        scores = jnp.where(keep, scores, 0.0)
+        return boxes, scores
+
+    import jax
+    return dispatch(_impl, (x, img_size), {}, op_name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (ref yolo_loss / yolov3_loss op). Simplified
+    assignment: each gt matches its best anchor in the mask; coordinate +
+    objectness + class BCE terms as in the paper."""
+    import jax
+
+    from ..ops.registry import dispatch
+    na = len(anchor_mask)
+
+    def _impl(xa, gtb, gtl):
+        n, c, h, w = xa.shape
+        pred = xa.reshape(n, na, 5 + class_num, h, w)
+        sig = jax.nn.sigmoid
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        total = 0.0
+        # objectness target grid built per image from gt centers
+        obj_target = jnp.zeros((n, na, h, w))
+        coord_loss = 0.0
+        cls_loss = 0.0
+        b_gt = gtb.shape[1]
+        masked_anchors = [(anchors[2 * i], anchors[2 * i + 1])
+                          for i in anchor_mask]
+        aw = jnp.asarray([a[0] for a in masked_anchors], jnp.float32)
+        ah = jnp.asarray([a[1] for a in masked_anchors], jnp.float32)
+        for bi in range(b_gt):
+            box = gtb[:, bi]                      # [n, 4] cx cy w h (0..1)
+            lab = gtl[:, bi].astype(jnp.int32)    # [n]
+            valid = (box[:, 2] > 0) & (box[:, 3] > 0)
+            gi = jnp.clip((box[:, 0] * w).astype(jnp.int32), 0, w - 1)
+            gj = jnp.clip((box[:, 1] * h).astype(jnp.int32), 0, h - 1)
+            # best anchor by IoU of (w, h)
+            bw = box[:, 2] * in_w
+            bh = box[:, 3] * in_h
+            inter = jnp.minimum(bw[:, None], aw) * jnp.minimum(bh[:, None],
+                                                               ah)
+            union = bw[:, None] * bh[:, None] + aw * ah - inter
+            best_a = jnp.argmax(inter / union, -1)
+            bidx = jnp.arange(n)
+            sel = pred[bidx, best_a, :, gj, gi]   # [n, 5+cls]
+            tx = box[:, 0] * w - gi
+            ty = box[:, 1] * h - gj
+            tw = jnp.log(jnp.maximum(bw / aw[best_a], 1e-9))
+            th = jnp.log(jnp.maximum(bh / ah[best_a], 1e-9))
+            cl = ((sig(sel[:, 0]) - tx) ** 2 + (sig(sel[:, 1]) - ty) ** 2
+                  + (sel[:, 2] - tw) ** 2 + (sel[:, 3] - th) ** 2)
+            coord_loss = coord_loss + jnp.sum(jnp.where(valid, cl, 0.0))
+            oh_lab = jax.nn.one_hot(lab, class_num)
+            if use_label_smooth:
+                oh_lab = oh_lab * (1 - 1.0 / class_num) + 1.0 / class_num \
+                    * (1 - oh_lab)
+            ce = -(oh_lab * jax.nn.log_sigmoid(sel[:, 5:])
+                   + (1 - oh_lab) * jax.nn.log_sigmoid(-sel[:, 5:]))
+            cls_loss = cls_loss + jnp.sum(
+                jnp.where(valid[:, None], ce, 0.0))
+            obj_target = obj_target.at[bidx, best_a, gj, gi].max(
+                valid.astype(jnp.float32))
+        conf = pred[:, :, 4]
+        obj_ce = -(obj_target * jax.nn.log_sigmoid(conf)
+                   + (1 - obj_target) * jax.nn.log_sigmoid(-conf))
+        total = coord_loss + cls_loss + jnp.sum(obj_ce) / (h * w)
+        return total.reshape(1)
+
+    return dispatch(_impl, (x, gt_box, gt_label), {}, op_name="yolo_loss")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes (ref prior_box op)."""
+    h, w = input.shape[2], input.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    step_h = steps[1] or img_h / h
+    step_w = steps[0] or img_w / w
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for i in range(h):
+        for j in range(w):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            for k_i, ms in enumerate(min_sizes):
+                for a in ars:
+                    bw = ms * np.sqrt(a) / 2
+                    bh = ms / np.sqrt(a) / 2
+                    boxes.append([(cx - bw) / img_w, (cy - bh) / img_h,
+                                  (cx + bw) / img_w, (cy + bh) / img_h])
+                if max_sizes:
+                    ms2 = np.sqrt(ms * max_sizes[k_i])
+                    boxes.append([(cx - ms2 / 2) / img_w,
+                                  (cy - ms2 / 2) / img_h,
+                                  (cx + ms2 / 2) / img_w,
+                                  (cy + ms2 / 2) / img_h])
+    arr = np.asarray(boxes, np.float32).reshape(h, w, -1, 4)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          arr.shape).copy()
+    return Tensor(jnp.asarray(arr)), Tensor(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (ref box_coder op)."""
+    from ..ops.registry import dispatch
+
+    def _impl(pb, pbv, tb):
+        norm = 1.0 if box_normalized else 0.0
+        pw = pb[:, 2] - pb[:, 0] + (1 - norm) * 0 + (0.0 if box_normalized
+                                                     else 1.0)
+        ph = pb[:, 3] - pb[:, 1] + (0.0 if box_normalized else 1.0)
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0.0 if box_normalized else 1.0)
+            th = tb[:, 3] - tb[:, 1] + (0.0 if box_normalized else 1.0)
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            ex = (tcx - pcx) / pw
+            ey = (tcy - pcy) / ph
+            ew = jnp.log(jnp.abs(tw / pw))
+            eh = jnp.log(jnp.abs(th / ph))
+            out = jnp.stack([ex, ey, ew, eh], -1)
+            if pbv is not None:
+                out = out / pbv
+            return out
+        # decode_center_size
+        d = tb
+        if pbv is not None:
+            d = d * pbv[None] if d.ndim == 3 else d * pbv
+        if d.ndim == 2:
+            d = d[:, None, :]
+        dcx = d[..., 0] * pw[:, None] + pcx[:, None]
+        dcy = d[..., 1] * ph[:, None] + pcy[:, None]
+        dw = jnp.exp(d[..., 2]) * pw[:, None]
+        dh = jnp.exp(d[..., 3]) * ph[:, None]
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - (0.0 if box_normalized else 1.0),
+                         dcy + dh / 2 - (0.0 if box_normalized else 1.0)],
+                        -1)
+        return out.squeeze(1) if out.shape[1] == 1 else out
+
+    return dispatch(_impl, (prior_box, prior_box_var, target_box), {},
+                    op_name="box_coder")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): soft decay by pairwise IoU, no sequential
+    suppression loop — the parallel-friendly NMS (good fit for TPU)."""
+    b = np.asarray(bboxes._data if isinstance(bboxes, Tensor) else bboxes)
+    s = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    outs, out_idx, rois_num = [], [], []
+    for n in range(b.shape[0]):
+        dets, idxs = [], []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            boxes_c = b[n, order]
+            sc_c = sc[order]
+            # pairwise IoU (upper triangle)
+            x1 = np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+            area = ((boxes_c[:, 2] - boxes_c[:, 0])
+                    * (boxes_c[:, 3] - boxes_c[:, 1]))
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-9)
+            iou = np.triu(iou, k=1)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - iou_cmax[None] ** 2)
+                               / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / (1 - iou_cmax[None] + 1e-9)).min(0)
+            dec_scores = sc_c * decay
+            ok = dec_scores >= post_threshold
+            for oi, okf in zip(range(len(order)), ok):
+                if okf:
+                    dets.append([c, dec_scores[oi], *boxes_c[oi]])
+                    idxs.append(order[oi])
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        if len(dets) > keep_top_k:
+            top = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[top]
+            idxs = [idxs[i] for i in top]
+        outs.append(dets)
+        out_idx.extend(idxs)
+        rois_num.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs)
+                             if outs else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(out_idx, np.int32))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (ref distribute_fpn_proposals)."""
+    rois = np.asarray(fpn_rois._data
+                      if isinstance(fpn_rois, Tensor) else fpn_rois)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.clip(w * h, 1e-6, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int32)
+    outs, idxs = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.where(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    order = np.concatenate(idxs) if idxs else np.zeros(0, np.int64)
+    restore = np.argsort(order)
+    nums = [Tensor(jnp.asarray(np.asarray([len(i)], np.int32)))
+            for i in idxs]
+    return outs, Tensor(jnp.asarray(restore.astype(np.int32)[:, None])), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (ref generate_proposals_v2): decode anchors,
+    clip, filter small, NMS."""
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    deltas = np.asarray(bbox_deltas._data
+                        if isinstance(bbox_deltas, Tensor) else bbox_deltas)
+    img = np.asarray(img_size._data
+                     if isinstance(img_size, Tensor) else img_size)
+    anc = np.asarray(anchors._data
+                     if isinstance(anchors, Tensor) else anchors).reshape(-1, 4)
+    var = np.asarray(variances._data
+                     if isinstance(variances, Tensor) else variances).reshape(-1, 4)
+    n = sc.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = deltas[b].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_b = s[order]
+        d_b = d[order] * var[order % len(var)]
+        a_b = anc[order % len(anc)]
+        aw = a_b[:, 2] - a_b[:, 0]
+        ah = a_b[:, 3] - a_b[:, 1]
+        acx = a_b[:, 0] + aw / 2
+        acy = a_b[:, 1] + ah / 2
+        cx = d_b[:, 0] * aw + acx
+        cy = d_b[:, 1] * ah + acy
+        bw = np.exp(np.clip(d_b[:, 2], -10, 10)) * aw
+        bh = np.exp(np.clip(d_b[:, 3], -10, 10)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                          cy + bh / 2], -1)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, img[b, 1])
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, img[b, 0])
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+              & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s_b = boxes[ok], s_b[ok]
+        keep = []
+        idx = np.argsort(-s_b)
+        while idx.size and len(keep) < post_nms_top_n:
+            i = idx[0]
+            keep.append(i)
+            if idx.size == 1:
+                break
+            rest = idx[1:]
+            xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+            inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = ((boxes[rest, 2] - boxes[rest, 0])
+                  * (boxes[rest, 3] - boxes[rest, 1]))
+            iou = inter / (a1 + a2 - inter + 1e-9)
+            idx = rest[iou <= nms_thresh]
+        all_rois.append(boxes[keep])
+        all_scores.append(s_b[keep])
+        nums.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois).astype(np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores)
+                                 .astype(np.float32)[:, None]))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, rscores
+
+
+# ---------------------------------------------------------------------------
+# image IO
+# ---------------------------------------------------------------------------
+
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor (PIL-backed; the reference uses nvjpeg)."""
+    import io
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("decode_jpeg needs Pillow") from e
+    data = bytes(np.asarray(x._data if isinstance(x, Tensor) else x)
+                 .astype(np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

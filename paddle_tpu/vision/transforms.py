@@ -299,3 +299,320 @@ __all__ = ["to_tensor", "normalize", "resize", "hflip", "vflip", "crop",
            "Normalize", "Resize", "RandomHorizontalFlip",
            "RandomVerticalFlip", "CenterCrop", "RandomCrop", "Pad",
            "RandomRotation", "BrightnessTransform", "ContrastTransform"]
+
+
+# -- functional tail (ref vision/transforms/functional.py) -------------------
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_hwc(img)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return gray.astype(arr.dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV round-trip."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _as_hwc(img).astype(np.float32)
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    a = arr / scale
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    maxc = a.max(-1)
+    minc = a.min(-1)
+    v = maxc
+    diff = maxc - minc + 1e-12
+    s = np.where(maxc > 0, diff / (maxc + 1e-12), 0.0)
+    rc = (maxc - r) / diff
+    gc = (maxc - g) / diff
+    bc = (maxc - b) / diff
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    tt = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    rgb = np.stack([
+        np.choose(i, [v, q, p, p, tt, v]),
+        np.choose(i, [tt, v, v, q, p, p]),
+        np.choose(i, [p, p, tt, v, v, q])], axis=-1)
+    return (rgb * scale).astype(arr.dtype)
+
+
+def _affine_matrix(angle, translate, scale_f, shear, center):
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0]]) * scale_f
+    m[0, 2] = translate[0] + cx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = translate[1] + cy - m[1, 0] * cx - m[1, 1] * cy
+    return m
+
+
+def _sample_affine(arr, m_inv, fill=0):
+    h, w = arr.shape[:2]
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    src_x = m_inv[0, 0] * xs + m_inv[0, 1] * ys + m_inv[0, 2]
+    src_y = m_inv[1, 0] * xs + m_inv[1, 1] * ys + m_inv[1, 2]
+    xi = np.round(src_x).astype(np.int32)
+    yi = np.round(src_y).astype(np.int32)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    xi = np.clip(xi, 0, w - 1)
+    yi = np.clip(yi, 0, h - 1)
+    out = arr[yi, xi]
+    out[~valid] = fill
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """ref transforms.functional.affine (nearest-neighbour resample)."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if np.isscalar(shear):
+        shear = (shear, 0.0)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    m3 = np.vstack([m, [0, 0, 1]])
+    m_inv = np.linalg.inv(m3)[:2]
+    return _sample_affine(arr, m_inv, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """ref transforms.functional.perspective: 4-point homography warp."""
+    arr = _as_hwc(img)
+    sp = np.asarray(startpoints, np.float32)
+    ep = np.asarray(endpoints, np.float32)
+    # solve homography mapping endpoints -> startpoints (inverse warp)
+    A = []
+    for (x, y), (u, v) in zip(ep, sp):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    A = np.asarray(A, np.float32)
+    bvec = sp.reshape(-1)
+    coeffs = np.linalg.lstsq(A, bvec, rcond=None)[0]
+    hmat = np.append(coeffs, 1.0).reshape(3, 3)
+    h, w = arr.shape[:2]
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    denom = hmat[2, 0] * xs + hmat[2, 1] * ys + hmat[2, 2]
+    src_x = (hmat[0, 0] * xs + hmat[0, 1] * ys + hmat[0, 2]) / denom
+    src_y = (hmat[1, 0] * xs + hmat[1, 1] * ys + hmat[1, 2]) / denom
+    xi = np.round(src_x).astype(np.int32)
+    yi = np.round(src_y).astype(np.int32)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    xi = np.clip(xi, 0, w - 1)
+    yi = np.clip(yi, 0, h - 1)
+    out = arr[yi, xi]
+    out[~valid] = fill
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """ref transforms.functional.erase: fill the region with v."""
+    arr = _as_hwc(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _as_hwc(img).astype(np.float32)
+    gray = to_grayscale(arr, 3)
+    out = gray + saturation_factor * (arr - gray)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    return np.clip(out, 0, hi).astype(arr.dtype)
+
+
+# -- transform classes tail (ref vision/transforms/transforms.py) ------------
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Transpose(BaseTransform):
+    """HWC -> CHW (ref transforms.Transpose)."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(_as_hwc(img), self.order)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = float(np.random.uniform(max(0, 1 - self.value), 1 + self.value))
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, float(np.random.uniform(-self.value,
+                                                       self.value)))
+
+
+class ColorJitter(BaseTransform):
+    """ref ColorJitter: random brightness/contrast/saturation/hue in
+    random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    """ref RandomResizedCrop: random area/aspect crop then resize."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                patch = arr[top:top + ch, left:left + cw]
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.translate = translate
+        self.scale_range = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def __call__(self, img):
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        angle = float(np.random.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = float(np.random.uniform(-self.translate[0],
+                                         self.translate[0]) * w)
+            ty = float(np.random.uniform(-self.translate[1],
+                                         self.translate[1]) * h)
+        sc = (float(np.random.uniform(*self.scale_range))
+              if self.scale_range else 1.0)
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            srange = ((-self.shear, self.shear) if np.isscalar(self.shear)
+                      else tuple(self.shear))
+            sh = (float(np.random.uniform(*srange[:2])), 0.0)
+        return affine(arr, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        jitter = lambda lo, hi: int(np.random.randint(lo, hi + 1))
+        end = [(jitter(0, dx), jitter(0, dy)),
+               (w - 1 - jitter(0, dx), jitter(0, dy)),
+               (w - 1 - jitter(0, dx), h - 1 - jitter(0, dy)),
+               (jitter(0, dx), h - 1 - jitter(0, dy))]
+        return perspective(arr, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """ref RandomErasing (Zhong 2020)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = (np.random.randn(eh, ew, arr.shape[2])
+                     if self.value == "random" else self.value)
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return arr
