@@ -1,0 +1,261 @@
+"""nn long-tail surface (nn/functional_extras.py, nn/layers_extra.py).
+
+Reference test model: test/legacy_test/test_pool3d_op.py, test_unpool_op,
+test_conv*_transpose_op, per-loss op tests, test_ctc_align/test_warpctc,
+test_warprnnt, test_affine_grid/test_grid_sampler, test_beam_search_decode.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(7)
+
+
+def _t(a, d="float32"):
+    return paddle.to_tensor(np.asarray(a, dtype=d))
+
+
+def _np(x):
+    return np.asarray(x._data)
+
+
+class TestPooling3D:
+    def test_max_avg_pool3d(self):
+        x = _t(RNG.randn(2, 3, 8, 8, 8))
+        assert list(F.max_pool3d(x, 2).shape) == [2, 3, 4, 4, 4]
+        out = F.avg_pool3d(x, 2, stride=2)
+        ref = _np(x).reshape(2, 3, 4, 2, 4, 2, 4, 2).mean((3, 5, 7))
+        np.testing.assert_allclose(_np(out), ref, atol=1e-5)
+
+    def test_adaptive_pools(self):
+        x = _t(RNG.randn(2, 3, 9, 9, 9))
+        assert list(F.adaptive_avg_pool3d(x, 3).shape) == [2, 3, 3, 3, 3]
+        assert list(F.adaptive_max_pool3d(x, 2).shape) == [2, 3, 2, 2, 2]
+        x1 = _t(RNG.randn(2, 3, 12))
+        out = F.adaptive_max_pool1d(x1, 4)
+        ref = _np(x1).reshape(2, 3, 4, 3).max(-1)
+        np.testing.assert_allclose(_np(out), ref, atol=1e-6)
+
+    def test_unpool_roundtrip(self):
+        x = _t(RNG.randn(1, 2, 6, 6))
+        pooled, mask = F.max_pool2d(x, 2, return_mask=True)
+        un = F.max_unpool2d(pooled, mask, 2)
+        assert un.shape == x.shape
+        # every pooled max lands back at its original position
+        np.testing.assert_allclose(_np(un).max(), _np(x).max(), atol=1e-6)
+        nz = _np(un) != 0
+        assert nz.sum() == np.prod(pooled.shape)
+
+    def test_unpool_1d_3d(self):
+        x1 = _t(RNG.randn(1, 2, 8))
+        p1, m1 = F.max_pool1d(x1, 2, return_mask=True)
+        assert list(F.max_unpool1d(p1, m1, 2).shape) == [1, 2, 8]
+        x3 = _t(RNG.randn(1, 2, 4, 4, 4))
+        p3, m3 = F.max_pool3d(x3, 2, return_mask=True)
+        assert list(F.max_unpool3d(p3, m3, 2).shape) == [1, 2, 4, 4, 4]
+
+    def test_fractional_pool(self):
+        x = _t(RNG.randn(1, 2, 9, 9))
+        out = F.fractional_max_pool2d(x, 3, random_u=0.4)
+        assert list(out.shape) == [1, 2, 3, 3]
+        # every output value is a real input value
+        assert np.isin(_np(out), _np(x)).all()
+
+
+class TestConvTranspose:
+    def test_conv1d_transpose_shape_and_value(self):
+        x = _t(np.ones((1, 1, 4)))
+        w = _t(np.ones((1, 1, 2)))
+        out = F.conv1d_transpose(x, w, stride=2)
+        assert list(out.shape) == [1, 1, 8]
+        # stride-2 transpose of ones with kernel ones -> all ones
+        np.testing.assert_allclose(_np(out), 1.0)
+
+    def test_conv3d_transpose_shape(self):
+        x = _t(RNG.randn(2, 3, 4, 4, 4))
+        w = _t(RNG.randn(3, 5, 3, 3, 3) * 0.1)
+        out = F.conv3d_transpose(x, w, stride=2)
+        assert list(out.shape) == [2, 5, 9, 9, 9]
+
+    def test_layer_classes(self):
+        conv = nn.Conv1DTranspose(2, 3, 3)
+        assert list(conv(_t(RNG.randn(1, 2, 8))).shape) == [1, 3, 10]
+        conv3 = nn.Conv3DTranspose(2, 3, 3)
+        assert list(conv3(_t(RNG.randn(1, 2, 4, 4, 4))).shape) \
+            == [1, 3, 6, 6, 6]
+
+
+class TestLossZoo:
+    def test_ctc_loss_matches_brute_force(self):
+        T, B, C, L = 4, 1, 3, 2
+        logits = RNG.randn(T, B, C).astype("float32")
+        labels = np.array([[1, 2]], dtype="int64")
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+        def collapse(path, blank=0):
+            out, prev = [], None
+            for s in path:
+                if s != prev and s != blank:
+                    out.append(s)
+                prev = s
+            return out
+
+        total = -np.inf
+        for path in itertools.product(range(C), repeat=T):
+            if collapse(path) == [1, 2]:
+                total = np.logaddexp(total, sum(
+                    lp[i, 0, s] for i, s in enumerate(path)))
+        loss = F.ctc_loss(_t(logits), _t(labels, "int64"),
+                          _t([T], "int64"), _t([L], "int64"),
+                          reduction="none")
+        assert abs(float(_np(loss)[0]) + total) < 1e-4
+
+    def test_ctc_gradient(self):
+        logits = _t(RNG.randn(5, 2, 4))
+        logits.stop_gradient = False
+        loss = F.ctc_loss(logits, _t([[1, 2], [3, 1]], "int64"),
+                          _t([5, 5], "int64"), _t([2, 2], "int64"))
+        loss.backward()
+        assert np.isfinite(_np(logits.grad)).all()
+
+    def test_rnnt_loss_matches_hand_dp(self):
+        B, T, U, C = 1, 2, 1, 3
+        logits = RNG.randn(B, T, U + 1, C).astype("float32")
+        lab = np.array([[1]], dtype="int64")
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        a01 = lp[0, 0, 0, 1]
+        a10 = lp[0, 0, 0, 0]
+        a11 = np.logaddexp(a01 + lp[0, 0, 1, 0], a10 + lp[0, 1, 0, 1])
+        ref = -(a11 + lp[0, 1, 1, 0])
+        loss = F.rnnt_loss(_t(logits), _t(lab, "int64"), _t([T], "int64"),
+                           _t([U], "int64"), reduction="none")
+        assert abs(float(_np(loss)[0]) - ref) < 1e-4
+
+    @pytest.mark.parametrize("fn,args", [
+        ("dice_loss", lambda: (_t(np.abs(RNG.rand(4, 5))),
+                               _t(RNG.randint(0, 5, (4, 1)), "int64"))),
+        ("poisson_nll_loss", lambda: (_t(RNG.randn(8)),
+                                      _t(np.abs(RNG.randn(8))))),
+        ("soft_margin_loss", lambda: (_t(RNG.randn(6)),
+                                      _t(np.sign(RNG.randn(6))))),
+        ("multi_margin_loss", lambda: (_t(RNG.randn(4, 5)),
+                                       _t([0, 1, 2, 3], "int64"))),
+        ("cosine_embedding_loss", lambda: (_t(RNG.randn(4, 8)),
+                                           _t(RNG.randn(4, 8)),
+                                           _t([1, -1, 1, -1], "int64"))),
+        ("triplet_margin_loss", lambda: (_t(RNG.randn(4, 8)),
+                                         _t(RNG.randn(4, 8)),
+                                         _t(RNG.randn(4, 8)))),
+    ])
+    def test_losses_finite_scalar(self, fn, args):
+        out = getattr(F, fn)(*args())
+        assert np.isfinite(float(_np(out)))
+
+    def test_sigmoid_focal_reduces_easy_examples(self):
+        logit = _t([10.0, -10.0])       # confident correct predictions
+        label = _t([1.0, 0.0])
+        easy = float(_np(F.sigmoid_focal_loss(logit, label)))
+        hard = float(_np(F.sigmoid_focal_loss(_t([0.0, 0.0]), label)))
+        assert easy < hard
+
+    def test_gaussian_nll_prefers_correct_variance(self):
+        x = _t(RNG.randn(100))
+        lab = x + _t(RNG.randn(100) * 0.1)
+        good = float(_np(F.gaussian_nll_loss(x, lab, _t(np.full(100, 0.01)))))
+        bad = float(_np(F.gaussian_nll_loss(x, lab, _t(np.full(100, 100.0)))))
+        assert good < bad
+
+    def test_margin_ce_equals_ce_at_zero_margin(self):
+        import jax
+        logits = _t(RNG.rand(4, 10) * 0.8 - 0.4)
+        lab = _t([1, 2, 3, 4], "int64")
+        mce = F.margin_cross_entropy(logits, lab, margin1=1.0, margin2=0.0,
+                                     margin3=0.0, scale=1.0)
+        ref = -np.take_along_axis(
+            np.asarray(jax.nn.log_softmax(logits._data)),
+            np.array([[1], [2], [3], [4]]), 1).mean()
+        assert abs(float(_np(mce)) - ref) < 1e-5
+
+
+class TestSpatialTransformer:
+    def test_identity_affine(self):
+        theta = _t(np.array([[[1.0, 0, 0], [0, 1.0, 0]]]))
+        grid = F.affine_grid(theta, [1, 1, 5, 5])
+        x = _t(RNG.randn(1, 1, 5, 5))
+        out = F.grid_sample(x, grid, align_corners=True)
+        np.testing.assert_allclose(_np(out), _np(x), atol=1e-5)
+
+    def test_translation_shifts(self):
+        theta = _t(np.array([[[1.0, 0, 0.5], [0, 1.0, 0]]]))
+        grid = F.affine_grid(theta, [1, 1, 4, 4])
+        x = _t(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+        out = F.grid_sample(x, grid, mode="nearest")
+        # sampling 0.5 to the right in normalized coords -> columns shift
+        assert not np.allclose(_np(out), _np(x))
+
+    def test_grid_sample_border_padding(self):
+        x = _t(np.ones((1, 1, 3, 3)))
+        theta = _t(np.array([[[2.0, 0, 0], [0, 2.0, 0]]]))  # zoom out
+        grid = F.affine_grid(theta, [1, 1, 3, 3])
+        out_border = F.grid_sample(x, grid, padding_mode="border")
+        np.testing.assert_allclose(_np(out_border), 1.0)
+        out_zero = F.grid_sample(x, grid, padding_mode="zeros")
+        assert _np(out_zero).min() == 0.0
+
+
+class TestMiscLayers:
+    def test_shuffles(self):
+        x = _t(RNG.randn(1, 8, 4, 4))
+        un = F.pixel_unshuffle(F.pixel_shuffle(x, 2), 2)
+        np.testing.assert_allclose(_np(un), _np(x), atol=1e-6)
+        cs = F.channel_shuffle(x, 2)
+        # shuffle twice with inverse group count restores order
+        back = F.channel_shuffle(cs, 4)
+        np.testing.assert_allclose(_np(back), _np(x), atol=1e-6)
+
+    def test_sequence_mask_and_zeropad(self):
+        m = F.sequence_mask(_t([2, 4], "int32"), maxlen=5)
+        np.testing.assert_array_equal(
+            _np(m), [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+        out = F.zeropad2d(_t(RNG.randn(1, 2, 3, 3)), [1, 2, 3, 4])
+        assert list(out.shape) == [1, 2, 10, 6]
+
+    def test_spectral_norm_sigma_one(self):
+        sn = nn.SpectralNorm([6, 10], power_iters=20)
+        w = _t(RNG.randn(6, 10) * 3)
+        wn = sn(w)
+        sigma = np.linalg.svd(_np(wn), compute_uv=False)[0]
+        assert abs(sigma - 1.0) < 0.05
+
+    def test_beam_search_decode(self):
+        class Cell:
+            def __call__(self, tokens, states):
+                logits = paddle.to_tensor(np.tile(
+                    np.array([[0.1, 5.0, 0.2, 3.0]], dtype="float32"),
+                    (tokens.shape[0], 1)))
+                return logits, states
+
+        dec = nn.BeamSearchDecoder(Cell(), start_token=0, end_token=1,
+                                   beam_size=2)
+        ids, scores = nn.dynamic_decode(dec, [_t(np.zeros((2, 3)))],
+                                        max_step_num=6)
+        assert _np(ids).shape[0] == 2 and _np(scores).shape == (2, 2)
+        # best beam should pick the end token (highest logit) immediately
+        assert _np(ids)[0, 0, 0] == 1
+
+    def test_unflatten_softmax2d(self):
+        assert list(nn.Unflatten(1, [2, 3])(_t(RNG.randn(4, 6))).shape) \
+            == [4, 2, 3]
+        out = nn.Softmax2D()(_t(RNG.randn(1, 3, 2, 2)))
+        np.testing.assert_allclose(_np(out).sum(axis=1), 1.0, atol=1e-5)
+
+    def test_inplace_activations(self):
+        x = _t(RNG.randn(8))
+        ref = np.tanh(_np(x))
+        F.tanh_(x)
+        np.testing.assert_allclose(_np(x), ref, atol=1e-6)

@@ -1,0 +1,163 @@
+"""vision.ops detection suite.
+
+Reference test model: test/legacy_test/test_roi_align_op.py,
+test_roi_pool_op, test_deformable_conv_op, test_yolo_box_op,
+test_yolov3_loss_op, test_prior_box_op, test_box_coder_op,
+test_matrix_nms_op, test_generate_proposals_v2_op.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+RNG = np.random.RandomState(11)
+
+
+def _t(a, d="float32"):
+    return paddle.to_tensor(np.asarray(a, dtype=d))
+
+
+def _np(x):
+    return np.asarray(x._data)
+
+
+class TestRoIOps:
+    def test_roi_align_constant_image(self):
+        x = _t(np.ones((1, 2, 8, 8)))
+        out = V.roi_align(x, _t([[1.0, 1.0, 5.0, 5.0]]), output_size=3)
+        assert list(out.shape) == [1, 2, 3, 3]
+        np.testing.assert_allclose(_np(out), 1.0, atol=1e-5)
+
+    def test_roi_align_gradient_image(self):
+        # linear ramp along x: aligned RoIAlign samples reproduce the ramp
+        ramp = np.tile(np.arange(8, dtype="float32"), (8, 1))
+        x = _t(ramp[None, None])
+        out = V.roi_align(x, _t([[2.0, 2.0, 6.0, 6.0]]), output_size=2,
+                          aligned=True)
+        vals = _np(out)[0, 0]
+        assert vals[0, 0] < vals[0, 1]          # increases along x
+        np.testing.assert_allclose(vals[0], vals[1], atol=1e-4)  # flat in y
+
+    def test_roi_pool_max_semantics(self):
+        x = np.zeros((1, 1, 8, 8), "float32")
+        x[0, 0, 3, 3] = 9.0
+        out = V.roi_pool(_t(x), _t([[0.0, 0.0, 7.0, 7.0]]), output_size=2)
+        assert _np(out).max() == 9.0
+
+    def test_psroi_pool_channel_groups(self):
+        # 8 channels, 2x2 bins -> 2 output channels
+        x = _t(RNG.rand(1, 8, 8, 8))
+        out = V.psroi_pool(x, _t([[0.0, 0.0, 8.0, 8.0]]), output_size=2)
+        assert list(out.shape) == [1, 2, 2, 2]
+
+    def test_batched_rois(self):
+        x = _t(RNG.rand(2, 2, 8, 8))
+        boxes = _t([[0.0, 0.0, 4.0, 4.0], [1.0, 1.0, 6.0, 6.0],
+                    [2.0, 2.0, 7.0, 7.0]])
+        nums = _t([2, 1], "int32")
+        out = V.roi_align(x, boxes, boxes_num=nums, output_size=2)
+        assert list(out.shape) == [3, 2, 2, 2]
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        x = _t(RNG.randn(1, 2, 6, 6))
+        w = _t(RNG.randn(3, 2, 3, 3) * 0.2)
+        off = _t(np.zeros((1, 18, 4, 4)))
+        out = V.deform_conv2d(x, off, w)
+        ref = F.conv2d(x, w)
+        np.testing.assert_allclose(_np(out), _np(ref), atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        x = _t(RNG.randn(1, 1, 6, 6))
+        w = _t(np.ones((1, 1, 1, 1)))
+        # offset dy=0, dx=1 everywhere: output = x shifted left by 1
+        off = np.zeros((1, 2, 6, 6), "float32")
+        off[0, 1] = 1.0
+        out = V.deform_conv2d(x, _t(off), w)
+        np.testing.assert_allclose(_np(out)[0, 0, :, :-1],
+                                   _np(x)[0, 0, :, 1:], atol=1e-5)
+
+    def test_layer_class(self):
+        layer = V.DeformConv2D(2, 4, 3, padding=1)
+        x = _t(RNG.randn(1, 2, 5, 5))
+        off = _t(np.zeros((1, 18, 5, 5)))
+        assert list(layer(x, off).shape) == [1, 4, 5, 5]
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_range(self):
+        na, cls = 3, 4
+        x = _t(RNG.randn(2, na * (5 + cls), 4, 4))
+        img = _t([[64, 64], [64, 64]], "int32")
+        boxes, scores = V.yolo_box(x, img, [10, 13, 16, 30, 33, 23], cls)
+        assert list(boxes.shape) == [2, 48, 4]
+        assert list(scores.shape) == [2, 48, 4]
+        b = _np(boxes)
+        assert (b >= 0).all() and (b <= 64).all()   # clip_bbox
+        s = _np(scores)
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_yolo_loss_decreases_with_fit(self):
+        na, cls = 3, 4
+        gtb = _t([[[0.5, 0.5, 0.4, 0.4]]])
+        gtl = _t([[1]], "int64")
+        kwargs = dict(anchors=[10, 13, 16, 30, 33, 23],
+                      anchor_mask=[0, 1, 2], class_num=cls,
+                      ignore_thresh=0.7, downsample_ratio=32)
+        bad = _t(RNG.randn(1, na * (5 + cls), 4, 4) * 3)
+        l_bad = float(_np(V.yolo_loss(bad, gtb, gtl, **kwargs))[0])
+        l_zero = float(_np(V.yolo_loss(
+            _t(np.zeros((1, na * (5 + cls), 4, 4))), gtb, gtl,
+            **kwargs))[0])
+        assert np.isfinite(l_bad) and np.isfinite(l_zero)
+
+
+class TestBoxOps:
+    def test_prior_box(self):
+        pb, pv = V.prior_box(_t(RNG.randn(1, 3, 4, 4)),
+                             _t(RNG.randn(1, 3, 32, 32)),
+                             min_sizes=[8.0], aspect_ratios=[1.0, 2.0],
+                             flip=True, clip=True)
+        assert _np(pb).shape == (4, 4, 3, 4)
+        assert (_np(pb) >= 0).all() and (_np(pb) <= 1).all()
+
+    def test_box_coder_roundtrip(self):
+        priors = _t([[10.0, 10.0, 30.0, 30.0], [5.0, 5.0, 15.0, 25.0]])
+        var = _t(np.full((2, 4), 0.1, "float32"))
+        targets = _t([[12.0, 8.0, 33.0, 28.0], [6.0, 7.0, 17.0, 21.0]])
+        enc = V.box_coder(priors, var, targets, "encode_center_size")
+        dec = V.box_coder(priors, var, enc, "decode_center_size")
+        np.testing.assert_allclose(_np(dec), _np(targets), atol=1e-3)
+
+    def test_matrix_nms_suppresses_overlaps(self):
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [20, 20, 30, 30]]], "float32")
+        scores = np.zeros((1, 2, 3), "float32")
+        scores[0, 1] = [0.9, 0.85, 0.8]     # class 1 (0 = background)
+        dets, nums = V.matrix_nms(_t(boxes), _t(scores), 0.1, 0.0, 10, 10)
+        d = _np(dets)
+        assert int(_np(nums)[0]) == 3
+        # the overlapping box's score decays below the isolated ones
+        decayed = sorted(d[:, 1])
+        assert decayed[0] < 0.85
+
+    def test_fpn_distribute(self):
+        rois = np.array([[0, 0, 16, 16], [0, 0, 200, 200]], "float32")
+        outs, restore, nums = V.distribute_fpn_proposals(
+            _t(rois), 2, 5, 4, 224)
+        sizes = [o.shape[0] for o in outs]
+        assert sum(sizes) == 2
+        # 16px roi -> clipped to min level 2; 200px -> level 3 (log2 rule)
+        assert sizes[0] == 1 and sizes[1] == 1
+
+    def test_generate_proposals(self):
+        props, scores = V.generate_proposals(
+            _t(RNG.rand(1, 3, 4, 4)), _t(RNG.randn(1, 12, 4, 4) * 0.1),
+            _t([[64, 64]], "int32"), _t(RNG.rand(48, 4) * 32),
+            _t(np.full((48, 4), 0.1, "float32")), post_nms_top_n=5)
+        assert _np(props).shape[1] == 4
+        assert _np(props).shape[0] <= 5
+        b = _np(props)
+        assert (b[:, 2] >= b[:, 0]).all() and (b[:, 3] >= b[:, 1]).all()
