@@ -244,3 +244,39 @@ __all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
            "enable_operator_stats_collection",
            "disable_operator_stats_collection", "collect_operator_stats",
            "compare_accuracy"]
+
+
+def check_layer_numerics(func):
+    """ref amp/debugging.py check_layer_numerics: decorator over a layer's
+    forward that checks inputs/outputs for NaN/Inf when the tensor checker
+    is active."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        # checks (each a device->host sync) only run while the tensor
+        # checker is enabled — the decorator is free otherwise
+        if _active_config is None:
+            return func(self, *args, **kwargs)
+
+        import numpy as np
+
+        from ..core.tensor import Tensor
+
+        def _check(tag, xs):
+            for x in xs:
+                if isinstance(x, Tensor):
+                    arr = np.asarray(x._data)
+                    if not np.isfinite(arr).all():
+                        raise RuntimeError(
+                            f"check_layer_numerics: non-finite values in "
+                            f"{tag} of {type(self).__name__}")
+        _check("inputs", list(args) + list(kwargs.values()))
+        out = func(self, *args, **kwargs)
+        _check("outputs", out if isinstance(out, (list, tuple)) else [out])
+        return out
+
+    return wrapper
+
+
+__all__.append("check_layer_numerics")

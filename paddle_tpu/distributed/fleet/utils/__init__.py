@@ -10,3 +10,99 @@ from .sequence_parallel_utils import (AllGatherOp, ColumnSequenceParallelLinear,
                                       is_sequence_parallel_parameter,
                                       mark_as_sequence_parallel_parameter,
                                       register_sequence_parallel_allreduce_hooks)
+
+# -- reference fleet.utils __all__: LocalFS, HDFSClient, recompute,
+#    DistributedInfer (fleet/utils/fs.py + __init__.py) ----------------------
+from ..recompute import recompute  # noqa: E402
+import os as _os  # noqa: E402
+import shutil as _shutil  # noqa: E402
+
+
+class LocalFS:
+    """ref fleet/utils/fs.py LocalFS: filesystem ops behind the FS
+    interface used by checkpoint/save paths."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(_os.listdir(fs_path)):
+            (dirs if _os.path.isdir(_os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        _os.makedirs(fs_path, exist_ok=True)
+
+    def is_exist(self, fs_path):
+        return _os.path.exists(fs_path)
+
+    def is_dir(self, fs_path):
+        return _os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return _os.path.isfile(fs_path)
+
+    def delete(self, fs_path):
+        if _os.path.isdir(fs_path):
+            _shutil.rmtree(fs_path, ignore_errors=True)
+        elif _os.path.exists(fs_path):
+            _os.remove(fs_path)
+
+    def rename(self, src, dst):
+        _os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if _os.path.exists(dst):
+            if not overwrite:
+                # os.rename would silently replace dst on POSIX — the
+                # reference FS raises instead (checkpoint anti-clobber)
+                raise FileExistsError(
+                    f"mv: destination {dst} exists (overwrite=False)")
+            self.delete(dst)
+        _os.rename(src, dst)
+
+    def upload(self, local_path, fs_path):
+        _shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        _shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if _os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def cat(self, fs_path):
+        with open(fs_path) as f:
+            return f.read()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """ref fleet/utils/fs.py HDFSClient (hadoop CLI wrapper): requires a
+    hadoop binary; unavailable offline — raises with a clear message so
+    checkpoint paths fall back to LocalFS."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **k):
+        raise RuntimeError(
+            "HDFSClient needs a hadoop installation; none exists in this "
+            "environment — use LocalFS (same interface)")
+
+
+class DistributedInfer:
+    """ref fleet/utils/__init__.py DistributedInfer (PS inference helper):
+    single-controller inference needs no var distribution; init/get
+    methods keep API compatibility."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        return None
+
+    def get_dist_infer_program(self):
+        return self._main

@@ -1,0 +1,3 @@
+"""paddle.distributed.utils (ref distributed/utils/__init__.py — empty
+__all__; launch-time helpers live in distributed.launch)."""
+__all__ = []

@@ -1,5 +1,7 @@
 """paddle.incubate analog — experimental APIs (reference: python/paddle/incubate)."""
 from . import asp
+from . import autograd
+from . import multiprocessing
 from . import distributed
 from . import nn
 from . import optimizer

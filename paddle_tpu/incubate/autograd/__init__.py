@@ -1,0 +1,136 @@
+"""paddle.incubate.autograd (ref python/paddle/incubate/autograd): the
+functional-autodiff surface (vjp/jvp/Jacobian/Hessian) and the prim-mode
+switches. jax transforms back every entry natively."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+
+def _wrap_fn(func):
+    """Tensor-level func -> array-level pure fn (replays eagerly)."""
+
+    def fn(*arrays):
+        ins = [Tensor(a) for a in arrays]
+        for t in ins:
+            t.stop_gradient = False
+        out = func(*ins)
+        return jax.tree_util.tree_map(
+            lambda o: o._data if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+
+    return fn
+
+
+def _pack_out(out):
+    if isinstance(out, (list, tuple)):
+        return [Tensor(o) for o in out]
+    return Tensor(out)
+
+
+def vjp(func, xs, v=None):
+    """ref autograd.vjp: returns (outputs, vjp_result). Handles single and
+    tuple-returning funcs."""
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x._data for x in xs_list]
+    out, pull = jax.vjp(_wrap_fn(func), *arrays)
+    if v is None:
+        ct = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        cts = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+               for t in vs]
+        ct = tuple(cts) if isinstance(out, (list, tuple)) else cts[0]
+    grads = pull(ct)
+    grads_t = [Tensor(g) for g in grads]
+    return _pack_out(out), grads_t if len(grads_t) > 1 else grads_t[0]
+
+
+def jvp(func, xs, v=None):
+    """ref autograd.jvp: forward-mode directional derivative."""
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x._data for x in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                    for t in vs]
+    out, tangent_out = jax.jvp(_wrap_fn(func), tuple(arrays),
+                               tuple(tangents))
+    return _pack_out(out), _pack_out(tangent_out)
+
+
+class Jacobian:
+    """ref autograd.Jacobian: lazily evaluated full Jacobian with row/col
+    indexing."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+        arrays = [x._data for x in xs_list]
+        jac = jax.jacrev(_wrap_fn(func), argnums=tuple(
+            range(len(arrays))))(*arrays)
+        j = jac[0] if len(arrays) == 1 else jnp.concatenate(
+            [g.reshape(g.shape[0], -1) for g in jac], axis=-1)
+        self._jac = Tensor(jnp.asarray(j))
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac._data[idx])
+
+    @property
+    def shape(self):
+        return list(self._jac.shape)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+        arrays = [x._data for x in xs_list]
+        wrapped = _wrap_fn(func)
+
+        def scalar_fn(*arrs):
+            return wrapped(*arrs).reshape(())
+
+        hes = jax.hessian(scalar_fn)(*arrays)
+        self._h = Tensor(jnp.asarray(hes))
+
+    def __getitem__(self, idx):
+        return Tensor(self._h._data[idx])
+
+    @property
+    def shape(self):
+        return list(self._h.shape)
+
+
+_PRIM = [False]
+
+
+def enable_prim():
+    """prim mode decomposes ops into primitives for transforms — jax ops
+    are already primitive-composed, so this toggles a flag only."""
+    _PRIM[0] = True
+
+
+def disable_prim():
+    _PRIM[0] = False
+
+
+def prim_enabled():
+    return _PRIM[0]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """ref primapi.forward_grad (jvp by another name, prim mode)."""
+    raise NotImplementedError(
+        "forward_grad operates on static prim programs; use "
+        "paddle.incubate.autograd.jvp for the functional equivalent")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from ...autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs=grad_outputs)

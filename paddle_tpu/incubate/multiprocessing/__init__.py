@@ -1,0 +1,8 @@
+"""paddle.incubate.multiprocessing (ref incubate/multiprocessing):
+multiprocessing with tensor-aware reductions. Tensors here are jax arrays
+(host-transferable via pickle of numpy views), so the stdlib reductions
+suffice — no shared-memory rewrite needed for correctness.
+"""
+from multiprocessing import *  # noqa: F401,F403
+
+__all__ = []

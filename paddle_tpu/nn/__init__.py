@@ -46,3 +46,5 @@ from .layers_extra import (AdaptiveAvgPool3D, AdaptiveMaxPool1D,
                            TripletMarginWithDistanceLoss, Unflatten,
                            UpsamplingBilinear2D, UpsamplingNearest2D,
                            dynamic_decode)
+from . import utils
+from . import quant

@@ -1,0 +1,76 @@
+"""paddle.nn.quant (ref python/paddle/nn/quant): weight-only quantized
+linear for LLM serving. int8/int4 weights dequantize on the fly; the
+matmul itself runs bf16/fp32 on the MXU (the reference's cutlass
+weight-only kernels become dequant + GEMM that XLA fuses)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...ops.registry import dispatch
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+
+class Stub(Layer):
+    """ref nn/quant/stub.py Stub: placeholder observed/replaced by the
+    quantization framework; identity otherwise."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        if self._observer is not None:
+            self._observer.observe(x)
+        return x
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Quantize a [in, out] weight to int8/int4 per output channel.
+    Returns (quantized_weight, scale)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    bits = 4 if "int4" in algo else 8
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = np.abs(arr).max(axis=0) / qmax
+    scale = np.where(scale == 0, 1.0, scale)
+    q = np.clip(np.round(arr / scale), -qmax, qmax).astype(np.int8)
+    # int4 values are stored UNPACKED (one per int8 byte): this build's
+    # weight_only_linear consumes them directly; the reference's packed
+    # two-per-byte layout is NOT produced here
+    return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(
+        scale.astype(np.float32)))
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+    from ...core import dtype as dtype_mod
+
+    def _impl(q, s):
+        return q.astype(jnp.float32) * s
+
+    out = dispatch(_impl, (x, scale), {}, op_name="weight_dequantize")
+    return out.astype(out_dtype)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """ref nn/quant/quantized_linear.py weight_only_linear."""
+
+    def _impl(x, w, s, b):
+        wf = w.astype(jnp.float32) * s
+        out = x @ wf.astype(x.dtype)
+        return out + b if b is not None else out
+
+    return dispatch(_impl, (x, weight, weight_scale, bias), {},
+                    op_name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """ref llm_int8_linear (LLM.int8()): outlier channels in higher
+    precision. TPU form: the dequantized GEMM IS the fast path, so the
+    outlier split reduces to the same computation."""
+    return weight_only_linear(x, weight, bias, weight_scale)

@@ -1,0 +1,162 @@
+"""paddle.nn.utils (ref python/paddle/nn/utils/__init__.py):
+weight/spectral norm reparameterizations + parameter vector helpers +
+gradient clipping utilities."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .layer import Layer
+
+
+def _norm_except(w, dim):
+    """Per-slice L2 norm keeping only `dim`; dim=None -> norm over all."""
+    if dim is None:
+        axes = tuple(range(w.ndim))
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def _norm_except_t(v: Tensor, dim):
+    """Tensor-level (tape-recorded) version of _norm_except — keeps
+    weight_g/weight_v trainable through the recompute."""
+    if dim is None:
+        axes = list(range(v.ndim))
+    else:
+        axes = [i for i in range(v.ndim) if i != dim]
+    return ((v * v).sum(axis=axes, keepdim=True)) ** 0.5
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    """ref nn/utils/weight_norm_hook.py: w = g * v/||v||, recomputed every
+    forward via a pre-hook; weight_g / weight_v become the parameters."""
+    w = getattr(layer, name)
+    d = None if dim is None else dim % w.ndim
+    g0 = _norm_except(w._data, d)
+    v0 = w._data
+    g = layer.create_parameter(list(g0.shape))
+    g._set_data(g0)
+    v = layer.create_parameter(list(v0.shape))
+    v._set_data(v0)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # the original weight becomes derived state, not a parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lay, inputs):
+        vv = getattr(lay, name + "_v")
+        gg = getattr(lay, name + "_g")
+        # Tensor-level math: the derived weight carries a tape node, so
+        # backward reaches weight_g / weight_v
+        new_w = gg * vv / (_norm_except_t(vv, d) + 1e-12)
+        object.__setattr__(lay, name, new_w)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (handle, name, d)
+    _recompute(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    info = getattr(layer, "_weight_norm_hook", None)
+    if info is None:
+        raise ValueError("layer has no weight norm applied")
+    handle, nm, d = info
+    if hasattr(handle, "remove"):
+        handle.remove()
+    v = getattr(layer, nm + "_v")
+    g = getattr(layer, nm + "_g")
+    norm = _norm_except(v._data, d)
+    w = layer.create_parameter(list(v.shape))
+    w._set_data(g._data * v._data / (norm + 1e-12))
+    # drop the derived instance attribute the pre-hook installed so the
+    # restored parameter is visible through normal attribute lookup
+    if nm in layer.__dict__:
+        del layer.__dict__[nm]
+    layer.add_parameter(nm, w)
+    for suffix in ("_g", "_v"):
+        if nm + suffix in layer._parameters:
+            del layer._parameters[nm + suffix]
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1,
+                  eps=1e-12, dim=None):
+    """ref nn/utils/spectral_norm_hook.py: normalize the weight's largest
+    singular value to 1 every forward. The original weight stays trainable
+    as `<name>_orig` (the reference's weight_orig); the forward reads its
+    CURRENT value, so optimizer updates take effect."""
+    from .norm import SpectralNorm as _SN
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(list(w.shape), axis=dim, power_iters=n_power_iterations,
+             epsilon=eps)
+    layer._spectral_norm_mod = sn
+    layer.add_parameter(name + "_orig", w)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lay, inputs):
+        object.__setattr__(lay, name, sn(getattr(lay, name + "_orig")))
+        return inputs
+
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._set_data(arr[off:off + n].reshape(p._data.shape)
+                    .astype(p._data.dtype))
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style utility (ref nn/utils/clip_grad.py)."""
+    params = ([parameters] if isinstance(parameters, Tensor)
+              else list(parameters))
+    grads = [p.grad._data for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.abs(g).max() for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite gradient norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad._set_data(p.grad._data * scale)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = ([parameters] if isinstance(parameters, Tensor)
+              else list(parameters))
+    for p in params:
+        if p.grad is not None:
+            p.grad._set_data(jnp.clip(p.grad._data, -clip_value, clip_value))
+
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]

@@ -1,0 +1,84 @@
+"""paddle.utils.cpp_extension (ref utils/cpp_extension): build/load C++
+custom ops. The reference generates pybind bindings against libpaddle;
+here extensions build with setuptools against the CPython C API (the
+native toolchain g++/ninja is available; pybind11 is not) and register
+ops into the defop registry via PD_BUILD_OP-style entry points.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import tempfile
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup",
+           "get_build_directory"]
+
+
+def get_build_directory(verbose=False):
+    root = os.environ.get("PADDLE_EXTENSION_DIR",
+                          os.path.join(tempfile.gettempdir(),
+                                       "paddle_tpu_extensions"))
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def CppExtension(sources, *args, **kwargs):
+    """ref cpp_extension.CppExtension: returns a setuptools Extension
+    configured for the framework's include paths."""
+    from setuptools import Extension
+    import sysconfig
+    kwargs.setdefault("include_dirs", []).append(sysconfig.get_path("include"))
+    kwargs.setdefault("language", "c++")
+    extra = kwargs.setdefault("extra_compile_args", [])
+    if "-std=c++17" not in extra:
+        extra.append("-std=c++17")
+    name = kwargs.pop("name", "paddle_tpu_custom_op")
+    return Extension(name=name, sources=list(sources), *args, **kwargs)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension: no CUDA toolchain on the TPU build — custom device "
+        "kernels are Pallas (python) here; host-side ops use CppExtension")
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """ref cpp_extension.setup: drives setuptools build for the extension."""
+    from setuptools import setup as _setup
+    return _setup(name=name, ext_modules=ext_modules or [], **kwargs)
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose=False):
+    """JIT-compile a C++ source into a python extension and import it
+    (ref cpp_extension.load)."""
+    import sysconfig
+
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    # rebuild when any source is newer than the .so
+    if not os.path.exists(so_path) or any(
+            os.path.getmtime(s) > os.path.getmtime(so_path) for s in srcs):
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               "-I" + sysconfig.get_path("include")]
+        for inc in (extra_include_paths or []):
+            cmd.append("-I" + inc)
+        cmd += (extra_cxx_cflags or [])
+        cmd += srcs + ["-o", so_path] + (extra_ldflags or [])
+        if verbose:
+            print(" ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=not verbose, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension.load({name}): g++ failed "
+                f"(exit {proc.returncode})\n{proc.stderr or ''}")
+    spec = importlib.util.spec_from_file_location(name, so_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    sys.modules[name] = mod
+    return mod

@@ -51,3 +51,20 @@ def test_namespace_parity(ns):
     missing = [n for n in ref if not hasattr(mod, n)]
     assert not missing, (f"paddle.{ns or '<top>'} lost parity: "
                          f"{len(missing)} missing: {missing[:20]}")
+
+
+DEEP_NAMESPACES = [
+    "nn.utils", "nn.quant", "incubate.nn", "incubate.nn.functional",
+    "incubate.autograd", "distributed.fleet.utils", "utils.cpp_extension",
+    "amp.debugging",
+]
+
+
+@pytest.mark.parametrize("ns", DEEP_NAMESPACES)
+def test_deep_namespace_parity(ns):
+    ref = _ref_all(ns)
+    if ref is None:
+        pytest.skip(f"reference has no literal __all__ for {ns!r}")
+    mod = importlib.import_module("paddle_tpu." + ns)
+    missing = [n for n in ref if not hasattr(mod, n)]
+    assert not missing, f"paddle.{ns} missing: {missing}"

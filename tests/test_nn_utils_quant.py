@@ -1,0 +1,185 @@
+"""nn.utils / nn.quant / incubate.autograd / cpp_extension.
+
+Reference test model: test/legacy_test/test_weight_norm_hook.py,
+test_spectral_norm_op, test_clip_grad_*, test/quantization weight-only
+tests, test/autograd/test_autograd_functional_*.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _t(a, d="float32"):
+    return paddle.to_tensor(np.asarray(a, dtype=d))
+
+
+def _np(x):
+    return np.asarray(x._data)
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip(self):
+        lin = nn.Linear(4, 6)
+        w0 = _np(lin.weight).copy()
+        nn.utils.weight_norm(lin, dim=1)
+        x = _t(np.random.RandomState(0).randn(2, 4))
+        out_wn = _np(lin(x))
+        assert hasattr(lin, "weight_g") and hasattr(lin, "weight_v")
+        nn.utils.remove_weight_norm(lin)
+        np.testing.assert_allclose(_np(lin(x)), out_wn, atol=1e-5)
+        np.testing.assert_allclose(_np(lin.weight), w0, atol=1e-5)
+
+    def test_spectral_norm_util(self):
+        lin = nn.Linear(6, 10)
+        lin.weight._set_data(lin.weight._data * 5)
+        nn.utils.spectral_norm(lin, n_power_iterations=10)
+        lin(_t(np.random.randn(2, 6)))
+        sigma = np.linalg.svd(_np(lin.weight), compute_uv=False)[0]
+        assert abs(sigma - 1.0) < 0.1
+
+    def test_param_vector_roundtrip(self):
+        lin = nn.Linear(3, 5)
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        assert vec.shape[0] == 3 * 5 + 5
+        nn.utils.vector_to_parameters(vec * 2, lin.parameters())
+        np.testing.assert_allclose(
+            _np(nn.utils.parameters_to_vector(lin.parameters())),
+            _np(vec) * 2, atol=1e-6)
+
+    def test_clip_grad_utils(self):
+        lin = nn.Linear(4, 4)
+        loss = (lin(_t(np.ones((2, 4)))) ** 2).sum()
+        loss.backward()
+        total = nn.utils.clip_grad_norm_(lin.parameters(), max_norm=0.01)
+        new_norm = np.sqrt(sum(
+            (_np(p.grad) ** 2).sum() for p in lin.parameters()
+            if p.grad is not None))
+        assert new_norm <= 0.011
+        nn.utils.clip_grad_value_(lin.parameters(), 1e-4)
+        for p in lin.parameters():
+            if p.grad is not None:
+                assert np.abs(_np(p.grad)).max() <= 1e-4 + 1e-9
+
+
+class TestNNQuant:
+    def test_weight_quant_dequant(self):
+        from paddle_tpu.nn import quant as Q
+        w = _t(np.random.RandomState(0).randn(8, 16))
+        qw, scale = Q.weight_quantize(w)
+        assert _np(qw).dtype == np.int8
+        deq = Q.weight_dequantize(qw, scale, out_dtype="float32")
+        assert np.abs(_np(deq) - _np(w)).max() < 0.05
+
+    def test_weight_only_linear(self):
+        from paddle_tpu.nn import quant as Q
+        rng = np.random.RandomState(1)
+        w = _t(rng.randn(8, 16))
+        x = _t(rng.randn(3, 8))
+        qw, scale = Q.weight_quantize(w)
+        out = Q.weight_only_linear(x, qw, weight_scale=scale)
+        ref = _np(x) @ _np(w)
+        assert np.abs(_np(out) - ref).max() / np.abs(ref).max() < 0.05
+
+    def test_stub_identity(self):
+        from paddle_tpu.nn.quant import Stub
+        x = _t(np.random.randn(4))
+        np.testing.assert_allclose(_np(Stub()(x)), _np(x))
+
+
+class TestIncubateAutograd:
+    def test_vjp_jvp(self):
+        from paddle_tpu.incubate import autograd as IA
+        x = _t([1.0, 2.0])
+        f = lambda t: (t * t).sum()
+        _, g = IA.vjp(f, x)
+        np.testing.assert_allclose(_np(g), [2.0, 4.0])
+        _, tangent = IA.jvp(f, x, _t([1.0, 0.0]))
+        assert abs(float(_np(tangent)) - 2.0) < 1e-6
+
+    def test_jacobian_hessian_objects(self):
+        from paddle_tpu.incubate import autograd as IA
+        x = _t([1.0, 2.0])
+        J = IA.Jacobian(lambda t: t * 3, x)
+        np.testing.assert_allclose(_np(J[:]), 3 * np.eye(2), atol=1e-6)
+        H = IA.Hessian(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(_np(H[:]), 2 * np.eye(2), atol=1e-6)
+
+
+class TestCppExtension:
+    def test_jit_load(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension as CE
+        src = tmp_path / "mini_ext.cc"
+        src.write_text("""
+#include <Python.h>
+static PyObject* triple(PyObject* self, PyObject* args) {
+  long a; if (!PyArg_ParseTuple(args, "l", &a)) return NULL;
+  return PyLong_FromLong(3 * a);
+}
+static PyMethodDef M[] = {{"triple", triple, METH_VARARGS, ""},
+                          {NULL, NULL, 0, NULL}};
+static struct PyModuleDef mod = {PyModuleDef_HEAD_INIT, "mini_ext",
+                                 NULL, -1, M};
+PyMODINIT_FUNC PyInit_mini_ext(void) { return PyModule_Create(&mod); }
+""")
+        ext = CE.load("mini_ext", [str(src)],
+                      build_directory=str(tmp_path))
+        assert ext.triple(7) == 21
+
+
+class TestReviewRegressions2:
+    def test_weight_norm_trains(self):
+        lin = nn.Linear(4, 3)
+        nn.utils.weight_norm(lin, dim=1)
+        x = _t(np.random.RandomState(0).randn(2, 4))
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+
+    def test_weight_norm_dim_handling(self):
+        lin = nn.Linear(4, 6)
+        nn.utils.weight_norm(lin, dim=-2)
+        assert list(lin.weight_g.shape) == [4, 1]      # per-row
+        lin2 = nn.Linear(4, 6)
+        nn.utils.weight_norm(lin2, dim=None)
+        assert list(lin2.weight_g.shape) == [1, 1]     # whole-tensor norm
+
+    def test_spectral_norm_reads_live_weight(self):
+        lin = nn.Linear(4, 3)
+        nn.utils.spectral_norm(lin)
+        x = _t(np.random.RandomState(0).randn(2, 4))
+        o1 = _np(lin(x))
+        lin.weight_orig._set_data(lin.weight_orig._data * 0 + 1.0)
+        assert not np.allclose(o1, _np(lin(x)))
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        assert lin.weight_orig.grad is not None
+
+    def test_vjp_multi_output(self):
+        from paddle_tpu.incubate import autograd as IA
+        x = _t([1.0, 2.0])
+        outs, g = IA.vjp(lambda t: (t.sum(), (t * t).sum()), x)
+        assert len(outs) == 2
+        np.testing.assert_allclose(_np(g), [3.0, 5.0])
+
+    def test_localfs_mv_anticlobber(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        a = tmp_path / "a"
+        b = tmp_path / "b"
+        a.write_text("a")
+        b.write_text("b")
+        with pytest.raises(FileExistsError):
+            fs.mv(str(a), str(b))
+        fs.mv(str(a), str(b), overwrite=True)
+        assert b.read_text() == "a"
+
+    def test_cpp_extension_error_shows_stderr(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension as CE
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++;")
+        with pytest.raises(RuntimeError) as e:
+            CE.load("bad_ext", [str(bad)], build_directory=str(tmp_path))
+        assert "error" in str(e.value).lower()
