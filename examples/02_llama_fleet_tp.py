@@ -1,0 +1,46 @@
+"""Llama with hybrid parallelism (fleet TP + DP) on an 8-device mesh.
+
+Run on CPU mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/02_llama_fleet_tp.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn, optimizer
+from paddle_tpu.distributed import fleet, shard_optimizer
+from paddle_tpu.distributed.auto_parallel import (ProcessMesh, Replicate,
+                                                  Shard, shard_tensor)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config, shard_llama
+
+
+def main():
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            vocab_size=256, max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["dp", "fsdp", "mp"])
+    shard_llama(model, mesh, mp_axis="mp", fsdp_axis="fsdp")
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters(),
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    shard_optimizer(opt, mesh)  # ZeRO: optimizer states sharded
+
+    step = jit.TrainStep(lambda ids, labels: model(ids, labels=labels)[1],
+                         opt)
+
+    rng = np.random.RandomState(0)
+    place = [Shard(0), Replicate(), Replicate()]   # batch over dp
+    for i in range(3):
+        ids = shard_tensor(paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (4, 16))), mesh, place)
+        labels = shard_tensor(paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (4, 16))), mesh, place)
+        loss = step(ids, labels)
+        print(f"step {i}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

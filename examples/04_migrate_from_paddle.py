@@ -1,0 +1,46 @@
+"""Migration cheat-sheet: familiar paddle code runs with the import swapped.
+
+Run: python examples/04_migrate_from_paddle.py
+"""
+import numpy as np
+
+# was: import paddle
+import paddle_tpu as paddle
+
+# --- tensors + the long-tail op surface works as in the reference
+x = paddle.to_tensor(np.linspace(-2, 2, 12).astype("float32"))
+print("sgn:", paddle.sgn(x).numpy()[:3])
+print("logcumsumexp:", paddle.logcumsumexp(x).shape)
+print("iinfo int8 max:", paddle.iinfo(paddle.int8).max)
+
+# --- inplace variants
+y = paddle.to_tensor(np.array([1.0, 4.0, 9.0], dtype="float32"))
+paddle.sqrt_(y)
+print("sqrt_:", y.numpy())
+
+# --- dynamic-to-static with graph breaks (SOT segments compile around them)
+@paddle.jit.to_static(full_graph=False)
+def branchy(t):
+    s = t * 2
+    if float(s.sum()) > 0:        # graph break: guards + compiled segments
+        return s + 1
+    return s - 1
+
+t = paddle.to_tensor(np.ones(4, dtype="float32"))
+for _ in range(3):
+    branchy(t)
+print("branchy:", branchy(t).numpy())
+
+# --- autograd utilities
+x2 = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+x2.stop_gradient = False
+out = (x2 * x2).sum() + x2[0] * x2[1]
+print("jacobian:", paddle.autograd.jacobian(out, x2).numpy())
+
+# --- distributions
+d = paddle.distribution.Normal(0.0, 1.0)
+print("normal sample:", d.sample([2]).shape)
+
+
+if __name__ == "__main__":
+    pass

@@ -263,9 +263,16 @@ def check_layer_numerics(func):
 
         from ..core.tensor import Tensor
 
+        import jax
+
         def _check(tag, xs):
             for x in xs:
                 if isinstance(x, Tensor):
+                    if isinstance(x._data, jax.core.Tracer):
+                        # under jit tracing a host transfer would raise;
+                        # compiled-path NaN checking is the dispatch-level
+                        # FLAGS_check_nan_inf hook's job
+                        continue
                     arr = np.asarray(x._data)
                     if not np.isfinite(arr).all():
                         raise RuntimeError(

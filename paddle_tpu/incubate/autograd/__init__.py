@@ -45,7 +45,13 @@ def vjp(func, xs, v=None):
         vs = v if isinstance(v, (list, tuple)) else [v]
         cts = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
                for t in vs]
-        ct = tuple(cts) if isinstance(out, (list, tuple)) else cts[0]
+        if isinstance(out, (list, tuple)):
+            # cotangent pytree must match the primal structure EXACTLY
+            # (list vs tuple matters to jax.vjp)
+            treedef = jax.tree_util.tree_structure(out)
+            ct = jax.tree_util.tree_unflatten(treedef, cts)
+        else:
+            ct = cts[0]
     grads = pull(ct)
     grads_t = [Tensor(g) for g in grads]
     return _pack_out(out), grads_t if len(grads_t) > 1 else grads_t[0]
@@ -89,14 +95,25 @@ class Jacobian:
 
 class Hessian:
     def __init__(self, func, xs, is_batched=False):
+        import numpy as np
         xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
         arrays = [x._data for x in xs_list]
         wrapped = _wrap_fn(func)
+        sizes = [int(np.prod(a.shape)) for a in arrays]
 
-        def scalar_fn(*arrs):
-            return wrapped(*arrs).reshape(())
+        # full Hessian over ALL inputs: differentiate through one
+        # concatenated vector (jax.hessian's default argnums=0 would give
+        # only the first input's diagonal block)
+        def vec_fn(vec):
+            parts = []
+            off = 0
+            for a, n in zip(arrays, sizes):
+                parts.append(vec[off:off + n].reshape(a.shape))
+                off += n
+            return wrapped(*parts).reshape(())
 
-        hes = jax.hessian(scalar_fn)(*arrays)
+        flat = jnp.concatenate([a.reshape(-1) for a in arrays])
+        hes = jax.hessian(vec_fn)(flat)
         self._h = Tensor(jnp.asarray(hes))
 
     def __getitem__(self, idx):

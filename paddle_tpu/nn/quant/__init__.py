@@ -46,8 +46,6 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
-    from ...core import dtype as dtype_mod
-
     def _impl(q, s):
         return q.astype(jnp.float32) * s
 

@@ -94,7 +94,10 @@ def spectral_norm(layer: Layer, name="weight", n_power_iterations=1,
     from .norm import SpectralNorm as _SN
     w = getattr(layer, name)
     if dim is None:
-        dim = 0
+        # reference rule (spectral_norm_hook.py): Linear and transposed
+        # convs keep their OUTPUT channels on dim 1, so matricize there
+        cls_name = type(layer).__name__
+        dim = 1 if ("Linear" in cls_name or "Transpose" in cls_name) else 0
     sn = _SN(list(w.shape), axis=dim, power_iters=n_power_iterations,
              epsilon=eps)
     layer._spectral_norm_mod = sn

@@ -17,10 +17,12 @@ __all__ = ["CppExtension", "CUDAExtension", "load", "setup",
 
 
 def get_build_directory(verbose=False):
-    root = os.environ.get("PADDLE_EXTENSION_DIR",
-                          os.path.join(tempfile.gettempdir(),
-                                       "paddle_tpu_extensions"))
-    os.makedirs(root, exist_ok=True)
+    # per-user cache dir (mode 0700): a shared world-writable path would
+    # let another user pre-plant a .so that load() then imports
+    default = os.path.join(tempfile.gettempdir(),
+                           f"paddle_tpu_extensions_{os.getuid()}")
+    root = os.environ.get("PADDLE_EXTENSION_DIR", default)
+    os.makedirs(root, mode=0o700, exist_ok=True)
     return root
 
 

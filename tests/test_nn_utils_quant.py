@@ -183,3 +183,33 @@ class TestReviewRegressions2:
         with pytest.raises(RuntimeError) as e:
             CE.load("bad_ext", [str(bad)], build_directory=str(tmp_path))
         assert "error" in str(e.value).lower()
+
+
+class TestReviewRegressions3:
+    def test_hessian_multi_input_full_matrix(self):
+        from paddle_tpu.incubate import autograd as IA
+        x = _t([1.0, 2.0])
+        y = _t([3.0])
+        H = IA.Hessian(lambda a, b: (a * a).sum() + (b * b * b).sum(),
+                       [x, y])
+        assert H.shape == [3, 3]
+        ref = np.diag([2.0, 2.0, 6.0 * 3.0])
+        np.testing.assert_allclose(_np(H[:]), ref, atol=1e-5)
+
+    def test_vjp_list_output_with_v(self):
+        from paddle_tpu.incubate import autograd as IA
+        x = _t([1.0, 2.0])
+        outs, g = IA.vjp(lambda t: [t.sum(), (t * t).sum()], x,
+                         v=[_t(1.0), _t(1.0)])
+        np.testing.assert_allclose(_np(g), [3.0, 5.0])
+
+    def test_build_dir_is_per_user(self):
+        import os
+        from paddle_tpu.utils import cpp_extension as CE
+        d = CE.get_build_directory()
+        assert str(os.getuid()) in d or "PADDLE_EXTENSION_DIR" in os.environ
+
+    def test_spectral_norm_dim_default_linear(self):
+        lin = nn.Linear(4, 6)
+        nn.utils.spectral_norm(lin)   # Linear -> dim 1 (output channels)
+        assert lin._spectral_norm_mod.axis == 1
