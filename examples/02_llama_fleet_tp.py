@@ -8,7 +8,7 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu import jit, nn, optimizer
-from paddle_tpu.distributed import fleet, shard_optimizer
+from paddle_tpu.distributed import shard_optimizer
 from paddle_tpu.distributed.auto_parallel import (ProcessMesh, Replicate,
                                                   Shard, shard_tensor)
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config, shard_llama

@@ -20,6 +20,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -54,6 +55,11 @@ class LlamaConfig:
     # backward instead of keeping its residuals (fleet/recompute analog —
     # trades ~30% step FLOPs for O(layers) less activation HBM)
     use_recompute: bool = False
+    # scan_layers: run the decoder stack as ONE lax.scan over stacked
+    # [L, ...] weights — the layer body is traced/compiled once, so XLA
+    # compile time is O(1) in depth instead of O(L). The canonical TPU
+    # pattern for deep stacks; numerics identical to the unrolled loop.
+    scan_layers: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -199,6 +205,122 @@ class LlamaDecoderLayer(Layer):
         return residual + hidden
 
 
+class ScannedLlamaLayers(Layer):
+    """The whole decoder stack as ONE ``lax.scan``.
+
+    Parameters are stacked [L, ...] arrays; the scan body (rmsnorm → GQA
+    attention with RoPE → rmsnorm → SwiGLU) is traced exactly once, so XLA
+    compile time stops growing with depth. ``remat`` re-runs each layer in
+    the backward (jax.checkpoint inside scan = the recompute analog with
+    O(1) compile). Flash attention (Pallas) slots into the body when
+    eligible. Numerics match the unrolled LlamaDecoderLayer stack.
+    """
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        if config.sep_mesh is not None:
+            raise ValueError(
+                "scan_layers does not implement ring (context-parallel) "
+                "attention yet — use the unrolled stack for sep_mesh")
+        self.config = config
+        L = config.num_hidden_layers
+        hs = config.hidden_size
+        h, kv, d = (config.num_attention_heads, config.num_key_value_heads,
+                    config.head_dim)
+        ims = config.intermediate_size
+        init = I.Normal(std=config.initializer_range)
+        ones = I.Constant(1.0)
+
+        def p(shape, initializer=init):
+            return self.create_parameter(shape,
+                                         default_initializer=initializer)
+
+        self.q_w = p([L, hs, h * d])
+        self.k_w = p([L, hs, kv * d])
+        self.v_w = p([L, hs, kv * d])
+        self.o_w = p([L, h * d, hs])
+        self.gate_w = p([L, hs, ims])
+        self.up_w = p([L, hs, ims])
+        self.down_w = p([L, ims, hs])
+        self.ln1_w = p([L, hs], ones)
+        self.ln2_w = p([L, hs], ones)
+
+    def forward(self, hidden, cos, sin, attn_mask=None):
+        from ..core.flags import get_flag
+        from ..ops import pallas as _pl
+        from ..ops.registry import dispatch
+        cfg = self.config
+        h, kv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                    cfg.head_dim)
+        eps = cfg.rms_norm_eps
+        seq = int(hidden.shape[1])
+        use_flash = (attn_mask is None and _pl.on_tpu()
+                     and get_flag("FLAGS_use_pallas_attention"))
+        if use_flash:
+            from ..ops.pallas.flash_attention import supported
+            use_flash = supported(seq, d)
+        remat = cfg.use_recompute and self.training
+
+        def _impl(hidden, cos, sin, mask, qw, kw, vw, ow, gw, uw, dw,
+                  ln1, ln2):
+            def rms(x, w):
+                xf = x.astype(jnp.float32)
+                r = jax.lax.rsqrt(
+                    jnp.mean(xf * xf, -1, keepdims=True) + eps)
+                return (xf * r * w.astype(jnp.float32)).astype(x.dtype)
+
+            def rope(x):
+                # same pure-jnp RoPE as the unrolled path — ONE definition
+                return apply_rotary_pos_emb(x, cos, sin)
+
+            def body_fn(h_, per_layer):
+                qw_, kw_, vw_, ow_, gw_, uw_, dw_, l1, l2 = per_layer
+                b, s, _ = h_.shape
+                x = rms(h_, l1)
+                q = rope((x @ qw_).reshape(b, s, h, d))
+                k = rope((x @ kw_).reshape(b, s, kv, d))
+                v = (x @ vw_).reshape(b, s, kv, d)
+                if kv != h:
+                    rep = h // kv
+                    k = jnp.broadcast_to(k[:, :, :, None],
+                                         (b, s, kv, rep, d)
+                                         ).reshape(b, s, h, d)
+                    v = jnp.broadcast_to(v[:, :, :, None],
+                                         (b, s, kv, rep, d)
+                                         ).reshape(b, s, h, d)
+                if use_flash:
+                    from ..ops.pallas.flash_attention import \
+                        flash_attention_pallas
+                    ctx = flash_attention_pallas(q, k, v, causal=True)
+                else:
+                    scale = 1.0 / (d ** 0.5)
+                    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+                    if mask is not None:
+                        scores = scores + mask
+                    else:
+                        causal = jnp.tril(jnp.ones((s, s), bool))
+                        scores = jnp.where(causal[None, None], scores, -1e9)
+                    probs = jax.nn.softmax(
+                        scores.astype(jnp.float32), -1).astype(h_.dtype)
+                    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+                h1 = h_ + ctx.reshape(b, s, h * d) @ ow_
+                x2 = rms(h1, l2)
+                mlp = (jax.nn.silu(x2 @ gw_) * (x2 @ uw_)) @ dw_
+                return h1 + mlp, None
+
+            body = jax.checkpoint(body_fn) if remat else body_fn
+            out, _ = jax.lax.scan(
+                body, hidden, (qw, kw, vw, ow, gw, uw, dw, ln1, ln2))
+            return out
+
+        return dispatch(
+            _impl,
+            (hidden, Tensor(cos), Tensor(sin), attn_mask, self.q_w,
+             self.k_w, self.v_w, self.o_w, self.gate_w, self.up_w,
+             self.down_w, self.ln1_w, self.ln2_w),
+            {}, op_name="llama_scanned_layers")
+
+
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
@@ -206,10 +328,14 @@ class LlamaModel(Layer):
         self.embed_tokens = Embedding(
             config.vocab_size, config.hidden_size,
             weight_attr=I.Normal(std=config.initializer_range))
-        self.layers = [LlamaDecoderLayer(config)
-                       for _ in range(config.num_hidden_layers)]
-        for i, l in enumerate(self.layers):
-            self.add_sublayer(f"layers.{i}", l)
+        if config.scan_layers:
+            self.layers_scanned = ScannedLlamaLayers(config)
+            self.layers = []
+        else:
+            self.layers = [LlamaDecoderLayer(config)
+                           for _ in range(config.num_hidden_layers)]
+            for i, l in enumerate(self.layers):
+                self.add_sublayer(f"layers.{i}", l)
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         jdt = dtype_mod.to_jax_dtype(config.dtype)
         self._cos, self._sin = _rope_cos_sin(
@@ -220,7 +346,10 @@ class LlamaModel(Layer):
         _, s = input_ids.shape
         hidden = self.embed_tokens(input_ids)
         cos, sin = self._cos[:s], self._sin[:s]
-        if self.config.use_recompute and self.training:
+        if self.config.scan_layers:
+            # one scan op: recompute (jax.checkpoint) handled inside
+            hidden = self.layers_scanned(hidden, cos, sin, attn_mask)
+        elif self.config.use_recompute and self.training:
             from ..distributed.fleet.recompute import recompute
             for layer in self.layers:
                 trainable = any(not p.stop_gradient
@@ -297,15 +426,26 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
         shard_tensor(param, mesh, placements)
 
     place(model.model.embed_tokens.weight, mp_dim=0, fsdp_dim=1)
-    for layer in model.model.layers:
-        attn, mlp = layer.self_attn, layer.mlp
-        for col in (attn.q_proj, attn.k_proj, attn.v_proj,
-                    mlp.gate_proj, mlp.up_proj):
-            place(col.weight, mp_dim=1, fsdp_dim=0)
-        for row in (attn.o_proj, mlp.down_proj):
-            place(row.weight, mp_dim=0, fsdp_dim=1)
-        place(layer.input_layernorm.weight)
-        place(layer.post_attention_layernorm.weight)
+    if model.config.scan_layers:
+        # stacked [L, in, out] weights: the layer dim leads, so the 2D
+        # placements shift by one (same TP plan, scan-compatible)
+        sc = model.model.layers_scanned
+        for col in (sc.q_w, sc.k_w, sc.v_w, sc.gate_w, sc.up_w):
+            place(col, mp_dim=2, fsdp_dim=1)
+        for row in (sc.o_w, sc.down_w):
+            place(row, mp_dim=1, fsdp_dim=2)
+        place(sc.ln1_w)
+        place(sc.ln2_w)
+    else:
+        for layer in model.model.layers:
+            attn, mlp = layer.self_attn, layer.mlp
+            for col in (attn.q_proj, attn.k_proj, attn.v_proj,
+                        mlp.gate_proj, mlp.up_proj):
+                place(col.weight, mp_dim=1, fsdp_dim=0)
+            for row in (attn.o_proj, mlp.down_proj):
+                place(row.weight, mp_dim=0, fsdp_dim=1)
+            place(layer.input_layernorm.weight)
+            place(layer.post_attention_layernorm.weight)
     place(model.model.norm.weight)
     if model.lm_head is not None:
         place(model.lm_head.weight, mp_dim=1, fsdp_dim=0)

@@ -96,8 +96,12 @@ def spectral_norm(layer: Layer, name="weight", n_power_iterations=1,
     if dim is None:
         # reference rule (spectral_norm_hook.py): Linear and transposed
         # convs keep their OUTPUT channels on dim 1, so matricize there
-        cls_name = type(layer).__name__
-        dim = 1 if ("Linear" in cls_name or "Transpose" in cls_name) else 0
+        # (isinstance, not name matching — nn.Bilinear must NOT match)
+        from .common import Linear as _Linear
+        from .conv import _ConvNd as _Conv
+        is_transpose_conv = isinstance(layer, _Conv) and \
+            "Transpose" in type(layer).__name__
+        dim = 1 if (type(layer) is _Linear or is_transpose_conv) else 0
     sn = _SN(list(w.shape), axis=dim, power_iters=n_power_iterations,
              epsilon=eps)
     layer._spectral_norm_mod = sn

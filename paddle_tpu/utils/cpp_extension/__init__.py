@@ -23,6 +23,14 @@ def get_build_directory(verbose=False):
                            f"paddle_tpu_extensions_{os.getuid()}")
     root = os.environ.get("PADDLE_EXTENSION_DIR", default)
     os.makedirs(root, mode=0o700, exist_ok=True)
+    # makedirs ignores mode for a pre-existing dir: verify nobody else owns
+    # or can write the cache (the pre-planted-.so attack)
+    st = os.stat(root)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        raise RuntimeError(
+            f"extension cache {root} is not exclusively owned by this user "
+            f"(uid {st.st_uid}, mode {oct(st.st_mode & 0o777)}); remove it "
+            f"or point PADDLE_EXTENSION_DIR at a private directory")
     return root
 
 

@@ -129,3 +129,76 @@ def test_llama_recompute_matches_baseline_trajectory():
     base = run(False)
     rc = run(True)
     assert all(abs(a - b) < 2e-3 for a, b in zip(base, rc)), (base, rc)
+
+
+class TestScanLayers:
+    """ScannedLlamaLayers: one lax.scan over stacked weights — numerics
+    must match the unrolled stack exactly (compile-time optimization only)."""
+
+    def _copy_unrolled_to_scanned(self, m_u, m_s):
+        import jax.numpy as jnp
+        sc = m_s.model.layers_scanned
+
+        def stack(getter):
+            return jnp.stack([getter(l)._data for l in m_u.model.layers])
+
+        sc.q_w._set_data(stack(lambda l: l.self_attn.q_proj.weight))
+        sc.k_w._set_data(stack(lambda l: l.self_attn.k_proj.weight))
+        sc.v_w._set_data(stack(lambda l: l.self_attn.v_proj.weight))
+        sc.o_w._set_data(stack(lambda l: l.self_attn.o_proj.weight))
+        sc.gate_w._set_data(stack(lambda l: l.mlp.gate_proj.weight))
+        sc.up_w._set_data(stack(lambda l: l.mlp.up_proj.weight))
+        sc.down_w._set_data(stack(lambda l: l.mlp.down_proj.weight))
+        sc.ln1_w._set_data(stack(lambda l: l.input_layernorm.weight))
+        sc.ln2_w._set_data(stack(lambda l: l.post_attention_layernorm.weight))
+        m_s.model.embed_tokens.weight._set_data(
+            m_u.model.embed_tokens.weight._data)
+        m_s.model.norm.weight._set_data(m_u.model.norm.weight._data)
+        if m_s.lm_head is not None:
+            m_s.lm_head.weight._set_data(m_u.lm_head.weight._data)
+
+    def test_matches_unrolled(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        paddle.seed(0)
+        m_u = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=3))
+        m_s = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=3,
+                                                 scan_layers=True))
+        self._copy_unrolled_to_scanned(m_u, m_s)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (2, 16)))
+        m_u.eval()
+        m_s.eval()
+        with paddle.no_grad():
+            out_u = np.asarray(m_u(ids)._data)
+            out_s = np.asarray(m_s(ids)._data)
+        np.testing.assert_allclose(out_u, out_s, atol=1e-4)
+
+    def test_trains_and_param_count_matches(self):
+        from paddle_tpu import jit, optimizer
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        paddle.seed(0)
+        m_u = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=2))
+        m_s = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=2,
+                                                 scan_layers=True))
+        assert m_u.num_params() == m_s.num_params()
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=m_s.parameters())
+        step = jit.TrainStep(lambda i, l: m_s(i, labels=l)[1], opt)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 128, (2, 16)))
+        labels = paddle.to_tensor(rng.randint(0, 128, (2, 16)))
+        losses = [float(step(ids, labels)._data) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_remat_inside_scan(self):
+        from paddle_tpu import jit, optimizer
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        m = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=2,
+                                               scan_layers=True,
+                                               use_recompute=True))
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        step = jit.TrainStep(lambda i, l: m(i, labels=l)[1], opt)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 128, (2, 16)))
+        labels = paddle.to_tensor(rng.randint(0, 128, (2, 16)))
+        assert np.isfinite(float(step(ids, labels)._data))
