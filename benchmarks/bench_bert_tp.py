@@ -1,0 +1,93 @@
+"""BASELINE.md config 4: BERT-large with auto-parallel TP over a mesh.
+
+On real hardware: v5e-16 mesh. Offline validation: 8 virtual CPU devices
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python benchmarks/bench_bert_tp.py
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer
+    from paddle_tpu.distributed.auto_parallel import (ProcessMesh, Replicate,
+                                                      Shard, shard_tensor)
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    devs = jax.devices()
+    n = len(devs)
+    on_tpu = devs[0].platform == "tpu"
+    if on_tpu and n >= 4:
+        cfg = BertConfig(vocab_size=30522, hidden_size=1024,
+                         num_hidden_layers=24, num_attention_heads=16,
+                         intermediate_size=4096)
+        batch, seq, iters = 16, 512, 10
+    else:
+        cfg = BertConfig(vocab_size=256, hidden_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=128,
+                         max_position_embeddings=128)
+        batch, seq, iters = 4, 32, 2
+
+    mp = 2 if n % 2 == 0 else 1
+    dp = n // mp
+    mesh = ProcessMesh(np.arange(n).reshape(dp, mp), dim_names=["dp", "mp"])
+
+    paddle.seed(0)
+    model = BertForMaskedLM(cfg)
+    # TP plan: shard attention/FFN projections over mp
+    from paddle_tpu.distributed.auto_parallel import get_placements
+    for name, p in model.named_parameters():
+        if p.ndim == 2 and ("intermediate" in name or "query" in name
+                            or "key" in name or "value" in name):
+            shard_tensor(p, mesh, [Replicate(), Shard(1)])
+        elif p.ndim == 2 and "output" in name and "attention" not in name:
+            shard_tensor(p, mesh, [Replicate(), Shard(0)])
+        else:
+            shard_tensor(p, mesh, [Replicate(), Replicate()])
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+
+    def loss_fn(ids, mlm_labels):
+        out = model(ids, labels=mlm_labels)
+        return out[-1] if isinstance(out, (list, tuple)) else out
+
+    step = jit.TrainStep(loss_fn, opt)
+    rng = np.random.RandomState(0)
+    place = [Shard(0), Replicate()]
+    ids = shard_tensor(paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq))), mesh, place)
+    labels = shard_tensor(paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq))), mesh, place)
+    step(ids, labels)
+    float(step(ids, labels))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bert_tp_train_tokens_per_sec",
+        "value": round(batch * seq * iters / dt, 2),
+        "unit": "tokens/s",
+        "detail": {"mesh": [dp, mp], "batch": batch, "seq": seq,
+                   "final_loss": round(final, 4),
+                   "device": devs[0].platform},
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        print(json.dumps({"metric": "bert_tp_train_tokens_per_sec",
+                          "value": 0.0, "unit": "tokens/s",
+                          "detail": {"error": str(e)[:200]}}))
+        sys.exit(0)

@@ -1,0 +1,68 @@
+"""BASELINE.md config 2: GPT-2 124M through to_static + AMP bf16.
+
+Exercises the compiled path (capture -> one XLA executable).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit, optimizer
+    from paddle_tpu.models import GPT2Config, GPT2ForCausalLM
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:  # GPT-2 124M
+        cfg = GPT2Config(vocab_size=50257, hidden_size=768,
+                         num_hidden_layers=12, num_attention_heads=12,
+                         max_position_embeddings=1024)
+        batch, seq, iters = 8, 512, 10
+    else:
+        cfg = GPT2Config(vocab_size=256, hidden_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         max_position_embeddings=128)
+        batch, seq, iters = 2, 64, 2
+
+    paddle.seed(0)
+    model = GPT2ForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=True)
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    step = jit.TrainStep(lambda i, l: model(i, labels=l)[1], opt)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    step(ids, labels)
+    float(step(ids, labels))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "gpt2_train_tokens_per_sec",
+        "value": round(batch * seq * iters / dt, 2),
+        "unit": "tokens/s",
+        "detail": {"params": model.num_params(), "batch": batch, "seq": seq,
+                   "final_loss": round(final, 4),
+                   "device": jax.devices()[0].platform,
+                   "amp": "O2 bf16"},
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        print(json.dumps({"metric": "gpt2_train_tokens_per_sec",
+                          "value": 0.0, "unit": "tokens/s",
+                          "detail": {"error": str(e)[:200]}}))
+        sys.exit(0)

@@ -1,0 +1,66 @@
+"""BASELINE.md config 1: ResNet-50, single-device dygraph train throughput.
+
+Prints one JSON line {metric, value, unit, detail}. CPU runs a tiny proxy;
+TPU runs the real config.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit, nn, optimizer
+    from paddle_tpu.models import resnet50
+    from paddle_tpu.vision.models import resnet18
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        model = resnet50()
+        batch, size, iters = 64, 224, 10
+    else:
+        model = resnet18(num_classes=10)
+        batch, size, iters = 4, 64, 2
+
+    paddle.seed(0)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    loss_fn = nn.CrossEntropyLoss()
+    # the auto_cast context casts the image input per-op (conv white list)
+    step = jit.TrainStep(lambda x, y: loss_fn(model(x), y), opt,
+                         amp=dict(level="O2", dtype="bfloat16"))
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype("int64"))
+    step(x, y)           # eager discovery
+    float(step(x, y))    # compile + warm
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "resnet_train_images_per_sec",
+        "value": round(batch * iters / dt, 2),
+        "unit": "images/s",
+        "detail": {"batch": batch, "size": size, "iters": iters,
+                   "final_loss": round(final, 4),
+                   "device": jax.devices()[0].platform},
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        print(json.dumps({"metric": "resnet_train_images_per_sec",
+                          "value": 0.0, "unit": "images/s",
+                          "detail": {"error": str(e)[:200]}}))
+        sys.exit(0)

@@ -23,6 +23,9 @@ WHITE_LIST = {
     "matmul", "mm", "bmm", "einsum", "conv1d", "conv2d", "conv3d",
     "conv2d_transpose", "linear", "addmm", "scaled_dot_product_attention",
     "flash_attention",
+    # matmul-dominated fused blocks (fp32-sensitive pieces inside them —
+    # rmsnorm reductions, softmax — already accumulate in fp32)
+    "llama_scanned_layers",
 }
 BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",

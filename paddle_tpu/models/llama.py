@@ -296,7 +296,13 @@ class ScannedLlamaLayers(Layer):
                     scale = 1.0 / (d ** 0.5)
                     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
                     if mask is not None:
-                        scores = scores + mask
+                        if mask.dtype == jnp.bool_:
+                            # keep/drop mask, matching _sdpa_op semantics
+                            scores = jnp.where(
+                                mask, scores,
+                                jnp.finfo(jnp.float32).min)
+                        else:
+                            scores = scores + mask
                     else:
                         causal = jnp.tril(jnp.ones((s, s), bool))
                         scores = jnp.where(causal[None, None], scores, -1e9)

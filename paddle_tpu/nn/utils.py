@@ -94,14 +94,16 @@ def spectral_norm(layer: Layer, name="weight", n_power_iterations=1,
     from .norm import SpectralNorm as _SN
     w = getattr(layer, name)
     if dim is None:
-        # reference rule (spectral_norm_hook.py): Linear and transposed
-        # convs keep their OUTPUT channels on dim 1, so matricize there
-        # (isinstance, not name matching — nn.Bilinear must NOT match)
-        from .common import Linear as _Linear
+        # reference rule (spectral_norm_hook.py): Linear-like layers and
+        # transposed convs keep their OUTPUT channels on dim 1, so
+        # matricize there. "Linear-like" = class named *Linear with a 2D
+        # [in, out] weight — covers Linear subclasses and the fleet
+        # Column/RowParallelLinear, excludes nn.Bilinear (3D weight).
         from .conv import _ConvNd as _Conv
-        is_transpose_conv = isinstance(layer, _Conv) and \
-            "Transpose" in type(layer).__name__
-        dim = 1 if (type(layer) is _Linear or is_transpose_conv) else 0
+        cls = type(layer).__name__
+        is_linear_like = cls.endswith("Linear") and w.ndim == 2
+        is_transpose_conv = isinstance(layer, _Conv) and "Transpose" in cls
+        dim = 1 if (is_linear_like or is_transpose_conv) else 0
     sn = _SN(list(w.shape), axis=dim, power_iters=n_power_iterations,
              epsilon=eps)
     layer._spectral_norm_mod = sn
