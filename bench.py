@@ -43,7 +43,7 @@ def _progress(msg):
 _T0 = time.perf_counter()
 
 
-def main():
+def main(scan_layers=True):
     import jax
     import paddle_tpu as paddle
     from paddle_tpu import amp, jit, optimizer
@@ -60,13 +60,15 @@ def main():
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=24,
                           num_attention_heads=16, num_key_value_heads=16,
-                          max_position_embeddings=1024, scan_layers=True)
+                          max_position_embeddings=1024,
+                          scan_layers=scan_layers)
         batch, seq, iters = 4, 1024, 20
     else:  # CPU smoke (driver sanity / local dev)
         cfg = LlamaConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=176, num_hidden_layers=2,
                           num_attention_heads=4, num_key_value_heads=4,
-                          max_position_embeddings=128, scan_layers=True)
+                          max_position_embeddings=128,
+                          scan_layers=scan_layers)
         batch, seq, iters = 2, 64, 3
 
     paddle.seed(0)
@@ -135,7 +137,27 @@ def main():
 
 if __name__ == "__main__":
     try:
-        main()
+        try:
+            main(scan_layers=True)
+        except Exception:
+            # self-heal chain: scanned stack -> unrolled stack -> unrolled
+            # with the Pallas kernel tier disabled (pure XLA). Same metric
+            # either way; only compile time / kernel choice differ.
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            try:
+                _progress("scan_layers path failed; retrying unrolled")
+                main(scan_layers=False)
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+                _progress("retrying with Pallas kernels disabled")
+                import paddle_tpu
+                paddle_tpu.set_flags({
+                    "FLAGS_use_pallas_attention": False,
+                    "FLAGS_use_pallas_rmsnorm": False,
+                    "FLAGS_use_pallas_adamw": False,
+                })
+                main(scan_layers=False)
     except Exception as e:  # still emit the one JSON line the driver records
         import traceback
         traceback.print_exc(file=sys.stderr)

@@ -41,16 +41,10 @@ def main():
 
     paddle.seed(0)
     model = BertForMaskedLM(cfg)
-    # TP plan: shard attention/FFN projections over mp
-    from paddle_tpu.distributed.auto_parallel import get_placements
-    for name, p in model.named_parameters():
-        if p.ndim == 2 and ("intermediate" in name or "query" in name
-                            or "key" in name or "value" in name):
-            shard_tensor(p, mesh, [Replicate(), Shard(1)])
-        elif p.ndim == 2 and "output" in name and "attention" not in name:
-            shard_tensor(p, mesh, [Replicate(), Shard(0)])
-        else:
-            shard_tensor(p, mesh, [Replicate(), Replicate()])
+    # the model zoo's Megatron plan: qkv/intermediate column-parallel,
+    # attention-out/output row-parallel over the mp axis
+    from paddle_tpu.models import shard_bert
+    shard_bert(model, mesh, mp_axis="mp")
     opt = optimizer.AdamW(learning_rate=1e-4,
                           parameters=model.parameters())
 
