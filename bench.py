@@ -1,22 +1,42 @@
 """Benchmark: Llama training tokens/sec/chip (BASELINE.md north-star metric).
 
-Runs the full compiled training step (forward + backward + AdamW in one XLA
-executable, bf16 AMP O2 with fp32 master weights) on the available chip and
-prints ONE JSON line:
+Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 vs_baseline is measured MFU / 0.50 — the north-star bar is ">50% of H100
 tokens/sec/chip", which at matched parallelism is an efficiency bar: 1.0 means
 the model FLOPs utilization on this chip reaches 50%.
+
+Structure (wedge-proof): the parent process NEVER imports jax. It
+  1. probes TPU health in a timeout-bounded subprocess (a wedged axon relay
+     hangs `jax.devices()` indefinitely — observed all of round 1);
+  2. if healthy, runs the real bench in a child (`--inproc`) with a
+     self-imposed timeout under the driver's budget, SIGTERM-first so the
+     axon claim is released cleanly;
+  3. on probe failure / child timeout, runs a CPU-proxy child with the axon
+     sitecustomize stripped from PYTHONPATH (immune to the wedge) so the
+     driver ALWAYS records a parsed line — tagged "tpu": false.
 """
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
-import numpy as np
+_T0 = time.perf_counter()
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+PROBE_TIMEOUT = int(os.environ.get("GRAFT_BENCH_PROBE_TIMEOUT", "150"))
+TPU_TIMEOUT = int(os.environ.get("GRAFT_BENCH_TPU_TIMEOUT", "1080"))
+CPU_TIMEOUT = int(os.environ.get("GRAFT_BENCH_CPU_TIMEOUT", "240"))
+
+
+def _progress(msg):
+    print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
 def _peak_bf16_flops(device) -> float:
@@ -35,15 +55,13 @@ def _peak_bf16_flops(device) -> float:
     return 197e12  # default to v5e-class
 
 
-def _progress(msg):
-    print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}",
-          file=sys.stderr, flush=True)
-
-
-_T0 = time.perf_counter()
-
+# ---------------------------------------------------------------------------
+# In-process bench body (runs in a child)
+# ---------------------------------------------------------------------------
 
 def main(scan_layers=True):
+    import numpy as np
+
     import jax
     import paddle_tpu as paddle
     from paddle_tpu import amp, jit, optimizer
@@ -63,7 +81,7 @@ def main(scan_layers=True):
                           max_position_embeddings=1024,
                           scan_layers=scan_layers)
         batch, seq, iters = 4, 1024, 20
-    else:  # CPU smoke (driver sanity / local dev)
+    else:  # CPU proxy (relay down / local dev) — same code path, tiny shape
         cfg = LlamaConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=176, num_hidden_layers=2,
                           num_attention_heads=4, num_key_value_heads=4,
@@ -123,6 +141,7 @@ def main(scan_layers=True):
         "vs_baseline": round(mfu / 0.50, 4),
         "detail": {
             "model": "llama",
+            "tpu": on_tpu,
             "params": n_params,
             "batch": batch,
             "seq": seq,
@@ -132,40 +151,154 @@ def main(scan_layers=True):
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "amp": "O2 bf16 + fp32 master",
         },
-    }))
+    }), flush=True)
+
+
+def _inproc():
+    """Child entry: self-heal chain scanned -> unrolled -> no-Pallas."""
+    try:
+        main(scan_layers=True)
+        return
+    except Exception:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+    try:
+        _progress("scan_layers path failed; retrying unrolled")
+        main(scan_layers=False)
+        return
+    except Exception:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+    _progress("retrying with Pallas kernels disabled")
+    import paddle_tpu
+    paddle_tpu.set_flags({
+        "FLAGS_use_pallas_attention": False,
+        "FLAGS_use_pallas_rmsnorm": False,
+        "FLAGS_use_pallas_adamw": False,
+    })
+    main(scan_layers=False)
+
+
+# ---------------------------------------------------------------------------
+# Parent orchestration (never imports jax)
+# ---------------------------------------------------------------------------
+
+def _sanitized_env(n_devices=1):
+    """Env with the axon sitecustomize stripped: immune to a wedged relay."""
+    import __graft_entry__ as graft
+    env = dict(os.environ)
+    graft.force_cpu_env(env, n_devices)
+    graft.strip_axon_pythonpath(env)
+    return env
+
+
+def _communicate(proc, timeout):
+    """communicate() with SIGTERM-first on timeout (a SIGKILL mid-TPU-use
+    leaves a dead pool claim that wedges the relay for every later process)."""
+    try:
+        return proc.communicate(timeout=timeout)[0], False
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            return proc.communicate(timeout=30)[0], True
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return proc.communicate()[0], True
+
+
+def _probe_tpu() -> bool:
+    """Is the TPU reachable? Bounded subprocess so a wedge can't hang us."""
+    _progress(f"probing TPU health (timeout {PROBE_TIMEOUT}s)")
+    code = ("import jax; ds = jax.devices(); "
+            "assert ds[0].platform == 'tpu', ds; print(ds)")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT,
+                            text=True, cwd=_REPO_DIR)
+    out, timed_out = _communicate(proc, PROBE_TIMEOUT)
+    if timed_out:
+        _progress("TPU probe timed out — relay wedged or unreachable")
+        return False
+    if proc.returncode == 0:
+        _progress(f"TPU healthy: {(out or '').strip()[:120]}")
+        return True
+    _progress(f"TPU probe failed rc={proc.returncode}: "
+              f"{(out or '').strip()[-200:]}")
+    return False
+
+
+def _run_child(env, timeout):
+    """Run `bench.py --inproc`; return the parsed JSON line or None.
+
+    A child that exited non-zero or whose line carries detail.error is a
+    FAILED run (value 0.0) — report None so the caller falls back instead of
+    recording an empty number.
+    """
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                             "--inproc"],
+                            stdout=subprocess.PIPE, stderr=sys.stderr,
+                            text=True, cwd=_REPO_DIR, env=env)
+    out, timed_out = _communicate(proc, timeout)
+    if timed_out:
+        _progress(f"bench child timed out after {timeout}s")
+    if proc.returncode != 0:
+        _progress(f"bench child failed rc={proc.returncode}")
+        return None
+    for line in reversed((out or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in parsed:
+                if parsed.get("detail", {}).get("error"):
+                    return None
+                return parsed
+    return None
+
+
+def _orchestrate():
+    tpu_ok = _probe_tpu()
+    result = None
+    if tpu_ok:
+        # spend the whole TPU budget minus what the probe already used
+        budget = max(300, TPU_TIMEOUT - int(time.perf_counter() - _T0))
+        _progress(f"running TPU bench (timeout {budget}s)")
+        result = _run_child(dict(os.environ), budget)
+        if result is None:
+            _progress("TPU bench produced no line; falling back to CPU proxy")
+    if result is None:
+        _progress(f"running CPU-proxy bench (timeout {CPU_TIMEOUT}s)")
+        result = _run_child(_sanitized_env(), CPU_TIMEOUT)
+        if result is not None:
+            result.setdefault("detail", {})["tpu"] = False
+            if tpu_ok:
+                result["detail"]["fallback"] = "tpu_bench_failed"
+            else:
+                result["detail"]["fallback"] = "tpu_unreachable"
+    if result is None:  # still emit the one line the driver records
+        result = {
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+            "detail": {"error": "all bench paths failed", "tpu": False},
+        }
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    try:
+    if "--inproc" in sys.argv:
         try:
-            main(scan_layers=True)
-        except Exception:
-            # self-heal chain: scanned stack -> unrolled stack -> unrolled
-            # with the Pallas kernel tier disabled (pure XLA). Same metric
-            # either way; only compile time / kernel choice differ.
+            _inproc()
+        except Exception as e:
             import traceback
             traceback.print_exc(file=sys.stderr)
-            try:
-                _progress("scan_layers path failed; retrying unrolled")
-                main(scan_layers=False)
-            except Exception:
-                traceback.print_exc(file=sys.stderr)
-                _progress("retrying with Pallas kernels disabled")
-                import paddle_tpu
-                paddle_tpu.set_flags({
-                    "FLAGS_use_pallas_attention": False,
-                    "FLAGS_use_pallas_rmsnorm": False,
-                    "FLAGS_use_pallas_adamw": False,
-                })
-                main(scan_layers=False)
-    except Exception as e:  # still emit the one JSON line the driver records
-        import traceback
-        traceback.print_exc(file=sys.stderr)
-        print(json.dumps({
-            "metric": "llama_train_tokens_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "tokens/s",
-            "vs_baseline": 0.0,
-            "detail": {"error": f"{type(e).__name__}: {e}"[:300]},
-        }))
+            print(json.dumps({
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                "detail": {"error": f"{type(e).__name__}: {e}"[:300]},
+            }), flush=True)
+            sys.exit(1)
+    else:
+        _orchestrate()
         sys.exit(0)
