@@ -66,7 +66,8 @@ from .checkpoint import load_state_dict, save_state_dict
 from .collective import (ParallelMode, ReduceType, alltoall_single,  # noqa: E402
                          broadcast_object_list, gather, get_backend,
                          gloo_barrier, gloo_init_parallel_env, gloo_release,
-                         is_available, scatter_object_list)
+                         get_bootstrap_store, is_available,
+                         scatter_object_list)
 from . import launch  # noqa: E402
 from ..framework import io  # noqa: E402  (paddle.distributed.io alias)
 

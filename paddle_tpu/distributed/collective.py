@@ -10,12 +10,22 @@ body is the XLA collective (psum/all_gather/ppermute/all_to_all) — the
 ProcessGroup/CommContext/NCCL stack collapses into the compiler's collective
 emission, and the executable cache plays the role of the comm-op cache.
 
-Single-controller convention: a tensor participating in an eager collective is
-RANK-STACKED — dim 0 indexes the group's ranks (the analog of each rank's
-local tensor in the reference's multi-process world; the reference's own
-single-host multi-rank tests, test/collective/, are the model). In-graph
-(jit/TrainStep) code should instead rely on sharding annotations, where GSPMD
-inserts collectives automatically.
+Two execution modes, auto-detected from ``jax.process_count()``:
+
+* **Single-controller** (1 process, N devices): a tensor participating in an
+  eager collective is RANK-STACKED — dim 0 indexes the group's ranks (the
+  analog of each rank's local tensor in the reference's multi-process world;
+  the reference's own single-host multi-rank tests, test/collective/, are the
+  model).
+* **Multi-process** (a real ``jax.distributed`` world, rank == process, as
+  bootstrapped by ``init_parallel_env`` from the launcher's env): tensors are
+  PROCESS-LOCAL, exactly the reference's semantics
+  (``process_group.h:47`` — each rank passes its local tensor and receives
+  its local result). The same shard_map bodies run over a one-device-per-
+  process mesh; XLA's CPU Gloo / TPU ICI transport carries the bytes.
+
+In-graph (jit/TrainStep) code should instead rely on sharding annotations,
+where GSPMD inserts collectives automatically.
 """
 from __future__ import annotations
 
@@ -49,6 +59,12 @@ class ReduceOp:
     AVG = "avg"
 
 
+def _mp() -> bool:
+    """True in a real multi-process world (rank == process, reference
+    semantics); False under the single-controller rank-stacked convention."""
+    return jax.process_count() > 1
+
+
 class Group:
     """Process group = 1-D mesh axis (process_group.h:47 analog)."""
 
@@ -61,6 +77,7 @@ class Group:
         self.axis_name = axis_name
         self.id = Group._next_id[0]
         Group._next_id[0] += 1
+        self._eager_mesh = None
 
     @property
     def world_size(self):
@@ -68,10 +85,33 @@ class Group:
 
     @property
     def rank(self):
+        if _mp():
+            return self.get_group_rank(jax.process_index())
         return 0  # single-controller SPMD: one logical program
+
+    @property
+    def rank_in_group(self):
+        return self.rank
 
     def get_group_rank(self, rank):
         return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def _collective_mesh(self):
+        """Mesh the eager collectives run over.
+
+        Multi-process: one device per member process (rank == process, as the
+        reference's ProcessGroup does); only member processes participate.
+        Single-controller: the group's full device mesh.
+        """
+        if not _mp():
+            return self.mesh.jax_mesh
+        if self._eager_mesh is None:
+            by_proc = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, d)
+            devs = np.array([by_proc[r] for r in self.ranks], dtype=object)
+            self._eager_mesh = jax.sharding.Mesh(devs, (self.axis_name,))
+        return self._eager_mesh
 
     def __repr__(self):
         return f"Group(id={self.id}, ranks={self.ranks})"
@@ -138,7 +178,13 @@ def init_parallel_env(strategy=None) -> Optional[Group]:
         _maybe_init_multihost()
         n = len(jax.devices())
         mesh = ProcessMesh(np.arange(n), ["world"])
-        _WORLD[0] = Group(list(range(n)), mesh, "world")
+        if _mp():
+            # rank == process (reference trainer semantics); the mesh still
+            # spans every device for in-graph GSPMD use
+            ranks = list(range(jax.process_count()))
+        else:
+            ranks = list(range(n))
+        _WORLD[0] = Group(ranks, mesh, "world")
     return _WORLD[0]
 
 
@@ -157,6 +203,8 @@ def get_world_size(group: Optional[Group] = None) -> int:
 
 
 def get_rank(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.rank if _mp() else jax.process_index()
     return jax.process_index()
 
 
@@ -164,7 +212,9 @@ def new_group(ranks: Optional[List[int]] = None, backend=None,
               timeout=None) -> Group:
     """distributed.new_group (collective.py:180 analog)."""
     if ranks is None:
-        ranks = list(range(len(jax.devices())))
+        # multi-process: rank space is processes, not devices
+        ranks = list(range(jax.process_count() if _mp()
+                           else len(jax.devices())))
     mesh = ProcessMesh(np.asarray(ranks), ["g"])
     return Group(ranks, mesh, "g")
 
@@ -176,7 +226,7 @@ def destroy_process_group(group=None):
 
 def barrier(group: Optional[Group] = None):
     g = group or _world()
-    x = jnp.zeros((g.nranks,), jnp.int32)
+    x = jnp.zeros((1,) if _mp() else (g.nranks,), jnp.int32)
     _stacked(lambda v: jax.lax.psum(v, g.axis_name), g, x,
              cache_key=("barrier",)).block_until_ready()
 
@@ -189,10 +239,17 @@ _STACKED_JIT_CACHE: dict = {}
 def _stacked(body, group: Group, arr, out_sharded=True, cache_key=None):
     """Run `body` per-rank-shard over the group axis via shard_map.
 
+    Single-controller: `arr` is rank-stacked [nranks, ...]; the stacked
+    result comes back. Multi-process: `arr` is this process's LOCAL slot
+    [...]; it is lifted to one row of the global array
+    (make_array_from_process_local_data), the same body runs SPMD across
+    processes, and the local row (or the replicated whole, for
+    out_sharded=False) comes back.
+
     cache_key (hashable, identifying the body's semantics) lets repeat eager
     collectives reuse one jitted callable instead of re-wrapping a fresh
     lambda in jax.jit every call (which defeats jit's identity cache)."""
-    mesh = group.mesh.jax_mesh
+    mesh = group._collective_mesh()
     in_spec = P(group.axis_name)
     out_spec = P(group.axis_name) if out_sharded else P()
     if cache_key is not None:
@@ -204,6 +261,14 @@ def _stacked(body, group: Group, arr, out_sharded=True, cache_key=None):
     else:
         fn = jax.jit(shard_map(body, mesh, (in_spec,), out_spec))
     sharding = NamedSharding(mesh, in_spec)
+    if _mp():
+        local = np.asarray(arr)[None]
+        gshape = (group.nranks,) + tuple(local.shape[1:])
+        garr = jax.make_array_from_process_local_data(sharding, local, gshape)
+        out = fn(garr)
+        if out_sharded:
+            return jnp.asarray(out.addressable_data(0))[0]
+        return jnp.asarray(out.addressable_data(0))
     if not isinstance(arr, jax.core.Tracer):
         arr = jax.device_put(arr, sharding)
     return fn(arr)
@@ -214,6 +279,8 @@ def _unwrap(t):
 
 
 def _check_stacked(arr, group, name):
+    if _mp():
+        return  # process-local tensors; any shape is this rank's own
     if arr.shape[0] != group.nranks:
         raise ValueError(
             f"{name}: single-controller collectives take rank-stacked tensors "
@@ -264,8 +331,44 @@ def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
     return Tensor(out)
 
 
+_OBJ_SEQ: dict = {}  # per-group sequence: only member ranks advance it
+
+
+def _obj_store_and_seq(g: Group):
+    import pickle  # noqa: F401  (callers use it; import checked here)
+    store = get_bootstrap_store()
+    if store is None:
+        raise RuntimeError(
+            "object collectives in a multi-process world need the TCPStore "
+            "control plane — launch via paddle_tpu.distributed.launch / "
+            "init_parallel_env with PADDLE_MASTER set")
+    _OBJ_SEQ[g.id] = _OBJ_SEQ.get(g.id, 0) + 1
+    return store, _OBJ_SEQ[g.id]
+
+
+def _store_all_gather_object(obj, g: Group):
+    """Object exchange over the bootstrap TCPStore control plane (the
+    reference routes object collectives through tensor serialization +
+    NCCL; host-side store exchange is the TPU-shaped equivalent — object
+    payloads are control-plane, not ICI-bandwidth, traffic). Keys are
+    deleted once the whole group has read them."""
+    import pickle
+    store, seq = _obj_store_and_seq(g)
+    mykey = f"__obj/{g.id}/{seq}/{g.rank}"
+    store.set(mykey, pickle.dumps(obj))
+    out = []
+    for r in range(g.nranks):
+        out.append(pickle.loads(store.get(f"__obj/{g.id}/{seq}/{r}")))
+    store.barrier(f"__obj/{g.id}/{seq}/done", world_size=g.nranks)
+    store.delete_key(mykey)
+    return out
+
+
 def all_gather_object(object_list, obj, group=None):
     g = group or _world()
+    if _mp():
+        object_list.extend(_store_all_gather_object(obj, g))
+        return object_list
     # single controller: every rank slot holds the same object
     object_list.extend([obj] * g.nranks)
     return object_list
@@ -282,7 +385,7 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
 
     # close over ints only — a closure over `arr` would pin the first call's
     # device buffer inside the jit cache for process lifetime
-    per = arr.shape[0] // g.nranks
+    per = 1 if _mp() else arr.shape[0] // g.nranks
     start = src_idx * per
 
     def body(x, _start=start, _per=per):
@@ -290,7 +393,9 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
         return jax.lax.dynamic_slice_in_dim(full, _start, _per, axis=0)
 
     out = _stacked(body, g, arr,
-                   cache_key=("broadcast", src_idx, arr.shape[0]))
+                   cache_key=("broadcast", src_idx, per))
+    if _mp():
+        out = out.reshape(arr.shape)
     if isinstance(tensor, Tensor):
         tensor._set_data(out)
         return tensor
@@ -304,11 +409,16 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
     _check_stacked(arr, g, "reduce")
     if dst not in g.ranks:
         raise ValueError(f"reduce: dst rank {dst} not in group {g.ranks}")
-    summed = all_reduce(Tensor(arr), op, g).numpy()
     dst_idx = g.get_group_rank(dst)
-    result = np.array(arr)
-    result[dst_idx] = summed[dst_idx]
-    out = jnp.asarray(result)
+    if _mp():
+        # every member participates in the reduction; only dst keeps it
+        summed = all_reduce(Tensor(jnp.asarray(arr)), op, g)
+        out = summed._data if g.rank == dst_idx else jnp.asarray(arr)
+    else:
+        summed = all_reduce(Tensor(arr), op, g).numpy()
+        result = np.array(arr)
+        result[dst_idx] = summed[dst_idx]
+        out = jnp.asarray(result)
     if isinstance(tensor, Tensor):
         tensor._set_data(out)
         return tensor
@@ -363,6 +473,34 @@ def scatter(tensor, tensor_list=None, src: int = 0,
     src_local = g.get_group_rank(src)
     if src_local < 0:
         raise ValueError(f"scatter: src rank {src} not in group {g.ranks}")
+    if _mp():
+        # tensor = this rank's output buffer; src contributes the real data,
+        # everyone else an equal-shaped zero buffer (SPMD participation)
+        out_arr = _unwrap(tensor)
+        chunk = out_arr.shape[0]
+        if g.rank == src_local:
+            if tensor_list is None:
+                raise ValueError("scatter: the src rank must pass tensor_list")
+            contrib = jnp.concatenate([_unwrap(t) for t in tensor_list],
+                                      axis=0)
+        else:
+            contrib = jnp.zeros((g.nranks * chunk,) + tuple(out_arr.shape[1:]),
+                                out_arr.dtype)
+
+        def body(x, _s=src_local, _c=chunk):
+            full = jax.lax.all_gather(x, g.axis_name, axis=0, tiled=True)
+            mine = jax.lax.dynamic_slice_in_dim(full, _s, 1, axis=0)[0]
+            idx = jax.lax.axis_index(g.axis_name)
+            return jax.lax.dynamic_slice_in_dim(mine, idx * _c, _c,
+                                                axis=0)[None]
+
+        out = _stacked(body, g, contrib,
+                       cache_key=("scatter_mp", src_local, chunk))
+        out = out.reshape(out_arr.shape)
+        if isinstance(tensor, Tensor):
+            tensor._set_data(out)
+            return tensor
+        return Tensor(out)
     if tensor_list is not None:
         data = jnp.stack([_unwrap(t)[src_local] for t in tensor_list], axis=0)
     else:
@@ -382,6 +520,26 @@ def alltoall(in_tensor_list, out_tensor_list=None,
              group: Optional[Group] = None, sync_op=True):
     """all-to-all: out[i][j] = in[j][i] (EP's global_scatter backbone)."""
     g = group or _world()
+    if _mp():
+        # local input: n chunks (row j goes to rank j); local output: n
+        # chunks (row i came from rank i)
+        if isinstance(in_tensor_list, (list, tuple)):
+            arr = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=0)
+        else:
+            arr = _unwrap(in_tensor_list)
+        if arr.shape[0] != g.nranks:
+            raise ValueError(
+                f"alltoall: expected {g.nranks} chunks, got {arr.shape[0]}")
+
+        def body(x):
+            return jax.lax.all_to_all(x[0], g.axis_name, split_axis=0,
+                                      concat_axis=0, tiled=True)[None]
+
+        out = _stacked(body, g, arr, cache_key=("alltoall_mp",))
+        if out_tensor_list is not None:
+            out_tensor_list.extend(Tensor(out[i]) for i in range(g.nranks))
+            return out_tensor_list
+        return Tensor(out)
     if isinstance(in_tensor_list, (list, tuple)):
         arr = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=1)
         # arr: [n, n, ...] — [src, dst, ...]
@@ -400,14 +558,50 @@ def alltoall(in_tensor_list, out_tensor_list=None,
     return Tensor(out)
 
 
+def _p2p_exchange(g: Group, arr, src_idx: int, dst_idx: int):
+    """Multi-process p2p over a TWO-device mesh spanning only the endpoints,
+    so other group members need not participate (the reference's NCCL p2p
+    creates a 2-rank communicator the same way,
+    pp_utils/p2p_communication.py:52). Send on src and recv on dst must be
+    called in matched order — that pairing IS the program."""
+    if src_idx == dst_idx:
+        return jnp.asarray(arr)
+    by_proc = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, d)
+    pair = (g.ranks[src_idx], g.ranks[dst_idx])
+    mesh = jax.sharding.Mesh(
+        np.array([by_proc[pair[0]], by_proc[pair[1]]], dtype=object),
+        (g.axis_name,))
+    key = (mesh, "p2p")
+    fn = _STACKED_JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(shard_map(
+            lambda x: jax.lax.ppermute(x, g.axis_name, [(0, 1)]),
+            mesh, (P(g.axis_name),), P(g.axis_name)))
+        _STACKED_JIT_CACHE[key] = fn
+    sharding = NamedSharding(mesh, P(g.axis_name))
+    local = np.asarray(arr)[None]
+    garr = jax.make_array_from_process_local_data(
+        sharding, local, (2,) + tuple(local.shape[1:]))
+    out = fn(garr)
+    return jnp.asarray(out.addressable_data(0))[0]
+
+
 def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
-    """Point-to-point stash for the matching recv. Single-controller: data is
-    globally addressable, so p2p is a FIFO handoff; in-graph pipeline comm
-    should use ppermute (see distributed.ppermute) instead. Matching is FIFO
-    per group — ambiguous outstanding sends raise rather than mis-deliver."""
+    """Point-to-point send.
+
+    Multi-process: a ppermute over the group mesh (the matching recv runs
+    the same program on the dst rank). Single-controller: data is globally
+    addressable, so p2p is a FIFO handoff; in-graph pipeline comm should use
+    ppermute (see distributed.ppermute) instead. Matching is FIFO per group —
+    ambiguous outstanding sends raise rather than mis-deliver."""
     g = group or _world()
     if dst not in g.ranks:
         raise ValueError(f"send: dst rank {dst} not in group {g.ranks}")
+    if _mp():
+        _p2p_exchange(g, _unwrap(tensor), g.rank, g.get_group_rank(dst))
+        return
     _P2P_BUF.setdefault(g.id, []).append((dst, _unwrap(tensor)))
 
 
@@ -415,6 +609,10 @@ def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
     g = group or _world()
     if src not in g.ranks:
         raise ValueError(f"recv: src rank {src} not in group {g.ranks}")
+    if _mp():
+        out = _p2p_exchange(g, _unwrap(tensor), g.get_group_rank(src), g.rank)
+        tensor._set_data(out.reshape(tensor._data.shape))
+        return tensor
     buf = _P2P_BUF.get(g.id, [])
     if not buf:
         raise RuntimeError("recv without matching send")
@@ -490,8 +688,25 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
         raise NotImplementedError("ragged alltoall_single splits")
     g = group or _world()
     arr = _unwrap(in_tensor)
-    _check_stacked(arr, g, "alltoall_single")
     n = g.nranks
+    if _mp():
+        if arr.shape[0] % n:
+            raise ValueError(
+                f"alltoall_single: dim0 {arr.shape[0]} not divisible by "
+                f"group size {n}")
+        chunks = arr.reshape((n, arr.shape[0] // n) + tuple(arr.shape[1:]))
+
+        def body(x):
+            return jax.lax.all_to_all(x[0], g.axis_name, split_axis=0,
+                                      concat_axis=0, tiled=True)[None]
+
+        out = _stacked(body, g, chunks, cache_key=("alltoall_single_mp",))
+        result = Tensor(out.reshape(arr.shape))
+        if out_tensor is not None:
+            out_tensor._set_data(result._data)
+            return out_tensor
+        return result
+    _check_stacked(arr, g, "alltoall_single")
     arr = arr.reshape((n, n, -1) + tuple(arr.shape[2:]))
     out = _stacked(
         lambda x: jax.lax.all_to_all(x, g.axis_name, split_axis=1,
@@ -509,6 +724,24 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     """Single controller: rank i's slot is in_object_list[i] (the src list
     is visible to all)."""
     g = group or _world()
+    if _mp():
+        import pickle
+        src_idx = g.get_group_rank(src)
+        if src_idx < 0:
+            raise ValueError(
+                f"scatter_object_list: src rank {src} not in group {g.ranks}")
+        store, seq = _obj_store_and_seq(g)
+        key = f"__objsc/{g.id}/{seq}"
+        if g.rank == src_idx:
+            if in_object_list is None or len(in_object_list) != g.nranks:
+                raise ValueError(
+                    "in_object_list must have one entry per rank")
+            store.set(key, pickle.dumps(list(in_object_list)))
+        out_object_list.append(pickle.loads(store.get(key))[g.rank])
+        store.barrier(f"{key}/done", world_size=g.nranks)
+        if g.rank == src_idx:
+            store.delete_key(key)
+        return out_object_list
     if in_object_list is None:
         raise ValueError("in_object_list required on the src rank")
     if len(in_object_list) != g.nranks:
@@ -518,7 +751,24 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
 
 
 def broadcast_object_list(object_list, src=0, group=None):
-    """Single controller: objects are already shared; identity."""
+    """Multi-process: src's list replaces everyone's (src sets the store key
+    once; the others fetch it). Single controller: identity."""
+    g = group or _world()
+    if _mp():
+        import pickle
+        src_idx = g.get_group_rank(src)
+        if src_idx < 0:
+            raise ValueError(
+                f"broadcast_object_list: src rank {src} not in group "
+                f"{g.ranks}")
+        store, seq = _obj_store_and_seq(g)
+        key = f"__objbc/{g.id}/{seq}"
+        if g.rank == src_idx:
+            store.set(key, pickle.dumps(list(object_list)))
+        object_list[:] = pickle.loads(store.get(key))
+        store.barrier(f"{key}/done", world_size=g.nranks)
+        if g.rank == src_idx:
+            store.delete_key(key)
     return object_list
 
 

@@ -59,7 +59,34 @@ def launch(argv=None) -> int:
     args, script_args = _parse(argv if argv is not None else sys.argv[1:])
     ctx = Context(args, script_args)
     server = None
-    if ctx.nnodes > 1:
+    if ctx.nnodes == 1 and ctx.world_size > 1 and not ctx.master:
+        # single-node multi-process: form a real world over loopback (the
+        # reference's single-node multi-GPU launch does the same). The KV
+        # master uses port p, the JAX coordinator p+1, the TCPStore p+2 —
+        # probe all three before committing to a base port.
+        import socket
+
+        def _three_free_ports():
+            for _ in range(32):
+                socks = []
+                try:
+                    with socket.socket() as probe:
+                        probe.bind(("127.0.0.1", 0))
+                        base = probe.getsockname()[1]
+                    for off in range(3):
+                        s = socket.socket()
+                        s.bind(("127.0.0.1", base + off))
+                        socks.append(s)
+                    return base
+                except OSError:
+                    continue
+                finally:
+                    for s in socks:
+                        s.close()
+            raise RuntimeError("no 3-consecutive-port window found")
+
+        ctx.master = f"127.0.0.1:{_three_free_ports()}"
+    if ctx.nnodes > 1 or (ctx.world_size > 1 and ctx.master):
         if not ctx.master:
             raise SystemExit(
                 "--master host:port is required for multi-node jobs "

@@ -1,0 +1,140 @@
+"""Per-rank worker for the multi-process E2E collective test.
+
+Launched by paddle_tpu.distributed.launch (2 ranks, CPU). Forms a real
+jax.distributed world through init_parallel_env, then exercises every eager
+collective across processes, the TCPStore control plane, and a sharded
+checkpoint save->load. Reference model for the test shape:
+test/collective/test_communication_api_base.py:59-74 (spawn ranks, assert
+per-rank results).
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    rank_env = int(os.environ["PADDLE_TRAINER_ID"])
+    world_env = int(os.environ["PADDLE_TRAINERS_NUM"])
+    ckpt_dir = sys.argv[1]
+
+    dist.init_parallel_env()
+    assert jax.process_count() == world_env, (
+        f"world not formed: process_count={jax.process_count()}")
+    rank = dist.get_rank()
+    n = dist.get_world_size()
+    assert rank == rank_env and n == world_env, (rank, n)
+
+    # --- all_reduce: each rank contributes rank+1 -> sum = n(n+1)/2
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), np.full((4,), n * (n + 1) / 2))
+
+    # --- all_gather: slice i came from rank i
+    gathered = []
+    dist.all_gather(gathered,
+                    paddle.to_tensor(np.full((2,), float(rank), np.float32)))
+    assert len(gathered) == n
+    for i, s in enumerate(gathered):
+        np.testing.assert_allclose(s.numpy(), np.full((2,), float(i)))
+
+    # --- broadcast from rank 1
+    b = paddle.to_tensor(np.full((3,), float(rank * 10 + 5), np.float32))
+    dist.broadcast(b, src=1)
+    np.testing.assert_allclose(b.numpy(), np.full((3,), 15.0))
+
+    # --- reduce to rank 1 (others keep their input)
+    r = paddle.to_tensor(np.full((2,), float(rank + 1), np.float32))
+    dist.reduce(r, dst=1)
+    expect = n * (n + 1) / 2 if rank == 1 else float(rank + 1)
+    np.testing.assert_allclose(r.numpy(), np.full((2,), expect))
+
+    # --- reduce_scatter: input [n*2] (chunk c = mine), output my summed chunk
+    chunks = np.arange(n * 2, dtype=np.float32) + 100 * rank
+    rs = paddle.to_tensor(np.zeros((2,), np.float32))
+    dist.reduce_scatter(rs, paddle.to_tensor(chunks))
+    base = np.arange(n * 2, dtype=np.float32).reshape(n, 2)[rank]
+    expect_rs = base * n + 100 * sum(range(n))
+    np.testing.assert_allclose(rs.numpy(), expect_rs)
+
+    # --- alltoall: out[i] = rank i's chunk addressed to me
+    in_list = [paddle.to_tensor(np.full((2,), float(rank * 10 + j),
+                                        np.float32)) for j in range(n)]
+    out_list = []
+    dist.alltoall(in_list, out_list)
+    assert len(out_list) == n
+    for i, o in enumerate(out_list):
+        np.testing.assert_allclose(o.numpy(), np.full((2,), i * 10 + rank))
+
+    # --- alltoall_single
+    src = np.arange(n * 3, dtype=np.float32) + 1000 * rank
+    out_single = dist.alltoall_single(paddle.to_tensor(src))
+    expect_rows = np.stack([
+        (np.arange(n * 3, dtype=np.float32) + 1000 * i).reshape(n, 3)[rank]
+        for i in range(n)])
+    np.testing.assert_allclose(out_single.numpy(),
+                               expect_rows.reshape(-1))
+
+    # --- scatter from rank 0
+    sc_out = paddle.to_tensor(np.zeros((2,), np.float32))
+    if rank == 0:
+        sc_list = [paddle.to_tensor(np.full((2,), float(7 + i), np.float32))
+                   for i in range(n)]
+        dist.scatter(sc_out, sc_list, src=0)
+    else:
+        dist.scatter(sc_out, src=0)
+    np.testing.assert_allclose(sc_out.numpy(), np.full((2,), 7.0 + rank))
+
+    # --- p2p: rank 0 -> rank 1 (both endpoints run the ppermute program)
+    payload = np.arange(6, dtype=np.float32).reshape(2, 3)
+    if rank == 0:
+        dist.send(paddle.to_tensor(payload), dst=1)
+    elif rank == 1:
+        box = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        dist.recv(box, src=0)
+        np.testing.assert_allclose(box.numpy(), payload)
+
+    # --- device barrier + TCPStore control-plane barrier
+    dist.barrier()
+    store = dist.get_bootstrap_store()
+    assert store is not None, "TCPStore bootstrap missing"
+    store.barrier("e2e_test", world_size=n)
+
+    # --- object collectives over the store
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "mp"})
+    assert [o["rank"] for o in objs] == list(range(n)), objs
+    blist = [{"from": rank}] if True else []
+    dist.broadcast_object_list(blist, src=0)
+    assert blist == [{"from": 0}], blist
+
+    # --- sharded checkpoint: save a dp-sharded global array, reload, compare
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    mesh = jax.sharding.Mesh(np.array(jax.devices(), object), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    full = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    garr = jax.make_array_from_process_local_data(
+        sharding, full[rank:rank + 1], (n, 4))
+    sd = {"w": paddle.Tensor(garr)}
+    save_state_dict(sd, ckpt_dir)
+    store.barrier("ckpt_saved", world_size=n)
+
+    target = jax.make_array_from_process_local_data(
+        sharding, np.zeros((1, 4), np.float32), (n, 4))
+    sd2 = {"w": paddle.Tensor(target)}
+    load_state_dict(sd2, ckpt_dir)
+    got = np.asarray(sd2["w"]._data.addressable_data(0))
+    np.testing.assert_allclose(got, full[rank:rank + 1])
+
+    print(f"MPWORKER_OK rank={rank}/{n}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

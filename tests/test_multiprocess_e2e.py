@@ -1,0 +1,51 @@
+"""Multi-process E2E: real ranks, real jax.distributed world (VERDICT #3).
+
+Uses the launch CLI to spawn 2 processes on CPU; each forms the world via
+init_parallel_env (PJRT distributed runtime + TCPStore control plane), runs
+every eager collective across ranks (Gloo transport on CPU — ICI on TPU),
+and round-trips a sharded checkpoint. Reference model:
+test/collective/test_communication_api_base.py:59-74.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "helpers", "mp_worker.py")
+
+
+def _launch_env():
+    """Child env: 1 CPU device per process, axon sitecustomize stripped
+    (a wedged TPU relay must not hang the CPU-only world)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)  # conftest's 8-device forcing: 1 dev/proc here
+    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
+    return env
+
+
+@pytest.mark.quick
+def test_two_rank_world(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+         WORKER, ckpt_dir],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=_launch_env())
+    logs = ""
+    log_root = tmp_path / "logs"
+    if log_root.exists():
+        for f in sorted(log_root.iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()
+    assert proc.returncode == 0, (
+        f"launch failed rc={proc.returncode}\nstdout:{proc.stdout[-2000:]}\n"
+        f"stderr:{proc.stderr[-2000:]}\nlogs:{logs[-4000:]}")
+    for r in range(2):
+        assert f"MPWORKER_OK rank={r}/2" in logs, (
+            f"rank {r} did not finish\n{logs[-4000:]}")
