@@ -3,6 +3,12 @@
 Single implementation behind distributed.shard_optimizer,
 sharding.group_sharded_parallel, and fleet's HybridParallelOptimizer
 (DygraphShardingOptimizer analog, dygraph_sharding_optimizer.py:48).
+
+``offload=True`` places the accumulators in ``pinned_host`` memory (jax
+memory kinds) — the ZeRO-offload analog of the reference's
+group_sharded_stage3.py:85 cpu_offload: states live on host RAM between
+steps and cross PCIe at the step boundary (H2D prefetch before the update,
+D2H write-back after).
 """
 from __future__ import annotations
 
@@ -10,26 +16,67 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
 
-def shard_optimizer_states(optimizer, mesh, axis: str):
-    """Monkeypatch optimizer._add_accumulator so new accumulators land
-    Shard(0) over `axis` when dim0 is divisible, else replicated.
-    Idempotent: re-sharding with the same axis is a no-op."""
-    if getattr(optimizer, "_sharded_states_axis", None) == axis:
+def _host_kind(device):
+    kinds = {m.kind for m in device.addressable_memories()}
+    if "pinned_host" in kinds:
+        return "pinned_host"
+    if "unpinned_host" in kinds:  # pragma: no cover - backend-dependent
+        return "unpinned_host"
+    raise NotImplementedError(
+        f"offload=True: backend {device.platform} exposes no host memory "
+        f"kind (have {sorted(kinds)})")
+
+
+def shard_optimizer_states(optimizer, mesh, axis: str, offload: bool = False):
+    """Patch optimizer._add_accumulator so new accumulators land Shard over
+    `axis` on their first divisible dim (replicated when none divides),
+    optionally in host memory. Idempotent; re-calling with different args
+    re-points the ONE patch instead of chaining wrappers."""
+    if getattr(optimizer, "_sharded_states_axis", None) == axis and \
+            getattr(optimizer, "_sharded_states_offload", None) == offload:
         return optimizer
     degree = mesh.get_dim_size(axis)
-    orig_add = optimizer._add_accumulator
+    memory_kind = _host_kind(jax.devices()[0]) if offload else None
+    if not hasattr(optimizer, "_orig_add_accumulator"):
+        optimizer._orig_add_accumulator = optimizer._add_accumulator
+    orig_add = optimizer._orig_add_accumulator
+
+    def _sharded_put(v, kind=None):
+        """device_put keeping the divisible-dim Shard spec (the one
+        placement rule for both accumulator creation and the offload
+        step-boundary transfers)."""
+        from .sharding import _divisible_dim
+        dim = _divisible_dim(v.shape, degree) if v.ndim else None
+        parts = [None] * v.ndim
+        if dim is not None:
+            parts[dim] = axis
+        spec = PartitionSpec(*parts)
+        sharding = NamedSharding(mesh.jax_mesh, spec, memory_kind=kind) \
+            if kind else NamedSharding(mesh.jax_mesh, spec)
+        return jax.device_put(v, sharding)
 
     def sharded_add(name, param, fill_value=0.0, dtype=None):
         store = optimizer._accumulators.setdefault(name, {})
         if id(param) not in store:
             arr = orig_add(name, param, fill_value, dtype)
-            spec = PartitionSpec(axis) if (
-                arr.ndim > 0 and arr.shape[0] % degree == 0) else PartitionSpec()
-            store[id(param)] = jax.device_put(
-                arr, NamedSharding(mesh.jax_mesh, spec))
+            store[id(param)] = _sharded_put(arr, memory_kind)
         return store[id(param)]
 
     optimizer._add_accumulator = sharded_add
     optimizer._sharded_states_axis = axis
+    optimizer._sharded_states_offload = offload
     optimizer._sharded_states_mesh = mesh
+
+    if memory_kind:
+        # step-boundary transfers: H2D prefetch for the update, D2H
+        # write-back to the sharded host residence
+        optimizer._fetch_state_for_update = \
+            lambda v: _sharded_put(v, "device")
+        optimizer._restore_state_placement = \
+            lambda v: _sharded_put(v, memory_kind)
+    else:
+        # drop any stale offload hooks from a prior offload=True wrap
+        for attr in ("_fetch_state_for_update", "_restore_state_placement"):
+            if attr in optimizer.__dict__:
+                del optimizer.__dict__[attr]
     return optimizer

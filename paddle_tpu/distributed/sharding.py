@@ -3,18 +3,34 @@
 Reference: paddle.distributed.sharding.group_sharded_parallel
 (distributed/sharding/group_sharded.py) dispatching to GroupShardedStage2
 (grad+optimizer sharding, group_sharded_stage2.py:46) and GroupShardedStage3
-(parameter sharding with prefetch, group_sharded_stage3.py:85); stage 1 via
-DygraphShardingOptimizer (optimizer-state sharding).
+(parameter sharding with prefetch + CPU offload, group_sharded_stage3.py:85);
+stage 1 via DygraphShardingOptimizer (optimizer-state sharding).
 
 TPU-native: ZeRO stages are PLACEMENT POLICIES over a 'sharding' mesh axis —
-  stage 1 (os):    optimizer states Shard(0) over the axis
-  stage 2 (os_g):  + gradients annotated Shard(0) (reduce-scatter backward)
-  stage 3 (p_g_os):+ parameters Shard(0); XLA all-gathers params where used
-                    and frees the gathered copies (prefetch/overlap is the
-                    scheduler's job). No gather hooks, no storage coalescing.
+  stage 1 (os):    optimizer states Shard over the axis
+  stage 2 (os_g):  + gradients annotated Shard (reduce-scatter backward)
+  stage 3 (p_g_os):+ parameters Shard; XLA all-gathers params where used
+                    and frees the gathered copies.
+
+Parameter sharding picks the FIRST dim divisible by the axis degree (dim0
+preferred, matching the reference's flat-storage split; a dim0-odd matrix
+still shards on its other dim instead of silently replicating). Params with
+no divisible dim replicate with an explicit warning.
+
+``offload=True`` is REAL: optimizer states (and master weights) land in
+``pinned_host`` memory via jax memory kinds — the reference's
+cpu_offload path (group_sharded_stage3.py:85). The compiled train step
+streams them over PCIe/host DMA at the step boundary; XLA schedules the
+prefetch so transfers overlap compute (the reference's manual prefetch
+thread collapses into the compiler's latency hiding).
+
+``buffer_max_size``/``segment_size`` (grad storage coalescing) are XLA's
+job — buffer assignment already coalesces; non-default values warn that
+they are no-ops here rather than being silently discarded.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -34,8 +50,17 @@ def _sharding_mesh_axis(group: Optional[Group]):
     return g.mesh, g.axis_name
 
 
-def _shard0_placements(mesh, axis):
-    return [Shard(0) if n == axis else Replicate() for n in mesh.dim_names]
+def _divisible_dim(shape, degree):
+    """First dim the axis degree divides (dim0 preferred), else None."""
+    for d, size in enumerate(shape):
+        if size % degree == 0 and size >= degree:
+            return d
+    return None
+
+
+def _placements(mesh, axis, shard_dim):
+    return [Shard(shard_dim) if n == axis else Replicate()
+            for n in mesh.dim_names]
 
 
 def _repl_placements(mesh):
@@ -45,42 +70,64 @@ def _repl_placements(mesh):
 class _ShardingStrategy:
     """Attached to the optimizer; consumed by TrainStep to constrain grads."""
 
-    def __init__(self, level, mesh, axis):
+    def __init__(self, level, mesh, axis, offload=False):
         self.level = level
         self.mesh = mesh
         self.axis = axis
+        self.offload = offload
 
     def grad_sharding(self, shape):
-        if self.level in ("os_g", "p_g_os") and shape and \
-                shape[0] % self.mesh.get_dim_size(self.axis) == 0:
-            from jax.sharding import NamedSharding, PartitionSpec
-            return NamedSharding(self.mesh.jax_mesh, PartitionSpec(self.axis))
-        return None
+        if self.level not in ("os_g", "p_g_os"):
+            return None
+        dim = _divisible_dim(shape, self.mesh.get_dim_size(self.axis))
+        if dim is None:
+            return None
+        spec = [None] * len(shape)
+        spec[dim] = self.axis
+        return NamedSharding(self.mesh.jax_mesh, PartitionSpec(*spec))
 
 
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
-                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
-                           segment_size=2 ** 20, sync_comm=False,
-                           dp_group=None, exclude_layer=None):
+                           offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None, exclude_layer=None):
     """distributed/sharding/group_sharded.py analog. level: os | os_g | p_g_os."""
     assert level in ("os", "os_g", "p_g_os"), f"bad sharding level {level}"
+    if offload and level == "os":
+        raise ValueError("offload needs level 'os_g' or 'p_g_os' "
+                         "(reference group_sharded.py constraint)")
+    if buffer_max_size != 2 ** 23 or segment_size != 2 ** 20:
+        warnings.warn(
+            "group_sharded_parallel: buffer_max_size/segment_size are no-ops "
+            "on the XLA backend (buffer assignment already coalesces "
+            "gradient storage)", stacklevel=2)
     mesh, axis = _sharding_mesh_axis(group)
     degree = mesh.get_dim_size(axis)
 
     # parameters: stage 3 shards them over the axis; else replicate
+    replicated = []
     for p in model.parameters():
         if p._dist_attr is not None and any(
                 not pl.is_replicate() for pl in p._dist_attr["placements"]):
             continue  # TP-annotated params keep their placement
-        if level == "p_g_os" and p.ndim > 0 and p.shape[0] % degree == 0:
-            shard_tensor(p, mesh, _shard0_placements(mesh, axis))
+        dim = _divisible_dim(p.shape, degree) if p.ndim > 0 else None
+        if level == "p_g_os" and dim is not None:
+            shard_tensor(p, mesh, _placements(mesh, axis, dim))
         else:
+            if level == "p_g_os" and p.ndim > 0:
+                replicated.append(getattr(p, "name", None) or str(p.shape))
             shard_tensor(p, mesh, _repl_placements(mesh))
+    if replicated:
+        warnings.warn(
+            f"group_sharded_parallel(p_g_os): {len(replicated)} param(s) "
+            f"have no dim divisible by the sharding degree {degree} and "
+            f"stay replicated: {replicated[:5]}"
+            + ("..." if len(replicated) > 5 else ""), stacklevel=2)
 
-    # optimizer states: sharded for every stage
+    # optimizer states: sharded for every stage; host-offloaded on request
     from ._shard_states import shard_optimizer_states
-    shard_optimizer_states(optimizer, mesh, axis)
-    optimizer._group_sharded = _ShardingStrategy(level, mesh, axis)
+    shard_optimizer_states(optimizer, mesh, axis, offload=offload)
+    optimizer._group_sharded = _ShardingStrategy(level, mesh, axis, offload)
 
     if scaler is not None:
         return model, optimizer, scaler

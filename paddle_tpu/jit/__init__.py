@@ -473,6 +473,12 @@ class TrainStep:
                    for p, um in zip(params, use_master)]
         opt_states = [{name: opt._accumulators[name][id(p)]
                        for name in opt._state_names()} for p in params]
+        if getattr(opt, "_sharded_states_offload", False):
+            # ZeRO-offload step boundary: prefetch host-resident states to
+            # device for the compiled step (the temporary device copies are
+            # donated, so HBM holds them only for the step's duration)
+            opt_states = [{k: opt._fetch_state_for_update(v)
+                           for k, v in st.items()} for st in opt_states]
         extra_arrays = [t._data for t in entry["extra"]]
         other_grads_in = [None if t._grad is None else t._grad._data
                           for t in entry["other_grad_ts"]]
@@ -489,7 +495,10 @@ class TrainStep:
                 opt._master_weights[id(p)] = m
         for p, st in zip(params, new_states):
             for name, v in st.items():
-                opt._accumulators[name][id(p)] = v
+                # ZeRO-offload hook: fresh state buffers return to their
+                # sharded host residence (identity when offload is off)
+                opt._accumulators[name][id(p)] = \
+                    opt._restore_state_placement(v)
         for t, a in zip(entry["extra_mut"], new_extra):
             t._data = a
         for t, g in zip(entry["other_grad_ts"], new_other_grads):

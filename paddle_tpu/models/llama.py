@@ -147,9 +147,13 @@ class LlamaAttention(Layer):
             out = ring_attention(q, k, v, mesh=cfg.sep_mesh,
                                  axis_name=cfg.sep_axis, causal=True)
         else:
-            if kv != h:
-                # GQA: repeat kv heads to full head count; XLA keeps this as
-                # a broadcast feeding the batched matmul (no copy).
+            from ..nn.functional import _pallas_attention_eligible
+            mask_arr = None if attn_mask is None else attn_mask._data
+            if kv != h and not _pallas_attention_eligible(
+                    q._data, k._data, mask_arr, 0.0):
+                # GQA on the dense XLA path: repeat kv heads to full head
+                # count; XLA keeps this as a broadcast feeding the batched
+                # matmul (no copy). The Pallas kernel handles GQA natively.
                 rep = h // kv
                 k = k.unsqueeze(3).expand(
                     [b, s, kv, rep, d]).reshape([b, s, h, d])
@@ -218,10 +222,6 @@ class ScannedLlamaLayers(Layer):
 
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
-        if config.sep_mesh is not None:
-            raise ValueError(
-                "scan_layers does not implement ring (context-parallel) "
-                "attention yet — use the unrolled stack for sep_mesh")
         self.config = config
         L = config.num_hidden_layers
         hs = config.hidden_size
@@ -254,7 +254,33 @@ class ScannedLlamaLayers(Layer):
                     cfg.head_dim)
         eps = cfg.rms_norm_eps
         seq = int(hidden.shape[1])
-        use_flash = (attn_mask is None and _pl.on_tpu()
+        ring_impl = None
+        if cfg.sep_mesh is not None and attn_mask is None:
+            # context parallelism inside the scan body: the ring shard_map
+            # runs per scanned layer (scan-of-shard_map — the layer body is
+            # still traced once; K/V blocks rotate the ICI ring each step)
+            from ..distributed.auto_parallel import ProcessMesh
+            from ..ops.ring_attention import (_DP_NAMES, _MP_NAMES,
+                                              _cached_impl, _pick_axis)
+            jmesh = (cfg.sep_mesh.jax_mesh
+                     if isinstance(cfg.sep_mesh, ProcessMesh)
+                     else cfg.sep_mesh)
+            if seq % jmesh.shape[cfg.sep_axis]:
+                raise ValueError(
+                    f"sequence length {seq} not divisible by sep axis "
+                    f"size {jmesh.shape[cfg.sep_axis]}")
+            batch = int(hidden.shape[0])
+            batch_axis = _pick_axis(jmesh.axis_names, _DP_NAMES,
+                                    cfg.sep_axis)
+            head_axis = _pick_axis(jmesh.axis_names, _MP_NAMES, cfg.sep_axis)
+            if batch_axis is not None and batch % jmesh.shape[batch_axis]:
+                batch_axis = None
+            if head_axis is not None and (h % jmesh.shape[head_axis]
+                                          or kv % jmesh.shape[head_axis]):
+                head_axis = None
+            ring_impl = _cached_impl(jmesh, cfg.sep_axis, True, batch_axis,
+                                     head_axis)
+        use_flash = (ring_impl is None and attn_mask is None and _pl.on_tpu()
                      and get_flag("FLAGS_use_pallas_attention"))
         if use_flash:
             from ..ops.pallas.flash_attention import supported
@@ -280,19 +306,25 @@ class ScannedLlamaLayers(Layer):
                 q = rope((x @ qw_).reshape(b, s, h, d))
                 k = rope((x @ kw_).reshape(b, s, kv, d))
                 v = (x @ vw_).reshape(b, s, kv, d)
-                if kv != h:
-                    rep = h // kv
-                    k = jnp.broadcast_to(k[:, :, :, None],
-                                         (b, s, kv, rep, d)
-                                         ).reshape(b, s, h, d)
-                    v = jnp.broadcast_to(v[:, :, :, None],
-                                         (b, s, kv, rep, d)
-                                         ).reshape(b, s, h, d)
-                if use_flash:
+                if ring_impl is not None:
+                    # raw-jnp ring call (we are already inside the traced
+                    # scan body; the op-level dispatch wrapper is above us)
+                    ctx = ring_impl(q, k, v)
+                elif use_flash:
+                    # GQA is native in the v2 kernel: K/V stay at kv heads
+                    # (the index map expands the group in-kernel)
                     from ..ops.pallas.flash_attention import \
                         flash_attention_pallas
                     ctx = flash_attention_pallas(q, k, v, causal=True)
                 else:
+                    if kv != h:
+                        rep = h // kv
+                        k = jnp.broadcast_to(k[:, :, :, None],
+                                             (b, s, kv, rep, d)
+                                             ).reshape(b, s, h, d)
+                        v = jnp.broadcast_to(v[:, :, :, None],
+                                             (b, s, kv, rep, d)
+                                             ).reshape(b, s, h, d)
                     scale = 1.0 / (d ** 0.5)
                     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
                     if mask is not None:

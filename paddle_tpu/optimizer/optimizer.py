@@ -67,6 +67,18 @@ class Optimizer:
     def _get_accumulator(self, name, param):
         return self._accumulators[name][id(param)]
 
+    def _restore_state_placement(self, v):
+        """Hook: distributed state sharding (ZeRO offload) re-pins updated
+        accumulators to their host residence; identity by default.
+        Patched by distributed._shard_states.shard_optimizer_states."""
+        return v
+
+    def _fetch_state_for_update(self, v):
+        """Hook: ZeRO offload prefetches host-resident accumulators to
+        device memory for the eager update (jit inserts the transfer
+        itself); identity by default."""
+        return v
+
     def _master_weight(self, param):
         if id(param) not in self._master_weights:
             self._master_weights[id(param)] = param._data.astype(jnp.float32)
@@ -99,7 +111,8 @@ class Optimizer:
             self._current_param = p
             self._create_accumulators_for(p)
             use_master = self._multi_precision and p.dtype != jnp.float32
-            state = {name: self._accumulators[name][id(p)]
+            state = {name: self._fetch_state_for_update(
+                         self._accumulators[name][id(p)])
                      for name in self._state_names()}
             pdata = self._master_weight(p) if use_master else p._data
             g = p.grad._data
@@ -112,7 +125,8 @@ class Optimizer:
             else:
                 p._set_data(new_p)
             for name, v in new_state.items():
-                self._accumulators[name][id(p)] = v
+                self._accumulators[name][id(p)] = \
+                    self._restore_state_placement(v)
         self._current_param = None
         self._step_count += 1
 

@@ -33,3 +33,39 @@ def _seed():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+
+
+# -- quick tier (VERDICT weak #8): one representative fast test per subsystem
+# so `pytest -m quick` verifies every layer in <2 min.
+_QUICK_TESTS = {
+    "tests/test_autograd.py::test_simple_backward",
+    "tests/test_bert_debugging_utils.py::test_bert_backbone_shapes",
+    "tests/test_dist_checkpoint.py::test_save_load_replicated",
+    "tests/test_dist_engine.py::test_strategy_defaults_and_config",
+    "tests/test_distributed.py::test_world_setup",
+    "tests/test_fused_kernels.py::test_rmsnorm_pallas_forward_matches_reference",
+    "tests/test_hapi.py::test_accuracy_metric",
+    "tests/test_io.py::test_tensor_dataset_and_subset",
+    "tests/test_jit.py::test_to_static_matches_eager",
+    "tests/test_launch.py::test_kv_server_roundtrip",
+    "tests/test_models.py::test_llama_forward_shapes",
+    "tests/test_moe.py::test_naive_gate_topk",
+    "tests/test_native.py::test_native_extension_builds",
+    "tests/test_nn.py::test_linear",
+    "tests/test_optimizer.py::test_optimizers_decrease_loss",
+    "tests/test_pipeline.py::test_segment_uniform",
+    "tests/test_profiler.py::test_make_scheduler_states",
+    "tests/test_quant_asp.py::test_quant_dequant_rounds_to_grid",
+    "tests/test_rnn.py::test_simple_rnn_cell_matches_numpy",
+    "tests/test_sequence_parallel.py::test_ring_attention_matches_dense",
+    "tests/test_sot.py::TestSOTSegments::test_replay_skips_python_and_matches_eager",
+    "tests/test_tensor.py::test_to_tensor_and_numpy",
+    "tests/test_vision_ops.py::TestRoIOps::test_roi_align_constant_image",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = item.nodeid.split("[")[0]
+        if base in _QUICK_TESTS:
+            item.add_marker(pytest.mark.quick)

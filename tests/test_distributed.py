@@ -361,3 +361,42 @@ def test_init_parallel_env_multihost_env_gating(monkeypatch):
     monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:29999")
     C._maybe_init_multihost()
     assert C.get_bootstrap_store() is None
+
+
+def test_group_sharded_stage3_offload():
+    """ZeRO-offload (VERDICT #8): optimizer states land in host memory and
+    the compiled step still trains (XLA streams them at the step boundary)."""
+    m = nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Linear(64, 8))
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    m, opt = dist.sharding.group_sharded_parallel(
+        m, opt, level="p_g_os", offload=True,
+        group=dist.init_parallel_env())
+    lossf = nn.CrossEntropyLoss()
+    step = jit.TrainStep(lambda x, y: lossf(m(x), y), opt)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(16, 32).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 8, (16,)))
+    losses = [float(step(x, y)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    st = list(opt._accumulators["moment1"].values())[0]
+    assert st.sharding.memory_kind == "pinned_host"
+    assert any(s is not None for s in st.sharding.spec)
+
+
+def test_group_sharded_stage3_nondivisible_uses_other_dim():
+    """A dim0-odd matrix shards on its other dim instead of replicating."""
+    m = nn.Linear(30, 64)  # weight [30, 64]: 30 % 8 != 0, 64 % 8 == 0
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    m, opt = dist.sharding.group_sharded_parallel(
+        m, opt, level="p_g_os", group=dist.init_parallel_env())
+    spec = m.weight._data.sharding.spec
+    assert spec[0] is None and spec[1] is not None  # sharded, NOT replicated
+
+
+def test_group_sharded_offload_stage1_rejected():
+    m = nn.Linear(8, 4)
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+    with pytest.raises(ValueError):
+        dist.sharding.group_sharded_parallel(
+            m, opt, level="os", offload=True,
+            group=dist.init_parallel_env())

@@ -177,3 +177,43 @@ def test_ring_attention_gqa_unexpanded_kv():
     v_exp = np.repeat(v, h // kv, axis=2)
     expected = _dense_attention(q, k_exp, v_exp, causal=True)
     np.testing.assert_allclose(out.numpy(), expected, rtol=2e-4, atol=2e-5)
+
+
+def test_scanned_llama_ring_matches_dense():
+    """scan_layers + sep ring attention == scanned dense (VERDICT #6: the
+    flagship compiled path can now use context parallelism)."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+    paddle.seed(11)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=32,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            vocab_size=64, max_position_embeddings=32)
+    cfg.scan_layers = True
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.arange(32).reshape(2, 16) % 64)
+    with paddle.no_grad():
+        ref = model(ids).numpy()
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "sep"])
+    cfg.sep_mesh = mesh
+    cfg.sep_axis = "sep"
+    with paddle.no_grad():
+        out = model(ids).numpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_scanned_llama_ring_backward():
+    """Gradients flow through scan-of-ring (training path)."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+    paddle.seed(12)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=32,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            vocab_size=64, max_position_embeddings=32)
+    cfg.scan_layers = True
+    cfg.sep_mesh = ProcessMesh(np.arange(8), ["sep"])
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.arange(16).reshape(1, 16) % 64)
+    labels = paddle.to_tensor((np.arange(16).reshape(1, 16) + 1) % 64)
+    _, loss = model(ids, labels=labels)
+    loss.backward()
+    sc = model.model.layers_scanned
+    assert sc.q_w.grad is not None
+    assert bool(np.isfinite(sc.q_w.grad.numpy()).all())
