@@ -4,25 +4,24 @@ Reference: fleet/meta_parallel/pipeline_parallel.py — PipelineParallel:150
 (1F1B, forward_backward_pipeline:440, train_batch:657),
 PipelineParallelWithInterleave:906 (virtual-pipeline / VPP).
 
-TPU-native redesign (single controller): the host issues forward/backward
-work for every stage; XLA dispatch is asynchronous, so stage s's devices chew
-on micro-batch m while stage s+1's devices run m-1 — the hardware overlap of
-the reference's per-rank 1F1B emerges from dataflow, not from per-rank
-programs. What the host-side 1F1B ORDER still controls is liveness: backward
-of micro-batch m is issued right after warmup so its activations (vjp
-residuals on the stage meshes) release early, bounding in-flight micro-batches
-at num_stages like the reference instead of accumulate_steps like GPipe.
-
-Interleave (VPP) differs from 1F1B only in placement here: chunks are assigned
-round-robin (chunk c on stage c % num_stages, pp_layers segmentation), which
-yields the reference's shallower per-stage model and its bubble profile; the
-host issue order is unchanged because device queues, not issue order, schedule
-the hardware.
+TPU-native redesign (single controller): a schedule PLAN
+(pipeline_schedules.generate_schedule — FThenB / 1F1B / interleaved VPP)
+orders per-(chunk, micro) forward and backward UNITS; the executor walks
+the plan with DETACHED stage boundaries, so each backward unit runs only
+its own chunk's vjp and hands the boundary cotangent to the previous
+chunk's unit — the per-rank p2p grad handoff of the reference
+(pipeline_parallel.py:440, pp_utils/p2p_communication.py:313) becomes an
+explicit cotangent dict. XLA dispatch is asynchronous, so stage s's devices
+chew on micro-batch m while stage s+1's run m-1; the plan controls what
+dispatch cannot: activation liveness (1F1B releases micro m's residuals
+after ~num_stages micros, not accumulate_steps) and chunk interleaving
+(VPP issues chunk-staggered forwards, pipeline_parallel.py:906).
 """
 from __future__ import annotations
 
 from typing import List, Optional
 
+from ...autograd import no_grad
 from ...core.tensor import Tensor
 from ...nn.layer import Layer
 from .p2p_communication import P2pHelper
@@ -58,71 +57,130 @@ class PipelineParallel(Layer):
         self._p2p = P2pHelper(layers._stage_meshes)
         self.total_loss = None
 
-    # -- per-micro-batch units ---------------------------------------------
-    def _forward_step(self, inp, label):
-        """Run one micro-batch through all chunks; PipelineLayer.forward
-        moves activations between stage meshes (_forward_step:732 analog)."""
-        layers = self._layers
-        if layers.num_chunks and layers._stage_meshes[0] is not None:
-            self._p2p.meta.record(
-                inp if isinstance(inp, (list, tuple)) else [inp])
-        x = layers(inp)
-        if layers._loss_fn is not None and label is not None:
-            return layers._loss_fn(x, label)
-        return x
+    # -- schedule plan ------------------------------------------------------
+    _schedule_kind = "1F1B"
 
-    def _backward_step(self, loss, scaler):
-        if scaler is not None:
-            scaled = scaler.scale(loss)
-            scaled.backward()
-        else:
-            loss.backward()
+    def _plan(self, num_micro, forward_only):
+        from .pipeline_schedules import generate_schedule
+        cfg = getattr(self._strategy, "pipeline_configs", {}) or {}
+        kind = cfg.get("schedule_mode", self._schedule_kind)
+        plan = generate_schedule(kind, self.num_stages,
+                                 self._layers.num_chunks, num_micro)
+        if forward_only:
+            plan = [u for u in plan if u[0] == "F"]
+        return list(plan)
 
-    # -- schedules ----------------------------------------------------------
+    @staticmethod
+    def _detach_boundary(x):
+        """Cut the tape at a stage boundary: the chunk's backward then stops
+        at its own input and the cotangent crosses by hand (the p2p analog)."""
+        def cut(t):
+            if isinstance(t, Tensor):
+                d = t.detach()
+                d.stop_gradient = False
+                return d
+            return t
+        if isinstance(x, (list, tuple)):
+            return type(x)(cut(e) for e in x)
+        return cut(x)
+
+    @staticmethod
+    def _boundary_tensors(x):
+        if isinstance(x, (list, tuple)):
+            return [e for e in x if isinstance(e, Tensor)]
+        return [x] if isinstance(x, Tensor) else []
+
     def forward_backward_pipeline(self, data, scaler=None,
                                   forward_only=False):
-        """1F1B (forward_backward_pipeline:440 analog): warmup forwards for
-        min(num_stages, m) micro-batches, then alternate B/F, then drain."""
+        """Plan-driven unit executor (forward_backward_pipeline:440 / :906
+        analog): walks the FThenB/1F1B/VPP plan unit by unit, per-chunk
+        backward via explicit cotangents across detached boundaries."""
+        from ...autograd.engine import run_backward
         inputs, labels = data if isinstance(data, (list, tuple)) and \
             len(data) == 2 else (data, None)
         m = self.accumulate_steps
         micro_in = _split_micro(inputs, m)
         micro_lb = _split_micro(labels, m) if labels is not None else [None] * m
 
+        layers = self._layers
+        C = layers.num_chunks
         inv = 1.0 / m
-        losses: List[Tensor] = []
-        pending: List[Tensor] = []  # forwarded, awaiting backward
-        warmup = m if forward_only else min(self.num_stages, m)
+        has_loss = layers._loss_fn is not None
+        plan = self._plan(m, forward_only)
+        self.schedule_trace = list(plan)
 
-        def fwd(i):
-            out = self._forward_step(micro_in[i], micro_lb[i])
-            if not forward_only and self._layers._loss_fn is not None:
-                out = out * inv
-            losses.append(out)
-            pending.append(out)
+        acts = {}        # (chunk, micro) -> (boundary_in, out)
+        cotangents = {}  # (chunk, micro) -> grads for chunk out's tensors
+        outs: List = [None] * m
 
-        for i in range(warmup):
-            fwd(i)
+        for kind, c, mb in plan:
+            stage = layers.stage_of_chunk(c)
+            if kind == "F":
+                if c == 0:
+                    x = micro_in[mb]
+                    if layers._stage_meshes[0] is not None:
+                        self._p2p.meta.record(
+                            x if isinstance(x, (list, tuple)) else [x])
+                else:
+                    # consume (and free) the producer's boundary activation
+                    x = (acts.pop((c - 1, mb))[1] if forward_only
+                         else acts[(c - 1, mb)][1])
+                # the hop itself is not differentiated: the backward unit
+                # hands the cotangent across by hand (no orphan tape nodes)
+                with no_grad():
+                    x = layers.stage_input(x, stage,
+                                           layers.stage_of_chunk(c - 1)
+                                           if c else None)
+                if not forward_only:
+                    x = self._detach_boundary(x)
+                out = layers.forward_chunk(x, c)
+                if c == C - 1 and has_loss and micro_lb[mb] is not None:
+                    out = layers._loss_fn(out, micro_lb[mb])
+                    if not forward_only:
+                        out = out * inv
+                    outs[mb] = out
+                elif c == C - 1:
+                    outs[mb] = out
+                if not forward_only or c < C - 1:
+                    acts[(c, mb)] = (x, out)
+            else:  # backward unit
+                x_in, out = acts.pop((c, mb))
+                roots = self._boundary_tensors(out)
+                if c == C - 1 and has_loss:
+                    loss = out if scaler is None else scaler.scale(out)
+                    run_backward([loss], [None])
+                else:
+                    grads = cotangents.pop((c, mb))
+                    pairs = [(t, g) for t, g in zip(roots, grads)
+                             if g is not None]
+                    if pairs:
+                        run_backward([t for t, _ in pairs],
+                                     [g for _, g in pairs])
+                if c > 0:
+                    # hand the boundary cotangent to the previous chunk,
+                    # hopping it onto that chunk's stage mesh (the reverse
+                    # p2p of p2p_communication.py:313)
+                    prev_stage = layers.stage_of_chunk(c - 1)
+                    cotangents[(c - 1, mb)] = [
+                        None if t.grad is None else layers.stage_input(
+                            t.grad, prev_stage, stage)
+                        for t in self._boundary_tensors(x_in)]
+
         if not forward_only:
-            for i in range(m - warmup):
-                self._backward_step(pending.pop(0), scaler)
-                fwd(warmup + i)
-            while pending:
-                self._backward_step(pending.pop(0), scaler)
             self._sync_shared_grads()
 
-        if self._layers._loss_fn is not None:
-            total = losses[0]
-            for l in losses[1:]:
+        if has_loss:
+            total = outs[0]
+            for l in outs[1:]:
                 total = total + l
             self.total_loss = total if not forward_only else total * inv
             return self.total_loss
         # no loss_fn: stitch the micro-batch outputs back into the full batch
         import paddle_tpu as paddle
-        if isinstance(losses[0], tuple):
-            return tuple(paddle.concat([o[i] for o in losses], axis=0)
-                         for i in range(len(losses[0])))
-        return paddle.concat(losses, axis=0) if len(losses) > 1 else losses[0]
+        if isinstance(outs[0], tuple):
+            return tuple(paddle.concat([o[i] for o in outs], axis=0)
+                         for i in range(len(outs[0])))
+        return paddle.concat(outs, axis=0) if len(outs) > 1 else outs[0]
 
     def _sync_shared_grads(self):
         """Sum gradients of shared-weight copies across their stages and
@@ -183,9 +241,13 @@ class PipelineParallel(Layer):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """pipeline_parallel.py:906 analog. Placement (round-robin chunks) is done
-    by PipelineLayer(num_virtual_pipeline_stages>1); the host order is shared
-    with 1F1B — see module docstring for why that preserves VPP semantics."""
+    """pipeline_parallel.py:906 analog: round-robin chunk placement
+    (PipelineLayer with num_virtual_pipeline_stages>1) PLUS the chunked-1F1B
+    issue order — forwards of different chunks interleave across micros per
+    the Megatron VPP warmup quota, shrinking the bubble relative to plain
+    1F1B (see pipeline_schedules.generate_schedule)."""
+
+    _schedule_kind = "VPP"
 
     def __init__(self, layers: PipelineLayer, hcg, strategy):
         super().__init__(layers, hcg, strategy)

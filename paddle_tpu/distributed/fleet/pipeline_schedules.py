@@ -1,0 +1,146 @@
+"""Pipeline schedule PLANS: FThenB, 1F1B, interleaved VPP.
+
+Reference: the static pass builds per-rank Job lists
+(passes/pipeline_scheduler_pass.py — FThenB/1F1B/VPP plans run by the
+multi-job StandaloneExecutor, new_executor/interpreter/plan.h), and the
+dygraph schedules hand-code the same orders
+(fleet/meta_parallel/pipeline_parallel.py:440 1F1B, :906 interleave).
+
+TPU-native: a plan is a host-side issue ORDER over (F|B, chunk, micro)
+units with detached stage boundaries. XLA's async dispatch turns the order
+into device-level overlap, and each chunk's forward/backward compiles once
+and is reused across micro-batches — the per-job programs of the reference
+collapse into the executable cache. The plan still controls the two things
+the compiler cannot: activation liveness (when a micro-batch's residuals
+are released) and cross-chunk issue interleaving.
+
+The generator SIMULATES the per-stage timeline round by round: each round,
+every stage issues at most one ready unit, picked by the schedule's policy
+(FThenB: all forwards first; 1F1B/VPP: forwards until the Megatron warmup
+quota, then alternate, then drain). The emitted global order is the merged
+timeline, so per-stage in-flight activations match the reference's bubble
+profile instead of GPipe's O(num_micro).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+Unit = Tuple[str, int, int]  # ("F"|"B", chunk, micro)
+
+
+def warmup_quota(kind: str, num_stages: int, num_virtual: int,
+                 num_micro: int) -> List[int]:
+    """Per-stage forward-warmup quota before backwards interleave."""
+    total = num_micro * num_virtual
+    if kind == "FThenB":
+        return [total] * num_stages
+    if num_virtual == 1:  # classic 1F1B (pipeline_parallel.py:440)
+        return [min(num_micro, num_stages - s) for s in range(num_stages)]
+    # interleaved VPP (pipeline_parallel.py:906 / Megatron chunked 1F1B)
+    return [min(total, (num_stages - s - 1) * 2 + (num_virtual - 1)
+                * num_stages) for s in range(num_stages)]
+
+
+@functools.lru_cache(maxsize=64)
+def generate_schedule(kind: str, num_stages: int, num_chunks: int,
+                      num_micro: int) -> List[Unit]:
+    """Global issue order for all (chunk, micro) forward+backward units.
+
+    Dependencies honored: F(c,m) after F(c-1,m); B(c,m) after F(c,m) and
+    B(c+1,m). One unit per stage per round (stage = chunk % num_stages).
+    Memoized: the plan depends only on its four arguments, and generation
+    is pure-Python — without the cache it would stall every train_batch.
+    """
+    if kind not in ("FThenB", "1F1B", "VPP"):
+        raise ValueError(f"unknown pipeline schedule {kind!r}")
+    S, C, M = num_stages, num_chunks, num_micro
+    V = C // S
+    warm = warmup_quota(kind, S, V, M)
+
+    done_f, done_b = set(), set()
+    fcount = [0] * S
+    plan: List[Unit] = []
+
+    def f_ready(s):
+        out = [(m, c) for c in range(s, C, S) for m in range(M)
+               if (c, m) not in done_f
+               and (c == 0 or (c - 1, m) in done_f)]
+        return min(out) if out else None
+
+    def b_ready(s):
+        out = [(m, c) for c in range(s, C, S) for m in range(M)
+               if (c, m) in done_f and (c, m) not in done_b
+               and (c == C - 1 or (c + 1, m) in done_b)]
+        return min(out) if out else None
+
+    total = 2 * C * M
+    while len(plan) < total:
+        progressed = False
+        for s in range(S):
+            fr = f_ready(s)
+            br = b_ready(s)
+            pick = None
+            if kind == "FThenB":
+                pick = ("F", fr) if fr is not None else ("B", br)
+            else:
+                if fcount[s] < warm[s] and fr is not None:
+                    pick = ("F", fr)
+                elif br is not None:
+                    pick = ("B", br)
+                elif fr is not None:
+                    pick = ("F", fr)
+            if pick is None or pick[1] is None:
+                continue
+            knd, (m, c) = pick
+            if knd == "F":
+                done_f.add((c, m))
+                fcount[s] += 1
+            else:
+                done_b.add((c, m))
+            plan.append((knd, c, m))
+            progressed = True
+        if not progressed:  # safety: issue ANY globally ready unit
+            for s in range(S):
+                fr = f_ready(s)
+                if fr is not None:
+                    m, c = fr
+                    done_f.add((c, m))
+                    fcount[s] += 1
+                    plan.append(("F", c, m))
+                    progressed = True
+                    break
+            if not progressed:
+                raise RuntimeError("pipeline schedule deadlock (bug)")
+    return tuple(plan)
+
+
+def validate_schedule(plan: List[Unit], num_chunks: int,
+                      num_micro: int) -> None:
+    """Assert the dependency order (used by tests; cheap enough for CI)."""
+    done_f, done_b = set(), set()
+    for kind, c, m in plan:
+        if kind == "F":
+            assert c == 0 or (c - 1, m) in done_f, f"F({c},{m}) too early"
+            done_f.add((c, m))
+        else:
+            assert (c, m) in done_f, f"B({c},{m}) before its F"
+            assert c == num_chunks - 1 or (c + 1, m) in done_b, \
+                f"B({c},{m}) before B({c + 1},{m})"
+            done_b.add((c, m))
+    assert len(done_f) == len(done_b) == num_chunks * num_micro
+
+
+def max_inflight_per_stage(plan: List[Unit], num_stages: int) -> List[int]:
+    """Peak live (forwarded, not yet backwarded) units per stage — the
+    activation-memory profile the schedule exists to bound."""
+    live = [0] * num_stages
+    peak = [0] * num_stages
+    for kind, c, m in plan:
+        s = c % num_stages
+        if kind == "F":
+            live[s] += 1
+            peak[s] = max(peak[s], live[s])
+        else:
+            live[s] -= 1
+    return peak
