@@ -191,12 +191,32 @@ class CollectiveController:
                 env=env, log_path=log_path, rank=rank))
         return self
 
+    def _collate_logs(self):
+        """Merge per-rank workerlogs into one rank-prefixed stream
+        (the reference launcher's log aggregation; one file to read
+        instead of N) — written as <log_dir>/collated.log."""
+        ctx = self.ctx
+        if not ctx.log_dir:
+            return
+        try:
+            path = os.path.join(ctx.log_dir, "collated.log")
+            with open(path, "w") as out:
+                for c in sorted(self.pod.containers, key=lambda c: c.rank):
+                    if not c.log_path or not os.path.exists(c.log_path):
+                        continue
+                    with open(c.log_path, errors="replace") as f:
+                        for line in f:
+                            out.write(f"[rank {c.rank}] {line}")
+        except OSError:  # collation must never fail the job
+            pass
+
     def run(self) -> int:
         ctx = self.ctx
         restarts = 0
         while True:
             self.pod.deploy()
             code = self.pod.join()
+            self._collate_logs()
             if code == 0:
                 return 0
             restarts += 1

@@ -128,4 +128,53 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+class BucketBatchingPredictor:
+    """Dynamic request batching over an AOT export (the serving-relevant
+    analog of AnalysisPredictor's zero-copy batch path,
+    analysis_predictor.h:100, rebuilt for XLA's compilation model).
+
+    XLA compiles per shape, so free-form batch sizes would retrace per
+    request. Requests are padded up to the nearest BUCKET batch size
+    instead: each bucket compiles once, every later request in that bucket
+    reuses the executable, and the pad rows are sliced off the outputs.
+    """
+
+    def __init__(self, predictor: Predictor, buckets=(1, 2, 4, 8, 16, 32)):
+        self._p = predictor
+        self.buckets = tuple(sorted(buckets))
+        self.max_batch = self.buckets[-1]
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch {n} exceeds max bucket {self.max_batch}")
+
+    def run_batch(self, requests: List[List[np.ndarray]]):
+        """requests: one [input_arrays] list per request; every array MUST
+        carry its batch dim (shape [1, ...] for single items — a bare
+        feature vector would be concatenated along the wrong axis).
+        Returns one output list per request."""
+        if not requests:
+            return []
+        n = len(requests)
+        bucket = self._bucket(n)
+        stacked = []
+        for i in range(len(requests[0])):
+            rows = [np.asarray(r[i]) for r in requests]
+            batch = np.concatenate(rows, axis=0)
+            pad = bucket * rows[0].shape[0] - batch.shape[0]
+            if pad:
+                batch = np.concatenate(
+                    [batch, np.repeat(batch[-1:], pad, axis=0)], axis=0)
+            stacked.append(batch)
+        outs = self._p.run(stacked)
+        per = outs[0].shape[0] // bucket
+        results = []
+        for r in range(n):
+            results.append([o[r * per:(r + 1) * per] for o in outs])
+        return results
+
+
+__all__ = ["Config", "Predictor", "BucketBatchingPredictor",
+           "create_predictor"]

@@ -19,12 +19,14 @@ dynamic-shape fallbacks): RNG draws (the frozen closure would replay one
 mask forever) and in-trace backward() (the tape does not pass through
 dispatch).
 
-Semantics note (same as to_static whole-graph capture): python-level
-constants the function reads — globals, closure variables, layer python
-attributes — are baked in at record time; only Tensor values stay live
-across replays (externals resolve to their current data every call).
-Guards cover tensor materializations, not python state. Code that flips a
-python flag between calls must keep that flag in a Tensor or stay eager.
+Python-state guards (reference SOT guards python values too,
+jit/sot/opcode_translator/executor/function_graph.py:143): each recording
+is keyed by a FINGERPRINT of the python state the function can read —
+referenced globals, closure cells, and simple attributes of Layer
+arguments (``training``, user flags). Flipping any of those re-records
+under the new fingerprint instead of replaying a stale trie. Values the
+fingerprint cannot capture (opaque mutable objects) remain baked in at
+record time — mutate such state in a Tensor or stay eager.
 """
 from __future__ import annotations
 
@@ -53,6 +55,57 @@ def _guard_value(kind: str, value):
                 value.shape, str(value.dtype))
     return (kind, value if not isinstance(value, (list, tuple))
             else tuple(value))
+
+
+def _fingerprint_value(v):
+    """One python value -> hashable guard token. Simple scalars guard by
+    VALUE; modules/types/functions by identity (stable); anything else is
+    opaque (unguardable — documented record-time bake-in)."""
+    import types
+    if isinstance(v, (bool, int, float, str, bytes, type(None))):
+        return ("v", v)
+    if isinstance(v, (types.ModuleType, type, types.FunctionType,
+                      types.BuiltinFunctionType, types.MethodType)):
+        return ("id", id(v))
+    if isinstance(v, (list, tuple)) and len(v) <= 8 and all(
+            isinstance(e, (bool, int, float, str, type(None))) for e in v):
+        return ("seq", tuple(v))
+    return ("opaque", type(v).__name__)
+
+
+def python_state_fingerprint(fn, args, kwargs):
+    """Hashable snapshot of the python state a traced run may read:
+    globals named in the code object, closure cells, and simple public
+    attributes (+ ``training``) of any Layer in the arguments."""
+    items = []
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        g = getattr(fn, "__globals__", {})
+        for n in sorted(set(code.co_names)):
+            if n in g:
+                items.append((("g", n), _fingerprint_value(g[n])))
+        cells = getattr(fn, "__closure__", None) or ()
+        for name, cell in zip(code.co_freevars, cells):
+            try:
+                items.append((("c", name),
+                              _fingerprint_value(cell.cell_contents)))
+            except ValueError:  # pragma: no cover - empty cell
+                pass
+    from ..nn.layer import Layer
+    leaves = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, (Tensor, Layer)))[0]
+    bound_self = getattr(fn, "__self__", None)
+    if isinstance(bound_self, Layer):
+        leaves = [bound_self] + list(leaves)
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, Layer):
+            attrs = [("training", leaf.training)]
+            for k, v in sorted(leaf.__dict__.items()):
+                if not k.startswith("_") and isinstance(
+                        v, (bool, int, float, str, type(None))):
+                    attrs.append((k, v))
+            items.append((("layer", i), tuple(attrs)))
+    return tuple(items)
 
 
 class _OpRecord:
@@ -155,16 +208,26 @@ class SOTCache:
     MAX_RECORDINGS_WITHOUT_REPLAY = 8
     MAX_TRIE_CHILDREN = 16
 
+    MAX_PY_STATE_VARIANTS = 8
+
     def __init__(self, fn):
         self._fn = fn
-        self._root: Optional[_TrieNode] = None
+        # one guard trie per python-state fingerprint: flipping a guarded
+        # python flag re-records under its own root instead of replaying
+        # the stale trie
+        self._roots: Dict[Any, _TrieNode] = {}
         self._externals: List[Any] = []
         self._always_eager: Optional[str] = None
         self._record_count = 0
         self._replay_hits = 0
 
     # -- recording ----------------------------------------------------------
-    def _record(self, args, kwargs):
+    def _record(self, args, kwargs, fp=None):
+        # fingerprint BEFORE the run: the traced function may mutate its own
+        # guarded python state, and the trace belongs to the state that
+        # PRODUCED it, not the state left behind
+        if fp is None:
+            fp = python_state_fingerprint(self._fn, args, kwargs)
         self._record_count += 1
         if self._record_count > self.MAX_RECORDINGS_WITHOUT_REPLAY \
                 and self._replay_hits == 0:
@@ -190,10 +253,14 @@ class SOTCache:
         if rec.invalid:
             self._always_eager = rec.invalid
             return out
-        self._merge(rec, out)
+        if fp not in self._roots and \
+                len(self._roots) >= self.MAX_PY_STATE_VARIANTS:
+            self._always_eager = "python-state fan-out exceeded cap"
+            return out
+        self._merge(rec, out, fp)
         return out
 
-    def _merge(self, rec: _Recorder, out):
+    def _merge(self, rec: _Recorder, out, fp=None):
         # externals are merged by object identity across recordings
         ext_map = {}
         for i, t in enumerate(rec.externals):
@@ -250,10 +317,10 @@ class SOTCache:
             else:
                 leaf_descr.append(("static", leaf))
 
-        # walk/extend the trie segment by segment
-        if self._root is None:
-            self._root = _TrieNode()
-        node = self._root
+        # walk/extend this fingerprint's trie segment by segment
+        if fp not in self._roots:
+            self._roots[fp] = _TrieNode()
+        node = self._roots[fp]
         lo = 0
         for si, hi in enumerate(bounds):
             if node.seg_fn is None:
@@ -321,8 +388,11 @@ class SOTCache:
     def run(self, args, kwargs):
         if self._always_eager is not None:
             return self._fn(*args, **kwargs)
-        if self._root is None:
-            return self._record(args, kwargs)
+        fp = python_state_fingerprint(self._fn, args, kwargs)
+        node = self._roots.get(fp)
+        if node is None:
+            # unseen python state: record fresh under its own fingerprint
+            return self._record(args, kwargs, fp)
 
         from ..ops import registry as _registry
         flat = jax.tree_util.tree_flatten((args, kwargs),
@@ -337,12 +407,11 @@ class SOTCache:
                 return self._externals[ref[1]]
             return env[ref]
 
-        node = self._root
         while True:
             if node.seg_fn is None:
                 # path recorded structurally but never compiled (shouldn't
                 # happen) — re-record to be safe
-                return self._record(args, kwargs)
+                return self._record(args, kwargs, fp)
             if node.ops_hi > node.ops_lo:
                 ins = [resolve(r) for r in node.seg_in_refs]
                 outs = _registry.dispatch(node.seg_fn, tuple(ins), {},
@@ -366,7 +435,7 @@ class SOTCache:
                     self._always_eager = "guard fan-out exceeded cap"
                     return self._fn(*args, **kwargs)
                 # novel branch: eager re-record extends the trie
-                return self._record(args, kwargs)
+                return self._record(args, kwargs, fp)
             node = child
 
     @staticmethod

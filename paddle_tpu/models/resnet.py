@@ -146,61 +146,80 @@ class ResNet(Layer):
         return x
 
 
-def resnet18(**kwargs):
-    return ResNet(BasicBlock, [2, 2, 2, 2], **kwargs)
+def _load_pretrained(model, arch):
+    from ..utils.checkpoint_converter import load_pretrained
+    load_pretrained(model, arch)
+    return model
 
 
-def resnet34(**kwargs):
-    return ResNet(BasicBlock, [3, 4, 6, 3], **kwargs)
+def resnet18(pretrained=False, **kwargs):
+    model = ResNet(BasicBlock, [2, 2, 2, 2], **kwargs)
+    return _load_pretrained(model, "resnet18") if pretrained else model
 
 
-def resnet50(**kwargs):
-    return ResNet(BottleneckBlock, [3, 4, 6, 3], **kwargs)
+def resnet34(pretrained=False, **kwargs):
+    model = ResNet(BasicBlock, [3, 4, 6, 3], **kwargs)
+    return _load_pretrained(model, "resnet34") if pretrained else model
 
 
-def resnet101(**kwargs):
-    return ResNet(BottleneckBlock, [3, 4, 23, 3], **kwargs)
+def resnet50(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 4, 6, 3], **kwargs)
+    return _load_pretrained(model, "resnet50") if pretrained else model
 
 
-def resnet152(**kwargs):
-    return ResNet(BottleneckBlock, [3, 8, 36, 3], **kwargs)
+def resnet101(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 4, 23, 3], **kwargs)
+    return _load_pretrained(model, "resnet101") if pretrained else model
 
 
-def resnext50_32x4d(**kwargs):
-    return ResNet(BottleneckBlock, [3, 4, 6, 3], groups=32,
+def resnet152(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 8, 36, 3], **kwargs)
+    return _load_pretrained(model, "resnet152") if pretrained else model
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 4, 6, 3], groups=32,
                   width_per_group=4, **kwargs)
+    return _load_pretrained(model, "resnext50_32x4d") if pretrained else model
 
 
-def resnext50_64x4d(**kwargs):
-    return ResNet(BottleneckBlock, [3, 4, 6, 3], groups=64,
+def resnext50_64x4d(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 4, 6, 3], groups=64,
                   width_per_group=4, **kwargs)
+    return _load_pretrained(model, "resnext50_64x4d") if pretrained else model
 
 
-def resnext101_32x4d(**kwargs):
-    return ResNet(BottleneckBlock, [3, 4, 23, 3], groups=32,
+def resnext101_32x4d(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 4, 23, 3], groups=32,
                   width_per_group=4, **kwargs)
+    return _load_pretrained(model, "resnext101_32x4d") if pretrained else model
 
 
-def resnext101_64x4d(**kwargs):
-    return ResNet(BottleneckBlock, [3, 4, 23, 3], groups=64,
+def resnext101_64x4d(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 4, 23, 3], groups=64,
                   width_per_group=4, **kwargs)
+    return _load_pretrained(model, "resnext101_64x4d") if pretrained else model
 
 
-def resnext152_32x4d(**kwargs):
-    return ResNet(BottleneckBlock, [3, 8, 36, 3], groups=32,
+def resnext152_32x4d(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 8, 36, 3], groups=32,
                   width_per_group=4, **kwargs)
+    return _load_pretrained(model, "resnext152_32x4d") if pretrained else model
 
 
-def resnext152_64x4d(**kwargs):
-    return ResNet(BottleneckBlock, [3, 8, 36, 3], groups=64,
+def resnext152_64x4d(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 8, 36, 3], groups=64,
                   width_per_group=4, **kwargs)
+    return _load_pretrained(model, "resnext152_64x4d") if pretrained else model
 
 
-def wide_resnet50_2(**kwargs):
-    return ResNet(BottleneckBlock, [3, 4, 6, 3], width_per_group=128,
+def wide_resnet50_2(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 4, 6, 3], width_per_group=128,
                   **kwargs)
+    return _load_pretrained(model, "wide_resnet50_2") if pretrained else model
 
 
-def wide_resnet101_2(**kwargs):
-    return ResNet(BottleneckBlock, [3, 4, 23, 3], width_per_group=128,
+def wide_resnet101_2(pretrained=False, **kwargs):
+    model = ResNet(BottleneckBlock, [3, 4, 23, 3], width_per_group=128,
                   **kwargs)
+    return _load_pretrained(model, "wide_resnet101_2") if pretrained else model

@@ -72,3 +72,47 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
     precision. TPU form: the dequantized GEMM IS the fast path, so the
     outlier split reduces to the same computation."""
     return weight_only_linear(x, weight, bias, weight_scale)
+
+
+class WeightOnlyLinear(Layer):
+    """A Linear whose weight is stored int8/int4 per-channel quantized;
+    forward dequantizes on the fly (weight_only_linear). HBM for weights
+    drops 4x/8x — the reference's serving path for LLM decode
+    (nn/quant/quantized_linear.py), with XLA fusing dequant into the GEMM."""
+
+    def __init__(self, linear, algo: str = "weight_only_int8"):
+        super().__init__()
+        self.algo = algo
+        qw, scale = weight_quantize(linear.weight, algo)
+        qw.stop_gradient = True
+        scale.stop_gradient = True
+        self.quant_weight = qw
+        self.weight_scale = scale
+        self.bias = getattr(linear, "bias", None)
+        self.in_features = linear.weight.shape[0]
+        self.out_features = linear.weight.shape[1]
+
+    def forward(self, x):
+        return weight_only_linear(x, self.quant_weight, self.bias,
+                                  self.weight_scale,
+                                  weight_dtype="int4" if "int4" in self.algo
+                                  else "int8")
+
+
+def quantize_linear_layers(model, algo: str = "weight_only_int8",
+                           min_features: int = 1):
+    """Swap every nn.Linear sublayer for WeightOnlyLinear in place
+    (serving-side module pass; the reference routes this through
+    quantization passes + cutlass kernels). Returns the count swapped."""
+    from .. import Linear as _Linear
+    swapped = 0
+    for layer in [model] + [s for s in model.sublayers()]:
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _Linear) and \
+                    sub.weight.shape[0] >= min_features:
+                layer._sub_layers[name] = WeightOnlyLinear(sub, algo)
+                swapped += 1
+    return swapped
+
+
+__all__ += ["WeightOnlyLinear", "quantize_linear_layers"]

@@ -1,0 +1,116 @@
+"""Reference-checkpoint converter: .pdparams/.pdopt -> paddle_tpu state dict.
+
+The reference's ``paddle.save`` pickles state dicts with a custom reducer
+(framework/io.py:355 ``_pickle_save``): each Tensor/EagerParamBase becomes
+``tuple((name, numpy_array))`` — so a saved ``.pdparams`` unpickles with NO
+paddle installation into nested dicts of ``(name, ndarray)`` tuples (plus a
+``StructuredToParameterName@@`` name table from
+``_build_saved_state_dict:128``). Older 2.0-era saves hold plain ndarrays.
+
+This module loads those files offline and normalizes them to
+``{structured_name: np.ndarray}``, so ``pretrained=True`` in the vision zoo
+(reference python/paddle/vision/models/resnet.py model_urls download path)
+works from a LOCAL weights directory — this image has zero egress, so the
+download half of the reference flow is out of scope by design; drop the
+official ``.pdparams`` files into ``$PADDLE_TPU_PRETRAINED_HOME`` instead.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict
+
+import numpy as np
+
+NAME_TABLE_KEY = "StructuredToParameterName@@"
+
+PRETRAINED_HOME_ENV = "PADDLE_TPU_PRETRAINED_HOME"
+_DEFAULT_HOME = os.path.join("~", ".cache", "paddle_tpu", "checkpoints")
+
+
+def pretrained_home() -> str:
+    return os.path.expanduser(
+        os.environ.get(PRETRAINED_HOME_ENV, _DEFAULT_HOME))
+
+
+def _normalize(value):
+    """One saved leaf -> np.ndarray (handles every reference save era)."""
+    if isinstance(value, tuple) and len(value) == 2 and \
+            isinstance(value[1], np.ndarray):
+        return value[1]  # paddle>=2.1 reduce_varbase: (tensor_name, data)
+    if isinstance(value, np.ndarray):
+        return value
+    return value  # non-tensor entry (python scalar, LR, step counters...)
+
+
+def load_pdparams(path: str) -> Dict[str, np.ndarray]:
+    """Unpickle a reference ``.pdparams``/``.pdopt`` file to flat numpy.
+
+    Nested dicts (optimizer states) keep their structure; tensor leaves are
+    normalized; the name table is dropped (structured names ARE the keys).
+    """
+    with open(path, "rb") as f:
+        raw = pickle.load(f, encoding="latin1")
+    return convert_state_dict(raw)
+
+
+def convert_state_dict(raw) -> Dict[str, np.ndarray]:
+    if not isinstance(raw, dict):
+        return _normalize(raw)
+    out = {}
+    for key, value in raw.items():
+        if key == NAME_TABLE_KEY:
+            continue
+        if isinstance(value, dict):
+            out[key] = convert_state_dict(value)
+        else:
+            out[key] = _normalize(value)
+    return out
+
+
+def load_pretrained(model, arch: str, path: str = None):
+    """Load converted reference weights into ``model``.
+
+    path defaults to ``$PADDLE_TPU_PRETRAINED_HOME/<arch>.pdparams``. Raises
+    with download-free instructions when the file is absent; reports key
+    mismatches loudly instead of silently skipping.
+    """
+    if path is None:
+        path = os.path.join(pretrained_home(), f"{arch}.pdparams")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{arch}(pretrained=True): no weights at {path}. This "
+            f"environment has no network egress; obtain the official "
+            f"'{arch}.pdparams' (reference vision/models model_urls) and "
+            f"place it there, or set ${PRETRAINED_HOME_ENV}.")
+    state = load_pdparams(path)
+    own = model.state_dict()
+    missing = [k for k in own if k not in state]
+    unexpected = [k for k in state if k not in own]
+    if missing or unexpected:
+        raise ValueError(
+            f"{arch}: checkpoint/model key mismatch — missing "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''}, unexpected "
+            f"{unexpected[:5]}{'...' if len(unexpected) > 5 else ''}")
+    model.set_state_dict(state)
+    return model
+
+
+def save_pdparams(state_dict, path: str):
+    """Write a state dict in the REFERENCE pickle format ((name, ndarray)
+    tuples + name table), so checkpoints round-trip to actual paddle."""
+    save_dict = {}
+    name_table = {}
+    for key, value in state_dict.items():
+        data = value
+        if hasattr(value, "numpy"):
+            data = value.numpy()
+        if isinstance(data, np.ndarray):
+            # a real paddle unpickles its reduce_varbase to exactly this
+            save_dict[key] = (key, data)
+            name_table[key] = key
+        else:
+            save_dict[key] = data
+    save_dict[NAME_TABLE_KEY] = name_table
+    with open(path, "wb") as f:
+        pickle.dump(save_dict, f, protocol=4)

@@ -1,0 +1,105 @@
+"""Global monitor gauges + peak trackers.
+
+Reference: paddle/fluid/platform/monitor.h (STATS_INT registry — named
+int64 gauges sampled by the framework and exported for observability) and
+fluid/memory/stats.h peak trackers (DEVICE_MEMORY_STAT_CURRENT_VALUE /
+PEAK_VALUE). TPU-native: gauges live in the C++ stat registry
+(csrc/native.cc — cross-thread, shared with the data-loader and tracer
+tiers) with a pure-python fallback; peaks track alongside; device memory
+gauges sample PJRT's memory_stats.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..core import native as _native
+
+_PEAKS: Dict[str, int] = {}
+_PY_STATS: Dict[str, int] = {}  # fallback when the C++ tier is unavailable
+
+
+def _update_raw(name: str, delta: int) -> int:
+    try:
+        v = _native.stat_update(name, delta)
+        return v[0] if isinstance(v, tuple) else v
+    except Exception:
+        _PY_STATS[name] = _PY_STATS.get(name, 0) + delta
+        return _PY_STATS[name]
+
+
+def stat_update(name: str, delta: int = 1) -> int:
+    """Add delta to gauge `name`; tracks the peak (STATS_INT analog)."""
+    cur = _update_raw(name, int(delta))
+    if cur > _PEAKS.get(name, cur - 1):
+        _PEAKS[name] = cur
+    return cur
+
+
+def _native_get(name: str):
+    """Native registry entry as (current, peak), or None."""
+    try:
+        v = _native.stat_get(name)
+    except Exception:
+        return None
+    if isinstance(v, tuple):
+        return v
+    return (v, v) if v is not None else None
+
+
+def stat_get(name: str) -> int:
+    v = _native_get(name)
+    if v is not None:
+        return v[0]
+    return _PY_STATS.get(name, 0)
+
+
+def stat_peak(name: str) -> int:
+    """Peak value seen through stat_update (PEAK_VALUE analog — the C++
+    registry tracks it natively; the python fallback tracks it here)."""
+    v = _native_get(name)
+    if v is not None:
+        return max(v[1], _PEAKS.get(name, v[1]))
+    return _PEAKS.get(name, stat_get(name))
+
+
+def stat_reset(name: str) -> None:
+    try:
+        _native.stat_reset(name)
+    except Exception:
+        pass
+    _PY_STATS.pop(name, None)
+    _PEAKS.pop(name, None)
+
+
+def get_monitor_values() -> Dict[str, int]:
+    """Snapshot every gauge's current value (native + python merged)."""
+    out = dict(_PY_STATS)
+    try:
+        for name, v in (_native.stat_all() or {}).items():
+            out[name] = v[0] if isinstance(v, tuple) else v
+    except Exception:
+        pass
+    return out
+
+
+def sample_device_memory(prefix: str = "device_memory") -> Dict[str, int]:
+    """Sample PJRT memory stats into gauges (memory/stats.h sampling)."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            name = f"{prefix}.{key}"
+            cur = int(stats[key])
+            delta = cur - stat_get(name)
+            if delta:
+                stat_update(name, delta)
+            out[name] = cur
+    return out
+
+
+__all__ = ["stat_update", "stat_get", "stat_peak", "stat_reset",
+           "get_monitor_values", "sample_device_memory"]

@@ -16,10 +16,14 @@ from ..nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Flatten,
                   Layer, Linear, MaxPool2D, ReLU, ReLU6, Sequential)
 
 
-def _no_pretrained(name):
-    raise RuntimeError(f"{name}(pretrained=True): pretrained weights are not "
-                       f"available in this environment (no egress); pass "
-                       f"pretrained=False and load a local state dict.")
+def _load_pretrained_weights(model, name):
+    """pretrained=True: load reference .pdparams weights from the LOCAL
+    pretrained home (reference model_urls download path; this environment
+    has no egress, so the fetch half is a user-supplied file — see
+    utils.checkpoint_converter)."""
+    from ..utils.checkpoint_converter import load_pretrained
+    load_pretrained(model, name)
+    return model
 
 
 class LeNet(Layer):
@@ -90,9 +94,10 @@ def _vgg_features(cfg, batch_norm=False):
 
 
 def _make_vgg(depth, batch_norm, pretrained, **kwargs):
+    model = VGG(_vgg_features(_VGG_CFGS[depth], batch_norm), **kwargs)
     if pretrained:
-        _no_pretrained(f"vgg{depth}")
-    return VGG(_vgg_features(_VGG_CFGS[depth], batch_norm), **kwargs)
+        _load_pretrained_weights(model, f"vgg{depth}")
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
@@ -152,9 +157,10 @@ class MobileNetV1(Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV1(scale=scale, **kwargs)
     if pretrained:
-        _no_pretrained("mobilenet_v1")
-    return MobileNetV1(scale=scale, **kwargs)
+        _load_pretrained_weights(model, "mobilenet_v1")
+    return model
 
 
 class _InvertedResidual(Layer):
@@ -210,9 +216,10 @@ class MobileNetV2(Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV2(scale=scale, **kwargs)
     if pretrained:
-        _no_pretrained("mobilenet_v2")
-    return MobileNetV2(scale=scale, **kwargs)
+        _load_pretrained_weights(model, "mobilenet_v2")
+    return model
 
 
 from .models_extra import (  # noqa: E402

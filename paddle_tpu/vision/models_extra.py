@@ -13,7 +13,7 @@ from ..nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout,
                   Flatten, Hardsigmoid, Hardswish, Layer, Linear, MaxPool2D,
                   ReLU, Sequential, Swish)
 from ..nn import functional as F
-from .models import _no_pretrained
+from .models import _load_pretrained_weights
 
 
 def _concat(xs):
@@ -55,9 +55,10 @@ class AlexNet(Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
+    model = AlexNet(**kwargs)
     if pretrained:
-        _no_pretrained("alexnet")
-    return AlexNet(**kwargs)
+        _load_pretrained_weights(model, "alexnet")
+    return model
 
 
 # ---------------------------------------------------------------------------
@@ -124,15 +125,17 @@ class SqueezeNet(Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
+    model = SqueezeNet("1.0", **kwargs)
     if pretrained:
-        _no_pretrained("squeezenet1_0")
-    return SqueezeNet("1.0", **kwargs)
+        _load_pretrained_weights(model, "squeezenet1_0")
+    return model
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
+    model = SqueezeNet("1.1", **kwargs)
     if pretrained:
-        _no_pretrained("squeezenet1_1")
-    return SqueezeNet("1.1", **kwargs)
+        _load_pretrained_weights(model, "squeezenet1_1")
+    return model
 
 
 # ---------------------------------------------------------------------------
@@ -220,33 +223,38 @@ class DenseNet(Layer):
 
 
 def densenet121(pretrained=False, **kwargs):
+    model = DenseNet(121, **kwargs)
     if pretrained:
-        _no_pretrained("densenet121")
-    return DenseNet(121, **kwargs)
+        _load_pretrained_weights(model, "densenet121")
+    return model
 
 
 def densenet161(pretrained=False, **kwargs):
+    model = DenseNet(161, **kwargs)
     if pretrained:
-        _no_pretrained("densenet161")
-    return DenseNet(161, **kwargs)
+        _load_pretrained_weights(model, "densenet161")
+    return model
 
 
 def densenet169(pretrained=False, **kwargs):
+    model = DenseNet(169, **kwargs)
     if pretrained:
-        _no_pretrained("densenet169")
-    return DenseNet(169, **kwargs)
+        _load_pretrained_weights(model, "densenet169")
+    return model
 
 
 def densenet201(pretrained=False, **kwargs):
+    model = DenseNet(201, **kwargs)
     if pretrained:
-        _no_pretrained("densenet201")
-    return DenseNet(201, **kwargs)
+        _load_pretrained_weights(model, "densenet201")
+    return model
 
 
 def densenet264(pretrained=False, **kwargs):
+    model = DenseNet(264, **kwargs)
     if pretrained:
-        _no_pretrained("densenet264")
-    return DenseNet(264, **kwargs)
+        _load_pretrained_weights(model, "densenet264")
+    return model
 
 
 # ---------------------------------------------------------------------------
@@ -338,9 +346,10 @@ class GoogLeNet(Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
+    model = GoogLeNet(**kwargs)
     if pretrained:
-        _no_pretrained("googlenet")
-    return GoogLeNet(**kwargs)
+        _load_pretrained_weights(model, "googlenet")
+    return model
 
 
 # ---------------------------------------------------------------------------
@@ -482,9 +491,10 @@ class InceptionV3(Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
+    model = InceptionV3(**kwargs)
     if pretrained:
-        _no_pretrained("inception_v3")
-    return InceptionV3(**kwargs)
+        _load_pretrained_weights(model, "inception_v3")
+    return model
 
 
 # ---------------------------------------------------------------------------
@@ -622,15 +632,17 @@ class MobileNetV3Small(MobileNetV3):
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV3Large(scale=scale, **kwargs)
     if pretrained:
-        _no_pretrained("mobilenet_v3_large")
-    return MobileNetV3Large(scale=scale, **kwargs)
+        _load_pretrained_weights(model, "mobilenet_v3_large")
+    return model
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV3Small(scale=scale, **kwargs)
     if pretrained:
-        _no_pretrained("mobilenet_v3_small")
-    return MobileNetV3Small(scale=scale, **kwargs)
+        _load_pretrained_weights(model, "mobilenet_v3_small")
+    return model
 
 
 # ---------------------------------------------------------------------------
@@ -738,45 +750,52 @@ class ShuffleNetV2(Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=0.25, **kwargs)
     if pretrained:
-        _no_pretrained("shufflenet_v2_x0_25")
-    return ShuffleNetV2(scale=0.25, **kwargs)
+        _load_pretrained_weights(model, "shufflenet_v2_x0_25")
+    return model
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=0.33, **kwargs)
     if pretrained:
-        _no_pretrained("shufflenet_v2_x0_33")
-    return ShuffleNetV2(scale=0.33, **kwargs)
+        _load_pretrained_weights(model, "shufflenet_v2_x0_33")
+    return model
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=0.5, **kwargs)
     if pretrained:
-        _no_pretrained("shufflenet_v2_x0_5")
-    return ShuffleNetV2(scale=0.5, **kwargs)
+        _load_pretrained_weights(model, "shufflenet_v2_x0_5")
+    return model
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=1.0, **kwargs)
     if pretrained:
-        _no_pretrained("shufflenet_v2_x1_0")
-    return ShuffleNetV2(scale=1.0, **kwargs)
+        _load_pretrained_weights(model, "shufflenet_v2_x1_0")
+    return model
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=1.5, **kwargs)
     if pretrained:
-        _no_pretrained("shufflenet_v2_x1_5")
-    return ShuffleNetV2(scale=1.5, **kwargs)
+        _load_pretrained_weights(model, "shufflenet_v2_x1_5")
+    return model
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=2.0, **kwargs)
     if pretrained:
-        _no_pretrained("shufflenet_v2_x2_0")
-    return ShuffleNetV2(scale=2.0, **kwargs)
+        _load_pretrained_weights(model, "shufflenet_v2_x2_0")
+    return model
 
 
 def shufflenet_v2_swish(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=1.0, act="swish", **kwargs)
     if pretrained:
-        _no_pretrained("shufflenet_v2_swish")
-    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+        _load_pretrained_weights(model, "shufflenet_v2_swish")
+    return model
 
 
 __all__ = [

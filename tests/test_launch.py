@@ -160,3 +160,43 @@ def test_launch_elastic_plain_failure_propagates(tmp_path):
         assert launch_elastic(ctx, manager=mgr) == 9
     finally:
         server.stop()
+
+
+def test_per_rank_log_collation(tmp_path):
+    """The launcher merges per-rank workerlogs into one rank-prefixed
+    collated.log (reference launcher log aggregation)."""
+    import subprocess
+    import sys
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os\n"
+        "print('hello from rank', os.environ['PADDLE_TRAINER_ID'], "
+        "flush=True)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    collated = (tmp_path / "logs" / "collated.log").read_text()
+    assert "[rank 0] hello from rank 0" in collated
+    assert "[rank 1] hello from rank 1" in collated
+
+
+def test_monitor_gauges_and_peaks():
+    from paddle_tpu.utils import monitor
+    monitor.stat_reset("test.gauge")
+    monitor.stat_update("test.gauge", 5)
+    monitor.stat_update("test.gauge", 3)
+    monitor.stat_update("test.gauge", -6)
+    assert monitor.stat_get("test.gauge") == 2
+    assert monitor.stat_peak("test.gauge") == 8
+    assert monitor.get_monitor_values().get("test.gauge") == 2
+    mem = monitor.sample_device_memory()
+    assert isinstance(mem, dict)
+    monitor.stat_reset("test.gauge")
+    assert monitor.stat_get("test.gauge") == 0

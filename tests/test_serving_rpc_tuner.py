@@ -228,3 +228,67 @@ def test_ps_stub_raises_with_guidance():
     from paddle_tpu.distributed import ps
     with pytest.raises(NotImplementedError, match="SPMD"):
         ps.init_server()
+
+
+class TestServingDepth:
+    def test_weight_only_quantize_linear_layers(self):
+        from paddle_tpu import nn
+        paddle.seed(3)
+        m = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+        x = paddle.randn([4, 32])
+        with paddle.no_grad():
+            ref = m(x).numpy()
+        n = nn.quant.quantize_linear_layers(m)
+        assert n == 2
+        from paddle_tpu.nn.quant import WeightOnlyLinear
+        assert isinstance(m[0], WeightOnlyLinear)
+        with paddle.no_grad():
+            got = m(x).numpy()
+        # int8 per-channel drift stays small
+        assert np.abs(got - ref).max() < 0.1 * np.abs(ref).max() + 0.05
+
+    def test_weight_only_gpt2_decode(self):
+        from paddle_tpu import nn
+        from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+        paddle.seed(4)
+        cfg = GPT2Config(vocab_size=128, hidden_size=32,
+                         num_hidden_layers=2, num_attention_heads=2,
+                         max_position_embeddings=64)
+        model = GPT2ForCausalLM(cfg)
+        model.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (1, 16)))
+        with paddle.no_grad():
+            ref = model(ids).numpy()
+        n = nn.quant.quantize_linear_layers(model)
+        assert n >= 2 * cfg.num_hidden_layers
+        with paddle.no_grad():
+            got = model(ids).numpy()
+        assert got.shape == ref.shape
+        # quantization drift is bounded; argmax token mostly preserved
+        agree = (got[0, -1].argmax() == ref[0, -1].argmax())
+        assert np.isfinite(got).all() and (
+            agree or np.abs(got - ref).max() < 1.0)
+
+    def test_bucket_batching_predictor(self, tmp_path):
+        from paddle_tpu import jit, nn
+        from paddle_tpu.inference import (BucketBatchingPredictor, Config,
+                                          create_predictor)
+        from paddle_tpu.static import InputSpec
+        paddle.seed(5)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m.eval()
+        path = str(tmp_path / "served")
+        jit.save(m, path, input_spec=[InputSpec([None, 8], "float32")])
+        pred = create_predictor(Config(path))
+        batcher = BucketBatchingPredictor(pred, buckets=(2, 4, 8))
+
+        rng = np.random.RandomState(0)
+        reqs = [[rng.randn(1, 8).astype("float32")] for _ in range(3)]
+        outs = batcher.run_batch(reqs)  # 3 requests -> bucket 4 (padded)
+        assert len(outs) == 3
+        for r, o in zip(reqs, outs):
+            direct = pred.run([r[0]])[0]
+            np.testing.assert_allclose(o[0], direct, rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError):
+            batcher.run_batch([[rng.randn(1, 8).astype("float32")]] * 9)

@@ -181,3 +181,79 @@ class TestSOTSegments:
         import pytest
         with pytest.raises(RuntimeError):
             f(x)
+
+
+class TestPythonStateGuards:
+    """VERDICT #9: python-state changes must re-record, not replay stale
+    (reference SOT guards python values, function_graph.py:143)."""
+
+    def test_closure_flag_flip_rerecords(self):
+        from paddle_tpu.jit.sot import SOTCache
+        flag = {"on": True}
+        calls = {"n": 0}
+
+        scale_on = 3.0
+
+        def fn(x):
+            calls["n"] += 1
+            if bool(x.sum() > -1e9):  # always-true break -> segments
+                return x * (scale_on if use_scale else 1.0)
+            return x
+
+        use_scale = True
+        cache = SOTCache(fn)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        out1 = cache.run((x,), {})
+        np.testing.assert_allclose(out1.numpy(), 3.0)
+        out2 = cache.run((x,), {})  # replay
+        np.testing.assert_allclose(out2.numpy(), 3.0)
+
+        use_scale = False  # closure flip: stale replay would still give 3.0
+        out3 = cache.run((x,), {})
+        np.testing.assert_allclose(out3.numpy(), 1.0)
+        use_scale = True
+        np.testing.assert_allclose(cache.run((x,), {}).numpy(), 3.0)
+
+    def test_layer_attribute_flip_rerecords(self):
+        from paddle_tpu import nn
+        from paddle_tpu.jit.sot import SOTCache
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.double = True
+
+            def forward(self, x):
+                if bool(x.sum() > -1e9):
+                    return x * (2.0 if self.double else 1.0)
+                return x
+
+        m = M()
+        cache = SOTCache(m.forward)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(cache.run((x,), {}).numpy(), 2.0)
+        m.double = False
+        np.testing.assert_allclose(cache.run((x,), {}).numpy(), 1.0)
+
+    def test_self_mutating_guarded_state(self):
+        """A function that FLIPS its own guarded state must key the trace
+        by the pre-call fingerprint (stale-replay repro from review)."""
+        from paddle_tpu.jit.sot import SOTCache
+        state = {"first": True}
+
+        first = True
+
+        def fn(x):
+            nonlocal first
+            if bool(x.sum() > -1e9):
+                if first:
+                    first = False
+                    return x * 2.0
+                return x * 1.0
+            return x
+
+        cache = SOTCache(fn)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(cache.run((x,), {}).numpy(), 2.0)
+        np.testing.assert_allclose(cache.run((x,), {}).numpy(), 1.0)
+        np.testing.assert_allclose(cache.run((x,), {}).numpy(), 1.0)

@@ -89,8 +89,12 @@ def test_vgg11_tiny_forward():
     assert tuple(net(x).shape) == (1, 5)
 
 
-def test_pretrained_raises():
-    with pytest.raises(RuntimeError, match="pretrained"):
+def test_pretrained_without_local_weights_raises(tmp_path, monkeypatch):
+    """pretrained=True now loads LOCAL reference .pdparams weights
+    (utils.checkpoint_converter); with no file present it fails loudly
+    with placement instructions."""
+    monkeypatch.setenv("PADDLE_TPU_PRETRAINED_HOME", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="pretrained=True"):
         vision.models.vgg11(pretrained=True)
 
 
