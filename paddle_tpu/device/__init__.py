@@ -236,3 +236,6 @@ def set_stream(stream=None):
 class IPUPlace:
     def __init__(self, *a):
         raise RuntimeError("IPU is not available in the TPU build")
+
+from . import topology  # noqa: E402  (ICI-aware device-manager tier)
+__all__.append("topology")

@@ -1,0 +1,93 @@
+"""Device-topology surface: ICI-aware mesh construction + chip coords.
+
+Reference: the custom-device DeviceManager + topology-aware rank mapping
+(phi/backends/device_manager.h; fleet's topology-aware scheduling). On TPU
+the physical fabric is the ICI torus: which devices sit next to each other
+determines whether a mesh axis's collectives ride one-hop ICI links or
+bounce across the slice. jax.experimental.mesh_utils encodes the known
+slice topologies; this module surfaces it as the framework's device
+manager tier.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def device_attributes(device=None) -> Dict:
+    """One device's identity + fabric coordinates (TPU: torus coords +
+    core index; other platforms: id/process only)."""
+    d = device or jax.devices()[0]
+    out = {
+        "id": d.id,
+        "platform": d.platform,
+        "process_index": d.process_index,
+        "device_kind": getattr(d, "device_kind", d.platform),
+    }
+    for attr in ("coords", "core_on_chip", "slice_index"):
+        if hasattr(d, attr):
+            out[attr] = getattr(d, attr)
+    return out
+
+
+def topology_summary() -> Dict:
+    """Whole-slice view: device count, hosts, and the coordinate bounds
+    (the torus shape) when the platform exposes them."""
+    devs = jax.devices()
+    out = {
+        "platform": devs[0].platform,
+        "num_devices": len(devs),
+        "num_processes": jax.process_count(),
+        "devices": [device_attributes(d) for d in devs],
+    }
+    coords = [d.get("coords") for d in out["devices"] if "coords" in d]
+    if coords:
+        arr = np.asarray(coords)
+        out["torus_shape"] = (arr.max(axis=0) - arr.min(axis=0)
+                              + 1).tolist()
+    return out
+
+
+def create_ici_mesh(mesh_shape: Sequence[int],
+                    dim_names: Optional[Sequence[str]] = None,
+                    devices: Optional[List] = None):
+    """Build a ProcessMesh whose device order follows the PHYSICAL fabric.
+
+    jax.experimental.mesh_utils.create_device_mesh knows the TPU slice
+    topologies and lays devices out so each mesh axis maps to a torus
+    dimension — collectives over an axis then ride neighbor ICI links
+    instead of crossing the slice (How-to-Scale-Your-Model mesh recipe).
+    Falls back to logical id order on platforms without coords (CPU).
+    """
+    from jax.experimental import mesh_utils
+    from ..distributed.auto_parallel import ProcessMesh
+    from jax.sharding import Mesh
+    devices = devices if devices is not None else jax.devices()
+    if int(np.prod(mesh_shape)) != len(devices):
+        raise ValueError(
+            f"mesh shape {tuple(mesh_shape)} needs {np.prod(mesh_shape)} "
+            f"devices, have {len(devices)}")
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            tuple(mesh_shape), devices=devices)
+    except Exception:
+        # platform without topology info: logical order
+        dev_array = np.asarray(devices, dtype=object).reshape(
+            tuple(mesh_shape))
+    names = tuple(dim_names) if dim_names is not None else tuple(
+        f"d{i}" for i in range(len(mesh_shape)))
+    return ProcessMesh(None, None, _jax_mesh=Mesh(dev_array, names))
+
+
+__all__ = ["device_count", "local_device_count", "device_attributes",
+           "topology_summary", "create_ici_mesh"]

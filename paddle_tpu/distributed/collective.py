@@ -682,13 +682,22 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
 
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
-    """paddle.distributed.alltoall_single (equal splits; ragged splits are a
-    DCN feature the stacked-mesh runner does not model)."""
-    if in_split_sizes is not None or out_split_sizes is not None:
-        raise NotImplementedError("ragged alltoall_single splits")
+    """paddle.distributed.alltoall_single. Equal splits run in both modes;
+    RAGGED splits (in/out_split_sizes) run in a real multi-process world
+    (_ragged_alltoall_single: pad-to-global-max over the tiled all_to_all)
+    — the single-controller rank-stacked convention cannot express
+    per-rank sizes and raises."""
     g = group or _world()
     arr = _unwrap(in_tensor)
     n = g.nranks
+    if in_split_sizes is not None or out_split_sizes is not None:
+        if not _mp():
+            raise NotImplementedError(
+                "ragged alltoall_single needs a real multi-process world "
+                "(per-rank tensor sizes differ; the single-controller "
+                "rank-stacked convention cannot express them)")
+        return _ragged_alltoall_single(arr, in_tensor, out_tensor,
+                                       in_split_sizes, out_split_sizes, g)
     if _mp():
         if arr.shape[0] % n:
             raise ValueError(
@@ -713,6 +722,49 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                                      concat_axis=0, tiled=True),
         g, arr, cache_key=("alltoall_single",))
     result = Tensor(out.reshape(_unwrap(in_tensor).shape))
+    if out_tensor is not None:
+        out_tensor._set_data(result._data)
+        return out_tensor
+    return result
+
+
+def _ragged_alltoall_single(arr, in_tensor, out_tensor, in_split_sizes,
+                            out_split_sizes, g: Group):
+    """Ragged splits (reference's DCN EP path): every rank pads its send
+    chunks to the GLOBAL max split (one tiny pmax exchange), rides the same
+    tiled all_to_all, then slices its receive sizes back out."""
+    n = g.nranks
+    if len(in_split_sizes) != n or len(out_split_sizes) != n:
+        raise ValueError("split size lists must have one entry per rank")
+    if sum(in_split_sizes) != arr.shape[0]:
+        raise ValueError(
+            f"in_split_sizes sum {sum(in_split_sizes)} != dim0 "
+            f"{arr.shape[0]}")
+    local_max = max(list(in_split_sizes) + list(out_split_sizes) + [1])
+    m = int(_stacked(lambda x: jax.lax.pmax(x, g.axis_name), g,
+                     jnp.asarray([local_max], jnp.int32),
+                     cache_key=("ragged_a2a_max",))[0])
+    tail = tuple(arr.shape[1:])
+    chunks = []
+    off = 0
+    for size in in_split_sizes:
+        c = arr[off:off + size]
+        if size < m:
+            c = jnp.concatenate(
+                [c, jnp.zeros((m - size,) + tail, arr.dtype)], axis=0)
+        chunks.append(c)
+        off += size
+    packed = jnp.stack(chunks, axis=0)  # [n, m, ...]
+
+    def body(x):
+        return jax.lax.all_to_all(x[0], g.axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)[None]
+
+    out = _stacked(body, g, packed, cache_key=("ragged_a2a", m))
+    rows = out.reshape((n, m) + tail)
+    parts = [rows[i, :out_split_sizes[i]] for i in range(n)]
+    result = Tensor(jnp.concatenate(parts, axis=0) if parts
+                    else jnp.zeros((0,) + tail, arr.dtype))
     if out_tensor is not None:
         out_tensor._set_data(result._data)
         return out_tensor

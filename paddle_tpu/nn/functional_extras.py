@@ -363,11 +363,51 @@ def class_center_sample(label, num_classes, num_samples, group=None):
             Tensor(jnp.asarray(sampled)))
 
 
+_CSR_MASK_CACHE: dict = {}  # pattern digest -> (elem_mask, block_mask|None)
+
+
+def _csr_masks(offs, cols, seq, block):
+    """CSR pattern -> (dense [b,h,s,s] bool mask, tile-aligned block mask
+    or None). The pattern is static across decode steps, so the O(seq^2)
+    host expansion and the alignment probe are cached by content digest."""
+    import hashlib
+    key = (hashlib.sha1(offs.tobytes()).hexdigest(),
+           hashlib.sha1(cols.tobytes()).hexdigest(), seq, block)
+    hit = _CSR_MASK_CACHE.get(key)
+    if hit is not None:
+        return hit
+    b, h = offs.shape[0], offs.shape[1]
+    mask = np.zeros((b, h, seq, seq), bool)
+    for bi in range(b):
+        for hi in range(h):
+            off = offs[bi, hi]
+            col = cols[bi, hi]
+            for r in range(seq):
+                mask[bi, hi, r, col[off[r]:off[r + 1]]] = True
+    block_mask = None
+    if seq % block == 0:
+        nb = seq // block
+        blocks = mask.reshape(b, h, nb, block, nb, block)
+        any_ = blocks.any(axis=(3, 5))
+        all_ = blocks.all(axis=(3, 5))
+        if np.array_equal(any_, all_):  # every active tile fully dense
+            first = any_[0, 0]
+            if (any_ == first[None, None]).all():  # uniform across b/h
+                block_mask = first
+    if len(_CSR_MASK_CACHE) >= 8:
+        _CSR_MASK_CACHE.pop(next(iter(_CSR_MASK_CACHE)))
+    _CSR_MASK_CACHE[key] = (mask, block_mask)
+    return mask, block_mask
+
+
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                      key_padding_mask=None, attn_mask=None, name=None):
-    """Block-sparse attention (ref sparse_attention op, GPU-only): computed
-    densely with the CSR pattern as a mask — XLA fuses; a Pallas
-    block-sparse kernel is the planned fast path."""
+    """Block-sparse attention (ref sparse_attention op, GPU-only,
+    phi/kernels/gpu/sparse_attention_kernel.cu). When the CSR pattern is
+    TILE-aligned, the Pallas block-sparse kernel computes only the active
+    tiles on TPU (ops/pallas/block_sparse_attention.py); otherwise the
+    pattern is applied densely as a mask (XLA fuses). The pattern
+    expansion is cached by content digest (static across decode steps)."""
     q = query._data if isinstance(query, Tensor) else query
     k = key._data if isinstance(key, Tensor) else key
     v = value._data if isinstance(value, Tensor) else value
@@ -378,16 +418,25 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                       if isinstance(sparse_csr_columns, Tensor)
                       else sparse_csr_columns)
     b, h, seq, d = q.shape
-    mask = np.zeros((b, h, seq, seq), bool)
-    for bi in range(b):
-        for hi in range(h):
-            off = offs[bi, hi]
-            col = cols[bi, hi]
-            for r in range(seq):
-                mask[bi, hi, r, col[off[r]:off[r + 1]]] = True
+    mask, block_mask = _csr_masks(offs, cols, seq, 128)
+    if (key_padding_mask is None and attn_mask is None
+            and block_mask is not None and d % 8 == 0):
+        from ..ops import pallas as _pl
+        from ..core.flags import get_flag
+        if _pl.on_tpu() and get_flag("FLAGS_use_pallas_attention"):
+            from ..ops.pallas.block_sparse_attention import \
+                block_sparse_attention_pallas
+            qs = jnp.einsum("bhsd->bshd", q)
+            ks = jnp.einsum("bhsd->bshd", k)
+            vs = jnp.einsum("bhsd->bshd", v)
+            out = block_sparse_attention_pallas(qs, ks, vs, block_mask)
+            return Tensor(jnp.einsum("bshd->bhsd", out))
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
     scores = jnp.where(jnp.asarray(mask), scores, -1e9)
     probs = jax.nn.softmax(scores, axis=-1)
+    # empty CSR rows output zero (the kernel's l=0 semantics)
+    row_live = jnp.asarray(mask.any(axis=-1))
+    probs = jnp.where(row_live[..., None], probs, 0.0)
     return Tensor(jnp.einsum("bhqk,bhkd->bhqd", probs, v))
 
 
@@ -543,10 +592,30 @@ def triplet_margin_with_distance_loss(input, positive, negative,
 @defop()
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False):
-    """Hierarchical sigmoid loss, default complete-binary-tree coding
-    (ref hsigmoid_loss; phi hierarchical_sigmoid kernel)."""
+    """Hierarchical sigmoid loss (ref hsigmoid_loss; phi
+    hierarchical_sigmoid kernel). Default: complete-binary-tree coding.
+    Custom trees: path_table [N, L] holds each sample's internal-node walk
+    (entries < 0 are padding) and path_code [N, L] the 0/1 branch codes —
+    the reference's is_custom Huffman-tree path."""
+
+    def _walk_loss(nodes, codes):
+        valid = nodes >= 0
+        w = weight[jnp.maximum(nodes, 0)]     # [N, L, D]
+        logits = jnp.einsum("nd,nkd->nk", input, w)
+        if bias is not None:
+            logits_b = logits + bias.reshape(-1)[jnp.maximum(nodes, 0)]
+        else:
+            logits_b = logits
+        ce = -(codes * jax.nn.log_sigmoid(logits_b)
+               + (1 - codes) * jax.nn.log_sigmoid(-logits_b))
+        return jnp.sum(jnp.where(valid, ce, 0.0), -1, keepdims=True)
+
     if path_table is not None or path_code is not None:
-        raise NotImplementedError("custom trees not supported yet")
+        if path_table is None or path_code is None:
+            raise ValueError(
+                "custom-tree hsigmoid needs BOTH path_table and path_code")
+        return _walk_loss(path_table.astype(jnp.int32),
+                          path_code.astype(input.dtype))
     code_len = int(np.ceil(np.log2(num_classes)))
     lab = label.astype(jnp.int32)
     # node index walk of the complete binary tree: internal nodes 0..C-2
@@ -558,16 +627,7 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         codes.append((cur % 2 == 1).astype(input.dtype))  # left=1 like ref
         nodes.append(parent)
         cur = parent
-    codes = jnp.stack(codes, -1)          # [N, code_len]
-    nodes = jnp.stack(nodes, -1)          # [N, code_len]
-    valid = nodes >= 0
-    w = weight[jnp.maximum(nodes, 0)]     # [N, code_len, D]
-    logits = jnp.einsum("nd,nkd->nk", input, w)
-    if bias is not None:
-        logits = logits + bias.reshape(-1)[jnp.maximum(nodes, 0)]
-    ce = -(codes * jax.nn.log_sigmoid(logits)
-           + (1 - codes) * jax.nn.log_sigmoid(-logits))
-    return jnp.sum(jnp.where(valid, ce, 0.0), -1, keepdims=True)
+    return _walk_loss(jnp.stack(nodes, -1), jnp.stack(codes, -1))
 
 
 def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,

@@ -412,8 +412,7 @@ class HSigmoidLoss(Layer):
                  bias_attr=None, is_custom=False, is_sparse=False,
                  name=None):
         super().__init__()
-        if is_custom:
-            raise NotImplementedError("custom trees not supported yet")
+        self.is_custom = is_custom
         self.num_classes = num_classes
         self.weight = self.create_parameter(
             [num_classes - 1, feature_size], attr=weight_attr,
@@ -422,6 +421,15 @@ class HSigmoidLoss(Layer):
                                           attr=bias_attr, is_bias=True)
 
     def forward(self, input, label, path_table=None, path_code=None):
+        has_paths = path_table is not None and path_code is not None
+        if self.is_custom and not has_paths:
+            raise ValueError(
+                "HSigmoidLoss(is_custom=True) requires path_table and "
+                "path_code at every forward (reference semantics)")
+        if not self.is_custom and (path_table is not None
+                                   or path_code is not None):
+            raise ValueError(
+                "path_table/path_code need HSigmoidLoss(is_custom=True)")
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                self.bias, path_table, path_code)
 

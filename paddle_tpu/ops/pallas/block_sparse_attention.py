@@ -1,0 +1,148 @@
+"""Pallas block-sparse attention (TPU).
+
+Reference: the GPU-only sparse_attention op
+(phi/kernels/gpu/sparse_attention_kernel.cu — per-element CSR masking).
+TPU-native: sparsity lives at TILE granularity — a [num_q_blocks,
+num_k_blocks] block mask gates which (q, k) tiles are computed at all, so
+the MXU only sees active tiles and masked tiles cost no FLOPs (the
+streaming-softmax carry structure is shared with flash_attention.py's v2
+kernel). Tiles are still DMA'd (data-dependent index-map aliasing via
+scalar prefetch is the follow-up); compute is the skip that matters for
+the score/context matmuls.
+
+Backward recomputes through the DENSE masked path under custom_vjp —
+block-sparse serving/inference is the forward-latency case; training with
+static block patterns can use attn_mask on the flash kernel instead.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _bs_fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, block_q, block_k, scale):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(mask_ref[qi, ki] != 0)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])
+        m_ref[:] = m_next
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-20)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _bs_fwd(q, k, v, block_mask, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    nq, nk = s // block_q, s // block_k
+    kernel = functools.partial(_bs_fwd_kernel, block_q=block_q,
+                               block_k=block_k, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole block mask
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_mask, q, k, v)
+
+
+def _dense_masked(q, k, v, block_mask, block_q, block_k):
+    """Dense reference with the block pattern expanded — the bwd path.
+    Fully-masked rows output ZERO (matching the kernel's l=0 finalize, not
+    softmax's uniform-over-equal-scores artifact)."""
+    bh, s, d = q.shape
+    elem_mask = jnp.repeat(jnp.repeat(block_mask != 0, block_q, 0),
+                           block_k, 1)  # [s, s]
+    scores = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / (d ** 0.5)
+    scores = jnp.where(elem_mask[None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    row_live = elem_mask.any(axis=-1)  # [s]
+    p = jnp.where(row_live[None, :, None], p, 0.0)
+    return jnp.einsum("bqk,bkd->bqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _bs(q, k, v, block_mask, block_q, block_k, interpret):
+    return _bs_fwd(q, k, v, block_mask, block_q, block_k, interpret)
+
+
+def _bs_vjp_fwd(q, k, v, block_mask, block_q, block_k, interpret):
+    out = _bs_fwd(q, k, v, block_mask, block_q, block_k, interpret)
+    return out, (q, k, v, block_mask)
+
+
+def _bs_vjp_bwd(block_q, block_k, interpret, res, g):
+    q, k, v, block_mask = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _dense_masked(q_, k_, v_, block_mask,
+                                         block_q, block_k), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_bs.defvjp(_bs_vjp_fwd, _bs_vjp_bwd)
+
+
+def block_sparse_attention_pallas(q, k, v, block_mask, block_q=128,
+                                  block_k=128, interpret=False):
+    """q/k/v: [b, s, h, d]; block_mask: [s//block_q, s//block_k] (0 = the
+    whole tile is masked out). Returns [b, s, h, d]."""
+    b, s, h, d = q.shape
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq {s} must divide blocks ({block_q},{block_k})")
+    bm = jnp.asarray(block_mask, jnp.int32)
+    if bm.shape != (s // block_q, s // block_k):
+        raise ValueError(f"block_mask shape {bm.shape} != "
+                         f"{(s // block_q, s // block_k)}")
+
+    def to_bh(x):
+        return jnp.einsum("bshd->bhsd", x).reshape(b * h, s, d)
+
+    out = _bs(to_bh(q), to_bh(k), to_bh(v), bm, block_q, block_k, interpret)
+    return jnp.einsum("bhsd->bshd", out.reshape(b, h, s, d))

@@ -80,6 +80,21 @@ def main():
     np.testing.assert_allclose(out_single.numpy(),
                                expect_rows.reshape(-1))
 
+    # --- ragged alltoall_single (per-rank split sizes differ)
+    if n == 2:
+        if rank == 0:
+            send = np.arange(4, dtype=np.float32) * 10      # [r0:1, r1:3]
+            in_sp, out_sp = [1, 3], [1, 2]
+            expect_rag = np.array([0.0, 100.0, 101.0], np.float32)
+        else:
+            send = np.arange(3, dtype=np.float32) + 100     # [r0:2, r1:1]
+            in_sp, out_sp = [2, 1], [3, 1]
+            expect_rag = np.array([10.0, 20.0, 30.0, 102.0], np.float32)
+        got = dist.alltoall_single(paddle.to_tensor(send),
+                                   in_split_sizes=in_sp,
+                                   out_split_sizes=out_sp)
+        np.testing.assert_allclose(got.numpy(), expect_rag)
+
     # --- scatter from rank 0
     sc_out = paddle.to_tensor(np.zeros((2,), np.float32))
     if rank == 0:

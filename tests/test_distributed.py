@@ -400,3 +400,23 @@ def test_group_sharded_offload_stage1_rejected():
         dist.sharding.group_sharded_parallel(
             m, opt, level="os", offload=True,
             group=dist.init_parallel_env())
+
+
+def test_device_topology_surface():
+    """ICI-topology device-manager tier (VERDICT L2 gap): attributes,
+    slice summary, and topology-ordered mesh construction."""
+    from paddle_tpu.device import topology as topo
+    assert topo.device_count() == 8
+    attrs = topo.device_attributes()
+    assert {"id", "platform", "process_index"} <= set(attrs)
+    summary = topo.topology_summary()
+    assert summary["num_devices"] == 8
+    mesh = topo.create_ici_mesh((2, 4), ["dp", "mp"])
+    assert mesh.shape == [2, 4]
+    assert mesh.dim_names == ["dp", "mp"]
+    # the mesh is usable for real sharding work
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.auto_parallel import Shard, Replicate, shard_tensor
+    t = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(8, 4))
+    shard_tensor(t, mesh, [Shard(0), Replicate()])
+    assert t._data.sharding.spec[0] == "dp"

@@ -246,3 +246,75 @@ def test_flash_dropout():
         q, k, v, causal=False, dropout_p=0.3, seed=7,
         interpret=True).sum())(q)
     np.testing.assert_array_equal(np.asarray(g), np.asarray(g2))
+
+
+def test_block_sparse_attention_matches_dense_masked():
+    """Active tiles only: output must equal dense attention under the
+    expanded block mask (ref sparse_attention semantics at tile granularity)."""
+    from paddle_tpu.ops.pallas.block_sparse_attention import \
+        block_sparse_attention_pallas
+    b, s, h, d = 1, 512, 2, 32
+    q, k, v = _rand((b, s, h, d), 40), _rand((b, s, h, d), 41), \
+        _rand((b, s, h, d), 42)
+    nb = s // 128
+    rng = np.random.RandomState(43)
+    bm = (rng.rand(nb, nb) < 0.5)
+    bm[:, 0] = True  # every row keeps at least one active tile
+    out = block_sparse_attention_pallas(q, k, v, bm, interpret=True)
+
+    mask = np.repeat(np.repeat(bm, 128, 0), 128, 1)
+    big = jnp.asarray(np.where(mask, 0.0, -1e30), jnp.float32)
+    ref = _dense(q, k, v, False, mask=big[None, None])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+    # gradients flow (dense recompute backward)
+    g = jax.grad(lambda q: block_sparse_attention_pallas(
+        q, k, v, bm, interpret=True).sum())(q)
+    gref = jax.grad(lambda q: _dense(q, k, v, False,
+                                     mask=big[None, None]).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_csr_block_alignment_probe():
+    from paddle_tpu.nn.functional_extras import _csr_masks
+    seq, blk = 256, 128
+    nb = seq // blk
+    # block-aligned: every row attends exactly to block-col 0
+    offs = np.zeros((1, 1, seq + 1), np.int64)
+    cols_list = []
+    for r in range(seq):
+        cols_list.append(np.arange(blk))
+        offs[0, 0, r + 1] = offs[0, 0, r] + blk
+    cols = np.concatenate(cols_list)[None, None]
+    mask, bm = _csr_masks(offs, cols, seq, blk)
+    assert bm is not None and bm.shape == (nb, nb)
+    assert bm[:, 0].all() and not bm[:, 1:].any()
+    assert mask.shape == (1, 1, seq, seq)
+    # cached: same pattern returns the identical objects
+    mask2, bm2 = _csr_masks(offs, cols, seq, blk)
+    assert mask2 is mask and bm2 is bm
+    # non-aligned pattern (single element) probes to None
+    offs2 = np.zeros((1, 1, seq + 1), np.int64)
+    offs2[0, 0, 1:] = 1
+    cols2 = np.zeros((1, 1, seq), np.int64)
+    _, bm3 = _csr_masks(offs2, cols2, seq, blk)
+    assert bm3 is None
+
+
+def test_block_sparse_empty_row_zero_output():
+    """A fully-masked block-row outputs ZERO in fwd AND its bwd recompute
+    (review repro: softmax-of-all-masked must not become uniform)."""
+    from paddle_tpu.ops.pallas.block_sparse_attention import \
+        block_sparse_attention_pallas
+    b, s, h, d = 1, 256, 1, 16
+    q, k, v = _rand((b, s, h, d), 50), _rand((b, s, h, d), 51), \
+        _rand((b, s, h, d), 52)
+    bm = np.array([[True, False], [False, False]])  # row 1 fully masked
+    out = block_sparse_attention_pallas(q, k, v, bm, interpret=True)
+    np.testing.assert_allclose(np.asarray(out)[:, 128:], 0.0)
+    g = jax.grad(lambda v_: block_sparse_attention_pallas(
+        q, k, v_, bm, interpret=True).sum())(v)
+    # masked rows contribute nothing to dv's second half either
+    np.testing.assert_allclose(np.asarray(g)[:, 128:], 0.0, atol=1e-6)
