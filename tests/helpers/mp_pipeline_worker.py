@@ -1,0 +1,64 @@
+"""Two-rank cross-process pipeline worker.
+
+The reference's per-rank pipeline pattern
+(fleet/meta_parallel/pipeline_parallel.py:440 + p2p_communication.py:313):
+each RANK owns one stage; activations go forward over p2p, boundary
+cotangents come back. Here the transport is the multi-process eager p2p
+(2-endpoint mesh ppermute over Gloo/ICI) and the per-stage backward is the
+tape with an explicit cotangent — the cross-process twin of the
+single-controller plan executor in fleet/pipeline_parallel.py.
+"""
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.autograd.engine import run_backward
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    assert dist.get_world_size() == 2
+
+    paddle.seed(100 + rank)  # each rank initializes only ITS stage
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(8, 16).astype("float32")
+    y_np = rng.randint(0, 4, (8,))
+
+    steps = 4
+    losses = []
+    if rank == 0:
+        stage = nn.Sequential(nn.Linear(16, 32), nn.ReLU())
+        opt = optimizer.AdamW(learning_rate=5e-2,
+                              parameters=stage.parameters())
+        for _ in range(steps):
+            h = stage(paddle.to_tensor(x_np))
+            dist.send(h, dst=1)
+            cot = paddle.to_tensor(np.zeros((8, 32), np.float32))
+            dist.recv(cot, src=1)  # boundary cotangent comes back
+            run_backward([h], [cot])
+            opt.step()
+            opt.clear_grad()
+    else:
+        head = nn.Linear(32, 4)
+        lossf = nn.CrossEntropyLoss()
+        opt = optimizer.AdamW(learning_rate=5e-2,
+                              parameters=head.parameters())
+        for _ in range(steps):
+            h_in = paddle.to_tensor(np.zeros((8, 32), np.float32))
+            dist.recv(h_in, src=0)
+            h_in.stop_gradient = False
+            loss = lossf(head(h_in), paddle.to_tensor(y_np))
+            loss.backward()
+            dist.send(h_in.grad, dst=0)
+            losses.append(float(loss))
+            opt.step()
+            opt.clear_grad()
+        assert losses[-1] < losses[0], losses
+        print(f"MPPIPE_LOSSES {losses[0]:.4f}->{losses[-1]:.4f}", flush=True)
+    print(f"MPPIPE_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

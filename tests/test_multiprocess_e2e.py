@@ -29,13 +29,12 @@ def _launch_env():
     return env
 
 
-@pytest.mark.quick
-def test_two_rank_world(tmp_path):
-    ckpt_dir = str(tmp_path / "ckpt")
+def _run_launch(tmp_path, script, *args):
+    """Launch `script` across 2 ranks; return (proc, merged worker logs)."""
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
-         WORKER, ckpt_dir],
+         script, *args],
         capture_output=True, text=True, timeout=300, cwd=REPO,
         env=_launch_env())
     logs = ""
@@ -43,9 +42,32 @@ def test_two_rank_world(tmp_path):
     if log_root.exists():
         for f in sorted(log_root.iterdir()):
             logs += f"\n--- {f.name} ---\n" + f.read_text()
+    return proc, logs
+
+
+@pytest.mark.quick
+def test_two_rank_world(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    proc, logs = _run_launch(tmp_path, WORKER, ckpt_dir)
     assert proc.returncode == 0, (
         f"launch failed rc={proc.returncode}\nstdout:{proc.stdout[-2000:]}\n"
         f"stderr:{proc.stderr[-2000:]}\nlogs:{logs[-4000:]}")
     for r in range(2):
         assert f"MPWORKER_OK rank={r}/2" in logs, (
             f"rank {r} did not finish\n{logs[-4000:]}")
+
+
+PIPE_WORKER = os.path.join(REPO, "tests", "helpers", "mp_pipeline_worker.py")
+
+
+def test_two_rank_pipeline(tmp_path):
+    """Per-rank pipeline parallelism across REAL processes: activations
+    forward / cotangents back over eager p2p, per-stage tape backward —
+    the reference's multi-host PP seam (pipeline_parallel.py:440) on the
+    multi-process runtime."""
+    proc, logs = _run_launch(tmp_path, PIPE_WORKER)
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout:{proc.stdout[-1500:]}\n"
+        f"stderr:{proc.stderr[-1500:]}\nlogs:{logs[-4000:]}")
+    assert "MPPIPE_OK rank=0" in logs and "MPPIPE_OK rank=1" in logs, logs
+    assert "MPPIPE_LOSSES" in logs
