@@ -29,89 +29,114 @@ from typing import List, Tuple
 Unit = Tuple[str, int, int]  # ("F"|"B", chunk, micro)
 
 
-def warmup_quota(kind: str, num_stages: int, num_virtual: int,
-                 num_micro: int) -> List[int]:
-    """Per-stage forward-warmup quota before backwards interleave."""
-    total = num_micro * num_virtual
+def _interleaved_order(S: int, V: int, M: int):
+    """Megatron chunk-group cycling: micros advance in groups of up to S;
+    within a group every local chunk runs before the next group starts.
+    Handles M not divisible by S via a ragged final group."""
+    order = []
+    mb = 0
+    while mb < M:
+        grp = range(mb, min(mb + S, M))
+        for chunk in range(V):
+            for m in grp:
+                order.append((chunk, m))
+        mb += S
+    return order
+
+
+def _rank_program(kind: str, r: int, S: int, V: int, M: int) -> List[Unit]:
+    """Stage r's per-rank unit sequence — the reference's per-rank job
+    list. Global chunk ids: rank r owns chunks r, S+r, ..., so local chunk
+    j maps to global j*S + r.
+
+    1F1B/VPP follow the Megatron orders (classic warmup min(S-r-1, M) with
+    forward-first steady state; interleaved warmup (S-r-1)*2 + (V-1)*S
+    with chunk-group cycling — pipeline_parallel.py:440/:906); FThenB is
+    all forwards then all backwards (pipeline_scheduler_pass.py FThenB
+    plan).
+    """
+    total = M * V
+    f_order = _interleaved_order(S, V, M)
+    b_order = [(V - 1 - chunk, m) for chunk, m in f_order]
+
+    def f_unit(k):
+        chunk, micro = f_order[k]
+        return ("F", chunk * S + r, micro)
+
+    def b_unit(k):
+        chunk, micro = b_order[k]
+        return ("B", chunk * S + r, micro)
+
     if kind == "FThenB":
-        return [total] * num_stages
-    if num_virtual == 1:  # classic 1F1B (pipeline_parallel.py:440)
-        return [min(num_micro, num_stages - s) for s in range(num_stages)]
-    # interleaved VPP (pipeline_parallel.py:906 / Megatron chunked 1F1B)
-    return [min(total, (num_stages - s - 1) * 2 + (num_virtual - 1)
-                * num_stages) for s in range(num_stages)]
+        return [f_unit(k) for k in range(total)] + \
+               [b_unit(k) for k in range(total)]
+    if V > 1:
+        warm = min((S - r - 1) * 2 + (V - 1) * S, total)
+    else:
+        warm = min(S - r - 1, M)
+    seq = [f_unit(k) for k in range(warm)]
+    nf = warm
+    nb = 0
+    # steady state runs forward-first (Megatron order), then drains
+    while nb < total:
+        if nf < total:
+            seq.append(f_unit(nf))
+            nf += 1
+        seq.append(b_unit(nb))
+        nb += 1
+    return seq
 
 
 @functools.lru_cache(maxsize=64)
 def generate_schedule(kind: str, num_stages: int, num_chunks: int,
                       num_micro: int) -> List[Unit]:
-    """Global issue order for all (chunk, micro) forward+backward units.
+    """Global issue order for all (chunk, micro) forward+backward units:
+    the per-rank programs merged on a simulated timeline (each round every
+    stage runs its next program unit if its dependencies are done — the
+    single-controller image of the reference's per-rank execution).
 
     Dependencies honored: F(c,m) after F(c-1,m); B(c,m) after F(c,m) and
-    B(c+1,m). One unit per stage per round (stage = chunk % num_stages).
-    Memoized: the plan depends only on its four arguments, and generation
-    is pure-Python — without the cache it would stall every train_batch.
+    B(c+1,m). Memoized: the plan depends only on its four arguments, and
+    generation is pure-Python — without the cache it would stall every
+    train_batch.
     """
     if kind not in ("FThenB", "1F1B", "VPP"):
         raise ValueError(f"unknown pipeline schedule {kind!r}")
     S, C, M = num_stages, num_chunks, num_micro
     V = C // S
-    warm = warmup_quota(kind, S, V, M)
-
-    done_f, done_b = set(), set()
-    fcount = [0] * S
+    if V > 1 and kind != "FThenB" and M % S:
+        # Megatron's interleaved schedule carries the same constraint
+        # (its assert: microbatches % pipeline-parallel size == 0); the
+        # chunk-group cycling deadlocks on a ragged final group
+        raise ValueError(
+            f"interleaved pipeline schedules need accumulate_steps ({M}) "
+            f"divisible by num_stages ({S})")
+    progs = [_rank_program(kind, r, S, V, M) for r in range(S)]
+    pc = [0] * S
+    done = set()
     plan: List[Unit] = []
-
-    def f_ready(s):
-        out = [(m, c) for c in range(s, C, S) for m in range(M)
-               if (c, m) not in done_f
-               and (c == 0 or (c - 1, m) in done_f)]
-        return min(out) if out else None
-
-    def b_ready(s):
-        out = [(m, c) for c in range(s, C, S) for m in range(M)
-               if (c, m) in done_f and (c, m) not in done_b
-               and (c == C - 1 or (c + 1, m) in done_b)]
-        return min(out) if out else None
-
     total = 2 * C * M
+
+    def ready(u):
+        knd, c, m = u
+        if knd == "F":
+            return c == 0 or ("F", c - 1, m) in done
+        return ("F", c, m) in done and (
+            c == C - 1 or ("B", c + 1, m) in done)
+
     while len(plan) < total:
         progressed = False
-        for s in range(S):
-            fr = f_ready(s)
-            br = b_ready(s)
-            pick = None
-            if kind == "FThenB":
-                pick = ("F", fr) if fr is not None else ("B", br)
-            else:
-                if fcount[s] < warm[s] and fr is not None:
-                    pick = ("F", fr)
-                elif br is not None:
-                    pick = ("B", br)
-                elif fr is not None:
-                    pick = ("F", fr)
-            if pick is None or pick[1] is None:
-                continue
-            knd, (m, c) = pick
-            if knd == "F":
-                done_f.add((c, m))
-                fcount[s] += 1
-            else:
-                done_b.add((c, m))
-            plan.append((knd, c, m))
-            progressed = True
-        if not progressed:  # safety: issue ANY globally ready unit
-            for s in range(S):
-                fr = f_ready(s)
-                if fr is not None:
-                    m, c = fr
-                    done_f.add((c, m))
-                    fcount[s] += 1
-                    plan.append(("F", c, m))
-                    progressed = True
-                    break
-            if not progressed:
-                raise RuntimeError("pipeline schedule deadlock (bug)")
+        for r in range(S):
+            if pc[r] < len(progs[r]) and ready(progs[r][pc[r]]):
+                u = progs[r][pc[r]]
+                pc[r] += 1
+                done.add(u)
+                plan.append(u)
+                progressed = True
+        if not progressed:
+            raise RuntimeError(
+                f"pipeline schedule deadlock in {kind} per-rank programs "
+                f"(S={S}, C={C}, M={M}) — program order bug")
     return tuple(plan)
 
 

@@ -286,3 +286,25 @@ def test_fthenb_schedule_mode():
     kinds = [k for k, _, _ in pp.schedule_trace]
     nf = kinds.count("F")
     assert all(k == "F" for k in kinds[:nf])  # every F precedes every B
+
+
+def test_schedule_plans_parameter_sweep():
+    """Every (kind, S, V, M) combo — including M not divisible by S —
+    yields a valid plan (review regression: ragged micro groups)."""
+    from paddle_tpu.distributed.fleet.pipeline_schedules import (
+        generate_schedule, validate_schedule)
+    import pytest as _pytest
+    for kind in ("FThenB", "1F1B", "VPP"):
+        for S in (2, 3, 4):
+            for V in (1, 2, 3):
+                if kind == "VPP" and V == 1:
+                    continue
+                for M in (1, 2, 3, 5, 8):
+                    C = S * V
+                    if V > 1 and kind != "FThenB" and M % S:
+                        # Megatron constraint, rejected loudly
+                        with _pytest.raises(ValueError, match="divisible"):
+                            generate_schedule(kind, S, C, M)
+                        continue
+                    plan = generate_schedule(kind, S, C, M)
+                    validate_schedule(plan, C, M)
