@@ -270,13 +270,16 @@ class ScannedLlamaLayers(Layer):
                     f"sequence length {seq} not divisible by sep axis "
                     f"size {jmesh.shape[cfg.sep_axis]}")
             batch = int(hidden.shape[0])
+            from ..ops.ring_attention import _axes_size
             batch_axis = _pick_axis(jmesh.axis_names, _DP_NAMES,
                                     cfg.sep_axis)
             head_axis = _pick_axis(jmesh.axis_names, _MP_NAMES, cfg.sep_axis)
-            if batch_axis is not None and batch % jmesh.shape[batch_axis]:
+            if batch_axis is not None and \
+                    batch % _axes_size(jmesh, batch_axis):
                 batch_axis = None
-            if head_axis is not None and (h % jmesh.shape[head_axis]
-                                          or kv % jmesh.shape[head_axis]):
+            if head_axis is not None and (
+                    h % _axes_size(jmesh, head_axis)
+                    or kv % _axes_size(jmesh, head_axis)):
                 head_axis = None
             ring_impl = _cached_impl(jmesh, cfg.sep_axis, True, batch_axis,
                                      head_axis)

@@ -116,10 +116,19 @@ _MP_NAMES = ("mp", "model", "tp")
 
 
 def _pick_axis(mesh_axes, candidates, exclude):
-    for name in mesh_axes:
-        if name in candidates and name != exclude:
-            return name
-    return None
+    """ALL matching mesh axes as a tuple (None when none match): hybrid
+    dp x fsdp runs shard the batch over BOTH data axes, and omitting one
+    from the shard_map spec forces an all-gather at the attention
+    boundary (XLA 'involuntary full rematerialization')."""
+    names = tuple(n for n in mesh_axes if n in candidates and n != exclude)
+    return names or None
+
+
+def _axes_size(jmesh, axes):
+    size = 1
+    for a in (axes if isinstance(axes, tuple) else (axes,)):
+        size *= jmesh.shape[a]
+    return size
 
 
 def ring_attention(query, key, value, mesh=None, axis_name: str = "sep",
@@ -147,11 +156,17 @@ def ring_attention(query, key, value, mesh=None, axis_name: str = "sep",
         batch_axis = _pick_axis(axes, _DP_NAMES, axis_name)
     if head_axis is None:
         head_axis = _pick_axis(axes, _MP_NAMES, axis_name)
+    if isinstance(batch_axis, str):
+        batch_axis = (batch_axis,)
+    if isinstance(head_axis, str):
+        head_axis = (head_axis,)
     # auto-detected axes must evenly divide their dims; drop them otherwise
-    if batch_axis is not None and query.shape[0] % jmesh.shape[batch_axis]:
+    if batch_axis is not None and \
+            query.shape[0] % _axes_size(jmesh, batch_axis):
         batch_axis = None
-    if head_axis is not None and (query.shape[2] % jmesh.shape[head_axis] or
-                                  key.shape[2] % jmesh.shape[head_axis]):
+    if head_axis is not None and (
+            query.shape[2] % _axes_size(jmesh, head_axis)
+            or key.shape[2] % _axes_size(jmesh, head_axis)):
         head_axis = None
 
     impl = _cached_impl(jmesh, axis_name, bool(causal), batch_axis, head_axis)

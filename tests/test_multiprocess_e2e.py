@@ -71,3 +71,64 @@ def test_two_rank_pipeline(tmp_path):
         f"stderr:{proc.stderr[-1500:]}\nlogs:{logs[-4000:]}")
     assert "MPPIPE_OK rank=0" in logs and "MPPIPE_OK rank=1" in logs, logs
     assert "MPPIPE_LOSSES" in logs
+
+
+def test_two_node_launch(tmp_path):
+    """Multi-NODE path: two launcher invocations (--nnodes 2, distinct
+    --node_rank, shared --master) each spawn their node's worker; rank 0's
+    launcher binds the KV master, peers connect — the real pod topology on
+    one host."""
+    import socket
+
+    def _three_port_base():
+        # the job binds p (KV), p+1 (coordinator), p+2 (TCPStore)
+        for _ in range(32):
+            with socket.socket() as probe:
+                probe.bind(("127.0.0.1", 0))
+                base = probe.getsockname()[1]
+            socks = []
+            try:
+                for off in range(3):
+                    s = socket.socket()
+                    s.bind(("127.0.0.1", base + off))
+                    socks.append(s)
+                return base
+            except OSError:
+                continue
+            finally:
+                for s in socks:
+                    s.close()
+        raise RuntimeError("no free 3-port window")
+
+    port = _three_port_base()
+    ckpt = str(tmp_path / "ckpt")
+    env = _launch_env()
+    procs = []
+    for node in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--node_rank", str(node),
+             "--master", f"127.0.0.1:{port}",
+             "--log_dir", str(tmp_path / f"logs{node}"),
+             WORKER, ckpt],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env=env))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out or "")
+    logs = ""
+    for node in range(2):
+        root = tmp_path / f"logs{node}"
+        if root.exists():
+            for f in sorted(root.iterdir()):
+                logs += f"\n--- node{node}/{f.name} ---\n" + f.read_text()
+    assert all(p.returncode == 0 for p in procs), (
+        f"rcs={[p.returncode for p in procs]}\n"
+        f"out0:{outs[0][-1500:]}\nout1:{outs[1][-1500:]}\nlogs:{logs[-4000:]}")
+    for r in range(2):
+        assert f"MPWORKER_OK rank={r}/2" in logs, logs[-4000:]
