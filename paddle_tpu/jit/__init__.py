@@ -68,11 +68,13 @@ class StaticFunction:
     """
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
-                 backend=None, full_graph=True):
+                 backend=None, full_graph=True, batch_buckets=None):
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._cache: Dict[Any, dict] = {}
         self._full_graph = full_graph
+        self._buckets = tuple(sorted(batch_buckets)) if batch_buckets \
+            else None
 
     @property
     def code(self):
@@ -85,6 +87,11 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED[0]:
             return self._fn(*args, **kwargs)
+        if self._buckets:
+            return self._call_bucketed(args, kwargs)
+        return self._dispatch(args, kwargs)
+
+    def _dispatch(self, args, kwargs):
         key = _sig_of(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
@@ -93,6 +100,44 @@ class StaticFunction:
             # pop so the cache doesn't pin the first call's autograd tape
             return entry.pop("first_out")
         return self._run(entry, args, kwargs)
+
+    # -- bucketed dynamic-batch compilation (SURVEY §7 hard part (d)) -------
+    def _call_bucketed(self, args, kwargs):
+        """Pad the leading (batch) dim of every batch-carrying tensor arg
+        up to the next bucket, run the bucket's executable, slice outputs
+        back — XLA's static-shape answer to dynamic batch sizes: a BOUNDED
+        set of compilations instead of one per observed size. Opt-in and
+        only valid for per-sample maps (no cross-batch reductions inside)."""
+        leaves = [t for t in jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=_is_tensor) if _is_tensor(t)]
+        batched = [t for t in leaves if t.ndim >= 1]
+        if not batched:
+            return self._dispatch(args, kwargs)
+        b = batched[0].shape[0]
+        if any(t.shape[0] != b for t in batched):
+            return self._dispatch(args, kwargs)  # mixed leading dims
+        bucket = next((k for k in self._buckets if b <= k), None)
+        if bucket is None or bucket == b:
+            return self._dispatch(args, kwargs)
+
+        from .. import concat
+
+        def pad(t):
+            if _is_tensor(t) and t.ndim >= 1 and t.shape[0] == b:
+                reps = [t[-1:]] * (bucket - b)
+                return concat([t] + reps, axis=0)
+            return t
+
+        p_args, p_kwargs = jax.tree_util.tree_map(
+            pad, (args, kwargs), is_leaf=_is_tensor)
+        out = self._dispatch(p_args, p_kwargs)
+
+        def unpad(t):
+            if _is_tensor(t) and t.ndim >= 1 and t.shape[0] == bucket:
+                return t[:b]
+            return t
+
+        return jax.tree_util.tree_map(unpad, out, is_leaf=_is_tensor)
 
     # -- pass 1: discovery --------------------------------------------------
     def _trace(self, args, kwargs):
@@ -266,19 +311,25 @@ def _rewrap_args(flat_arrays, treedef, tensor_pos, static_flat):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True):
-    """paddle.jit.to_static analog (jit/api.py:171)."""
+              backend=None, full_graph=True, batch_buckets=None):
+    """paddle.jit.to_static analog (jit/api.py:171).
+
+    batch_buckets: opt-in dynamic-batch bucketing — inputs pad their
+    leading dim up to the next bucket so a BOUNDED set of executables
+    serves any batch size (valid only for per-sample maps: cross-batch
+    reductions would see the pad rows)."""
     def deco(fn):
         # Layer: compile its forward, keep the layer object semantics
         from ..nn.layer import Layer
         if isinstance(fn, Layer):
             layer = fn
             static = StaticFunction(layer.forward, input_spec,
-                                    build_strategy, backend, full_graph)
+                                    build_strategy, backend, full_graph,
+                                    batch_buckets)
             layer.forward = static
             return layer
         return StaticFunction(fn, input_spec, build_strategy, backend,
-                              full_graph)
+                              full_graph, batch_buckets)
     if function is not None:
         return deco(function)
     return deco

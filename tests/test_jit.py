@@ -257,3 +257,22 @@ def test_to_static_no_fallback_for_clean_functions():
     # stayed compiled: no fallback flag on the cache entry
     entry = clean.concrete_program(x)
     assert entry is not None and not entry.get("fallback")
+
+
+def test_to_static_batch_buckets():
+    """SURVEY §7 hard part (d): bounded compilations for dynamic batch —
+    leading dims pad to the next bucket and outputs slice back exactly."""
+    from paddle_tpu import jit, nn
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    eager = lambda x: net(x)
+    static = jit.to_static(net.forward, batch_buckets=(4, 8, 16))
+
+    rng = np.random.RandomState(0)
+    for b in (3, 5, 7, 2, 8, 11):
+        x = paddle.to_tensor(rng.randn(b, 8).astype("float32"))
+        np.testing.assert_allclose(static(x).numpy(), eager(x).numpy(),
+                                   rtol=1e-6, atol=1e-6)
+    # six distinct batch sizes -> at most three compiled signatures
+    assert len(static._cache) <= 3, list(static._cache)
