@@ -1,0 +1,12 @@
+#!/bin/bash
+# TPU relay probe daemon: logs a timestamped probe every 5 min; touches .tpu_healthy on success.
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  out=$(timeout 90 python -c "import jax; d=jax.devices(); print(d)" 2>&1 | tail -1)
+  rc=$?
+  echo "$ts rc=$rc ${out:0:200}" >> /root/repo/TPU_PROBES.log
+  if [ "$rc" -eq 0 ] && echo "$out" | grep -qi tpu; then
+    touch /root/repo/.tpu_healthy
+  fi
+  sleep 300
+done

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -383,13 +383,38 @@ class LlamaModel(Layer):
             config.max_position_embeddings, config.head_dim, config.rope_theta,
             jdt)
 
+    def _anchor(self, hidden):
+        """Re-anchor activation sharding at layer boundaries.
+
+        ``shard_llama(..., batch_axes=, sep_axis=)`` installs an activation
+        placement (batch over the data axes, sequence over the context-
+        parallel axis, hidden replicated — the Megatron contract where
+        row-parallel outputs are reduced over mp). Without the anchor, the
+        eager discovery pass lets GSPMD pick a different output sharding per
+        op and the batch-sharded residual meets an (seq, hidden)-sharded
+        branch — an involuntary full rematerialization (reference analog:
+        phi/infermeta/spmd_rules/* keep these transitions cheap by
+        construction)."""
+        anchor = getattr(self, "_act_anchor", None)
+        if anchor is None:
+            return hidden
+        from ..distributed.auto_parallel import shard_tensor
+        mesh, placements = anchor
+        return shard_tensor(hidden, mesh, placements)
+
     def forward(self, input_ids, attn_mask=None):
         _, s = input_ids.shape
         hidden = self.embed_tokens(input_ids)
+        # NOTE: no anchor directly on the embedding output — a gather's
+        # output sharding (hidden over fsdp, from the vocab-parallel table)
+        # has no efficient reshard rule, and constraining it forces an
+        # involuntary full rematerialization. The first layer's elementwise
+        # and dot ops bridge to the anchored layout cheaply instead.
         cos, sin = self._cos[:s], self._sin[:s]
         if self.config.scan_layers:
             # one scan op: recompute (jax.checkpoint) handled inside
             hidden = self.layers_scanned(hidden, cos, sin, attn_mask)
+            hidden = self._anchor(hidden)
         elif self.config.use_recompute and self.training:
             from ..distributed.fleet.recompute import recompute
             for layer in self.layers:
@@ -397,9 +422,11 @@ class LlamaModel(Layer):
                                 for p in layer.parameters())
                 hidden = recompute(layer, hidden, cos, sin, attn_mask,
                                    _trainable_hint=trainable)
+                hidden = self._anchor(hidden)
         else:
             for layer in self.layers:
                 hidden = layer(hidden, cos, sin, attn_mask)
+                hidden = self._anchor(hidden)
         return self.norm(hidden)
 
 
@@ -436,7 +463,9 @@ class LlamaForCausalLM(Layer):
 
 
 def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
-                fsdp_axis: Optional[str] = None):
+                fsdp_axis: Optional[str] = None,
+                batch_axes: Optional[Sequence[str]] = None,
+                sep_axis: Optional[str] = None):
     """Apply Megatron-style TP (+ optional FSDP) placements to a Llama model.
 
     The reference expresses this with dedicated parallel layer classes
@@ -449,6 +478,10 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
       - lm_head:                   column-parallel  -> Shard(vocab)   on mp
       - optional fsdp axis: every 2D weight additionally Shard on its other
         dim (ZeRO-3-style parameter sharding as placements, SURVEY.md §7).
+      - optional batch_axes/sep_axis: install the activation anchor
+        (batch over batch_axes, sequence over sep_axis, hidden replicated)
+        that LlamaModel re-applies at every layer boundary so GSPMD never
+        drifts into an involuntary full rematerialization.
     """
     from ..distributed.auto_parallel import Replicate, Shard, shard_tensor
 
@@ -466,7 +499,13 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
                 placements.append(Replicate())
         shard_tensor(param, mesh, placements)
 
-    place(model.model.embed_tokens.weight, mp_dim=0, fsdp_dim=1)
+    # Embedding: vocab-parallel over BOTH mp and fsdp (Megatron
+    # VocabParallelEmbedding, fleet/layers/mpu/mp_layers.py) — never the
+    # hidden dim. A gather from a hidden-sharded table has no efficient
+    # GSPMD reshard to the (batch, seq)-sharded activation layout
+    # (involuntary full remat); a vocab-sharded table partitions the
+    # lookup along the index sharding plus one allreduce.
+    place(model.model.embed_tokens.weight, mp_dim=0, fsdp_dim=0)
     if model.config.scan_layers:
         # stacked [L, in, out] weights: the layer dim leads, so the 2D
         # placements shift by one (same TP plan, scan-compatible)
@@ -490,4 +529,14 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
     place(model.model.norm.weight)
     if model.lm_head is not None:
         place(model.lm_head.weight, mp_dim=1, fsdp_dim=0)
+    if batch_axes or sep_axis:
+        act = []
+        for ax in names:
+            if batch_axes and ax in batch_axes and mesh.get_dim_size(ax) > 1:
+                act.append(Shard(0))
+            elif sep_axis and ax == sep_axis and mesh.get_dim_size(ax) > 1:
+                act.append(Shard(1))
+            else:
+                act.append(Replicate())
+        model.model._act_anchor = (mesh, act)
     return model

@@ -1,0 +1,34 @@
+"""The driver's multi-chip dryrun must be SPMD-clean.
+
+VERDICT r2 #3: MULTICHIP_r02 passed but GSPMD logged an involuntary full
+rematerialization (a tensor replicated mid-step — on a real pod, an
+all-gather of exactly the kind ZeRO-3 exists to avoid). The fix is the
+activation anchor installed by shard_llama(batch_axes=, sep_axis=) plus the
+vocab-parallel (never hidden-sharded) embedding table; this test pins both
+by grepping the compiled-step log. Reference analog: the spmd_rules
+(phi/infermeta/spmd_rules/*) exist to keep placement transitions efficient;
+here the assertion is on XLA's own partitioner diagnostics.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.smoke
+@pytest.mark.slow
+def test_dryrun_multichip_no_involuntary_remat():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8); print('OK8')"],
+        cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
+        timeout=1500)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "OK8" in proc.stdout
+    # dryrun_multichip pipes the sanitized subprocess's stderr through, so
+    # GSPMD diagnostics from the compiled step land here.
+    assert "Involuntary full rematerialization" not in proc.stderr, \
+        proc.stderr[-3000:]
