@@ -1,12 +1,22 @@
 #!/bin/bash
-# TPU relay probe daemon: logs a timestamped probe every 5 min; touches .tpu_healthy on success.
+# TPU relay probe daemon v3: pure jax.devices() probe (no allocations — safe
+# to kill), 300s budget, every 10 min. Touches .tpu_healthy on success.
+# Captures the probe's own exit code before piping (a pipeline would report
+# tail's rc) and keeps the stderr tail so failure modes are diagnosable from
+# TPU_PROBES.log alone.
+ERRF=/tmp/.tpu_probe_err
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  out=$(timeout 90 python -c "import jax; d=jax.devices(); print(d)" 2>&1 | tail -1)
+  raw=$(timeout 300 python -c "import jax; print('DEV', jax.devices())" 2>"$ERRF")
   rc=$?
-  echo "$ts rc=$rc ${out:0:200}" >> /root/repo/TPU_PROBES.log
-  if [ "$rc" -eq 0 ] && echo "$out" | grep -qi tpu; then
+  out=$(printf '%s\n' "$raw" | grep DEV | tail -1)
+  if [ "$rc" -eq 0 ] && [ -n "$out" ]; then
+    echo "$ts rc=0 ${out:0:160}" >> /root/repo/TPU_PROBES.log
     touch /root/repo/.tpu_healthy
+  else
+    err=$(tail -c 200 "$ERRF" | tr '\n' ' ')
+    echo "$ts rc=$rc out='${out:0:80}' err='${err}'" >> /root/repo/TPU_PROBES.log
+    rm -f /root/repo/.tpu_healthy
   fi
-  sleep 300
+  sleep 600
 done
