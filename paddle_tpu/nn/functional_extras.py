@@ -338,7 +338,10 @@ def gather_tree(ids, parents):
         beam_idx = jnp.take_along_axis(step_parents, beam_idx, axis=1)
         return beam_idx, out
 
-    init = jnp.tile(jnp.arange(ids.shape[2])[None, :], (ids.shape[1], 1))
+    # carry dtype must match the body's output (take_along_axis of parents)
+    # or lax.scan rejects the carry under x64 (harness-found)
+    init = jnp.tile(jnp.arange(ids.shape[2], dtype=parents.dtype)[None, :],
+                    (ids.shape[1], 1))
     _, outs = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
     return outs[::-1]
 

@@ -297,15 +297,19 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False):
 
 @defop()
 def sort(x, axis=-1, descending=False, stable=False):
-    out = jnp.sort(x, axis=axis, stable=stable or None)
-    return jnp.flip(out, axis=axis) if descending else out
+    # NB: jnp.sort requires a real bool here — `stable or None` lowers to
+    # BoolAttr.get(None) and fails at MLIR emission (harness-found). The
+    # descending flag must go to the sort itself: flipping a stable
+    # ascending sort would reverse the relative order of equal elements.
+    return jnp.sort(x, axis=axis, stable=bool(stable),
+                    descending=bool(descending))
 
 
 @defop(differentiable=False)
 def argsort(x, axis=-1, descending=False, stable=False):
-    idx = jnp.argsort(x, axis=axis, stable=stable or None)
-    if descending:
-        idx = jnp.flip(idx, axis=axis)
+    # descending must be native (not a flip) to keep stable tie order
+    idx = jnp.argsort(x, axis=axis, stable=bool(stable),
+                      descending=bool(descending))
     return idx.astype(jnp.int64)
 
 

@@ -1,0 +1,260 @@
+"""Per-op synthesis recipes for the OpTest harness (VERDICT r2 #4).
+
+The generic synthesizer covers ops taking plain float tensors; everything
+with structural attributes (axes lists, pad configs, window shapes, index
+operands, factorized-matrix inputs) gets an explicit recipe here — the
+reference expresses the same knowledge per-op in each
+test/legacy_test/test_*_op.py setUp. A recipe is
+``name -> fn(rng) -> (args, kwargs)``; the harness calls the op as
+``op(*args, **kwargs)``, differentiates the float positional args, and
+runs the bf16 smoke on them.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _f(rng, shape, lo=0.3, hi=0.9):
+    return jnp.asarray(rng.uniform(lo, hi, shape))
+
+
+def _i(rng, shape, hi, lo=0):
+    return jnp.asarray(rng.randint(lo, hi, shape), jnp.int32)
+
+
+def _spd(rng, n):
+    a = rng.uniform(-1, 1, (n, n))
+    return jnp.asarray(a @ a.T + n * np.eye(n))
+
+
+def _well_conditioned(rng, n):
+    return jnp.asarray(rng.uniform(-1, 1, (n, n)) + n * np.eye(n))
+
+
+RECIPES = {
+    # -- pooling / resizing --------------------------------------------------
+    "adaptive_avg_pool1d": lambda rng: ((_f(rng, (2, 3, 8)), 4), {}),
+    "adaptive_avg_pool2d": lambda rng: ((_f(rng, (2, 3, 8, 8)), 4), {}),
+    "adaptive_avg_pool3d": lambda rng: ((_f(rng, (1, 2, 4, 4, 4)), 2), {}),
+    "adaptive_max_pool2d": lambda rng: ((_f(rng, (1, 2, 8, 8)), 4), {}),
+    "interpolate": lambda rng: ((_f(rng, (1, 2, 4, 4)),),
+                                {"scale_factor": 2, "mode": "bilinear"}),
+    "pixel_shuffle": lambda rng: ((_f(rng, (1, 4, 3, 3)), 2), {}),
+    "pixel_unshuffle": lambda rng: ((_f(rng, (1, 1, 4, 4)), 2), {}),
+    "channel_shuffle": lambda rng: ((_f(rng, (1, 4, 4, 4)), 2), {}),
+    "local_response_norm": lambda rng: ((_f(rng, (1, 3, 4, 4)), 3), {}),
+    "maxout": lambda rng: ((_f(rng, (1, 4, 3, 3)), 2), {}),
+    "temporal_shift": lambda rng: ((_f(rng, (4, 4, 3, 3)), 2), {}),
+
+    # -- convolution ---------------------------------------------------------
+    "conv2d": lambda rng: ((_f(rng, (1, 3, 8, 8), -0.5, 0.5),
+                            _f(rng, (4, 3, 3, 3), -0.5, 0.5)),
+                           {"padding": 1}),
+    "conv3d": lambda rng: ((_f(rng, (1, 2, 4, 4, 4), -0.5, 0.5),
+                            _f(rng, (3, 2, 3, 3, 3), -0.5, 0.5)),
+                           {"padding": 1}),
+    "conv2d_transpose": lambda rng: ((_f(rng, (1, 3, 4, 4), -0.5, 0.5),
+                                      _f(rng, (3, 4, 3, 3), -0.5, 0.5)), {}),
+    "unfold": lambda rng: ((_f(rng, (1, 2, 6, 6)), [2, 2]),
+                           {"strides": 2}),
+    "fold": lambda rng: ((_f(rng, (1, 12, 4)), [4, 4], [2, 2]),
+                         {"strides": 2}),
+
+    # -- norm layers ---------------------------------------------------------
+    "group_norm": lambda rng: ((_f(rng, (2, 4, 3, 3)), 2), {}),
+
+    # -- attention -----------------------------------------------------------
+    "scaled_dot_product_attention": lambda rng: (
+        (_f(rng, (1, 8, 2, 16), -0.5, 0.5),
+         _f(rng, (1, 8, 2, 16), -0.5, 0.5),
+         _f(rng, (1, 8, 2, 16), -0.5, 0.5)), {}),
+    "flash_attention_pallas": lambda rng: (
+        (_f(rng, (1, 128, 2, 32), -0.5, 0.5).astype(jnp.float32),
+         _f(rng, (1, 128, 2, 32), -0.5, 0.5).astype(jnp.float32),
+         _f(rng, (1, 128, 2, 32), -0.5, 0.5).astype(jnp.float32)),
+        {"interpret": True}),
+
+    # -- shape / layout ------------------------------------------------------
+    "reshape": lambda rng: ((_f(rng, (3, 4)), [4, 3]), {}),
+    "transpose": lambda rng: ((_f(rng, (2, 3, 4)), [1, 0, 2]), {}),
+    "swapaxes": lambda rng: ((_f(rng, (2, 3, 4)), 0, 1), {}),
+    "moveaxis": lambda rng: ((_f(rng, (2, 3, 4)), 0, 2), {}),
+    "flip": lambda rng: ((_f(rng, (3, 4)), [0]), {}),
+    "reverse": lambda rng: ((_f(rng, (3, 4)), [1]), {}),
+    "broadcast_to": lambda rng: ((_f(rng, (3, 1)), [3, 4]), {}),
+    "expand": lambda rng: ((_f(rng, (3, 1)), [3, 4]), {}),
+    "unflatten": lambda rng: ((_f(rng, (6, 4)), 0, [2, 3]), {}),
+    "chunk": lambda rng: ((_f(rng, (6, 4)), 3, 0), {}),
+    "as_strided": lambda rng: ((_f(rng, (16,)), [3, 4], [4, 1]), {}),
+    "cast": lambda rng: ((_f(rng, (3, 4)), "float32"), {}),
+    "pad": lambda rng: ((_f(rng, (2, 3, 4, 4)), [1, 1, 1, 1]), {}),
+    "broadcast_shape_op": lambda rng: (([2, 3, 4], [3, 1]), {}),
+    "slice": lambda rng: ((_f(rng, (4, 5)), [0, 1], [0, 1], [3, 4]), {}),
+    "strided_slice": lambda rng: ((_f(rng, (4, 6)), [0, 1], [0, 0],
+                                   [4, 6], [2, 2]), {}),
+    "slice_scatter": lambda rng: ((_f(rng, (4, 6)), _f(rng, (2, 3)),
+                                   [0, 1], [0, 0], [4, 6], [2, 2]), {}),
+    "select_scatter": lambda rng: ((_f(rng, (3, 4)), _f(rng, (3,)), 1, 2),
+                                   {}),
+    "diagonal_scatter": lambda rng: ((_f(rng, (4, 4)), _f(rng, (4,))), {}),
+    "set_item": lambda rng: ((_f(rng, (3, 4)), 1, 0.5), {}),
+
+    # -- indexing / scatter-gather ------------------------------------------
+    "one_hot": lambda rng: ((_i(rng, (3,), 5), 5), {}),
+    "gather_nd": lambda rng: ((_f(rng, (3, 4)), _i(rng, (2, 2), 3)), {}),
+    "take_along_axis": lambda rng: ((_f(rng, (3, 4)), _i(rng, (3, 2), 4),
+                                     1), {}),
+    "put_along_axis": lambda rng: ((_f(rng, (3, 4)), _i(rng, (3, 1), 4),
+                                    0.5, 1), {}),
+    "index_add": lambda rng: ((_f(rng, (3, 4)), _i(rng, (2,), 3), 0,
+                               _f(rng, (2, 4))), {}),
+    "index_fill": lambda rng: ((_f(rng, (3, 4)), _i(rng, (2,), 3), 0, 0.5),
+                               {}),
+    "index_put": lambda rng: ((_f(rng, (3, 4)),
+                               (_i(rng, (2,), 3), _i(rng, (2,), 4)),
+                               _f(rng, (2,))), {}),
+    "masked_scatter": lambda rng: ((_f(rng, (3, 4)),
+                                    jnp.asarray(rng.rand(3, 4) > 0.5),
+                                    _f(rng, (12,))), {}),
+    "scatter": lambda rng: ((_f(rng, (3, 4)), _i(rng, (2,), 3),
+                             _f(rng, (2, 4))), {}),
+    "scatter_nd_add": lambda rng: ((_f(rng, (3, 4)), _i(rng, (2, 1), 3),
+                                    _f(rng, (2, 4))), {}),
+    # unpacked-array wrapper: float args must be top-level positionals or
+    # the harness's grad + bf16 checks silently skip (list args carry no
+    # .dtype)
+    "multiplex": lambda rng: ((_f(rng, (3, 4)), _f(rng, (3, 4)),
+                               _i(rng, (3, 1), 2)), {"_wrap": "multiplex"}),
+    "shard_index": lambda rng: ((_i(rng, (3, 1), 6), 6, 2, 0), {}),
+    "tril_indices": lambda rng: ((4, 4), {}),
+    "triu_indices": lambda rng: ((4,), {}),
+
+    # -- sort / select -------------------------------------------------------
+    "sort": lambda rng: ((_f(rng, (3, 4)),), {}),
+    "argsort": lambda rng: ((_f(rng, (3, 4)),), {}),
+    "topk": lambda rng: ((_f(rng, (3, 4)), 2), {}),
+    "kthvalue": lambda rng: ((_f(rng, (3, 4)), 2), {}),
+
+    # -- linalg --------------------------------------------------------------
+    "cholesky": lambda rng: ((_spd(rng, 3),), {}),
+    "cholesky_solve": lambda rng: ((_f(rng, (3, 2)),
+                                    jnp.linalg.cholesky(_spd(rng, 3))), {}),
+    "det": lambda rng: ((_well_conditioned(rng, 3),), {}),
+    "slogdet": lambda rng: ((_well_conditioned(rng, 3),), {}),
+    "inverse": lambda rng: ((_well_conditioned(rng, 3),), {}),
+    "solve": lambda rng: ((_well_conditioned(rng, 3), _f(rng, (3, 2))), {}),
+    "triangular_solve": lambda rng: ((jnp.triu(_well_conditioned(rng, 3)),
+                                      _f(rng, (3, 2))), {}),
+    "matrix_power": lambda rng: ((_well_conditioned(rng, 3), 2), {}),
+    "matrix_exp": lambda rng: ((_f(rng, (3, 3), -0.3, 0.3),), {}),
+    "multi_dot": lambda rng: ((_f(rng, (2, 3)), _f(rng, (3, 4)),
+                               _f(rng, (4, 2))), {"_wrap": "multi_dot"}),
+    "eig": lambda rng: ((_well_conditioned(rng, 3),), {}),
+    "eigvals": lambda rng: ((_well_conditioned(rng, 3),), {}),
+    "eigh": lambda rng: ((_spd(rng, 3),), {}),
+    "eigvalsh": lambda rng: ((_spd(rng, 3),), {}),
+    "lu_unpack": lambda rng: (
+        (lambda lu_piv: (lu_piv[0], lu_piv[1].astype(jnp.int32) + 1))(
+            jax.scipy.linalg.lu_factor(_well_conditioned(rng, 3))), {}),
+
+    # -- losses --------------------------------------------------------------
+    "dice_loss": lambda rng: ((_f(rng, (4, 3)), _i(rng, (4, 1), 3)), {}),
+    "nll_loss": lambda rng: ((jnp.log(_f(rng, (3, 4))), _i(rng, (3,), 4)),
+                             {}),
+    "multi_margin_loss": lambda rng: ((_f(rng, (3, 4)), _i(rng, (3,), 4)),
+                                      {}),
+    "npair_loss": lambda rng: ((_f(rng, (3, 4)), _f(rng, (3, 4)),
+                                _i(rng, (3,), 3)), {}),
+    "hsigmoid_loss": lambda rng: ((_f(rng, (3, 5)), _i(rng, (3,), 4), 4,
+                                   _f(rng, (3, 5), -0.5, 0.5)), {}),
+
+    # -- signal / frames -----------------------------------------------------
+    "frame_op": lambda rng: ((_f(rng, (8,)), 4, 2), {}),
+    "overlap_add_op": lambda rng: ((_f(rng, (4, 3)), 2), {}),
+
+    # -- special math --------------------------------------------------------
+    "polygamma": lambda rng: ((_f(rng, (3, 4), 1.2, 1.9), 1), {}),
+    "multigammaln": lambda rng: ((_f(rng, (3, 4), 3.0, 4.0), 2), {}),
+    "renorm": lambda rng: ((_f(rng, (3, 4)), 2.0, 0, 1.0), {}),
+
+    # -- dropout (fixed key: deterministic under grad/FD) --------------------
+    "dropout": lambda rng: ((_f(rng, (3, 4)), 0.3, None, "upscale_in_train",
+                             jax.random.PRNGKey(0)), {}),
+    "alpha_dropout_op": lambda rng: ((_f(rng, (3, 4)),
+                                      jax.random.PRNGKey(0), 0.3), {}),
+
+    # -- vision / geometry ---------------------------------------------------
+    "affine_grid": lambda rng: ((_f(rng, (2, 2, 3), -0.5, 0.5),
+                                 [2, 3, 4, 4]), {}),
+    "grid_sample": lambda rng: ((_f(rng, (1, 2, 4, 4)),
+                                 _f(rng, (1, 3, 3, 2), -0.9, 0.9)), {}),
+    "bilinear": lambda rng: ((_f(rng, (2, 3)), _f(rng, (2, 4)),
+                              _f(rng, (5, 3, 4), -0.5, 0.5)), {}),
+    "einsum_op": lambda rng: (("ij,jk->ik", _f(rng, (2, 3)),
+                               _f(rng, (3, 4))), {}),
+
+    # -- graph / segment -----------------------------------------------------
+    "segment_sum_op": lambda rng: ((_f(rng, (6, 3)),
+                                    jnp.asarray([0, 0, 1, 1, 2, 2],
+                                                jnp.int32), 3), {}),
+    "segment_mean_op": lambda rng: ((_f(rng, (6, 3)),
+                                     jnp.asarray([0, 0, 1, 1, 2, 2],
+                                                 jnp.int32), 3), {}),
+    "segment_max_op": lambda rng: ((_f(rng, (6, 3)),
+                                    jnp.asarray([0, 0, 1, 1, 2, 2],
+                                                jnp.int32), 3), {}),
+    "segment_min_op": lambda rng: ((_f(rng, (6, 3)),
+                                    jnp.asarray([0, 0, 1, 1, 2, 2],
+                                                jnp.int32), 3), {}),
+    "send_u_recv_op": lambda rng: ((_f(rng, (4, 3)), _i(rng, (5,), 4),
+                                    _i(rng, (5,), 4), "sum", 4), {}),
+    "send_ue_recv_op": lambda rng: ((_f(rng, (4, 3)), _f(rng, (5, 3)),
+                                     _i(rng, (5,), 4), _i(rng, (5,), 4),
+                                     "add", "sum", 4), {}),
+    "send_uv_op": lambda rng: ((_f(rng, (4, 3)), _f(rng, (4, 3)),
+                                _i(rng, (5,), 4), _i(rng, (5,), 4), "add"),
+                               {}),
+
+    # -- sequence / decode ---------------------------------------------------
+    "gather_tree": lambda rng: ((_i(rng, (4, 2, 3), 3),
+                                 _i(rng, (4, 2, 3), 3)), {}),
+    "viterbi_decode_op": lambda rng: ((_f(rng, (2, 4, 3), -1, 1),
+                                       _f(rng, (3, 3), -1, 1),
+                                       jnp.asarray([4, 3], jnp.int64),
+                                       False), {}),
+}
+
+
+# Adapters for ops whose natural signature takes a LIST of tensors: the
+# harness needs float args as top-level positionals so grad/bf16 checks see
+# them. A recipe opts in via kwargs={"_wrap": "<name>"}.
+ADAPTERS = {
+    "multi_dot": lambda fn: (lambda a, b, c: fn([a, b, c])),
+    "multiplex": lambda fn: (lambda a, b, idx: fn([a, b], idx)),
+}
+
+
+# Named whitelist: ops the harness intentionally does NOT synthesize, each
+# with the reason — the reference gates every exception by name the same
+# way (test/white_list/, op_test.py:420). test_whitelist_is_exact pins that
+# this list matches reality in both directions.
+WHITELIST = {
+    "_adaptive_max_nd": "private helper behind adaptive_max_pool{1,2,3}d "
+                        "(covered via the public recipes + test_nn.py)",
+    "_avg_pool": "private helper behind avg_pool{1,2,3}d (public ops are "
+                 "generically synthesized; window semantics in test_nn.py)",
+    "_max_pool": "private helper behind max_pool{1,2,3}d (same coverage as "
+                 "_avg_pool)",
+    "_batch_norm_eval": "private helper behind batch_norm (running-stat "
+                        "plumbing exercised in test_nn.py BatchNorm tests)",
+    "_batch_norm_train": "private helper behind batch_norm (same coverage "
+                         "as _batch_norm_eval)",
+    "_conv_transpose_nd": "private helper behind conv{1,2,3}d_transpose "
+                          "(conv2d_transpose recipe covers the path)",
+    "_ctc_loss_impl": "private helper behind ctc_loss; needs coupled "
+                      "log-prob/label/length structure (test_loss.py "
+                      "pins numerics against reference values)",
+    "_rnnt_loss_impl": "private helper behind rnnt_loss; same structural "
+                       "coupling as _ctc_loss_impl (test_loss.py)",
+}

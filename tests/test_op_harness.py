@@ -9,6 +9,7 @@ randomness) are EXPLICITLY whitelisted, mirroring test/white_list/ — a new
 op must either pass the harness or be added there with a reason.
 """
 import functools
+import zlib
 
 import numpy as np
 import pytest
@@ -18,6 +19,13 @@ import jax.numpy as jnp
 
 import paddle_tpu  # noqa: F401  (populates OP_REGISTRY)
 from paddle_tpu.ops.registry import OP_REGISTRY
+
+from op_harness_recipes import ADAPTERS, RECIPES, WHITELIST
+
+
+def _seed_of(name):
+    """Stable per-op seed (hash() is randomized per interpreter run)."""
+    return zlib.crc32(name.encode()) % (2 ** 31)
 
 
 def _floatify(tree):
@@ -65,7 +73,7 @@ def _try_call(fn, args, need_float=True):
 
 def synthesize(name, fn):
     """Find (args) of float64 arrays on which fn runs and is finite."""
-    rng = np.random.RandomState(hash(name) % (2 ** 31))
+    rng = np.random.RandomState(_seed_of(name))
     for arity in (1, 2, 3):
         for shape in _SHAPES:
             for lo, hi in _RANGES:
@@ -80,7 +88,7 @@ def synthesize_mixed(name, fn):
     """Second-chance synthesis for ops needing integer/bool operands
     (indices, comparisons, shifts): int32, bool, and (float, int) combos.
     Output need not be float (comparisons etc. are forward-only checks)."""
-    rng = np.random.RandomState(hash(name) % (2 ** 31))
+    rng = np.random.RandomState(_seed_of(name))
 
     def ints(shape, hi=3):
         return jnp.asarray(rng.randint(0, hi, shape), jnp.int32)
@@ -109,11 +117,34 @@ def synthesize_mixed(name, fn):
     return None
 
 
+def _has_float_arg(args):
+    return any(hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+               for a in args)
+
+
 @functools.lru_cache(maxsize=None)
 def _plan(name):
     """Lazy per-op synthesis so COLLECTION stays cheap (the sweep used to
-    synthesize all ~400 ops at import, taxing every pytest run)."""
+    synthesize all ~400 ops at import, taxing every pytest run).
+
+    Resolution order: explicit recipe (op_harness_recipes.RECIPES, the
+    structural-attr ops) → generic float synthesis → mixed int/bool
+    synthesis → None (must then be in WHITELIST)."""
     entry = OP_REGISTRY[name]
+    if name in RECIPES:
+        rng = np.random.RandomState(_seed_of(name))
+        r_args, r_kwargs = RECIPES[name](rng)
+        r_kwargs = dict(r_kwargs)
+        wrap = r_kwargs.pop("_wrap", None)
+        fn = ADAPTERS[wrap](entry["fn"]) if wrap else entry["fn"]
+        if r_kwargs:
+            fn = functools.partial(fn, **r_kwargs)
+        out = _try_call(fn, list(r_args), need_float=False)
+        # a recipe that stops running is a bug, not a skip
+        assert out is not None, f"recipe for '{name}' fails to execute"
+        diff = (entry["differentiable"] and _has_float_arg(r_args)
+                and _floatify(out) is not None)
+        return fn, list(r_args), diff
     args = synthesize(name, entry["fn"])
     if args is None:
         args = synthesize_mixed(name, entry["fn"])
@@ -131,6 +162,27 @@ def _plan(name):
 
 
 _ALL_OPS = sorted(OP_REGISTRY)
+
+# Ops whose loss is non-deterministic across calls (fresh PRNG draw inside
+# the op): finite differences are meaningless; grads are still required to
+# exist and be finite, and each has a dedicated distributional test.
+_NO_FD = {
+    "gumbel_softmax": "fresh gumbel noise per call (test_activation pins "
+                      "the distribution; straight-through grad is exact "
+                      "by construction)",
+    "flash_attention_pallas": "f32 kernel accumulation noise dominates "
+                              "central differences at any usable eps; "
+                              "grads are pinned against the dense "
+                              "reference in tests/test_pallas_kernels.py",
+}
+
+# f32-internal ops where fp64 central differences at eps=1e-5 hit the
+# kernel's own rounding noise: relaxed (atol, rtol) for the FD comparison.
+# Their exact gradients are pinned against dense references elsewhere
+# (tests/test_pallas_kernels.py, tests/test_nn.py attention tests).
+_FD_TOL = {
+    "scaled_dot_product_attention": (2e-3, 0.5),
+}
 
 
 # numpy forward references for ops whose semantics match a numpy call
@@ -152,12 +204,25 @@ _NP_REF = {k: v for k, v in _NP_REF.items() if v is not None}
 
 
 def test_registry_fully_covered():
-    """Coverage pin: the synthesizable fraction must not silently regress
-    (non-synthesizable ops are the implicit whitelist, visible as skips)."""
+    """Coverage pin: the synthesizable fraction must not silently regress."""
     covered = sum(1 for n in _ALL_OPS if _plan(n) is not None)
     covered_frac = covered / len(OP_REGISTRY)
-    assert covered_frac > 0.70, (
+    assert covered_frac >= 0.90, (
         f"harness coverage dropped to {covered_frac:.0%}")
+
+
+def test_whitelist_is_exact():
+    """The skip set must equal the NAMED whitelist in both directions
+    (test/white_list/ discipline, op_test.py:420): a new op either passes
+    the harness or gets a whitelist entry with a reason; a whitelisted op
+    that becomes synthesizable must be removed from the list."""
+    skipped = {n for n in _ALL_OPS if _plan(n) is None}
+    unlisted = skipped - set(WHITELIST)
+    stale = set(WHITELIST) - skipped
+    assert not unlisted, (
+        f"ops skipped without a whitelist entry+reason: {sorted(unlisted)}")
+    assert not stale, (
+        f"stale whitelist entries (now synthesizable): {sorted(stale)}")
 
 
 @pytest.mark.parametrize("name", _ALL_OPS)
@@ -181,13 +246,33 @@ def test_op_forward_and_grad(name):
         return
 
     def loss(*a):
-        val = _floatify(fn(*a))
-        return val if val is not None else jnp.float64(0)
+        """Random-cotangent reduction: sum(out * w) with fixed random w.
 
-    # differentiate only the float arguments (int/bool operands of mixed
-    # ops carry no gradient)
+        A uniform all-ones cotangent (plain .sum()) lets transposed or
+        permuted gradients pass; the random weighting makes the vjp
+        direction generic (VERDICT r2 #4). w is reseeded per call so
+        finite-difference evaluations see the identical weights."""
+        out = fn(*a)
+        wrng = np.random.RandomState(_seed_of(name) ^ 0x5EED)
+        total = None
+        for leaf in jax.tree_util.tree_leaves(out):
+            if not hasattr(leaf, "dtype"):
+                continue
+            w = jnp.asarray(wrng.uniform(0.5, 1.5, np.shape(leaf)))
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                term = jnp.sum(leaf.astype(jnp.float64) * w)
+            elif jnp.issubdtype(leaf.dtype, jnp.complexfloating):
+                term = jnp.sum(jnp.abs(leaf).astype(jnp.float64) ** 2 * w)
+            else:
+                continue
+            total = term if total is None else total + term
+        return total if total is not None else jnp.float64(0)
+
+    # differentiate only the float ARRAY arguments (int/bool operands and
+    # structural attrs — ints, strings, shape lists — carry no gradient)
     float_pos = tuple(i for i, a in enumerate(args)
-                      if jnp.issubdtype(a.dtype, jnp.floating))
+                      if hasattr(a, "dtype")
+                      and jnp.issubdtype(a.dtype, jnp.floating))
     if not float_pos:
         pytest.skip(f"{name}: no float argument to differentiate")
     try:
@@ -195,25 +280,37 @@ def test_op_forward_and_grad(name):
     except Exception:
         pytest.skip(f"{name}: jax.grad unsupported on synthesized inputs")
 
+    if name in _NO_FD:
+        for g in grads:
+            assert bool(jnp.isfinite(jnp.asarray(g)).all()), (
+                f"{name}: non-finite gradient")
+        return
+
     eps = 1e-5
+    fd_atol, fd_rtol = _FD_TOL.get(name, (1e-3, 1e-2))
     for i, g in zip(float_pos, grads):
         flat = np.asarray(args[i]).ravel()
         # probe a few coordinates (full FD over every element is O(n) evals)
         idx = np.linspace(0, flat.size - 1, min(4, flat.size)).astype(int)
         for j in idx:
             # preserve each operand's dtype — only the float arg under
-            # test is perturbed (int/bool operands must stay integral)
-            ap = [np.asarray(a).copy() for a in args]
-            am = [np.asarray(a).copy() for a in args]
+            # test is perturbed (int/bool operands must stay integral;
+            # non-array structural args pass through untouched)
+            ap = [np.asarray(a).copy() if hasattr(a, "dtype") else a
+                  for a in args]
+            am = [np.asarray(a).copy() if hasattr(a, "dtype") else a
+                  for a in args]
             ap[i] = ap[i].astype(np.float64)
             am[i] = am[i].astype(np.float64)
             ap[i].ravel()[j] += eps
             am[i].ravel()[j] -= eps
-            fp = float(loss(*[jnp.asarray(a) for a in ap]))
-            fm = float(loss(*[jnp.asarray(a) for a in am]))
+            fp = float(loss(*[jnp.asarray(a) if hasattr(a, "dtype") else a
+                              for a in ap]))
+            fm = float(loss(*[jnp.asarray(a) if hasattr(a, "dtype") else a
+                              for a in am]))
             fd = (fp - fm) / (2 * eps)
             an = float(np.asarray(g).ravel()[j])
-            assert abs(fd - an) <= 1e-3 + 1e-2 * abs(fd), (
+            assert abs(fd - an) <= fd_atol + fd_rtol * abs(fd), (
                 f"{name}: grad mismatch at arg{i}[{j}]: fd={fd} vs "
                 f"analytic={an}")
 
@@ -225,7 +322,9 @@ def test_op_bf16_smoke(name):
         pytest.skip(f"{name}: no generic float synthesis (whitelisted)")
     fn, args, _ = plan
     bf_args = [a.astype(jnp.bfloat16)
-               if jnp.issubdtype(a.dtype, jnp.floating) else a
+               if hasattr(a, "dtype") and jnp.issubdtype(a.dtype,
+                                                         jnp.floating)
+               else a
                for a in args]
     if all(b is a for b, a in zip(bf_args, args)):
         pytest.skip(f"{name}: no float arg to cast (int/bool-only op)")
