@@ -139,13 +139,19 @@ class LlamaAttention(Layer):
         v = self.v_proj(hidden).reshape([b, s, kv, d])
         q = apply_rotary_pos_emb_t(q, cos, sin)
         k = apply_rotary_pos_emb_t(k, cos, sin)
-        if cfg.sep_mesh is not None and attn_mask is None:
+        if cfg.sep_mesh is not None:
             # context parallelism: exact global attention with K/V blocks
             # rotating the ICI ring (SURVEY.md §5's CP gap filler). GQA kv
             # heads stay unexpanded — the ring ships h/kv less K/V traffic.
+            # Masked/padded batches ride the ring too: the mask's query rows
+            # are sequence-sharded, each step slices the block's columns.
             from ..ops.ring_attention import ring_attention
+            # an explicit mask is the COMPLETE attention spec (callers bake
+            # causality into it), matching the dense path's is_causal rule
             out = ring_attention(q, k, v, mesh=cfg.sep_mesh,
-                                 axis_name=cfg.sep_axis, causal=True)
+                                 axis_name=cfg.sep_axis,
+                                 causal=attn_mask is None,
+                                 attn_mask=attn_mask)
         else:
             from ..nn.functional import _pallas_attention_eligible
             mask_arr = None if attn_mask is None else attn_mask._data
@@ -255,7 +261,7 @@ class ScannedLlamaLayers(Layer):
         eps = cfg.rms_norm_eps
         seq = int(hidden.shape[1])
         ring_impl = None
-        if cfg.sep_mesh is not None and attn_mask is None:
+        if cfg.sep_mesh is not None:
             # context parallelism inside the scan body: the ring shard_map
             # runs per scanned layer (scan-of-shard_map — the layer body is
             # still traced once; K/V blocks rotate the ICI ring each step)
@@ -281,8 +287,13 @@ class ScannedLlamaLayers(Layer):
                     h % _axes_size(jmesh, head_axis)
                     or kv % _axes_size(jmesh, head_axis)):
                 head_axis = None
-            ring_impl = _cached_impl(jmesh, cfg.sep_axis, True, batch_axis,
-                                     head_axis)
+            # explicit mask == complete attention spec (non-causal ring),
+            # matching the dense branch's `mask is None` causality rule.
+            # Flags passed positionally to share lru_cache slots with the
+            # public ring_attention() call sites.
+            ring_impl = _cached_impl(jmesh, cfg.sep_axis, attn_mask is None,
+                                     batch_axis, head_axis,
+                                     attn_mask is not None, False)
         use_flash = (ring_impl is None and attn_mask is None and _pl.on_tpu()
                      and get_flag("FLAGS_use_pallas_attention"))
         if use_flash:
@@ -312,7 +323,8 @@ class ScannedLlamaLayers(Layer):
                 if ring_impl is not None:
                     # raw-jnp ring call (we are already inside the traced
                     # scan body; the op-level dispatch wrapper is above us)
-                    ctx = ring_impl(q, k, v)
+                    ctx = (ring_impl(q, k, v) if mask is None
+                           else ring_impl(q, k, v, mask))
                 elif use_flash:
                     # GQA is native in the v2 kernel: K/V stay at kv heads
                     # (the index map expands the group in-kernel)
