@@ -54,7 +54,7 @@ class GPT2Attention(Layer):
         self.config = config
         self.resid_dropout = Dropout(config.dropout)
 
-    def forward(self, hidden):
+    def forward(self, hidden, return_kv=False):
         b, s, _ = hidden.shape
         h, d = self.config.num_attention_heads, self.config.head_dim
         qkv = self.c_attn(hidden).reshape([b, s, 3, h, d])
@@ -63,7 +63,11 @@ class GPT2Attention(Layer):
             q, k, v, is_causal=True,
             dropout_p=self.config.dropout if self.training else 0.0)
         out = self.c_proj(out.reshape([b, s, h * d]))
-        return self.resid_dropout(out)
+        out = self.resid_dropout(out)
+        if return_kv:
+            # cache layout [B, H, S, D] (masked_multihead_attention's)
+            return out, k.transpose([0, 2, 1, 3]), v.transpose([0, 2, 1, 3])
+        return out
 
 
 class GPT2MLP(Layer):
@@ -94,6 +98,33 @@ class GPT2Block(Layer):
         hidden = hidden + self.attn(self.ln_1(hidden))
         return hidden + self.mlp(self.ln_2(hidden))
 
+    def forward_kv(self, hidden):
+        """Prefill: dense causal attention + this layer's K/V for the cache."""
+        attn_out, k, v = self.attn(self.ln_1(hidden), return_kv=True)
+        hidden = hidden + attn_out
+        return hidden + self.mlp(self.ln_2(hidden)), k, v
+
+    def decode(self, hidden, cache_kv, t):
+        """One-token decode over the dense KV cache.
+
+        hidden: [B, 1, E]; cache_kv: [2, B, H, S_max, D]; t: [B, 1] current
+        lengths. The attention is masked_multihead_attention (reference
+        masked_multihead_attention.py:19 / its fused CUDA kernel) — scatter
+        this step's K/V at row t, attend over the prefix. Returns
+        (hidden', new_cache).
+        """
+        from ..incubate.nn.functional.decode_attention import \
+            masked_multihead_attention
+        b = hidden.shape[0]
+        x = self.ln_1(hidden)
+        qkv = self.attn.c_attn(x.reshape([b, -1]))       # [B, 3*H*D]
+        out, new_cache = masked_multihead_attention(
+            qkv, cache_kv, sequence_lengths=t)
+        attn_out = self.attn.resid_dropout(
+            self.attn.c_proj(out.reshape([b, 1, -1])))
+        hidden = hidden + attn_out
+        return hidden + self.mlp(self.ln_2(hidden)), new_cache
+
 
 class GPT2Model(Layer):
     def __init__(self, config: GPT2Config):
@@ -121,6 +152,31 @@ class GPT2Model(Layer):
             hidden = blk(hidden)
         return self.ln_f(hidden)
 
+    def forward_prefill(self, input_ids, s_max):
+        """Dense prompt pass that also fills the decode KV caches.
+
+        Returns (hidden [B, S, E], caches [L, 2, B, H, s_max, D]).
+        """
+        import paddle_tpu as paddle
+        from .. import ops
+        b, s = input_ids.shape
+        if s > s_max:
+            raise ValueError(f"prompt length {s} exceeds cache size {s_max}")
+        pos = ops.arange(0, s, dtype="int32").unsqueeze(0)
+        hidden = self.drop(self.wte(input_ids) + self.wpe(pos))
+        h, d = self.config.num_attention_heads, self.config.head_dim
+        pad = (paddle.zeros([b, h, s_max - s, d],
+                            dtype=self.config.dtype)
+               if s < s_max else None)
+        caches = []
+        for blk in self.h:
+            hidden, k, v = blk.forward_kv(hidden)
+            if pad is not None:
+                k = ops.concat([k, pad.astype(k.dtype)], axis=2)
+                v = ops.concat([v, pad.astype(v.dtype)], axis=2)
+            caches.append(ops.stack([k, v]))
+        return self.ln_f(hidden), ops.stack(caches)
+
 
 class GPT2ForCausalLM(Layer):
     def __init__(self, config: GPT2Config):
@@ -137,18 +193,88 @@ class GPT2ForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         hidden = self.transformer(input_ids)
-        if self.lm_head is None:
-            from .. import ops
-            logits = ops.matmul(hidden, self.transformer.wte.weight,
-                                transpose_y=True)
-        else:
-            logits = self.lm_head(hidden)
+        logits = self._logits(hidden)
         if labels is None:
             return logits
         loss = F.cross_entropy(
             logits.reshape([-1, self.config.vocab_size]).astype("float32"),
             labels.reshape([-1]))
         return logits, loss
+
+    def _logits(self, hidden):
+        if self.lm_head is None:
+            from .. import ops
+            return ops.matmul(hidden, self.transformer.wte.weight,
+                              transpose_y=True)
+        return self.lm_head(hidden)
+
+    def prefill(self, input_ids, s_max):
+        """Prompt pass for incremental decode (the serving path).
+
+        Returns (last_logits [B, 1, V], caches [L, 2, B, H, s_max, D],
+        t [B, 1] int32 — the next write position).
+        """
+        import paddle_tpu as paddle
+        b, s = input_ids.shape
+        hidden, caches = self.transformer.forward_prefill(input_ids, s_max)
+        logits = self._logits(hidden[:, s - 1:s])
+        t = paddle.full([b, 1], s, dtype="int32")
+        return logits, caches, t
+
+    def decode_step(self, tok, caches, t):
+        """One incremental token through every layer's KV cache.
+
+        tok: [B, 1] int; caches: [L, 2, B, H, S_max, D]; t: [B, 1] int32.
+        All shapes are static, so `jit.to_static(model.decode_step)`
+        compiles ONE executable that serves every step — the XLA analog of
+        the reference's fused decode kernels
+        (masked_multihead_attention_kernel.cu). Returns
+        (logits [B, 1, V], caches', t+1).
+        """
+        from .. import ops
+        hidden = self.transformer.wte(tok) + self.transformer.wpe(t)
+        hidden = self.transformer.drop(hidden)
+        new_caches = []
+        for i, blk in enumerate(self.transformer.h):
+            hidden, nc = blk.decode(hidden, caches[i], t)
+            new_caches.append(nc)
+        hidden = self.transformer.ln_f(hidden)
+        return self._logits(hidden), ops.stack(new_caches), t + 1
+
+    def generate(self, input_ids, max_new_tokens, s_max=None,
+                 decode_fn=None):
+        """Greedy incremental decode over the KV cache.
+
+        decode_fn: optionally a compiled decode step (e.g.
+        ``jit.to_static(model.decode_step)``) so every token reuses one
+        executable; defaults to the eager step. Returns [B, S + new] ids.
+        """
+        import paddle_tpu as paddle
+        from .. import ops
+        b, s = input_ids.shape
+        if s_max is None:
+            s_max = min(self.config.max_position_embeddings,
+                        s + max_new_tokens)
+        if s_max > self.config.max_position_embeddings:
+            # wpe lookups beyond the table would CLIP silently (jnp.take),
+            # reusing the last position embedding — reject loudly instead
+            raise ValueError(
+                f"s_max={s_max} exceeds max_position_embeddings="
+                f"{self.config.max_position_embeddings}")
+        if s + max_new_tokens > s_max:
+            raise ValueError(f"s_max={s_max} too small for prompt {s} + "
+                             f"{max_new_tokens} new tokens")
+        step = decode_fn if decode_fn is not None else self.decode_step
+        logits, caches, t = self.prefill(input_ids, s_max)
+        toks = [input_ids]
+        tok = ops.argmax(logits[:, -1], axis=-1).reshape([b, 1])
+        for i in range(max_new_tokens):
+            toks.append(tok)
+            if i + 1 == max_new_tokens:
+                break
+            logits, caches, t = step(tok.astype(input_ids.dtype), caches, t)
+            tok = ops.argmax(logits[:, -1], axis=-1).reshape([b, 1])
+        return ops.concat([x.astype("int64") for x in toks], axis=1)
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in self.parameters())

@@ -1,0 +1,90 @@
+"""Incremental (KV-cache) decode tests — the serving path (VERDICT r2 #6).
+
+Reference coverage model: the decode parity tests around
+masked_multihead_attention / block_multihead_attention
+(test/legacy_test/test_masked_multihead_attention_op.py): an incremental
+step over the cache must produce exactly the tokens the full-context
+forward produces.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+
+
+def _tiny(dropout=0.0):
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64,
+                     dropout=dropout)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _greedy_full_recompute(m, ids, n):
+    cur = np.asarray(ids._data)
+    for _ in range(n):
+        logits = m(paddle.to_tensor(cur))
+        nxt = np.asarray(logits._data)[:, -1].argmax(-1)[:, None]
+        cur = np.concatenate([cur, nxt], axis=1)
+    return cur.tolist()
+
+
+@pytest.mark.quick
+def test_kv_cache_decode_matches_full_recompute():
+    m, cfg = _tiny()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 128, (2, 10)))
+    with paddle.no_grad():
+        out = m.generate(ids, max_new_tokens=6).numpy().tolist()
+        ref = _greedy_full_recompute(m, ids, 6)
+    assert out == ref
+
+
+def test_compiled_decode_step_matches_eager():
+    """jit.to_static(decode_step): one executable serves every step."""
+    m, cfg = _tiny()
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(0, 128, (2, 12)))
+    with paddle.no_grad():
+        ref = m.generate(ids, max_new_tokens=8).numpy().tolist()
+        step = jit.to_static(m.decode_step)
+        out = m.generate(ids, max_new_tokens=8,
+                         decode_fn=step).numpy().tolist()
+    assert out == ref
+
+
+def test_prefill_cache_layout():
+    m, cfg = _tiny()
+    b, s, s_max = 2, 7, 16
+    ids = paddle.to_tensor(np.random.RandomState(2).randint(0, 128, (b, s)))
+    with paddle.no_grad():
+        logits, caches, t = m.prefill(ids, s_max)
+    L = cfg.num_hidden_layers
+    h, d = cfg.num_attention_heads, cfg.head_dim
+    assert list(caches.shape) == [L, 2, b, h, s_max, d]
+    assert list(logits.shape) == [b, 1, cfg.vocab_size]
+    assert t.numpy().ravel().tolist() == [s, s]
+    # rows beyond the prompt are zero until decode writes them
+    tail = caches.numpy()[:, :, :, :, s:, :]
+    np.testing.assert_allclose(tail, 0.0)
+
+
+def test_generate_respects_cache_bound():
+    m, cfg = _tiny()
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(0, 128, (1, 8)))
+    with pytest.raises(ValueError, match="s_max"):
+        m.generate(ids, max_new_tokens=16, s_max=12)
+
+
+def test_int8_decode_runs():
+    """Weight-only int8 + KV cache: the serving combo stays greedy-stable."""
+    from paddle_tpu import nn
+    m, cfg = _tiny()
+    ids = paddle.to_tensor(np.random.RandomState(4).randint(0, 128, (1, 8)))
+    with paddle.no_grad():
+        nn.quant.quantize_linear_layers(m)
+        out = m.generate(ids, max_new_tokens=4)
+        ref = _greedy_full_recompute(m, ids, 4)
+    assert out.numpy().tolist() == ref
