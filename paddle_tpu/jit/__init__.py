@@ -68,13 +68,20 @@ class StaticFunction:
     """
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
-                 backend=None, full_graph=True, batch_buckets=None):
+                 backend=None, full_graph=True, batch_buckets=None,
+                 seq_buckets=None, seq_axis=1, seq_mask_arg=None,
+                 seq_unpad_outputs=True):
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._cache: Dict[Any, dict] = {}
         self._full_graph = full_graph
         self._buckets = tuple(sorted(batch_buckets)) if batch_buckets \
             else None
+        self._seq_buckets = tuple(sorted(seq_buckets)) if seq_buckets \
+            else None
+        self._seq_axis = seq_axis
+        self._seq_mask_arg = seq_mask_arg
+        self._seq_unpad_outputs = seq_unpad_outputs
 
     @property
     def code(self):
@@ -87,6 +94,11 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED[0]:
             return self._fn(*args, **kwargs)
+        if self._seq_buckets:
+            return self._call_seq_bucketed(args, kwargs)
+        return self._inner_dispatch(args, kwargs)
+
+    def _inner_dispatch(self, args, kwargs):
         if self._buckets:
             return self._call_bucketed(args, kwargs)
         return self._dispatch(args, kwargs)
@@ -138,6 +150,133 @@ class StaticFunction:
             return t
 
         return jax.tree_util.tree_map(unpad, out, is_leaf=_is_tensor)
+
+    # -- bucketed dynamic-SEQUENCE compilation (SURVEY §7 hard part (d)) ----
+    def _call_seq_bucketed(self, args, kwargs):
+        """Pad dim `seq_axis` of every sequence-carrying tensor arg up to
+        the next bucket and slice outputs back — O(log s_max) executables
+        serve any sequence length instead of one trace/compile per length
+        (the reference re-traces via SOT guards,
+        jit/sot/opcode_translator/executor/function_graph.py:143; XLA's
+        static shapes want padding instead).
+
+        Exact for causal models as-is (real positions never attend to the
+        right-padded tail). For bidirectional attention pass
+        ``seq_mask_arg``: the wrapper synthesizes (or pads a caller's)
+        keep-mask blocking the tail keys.
+
+        Limitations (document-level contract, like batch_buckets'
+        per-sample-map rule): every arg carrying the sequence must carry
+        it at `seq_axis` (attention masks go through seq_mask_arg); an
+        output whose `seq_axis` dim coincidentally EQUALS a bucket size
+        would be sliced — models whose outputs carry no sequence axis
+        (classifier heads) should pass seq_unpad_outputs=False.
+        """
+        leaves = [t for t in jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=_is_tensor) if _is_tensor(t)]
+        ax = self._seq_axis
+        seqful = [t for t in leaves if t.ndim > ax]
+        if not seqful:
+            return self._inner_dispatch(args, kwargs)
+        s = seqful[0].shape[ax]
+        bucket = next((k for k in self._seq_buckets if s <= k), None)
+        if bucket is None or bucket == s:
+            return self._inner_dispatch(args, kwargs)
+
+        from .. import concat, zeros
+
+        # locate the caller's mask whether it came by keyword OR position
+        mask_name = self._seq_mask_arg
+        user_mask = None
+        mask_pos = None
+        if mask_name:
+            if mask_name in kwargs:
+                user_mask = kwargs[mask_name]
+            else:
+                import inspect
+                try:
+                    params = list(
+                        inspect.signature(self._fn).parameters)
+                    pos = params.index(mask_name)
+                    if pos < len(args):
+                        mask_pos = pos
+                        user_mask = args[pos]
+                except ValueError:
+                    pass
+
+        def pad_seq(t):
+            if not (_is_tensor(t) and t.ndim > ax and t.shape[ax] == s):
+                return t
+            if t is user_mask:
+                return t  # handled below (needs blocking, not zero, fill)
+            pshape = list(t.shape)
+            pshape[ax] = bucket - s
+            return concat([t, zeros(pshape, dtype=t.dtype)], axis=ax)
+
+        p_args, p_kwargs = jax.tree_util.tree_map(
+            pad_seq, (args, kwargs), is_leaf=_is_tensor)
+
+        if mask_name:
+            padded = self._padded_mask(user_mask, s, bucket)
+            if mask_pos is not None:
+                p_args = list(p_args)
+                p_args[mask_pos] = padded
+                p_args = tuple(p_args)
+            else:
+                p_kwargs = dict(p_kwargs)
+                p_kwargs[mask_name] = padded
+        out = self._inner_dispatch(p_args, p_kwargs)
+        if not self._seq_unpad_outputs:
+            return out
+
+        def unpad(t):
+            if _is_tensor(t) and t.ndim > ax and t.shape[ax] == bucket:
+                idx = [slice(None)] * t.ndim
+                idx[ax] = slice(0, s)
+                return t[tuple(idx)]
+            return t
+
+        return jax.tree_util.tree_map(unpad, out, is_leaf=_is_tensor)
+
+    @staticmethod
+    def _padded_mask(user_mask, s, bucket):
+        """Tail-blocking attention mask at the bucket size.
+
+        No caller mask: a [1, 1, 1, bucket] bool keep-mask (tail keys
+        dropped, broadcast over rows/heads). Caller mask with trailing
+        [.., s, s]: padded to [.., bucket, bucket] — tail KEY columns
+        blocked (False, or a dtype-safe large negative: -1e9 overflows
+        fp16 to -inf and fully-blocked rows then NaN through softmax),
+        tail query rows are sliced off the output so their fill is
+        irrelevant.
+        """
+        import numpy as np
+
+        from .. import to_tensor
+
+        if user_mask is None:
+            keep = np.zeros((1, 1, 1, bucket), dtype=bool)
+            keep[..., :s] = True
+            return to_tensor(keep)
+        m = user_mask
+        is_bool = "bool" in str(m.dtype)
+        from .. import concat, full
+        qs, ks = m.shape[-2], m.shape[-1]
+        if is_bool:
+            blocked = False
+        else:
+            np_dtype = np.dtype(str(m.dtype).replace("paddle.", ""))
+            blocked = (float(np.finfo(np_dtype).min) / 2
+                       if np.issubdtype(np_dtype, np.floating) else -1e9)
+        if ks == s and bucket > s:
+            cshape = list(m.shape)
+            cshape[-1] = bucket - s
+            m = concat([m, full(cshape, blocked, dtype=m.dtype)], axis=-1)
+        if qs == s and bucket > s:
+            rshape = list(m.shape)
+            rshape[-2] = bucket - s
+            m = concat([m, full(rshape, blocked, dtype=m.dtype)], axis=-2)
+        return m
 
     # -- pass 1: discovery --------------------------------------------------
     def _trace(self, args, kwargs):
@@ -311,13 +450,21 @@ def _rewrap_args(flat_arrays, treedef, tensor_pos, static_flat):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, batch_buckets=None):
+              backend=None, full_graph=True, batch_buckets=None,
+              seq_buckets=None, seq_axis=1, seq_mask_arg=None,
+              seq_unpad_outputs=True):
     """paddle.jit.to_static analog (jit/api.py:171).
 
     batch_buckets: opt-in dynamic-batch bucketing — inputs pad their
     leading dim up to the next bucket so a BOUNDED set of executables
     serves any batch size (valid only for per-sample maps: cross-batch
-    reductions would see the pad rows)."""
+    reductions would see the pad rows).
+
+    seq_buckets: opt-in dynamic-SEQUENCE bucketing (e.g. powers of two):
+    inputs pad dim `seq_axis` up to the next bucket and outputs slice
+    back, so varying lengths reuse O(log s_max) executables. Exact for
+    causal models; for bidirectional attention name the mask kwarg via
+    `seq_mask_arg` and the wrapper blocks the tail keys."""
     def deco(fn):
         # Layer: compile its forward, keep the layer object semantics
         from ..nn.layer import Layer
@@ -325,11 +472,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             layer = fn
             static = StaticFunction(layer.forward, input_spec,
                                     build_strategy, backend, full_graph,
-                                    batch_buckets)
+                                    batch_buckets, seq_buckets, seq_axis,
+                                    seq_mask_arg, seq_unpad_outputs)
             layer.forward = static
             return layer
         return StaticFunction(fn, input_spec, build_strategy, backend,
-                              full_graph, batch_buckets)
+                              full_graph, batch_buckets, seq_buckets,
+                              seq_axis, seq_mask_arg, seq_unpad_outputs)
     if function is not None:
         return deco(function)
     return deco

@@ -1,0 +1,125 @@
+"""Dynamic-shape (sequence) bucketing for to_static (SURVEY §7 hard (d)).
+
+The reference handles dynamic shapes by guard + re-trace per shape
+(jit/sot/.../function_graph.py:143); XLA wants static shapes, so varying
+lengths pad up to power-of-two buckets and reuse O(log n) executables.
+These tests pin: two distinct lengths hit the SAME executable with
+matching numerics (VERDICT r2 #7's done-criterion), and the tail masking
+keeps bidirectional attention exact.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+
+
+@pytest.mark.quick
+def test_causal_lm_two_lengths_one_executable():
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=32,
+                     dropout=0.0)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids10 = paddle.to_tensor(rng.randint(0, 128, (2, 10)))
+    ids13 = paddle.to_tensor(rng.randint(0, 128, (2, 13)))
+    with paddle.no_grad():
+        ref10 = m(ids10).numpy()
+        ref13 = m(ids13).numpy()
+        static = jit.to_static(m.forward, seq_buckets=(16, 32))
+        out10 = static(ids10).numpy()
+        out13 = static(ids13).numpy()
+    # both lengths pad to bucket 16 → ONE cache entry / executable
+    assert len(static._cache) == 1
+    assert out10.shape == ref10.shape and out13.shape == ref13.shape
+    np.testing.assert_allclose(out10, ref10, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out13, ref13, rtol=1e-5, atol=1e-5)
+
+
+def test_longer_length_next_bucket():
+    paddle.seed(1)
+    cfg = GPT2Config(vocab_size=64, hidden_size=32, num_hidden_layers=1,
+                     num_attention_heads=2, max_position_embeddings=64,
+                     dropout=0.0)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(1)
+    static = jit.to_static(m.forward, seq_buckets=(8, 16, 32))
+    with paddle.no_grad():
+        for s in (5, 7, 12, 30):
+            ids = paddle.to_tensor(rng.randint(0, 64, (1, s)))
+            out = static(ids).numpy()
+            ref = m(ids).numpy()
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # lengths 5,7 → bucket 8; 12 → 16; 30 → 32: exactly three executables
+    assert len(static._cache) == 3
+
+
+def test_bidirectional_tail_mask_synthesized():
+    """Non-causal attention needs the tail keys blocked; seq_mask_arg
+    makes the wrapper synthesize the keep-mask."""
+    paddle.seed(2)
+    lin = nn.Linear(16, 16)
+
+    def encode(x, attn_mask=None):
+        q = lin(x)
+        return nn.functional.scaled_dot_product_attention(
+            q.reshape([1, x.shape[1], 2, 8]),
+            q.reshape([1, x.shape[1], 2, 8]),
+            q.reshape([1, x.shape[1], 2, 8]),
+            attn_mask=attn_mask, is_causal=False)
+
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(1, 11, 16).astype("float32"))
+    with paddle.no_grad():
+        ref = encode(x).numpy()
+        static = jit.to_static(encode, seq_buckets=(16,),
+                               seq_mask_arg="attn_mask")
+        out = static(x).numpy()
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_caller_mask_padded_to_bucket():
+    """A caller's own additive mask is padded with blocking values."""
+    paddle.seed(3)
+    lin = nn.Linear(16, 16)
+
+    def encode(x, attn_mask=None):
+        q = lin(x)
+        return nn.functional.scaled_dot_product_attention(
+            q.reshape([1, x.shape[1], 2, 8]),
+            q.reshape([1, x.shape[1], 2, 8]),
+            q.reshape([1, x.shape[1], 2, 8]),
+            attn_mask=attn_mask, is_causal=False)
+
+    rng = np.random.RandomState(3)
+    s = 10
+    x = paddle.to_tensor(rng.randn(1, s, 16).astype("float32"))
+    mask = paddle.to_tensor((rng.randn(1, 1, s, s) * 0.5).astype("float32"))
+    with paddle.no_grad():
+        ref = encode(x, attn_mask=mask).numpy()
+        static = jit.to_static(encode, seq_buckets=(16,),
+                               seq_mask_arg="attn_mask")
+        out = static(x, attn_mask=mask).numpy()
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_exact_bucket_size_passthrough():
+    """A length already at a bucket boundary skips padding entirely."""
+    paddle.seed(4)
+    cfg = GPT2Config(vocab_size=64, hidden_size=32, num_hidden_layers=1,
+                     num_attention_heads=2, max_position_embeddings=32,
+                     dropout=0.0)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(4).randint(0, 64, (1, 16)))
+    with paddle.no_grad():
+        static = jit.to_static(m.forward, seq_buckets=(16,))
+        out = static(ids).numpy()
+        ref = m(ids).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
