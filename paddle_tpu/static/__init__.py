@@ -27,6 +27,8 @@ from .extras import (BuildStrategy, CompiledProgram, ExecutionStrategy,
                      save_inference_model, save_to_file, scope_guard,
                      serialize_persistables, serialize_program)
 from . import nn
+from . import ir
+from .ir import IrProgram, apply_pass, list_passes, register_pass
 
 __all__ = ["InputSpec", "Program", "Executor", "program_guard", "data",
            "default_main_program", "default_startup_program", "quantization",
@@ -41,4 +43,5 @@ __all__ = ["InputSpec", "Program", "Executor", "program_guard", "data",
     "serialize_persistables", "serialize_program", "Variable", "accuracy",
     "auc", "cpu_places", "create_global_var", "create_parameter",
     "ctr_metric_bundle", "cuda_places", "device_guard", "load_program_state",
-    "normalize_program", "set_ipu_shard", "set_program_state", "xpu_places"]
+    "normalize_program", "set_ipu_shard", "set_program_state", "xpu_places",
+    "ir", "IrProgram", "apply_pass", "list_passes", "register_pass"]
