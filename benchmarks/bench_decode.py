@@ -87,6 +87,19 @@ def main():
         rate = _steady_rate(compiled_step)
         detail["kv_cache_compiled_steps_per_s"] = round(rate, 3)
 
+        # paged block cache (vLLM-style) decode step, eager — measured on
+        # the fp32 model so it compares against kv_cache_eager, not int8
+        _, pstate = model.paged_prefill(ids, block_size=64)
+        ptok = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (batch,)))
+        pbox = {"s": pstate}
+
+        def paged_step():
+            _, pbox["s"] = model.paged_decode_step(ptok, pbox["s"])
+
+        detail["paged_eager_steps_per_s"] = round(
+            _steady_rate(paged_step, iters=8), 3)
+
         # int8 weight-only variant
         n_q = nn.quant.quantize_linear_layers(model)
         compiled_q = jit.to_static(model.decode_step)

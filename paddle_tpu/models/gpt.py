@@ -241,6 +241,136 @@ class GPT2ForCausalLM(Layer):
         hidden = self.transformer.ln_f(hidden)
         return self._logits(hidden), ops.stack(new_caches), t + 1
 
+    # -- paged-KV serving route (vLLM-style block cache) --------------------
+
+    def paged_prefill(self, input_ids, block_size=64, blocks_per_seq=None):
+        """Prompt pass through the paged block cache
+        (block_multihead_attention, reference
+        incubate/nn/functional/block_multihead_attention.py:19).
+
+        Returns (last_logits [B, V], state dict). The cache is a pool of
+        physical [block_size] pages per layer; block_tables maps each
+        sequence's logical block index to its page — decode appends into
+        pages instead of one dense [B, S_max] strip, so cache memory
+        scales with actual lengths and pages are shareable/evictable.
+        """
+        import paddle_tpu as paddle
+        from .. import ops
+        from ..incubate.nn.functional.decode_attention import \
+            block_multihead_attention
+
+        cfg = self.config
+        b, s = input_ids.shape
+        h, d = cfg.num_attention_heads, cfg.head_dim
+        if blocks_per_seq is None:
+            blocks_per_seq = (cfg.max_position_embeddings + block_size - 1) \
+                // block_size
+        n_blocks = b * blocks_per_seq
+        bt = paddle.to_tensor(
+            np.arange(n_blocks, dtype=np.int32).reshape(b, blocks_per_seq))
+        enc = paddle.to_tensor(np.full((b,), s, np.int32))
+        dec = paddle.to_tensor(np.zeros((b,), np.int32))
+        cu_q = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
+
+        # packed-token forward: hidden is [T, E] (sequences concatenated)
+        ids_flat = input_ids.reshape([b * s])
+        pos_flat = paddle.to_tensor(np.tile(np.arange(s, dtype=np.int32), b))
+        hidden = self.transformer.wte(ids_flat) + self.transformer.wpe(
+            pos_flat)
+        hidden = self.transformer.drop(hidden)
+        layers_state = []
+        for blk in self.transformer.h:
+            kc = paddle.zeros([n_blocks, h, block_size, d],
+                              dtype=cfg.dtype)
+            vc = paddle.zeros([n_blocks, h, block_size, d],
+                              dtype=cfg.dtype)
+            x = blk.ln_1(hidden)
+            qkv = blk.attn.c_attn(x)                     # [T, 3*H*D]
+            out, _, kc, vc = block_multihead_attention(
+                qkv, kc, vc, enc, dec, enc, None, None, cu_q, cu_q, bt,
+                block_size=block_size)
+            hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
+            hidden = hidden + blk.mlp(blk.ln_2(hidden))
+            layers_state.append((kc, vc))
+        hidden = self.transformer.ln_f(hidden)
+        # last token of each sequence
+        last = hidden.reshape([b, s, -1])[:, s - 1]
+        logits = self._logits(last)
+        state = {"layers": layers_state, "block_tables": bt,
+                 "dec_lens": paddle.to_tensor(np.full((b,), s, np.int32)),
+                 "block_size": block_size,
+                 "capacity": blocks_per_seq * block_size,
+                 # per-step constants (batch-size-only): built once, not on
+                 # the hot decode path
+                 "zeros_b": paddle.to_tensor(np.zeros((b,), np.int32)),
+                 "ones_b": paddle.to_tensor(np.ones((b,), np.int32)),
+                 "cu_b": paddle.to_tensor(np.arange(b + 1, dtype=np.int32))}
+        return logits, state
+
+    def paged_decode_step(self, tok, state):
+        """One token per sequence through the paged cache (decode mode:
+        seq_lens_this_time == 1, append at dec_lens). tok: [B]."""
+        import paddle_tpu as paddle
+        from ..incubate.nn.functional.decode_attention import \
+            block_multihead_attention
+
+        cfg = self.config
+        b = tok.shape[0]
+        t = state["dec_lens"]
+        bt = state["block_tables"]
+        enc, this, cu_q = state["zeros_b"], state["ones_b"], state["cu_b"]
+        hidden = self.transformer.wte(tok) + self.transformer.wpe(t)
+        hidden = self.transformer.drop(hidden)
+        new_layers = []
+        for blk, (kc, vc) in zip(self.transformer.h, state["layers"]):
+            x = blk.ln_1(hidden)
+            qkv = blk.attn.c_attn(x)                     # [B, 3*H*D]
+            out, _, kc, vc = block_multihead_attention(
+                qkv, kc, vc, enc, t, this, None, None, cu_q, cu_q, bt,
+                block_size=state["block_size"])
+            hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
+            hidden = hidden + blk.mlp(blk.ln_2(hidden))
+            new_layers.append((kc, vc))
+        hidden = self.transformer.ln_f(hidden)
+        logits = self._logits(hidden)
+        new_state = dict(state, layers=new_layers, dec_lens=t + 1)
+        return logits, new_state
+
+    def generate_paged(self, input_ids, max_new_tokens, block_size=64,
+                       blocks_per_seq=None):
+        """Greedy decode over the paged block cache (the serving route the
+        reference exposes as block_multihead_attention + AnalysisPredictor;
+        here the cache pages live in HBM and XLA compiles the step)."""
+        from .. import ops
+        b, s = input_ids.shape
+        needed = s + max_new_tokens
+        if needed > self.config.max_position_embeddings:
+            # same silent-clip hazard as the dense route: wpe and the block
+            # table would both clip-index and corrupt live pages
+            raise ValueError(
+                f"prompt {s} + {max_new_tokens} new tokens exceeds "
+                f"max_position_embeddings="
+                f"{self.config.max_position_embeddings}")
+        if blocks_per_seq is None:
+            # size the page pool to the actual timeline, not the model max
+            blocks_per_seq = (needed + block_size - 1) // block_size
+        elif needed > blocks_per_seq * block_size:
+            raise ValueError(
+                f"paged cache capacity {blocks_per_seq * block_size} too "
+                f"small for prompt {s} + {max_new_tokens} new tokens")
+        logits, state = self.paged_prefill(input_ids, block_size,
+                                           blocks_per_seq)
+        toks = [input_ids]
+        tok = ops.argmax(logits, axis=-1).reshape([b])
+        for i in range(max_new_tokens):
+            toks.append(tok.reshape([b, 1]))
+            if i + 1 == max_new_tokens:
+                break
+            logits, state = self.paged_decode_step(
+                tok.astype(input_ids.dtype), state)
+            tok = ops.argmax(logits, axis=-1).reshape([b])
+        return ops.concat([x.astype("int64") for x in toks], axis=1)
+
     def generate(self, input_ids, max_new_tokens, s_max=None,
                  decode_fn=None):
         """Greedy incremental decode over the KV cache.
