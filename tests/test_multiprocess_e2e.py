@@ -100,33 +100,52 @@ def test_two_node_launch(tmp_path):
                     s.close()
         raise RuntimeError("no free 3-port window")
 
-    port = _three_port_base()
-    ckpt = str(tmp_path / "ckpt")
-    env = _launch_env()
-    procs = []
-    for node in range(2):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "paddle_tpu.distributed.launch",
-             "--nnodes", "2", "--node_rank", str(node),
-             "--master", f"127.0.0.1:{port}",
-             "--log_dir", str(tmp_path / f"logs{node}"),
-             WORKER, ckpt],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            cwd=REPO, env=env))
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            out, _ = p.communicate()
-        outs.append(out or "")
-    logs = ""
-    for node in range(2):
-        root = tmp_path / f"logs{node}"
-        if root.exists():
-            for f in sorted(root.iterdir()):
-                logs += f"\n--- node{node}/{f.name} ---\n" + f.read_text()
+    def _attempt(attempt_dir):
+        """One two-launcher run on a freshly probed port window. The probe
+        closes its sockets before the launchers bind (unavoidable TOCTOU),
+        so the CALLER retries on bind-race signatures rather than trusting
+        one window."""
+        port = _three_port_base()
+        ckpt = str(attempt_dir / "ckpt")
+        env = _launch_env()
+        procs = []
+        for node in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nnodes", "2", "--node_rank", str(node),
+                 "--master", f"127.0.0.1:{port}",
+                 "--log_dir", str(attempt_dir / f"logs{node}"),
+                 WORKER, ckpt],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                cwd=REPO, env=env))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out or "")
+        logs = ""
+        for node in range(2):
+            root = attempt_dir / f"logs{node}"
+            if root.exists():
+                for f in sorted(root.iterdir()):
+                    logs += f"\n--- node{node}/{f.name} ---\n" + f.read_text()
+        return procs, outs, logs
+
+    last = None
+    for attempt in range(3):
+        adir = tmp_path / f"attempt{attempt}"
+        adir.mkdir()
+        procs, outs, logs = _attempt(adir)
+        last = (procs, outs, logs)
+        if all(p.returncode == 0 for p in procs):
+            break
+        blob = "".join(outs) + logs
+        if "Address already in use" not in blob and "EADDRINUSE" not in blob:
+            break  # a real failure, not the port race — report it
+    procs, outs, logs = last
     assert all(p.returncode == 0 for p in procs), (
         f"rcs={[p.returncode for p in procs]}\n"
         f"out0:{outs[0][-1500:]}\nout1:{outs[1][-1500:]}\nlogs:{logs[-4000:]}")
