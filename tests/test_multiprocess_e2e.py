@@ -105,6 +105,9 @@ def test_two_node_launch(tmp_path):
         closes its sockets before the launchers bind (unavoidable TOCTOU),
         so the CALLER retries on bind-race signatures rather than trusting
         one window."""
+        import signal as _signal
+        import time as _time
+
         port = _three_port_base()
         ckpt = str(attempt_dir / "ckpt")
         env = _launch_env()
@@ -117,13 +120,38 @@ def test_two_node_launch(tmp_path):
                  "--log_dir", str(attempt_dir / f"logs{node}"),
                  WORKER, ckpt],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-                cwd=REPO, env=env))
+                cwd=REPO, env=env, start_new_session=True))
+
+        def _kill_group(p):
+            # each launcher leads its own session; killing the GROUP takes
+            # its spawned rank workers down too (a bare p.kill() would
+            # orphan them to spin through the remaining attempts)
+            try:
+                os.killpg(os.getpgid(p.pid), _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+        # poll both: when one launcher dies nonzero (e.g. the master lost
+        # the bind race), take its sibling down immediately instead of
+        # letting it wait out the full timeout against a dead master
+        deadline = _time.monotonic() + 300
+        while _time.monotonic() < deadline:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                break
+            if any(rc not in (None, 0) for rc in rcs):
+                _time.sleep(5)  # grace for the sibling to notice on its own
+                for p in procs:
+                    if p.poll() is None:
+                        _kill_group(p)
+                break
+            _time.sleep(0.5)
         outs = []
         for p in procs:
             try:
-                out, _ = p.communicate(timeout=300)
+                out, _ = p.communicate(timeout=30)
             except subprocess.TimeoutExpired:
-                p.kill()
+                _kill_group(p)
                 out, _ = p.communicate()
             outs.append(out or "")
         logs = ""
@@ -134,18 +162,15 @@ def test_two_node_launch(tmp_path):
                     logs += f"\n--- node{node}/{f.name} ---\n" + f.read_text()
         return procs, outs, logs
 
-    last = None
     for attempt in range(3):
         adir = tmp_path / f"attempt{attempt}"
         adir.mkdir()
         procs, outs, logs = _attempt(adir)
-        last = (procs, outs, logs)
         if all(p.returncode == 0 for p in procs):
             break
         blob = "".join(outs) + logs
         if "Address already in use" not in blob and "EADDRINUSE" not in blob:
             break  # a real failure, not the port race — report it
-    procs, outs, logs = last
     assert all(p.returncode == 0 for p in procs), (
         f"rcs={[p.returncode for p in procs]}\n"
         f"out0:{outs[0][-1500:]}\nout1:{outs[1][-1500:]}\nlogs:{logs[-4000:]}")
