@@ -337,10 +337,14 @@ class GPT2ForCausalLM(Layer):
         return logits, new_state
 
     def generate_paged(self, input_ids, max_new_tokens, block_size=64,
-                       blocks_per_seq=None):
+                       blocks_per_seq=None, decode_fn=None):
         """Greedy decode over the paged block cache (the serving route the
         reference exposes as block_multihead_attention + AnalysisPredictor;
-        here the cache pages live in HBM and XLA compiles the step)."""
+        here the cache pages live in HBM and XLA compiles the step).
+
+        decode_fn: optionally ``jit.to_static(model.paged_decode_step)`` —
+        the state pytree has static shapes, so one executable serves every
+        step here too."""
         from .. import ops
         b, s = input_ids.shape
         needed = s + max_new_tokens
@@ -360,14 +364,14 @@ class GPT2ForCausalLM(Layer):
                 f"small for prompt {s} + {max_new_tokens} new tokens")
         logits, state = self.paged_prefill(input_ids, block_size,
                                            blocks_per_seq)
+        step = decode_fn if decode_fn is not None else self.paged_decode_step
         toks = [input_ids]
         tok = ops.argmax(logits, axis=-1).reshape([b])
         for i in range(max_new_tokens):
             toks.append(tok.reshape([b, 1]))
             if i + 1 == max_new_tokens:
                 break
-            logits, state = self.paged_decode_step(
-                tok.astype(input_ids.dtype), state)
+            logits, state = step(tok.astype(input_ids.dtype), state)
             tok = ops.argmax(logits, axis=-1).reshape([b])
         return ops.concat([x.astype("int64") for x in toks], axis=1)
 

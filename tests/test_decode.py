@@ -113,3 +113,17 @@ def test_paged_decode_cross_block_boundary():
                                block_size=4).numpy().tolist()
         ref = _greedy_full_recompute(m, ids, 8)
     assert out == ref
+
+
+def test_compiled_paged_decode_step_matches_eager():
+    """to_static over the paged step: the state pytree has static shapes,
+    so one executable serves every paged decode step too."""
+    m, cfg = _tiny()
+    ids = paddle.to_tensor(np.random.RandomState(7).randint(0, 128, (2, 10)))
+    with paddle.no_grad():
+        ref = m.generate_paged(ids, max_new_tokens=6,
+                               block_size=8).numpy().tolist()
+        step = jit.to_static(m.paged_decode_step)
+        out = m.generate_paged(ids, max_new_tokens=6, block_size=8,
+                               decode_fn=step).numpy().tolist()
+    assert out == ref
