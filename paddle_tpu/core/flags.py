@@ -79,6 +79,10 @@ define_flag("FLAGS_eager_op_cache", True, "cache per-op jitted executables in ea
 define_flag("FLAGS_use_pallas_attention", True,
             "route attention to the Pallas flash kernel on TPU when shapes "
             "allow (reference: dynloaded flashattn, N27)")
+define_flag("FLAGS_flash_autotune", False,
+            "measure flash-attention (block_q, block_k) tilings on-device "
+            "at first eager call per shape and cache the winner (TPU only; "
+            "reference analog: per-arch tuned flashattn binaries)")
 define_flag("FLAGS_use_pallas_rmsnorm", True,
             "route weighted rms_norm to the fused Pallas kernel on TPU "
             "(reference: fused_rms_norm in phi/kernels/fusion)")

@@ -1,0 +1,122 @@
+"""Block-size autotuner for the Pallas flash-attention kernel.
+
+The reference ships per-arch tuned CUDA kernels (flashattn binaries per SM
+generation); on TPU the analogous knob is the (block_q, block_k) tiling of
+the Pallas grid — the right choice depends on chip generation (VMEM size,
+MXU shape) and on (seq, head_dim, heads). Rather than bake one guess,
+`tune_flash_blocks` measures a candidate set ON THE DEVICE and caches the
+winner per shape signature; `flash_attention_pallas` consults the cache
+when `FLAGS_flash_autotune` is on.
+
+Timing only means something on real hardware, so tuning is a no-op off
+TPU (interpret mode would measure the python interpreter). The real-TPU
+tier (`pytest -m tpu`) exercises one tuning sweep; `bench.py` can enable
+the flag for the headline run.
+
+MULTI-CONTROLLER CAUTION: the cache is process-local. In a multi-process
+SPMD world every controller must trace the SAME program — per-host timing
+noise could elect different winners and diverge the compiled step. There,
+tune on rank 0 only and distribute the winner to every rank via
+``set_best`` (e.g. over distributed.broadcast_object_list) before the
+first flagged call.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+# (block_q, block_k) candidates: MXU-friendly multiples of 128, biased
+# toward tall-K tiles (K/V streaming is the HBM-bound leg).
+CANDIDATES: List[Tuple[int, int]] = [
+    (128, 128), (128, 256), (256, 128), (256, 256),
+    (128, 512), (512, 128),
+]
+
+# shape signature -> winning (block_q, block_k)
+_BEST: Dict[tuple, Tuple[int, int]] = {}
+
+
+def _sig(q, k, causal, has_mask, dropout_p):
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    return (b, s, hq, hkv, d, bool(causal), bool(has_mask),
+            bool(dropout_p))
+
+
+def cached_blocks(q, k, causal, has_mask, dropout_p):
+    return _BEST.get(_sig(q, k, causal, has_mask, dropout_p))
+
+
+def set_best(q, k, causal, has_mask, dropout_p, blocks: Tuple[int, int]):
+    """Install a winner without measuring (rank-0-tunes-and-broadcasts
+    pattern for multi-controller worlds — see module docstring)."""
+    _BEST[_sig(q, k, causal, has_mask, dropout_p)] = tuple(blocks)
+
+
+def _filter_candidates(s: int, candidates) -> List[Tuple[int, int]]:
+    """Keep tilings the kernel will actually run at this length: the
+    kernel pads sequences to lcm(block_q, block_k) and SHRINKS blocks
+    when s < lcm, so any candidate with lcm > s would be measured as a
+    different tiling than the one cached."""
+    return [c for c in candidates if math.lcm(*c) <= s]
+
+
+def tune_flash_blocks(q, k, v, causal: bool = True, attn_mask=None,
+                      dropout_p: float = 0.0,
+                      candidates: Optional[List[Tuple[int, int]]] = None,
+                      iters: int = 5, include_bwd: bool = True):
+    """Measure the candidate tilings on-device; cache + return the winner.
+
+    Returns (best, results) where results is {(bq, bk): seconds | None}
+    (None = that tiling failed to compile/run, e.g. VMEM overflow —
+    recorded, not raised, so one oversized candidate can't kill tuning).
+    """
+    from . import on_tpu
+    from .flash_attention import flash_attention_pallas
+
+    if not on_tpu():
+        raise RuntimeError("tune_flash_blocks times real kernels; it is "
+                           "meaningless off TPU")
+    s = q.shape[1]
+    cands = _filter_candidates(s, candidates or CANDIDATES)
+    if not cands:
+        raise RuntimeError(
+            f"sequence length {s} below every candidate tiling's lcm — "
+            f"the kernel's short-sequence shrink governs; nothing to tune")
+    results: Dict[Tuple[int, int], Optional[float]] = {}
+
+    def run(bq, bk):
+        def fwd_bwd(q_, k_, v_):
+            out = flash_attention_pallas(q_, k_, v_, causal=causal,
+                                         attn_mask=attn_mask,
+                                         dropout_p=dropout_p,
+                                         block_q=bq, block_k=bk)
+            return out.sum()
+        fn = (jax.jit(jax.grad(fwd_bwd, argnums=(0, 1, 2)))
+              if include_bwd else jax.jit(fwd_bwd))
+        r = fn(q, k, v)  # compile + warm
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(q, k, v)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters
+
+    for c in cands:
+        try:
+            results[c] = run(*c)
+        except Exception:
+            results[c] = None  # VMEM overflow / Mosaic reject at this tile
+    timed = {c: t for c, t in results.items() if t is not None}
+    if not timed:
+        raise RuntimeError(f"no flash block candidate ran: {results}")
+    best = min(timed, key=timed.get)
+    _BEST[_sig(q, k, causal, attn_mask is not None, dropout_p)] = best
+    return best, results
+
+
+def clear_cache():
+    _BEST.clear()

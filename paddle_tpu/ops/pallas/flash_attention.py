@@ -528,10 +528,44 @@ def supported(seq_len: int, head_dim: int, block_q: int = DEFAULT_BLOCK_Q,
     return head_dim % 8 == 0 and seq_len >= 1
 
 
+def _resolve_blocks(q, k, v, causal, attn_mask, dropout_p, block_q, block_k,
+                    interpret):
+    """Pick the (block_q, block_k) tiling for this call.
+
+    Explicit blocks always win (a caller passing 128/128 gets 128/128 even
+    when the autotuner would prefer another tiling). With both unset and
+    FLAGS_flash_autotune on, consult the autotune cache — and, on real
+    hardware with concrete (non-traced) inputs, measure the candidates
+    once per shape. Sequences below DEFAULT_BLOCK_Q skip the consult
+    entirely: the short-sequence shrink below would override any tuned
+    tiling, so tuning them would burn compiles for a discarded answer.
+    """
+    if block_q is not None or block_k is not None:
+        return (block_q or DEFAULT_BLOCK_Q, block_k or DEFAULT_BLOCK_K)
+    s = q.shape[1]
+    if not interpret and s >= DEFAULT_BLOCK_Q:
+        from ...core.flags import get_flag
+        if get_flag("FLAGS_flash_autotune"):
+            from . import autotune, on_tpu
+            tuned = autotune.cached_blocks(q, k, causal,
+                                           attn_mask is not None, dropout_p)
+            if tuned is None and on_tpu() \
+                    and not isinstance(q, jax.core.Tracer):
+                # first eager call at this shape: measure candidates once
+                try:
+                    tuned, _ = autotune.tune_flash_blocks(
+                        q, k, v, causal=causal, attn_mask=attn_mask,
+                        dropout_p=dropout_p)
+                except Exception:
+                    tuned = None  # tuning must never break the call
+            if tuned is not None:
+                return tuned
+    return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+
+
 def flash_attention_pallas(q, k, v, causal: bool = True, attn_mask=None,
                            dropout_p: float = 0.0, seed=0, kv_seqlens=None,
-                           block_q: int = DEFAULT_BLOCK_Q,
-                           block_k: int = DEFAULT_BLOCK_K,
+                           block_q=None, block_k=None,
                            interpret: bool = False):
     """Blockwise flash attention.
 
@@ -548,8 +582,10 @@ def flash_attention_pallas(q, k, v, causal: bool = True, attn_mask=None,
                          "cross-attention")
     if hq % hkv:
         raise ValueError(f"GQA needs hq % hkv == 0, got {hq}/{hkv}")
-    if not supported(s, d, block_q, block_k):
+    if not supported(s, d):
         raise ValueError(f"flash_attention_pallas: unsupported head_dim {d}")
+    block_q, block_k = _resolve_blocks(q, k, v, causal, attn_mask, dropout_p,
+                                       block_q, block_k, interpret)
 
     # arbitrary lengths: pad to the block lcm and mask the tail via seqlens
     unit = math.lcm(block_q, block_k)

@@ -379,3 +379,31 @@ def test_flagship_attention_step_profile():
 
     step()  # compile outside the trace
     _profile("llama_flash_step", step)
+
+
+def test_flash_autotune_sweep():
+    """One on-device tuning sweep: every candidate measured (or recorded
+    as failed), winner cached, and the flagged kernel path adopts it."""
+    _require_tpu()
+    if INTERPRET:
+        pytest.skip("tuning times real kernels; meaningless interpreted")
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.pallas import autotune
+    q, k, v = _rand((1, 1024, 4, 64), 70), _rand((1, 1024, 4, 64), 71), \
+        _rand((1, 1024, 4, 64), 72)
+    best, results = autotune.tune_flash_blocks(q, k, v, causal=True,
+                                               iters=3)
+    assert best in results and results[best] is not None
+    assert autotune.cached_blocks(q, k, True, False, 0.0) == best
+    timed = {c: t for c, t in results.items() if t is not None}
+    assert timed, results
+    # the flagged path must now produce identical numerics at the winner
+    paddle.set_flags({"FLAGS_flash_autotune": True})
+    try:
+        out = _flash(q, k, v, causal=True)
+        ref = _flash(q, k, v, causal=True, block_q=best[0],
+                     block_k=best[1])
+    finally:
+        paddle.set_flags({"FLAGS_flash_autotune": False})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
