@@ -81,8 +81,11 @@ define_flag("FLAGS_use_pallas_attention", True,
             "allow (reference: dynloaded flashattn, N27)")
 define_flag("FLAGS_flash_autotune", False,
             "measure flash-attention (block_q, block_k) tilings on-device "
-            "at first eager call per shape and cache the winner (TPU only; "
-            "reference analog: per-arch tuned flashattn binaries)")
+            "at the first call per (shape, dtype) and cache the winner; "
+            "traced calls tune on synthesized arrays, so compiled training "
+            "benefits too (TPU only; reference analog: per-arch tuned "
+            "flashattn binaries; multi-controller: tune rank 0, broadcast "
+            "via autotune.set_best)")
 define_flag("FLAGS_use_pallas_rmsnorm", True,
             "route weighted rms_norm to the fused Pallas kernel on TPU "
             "(reference: fused_rms_norm in phi/kernels/fusion)")

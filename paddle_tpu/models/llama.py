@@ -60,6 +60,17 @@ class LlamaConfig:
     # compile time is O(1) in depth instead of O(L). The canonical TPU
     # pattern for deep stacks; numerics identical to the unrolled loop.
     scan_layers: bool = False
+    # Mixture-of-experts MLP (Mixtral-style): num_experts > 1 replaces each
+    # layer's SwiGLU with a routed expert bank (gshard top-k gate, stacked
+    # expert weights, optional expert parallelism over ep_mesh/ep_axis —
+    # GSPMD inserts the dispatch/combine collectives). The gate's
+    # load-balancing aux loss is added to the LM loss with moe_aux_coeff.
+    num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_coeff: float = 0.01
+    ep_mesh: Optional[object] = None
+    ep_axis: str = "ep"
 
     @property
     def head_dim(self) -> int:
@@ -194,11 +205,84 @@ class LlamaMLP(Layer):
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 
+def _make_expert_bank_cls():
+    """Build the SwiGLU expert bank class lazily (the moe package imports
+    back into models; a deferred class avoids the cycle at import time)."""
+    from ..incubate.distributed.models.moe.moe_layer import _MoEBase
+
+    class _LlamaExpertBank(_MoEBase):
+        """Routed SwiGLU experts over stacked [E, h, I]/[E, I, h] weights."""
+
+        def __init__(self, config: "LlamaConfig"):
+            _MoEBase.__init__(
+                self, config.hidden_size, config.num_experts,
+                gate={"type": "gshard", "top_k": config.moe_top_k},
+                capacity_factor=config.moe_capacity_factor,
+                ep_mesh=config.ep_mesh,
+                ep_axis=config.ep_axis if config.ep_mesh is not None
+                else None)
+            E, h, ims = (config.num_experts, config.hidden_size,
+                         config.intermediate_size)
+            init = I.Normal(std=config.initializer_range)
+            self.gate_w = self.create_parameter([E, h, ims],
+                                                default_initializer=init)
+            self.up_w = self.create_parameter([E, h, ims],
+                                              default_initializer=init)
+            self.down_w = self.create_parameter([E, ims, h],
+                                                default_initializer=init)
+            if config.ep_mesh is not None:
+                from ..distributed.auto_parallel import (Replicate, Shard,
+                                                         shard_tensor)
+                pl = [Shard(0) if n == config.ep_axis else Replicate()
+                      for n in config.ep_mesh.dim_names]
+                for p in (self.gate_w, self.up_w, self.down_w):
+                    shard_tensor(p, config.ep_mesh, pl)
+
+        def _run_experts(self, x):
+            """x [E, C, h] → SwiGLU per expert (batched einsums)."""
+            import paddle_tpu as paddle
+            g = F.silu(paddle.einsum("ecd,edh->ech", x, self.gate_w))
+            u = paddle.einsum("ecd,edh->ech", x, self.up_w)
+            return paddle.einsum("ech,ehd->ecd", g * u, self.down_w)
+
+    return _LlamaExpertBank
+
+
+_EXPERT_BANK_CLS = None
+
+
+class LlamaMoEMLP(Layer):
+    """Mixtral-style routed SwiGLU expert bank.
+
+    Stacked expert weights [E, h, I]/[E, I, h] with the shared MoE routing
+    machinery (gshard top-k gate → dispatch [N,E,C] → per-expert SwiGLU →
+    combine). Expert parallelism: with cfg.ep_mesh/ep_axis the expert dim
+    is Shard(0) over the ep axis and GSPMD inserts the all-to-alls —
+    reference surface: incubate/distributed/models/moe (moe_layer.py:263)
+    composed with the llama FFN.
+    """
+
+    def __init__(self, config: LlamaConfig):
+        global _EXPERT_BANK_CLS
+        super().__init__(dtype=config.dtype)
+        if _EXPERT_BANK_CLS is None:
+            _EXPERT_BANK_CLS = _make_expert_bank_cls()
+        self.moe = _EXPERT_BANK_CLS(config)
+
+    @property
+    def l_aux(self):
+        return self.moe.l_aux
+
+    def forward(self, x):
+        return self.moe(x)
+
+
 class LlamaDecoderLayer(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
         self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        self.mlp = (LlamaMoEMLP(config) if config.num_experts > 1
+                    else LlamaMLP(config))
         self.input_layernorm = RMSNorm(config.hidden_size,
                                        epsilon=config.rms_norm_eps)
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
@@ -381,6 +465,11 @@ class LlamaModel(Layer):
         self.embed_tokens = Embedding(
             config.vocab_size, config.hidden_size,
             weight_attr=I.Normal(std=config.initializer_range))
+        if config.scan_layers and config.num_experts > 1:
+            raise ValueError(
+                "scan_layers + num_experts > 1 is not supported yet: the "
+                "routed expert bank is per-layer state the scan body can't "
+                "stack; use the unrolled path for MoE")
         if config.scan_layers:
             self.layers_scanned = ScannedLlamaLayers(config)
             self.layers = []
@@ -468,6 +557,11 @@ class LlamaForCausalLM(Layer):
         loss = F.cross_entropy(
             logits.reshape([-1, self.config.vocab_size]).astype("float32"),
             labels.reshape([-1]))
+        if self.config.num_experts > 1 and self.config.moe_aux_coeff:
+            for layer in self.model.layers:
+                aux = getattr(layer.mlp, "l_aux", None)
+                if aux is not None:
+                    loss = loss + self.config.moe_aux_coeff * aux
         return logits, loss
 
     def num_params(self) -> int:
@@ -477,7 +571,8 @@ class LlamaForCausalLM(Layer):
 def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
                 fsdp_axis: Optional[str] = None,
                 batch_axes: Optional[Sequence[str]] = None,
-                sep_axis: Optional[str] = None):
+                sep_axis: Optional[str] = None,
+                ep_axis: str = "ep"):
     """Apply Megatron-style TP (+ optional FSDP) placements to a Llama model.
 
     The reference expresses this with dedicated parallel layer classes
@@ -499,7 +594,7 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
 
     names = mesh.dim_names
 
-    def place(param, mp_dim=None, fsdp_dim=None):
+    def place(param, mp_dim=None, fsdp_dim=None, ep_dim=None):
         placements = []
         for ax in names:
             if ax == mp_axis and mp_dim is not None:
@@ -507,6 +602,8 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
             elif fsdp_axis is not None and ax == fsdp_axis \
                     and fsdp_dim is not None:
                 placements.append(Shard(fsdp_dim))
+            elif ax == ep_axis and ep_dim is not None:
+                placements.append(Shard(ep_dim))
             else:
                 placements.append(Replicate())
         shard_tensor(param, mesh, placements)
@@ -531,10 +628,26 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
     else:
         for layer in model.model.layers:
             attn, mlp = layer.self_attn, layer.mlp
-            for col in (attn.q_proj, attn.k_proj, attn.v_proj,
-                        mlp.gate_proj, mlp.up_proj):
+            cols = [attn.q_proj, attn.k_proj, attn.v_proj]
+            rows = [attn.o_proj]
+            if isinstance(mlp, LlamaMoEMLP):
+                # expert dim Shard(0) over ep; TP splits each expert's FFN
+                # dims, FSDP takes the other dim; the router's tiny linear
+                # is replicated EXPLICITLY so every parameter of an MoE
+                # model carries a placement (dist-checkpoint audits rely
+                # on that invariant)
+                place(mlp.moe.gate_w, mp_dim=2, fsdp_dim=1, ep_dim=0)
+                place(mlp.moe.up_w, mp_dim=2, fsdp_dim=1, ep_dim=0)
+                place(mlp.moe.down_w, mp_dim=1, fsdp_dim=2, ep_dim=0)
+                place(mlp.moe.gate.gate.weight)
+                if mlp.moe.gate.gate.bias is not None:
+                    place(mlp.moe.gate.gate.bias)
+            else:
+                cols += [mlp.gate_proj, mlp.up_proj]
+                rows.append(mlp.down_proj)
+            for col in cols:
                 place(col.weight, mp_dim=1, fsdp_dim=0)
-            for row in (attn.o_proj, mlp.down_proj):
+            for row in rows:
                 place(row.weight, mp_dim=0, fsdp_dim=1)
             place(layer.input_layernorm.weight)
             place(layer.post_attention_layernorm.weight)

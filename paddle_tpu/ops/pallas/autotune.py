@@ -42,7 +42,9 @@ _BEST: Dict[tuple, Tuple[int, int]] = {}
 def _sig(q, k, causal, has_mask, dropout_p):
     b, s, hq, d = q.shape
     hkv = k.shape[2]
-    return (b, s, hq, hkv, d, bool(causal), bool(has_mask),
+    # dtype matters twice over: VMEM footprint (a tiling that fits bf16 can
+    # overflow f32) and timing winners differ per dtype
+    return (b, s, hq, hkv, d, str(q.dtype), bool(causal), bool(has_mask),
             bool(dropout_p))
 
 
@@ -54,6 +56,25 @@ def set_best(q, k, causal, has_mask, dropout_p, blocks: Tuple[int, int]):
     """Install a winner without measuring (rank-0-tunes-and-broadcasts
     pattern for multi-controller worlds — see module docstring)."""
     _BEST[_sig(q, k, causal, has_mask, dropout_p)] = tuple(blocks)
+
+
+def synth_like(q, k, v, attn_mask):
+    """Concrete random arrays matching (possibly traced) inputs' avals.
+
+    Tuning only needs shapes/dtypes; this lets the flag work from inside a
+    jit/vjp trace (the training path) — the sweep runs on synthesized
+    data while the trace is suspended in python."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+
+    def mk(t):
+        if t is None:
+            return None
+        return jnp.asarray(rng.randn(*t.shape), jnp.float32).astype(t.dtype)
+
+    return mk(q), mk(k), mk(v), mk(attn_mask)
 
 
 def _filter_candidates(s: int, candidates) -> List[Tuple[int, int]]:

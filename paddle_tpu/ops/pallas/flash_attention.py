@@ -534,11 +534,17 @@ def _resolve_blocks(q, k, v, causal, attn_mask, dropout_p, block_q, block_k,
 
     Explicit blocks always win (a caller passing 128/128 gets 128/128 even
     when the autotuner would prefer another tiling). With both unset and
-    FLAGS_flash_autotune on, consult the autotune cache — and, on real
-    hardware with concrete (non-traced) inputs, measure the candidates
-    once per shape. Sequences below DEFAULT_BLOCK_Q skip the consult
-    entirely: the short-sequence shrink below would override any tuned
-    tiling, so tuning them would burn compiles for a discarded answer.
+    FLAGS_flash_autotune on, consult the autotune cache; on a miss, on
+    real hardware, measure the candidates ONCE per (shape, dtype)
+    signature. Traced calls (the training path always traces through
+    jax.vjp) tune on synthesized concrete arrays matching the tracer's
+    aval — tuning needs the shapes, not the values — so the flag works
+    for compiled training, not just eager inference. A failed sweep
+    negative-caches the defaults so serving loops don't re-pay the
+    compile attempts per call. Sequences below DEFAULT_BLOCK_Q skip the
+    consult entirely: the short-sequence shrink below would override any
+    tuned tiling, so tuning them would burn compiles for a discarded
+    answer.
     """
     if block_q is not None or block_k is not None:
         return (block_q or DEFAULT_BLOCK_Q, block_k or DEFAULT_BLOCK_K)
@@ -549,15 +555,22 @@ def _resolve_blocks(q, k, v, causal, attn_mask, dropout_p, block_q, block_k,
             from . import autotune, on_tpu
             tuned = autotune.cached_blocks(q, k, causal,
                                            attn_mask is not None, dropout_p)
-            if tuned is None and on_tpu() \
-                    and not isinstance(q, jax.core.Tracer):
-                # first eager call at this shape: measure candidates once
+            if tuned is None and on_tpu():
                 try:
+                    if isinstance(q, jax.core.Tracer):
+                        qc, kc, vc, mc = autotune.synth_like(q, k, v,
+                                                             attn_mask)
+                    else:
+                        qc, kc, vc, mc = q, k, v, attn_mask
                     tuned, _ = autotune.tune_flash_blocks(
-                        q, k, v, causal=causal, attn_mask=attn_mask,
+                        qc, kc, vc, causal=causal, attn_mask=mc,
                         dropout_p=dropout_p)
                 except Exception:
-                    tuned = None  # tuning must never break the call
+                    # tuning must never break the call; remember the
+                    # failure so the sweep isn't re-paid every call
+                    tuned = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+                    autotune.set_best(q, k, causal, attn_mask is not None,
+                                      dropout_p, tuned)
             if tuned is not None:
                 return tuned
     return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
