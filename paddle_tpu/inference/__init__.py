@@ -176,5 +176,7 @@ class BucketBatchingPredictor:
         return results
 
 
+from .serving import ContinuousBatcher, Request  # noqa: E402
+
 __all__ = ["Config", "Predictor", "BucketBatchingPredictor",
-           "create_predictor"]
+           "ContinuousBatcher", "Request", "create_predictor"]

@@ -1,0 +1,179 @@
+"""Continuous batching over the KV-cache decode step.
+
+Reference surface: the serving loop the reference builds around
+AnalysisPredictor + block_multihead_attention (dynamic request admission
+into a running decode batch). TPU-first design: XLA wants ONE static
+shape, so the batcher owns `max_batch` SLOTS — a fixed [L, 2, B, H, S, D]
+cache — and the host-side scheduler admits pending requests into free
+slots at step boundaries, evicts finished ones, and steps every slot
+through one compiled decode executable. Inactive slots decode garbage
+into a scratch row that admission's prefill overwrites before any real
+read (causality: a slot's attention never reads rows past its own t), so
+no per-occupancy recompilation ever happens.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ContinuousBatcher", "Request"]
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray          # [s] int64
+    max_new_tokens: int
+    tokens: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        return self.slot is None and bool(self.tokens)
+
+
+class ContinuousBatcher:
+    """Greedy continuous batcher over GPT2ForCausalLM's dense KV cache.
+
+    model: a GPT2ForCausalLM (eval mode). max_batch: slot count (ONE
+    compiled decode executable serves every step at this batch). s_max:
+    per-slot cache rows (prompt + generation must fit). eos_id: optional
+    early-stop token. compile: jit.to_static the decode step (recommended;
+    disable for debugging).
+    """
+
+    def __init__(self, model, max_batch: int = 8, s_max: int = 256,
+                 eos_id: Optional[int] = None, compile: bool = True):
+        import paddle_tpu as paddle
+
+        self.model = model
+        self.max_batch = max_batch
+        self.s_max = s_max
+        self.eos_id = eos_id
+        cfg = model.config
+        if s_max > cfg.max_position_embeddings:
+            raise ValueError(f"s_max={s_max} exceeds "
+                             f"max_position_embeddings="
+                             f"{cfg.max_position_embeddings}")
+        L, h, d = (cfg.num_hidden_layers, cfg.num_attention_heads,
+                   cfg.head_dim)
+        self._caches = paddle.zeros([L, 2, max_batch, h, s_max, d],
+                                    dtype=cfg.dtype)
+        self._t = np.full((max_batch, 1), s_max - 1, np.int32)  # parked
+        self._free = list(range(max_batch))
+        self._slot_req: Dict[int, Request] = {}
+        self._pending: List[Request] = []
+        self._finished: Dict[int, Request] = {}
+        self._next_rid = 0
+        self._last_tok = np.zeros((max_batch, 1), np.int64)
+        if compile:
+            from .. import jit
+            self._step_fn = jit.to_static(model.decode_step)
+        else:
+            self._step_fn = model.decode_step
+
+    # -- request lifecycle --------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
+        if len(prompt) + max_new_tokens > self.s_max:
+            raise ValueError(f"prompt {len(prompt)} + {max_new_tokens} "
+                             f"exceeds slot capacity {self.s_max}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._pending.append(Request(rid, prompt, max_new_tokens))
+        return rid
+
+    def _admit(self) -> List[int]:
+        """Move pending requests into free slots (prefill writes the slot's
+        cache rows; one prefill compile per prompt length — callers who
+        need fewer compiles can pad prompts to buckets themselves).
+        Returns rids that finished AT admission (max_new_tokens == 1 or
+        EOS on the prefill token)."""
+        import paddle_tpu as paddle
+        finished = []
+        while self._pending and self._free:
+            req = self._pending.pop(0)
+            slot = self._free.pop(0)
+            ids = paddle.to_tensor(req.prompt[None, :])
+            logits, cache, _t = self.model.prefill(ids, self.s_max)
+            # write the slot: caches[:, :, slot] = cache[:, :, 0]
+            self._caches[:, :, slot] = cache[:, :, 0]
+            tok = int(np.asarray(logits._data)[0, -1].argmax())
+            req.slot = slot
+            req.tokens.append(tok)
+            self._slot_req[slot] = req
+            self._t[slot, 0] = len(req.prompt)
+            self._last_tok[slot, 0] = tok
+            if self._maybe_finish(req, tok):
+                finished.append(req.rid)
+        return finished
+
+    def _maybe_finish(self, req: Request, tok: int) -> bool:
+        if (tok == self.eos_id if self.eos_id is not None else False) \
+                or len(req.tokens) >= req.max_new_tokens:
+            slot = req.slot
+            req.slot = None
+            del self._slot_req[slot]
+            self._free.append(slot)
+            self._t[slot, 0] = self.s_max - 1  # park
+            self._finished[req.rid] = req
+            return True
+        return False
+
+    # -- the engine ---------------------------------------------------------
+    def step(self) -> List[int]:
+        """Admit, decode one token for every active slot, evict finished.
+        Returns the rids that finished during THIS call (including ones
+        that finished at admission)."""
+        import paddle_tpu as paddle
+        finished = self._admit()
+        if not self._slot_req:
+            return finished
+        tok_t = paddle.to_tensor(self._last_tok)
+        t_t = paddle.to_tensor(self._t)
+        logits, self._caches, _ = self._step_fn(tok_t, self._caches, t_t)
+        next_tok = np.asarray(logits._data)[:, -1].argmax(-1)
+        for slot, req in list(self._slot_req.items()):
+            tok = int(next_tok[slot])
+            self._t[slot, 0] += 1
+            req.tokens.append(tok)
+            self._last_tok[slot, 0] = tok
+            if self._maybe_finish(req, tok):
+                finished.append(req.rid)
+        return finished
+
+    def result(self, rid: int) -> np.ndarray:
+        """Full sequence (prompt + generated) of a finished request."""
+        req = self._finished[rid]
+        return np.concatenate([req.prompt, np.asarray(req.tokens)])
+
+    def pop_result(self, rid: int) -> np.ndarray:
+        """result() + release the request's memory — long-lived batchers
+        must pop (or use run_until_done, which pops) or _finished grows
+        with every request ever served."""
+        out = self.result(rid)
+        del self._finished[rid]
+        return out
+
+    def run_until_done(self, max_steps: int = 10000) -> Dict[int, np.ndarray]:
+        """Drive until every submitted request completes; returns (and
+        releases) exactly THIS run's results. Raises if the step budget
+        is exhausted with work still pending/active — a silent partial
+        dict would read as lost requests."""
+        done: List[int] = []
+        for _ in range(max_steps):
+            done += self.step()
+            if not self._pending and not self._slot_req:
+                break
+        else:
+            raise RuntimeError(
+                f"run_until_done: {len(self._pending)} pending / "
+                f"{len(self._slot_req)} active requests remain after "
+                f"{max_steps} steps")
+        return {rid: self.pop_result(rid) for rid in done}
+
+    @property
+    def active(self) -> int:
+        return len(self._slot_req)
