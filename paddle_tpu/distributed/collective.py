@@ -224,6 +224,26 @@ def destroy_process_group(group=None):
         _WORLD[0] = None
 
 
+
+def _watched(name):
+    """Wrap a collective entry point with the desync watchdog (no-op —
+    one attribute read — unless enable_collective_watchdog armed it)."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from . import watchdog as _wd
+            if _wd.get_watchdog() is None:
+                return fn(*args, **kwargs)
+            t = next((a for a in args if hasattr(a, "shape")), None)
+            with _wd.watch(name, t):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+@_watched("barrier")
 def barrier(group: Optional[Group] = None):
     g = group or _world()
     x = jnp.zeros((1,) if _mp() else (g.nranks,), jnp.int32)
@@ -287,6 +307,7 @@ def _check_stacked(arr, group, name):
             f"(dim0 == group size {group.nranks}); got shape {tuple(arr.shape)}")
 
 
+@_watched("all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op=True):
     """Each rank slot receives the reduction over all slots
@@ -313,6 +334,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     return Tensor(out)
 
 
+@_watched("all_gather")
 def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
                sync_op=True):
     """paddle.distributed.all_gather: append every rank's slice."""
@@ -374,6 +396,7 @@ def all_gather_object(object_list, obj, group=None):
     return object_list
 
 
+@_watched("broadcast")
 def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
               sync_op=True):
     g = group or _world()
@@ -402,6 +425,7 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
     return Tensor(out)
 
 
+@_watched("reduce")
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
            group: Optional[Group] = None, sync_op=True):
     g = group or _world()
@@ -425,6 +449,7 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
     return Tensor(out)
 
 
+@_watched("reduce_scatter")
 def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op=True):
     """Input stacked [n, n*m, ...]; each rank slot gets its reduced chunk
@@ -467,6 +492,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
     return Tensor(out)
 
 
+@_watched("scatter")
 def scatter(tensor, tensor_list=None, src: int = 0,
             group: Optional[Group] = None, sync_op=True):
     g = group or _world()
@@ -516,6 +542,7 @@ def scatter(tensor, tensor_list=None, src: int = 0,
     return Tensor(data)
 
 
+@_watched("alltoall")
 def alltoall(in_tensor_list, out_tensor_list=None,
              group: Optional[Group] = None, sync_op=True):
     """all-to-all: out[i][j] = in[j][i] (EP's global_scatter backbone)."""
@@ -588,6 +615,7 @@ def _p2p_exchange(g: Group, arr, src_idx: int, dst_idx: int):
     return jnp.asarray(out.addressable_data(0))[0]
 
 
+@_watched("send")
 def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
     """Point-to-point send.
 
@@ -605,6 +633,7 @@ def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
     _P2P_BUF.setdefault(g.id, []).append((dst, _unwrap(tensor)))
 
 
+@_watched("recv")
 def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
     g = group or _world()
     if src not in g.ranks:
@@ -669,6 +698,7 @@ def axis_index(axis_name):
     return jax.lax.axis_index(axis_name)
 
 
+@_watched("gather")
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """paddle.distributed.gather: rank `dst` receives every slice (single
     controller: all_gather then keep; non-dst ranks get an empty list)."""
@@ -680,6 +710,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return slices
 
 
+@_watched("alltoall_single")
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """paddle.distributed.alltoall_single. Equal splits run in both modes;

@@ -1,0 +1,261 @@
+"""Collective desync watchdog over the TCPStore control plane.
+
+Reference surface: comm_task_manager.cc's watchdog + the store-based
+barrier timeout (tcp_store) — when a multi-process job hangs, the single
+most valuable diagnostic is WHICH rank is stuck at WHICH collective while
+its peers moved on (or entered a DIFFERENT collective — a mismatched
+program). TPU-native: each rank publishes (seq, op, spec, ts) to the
+job-wide TCPStore before entering a collective; a poller compares peers
+and reports desyncs instead of letting the job die silently at the ICI
+timeout.
+
+Opt-in: ``enable_collective_watchdog(timeout=...)`` after
+init_parallel_env in a multi-process world (no-op in single-controller
+runs — GSPMD issues collectives from one program, so ranks cannot
+diverge).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["CollectiveWatchdog", "DesyncError",
+           "enable_collective_watchdog", "disable_collective_watchdog",
+           "get_watchdog"]
+
+_ACTIVE: List[Optional["CollectiveWatchdog"]] = [None]
+
+
+class DesyncError(RuntimeError):
+    pass
+
+
+class CollectiveWatchdog:
+    """Publishes this rank's collective progress; detects peer desync.
+
+    enter(op, spec) before each collective; exit() after. A background
+    poller flags:
+      - MISMATCH: a peer at OUR seq entered a different collective OP —
+        the ranks' programs diverged (the reference desync debugger's bug
+        class). P2P pairs (send/recv) are legitimately asymmetric and
+        exempt; tensor specs are diagnostic only (ragged alltoall ships
+        different shapes per rank by design).
+      - STUCK: this rank sat inside one collective > timeout while some
+        peer is at a DIFFERENT position (ahead, behind, or missing — a
+        dead rank shows up as a peer frozen at an older seq, the
+        canonical hang).
+      - SLOW: > timeout with every peer at the same position — reported
+        for visibility but NOT poisoned (a genuinely big collective looks
+        like this).
+    Divergence reports poison later enter() calls with DesyncError so the
+    hang surfaces as a python error instead of an ICI timeout.
+    """
+
+    # legitimately different op names across ranks of one exchange
+    _ASYMMETRIC = frozenset({"send", "recv"})
+
+    def __init__(self, store, rank: int, world_size: int,
+                 timeout: float = 120.0, poll: Optional[float] = None,
+                 on_desync: Optional[Callable[[dict], None]] = None,
+                 prefix: str = "collective_wd"):
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.timeout = timeout
+        self.poll = poll if poll is not None else max(1.0, timeout / 4)
+        self.prefix = prefix
+        self.on_desync = on_desync or self._default_report
+        self._seq = 0
+        self._inside = False
+        self._enter_ts = 0.0
+        self._cur = ("", "")
+        self._poison: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._publish(done=True)
+
+    # -- publishing ---------------------------------------------------------
+    def _key(self, rank):
+        return f"{self.prefix}/{rank}"
+
+    def _publish(self, done: bool):
+        rec = {"seq": self._seq, "op": self._cur[0], "spec": self._cur[1],
+               "ts": time.time(), "done": done}
+        self.store.set(self._key(self.rank), json.dumps(rec))
+
+    def enter(self, op: str, spec: str = ""):
+        if self._poison is not None:
+            raise DesyncError(
+                f"collective desync detected earlier: {self._poison}")
+        with self._lock:
+            self._seq += 1
+            self._cur = (op, spec)
+            self._enter_ts = time.time()
+            self._inside = True
+            self._publish(done=False)
+
+    def exit(self):
+        with self._lock:
+            self._inside = False
+            self._publish(done=True)
+
+    @property
+    def seq(self) -> int:
+        """Collectives observed so far (public, for tests/metrics)."""
+        return self._seq
+
+    # -- detection ----------------------------------------------------------
+    def _peer(self, rank) -> Optional[dict]:
+        try:
+            raw = self.store.get(self._key(rank), timeout=2.0)
+        except Exception:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except Exception:
+            return None
+
+    def check_once(self) -> Optional[dict]:
+        """One desync scan; returns the report (also dispatched) or None."""
+        with self._lock:
+            inside = self._inside
+            seq = self._seq
+            cur = self._cur
+            enter_ts = self._enter_ts
+        if not inside:
+            return None
+        peers: Dict[int, dict] = {}
+        missing: List[int] = []
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            p = self._peer(r)
+            if p is None:
+                missing.append(r)
+            else:
+                peers[r] = p
+        report = None
+        if cur[0] not in self._ASYMMETRIC:
+            for r, p in peers.items():
+                if p["seq"] == seq and not p.get("done") \
+                        and p["op"] != cur[0] \
+                        and p["op"] not in self._ASYMMETRIC:
+                    report = {"kind": "mismatch", "rank": self.rank,
+                              "seq": seq, "op": cur[0], "spec": cur[1],
+                              "peer": r, "peer_op": p["op"],
+                              "peer_spec": p["spec"]}
+                    break
+        stuck_for = time.time() - enter_ts
+        if report is None and stuck_for > self.timeout:
+            ahead = {r: p["seq"] for r, p in peers.items()
+                     if p["seq"] > seq}
+            behind = {r: p["seq"] for r, p in peers.items()
+                      if p["seq"] < seq or (p["seq"] == seq
+                                            and p.get("done"))}
+            base = {"rank": self.rank, "seq": seq, "op": cur[0],
+                    "spec": cur[1], "stuck_for_s": round(stuck_for, 1)}
+            if ahead or behind or missing:
+                # a dead rank freezes at an older seq (behind) or loses
+                # its store record (missing) — the canonical hang
+                report = dict(base, kind="stuck", peers_ahead=ahead,
+                              peers_behind=behind, peers_missing=missing)
+            else:
+                # everyone is inside the same collective: likely just a
+                # big transfer — report for visibility, do NOT poison
+                self.on_desync(dict(base, kind="slow"))
+                with self._lock:
+                    self._enter_ts = time.time()  # re-arm, don't spam
+                return None
+        if report is not None:
+            self._poison = report
+            self.on_desync(report)
+        return report
+
+    def _default_report(self, report: dict):
+        print(f"[collective-watchdog] DESYNC {json.dumps(report)}",
+              file=sys.stderr, flush=True)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.wait(self.poll):
+                try:
+                    self.check_once()
+                except Exception:
+                    pass  # the watchdog must never take the job down
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="collective-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def enable_collective_watchdog(timeout: float = 120.0,
+                               poll: Optional[float] = None,
+                               on_desync=None) -> Optional[CollectiveWatchdog]:
+    """Arm the watchdog over the job's bootstrap store (multi-process
+    worlds only; returns None — with a note — in single-controller runs)."""
+    import jax
+
+    from .collective import get_bootstrap_store
+    store = get_bootstrap_store()
+    if store is None or jax.process_count() <= 1:
+        return None
+    disable_collective_watchdog()  # re-arming must not leak a poller
+    wd = CollectiveWatchdog(store, jax.process_index(), jax.process_count(),
+                            timeout=timeout, poll=poll, on_desync=on_desync)
+    wd.start()
+    _ACTIVE[0] = wd
+    return wd
+
+
+def disable_collective_watchdog():
+    wd = _ACTIVE[0]
+    if wd is not None:
+        wd.stop()
+        _ACTIVE[0] = None
+
+
+def get_watchdog() -> Optional[CollectiveWatchdog]:
+    return _ACTIVE[0]
+
+
+def watch(op_name: str, tensor=None):
+    """Context manager the collective entry points use: no-op unless a
+    watchdog is armed."""
+    wd = _ACTIVE[0]
+    return _Watch(wd, op_name, tensor)
+
+
+class _Watch:
+    def __init__(self, wd, op_name, tensor):
+        self.wd = wd
+        self.op = op_name
+        self.tensor = tensor
+
+    def __enter__(self):
+        if self.wd is not None:
+            spec = ""
+            t = self.tensor
+            if t is not None and hasattr(t, "shape"):
+                spec = f"{tuple(t.shape)}:{getattr(t, 'dtype', '')}"
+            self.wd.enter(self.op, spec)
+        return self
+
+    def __exit__(self, *exc):
+        if self.wd is not None:
+            self.wd.exit()
+        return False

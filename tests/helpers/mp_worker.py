@@ -30,10 +30,18 @@ def main():
     n = dist.get_world_size()
     assert rank == rank_env and n == world_env, (rank, n)
 
+    # --- arm the collective desync watchdog over the real store: every
+    # collective below publishes progress; a clean run must produce no
+    # desync report (poison would raise on the next enter)
+    wd = dist.enable_collective_watchdog(timeout=60.0)
+    assert wd is not None, "watchdog must arm in a multi-process world"
+
     # --- all_reduce: each rank contributes rank+1 -> sum = n(n+1)/2
     t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
     dist.all_reduce(t)
     np.testing.assert_allclose(t.numpy(), np.full((4,), n * (n + 1) / 2))
+    assert wd.seq >= 1, "watchdog did not observe the collective"
+    assert wd.check_once() is None, "healthy run flagged a desync"
 
     # --- all_gather: slice i came from rank i
     gathered = []

@@ -1,0 +1,139 @@
+"""Collective desync watchdog (comm_task_manager.cc analog).
+
+Unit-level: two watchdog instances over one shared store simulate two
+ranks; the detector must flag a straggler (peer advanced) and a
+mismatched collective (same seq, different op), poison later entries,
+and stay silent for healthy lockstep progress.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.watchdog import CollectiveWatchdog, DesyncError
+
+
+class _DictStore:
+    def __init__(self):
+        self.d = {}
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        self.d[key] = value
+
+    def get(self, key, timeout=None):
+        if key not in self.d:
+            raise KeyError(key)
+        return self.d[key]
+
+
+def _pair(timeout=0.2):
+    store = _DictStore()
+    a = CollectiveWatchdog(store, 0, 2, timeout=timeout, poll=999)
+    b = CollectiveWatchdog(store, 1, 2, timeout=timeout, poll=999)
+    return store, a, b
+
+
+def test_lockstep_progress_is_clean():
+    _, a, b = _pair()
+    for i in range(3):
+        a.enter("all_reduce", "(4,):float32")
+        b.enter("all_reduce", "(4,):float32")
+        assert a.check_once() is None
+        assert b.check_once() is None
+        a.exit()
+        b.exit()
+
+
+def test_straggler_detected():
+    """Rank 0 stuck inside seq 1 while rank 1 advanced to seq 3."""
+    _, a, b = _pair(timeout=0.05)
+    a.enter("all_reduce", "x")
+    for _ in range(3):
+        b.enter("all_reduce", "x")
+        b.exit()
+    time.sleep(0.08)
+    report = a.check_once()
+    assert report is not None and report["kind"] == "stuck"
+    assert report["peers_ahead"] == {1: 3}
+    # later collectives on the stuck rank surface the diagnosis as an error
+    a._inside = False
+    with pytest.raises(DesyncError, match="stuck"):
+        a.enter("all_reduce", "x")
+
+
+def test_mismatched_collective_detected_immediately():
+    """Same seq, different op: program divergence flags without waiting
+    for the timeout."""
+    _, a, b = _pair(timeout=999)
+    a.enter("all_reduce", "(4,):float32")
+    b.enter("broadcast", "(4,):float32")
+    report = a.check_once()
+    assert report is not None and report["kind"] == "mismatch"
+    assert report["peer_op"] == "broadcast"
+
+
+def test_spec_difference_tolerated():
+    """Same op, different tensor spec is NOT a desync: ragged
+    alltoall_single legitimately ships different shapes per rank."""
+    _, a, b = _pair(timeout=999)
+    a.enter("all_reduce", "(4,):float32")
+    b.enter("all_reduce", "(8,):float32")
+    assert a.check_once() is None
+
+
+def test_send_recv_asymmetry_tolerated():
+    """P2P pairs are different ops on purpose — no mismatch flag."""
+    _, a, b = _pair(timeout=999)
+    a.enter("send", "(4,):float32")
+    b.enter("recv", "(4,):float32")
+    assert a.check_once() is None
+    assert b.check_once() is None
+
+
+def test_dead_rank_detected():
+    """The canonical hang: a peer frozen BEHIND (dead / never arrived)
+    while this rank waits inside the collective past the timeout."""
+    _, a, b = _pair(timeout=0.05)
+    b.enter("all_reduce", "x")
+    b.exit()                      # b died after seq 1
+    a.enter("all_reduce", "x")
+    a.exit()
+    a.enter("all_reduce", "x")    # a at seq 2, b frozen at seq 1
+    time.sleep(0.08)
+    report = a.check_once()
+    assert report is not None and report["kind"] == "stuck"
+    assert report["peers_behind"] == {1: 1}
+
+
+def test_all_ranks_slow_is_reported_not_poisoned():
+    """Everyone inside the same collective past the timeout: visibility
+    report only — a big transfer must not be killed."""
+    store, a, b = _pair(timeout=0.05)
+    seen = []
+    a.on_desync = seen.append
+    a.enter("all_reduce", "x")
+    b.enter("all_reduce", "x")
+    time.sleep(0.08)
+    assert a.check_once() is None
+    assert seen and seen[0]["kind"] == "slow"
+    a.exit()
+    a.enter("all_reduce", "x")  # NOT poisoned
+
+
+def test_collective_entry_points_call_watchdog(monkeypatch):
+    """The decorated collectives publish through an armed watchdog."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import collective, watchdog
+
+    store = _DictStore()
+    wd = CollectiveWatchdog(store, 0, 1, timeout=999, poll=999)
+    monkeypatch.setattr(watchdog, "_ACTIVE", [wd])
+    # single-controller collectives take rank-stacked tensors (dim0 == 8)
+    t = paddle.to_tensor(np.ones((8, 4), np.float32))
+    collective.all_reduce(t)
+    import json
+    rec = json.loads(store.d["collective_wd/0"].decode())
+    assert rec["op"] == "all_reduce" and rec["done"] is True
+    assert rec["seq"] == 1
