@@ -29,6 +29,7 @@ where GSPMD inserts collectives automatically.
 """
 from __future__ import annotations
 
+import os
 from typing import List, Optional
 
 import jax
@@ -185,6 +186,12 @@ def init_parallel_env(strategy=None) -> Optional[Group]:
         else:
             ranks = list(range(n))
         _WORLD[0] = Group(ranks, mesh, "world")
+        if _mp() and os.environ.get("PADDLE_COLLECTIVE_WATCHDOG") == "1":
+            # opt-in auto-arm (launcher propagates env to every rank):
+            # desync diagnosis without touching user code
+            from .watchdog import enable_collective_watchdog
+            enable_collective_watchdog(timeout=float(os.environ.get(
+                "PADDLE_COLLECTIVE_WATCHDOG_TIMEOUT", "300")))
     return _WORLD[0]
 
 

@@ -30,11 +30,13 @@ def main():
     n = dist.get_world_size()
     assert rank == rank_env and n == world_env, (rank, n)
 
-    # --- arm the collective desync watchdog over the real store: every
-    # collective below publishes progress; a clean run must produce no
-    # desync report (poison would raise on the next enter)
+    # --- the launcher env auto-armed the watchdog at init_parallel_env;
+    # re-arming must swap it cleanly (disable-then-enable), and every
+    # collective below publishes progress with no desync report
+    from paddle_tpu.distributed.watchdog import get_watchdog
+    assert get_watchdog() is not None, "env auto-arm did not fire"
     wd = dist.enable_collective_watchdog(timeout=60.0)
-    assert wd is not None, "watchdog must arm in a multi-process world"
+    assert wd is not None and get_watchdog() is wd
 
     # --- all_reduce: each rank contributes rank+1 -> sum = n(n+1)/2
     t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))

@@ -22,6 +22,9 @@ def _launch_env():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
+    # auto-arm the collective watchdog from env (the worker re-arms
+    # manually too, exercising the disable-then-enable path)
+    env["PADDLE_COLLECTIVE_WATCHDOG"] = "1"
     env.pop("XLA_FLAGS", None)  # conftest's 8-device forcing: 1 dev/proc here
     keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
             if p and "axon" not in p]
