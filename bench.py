@@ -100,6 +100,11 @@ def main(scan_layers=True, size="large"):
                           scan_layers=scan_layers)
         batch, seq, iters = 2, 64, 3
 
+    if on_tpu:
+        # measure flash (block_q, block_k) tilings once per shape and run
+        # the headline number at the winner (autotune is trace-safe)
+        paddle.set_flags({"FLAGS_flash_autotune": True})
+
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
