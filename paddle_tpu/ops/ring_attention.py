@@ -96,6 +96,14 @@ def _ring_body(q_blk, k_blk, v_blk, axis_name, num_blocks, causal, scale,
     k_cur, v_cur = k_blk, v_blk
     for t in range(num_blocks):
         src = (i - t) % num_blocks  # owner of the kv block now held locally
+        # issue the NEXT block's rotation BEFORE this block's math: the
+        # permute depends only on k_cur/v_cur (already live), so XLA's
+        # latency-hiding scheduler overlaps the ICI transfer with the MXU
+        # work — the double-buffered ring (the whole point of ring
+        # attention's comm/compute pipelining)
+        if t + 1 < num_blocks:
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         mask_blk = None
         if mask_local is not None:
             mask_blk = jax.lax.dynamic_slice_in_dim(
@@ -105,8 +113,7 @@ def _ring_body(q_blk, k_blk, v_blk, axis_name, num_blocks, causal, scale,
             q_off=i * sq, k_off=src * sq, causal=causal, scale=scale,
             mask_blk=mask_blk, seqlens=seqlens)
         if t + 1 < num_blocks:
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            k_cur, v_cur = k_nxt, v_nxt
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return out.reshape(b, h, sq, d).astype(q_blk.dtype)
 
