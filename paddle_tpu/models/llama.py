@@ -55,6 +55,14 @@ class LlamaConfig:
     # backward instead of keeping its residuals (fleet/recompute analog —
     # trades ~30% step FLOPs for O(layers) less activation HBM)
     use_recompute: bool = False
+    # recompute_granularity (reference knob on its recompute configs):
+    #   "full"      — save only layer inputs, recompute everything
+    #   "selective" — jax.checkpoint_policies.dots_with_no_batch_dims_
+    #                 saveable: matmul outputs stay resident, only the
+    #                 cheap elementwise/softmax work replays (the TPU
+    #                 analog of the reference's core_attn tier: most of
+    #                 the memory win at a fraction of the recompute FLOPs)
+    recompute_granularity: str = "full"
     # scan_layers: run the decoder stack as ONE lax.scan over stacked
     # [L, ...] weights — the layer body is traced/compiled once, so XLA
     # compile time is O(1) in depth instead of O(L). The canonical TPU
@@ -445,7 +453,21 @@ class ScannedLlamaLayers(Layer):
                 mlp = (jax.nn.silu(x2 @ gw_) * (x2 @ uw_)) @ dw_
                 return h1 + mlp, None
 
-            body = jax.checkpoint(body_fn) if remat else body_fn
+            if remat:
+                gran = getattr(cfg, "recompute_granularity", "full")
+                if gran in ("selective", "core_attn", "dots"):
+                    body = jax.checkpoint(
+                        body_fn,
+                        policy=jax.checkpoint_policies
+                        .dots_with_no_batch_dims_saveable)
+                elif gran == "full":
+                    body = jax.checkpoint(body_fn)
+                else:
+                    raise ValueError(
+                        f"unknown recompute_granularity '{gran}' "
+                        f"(use 'full' or 'selective')")
+            else:
+                body = body_fn
             out, _ = jax.lax.scan(
                 body, hidden, (qw, kw, vw, ow, gw, uw, dw, ln1, ln2))
             return out

@@ -411,3 +411,45 @@ def test_ring_attention_per_head_mask_with_mp_axis():
     # dense ref with per-head mask
     expected = _dense_masked(q, k, v, False, mask=mask)
     np.testing.assert_allclose(out.numpy(), expected, rtol=2e-4, atol=2e-5)
+
+
+def test_scanned_llama_selective_recompute_matches_full():
+    """recompute_granularity='selective' (dots-saveable checkpoint policy)
+    must match full recompute and no-recompute numerics exactly — the
+    policy changes WHAT XLA keeps resident, never the math."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+    results = {}
+    for gran, remat in (("none", False), ("full", True),
+                        ("selective", True)):
+        paddle.seed(21)
+        cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=32,
+                                num_attention_heads=2,
+                                num_key_value_heads=2, vocab_size=64,
+                                max_position_embeddings=32)
+        cfg.scan_layers = True
+        cfg.use_recompute = remat
+        cfg.recompute_granularity = gran if remat else "full"
+        m = LlamaForCausalLM(cfg)
+        m.train()
+        ids = paddle.to_tensor(np.arange(16).reshape(1, 16) % 64)
+        _, loss = m(ids, labels=ids)
+        loss.backward()
+        results[gran] = (float(loss),
+                         m.model.layers_scanned.q_w.grad.numpy().copy())
+    for gran in ("full", "selective"):
+        assert results[gran][0] == results["none"][0]
+        np.testing.assert_allclose(results[gran][1], results["none"][1],
+                                   rtol=1e-5, atol=1e-6)
+    # unknown granularity rejected loudly
+    paddle.seed(22)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            vocab_size=64, max_position_embeddings=32)
+    cfg.scan_layers = True
+    cfg.use_recompute = True
+    cfg.recompute_granularity = "bogus"
+    m = LlamaForCausalLM(cfg)
+    m.train()
+    ids = paddle.to_tensor(np.arange(16).reshape(1, 16) % 64)
+    with pytest.raises(ValueError, match="recompute_granularity"):
+        m(ids, labels=ids)
