@@ -69,7 +69,8 @@ class _GradientMergeConfig(_Config):
 
 
 class _RecomputeConfig(_Config):
-    _fields = {"enable": False, "checkpoints": None, "refined_ops": None}
+    _fields = {"enable": False, "checkpoints": None, "refined_ops": None,
+               "granularity": None}
 
 
 class _FusedPassesConfig(_Config):
@@ -173,6 +174,8 @@ class DistModel:
                         place = [Shard(0) if n == axis else Replicate()
                                  for n in mesh.dim_names]
                         shard_tensor(p, mesh, place)
+        if st.recompute.enable:
+            self._apply_recompute(st.recompute)
         self._amp_kwargs = None
         if st.amp.enable:
             self._amp_kwargs = {"enable": True, "dtype": st.amp.dtype,
@@ -183,6 +186,99 @@ class DistModel:
             if st.amp.custom_black_list:
                 self._amp_kwargs["custom_black_list"] = (
                     st.amp.custom_black_list)
+
+    def _apply_recompute(self, rc):
+        """Strategy.recompute → real behavior (it used to parse and then
+        silently do nothing).
+
+        Models with a native recompute knob (config.use_recompute — the
+        llama/gpt zoo) get it flipped (+ granularity when supported);
+        otherwise each DIRECT sublayer (or just the ones named in
+        `checkpoints`) becomes a recompute region via fleet.recompute —
+        the reference's segment-at-checkpoints behavior at layer
+        granularity."""
+        import warnings
+
+        net = self.network
+        cfg = getattr(net, "config", None)
+        if cfg is not None and hasattr(cfg, "use_recompute"):
+            cfg.use_recompute = True
+            if rc.checkpoints:
+                warnings.warn(
+                    "Strategy.recompute.checkpoints is ignored for models "
+                    "with a native config.use_recompute knob (recompute "
+                    "applies to every layer there)")
+            if rc.granularity:
+                if hasattr(cfg, "recompute_granularity"):
+                    cfg.recompute_granularity = rc.granularity
+                else:
+                    warnings.warn(
+                        f"model config has no recompute_granularity; "
+                        f"'{rc.granularity}' dropped")
+            return
+        from ..core.tensor import Tensor
+        from .fleet.recompute import recompute as _recompute
+
+        def _wrap(sub):
+            if getattr(sub, "_recompute_wrapped", False):
+                return False
+            orig = sub.forward
+            # hint computed once: skips the per-call reflective closure
+            # probe on the hot path (the pp_layers pattern)
+            hint = any(not p.stop_gradient for p in sub.parameters())
+            state = {"mode": None}
+
+            def fwd(*a, **k):
+                if state["mode"] == "rc":
+                    return _recompute(orig, *a, _trainable_hint=hint, **k)
+                # first call probes the output shape: fleet.recompute only
+                # replays Tensor / list / tuple outputs — dict-returning
+                # layers fall back (with one warning) instead of crashing
+                out = orig(*a, **k)
+                ok = isinstance(out, Tensor) or (
+                    isinstance(out, (list, tuple))
+                    and any(isinstance(o, Tensor) for o in out))
+                if state["mode"] is None:
+                    state["mode"] = "rc" if ok else "direct"
+                    if not ok:
+                        warnings.warn(
+                            f"recompute skipped for {type(sub).__name__}: "
+                            f"output type {type(out).__name__} is not "
+                            f"replayable (Tensor/list/tuple only)")
+                return out
+
+            sub.forward = fwd
+            sub._recompute_wrapped = True
+            return True
+
+        wrapped = 0
+        if rc.checkpoints:
+            names = list(rc.checkpoints)
+            matched = set()
+            all_named = dict(net.named_sublayers(include_self=False))
+            # skip names nested under another matched name: wrapping both a
+            # parent and its child would recompute the child twice
+            hits = [n for n in names if n in all_named]
+            hits = [n for n in hits
+                    if not any(n != m and n.startswith(m + ".")
+                               for m in hits)]
+            for n in hits:
+                if _wrap(all_named[n]):
+                    matched.add(n)
+                    wrapped += 1
+            missing = [n for n in names if n not in all_named]
+            if missing:
+                warnings.warn(
+                    f"Strategy.recompute.checkpoints entries not found in "
+                    f"the model: {missing}")
+        else:
+            for _name, sub in net.named_children():
+                wrapped += bool(_wrap(sub))
+        if not wrapped:
+            warnings.warn(
+                "Strategy.recompute.enable had nothing to apply: the model "
+                "has no config.use_recompute and no sublayers matched "
+                "`checkpoints`")
 
     # -- modes ---------------------------------------------------------------
     def train(self):

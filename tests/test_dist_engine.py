@@ -225,3 +225,60 @@ def test_dist_model_requires_loss_for_train(mesh):
     assert model._mode == "predict"
     with pytest.raises(ValueError):
         model.train()
+
+
+def test_strategy_recompute_applies_to_model_config():
+    """Strategy.recompute flips a zoo model's native knob (+ granularity)."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+    paddle.seed(30)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            vocab_size=64, max_position_embeddings=32)
+    m = LlamaForCausalLM(cfg)
+    from paddle_tpu.distributed import Strategy
+    from paddle_tpu.distributed.engine import DistModel
+    st = Strategy({"recompute": {"enable": True,
+                                 "granularity": "selective"}})
+    DistModel(m, loss=lambda out, lbl: out.sum(), optimizer=None,
+              strategy=st)
+    assert cfg.use_recompute is True
+    assert cfg.recompute_granularity == "selective"
+
+
+def test_strategy_recompute_wraps_generic_sublayers():
+    """Generic models: direct sublayers become recompute regions and the
+    loss/grads match the unwrapped model exactly."""
+    import numpy as np
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import Strategy
+    from paddle_tpu.distributed.engine import DistModel
+
+    def build():
+        paddle.seed(31)
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                             nn.Linear(16, 8))
+
+    x = paddle.to_tensor(np.random.RandomState(31).randn(4, 8)
+                         .astype("float32"))
+
+    ref_net = build()
+    ref = ref_net(x)
+    ref.sum().backward()
+    ref_grad = ref_net[0].weight.grad.numpy().copy()
+
+    net = build()
+    st = Strategy({"recompute": {"enable": True}})
+    DistModel(net, loss=lambda out, lbl: out.sum(), optimizer=None,
+              strategy=st)
+    out = net(x)  # call 1 probes output types (direct mode)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+    out.sum().backward()
+    np.testing.assert_allclose(net[0].weight.grad.numpy(), ref_grad,
+                               rtol=1e-6)
+    # call 2+ runs through fleet.recompute: same numerics, grads replayed
+    net[0].weight.clear_grad()
+    out2 = net(x)
+    np.testing.assert_allclose(out2.numpy(), ref.numpy(), rtol=1e-6)
+    out2.sum().backward()
+    np.testing.assert_allclose(net[0].weight.grad.numpy(), ref_grad,
+                               rtol=1e-6)
