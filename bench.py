@@ -104,6 +104,16 @@ def main(scan_layers=True, size="large"):
         # measure flash (block_q, block_k) tilings once per shape and run
         # the headline number at the winner (autotune is trace-safe)
         paddle.set_flags({"FLAGS_flash_autotune": True})
+        # persistent compilation cache: the first Llama compile through the
+        # remote-compile tunnel has exceeded 15 min; with the cache, a
+        # retried/repeated bench (or the next round) skips it entirely
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(_REPO_DIR, ".jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+        except Exception:
+            _progress("persistent compilation cache unavailable")
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
