@@ -63,6 +63,17 @@ if not TPU_TIER:
     # float64 for numeric-gradient checks (OpTest runs fp64 refs too);
     # TPU has no f64, so the real-hardware tier keeps x64 off.
     jax.config.update("jax_enable_x64", True)
+else:
+    # persistent compilation cache: Mosaic compiles ride the slow
+    # remote-compile tunnel; cache hits make tier reruns near-free
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
 import numpy as np
 import pytest
