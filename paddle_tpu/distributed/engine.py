@@ -174,6 +174,14 @@ class DistModel:
                         place = [Shard(0) if n == axis else Replicate()
                                  for n in mesh.dim_names]
                         shard_tensor(p, mesh, place)
+        if st.fused_passes.enable:
+            # XLA owns operator fusion on TPU (the CINN/pass-zoo
+            # disposition): say so instead of silently accepting config
+            import warnings
+            warnings.warn(
+                "Strategy.fused_passes is absorbed by XLA's fusion "
+                "pipeline on TPU; the listed passes "
+                f"({st.fused_passes.fused_passes_list}) configure nothing")
         if st.recompute.enable:
             self._apply_recompute(st.recompute)
         self._amp_kwargs = None

@@ -282,3 +282,17 @@ def test_strategy_recompute_wraps_generic_sublayers():
     out2.sum().backward()
     np.testing.assert_allclose(net[0].weight.grad.numpy(), ref_grad,
                                rtol=1e-6)
+
+
+def test_strategy_fused_passes_warns_not_silent():
+    import warnings as w
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import Strategy
+    from paddle_tpu.distributed.engine import DistModel
+    net = nn.Linear(4, 4)
+    st = Strategy({"fused_passes": {"enable": True,
+                                    "fused_passes_list": ["fuse_gemm"]}})
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        DistModel(net, loss=lambda o, l: o.sum(), strategy=st)
+    assert any("absorbed by XLA" in str(r.message) for r in rec)
