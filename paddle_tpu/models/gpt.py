@@ -375,9 +375,44 @@ class GPT2ForCausalLM(Layer):
             tok = ops.argmax(logits, axis=-1).reshape([b])
         return ops.concat([x.astype("int64") for x in toks], axis=1)
 
+    @staticmethod
+    def _select_token(logits_np, do_sample, temperature, top_k, top_p, rng):
+        """Next-token selection on host logits [B, V] (reference surface:
+        generation_utils' TopKProcess/TopPProcess + sampling).
+
+        Greedy unless do_sample; sampling applies temperature, then top-k
+        truncation, then nucleus (top-p) truncation, then draws from the
+        renormalized distribution."""
+        if not do_sample:
+            return logits_np.argmax(-1)
+        logits = logits_np.astype(np.float64) / max(temperature, 1e-6)
+        out = np.empty(logits.shape[0], np.int64)
+        for b in range(logits.shape[0]):
+            row = logits[b]
+            if top_k and 0 < top_k < row.shape[-1]:
+                kth = np.partition(row, -top_k)[-top_k]
+                row = np.where(row < kth, -np.inf, row)
+            probs = np.exp(row - row.max())
+            probs /= probs.sum()
+            if top_p is not None and 0 < top_p < 1.0:
+                order = np.argsort(-probs)
+                csum = np.cumsum(probs[order])
+                # keep the smallest prefix reaching top_p (always >= 1)
+                cutoff = int(np.searchsorted(csum, top_p) + 1)
+                keep = order[:cutoff]
+                mask = np.zeros_like(probs, bool)
+                mask[keep] = True
+                probs = np.where(mask, probs, 0.0)
+                probs /= probs.sum()
+            out[b] = rng.choice(probs.shape[-1], p=probs)
+        return out
+
     def generate(self, input_ids, max_new_tokens, s_max=None,
-                 decode_fn=None):
-        """Greedy incremental decode over the KV cache.
+                 decode_fn=None, do_sample=False, temperature=1.0,
+                 top_k=0, top_p=None, seed=None):
+        """Incremental decode over the KV cache — greedy by default;
+        ``do_sample=True`` draws with temperature / top-k / top-p
+        (nucleus) truncation, seeded via ``seed`` for reproducibility.
 
         decode_fn: optionally a compiled decode step (e.g.
         ``jit.to_static(model.decode_step)``) so every token reuses one
@@ -399,15 +434,25 @@ class GPT2ForCausalLM(Layer):
             raise ValueError(f"s_max={s_max} too small for prompt {s} + "
                              f"{max_new_tokens} new tokens")
         step = decode_fn if decode_fn is not None else self.decode_step
+        rng = np.random.RandomState(seed)
         logits, caches, t = self.prefill(input_ids, s_max)
+
+        def pick(lg):
+            if not do_sample:
+                # greedy stays ON DEVICE: no host round trip per step
+                return ops.argmax(lg[:, -1], axis=-1).reshape([b, 1])
+            sel = self._select_token(np.asarray(lg._data)[:, -1], True,
+                                     temperature, top_k, top_p, rng)
+            return paddle.to_tensor(sel.reshape(b, 1))
+
         toks = [input_ids]
-        tok = ops.argmax(logits[:, -1], axis=-1).reshape([b, 1])
+        tok = pick(logits)
         for i in range(max_new_tokens):
             toks.append(tok)
             if i + 1 == max_new_tokens:
                 break
             logits, caches, t = step(tok.astype(input_ids.dtype), caches, t)
-            tok = ops.argmax(logits[:, -1], axis=-1).reshape([b, 1])
+            tok = pick(logits)
         return ops.concat([x.astype("int64") for x in toks], axis=1)
 
     def num_params(self) -> int:

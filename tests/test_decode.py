@@ -127,3 +127,31 @@ def test_compiled_paged_decode_step_matches_eager():
         out = m.generate_paged(ids, max_new_tokens=6, block_size=8,
                                decode_fn=step).numpy().tolist()
     assert out == ref
+
+
+def test_sampling_generate():
+    """do_sample draws reproducibly (seeded), respects top-k truncation,
+    and temperature→0 collapses to greedy."""
+    m, cfg = _tiny()
+    ids = paddle.to_tensor(np.random.RandomState(8).randint(0, 128, (2, 8)))
+    with paddle.no_grad():
+        greedy = m.generate(ids, max_new_tokens=6).numpy().tolist()
+        s1 = m.generate(ids, max_new_tokens=6, do_sample=True,
+                        temperature=1.0, seed=7).numpy().tolist()
+        s2 = m.generate(ids, max_new_tokens=6, do_sample=True,
+                        temperature=1.0, seed=7).numpy().tolist()
+        s3 = m.generate(ids, max_new_tokens=6, do_sample=True,
+                        temperature=1.0, seed=8).numpy().tolist()
+        cold = m.generate(ids, max_new_tokens=6, do_sample=True,
+                          temperature=1e-4, seed=7).numpy().tolist()
+        k1 = m.generate(ids, max_new_tokens=6, do_sample=True, top_k=1,
+                        seed=7).numpy().tolist()
+    assert s1 == s2            # seeded determinism
+    assert s1 != s3            # seed matters
+    assert cold == greedy      # temperature -> 0 is greedy
+    assert k1 == greedy        # top-k=1 is greedy
+    # nucleus: with top_p tiny, also collapses to greedy
+    with paddle.no_grad():
+        p0 = m.generate(ids, max_new_tokens=6, do_sample=True,
+                        top_p=1e-9, seed=7).numpy().tolist()
+    assert p0 == greedy
