@@ -45,10 +45,18 @@ class ContinuousBatcher:
     """
 
     def __init__(self, model, max_batch: int = 8, s_max: int = 256,
-                 eos_id: Optional[int] = None, compile: bool = True):
+                 eos_id: Optional[int] = None, compile: bool = True,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: Optional[float] = None,
+                 seed: Optional[int] = None):
         import paddle_tpu as paddle
 
         self.model = model
+        self._do_sample = do_sample
+        self._temperature = temperature
+        self._top_k = top_k
+        self._top_p = top_p
+        self._rng = np.random.RandomState(seed)
         self.max_batch = max_batch
         self.s_max = s_max
         self.eos_id = eos_id
@@ -100,7 +108,7 @@ class ContinuousBatcher:
             logits, cache, _t = self.model.prefill(ids, self.s_max)
             # write the slot: caches[:, :, slot] = cache[:, :, 0]
             self._caches[:, :, slot] = cache[:, :, 0]
-            tok = int(np.asarray(logits._data)[0, -1].argmax())
+            tok = int(self._pick(np.asarray(logits._data)[:, -1])[0])
             req.slot = slot
             req.tokens.append(tok)
             self._slot_req[slot] = req
@@ -122,6 +130,13 @@ class ContinuousBatcher:
             return True
         return False
 
+    def _pick(self, logits_np):
+        """Next-token selection (greedy or sampled) on host logits [B, V];
+        shares the model's sampling semantics."""
+        return type(self.model)._select_token(
+            logits_np, self._do_sample, self._temperature, self._top_k,
+            self._top_p, self._rng)
+
     # -- the engine ---------------------------------------------------------
     def step(self) -> List[int]:
         """Admit, decode one token for every active slot, evict finished.
@@ -134,7 +149,7 @@ class ContinuousBatcher:
         tok_t = paddle.to_tensor(self._last_tok)
         t_t = paddle.to_tensor(self._t)
         logits, self._caches, _ = self._step_fn(tok_t, self._caches, t_t)
-        next_tok = np.asarray(logits._data)[:, -1].argmax(-1)
+        next_tok = self._pick(np.asarray(logits._data)[:, -1])
         for slot, req in list(self._slot_req.items()):
             tok = int(next_tok[slot])
             self._t[slot, 0] += 1

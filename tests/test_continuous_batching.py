@@ -144,3 +144,28 @@ def test_run_until_done_budget_raises():
             b.submit(rng.randint(0, 128, (4,)), 4)
         with pytest.raises(RuntimeError, match="remain after"):
             b.run_until_done(max_steps=2)
+
+
+def test_sampled_batching_is_seeded_and_diverse():
+    """do_sample in the batcher: reproducible under a seed; differs from
+    greedy at temperature 1."""
+    m = _model()
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(0, 128, (6,)) for _ in range(2)]
+    with paddle.no_grad():
+        def run(seed):
+            b = ContinuousBatcher(m, max_batch=2, s_max=32, compile=False,
+                                  do_sample=True, temperature=1.0,
+                                  seed=seed)
+            rids = [b.submit(p, 6) for p in prompts]
+            outs = b.run_until_done()
+            return [outs[r].tolist() for r in rids]
+
+        s1, s2, s3 = run(7), run(7), run(8)
+        g = ContinuousBatcher(m, max_batch=2, s_max=32, compile=False)
+        rids = [g.submit(p, 6) for p in prompts]
+        gouts = g.run_until_done()
+        greedy = [gouts[r].tolist() for r in rids]
+    assert s1 == s2
+    assert s1 != s3
+    assert s1 != greedy
