@@ -407,6 +407,42 @@ class GPT2ForCausalLM(Layer):
             out[b] = rng.choice(probs.shape[-1], p=probs)
         return out
 
+    @staticmethod
+    def _generate_loop(prefill_fn, step_fn, input_ids, max_new_tokens,
+                       do_sample, temperature, top_k, top_p, seed):
+        """Shared incremental-decode driver (GPT-2 and Llama): prefill,
+        then step/pick until the budget, with greedy selection staying on
+        device and sampling reading logits to host.
+
+        NOTE on the hot path: each step's returned caches are fresh
+        buffers (functional update); true in-place reuse needs donation
+        support in StaticFunction — tracked for the serving tier."""
+        import paddle_tpu as paddle
+        from .. import ops
+        b = input_ids.shape[0]
+        rng = np.random.RandomState(seed)
+
+        def pick(lg):
+            if not do_sample:
+                # greedy stays ON DEVICE: no host round trip per step
+                return ops.argmax(lg[:, -1], axis=-1).reshape([b, 1])
+            sel = GPT2ForCausalLM._select_token(
+                np.asarray(lg._data)[:, -1], True, temperature, top_k,
+                top_p, rng)
+            return paddle.to_tensor(sel.reshape(b, 1))
+
+        logits, caches, t = prefill_fn()
+        toks = [input_ids]
+        tok = pick(logits)
+        for i in range(max_new_tokens):
+            toks.append(tok)
+            if i + 1 == max_new_tokens:
+                break
+            logits, caches, t = step_fn(tok.astype(input_ids.dtype),
+                                        caches, t)
+            tok = pick(logits)
+        return ops.concat([x.astype("int64") for x in toks], axis=1)
+
     def generate(self, input_ids, max_new_tokens, s_max=None,
                  decode_fn=None, do_sample=False, temperature=1.0,
                  top_k=0, top_p=None, seed=None):
@@ -434,26 +470,9 @@ class GPT2ForCausalLM(Layer):
             raise ValueError(f"s_max={s_max} too small for prompt {s} + "
                              f"{max_new_tokens} new tokens")
         step = decode_fn if decode_fn is not None else self.decode_step
-        rng = np.random.RandomState(seed)
-        logits, caches, t = self.prefill(input_ids, s_max)
-
-        def pick(lg):
-            if not do_sample:
-                # greedy stays ON DEVICE: no host round trip per step
-                return ops.argmax(lg[:, -1], axis=-1).reshape([b, 1])
-            sel = self._select_token(np.asarray(lg._data)[:, -1], True,
-                                     temperature, top_k, top_p, rng)
-            return paddle.to_tensor(sel.reshape(b, 1))
-
-        toks = [input_ids]
-        tok = pick(logits)
-        for i in range(max_new_tokens):
-            toks.append(tok)
-            if i + 1 == max_new_tokens:
-                break
-            logits, caches, t = step(tok.astype(input_ids.dtype), caches, t)
-            tok = pick(logits)
-        return ops.concat([x.astype("int64") for x in toks], axis=1)
+        return self._generate_loop(
+            lambda: self.prefill(input_ids, s_max), step, input_ids,
+            max_new_tokens, do_sample, temperature, top_k, top_p, seed)
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in self.parameters())

@@ -149,7 +149,7 @@ class LlamaAttention(Layer):
         self.o_proj = Linear(h * d, config.hidden_size, weight_attr=init,
                              bias_attr=False)
 
-    def forward(self, hidden, cos, sin, attn_mask=None):
+    def forward(self, hidden, cos, sin, attn_mask=None, return_kv=False):
         b, s, _ = hidden.shape
         cfg = self.config
         h, kv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -158,6 +158,9 @@ class LlamaAttention(Layer):
         v = self.v_proj(hidden).reshape([b, s, kv, d])
         q = apply_rotary_pos_emb_t(q, cos, sin)
         k = apply_rotary_pos_emb_t(k, cos, sin)
+        if return_kv:
+            # decode-cache layout [B, KV, S, D], post-RoPE, unexpanded GQA
+            kv_out = (k.transpose([0, 2, 1, 3]), v.transpose([0, 2, 1, 3]))
         if cfg.sep_mesh is not None:
             # context parallelism: exact global attention with K/V blocks
             # rotating the ICI ring (SURVEY.md §5's CP gap filler). GQA kv
@@ -187,13 +190,57 @@ class LlamaAttention(Layer):
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=attn_mask is None)
         out = out.reshape([b, s, h * d])
-        return self.o_proj(out)
+        out = self.o_proj(out)
+        if return_kv:
+            return out, kv_out[0], kv_out[1]
+        return out
 
 
 def apply_rotary_pos_emb_t(x: Tensor, cos, sin) -> Tensor:
     """Tensor-level RoPE wired through the op layer so autograd sees it."""
     from ..ops.registry import dispatch
     return dispatch(apply_rotary_pos_emb, (x, cos, sin), {}, "rope")
+
+
+def _rope_at(x, cos_tab, sin_tab, t):
+    """Rotate [B, H, D] by per-batch positions t [B] (decode step RoPE)."""
+    c = cos_tab[t][:, None, :].astype(jnp.float32)   # [B, 1, D/2]
+    s = sin_tab[t][:, None, :].astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _decode_attn(q, k_new, v_new, cache_k, cache_v, t, cos_tab, sin_tab):
+    """One-token GQA decode over the dense cache (the serving hot op).
+
+    q [B, H, D] (pre-RoPE); k_new/v_new [B, KV, D] (pre-RoPE);
+    cache_k/v [B, KV, S_max, D] (post-RoPE rows); t [B] write positions.
+    RoPE applies at position t, the new K/V row scatters in, and the
+    attention runs grouped (GQA unexpanded — [B, KV, rep, D] against
+    [B, KV, S, D]). Returns (ctx [B, H*D], cache_k', cache_v').
+    Reference analog: masked_multihead_attention_kernel.cu, with GQA.
+    """
+    b, h, d = q.shape
+    kvh = cache_k.shape[1]
+    s_max = cache_k.shape[2]
+    q = _rope_at(q, cos_tab, sin_tab, t)
+    k_new = _rope_at(k_new, cos_tab, sin_tab, t)
+    b_idx = jnp.arange(b)
+    ck = cache_k.at[b_idx, :, t].set(k_new.astype(cache_k.dtype))
+    cv = cache_v.at[b_idx, :, t].set(v_new.astype(cache_v.dtype))
+    rep = h // kvh
+    qg = q.reshape(b, kvh, rep, d)
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bgrd,bgsd->bgrs", qg.astype(jnp.float32),
+                        ck.astype(jnp.float32)) * scale
+    pos = jnp.arange(s_max)[None, None, None, :]
+    scores = jnp.where(pos <= t[:, None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bgrs,bgsd->bgrd", probs, cv.astype(jnp.float32))
+    return ctx.reshape(b, h * d).astype(q.dtype), ck, cv
 
 
 class LlamaMLP(Layer):
@@ -305,6 +352,39 @@ class LlamaDecoderLayer(Layer):
         hidden = self.post_attention_layernorm(hidden)
         hidden = self.mlp(hidden)
         return residual + hidden
+
+    def forward_kv(self, hidden, cos, sin):
+        """Prefill: dense forward + this layer's post-RoPE K/V for the
+        decode cache ([B, KV, S, D])."""
+        attn_out, k, v = self.self_attn(self.input_layernorm(hidden),
+                                        cos, sin, return_kv=True)
+        hidden = hidden + attn_out
+        return hidden + self.mlp(self.post_attention_layernorm(hidden)), k, v
+
+    def decode(self, hidden, cache_kv, t, cos_tab, sin_tab):
+        """One-token decode over the dense KV cache.
+
+        hidden [B, 1, E]; cache_kv [2, B, KV, S_max, D]; t [B] int32.
+        Returns (hidden', new_cache)."""
+        from ..ops.registry import dispatch
+        attn = self.self_attn
+        cfg = attn.config
+        b = hidden.shape[0]
+        h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        x = self.input_layernorm(hidden)
+        q = attn.q_proj(x).reshape([b, h, d])
+        k = attn.k_proj(x).reshape([b, kvh, d])
+        v = attn.v_proj(x).reshape([b, kvh, d])
+        ctx, ck, cv = dispatch(
+            _decode_attn,
+            (q, k, v, cache_kv[0], cache_kv[1], t, Tensor(cos_tab),
+             Tensor(sin_tab)), {}, "llama_decode_attn")
+        hidden = hidden + attn.o_proj(ctx.reshape([b, 1, h * d]))
+        from .. import ops
+        new_cache = ops.stack([ck, cv])
+        return (hidden + self.mlp(self.post_attention_layernorm(hidden)),
+                new_cache)
 
 
 class ScannedLlamaLayers(Layer):
@@ -525,6 +605,42 @@ class LlamaModel(Layer):
         mesh, placements = anchor
         return shard_tensor(hidden, mesh, placements)
 
+    def forward_prefill(self, input_ids, s_max):
+        """Dense prompt pass that also fills the decode KV caches.
+
+        Returns (hidden [B, S, E], caches [L, 2, B, KV, s_max, D]).
+        Serving uses the unrolled stack (scan_layers exposes no per-layer
+        K/V) and runs mesh-free (no sep ring)."""
+        import paddle_tpu as paddle
+        from .. import ops
+        if self.config.scan_layers:
+            raise ValueError("incremental decode needs the unrolled stack: "
+                             "build the model with scan_layers=False for "
+                             "serving")
+        if self.config.sep_mesh is not None:
+            # the ring would fill the cache through context-parallel
+            # attention while decode attends a single dense cache — the
+            # mismatch would be silent; refuse instead
+            raise ValueError("incremental decode is mesh-free: clear "
+                             "config.sep_mesh for serving (context "
+                             "parallelism is a training-time layout)")
+        b, s = input_ids.shape
+        if s > s_max:
+            raise ValueError(f"prompt length {s} exceeds cache size {s_max}")
+        hidden = self.embed_tokens(input_ids)
+        cos, sin = self._cos[:s], self._sin[:s]
+        kvh, d = self.config.num_key_value_heads, self.config.head_dim
+        pad = (paddle.zeros([b, kvh, s_max - s, d], dtype=self.config.dtype)
+               if s < s_max else None)
+        caches = []
+        for layer in self.layers:
+            hidden, k, v = layer.forward_kv(hidden, cos, sin)
+            if pad is not None:
+                k = ops.concat([k, pad.astype(k.dtype)], axis=2)
+                v = ops.concat([v, pad.astype(v.dtype)], axis=2)
+            caches.append(ops.stack([k, v]))
+        return self.norm(hidden), ops.stack(caches)
+
     def forward(self, input_ids, attn_mask=None):
         _, s = input_ids.shape
         hidden = self.embed_tokens(input_ids)
@@ -585,6 +701,67 @@ class LlamaForCausalLM(Layer):
                 if aux is not None:
                     loss = loss + self.config.moe_aux_coeff * aux
         return logits, loss
+
+    # -- incremental (KV-cache) decode — the serving path -------------------
+
+    def prefill(self, input_ids, s_max):
+        """Prompt pass for incremental decode. Returns
+        (last_logits [B, 1, V], caches [L, 2, B, KV, s_max, D], t [B])."""
+        import paddle_tpu as paddle
+        b, s = input_ids.shape
+        hidden, caches = self.model.forward_prefill(input_ids, s_max)
+        logits = self._lm_logits(hidden[:, s - 1:s])
+        t = paddle.to_tensor(np.full((b,), s, np.int32))
+        return logits, caches, t
+
+    def _lm_logits(self, hidden):
+        if self.lm_head is None:
+            from .. import ops
+            return ops.matmul(hidden, self.model.embed_tokens.weight,
+                              transpose_y=True)
+        return self.lm_head(hidden)
+
+    def decode_step(self, tok, caches, t):
+        """One incremental token through every layer's KV cache.
+
+        tok [B, 1] int; caches [L, 2, B, KV, S_max, D]; t [B] int32.
+        Static shapes — ``jit.to_static(model.decode_step)`` compiles ONE
+        executable that serves every step. Returns (logits, caches', t+1).
+        """
+        from .. import ops
+        model = self.model
+        hidden = model.embed_tokens(tok)           # [B, 1, E]
+        cos_tab, sin_tab = model._cos, model._sin
+        new_caches = []
+        for i, layer in enumerate(model.layers):
+            hidden, nc = layer.decode(hidden, caches[i], t, cos_tab,
+                                      sin_tab)
+            new_caches.append(nc)
+        hidden = model.norm(hidden)
+        return self._lm_logits(hidden), ops.stack(new_caches), t + 1
+
+    def generate(self, input_ids, max_new_tokens, s_max=None,
+                 decode_fn=None, do_sample=False, temperature=1.0,
+                 top_k=0, top_p=None, seed=None):
+        """Incremental decode over the KV cache — greedy by default;
+        ``do_sample`` draws with temperature / top-k / top-p (shared
+        sampling semantics with the GPT-2 zoo)."""
+        from .gpt import GPT2ForCausalLM
+        _, s = input_ids.shape
+        if s_max is None:
+            s_max = min(self.config.max_position_embeddings,
+                        s + max_new_tokens)
+        if s_max > self.config.max_position_embeddings:
+            raise ValueError(
+                f"s_max={s_max} exceeds max_position_embeddings="
+                f"{self.config.max_position_embeddings}")
+        if s + max_new_tokens > s_max:
+            raise ValueError(f"s_max={s_max} too small for prompt {s} + "
+                             f"{max_new_tokens} new tokens")
+        step = decode_fn if decode_fn is not None else self.decode_step
+        return GPT2ForCausalLM._generate_loop(
+            lambda: self.prefill(input_ids, s_max), step, input_ids,
+            max_new_tokens, do_sample, temperature, top_k, top_p, seed)
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in self.parameters())
