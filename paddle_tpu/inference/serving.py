@@ -35,9 +35,10 @@ class Request:
 
 
 class ContinuousBatcher:
-    """Greedy continuous batcher over GPT2ForCausalLM's dense KV cache.
+    """Continuous batcher over a causal LM's dense KV cache.
 
-    model: a GPT2ForCausalLM (eval mode). max_batch: slot count (ONE
+    model: a GPT2ForCausalLM or LlamaForCausalLM (eval mode — any model
+    exposing prefill/decode_step with the [B, 1] t convention). max_batch: slot count (ONE
     compiled decode executable serves every step at this batch). s_max:
     per-slot cache rows (prompt + generation must fit). eos_id: optional
     early-stop token. compile: jit.to_static the decode step (recommended;
@@ -65,9 +66,11 @@ class ContinuousBatcher:
             raise ValueError(f"s_max={s_max} exceeds "
                              f"max_position_embeddings="
                              f"{cfg.max_position_embeddings}")
-        L, h, d = (cfg.num_hidden_layers, cfg.num_attention_heads,
-                   cfg.head_dim)
-        self._caches = paddle.zeros([L, 2, max_batch, h, s_max, d],
+        L, d = cfg.num_hidden_layers, cfg.head_dim
+        # GQA models cache at kv-head count (unexpanded)
+        kvh = getattr(cfg, "num_key_value_heads", None) \
+            or cfg.num_attention_heads
+        self._caches = paddle.zeros([L, 2, max_batch, kvh, s_max, d],
                                     dtype=cfg.dtype)
         self._t = np.full((max_batch, 1), s_max - 1, np.int32)  # parked
         self._free = list(range(max_batch))
@@ -133,7 +136,8 @@ class ContinuousBatcher:
     def _pick(self, logits_np):
         """Next-token selection (greedy or sampled) on host logits [B, V];
         shares the model's sampling semantics."""
-        return type(self.model)._select_token(
+        from ..models.gpt import GPT2ForCausalLM
+        return GPT2ForCausalLM._select_token(
             logits_np, self._do_sample, self._temperature, self._top_k,
             self._top_p, self._rng)
 

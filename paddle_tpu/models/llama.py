@@ -711,7 +711,9 @@ class LlamaForCausalLM(Layer):
         b, s = input_ids.shape
         hidden, caches = self.model.forward_prefill(input_ids, s_max)
         logits = self._lm_logits(hidden[:, s - 1:s])
-        t = paddle.to_tensor(np.full((b,), s, np.int32))
+        # t is [B, 1] — the shared decode-state convention (GPT-2 and the
+        # serving batcher use the same shape)
+        t = paddle.to_tensor(np.full((b, 1), s, np.int32))
         return logits, caches, t
 
     def _lm_logits(self, hidden):
@@ -724,7 +726,7 @@ class LlamaForCausalLM(Layer):
     def decode_step(self, tok, caches, t):
         """One incremental token through every layer's KV cache.
 
-        tok [B, 1] int; caches [L, 2, B, KV, S_max, D]; t [B] int32.
+        tok [B, 1] int; caches [L, 2, B, KV, S_max, D]; t [B, 1] int32.
         Static shapes — ``jit.to_static(model.decode_step)`` compiles ONE
         executable that serves every step. Returns (logits, caches', t+1).
         """
@@ -732,9 +734,10 @@ class LlamaForCausalLM(Layer):
         model = self.model
         hidden = model.embed_tokens(tok)           # [B, 1, E]
         cos_tab, sin_tab = model._cos, model._sin
+        t_flat = t.reshape([-1])
         new_caches = []
         for i, layer in enumerate(model.layers):
-            hidden, nc = layer.decode(hidden, caches[i], t, cos_tab,
+            hidden, nc = layer.decode(hidden, caches[i], t_flat, cos_tab,
                                       sin_tab)
             new_caches.append(nc)
         hidden = model.norm(hidden)
