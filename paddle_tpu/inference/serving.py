@@ -81,7 +81,10 @@ class ContinuousBatcher:
         self._last_tok = np.zeros((max_batch, 1), np.int64)
         if compile:
             from .. import jit
-            self._step_fn = jit.to_static(model.decode_step)
+            # donate the caches argument (tensor arg index 1): XLA reuses
+            # the cache HBM in place instead of double-buffering per step
+            self._step_fn = jit.to_static(model.decode_step,
+                                          donate_args=(1,))
         else:
             self._step_fn = model.decode_step
 
@@ -108,7 +111,8 @@ class ContinuousBatcher:
             req = self._pending.pop(0)
             slot = self._free.pop(0)
             ids = paddle.to_tensor(req.prompt[None, :])
-            logits, cache, _t = self.model.prefill(ids, self.s_max)
+            with paddle.no_grad():
+                logits, cache, _t = self.model.prefill(ids, self.s_max)
             # write the slot: caches[:, :, slot] = cache[:, :, 0]
             self._caches[:, :, slot] = cache[:, :, 0]
             tok = int(self._pick(np.asarray(logits._data)[:, -1])[0])
@@ -152,7 +156,11 @@ class ContinuousBatcher:
             return finished
         tok_t = paddle.to_tensor(self._last_tok)
         t_t = paddle.to_tensor(self._t)
-        logits, self._caches, _ = self._step_fn(tok_t, self._caches, t_t)
+        # serving is inference by construction: the batcher supplies the
+        # no_grad scope its donating compiled step requires
+        with paddle.no_grad():
+            logits, self._caches, _ = self._step_fn(tok_t, self._caches,
+                                                    t_t)
         next_tok = self._pick(np.asarray(logits._data)[:, -1])
         for slot, req in list(self._slot_req.items()):
             tok = int(next_tok[slot])

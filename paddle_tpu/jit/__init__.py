@@ -70,7 +70,7 @@ class StaticFunction:
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  backend=None, full_graph=True, batch_buckets=None,
                  seq_buckets=None, seq_axis=1, seq_mask_arg=None,
-                 seq_unpad_outputs=True):
+                 seq_unpad_outputs=True, donate_args=None):
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._cache: Dict[Any, dict] = {}
@@ -82,6 +82,13 @@ class StaticFunction:
         self._seq_axis = seq_axis
         self._seq_mask_arg = seq_mask_arg
         self._seq_unpad_outputs = seq_unpad_outputs
+        # donate_args: indices of TOP-LEVEL POSITIONAL arguments whose
+        # tensor buffers (every leaf, for pytree args) are donated to the
+        # executable — XLA reuses them in place (e.g. a decode step's KV
+        # caches, halving serving HBM traffic). Inference-only: donated
+        # inputs are invalid after the call, so any grad-mode call on a
+        # donating function raises up front.
+        self._donate_args = tuple(donate_args) if donate_args else ()
 
     @property
     def code(self):
@@ -94,6 +101,12 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED[0]:
             return self._fn(*args, **kwargs)
+        if self._donate_args and _engine.is_grad_enabled():
+            # fail fast and CONSISTENTLY (not only once compiled): donated
+            # buffers die after the call, which would corrupt the tape
+            raise RuntimeError(
+                "to_static(donate_args=...) is inference-only: run under "
+                "paddle.no_grad() (or drop donate_args)")
         if self._seq_buckets:
             return self._call_seq_bucketed(args, kwargs)
         return self._inner_dispatch(args, kwargs)
@@ -331,7 +344,27 @@ class StaticFunction:
         self._tensor_pos = [i for i, x in enumerate(flat) if _is_tensor(x)]
         self._static_flat = [None if _is_tensor(x) else x for x in flat]
 
-        compiled = jax.jit(pure)
+        # donate_args indexes TOP-LEVEL positional args; expand each to
+        # its tensor-leaf range in the flat calling convention (a pytree
+        # cache arg donates every leaf, and args after a pytree don't
+        # silently shift onto the wrong buffer)
+        donate_leaves = []
+        if self._donate_args:
+            ranges = []
+            pos = 0
+            for a in args:
+                n = sum(1 for t in jax.tree_util.tree_leaves(
+                    a, is_leaf=_is_tensor) if _is_tensor(t))
+                ranges.append((pos, pos + n))
+                pos += n
+            for i in self._donate_args:
+                if i >= len(ranges):
+                    raise ValueError(
+                        f"donate_args index {i} out of range for "
+                        f"{len(args)} positional arguments")
+                donate_leaves.extend(range(*ranges[i]))
+        donate = tuple(3 + j for j in donate_leaves)
+        compiled = jax.jit(pure, donate_argnums=donate)
         entry = {"compiled": compiled, "state": state, "mutated": mutated,
                  "grad_ts": grad_ts, "rng_used": rng_used, "first_out": out,
                  "treedef": treedef, "tensor_pos": self._tensor_pos,
@@ -452,7 +485,7 @@ def _rewrap_args(flat_arrays, treedef, tensor_pos, static_flat):
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True, batch_buckets=None,
               seq_buckets=None, seq_axis=1, seq_mask_arg=None,
-              seq_unpad_outputs=True):
+              seq_unpad_outputs=True, donate_args=None):
     """paddle.jit.to_static analog (jit/api.py:171).
 
     batch_buckets: opt-in dynamic-batch bucketing — inputs pad their
@@ -473,12 +506,14 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             static = StaticFunction(layer.forward, input_spec,
                                     build_strategy, backend, full_graph,
                                     batch_buckets, seq_buckets, seq_axis,
-                                    seq_mask_arg, seq_unpad_outputs)
+                                    seq_mask_arg, seq_unpad_outputs,
+                                    donate_args)
             layer.forward = static
             return layer
         return StaticFunction(fn, input_spec, build_strategy, backend,
                               full_graph, batch_buckets, seq_buckets,
-                              seq_axis, seq_mask_arg, seq_unpad_outputs)
+                              seq_axis, seq_mask_arg, seq_unpad_outputs,
+                              donate_args)
     if function is not None:
         return deco(function)
     return deco

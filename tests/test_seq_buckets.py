@@ -123,3 +123,27 @@ def test_exact_bucket_size_passthrough():
         out = static(ids).numpy()
         ref = m(ids).numpy()
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_donate_args_inference_and_grad_guard():
+    """to_static(donate_args=...): the donated input buffer is consumed
+    (serving caches update in place); grad-mode calls at a donating
+    signature raise instead of corrupting the tape."""
+    def step(x, cache):
+        new_cache = cache + x.sum()
+        return x * 2.0, new_cache
+
+    fn = jit.to_static(step, donate_args=(1,))
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    with paddle.no_grad():
+        cache = paddle.to_tensor(np.zeros((8,), np.float32))
+        fn(x, cache)  # call 1: eager discovery
+        cache2 = paddle.to_tensor(np.zeros((8,), np.float32))
+        out, new_cache = fn(x, cache2)  # call 2: compiled + donated
+        np.testing.assert_allclose(new_cache.numpy(), np.full((8,), 4.0))
+        assert cache2._data.is_deleted()  # buffer consumed by donation
+    # grad mode at the same signature must refuse loudly
+    xg = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    cache3 = paddle.to_tensor(np.zeros((8,), np.float32))
+    with pytest.raises(RuntimeError, match="inference-only"):
+        fn(xg, cache3)
