@@ -443,6 +443,94 @@ class GPT2ForCausalLM(Layer):
             tok = pick(logits)
         return ops.concat([x.astype("int64") for x in toks], axis=1)
 
+    @staticmethod
+    def _resolve_s_max(config, s, max_new_tokens, s_max):
+        """Default + validate the cache size (shared by every generate
+        flavor in both model families): positions past the embedding
+        table would CLIP silently (jnp.take), so reject loudly."""
+        if s_max is None:
+            s_max = min(config.max_position_embeddings, s + max_new_tokens)
+        if s_max > config.max_position_embeddings:
+            raise ValueError(
+                f"s_max={s_max} exceeds max_position_embeddings="
+                f"{config.max_position_embeddings}")
+        if s + max_new_tokens > s_max:
+            raise ValueError(f"s_max={s_max} too small for prompt {s} + "
+                             f"{max_new_tokens} new tokens")
+        return s_max
+
+    @staticmethod
+    def _beam_loop(prefill_fn, step_fn, input_ids, max_new_tokens,
+                   num_beams, length_penalty):
+        """Shared beam-search driver over the KV cache.
+
+        Beams ride the batch dimension: inputs expand to B*W rows, the
+        per-beam caches reorder by index_select along the cache's batch
+        axis at every step (the KV-cache beam shuffle the reference's
+        beam_search_decode does), and ONE decode executable at batch B*W
+        serves every step. No EOS handling — fixed-length beams; the best
+        beam per batch wins by summed log-prob / len**length_penalty.
+        """
+        import paddle_tpu as paddle
+        from .. import ops
+        b, s = input_ids.shape
+        w = num_beams
+        ids_np = np.asarray(input_ids._data)
+        expanded = paddle.to_tensor(np.repeat(ids_np, w, axis=0))
+        logits, caches, t = prefill_fn(expanded)
+
+        def logprobs(lg):
+            x = np.asarray(lg._data)[:, -1].astype(np.float64)
+            x = x - x.max(-1, keepdims=True)
+            return x - np.log(np.exp(x).sum(-1, keepdims=True))
+
+        v = logits.shape[-1]
+        # seed: the W beams of each batch start DISTINCT (top-W tokens of
+        # the prompt's next-token distribution; all W rows of a batch hold
+        # identical prompt logits, so read row 0 of each group)
+        lp0 = logprobs(logits)[::w]                       # [B, V]
+        top0 = np.argsort(-lp0, axis=-1)[:, :w]           # [B, W]
+        beam_scores = np.take_along_axis(lp0, top0, -1)   # [B, W]
+        beam_tokens = [top0.reshape(b * w, 1)]            # list of [BW, 1]
+        tok = paddle.to_tensor(beam_tokens[0])
+        for i in range(1, max_new_tokens):
+            logits, caches, t = step_fn(
+                tok.astype(input_ids.dtype), caches, t)
+            lp = logprobs(logits).reshape(b, w, v)        # [B, W, V]
+            total = beam_scores[..., None] + lp           # [B, W, V]
+            flat = total.reshape(b, w * v)
+            best = np.argsort(-flat, axis=-1)[:, :w]      # [B, W]
+            src_beam = best // v                          # [B, W]
+            token = best % v                              # [B, W]
+            beam_scores = np.take_along_axis(flat, best, -1)
+            # reorder every beam-carrying structure by the source beams
+            gather = (np.arange(b)[:, None] * w + src_beam).reshape(-1)
+            gidx = paddle.to_tensor(gather.astype(np.int64))
+            caches = ops.index_select(caches, gidx, axis=2)
+            t = ops.index_select(t, gidx, axis=0)
+            beam_tokens = [tk[gather] for tk in beam_tokens]
+            beam_tokens.append(token.reshape(b * w, 1))
+            tok = paddle.to_tensor(beam_tokens[-1])
+        # best beam per batch (length fixed, penalty kept for API parity)
+        denom = max_new_tokens ** length_penalty if length_penalty else 1.0
+        best_beam = (beam_scores / denom).argmax(-1)      # [B]
+        rows = np.arange(b) * w + best_beam
+        gen = np.concatenate([tk[rows] for tk in beam_tokens], axis=1)
+        return paddle.to_tensor(
+            np.concatenate([ids_np.astype(np.int64), gen], axis=1))
+
+    def generate_beam(self, input_ids, max_new_tokens, num_beams=4,
+                      s_max=None, decode_fn=None, length_penalty=0.0):
+        """Beam search over the KV cache (reference generation's
+        beam_search mode). Returns the best beam per batch,
+        [B, S + max_new_tokens]."""
+        _, s = input_ids.shape
+        s_max = self._resolve_s_max(self.config, s, max_new_tokens, s_max)
+        step = decode_fn if decode_fn is not None else self.decode_step
+        return self._beam_loop(lambda ids: self.prefill(ids, s_max), step,
+                               input_ids, max_new_tokens, num_beams,
+                               length_penalty)
+
     def generate(self, input_ids, max_new_tokens, s_max=None,
                  decode_fn=None, do_sample=False, temperature=1.0,
                  top_k=0, top_p=None, seed=None):
@@ -456,19 +544,8 @@ class GPT2ForCausalLM(Layer):
         """
         import paddle_tpu as paddle
         from .. import ops
-        b, s = input_ids.shape
-        if s_max is None:
-            s_max = min(self.config.max_position_embeddings,
-                        s + max_new_tokens)
-        if s_max > self.config.max_position_embeddings:
-            # wpe lookups beyond the table would CLIP silently (jnp.take),
-            # reusing the last position embedding — reject loudly instead
-            raise ValueError(
-                f"s_max={s_max} exceeds max_position_embeddings="
-                f"{self.config.max_position_embeddings}")
-        if s + max_new_tokens > s_max:
-            raise ValueError(f"s_max={s_max} too small for prompt {s} + "
-                             f"{max_new_tokens} new tokens")
+        _, s = input_ids.shape
+        s_max = self._resolve_s_max(self.config, s, max_new_tokens, s_max)
         step = decode_fn if decode_fn is not None else self.decode_step
         return self._generate_loop(
             lambda: self.prefill(input_ids, s_max), step, input_ids,

@@ -751,20 +751,24 @@ class LlamaForCausalLM(Layer):
         sampling semantics with the GPT-2 zoo)."""
         from .gpt import GPT2ForCausalLM
         _, s = input_ids.shape
-        if s_max is None:
-            s_max = min(self.config.max_position_embeddings,
-                        s + max_new_tokens)
-        if s_max > self.config.max_position_embeddings:
-            raise ValueError(
-                f"s_max={s_max} exceeds max_position_embeddings="
-                f"{self.config.max_position_embeddings}")
-        if s + max_new_tokens > s_max:
-            raise ValueError(f"s_max={s_max} too small for prompt {s} + "
-                             f"{max_new_tokens} new tokens")
+        s_max = GPT2ForCausalLM._resolve_s_max(self.config, s,
+                                               max_new_tokens, s_max)
         step = decode_fn if decode_fn is not None else self.decode_step
         return GPT2ForCausalLM._generate_loop(
             lambda: self.prefill(input_ids, s_max), step, input_ids,
             max_new_tokens, do_sample, temperature, top_k, top_p, seed)
+
+    def generate_beam(self, input_ids, max_new_tokens, num_beams=4,
+                      s_max=None, decode_fn=None, length_penalty=0.0):
+        """Beam search over the GQA KV cache (shared driver with GPT-2)."""
+        from .gpt import GPT2ForCausalLM
+        _, s = input_ids.shape
+        s_max = GPT2ForCausalLM._resolve_s_max(self.config, s,
+                                               max_new_tokens, s_max)
+        step = decode_fn if decode_fn is not None else self.decode_step
+        return GPT2ForCausalLM._beam_loop(
+            lambda ids: self.prefill(ids, s_max), step, input_ids,
+            max_new_tokens, num_beams, length_penalty)
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in self.parameters())
