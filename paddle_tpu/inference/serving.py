@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["ContinuousBatcher", "Request"]
+__all__ = ["ContinuousBatcher", "PagedContinuousBatcher", "Request"]
 
 
 @dataclass
@@ -28,114 +28,51 @@ class Request:
     max_new_tokens: int
     tokens: List[int] = field(default_factory=list)
     slot: Optional[int] = None
+    # explicit flag: a PREEMPTED request also has slot None + partial
+    # tokens while it waits for re-admission — it is not done
+    finished: bool = False
 
     @property
     def done(self) -> bool:
-        return self.slot is None and bool(self.tokens)
+        return self.finished
 
 
-class ContinuousBatcher:
-    """Continuous batcher over a causal LM's dense KV cache.
+class _BatcherBase:
+    """Request lifecycle shared by the dense-slot and paged batchers:
+    FIFO submission, finish-on-EOS-or-budget, result retrieval, and the
+    drive loop. Subclasses own the cache layout and implement
+    ``_release_slot(slot)`` (return the slot's memory to their pool) plus
+    ``step()``."""
 
-    model: a GPT2ForCausalLM or LlamaForCausalLM (eval mode — any model
-    exposing prefill/decode_step with the [B, 1] t convention). max_batch: slot count (ONE
-    compiled decode executable serves every step at this batch). s_max:
-    per-slot cache rows (prompt + generation must fit). eos_id: optional
-    early-stop token. compile: jit.to_static the decode step (recommended;
-    disable for debugging).
-    """
-
-    def __init__(self, model, max_batch: int = 8, s_max: int = 256,
-                 eos_id: Optional[int] = None, compile: bool = True,
-                 do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, top_p: Optional[float] = None,
-                 seed: Optional[int] = None):
-        import paddle_tpu as paddle
-
-        self.model = model
-        self._do_sample = do_sample
-        self._temperature = temperature
-        self._top_k = top_k
-        self._top_p = top_p
-        self._rng = np.random.RandomState(seed)
-        self.max_batch = max_batch
-        self.s_max = s_max
-        self.eos_id = eos_id
-        cfg = model.config
-        if s_max > cfg.max_position_embeddings:
-            raise ValueError(f"s_max={s_max} exceeds "
-                             f"max_position_embeddings="
-                             f"{cfg.max_position_embeddings}")
-        L, d = cfg.num_hidden_layers, cfg.head_dim
-        # GQA models cache at kv-head count (unexpanded)
-        kvh = getattr(cfg, "num_key_value_heads", None) \
-            or cfg.num_attention_heads
-        self._caches = paddle.zeros([L, 2, max_batch, kvh, s_max, d],
-                                    dtype=cfg.dtype)
-        self._t = np.full((max_batch, 1), s_max - 1, np.int32)  # parked
-        self._free = list(range(max_batch))
+    def _init_queues(self):
         self._slot_req: Dict[int, Request] = {}
         self._pending: List[Request] = []
         self._finished: Dict[int, Request] = {}
         self._next_rid = 0
-        self._last_tok = np.zeros((max_batch, 1), np.int64)
-        if compile:
-            from .. import jit
-            # donate the caches argument (tensor arg index 1): XLA reuses
-            # the cache HBM in place instead of double-buffering per step
-            self._step_fn = jit.to_static(model.decode_step,
-                                          donate_args=(1,))
-        else:
-            self._step_fn = model.decode_step
 
-    # -- request lifecycle --------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens: int) -> int:
-        prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
+    @staticmethod
+    def _check_window(cfg, s_max: int):
+        if s_max > cfg.max_position_embeddings:
+            raise ValueError(f"s_max={s_max} exceeds "
+                             f"max_position_embeddings="
+                             f"{cfg.max_position_embeddings}")
+
+    def _validate(self, prompt: np.ndarray, max_new_tokens: int):
+        if max_new_tokens < 1:
+            # admission emits one token from the prefill logits, so a
+            # zero-token request cannot match generate(max_new_tokens=0)
+            raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) + max_new_tokens > self.s_max:
             raise ValueError(f"prompt {len(prompt)} + {max_new_tokens} "
                              f"exceeds slot capacity {self.s_max}")
+
+    def submit(self, prompt_ids, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
+        self._validate(prompt, max_new_tokens)
         rid = self._next_rid
         self._next_rid += 1
         self._pending.append(Request(rid, prompt, max_new_tokens))
         return rid
-
-    def _admit(self) -> List[int]:
-        """Move pending requests into free slots (prefill writes the slot's
-        cache rows; one prefill compile per prompt length — callers who
-        need fewer compiles can pad prompts to buckets themselves).
-        Returns rids that finished AT admission (max_new_tokens == 1 or
-        EOS on the prefill token)."""
-        import paddle_tpu as paddle
-        finished = []
-        while self._pending and self._free:
-            req = self._pending.pop(0)
-            slot = self._free.pop(0)
-            ids = paddle.to_tensor(req.prompt[None, :])
-            with paddle.no_grad():
-                logits, cache, _t = self.model.prefill(ids, self.s_max)
-            # write the slot: caches[:, :, slot] = cache[:, :, 0]
-            self._caches[:, :, slot] = cache[:, :, 0]
-            tok = int(self._pick(np.asarray(logits._data)[:, -1])[0])
-            req.slot = slot
-            req.tokens.append(tok)
-            self._slot_req[slot] = req
-            self._t[slot, 0] = len(req.prompt)
-            self._last_tok[slot, 0] = tok
-            if self._maybe_finish(req, tok):
-                finished.append(req.rid)
-        return finished
-
-    def _maybe_finish(self, req: Request, tok: int) -> bool:
-        if (tok == self.eos_id if self.eos_id is not None else False) \
-                or len(req.tokens) >= req.max_new_tokens:
-            slot = req.slot
-            req.slot = None
-            del self._slot_req[slot]
-            self._free.append(slot)
-            self._t[slot, 0] = self.s_max - 1  # park
-            self._finished[req.rid] = req
-            return True
-        return False
 
     def _pick(self, logits_np):
         """Next-token selection (greedy or sampled) on host logits [B, V];
@@ -145,31 +82,20 @@ class ContinuousBatcher:
             logits_np, self._do_sample, self._temperature, self._top_k,
             self._top_p, self._rng)
 
-    # -- the engine ---------------------------------------------------------
-    def step(self) -> List[int]:
-        """Admit, decode one token for every active slot, evict finished.
-        Returns the rids that finished during THIS call (including ones
-        that finished at admission)."""
-        import paddle_tpu as paddle
-        finished = self._admit()
-        if not self._slot_req:
-            return finished
-        tok_t = paddle.to_tensor(self._last_tok)
-        t_t = paddle.to_tensor(self._t)
-        # serving is inference by construction: the batcher supplies the
-        # no_grad scope its donating compiled step requires
-        with paddle.no_grad():
-            logits, self._caches, _ = self._step_fn(tok_t, self._caches,
-                                                    t_t)
-        next_tok = self._pick(np.asarray(logits._data)[:, -1])
-        for slot, req in list(self._slot_req.items()):
-            tok = int(next_tok[slot])
-            self._t[slot, 0] += 1
-            req.tokens.append(tok)
-            self._last_tok[slot, 0] = tok
-            if self._maybe_finish(req, tok):
-                finished.append(req.rid)
-        return finished
+    def _maybe_finish(self, req: Request, tok: int) -> bool:
+        if (tok == self.eos_id if self.eos_id is not None else False) \
+                or len(req.tokens) >= req.max_new_tokens:
+            slot = req.slot
+            req.slot = None
+            req.finished = True
+            del self._slot_req[slot]
+            self._release_slot(slot)
+            self._finished[req.rid] = req
+            return True
+        return False
+
+    def _release_slot(self, slot: int):          # pragma: no cover
+        raise NotImplementedError
 
     def result(self, rid: int) -> np.ndarray:
         """Full sequence (prompt + generated) of a finished request."""
@@ -204,3 +130,347 @@ class ContinuousBatcher:
     @property
     def active(self) -> int:
         return len(self._slot_req)
+
+
+class ContinuousBatcher(_BatcherBase):
+    """Continuous batcher over a causal LM's dense KV cache.
+
+    model: a GPT2ForCausalLM or LlamaForCausalLM (eval mode — any model
+    exposing prefill/decode_step with the [B, 1] t convention). max_batch: slot count (ONE
+    compiled decode executable serves every step at this batch). s_max:
+    per-slot cache rows (prompt + generation must fit). eos_id: optional
+    early-stop token. compile: jit.to_static the decode step (recommended;
+    disable for debugging).
+    """
+
+    def __init__(self, model, max_batch: int = 8, s_max: int = 256,
+                 eos_id: Optional[int] = None, compile: bool = True,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: Optional[float] = None,
+                 seed: Optional[int] = None):
+        import paddle_tpu as paddle
+
+        self.model = model
+        self._do_sample = do_sample
+        self._temperature = temperature
+        self._top_k = top_k
+        self._top_p = top_p
+        self._rng = np.random.RandomState(seed)
+        self.max_batch = max_batch
+        self.s_max = s_max
+        self.eos_id = eos_id
+        cfg = model.config
+        self._check_window(cfg, s_max)
+        L, d = cfg.num_hidden_layers, cfg.head_dim
+        # GQA models cache at kv-head count (unexpanded)
+        kvh = getattr(cfg, "num_key_value_heads", None) \
+            or cfg.num_attention_heads
+        self._caches = paddle.zeros([L, 2, max_batch, kvh, s_max, d],
+                                    dtype=cfg.dtype)
+        self._t = np.full((max_batch, 1), s_max - 1, np.int32)  # parked
+        self._free = list(range(max_batch))
+        self._init_queues()
+        self._last_tok = np.zeros((max_batch, 1), np.int64)
+        if compile:
+            from .. import jit
+            # donate the caches argument (tensor arg index 1): XLA reuses
+            # the cache HBM in place instead of double-buffering per step
+            self._step_fn = jit.to_static(model.decode_step,
+                                          donate_args=(1,))
+        else:
+            self._step_fn = model.decode_step
+
+    # -- request lifecycle --------------------------------------------------
+    def _release_slot(self, slot: int):
+        self._free.append(slot)
+        self._t[slot, 0] = self.s_max - 1  # park
+
+    def _admit(self) -> List[int]:
+        """Move pending requests into free slots (prefill writes the slot's
+        cache rows; one prefill compile per prompt length — callers who
+        need fewer compiles can pad prompts to buckets themselves).
+        Returns rids that finished AT admission (max_new_tokens == 1 or
+        EOS on the prefill token)."""
+        import paddle_tpu as paddle
+        finished = []
+        while self._pending and self._free:
+            req = self._pending.pop(0)
+            slot = self._free.pop(0)
+            ids = paddle.to_tensor(req.prompt[None, :])
+            with paddle.no_grad():
+                logits, cache, _t = self.model.prefill(ids, self.s_max)
+            # write the slot: caches[:, :, slot] = cache[:, :, 0]
+            self._caches[:, :, slot] = cache[:, :, 0]
+            tok = int(self._pick(np.asarray(logits._data)[:, -1])[0])
+            req.slot = slot
+            req.tokens.append(tok)
+            self._slot_req[slot] = req
+            self._t[slot, 0] = len(req.prompt)
+            self._last_tok[slot, 0] = tok
+            if self._maybe_finish(req, tok):
+                finished.append(req.rid)
+        return finished
+
+    # -- the engine ---------------------------------------------------------
+    def step(self) -> List[int]:
+        """Admit, decode one token for every active slot, evict finished.
+        Returns the rids that finished during THIS call (including ones
+        that finished at admission)."""
+        import paddle_tpu as paddle
+        finished = self._admit()
+        if not self._slot_req:
+            return finished
+        tok_t = paddle.to_tensor(self._last_tok)
+        t_t = paddle.to_tensor(self._t)
+        # serving is inference by construction: the batcher supplies the
+        # no_grad scope its donating compiled step requires
+        with paddle.no_grad():
+            logits, self._caches, _ = self._step_fn(tok_t, self._caches,
+                                                    t_t)
+        next_tok = self._pick(np.asarray(logits._data)[:, -1])
+        for slot, req in list(self._slot_req.items()):
+            tok = int(next_tok[slot])
+            self._t[slot, 0] += 1
+            req.tokens.append(tok)
+            self._last_tok[slot, 0] = tok
+            if self._maybe_finish(req, tok):
+                finished.append(req.rid)
+        return finished
+
+
+class PagedContinuousBatcher(_BatcherBase):
+    """Continuous batching over the PAGED (block) KV cache.
+
+    Reference surface: the vLLM-style serving loop the reference builds
+    around block_multihead_attention
+    (incubate/nn/functional/block_multihead_attention.py:19) — cache
+    memory is a pool of physical pages, a block table maps each live
+    sequence's logical blocks onto pool rows, and the scheduler admits/
+    preempts by moving pages, not tensors.
+
+    TPU design: the pool `[n_pages+1, H, bs, D]` per layer and the block
+    table `[max_batch, blocks_per_seq]` both have static shapes, so ONE
+    compiled decode executable serves every step at every occupancy. The
+    host owns the free list; parked slots point every logical block at a
+    reserved SCRATCH page (pool row n_pages) with dec_len 0, so their
+    garbage decode writes land in scratch and never touch a live page.
+
+    policy:
+      * ``"reserve"`` — admission reserves the worst-case page count
+        (ceil((prompt+max_new)/bs)) up front; head-of-line blocks when
+        the pool can't cover it. Deterministic, no preemption.
+      * ``"ondemand"`` — admission reserves only the prompt's pages;
+        growth allocates one page as a sequence crosses each block
+        boundary. On pool exhaustion the most-recently admitted request
+        is PREEMPTED: its pages return to the pool and it re-queues with
+        prompt ⧺ generated-so-far, so a later re-prefill recomputes its
+        state exactly (greedy decode reproduces the same continuation).
+    """
+
+    def __init__(self, model, max_batch: int = 8, s_max: int = 256,
+                 block_size: int = 16, n_pages: Optional[int] = None,
+                 eos_id: Optional[int] = None, compile: bool = True,
+                 policy: str = "reserve",
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: Optional[float] = None,
+                 seed: Optional[int] = None):
+        import paddle_tpu as paddle
+
+        if policy not in ("reserve", "ondemand"):
+            raise ValueError(f"unknown policy {policy!r}")
+        cfg = model.config
+        self._check_window(cfg, s_max)
+        self.model = model
+        self.max_batch = max_batch
+        self.s_max = s_max
+        self.block_size = block_size
+        self.blocks_per_seq = -(-s_max // block_size)
+        if n_pages is None:
+            n_pages = max_batch * self.blocks_per_seq
+        self.n_pages = n_pages
+        self.eos_id = eos_id
+        self.policy = policy
+        self._do_sample = do_sample
+        self._temperature = temperature
+        self._top_k = top_k
+        self._top_p = top_p
+        self._rng = np.random.RandomState(seed)
+
+        self._scratch = n_pages                     # reserved pool row
+        self._free_pages = list(range(n_pages))
+        self._bt = np.full((max_batch, self.blocks_per_seq), self._scratch,
+                           np.int32)
+        self._dec = np.zeros((max_batch,), np.int32)
+        self._free_slots = list(range(max_batch))
+        self._init_queues()
+        self._admit_order: List[int] = []           # slots, oldest first
+        self._last_tok = np.zeros((max_batch,), np.int64)
+
+        pool = model.paged_alloc(n_pages + 1, block_size)
+        self._state = {
+            "layers": pool,
+            "block_tables": paddle.to_tensor(self._bt),
+            "dec_lens": paddle.to_tensor(self._dec),
+            "block_size": block_size,
+            "capacity": self.blocks_per_seq * block_size,
+            "zeros_b": paddle.to_tensor(np.zeros((max_batch,), np.int32)),
+            "ones_b": paddle.to_tensor(np.ones((max_batch,), np.int32)),
+            "cu_b": paddle.to_tensor(np.arange(max_batch + 1,
+                                               dtype=np.int32)),
+        }
+        if compile:
+            from .. import jit
+            # donate the state pytree (arg 1): the page pool is the big
+            # buffer — XLA appends into it in place every step
+            self._step_fn = jit.to_static(model.paged_decode_step,
+                                          donate_args=(1,))
+        else:
+            self._step_fn = model.paged_decode_step
+
+    # -- page accounting ----------------------------------------------------
+    def _pages_for(self, n_rows: int) -> int:
+        return -(-n_rows // self.block_size)
+
+    def _alloc_pages(self, slot: int, upto_row: int) -> bool:
+        """Grow slot's block table so rows [0, upto_row) are backed.
+        Returns False (allocating nothing) if the pool can't cover it."""
+        need_blocks = self._pages_for(upto_row)
+        have = int(np.sum(self._bt[slot] != self._scratch))
+        grow = need_blocks - have
+        if grow <= 0:
+            return True
+        if grow > len(self._free_pages):
+            return False
+        for b in range(have, need_blocks):
+            self._bt[slot, b] = self._free_pages.pop()
+        return True
+
+    def _release_slot(self, slot: int):
+        for b in range(self.blocks_per_seq):
+            if self._bt[slot, b] != self._scratch:
+                self._free_pages.append(int(self._bt[slot, b]))
+                self._bt[slot, b] = self._scratch
+        self._dec[slot] = 0
+        self._free_slots.append(slot)
+        self._admit_order.remove(slot)
+
+    @property
+    def free_page_count(self) -> int:
+        return len(self._free_pages)
+
+    # -- request lifecycle --------------------------------------------------
+    def _validate(self, prompt: np.ndarray, max_new_tokens: int):
+        super()._validate(prompt, max_new_tokens)
+        worst = self._pages_for(len(prompt) + max_new_tokens)
+        if worst > self.n_pages:
+            raise ValueError(f"request needs {worst} pages but the pool "
+                             f"holds {self.n_pages}")
+
+    def _admit(self) -> List[int]:
+        """FIFO admission into free slots, gated by page availability
+        (reserve: worst case up front; ondemand: prompt + first step).
+        Head-of-line blocking is deliberate — it preserves arrival order
+        the way the reference's serving queue does."""
+        import paddle_tpu as paddle
+        finished = []
+        while self._pending and self._free_slots:
+            req = self._pending[0]
+            # a preempted request resumes from prompt ⧺ generated
+            ids_np = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int64)]) \
+                if req.tokens else req.prompt
+            if self.policy == "reserve":
+                need = self._pages_for(len(ids_np) + req.max_new_tokens
+                                       - len(req.tokens))
+            else:
+                need = self._pages_for(len(ids_np) + 1)
+            if need > len(self._free_pages):
+                break
+            self._pending.pop(0)
+            slot = self._free_slots.pop(0)
+            upto = len(ids_np) + (req.max_new_tokens - len(req.tokens)
+                                  if self.policy == "reserve" else 1)
+            if not self._alloc_pages(slot, upto):
+                raise RuntimeError("page accounting bug: admission gate "
+                                   "passed but allocation failed")
+            bt_row = paddle.to_tensor(self._bt[slot:slot + 1])
+            ids = paddle.to_tensor(ids_np[None, :])
+            with paddle.no_grad():
+                logits, self._state["layers"] = self.model.paged_prefill_into(
+                    ids, self._state["layers"], bt_row, self.block_size)
+            tok = int(self._pick(np.asarray(logits._data))[0])
+            req.slot = slot
+            req.tokens.append(tok)
+            self._slot_req[slot] = req
+            self._admit_order.append(slot)
+            self._dec[slot] = len(ids_np)
+            self._last_tok[slot] = tok
+            if self._maybe_finish(req, tok):
+                finished.append(req.rid)
+        return finished
+
+    def _sync_tables(self):
+        import paddle_tpu as paddle
+        self._state["block_tables"] = paddle.to_tensor(self._bt)
+        self._state["dec_lens"] = paddle.to_tensor(self._dec)
+
+    def _preempt_latest(self, protect: int) -> bool:
+        """Evict the most-recently admitted active request (≠ protect) back
+        to the FRONT of the queue; its pages return to the pool. Returns
+        False when no victim exists."""
+        for slot in reversed(self._admit_order):
+            if slot == protect:
+                continue
+            req = self._slot_req.pop(slot)
+            req.slot = None
+            self._release_slot(slot)
+            self._pending.insert(0, req)
+            return True
+        return False
+
+    def _grow_for_step(self):
+        """ondemand: every active slot is about to write kv row dec[slot];
+        back it with a page, preempting if the pool is dry."""
+        for slot in list(self._admit_order):
+            if slot not in self._slot_req:
+                continue
+            while not self._alloc_pages(slot, int(self._dec[slot]) + 1):
+                if not self._preempt_latest(protect=slot):
+                    raise RuntimeError(
+                        f"page pool exhausted: slot {slot} needs a page at "
+                        f"row {int(self._dec[slot])}, no free pages and no "
+                        f"other request to preempt (n_pages={self.n_pages})")
+
+    # -- the engine ---------------------------------------------------------
+    def step(self) -> List[int]:
+        """Admit, grow pages (ondemand), decode one token per active slot,
+        evict finished. Returns rids finishing during THIS call."""
+        import paddle_tpu as paddle
+        finished = self._admit()
+        if not self._slot_req:
+            return finished
+        if self.policy == "ondemand":
+            self._grow_for_step()
+        # the HOST owns the block table and the timeline: re-upload both
+        # every step (two tiny int32 arrays) so parked slots never drift —
+        # the device step increments dec_lens for all B slots, the host
+        # only for active ones
+        self._sync_tables()
+        tok_t = paddle.to_tensor(self._last_tok)
+        with paddle.no_grad():
+            logits, self._state = self._step_fn(tok_t, self._state)
+        self._dec += np.asarray(self._slot_active_mask(), np.int32)
+        next_tok = self._pick(np.asarray(logits._data))
+        for slot, req in list(self._slot_req.items()):
+            tok = int(next_tok[slot])
+            req.tokens.append(tok)
+            self._last_tok[slot] = tok
+            if self._maybe_finish(req, tok):
+                finished.append(req.rid)
+        return finished
+
+    def _slot_active_mask(self):
+        m = np.zeros((self.max_batch,), bool)
+        for slot in self._slot_req:
+            m[slot] = True
+        return m

@@ -243,6 +243,59 @@ class GPT2ForCausalLM(Layer):
 
     # -- paged-KV serving route (vLLM-style block cache) --------------------
 
+    def paged_alloc(self, n_pages, block_size=64):
+        """Allocate the physical KV page pool: per layer, (kc, vc) of
+        [n_pages, H, block_size, D]. Pages are position-free storage —
+        a block table maps (sequence, logical block) -> pool row, so the
+        same pool serves many sequences of different lengths."""
+        import paddle_tpu as paddle
+        cfg = self.config
+        h, d = cfg.num_attention_heads, cfg.head_dim
+        return [(paddle.zeros([n_pages, h, block_size, d], dtype=cfg.dtype),
+                 paddle.zeros([n_pages, h, block_size, d], dtype=cfg.dtype))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def paged_prefill_into(self, input_ids, layers, block_tables,
+                           block_size=64):
+        """Prompt pass writing KV into a CALLER-OWNED page pool.
+
+        input_ids [B, s]; layers: ``paged_alloc`` pool; block_tables
+        [B, blocks_per_seq] int32 rows naming each sequence's pages.
+        Returns (last_logits [B, V], new_layers). This is the admission
+        primitive continuous batchers use: the pool persists across
+        requests, only the named pages are written.
+        """
+        import paddle_tpu as paddle
+        from ..incubate.nn.functional.decode_attention import \
+            block_multihead_attention
+
+        b, s = input_ids.shape
+        bt = block_tables
+        enc = paddle.to_tensor(np.full((b,), s, np.int32))
+        dec = paddle.to_tensor(np.zeros((b,), np.int32))
+        cu_q = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
+
+        # packed-token forward: hidden is [T, E] (sequences concatenated)
+        ids_flat = input_ids.reshape([b * s])
+        pos_flat = paddle.to_tensor(np.tile(np.arange(s, dtype=np.int32), b))
+        hidden = self.transformer.wte(ids_flat) + self.transformer.wpe(
+            pos_flat)
+        hidden = self.transformer.drop(hidden)
+        layers_state = []
+        for blk, (kc, vc) in zip(self.transformer.h, layers):
+            x = blk.ln_1(hidden)
+            qkv = blk.attn.c_attn(x)                     # [T, 3*H*D]
+            out, _, kc, vc = block_multihead_attention(
+                qkv, kc, vc, enc, dec, enc, None, None, cu_q, cu_q, bt,
+                block_size=block_size)
+            hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
+            hidden = hidden + blk.mlp(blk.ln_2(hidden))
+            layers_state.append((kc, vc))
+        hidden = self.transformer.ln_f(hidden)
+        # last token of each sequence
+        last = hidden.reshape([b, s, -1])[:, s - 1]
+        return self._logits(last), layers_state
+
     def paged_prefill(self, input_ids, block_size=64, blocks_per_seq=None):
         """Prompt pass through the paged block cache
         (block_multihead_attention, reference
@@ -255,47 +308,18 @@ class GPT2ForCausalLM(Layer):
         scales with actual lengths and pages are shareable/evictable.
         """
         import paddle_tpu as paddle
-        from .. import ops
-        from ..incubate.nn.functional.decode_attention import \
-            block_multihead_attention
 
         cfg = self.config
         b, s = input_ids.shape
-        h, d = cfg.num_attention_heads, cfg.head_dim
         if blocks_per_seq is None:
             blocks_per_seq = (cfg.max_position_embeddings + block_size - 1) \
                 // block_size
         n_blocks = b * blocks_per_seq
         bt = paddle.to_tensor(
             np.arange(n_blocks, dtype=np.int32).reshape(b, blocks_per_seq))
-        enc = paddle.to_tensor(np.full((b,), s, np.int32))
-        dec = paddle.to_tensor(np.zeros((b,), np.int32))
-        cu_q = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
-
-        # packed-token forward: hidden is [T, E] (sequences concatenated)
-        ids_flat = input_ids.reshape([b * s])
-        pos_flat = paddle.to_tensor(np.tile(np.arange(s, dtype=np.int32), b))
-        hidden = self.transformer.wte(ids_flat) + self.transformer.wpe(
-            pos_flat)
-        hidden = self.transformer.drop(hidden)
-        layers_state = []
-        for blk in self.transformer.h:
-            kc = paddle.zeros([n_blocks, h, block_size, d],
-                              dtype=cfg.dtype)
-            vc = paddle.zeros([n_blocks, h, block_size, d],
-                              dtype=cfg.dtype)
-            x = blk.ln_1(hidden)
-            qkv = blk.attn.c_attn(x)                     # [T, 3*H*D]
-            out, _, kc, vc = block_multihead_attention(
-                qkv, kc, vc, enc, dec, enc, None, None, cu_q, cu_q, bt,
-                block_size=block_size)
-            hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
-            hidden = hidden + blk.mlp(blk.ln_2(hidden))
-            layers_state.append((kc, vc))
-        hidden = self.transformer.ln_f(hidden)
-        # last token of each sequence
-        last = hidden.reshape([b, s, -1])[:, s - 1]
-        logits = self._logits(last)
+        layers = self.paged_alloc(n_blocks, block_size)
+        logits, layers_state = self.paged_prefill_into(
+            input_ids, layers, bt, block_size)
         state = {"layers": layers_state, "block_tables": bt,
                  "dec_lens": paddle.to_tensor(np.full((b,), s, np.int32)),
                  "block_size": block_size,
@@ -476,8 +500,9 @@ class GPT2ForCausalLM(Layer):
         b, s = input_ids.shape
         w = num_beams
         ids_np = np.asarray(input_ids._data)
-        expanded = paddle.to_tensor(np.repeat(ids_np, w, axis=0))
-        logits, caches, t = prefill_fn(expanded)
+        # prefill ONCE at batch B, then fan the caches out to B*W rows —
+        # the W beams of a batch share the prompt's KV exactly
+        logits, caches, t = prefill_fn(input_ids)
 
         def logprobs(lg):
             x = np.asarray(lg._data)[:, -1].astype(np.float64)
@@ -485,10 +510,15 @@ class GPT2ForCausalLM(Layer):
             return x - np.log(np.exp(x).sum(-1, keepdims=True))
 
         v = logits.shape[-1]
+        if w > v:
+            raise ValueError(f"num_beams={w} exceeds vocab_size={v}: the "
+                             f"seed step cannot pick {w} distinct tokens")
+        rep = paddle.to_tensor(np.repeat(np.arange(b, dtype=np.int64), w))
+        caches = ops.index_select(caches, rep, axis=2)
+        t = ops.index_select(t, rep, axis=0)
         # seed: the W beams of each batch start DISTINCT (top-W tokens of
-        # the prompt's next-token distribution; all W rows of a batch hold
-        # identical prompt logits, so read row 0 of each group)
-        lp0 = logprobs(logits)[::w]                       # [B, V]
+        # the prompt's next-token distribution)
+        lp0 = logprobs(logits)                            # [B, V]
         top0 = np.argsort(-lp0, axis=-1)[:, :w]           # [B, W]
         beam_scores = np.take_along_axis(lp0, top0, -1)   # [B, W]
         beam_tokens = [top0.reshape(b * w, 1)]            # list of [BW, 1]
