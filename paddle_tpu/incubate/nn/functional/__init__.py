@@ -8,7 +8,8 @@ Pallas kernels; ring attention fills the reference's context-parallel gap
 from paddle_tpu.nn.functional import flash_attention
 from paddle_tpu.ops.ring_attention import ring_attention
 
-from .decode_attention import (block_multihead_attention,
+from .decode_attention import (block_gqa_attention,
+                               block_multihead_attention,
                                masked_multihead_attention,
                                variable_length_memory_efficient_attention)
 from .fused_ops import (fused_dot_product_attention, fused_dropout_add,
@@ -122,4 +123,5 @@ __all__ = ["flash_attention", "ring_attention",
            "fused_linear_activation", "fused_layer_norm", "fused_rms_norm",
            "fused_dot_product_attention", "fused_ec_moe",
            "masked_multihead_attention", "block_multihead_attention",
+           "block_gqa_attention",
            "variable_length_memory_efficient_attention"]

@@ -218,6 +218,90 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
             Tensor(kc), Tensor(vc))
 
 
+def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
+                        seq_lens_decoder, seq_lens_this_time, cu_seqlens_q,
+                        block_tables, block_size=64, rope_cos=None,
+                        rope_sin=None):
+    """Paged-KV attention with UNEXPANDED grouped-query heads (the GQA
+    sibling of block_multihead_attention; reference analog:
+    block_multihead_attention.py:19 serving Llama-family models, where
+    the CUDA kernel reads kv heads grouped).
+
+    q: [T, H, D]; k/v: [T, KV, D] — packed unpadded tokens, sequence
+    boundaries in cu_seqlens_q. key_cache/value_cache:
+    [n_pages, KV, block_size, D]. block_tables: [B, blocks_per_seq].
+    Per sequence: prefill when seq_lens_encoder[i] > 0, decode (append at
+    seq_lens_decoder[i]) when seq_lens_this_time[i] == 1.
+
+    rope_cos/rope_sin: optional [S, D/2] tables — when given, q and k are
+    rotated (interleaved-pair convention, fp32) at each token's timeline
+    position BEFORE the cache write, so prefill and decode share one RoPE
+    rule. The grouped einsums keep kv heads unexpanded: [T, KV, rep, D]
+    against the gathered [T, KV, S_kv, D] timeline, which is both the
+    memory win of GQA and an MXU-friendly batched matmul.
+
+    Returns (out [T, H*D], key_cache_out, value_cache_out).
+    """
+    qt, kt, vt = _arr(q), _arr(k), _arr(v)
+    kc, vc = _arr(key_cache), _arr(value_cache)
+    enc = _arr(seq_lens_encoder).reshape(-1).astype(jnp.int32)
+    dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
+    this = _arr(seq_lens_this_time).reshape(-1).astype(jnp.int32)
+    cu_q = _arr(cu_seqlens_q).reshape(-1).astype(jnp.int32)
+    bt = _arr(block_tables).astype(jnp.int32)
+    bsz, blocks_per_seq = bt.shape
+    kvh, bs_, hd = kc.shape[1], kc.shape[2], kc.shape[3]
+    token_num, nh, _ = qt.shape
+    rep = nh // kvh
+
+    # token -> (sequence, position-in-kv-timeline)
+    tok = jnp.arange(token_num)
+    seq_of = jnp.searchsorted(cu_q, tok, side="right") - 1     # [T]
+    local = tok - cu_q[seq_of]
+    pos = dec[seq_of] + local                                  # kv row
+
+    if rope_cos is not None:
+        cos_t = _arr(rope_cos)[pos].astype(jnp.float32)        # [T, D/2]
+        sin_t = _arr(rope_sin)[pos].astype(jnp.float32)
+
+        def _rope(u):
+            uf = u.astype(jnp.float32)
+            u1, u2 = uf[..., 0::2], uf[..., 1::2]
+            c, s = cos_t[:, None, :], sin_t[:, None, :]
+            return jnp.stack([u1 * c - u2 * s, u2 * c + u1 * s],
+                             axis=-1).reshape(u.shape).astype(u.dtype)
+        qt, kt = _rope(qt), _rope(kt)
+
+    # scatter k/v into the paged cache at (bt[seq, pos//bs], pos%bs)
+    phys = bt[seq_of, pos // bs_]
+    off = pos % bs_
+    kc = kc.at[phys, :, off].set(kt.astype(kc.dtype))
+    vc = vc.at[phys, :, off].set(vt.astype(vc.dtype))
+
+    # gather each sequence's full kv timeline [B, KV, S_kv, D]
+    kv_len = jnp.where(enc > 0, enc, dec + this)
+    s_kv = blocks_per_seq * bs_
+    gk = kc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, kvh, bs_, hd)
+    gv = vc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, kvh, bs_, hd)
+    gk = jnp.moveaxis(gk, 2, 1).reshape(bsz, kvh, s_kv, hd)
+    gv = jnp.moveaxis(gv, 2, 1).reshape(bsz, kvh, s_kv, hd)
+
+    # grouped scores: q regrouped [T, KV, rep, D] vs timeline [T, KV, S, D]
+    qg = qt.reshape(token_num, kvh, rep, hd).astype(jnp.float32)
+    scale = 1.0 / float(hd) ** 0.5
+    scores = jnp.einsum("tgrd,tgsd->tgrs", qg,
+                        gk[seq_of].astype(jnp.float32)) * scale
+    kv_pos = jnp.arange(s_kv)[None, None, None, :]
+    ok = (kv_pos <= pos[:, None, None, None]) \
+        & (kv_pos < kv_len[seq_of][:, None, None, None])
+    scores = jnp.where(ok, scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tgrs,tgsd->tgrd", probs,
+                     gv[seq_of].astype(jnp.float32))
+    return (Tensor(out.reshape(token_num, nh * hd).astype(qt.dtype)),
+            Tensor(kc), Tensor(vc))
+
+
 def variable_length_memory_efficient_attention(query, key, value, seq_lens,
                                                kv_seq_lens, mask=None,
                                                scale=None, causal=False,
@@ -253,4 +337,5 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
 
 
 __all__ = ["masked_multihead_attention", "block_multihead_attention",
+           "block_gqa_attention",
            "variable_length_memory_efficient_attention"]

@@ -758,6 +758,168 @@ class LlamaForCausalLM(Layer):
             lambda: self.prefill(input_ids, s_max), step, input_ids,
             max_new_tokens, do_sample, temperature, top_k, top_p, seed)
 
+    # -- paged-KV serving route (vLLM-style block cache, GQA-native) --------
+
+    def _check_paged_servable(self):
+        if self.config.scan_layers:
+            raise ValueError("paged decode needs the unrolled stack: build "
+                             "the model with scan_layers=False for serving")
+        if self.config.sep_mesh is not None:
+            raise ValueError("paged decode is mesh-free: clear "
+                             "config.sep_mesh for serving")
+
+    def paged_alloc(self, n_pages, block_size=64):
+        """Physical KV page pool: per layer, (kc, vc) of
+        [n_pages, KV, block_size, D] — GQA caches at kv-head count
+        (unexpanded), so the pool is H/KV times smaller than an
+        MHA-equivalent one."""
+        import paddle_tpu as paddle
+        cfg = self.config
+        kvh, d = cfg.num_key_value_heads, cfg.head_dim
+        return [(paddle.zeros([n_pages, kvh, block_size, d],
+                              dtype=cfg.dtype),
+                 paddle.zeros([n_pages, kvh, block_size, d],
+                              dtype=cfg.dtype))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def paged_prefill_into(self, input_ids, layers, block_tables,
+                           block_size=64):
+        """Prompt pass writing post-RoPE K / raw V into a CALLER-OWNED page
+        pool (block_gqa_attention in encoder mode). input_ids [B, s];
+        block_tables [B, blocks_per_seq]. Returns (last_logits [B, V],
+        new_layers) — the admission primitive for PagedContinuousBatcher.
+        """
+        import paddle_tpu as paddle
+        from ..incubate.nn.functional.decode_attention import \
+            block_gqa_attention
+
+        self._check_paged_servable()
+        cfg = self.config
+        b, s = input_ids.shape
+        h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        enc = paddle.to_tensor(np.full((b,), s, np.int32))
+        dec = paddle.to_tensor(np.zeros((b,), np.int32))
+        cu_q = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
+        model = self.model
+        cos_tab, sin_tab = model._cos, model._sin
+
+        hidden = model.embed_tokens(input_ids)         # [B, s, E]
+        layers_state = []
+        for layer, (kc, vc) in zip(model.layers, layers):
+            attn = layer.self_attn
+            x = layer.input_layernorm(hidden)
+            q = attn.q_proj(x).reshape([b * s, h, d])
+            k = attn.k_proj(x).reshape([b * s, kvh, d])
+            v = attn.v_proj(x).reshape([b * s, kvh, d])
+            out, kc, vc = block_gqa_attention(
+                q, k, v, kc, vc, enc, dec, enc, cu_q, block_tables,
+                block_size=block_size, rope_cos=Tensor(cos_tab),
+                rope_sin=Tensor(sin_tab))
+            hidden = hidden + attn.o_proj(out.reshape([b, s, h * d]))
+            hidden = hidden + layer.mlp(
+                layer.post_attention_layernorm(hidden))
+            layers_state.append((kc, vc))
+        hidden = model.norm(hidden)
+        return self._lm_logits(hidden[:, s - 1]), layers_state
+
+    def paged_prefill(self, input_ids, block_size=64, blocks_per_seq=None):
+        """Prompt pass through a freshly allocated paged cache. Returns
+        (last_logits [B, V], state dict) in the shared paged-state
+        convention (same keys as the GPT-2 route, so one batcher and one
+        compiled-step recipe serve both families)."""
+        import paddle_tpu as paddle
+        cfg = self.config
+        b, s = input_ids.shape
+        if blocks_per_seq is None:
+            blocks_per_seq = (cfg.max_position_embeddings + block_size - 1) \
+                // block_size
+        n_blocks = b * blocks_per_seq
+        bt = paddle.to_tensor(
+            np.arange(n_blocks, dtype=np.int32).reshape(b, blocks_per_seq))
+        layers = self.paged_alloc(n_blocks, block_size)
+        logits, layers_state = self.paged_prefill_into(
+            input_ids, layers, bt, block_size)
+        state = {"layers": layers_state, "block_tables": bt,
+                 "dec_lens": paddle.to_tensor(np.full((b,), s, np.int32)),
+                 "block_size": block_size,
+                 "capacity": blocks_per_seq * block_size,
+                 "zeros_b": paddle.to_tensor(np.zeros((b,), np.int32)),
+                 "ones_b": paddle.to_tensor(np.ones((b,), np.int32)),
+                 "cu_b": paddle.to_tensor(np.arange(b + 1, dtype=np.int32))}
+        return logits, state
+
+    def paged_decode_step(self, tok, state):
+        """One token per sequence through the paged GQA cache. tok: [B].
+        Static shapes — ``jit.to_static(model.paged_decode_step)`` serves
+        every step with one executable."""
+        from ..incubate.nn.functional.decode_attention import \
+            block_gqa_attention
+
+        self._check_paged_servable()
+        cfg = self.config
+        b = tok.shape[0]
+        h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        t = state["dec_lens"]
+        bt = state["block_tables"]
+        enc, this, cu_q = state["zeros_b"], state["ones_b"], state["cu_b"]
+        model = self.model
+        cos_tab, sin_tab = model._cos, model._sin
+
+        hidden = model.embed_tokens(tok.reshape([b, 1]))   # [B, 1, E]
+        new_layers = []
+        for layer, (kc, vc) in zip(model.layers, state["layers"]):
+            attn = layer.self_attn
+            x = layer.input_layernorm(hidden)
+            q = attn.q_proj(x).reshape([b, h, d])
+            k = attn.k_proj(x).reshape([b, kvh, d])
+            v = attn.v_proj(x).reshape([b, kvh, d])
+            out, kc, vc = block_gqa_attention(
+                q, k, v, kc, vc, enc, t, this, cu_q, bt,
+                block_size=state["block_size"], rope_cos=Tensor(cos_tab),
+                rope_sin=Tensor(sin_tab))
+            hidden = hidden + attn.o_proj(out.reshape([b, 1, h * d]))
+            hidden = hidden + layer.mlp(
+                layer.post_attention_layernorm(hidden))
+            new_layers.append((kc, vc))
+        hidden = model.norm(hidden)
+        logits = self._lm_logits(hidden[:, 0])             # [B, V]
+        new_state = dict(state, layers=new_layers, dec_lens=t + 1)
+        return logits, new_state
+
+    def generate_paged(self, input_ids, max_new_tokens, block_size=64,
+                       blocks_per_seq=None, decode_fn=None):
+        """Greedy decode over the paged GQA cache (mirrors the GPT-2
+        route; reference surface block_multihead_attention + the serving
+        predictor)."""
+        from .. import ops
+        b, s = input_ids.shape
+        needed = s + max_new_tokens
+        if needed > self.config.max_position_embeddings:
+            raise ValueError(
+                f"prompt {s} + {max_new_tokens} new tokens exceeds "
+                f"max_position_embeddings="
+                f"{self.config.max_position_embeddings}")
+        if blocks_per_seq is None:
+            blocks_per_seq = (needed + block_size - 1) // block_size
+        elif needed > blocks_per_seq * block_size:
+            raise ValueError(
+                f"paged cache capacity {blocks_per_seq * block_size} too "
+                f"small for prompt {s} + {max_new_tokens} new tokens")
+        logits, state = self.paged_prefill(input_ids, block_size,
+                                           blocks_per_seq)
+        step = decode_fn if decode_fn is not None else self.paged_decode_step
+        toks = [input_ids]
+        tok = ops.argmax(logits, axis=-1).reshape([b])
+        for i in range(max_new_tokens):
+            toks.append(tok.reshape([b, 1]))
+            if i + 1 == max_new_tokens:
+                break
+            logits, state = step(tok.astype(input_ids.dtype), state)
+            tok = ops.argmax(logits, axis=-1).reshape([b])
+        return ops.concat([x.astype("int64") for x in toks], axis=1)
+
     def generate_beam(self, input_ids, max_new_tokens, num_beams=4,
                       s_max=None, decode_fn=None, length_penalty=0.0):
         """Beam search over the GQA KV cache (shared driver with GPT-2)."""
