@@ -33,6 +33,39 @@ def _arr(x):
 _NEG = -1e9
 
 
+# -- shared paged-cache machinery (used by both the MHA and GQA routes) ----
+
+def _token_timeline(cu_q, dec, token_num):
+    """Map packed-token index -> (sequence, local offset, kv-timeline row).
+    Decode appends after the existing prefix (dec), prefill starts at 0
+    (dec is 0 in encoder mode)."""
+    tok = jnp.arange(token_num)
+    seq_of = jnp.searchsorted(cu_q, tok, side="right") - 1     # [T]
+    local = tok - cu_q[seq_of]
+    pos = dec[seq_of] + local
+    return seq_of, local, pos
+
+
+def _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, block_size):
+    """Write each token's k/v row at (block_tables[seq, pos//bs], pos%bs)."""
+    phys = bt[seq_of, pos // block_size]
+    off = pos % block_size
+    return (kc.at[phys, :, off].set(kt.astype(kc.dtype)),
+            vc.at[phys, :, off].set(vt.astype(vc.dtype)))
+
+
+def _gather_paged(kc, vc, bt, heads):
+    """Assemble every sequence's kv timeline from its pages:
+    [B, heads, blocks_per_seq*block_size, D]."""
+    bsz, blocks_per_seq = bt.shape
+    bs_, hd = kc.shape[2], kc.shape[3]
+    s_kv = blocks_per_seq * bs_
+    gk = kc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, heads, bs_, hd)
+    gv = vc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, heads, bs_, hd)
+    return (jnp.moveaxis(gk, 2, 1).reshape(bsz, heads, s_kv, hd),
+            jnp.moveaxis(gv, 2, 1).reshape(bsz, heads, s_kv, hd), s_kv)
+
+
 def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                                cum_offsets=None, sequence_lengths=None,
                                rotary_tensor=None, beam_cache_offset=None,
@@ -150,12 +183,7 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         qkv3 = qkv3 + _arr(qkv_bias).reshape(1, 3, nh, hd)
     qt, kt, vt = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]    # [T, H, D]
 
-    # token -> (sequence, position-in-kv-timeline)
-    tok = jnp.arange(token_num)
-    seq_of = jnp.searchsorted(cu_q, tok, side="right") - 1     # [T]
-    local = tok - cu_q[seq_of]                                 # pos in this call
-    start = dec[seq_of]          # decode appends after the existing prefix
-    pos = start + local                                        # kv row
+    seq_of, local, pos = _token_timeline(cu_q, dec, token_num)
     if rope_emb is not None:
         # rope_emb [2, B, 1, S, D/...]: cos at [0], sin at [1]
         re = _arr(rope_emb)
@@ -177,19 +205,9 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                              axis=-1).reshape(u.shape).astype(u.dtype)
         qt, kt = _rope(qt), _rope(kt)
 
-    # scatter k/v into the paged cache at (block_tables[seq, pos//bs], pos%bs)
-    phys = bt[seq_of, pos // bs_]                              # [T]
-    off = pos % bs_
-    kc = kc.at[phys, :, off].set(kt)
-    vc = vc.at[phys, :, off].set(vt)
-
-    # gather each sequence's full kv timeline [B, H, S_kv, D]
+    kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_)
     kv_len = jnp.where(enc > 0, enc, dec + this)               # [B]
-    s_kv = blocks_per_seq * bs_
-    gk = kc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, nh, bs_, hd)
-    gv = vc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, nh, bs_, hd)
-    gk = jnp.moveaxis(gk, 2, 1).reshape(bsz, nh, s_kv, hd)
-    gv = jnp.moveaxis(gv, 2, 1).reshape(bsz, nh, s_kv, hd)
+    gk, gv, s_kv = _gather_paged(kc, vc, bt, nh)
 
     # dense scores per token over its sequence's timeline
     scores = jnp.einsum("thd,tshd->ths", qt,
@@ -254,11 +272,7 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
     token_num, nh, _ = qt.shape
     rep = nh // kvh
 
-    # token -> (sequence, position-in-kv-timeline)
-    tok = jnp.arange(token_num)
-    seq_of = jnp.searchsorted(cu_q, tok, side="right") - 1     # [T]
-    local = tok - cu_q[seq_of]
-    pos = dec[seq_of] + local                                  # kv row
+    seq_of, _local, pos = _token_timeline(cu_q, dec, token_num)
 
     if rope_cos is not None:
         cos_t = _arr(rope_cos)[pos].astype(jnp.float32)        # [T, D/2]
@@ -272,19 +286,9 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
                              axis=-1).reshape(u.shape).astype(u.dtype)
         qt, kt = _rope(qt), _rope(kt)
 
-    # scatter k/v into the paged cache at (bt[seq, pos//bs], pos%bs)
-    phys = bt[seq_of, pos // bs_]
-    off = pos % bs_
-    kc = kc.at[phys, :, off].set(kt.astype(kc.dtype))
-    vc = vc.at[phys, :, off].set(vt.astype(vc.dtype))
-
-    # gather each sequence's full kv timeline [B, KV, S_kv, D]
+    kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_)
     kv_len = jnp.where(enc > 0, enc, dec + this)
-    s_kv = blocks_per_seq * bs_
-    gk = kc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, kvh, bs_, hd)
-    gv = vc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, kvh, bs_, hd)
-    gk = jnp.moveaxis(gk, 2, 1).reshape(bsz, kvh, s_kv, hd)
-    gv = jnp.moveaxis(gv, 2, 1).reshape(bsz, kvh, s_kv, hd)
+    gk, gv, s_kv = _gather_paged(kc, vc, bt, kvh)
 
     # grouped scores: q regrouped [T, KV, rep, D] vs timeline [T, KV, S, D]
     qg = qt.reshape(token_num, kvh, rep, hd).astype(jnp.float32)

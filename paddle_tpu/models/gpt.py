@@ -296,6 +296,77 @@ class GPT2ForCausalLM(Layer):
         last = hidden.reshape([b, s, -1])[:, s - 1]
         return self._logits(last), layers_state
 
+    @staticmethod
+    def _paged_state(layers_state, bt, b, s, block_size, blocks_per_seq):
+        """The SHARED paged-decode state convention (GPT-2 and Llama build
+        identical dicts, so one batcher / one compiled-step recipe serves
+        both families)."""
+        import paddle_tpu as paddle
+        return {"layers": layers_state, "block_tables": bt,
+                "dec_lens": paddle.to_tensor(np.full((b,), s, np.int32)),
+                "block_size": block_size,
+                "capacity": blocks_per_seq * block_size,
+                # per-step constants (batch-size-only): built once, not on
+                # the hot decode path
+                "zeros_b": paddle.to_tensor(np.zeros((b,), np.int32)),
+                "ones_b": paddle.to_tensor(np.ones((b,), np.int32)),
+                "cu_b": paddle.to_tensor(np.arange(b + 1, dtype=np.int32))}
+
+    @staticmethod
+    def _paged_prefill_impl(model, input_ids, block_size, blocks_per_seq):
+        """Shared fresh-pool prefill: allocate pages, identity block table,
+        run the model's pool-writing prefill, wrap the state dict."""
+        import paddle_tpu as paddle
+        cfg = model.config
+        b, s = input_ids.shape
+        if blocks_per_seq is None:
+            blocks_per_seq = (cfg.max_position_embeddings + block_size - 1) \
+                // block_size
+        n_blocks = b * blocks_per_seq
+        bt = paddle.to_tensor(
+            np.arange(n_blocks, dtype=np.int32).reshape(b, blocks_per_seq))
+        layers = model.paged_alloc(n_blocks, block_size)
+        logits, layers_state = model.paged_prefill_into(
+            input_ids, layers, bt, block_size)
+        return logits, GPT2ForCausalLM._paged_state(
+            layers_state, bt, b, s, block_size, blocks_per_seq)
+
+    @staticmethod
+    def _paged_generate_loop(model, input_ids, max_new_tokens, block_size,
+                             blocks_per_seq, decode_fn):
+        """Shared greedy paged-decode driver (capacity validation + the
+        prefill/step loop), parameterized the way _generate_loop and
+        _beam_loop are."""
+        from .. import ops
+        b, s = input_ids.shape
+        needed = s + max_new_tokens
+        if needed > model.config.max_position_embeddings:
+            # silent-clip hazard: position tables and the block table would
+            # both clip-index and corrupt live pages
+            raise ValueError(
+                f"prompt {s} + {max_new_tokens} new tokens exceeds "
+                f"max_position_embeddings="
+                f"{model.config.max_position_embeddings}")
+        if blocks_per_seq is None:
+            # size the page pool to the actual timeline, not the model max
+            blocks_per_seq = (needed + block_size - 1) // block_size
+        elif needed > blocks_per_seq * block_size:
+            raise ValueError(
+                f"paged cache capacity {blocks_per_seq * block_size} too "
+                f"small for prompt {s} + {max_new_tokens} new tokens")
+        logits, state = model.paged_prefill(input_ids, block_size,
+                                            blocks_per_seq)
+        step = decode_fn if decode_fn is not None else model.paged_decode_step
+        toks = [input_ids]
+        tok = ops.argmax(logits, axis=-1).reshape([b])
+        for i in range(max_new_tokens):
+            toks.append(tok.reshape([b, 1]))
+            if i + 1 == max_new_tokens:
+                break
+            logits, state = step(tok.astype(input_ids.dtype), state)
+            tok = ops.argmax(logits, axis=-1).reshape([b])
+        return ops.concat([x.astype("int64") for x in toks], axis=1)
+
     def paged_prefill(self, input_ids, block_size=64, blocks_per_seq=None):
         """Prompt pass through the paged block cache
         (block_multihead_attention, reference
@@ -307,29 +378,8 @@ class GPT2ForCausalLM(Layer):
         pages instead of one dense [B, S_max] strip, so cache memory
         scales with actual lengths and pages are shareable/evictable.
         """
-        import paddle_tpu as paddle
-
-        cfg = self.config
-        b, s = input_ids.shape
-        if blocks_per_seq is None:
-            blocks_per_seq = (cfg.max_position_embeddings + block_size - 1) \
-                // block_size
-        n_blocks = b * blocks_per_seq
-        bt = paddle.to_tensor(
-            np.arange(n_blocks, dtype=np.int32).reshape(b, blocks_per_seq))
-        layers = self.paged_alloc(n_blocks, block_size)
-        logits, layers_state = self.paged_prefill_into(
-            input_ids, layers, bt, block_size)
-        state = {"layers": layers_state, "block_tables": bt,
-                 "dec_lens": paddle.to_tensor(np.full((b,), s, np.int32)),
-                 "block_size": block_size,
-                 "capacity": blocks_per_seq * block_size,
-                 # per-step constants (batch-size-only): built once, not on
-                 # the hot decode path
-                 "zeros_b": paddle.to_tensor(np.zeros((b,), np.int32)),
-                 "ones_b": paddle.to_tensor(np.ones((b,), np.int32)),
-                 "cu_b": paddle.to_tensor(np.arange(b + 1, dtype=np.int32))}
-        return logits, state
+        return self._paged_prefill_impl(self, input_ids, block_size,
+                                        blocks_per_seq)
 
     def paged_decode_step(self, tok, state):
         """One token per sequence through the paged cache (decode mode:
@@ -369,35 +419,9 @@ class GPT2ForCausalLM(Layer):
         decode_fn: optionally ``jit.to_static(model.paged_decode_step)`` —
         the state pytree has static shapes, so one executable serves every
         step here too."""
-        from .. import ops
-        b, s = input_ids.shape
-        needed = s + max_new_tokens
-        if needed > self.config.max_position_embeddings:
-            # same silent-clip hazard as the dense route: wpe and the block
-            # table would both clip-index and corrupt live pages
-            raise ValueError(
-                f"prompt {s} + {max_new_tokens} new tokens exceeds "
-                f"max_position_embeddings="
-                f"{self.config.max_position_embeddings}")
-        if blocks_per_seq is None:
-            # size the page pool to the actual timeline, not the model max
-            blocks_per_seq = (needed + block_size - 1) // block_size
-        elif needed > blocks_per_seq * block_size:
-            raise ValueError(
-                f"paged cache capacity {blocks_per_seq * block_size} too "
-                f"small for prompt {s} + {max_new_tokens} new tokens")
-        logits, state = self.paged_prefill(input_ids, block_size,
-                                           blocks_per_seq)
-        step = decode_fn if decode_fn is not None else self.paged_decode_step
-        toks = [input_ids]
-        tok = ops.argmax(logits, axis=-1).reshape([b])
-        for i in range(max_new_tokens):
-            toks.append(tok.reshape([b, 1]))
-            if i + 1 == max_new_tokens:
-                break
-            logits, state = step(tok.astype(input_ids.dtype), state)
-            tok = ops.argmax(logits, axis=-1).reshape([b])
-        return ops.concat([x.astype("int64") for x in toks], axis=1)
+        return self._paged_generate_loop(self, input_ids, max_new_tokens,
+                                         block_size, blocks_per_seq,
+                                         decode_fn)
 
     @staticmethod
     def _select_token(logits_np, do_sample, temperature, top_k, top_p, rng):

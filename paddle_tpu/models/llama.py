@@ -828,26 +828,10 @@ class LlamaForCausalLM(Layer):
         (last_logits [B, V], state dict) in the shared paged-state
         convention (same keys as the GPT-2 route, so one batcher and one
         compiled-step recipe serve both families)."""
-        import paddle_tpu as paddle
-        cfg = self.config
-        b, s = input_ids.shape
-        if blocks_per_seq is None:
-            blocks_per_seq = (cfg.max_position_embeddings + block_size - 1) \
-                // block_size
-        n_blocks = b * blocks_per_seq
-        bt = paddle.to_tensor(
-            np.arange(n_blocks, dtype=np.int32).reshape(b, blocks_per_seq))
-        layers = self.paged_alloc(n_blocks, block_size)
-        logits, layers_state = self.paged_prefill_into(
-            input_ids, layers, bt, block_size)
-        state = {"layers": layers_state, "block_tables": bt,
-                 "dec_lens": paddle.to_tensor(np.full((b,), s, np.int32)),
-                 "block_size": block_size,
-                 "capacity": blocks_per_seq * block_size,
-                 "zeros_b": paddle.to_tensor(np.zeros((b,), np.int32)),
-                 "ones_b": paddle.to_tensor(np.ones((b,), np.int32)),
-                 "cu_b": paddle.to_tensor(np.arange(b + 1, dtype=np.int32))}
-        return logits, state
+        from .gpt import GPT2ForCausalLM
+        return GPT2ForCausalLM._paged_prefill_impl(self, input_ids,
+                                                   block_size,
+                                                   blocks_per_seq)
 
     def paged_decode_step(self, tok, state):
         """One token per sequence through the paged GQA cache. tok: [B].
@@ -890,35 +874,13 @@ class LlamaForCausalLM(Layer):
 
     def generate_paged(self, input_ids, max_new_tokens, block_size=64,
                        blocks_per_seq=None, decode_fn=None):
-        """Greedy decode over the paged GQA cache (mirrors the GPT-2
-        route; reference surface block_multihead_attention + the serving
+        """Greedy decode over the paged GQA cache (shared driver with
+        GPT-2; reference surface block_multihead_attention + the serving
         predictor)."""
-        from .. import ops
-        b, s = input_ids.shape
-        needed = s + max_new_tokens
-        if needed > self.config.max_position_embeddings:
-            raise ValueError(
-                f"prompt {s} + {max_new_tokens} new tokens exceeds "
-                f"max_position_embeddings="
-                f"{self.config.max_position_embeddings}")
-        if blocks_per_seq is None:
-            blocks_per_seq = (needed + block_size - 1) // block_size
-        elif needed > blocks_per_seq * block_size:
-            raise ValueError(
-                f"paged cache capacity {blocks_per_seq * block_size} too "
-                f"small for prompt {s} + {max_new_tokens} new tokens")
-        logits, state = self.paged_prefill(input_ids, block_size,
-                                           blocks_per_seq)
-        step = decode_fn if decode_fn is not None else self.paged_decode_step
-        toks = [input_ids]
-        tok = ops.argmax(logits, axis=-1).reshape([b])
-        for i in range(max_new_tokens):
-            toks.append(tok.reshape([b, 1]))
-            if i + 1 == max_new_tokens:
-                break
-            logits, state = step(tok.astype(input_ids.dtype), state)
-            tok = ops.argmax(logits, axis=-1).reshape([b])
-        return ops.concat([x.astype("int64") for x in toks], axis=1)
+        from .gpt import GPT2ForCausalLM
+        return GPT2ForCausalLM._paged_generate_loop(
+            self, input_ids, max_new_tokens, block_size, blocks_per_seq,
+            decode_fn)
 
     def generate_beam(self, input_ids, max_new_tokens, num_beams=4,
                       s_max=None, decode_fn=None, length_penalty=0.0):

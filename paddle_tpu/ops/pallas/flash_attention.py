@@ -49,16 +49,37 @@ def _iota(shape, dim):
     return jax.lax.broadcasted_iota(jnp.int32, shape, dim)
 
 
-def _keep_mask(seed_ref, b, qi, ki, q_start, k_start, shape, dropout_p,
-               tpu_prng):
+def _idiv(a, b):
+    """Truncating integer division for index maps and kernel scalars.
+
+    Python ``//`` on a traced i32 lowers to floor-division's sign-correction
+    graph (sign/rem/select wrapped in a closed_call), which the Mosaic
+    scalar core rejects; every quantity here is nonnegative, so truncating
+    ``lax.div`` is exact and lowers to one scalar op."""
+    if hasattr(a, "dtype"):
+        return jax.lax.div(a, jnp.int32(b))
+    return a // b
+
+
+def _imod(a, b):
+    if hasattr(a, "dtype"):
+        return jax.lax.rem(a, jnp.int32(b))
+    return a % b
+
+
+def _keep_mask(seed_ref, b, qi, ki, nq, nk, q_start, k_start, shape,
+               dropout_p, tpu_prng):
     """Deterministic keep mask: the bwd kernels regenerate it bit-exactly.
 
-    TPU compile path: the hardware PRNG seeded with (seed, head, q-tile,
-    k-tile). Interpret path (no prng_seed lowering on CPU): a counter-based
-    murmur3-finalizer hash of the ABSOLUTE (row, col) position, so any tile
-    decomposition reproduces the same mask."""
+    TPU compile path: the hardware PRNG seeded with (seed, tile) where tile
+    linearizes (head, q-tile, k-tile) — libtpu's prng_set_seed accepts at
+    most TWO seed values, so the coordinates fold into one index that the
+    forward and both backward kernels compute identically. Interpret path
+    (no prng_seed lowering on CPU): a counter-based murmur3-finalizer hash
+    of the ABSOLUTE (row, col) position, so any tile decomposition
+    reproduces the same mask."""
     if tpu_prng:
-        pltpu.prng_seed(seed_ref[0], b, qi, ki)
+        pltpu.prng_seed(seed_ref[0], (b * nq + qi) * nk + ki)
         bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     else:
         rows = (q_start + _iota(shape, 0)).astype(jnp.uint32)
@@ -79,9 +100,11 @@ def _keep_mask(seed_ref, b, qi, ki, q_start, k_start, shape, dropout_p,
     return bits >= thresh
 
 
-def _tile_scores(q, k, mask_ref, seq_ref, q_start, k_start, *, causal,
+def _tile_scores(q, k, mask_ref, sl, q_start, k_start, *, causal,
                  has_mask, has_seqlens):
-    """Scaled scores for one (q, k) tile with every mask applied."""
+    """Scaled scores for one (q, k) tile with every mask applied.
+    ``sl`` is this batch row's kv length (scalar, read from SMEM by the
+    caller) or None."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     shape = s.shape
@@ -92,7 +115,6 @@ def _tile_scores(q, k, mask_ref, seq_ref, q_start, k_start, *, causal,
         cols = k_start + _iota(shape, 1)
         s = jnp.where(cols <= rows, s, NEG_INF)
     if has_seqlens:
-        sl = seq_ref[0]
         rows = q_start + _iota(shape, 0)
         cols = k_start + _iota(shape, 1)
         s = jnp.where((cols < sl) & (rows < sl), s, NEG_INF)
@@ -100,7 +122,7 @@ def _tile_scores(q, k, mask_ref, seq_ref, q_start, k_start, *, causal,
 
 
 def _fwd_kernel(*refs, block_q, block_k, causal, scale, dropout_p, has_mask,
-                has_seqlens, tpu_prng=True):
+                has_seqlens, hq, tpu_prng=True):
     if has_mask:
         (q_ref, k_ref, v_ref, mask_ref, seq_ref, seed_ref,
          o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
@@ -112,6 +134,7 @@ def _fwd_kernel(*refs, block_q, block_k, causal, scale, dropout_p, has_mask,
     nk = pl.num_programs(2)
     q_start = qi * block_q
     k_start = ki * block_k
+    sl = seq_ref[_idiv(b, hq)] if has_seqlens else None
 
     @pl.when(ki == 0)
     def _init():
@@ -123,7 +146,7 @@ def _fwd_kernel(*refs, block_q, block_k, causal, scale, dropout_p, has_mask,
         q = q_ref[0].astype(jnp.float32) * scale
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        s = _tile_scores(q, k, mask_ref, seq_ref, q_start, k_start,
+        s = _tile_scores(q, k, mask_ref, sl, q_start, k_start,
                          causal=causal, has_mask=has_mask,
                          has_seqlens=has_seqlens)
         m_prev, l_prev = m_ref[:], l_ref[:]
@@ -131,7 +154,8 @@ def _fwd_kernel(*refs, block_q, block_k, causal, scale, dropout_p, has_mask,
         alpha = jnp.exp(m_prev - m_next)
         p = jnp.exp(s - m_next[:, :1])
         if dropout_p > 0.0:
-            keep = _keep_mask(seed_ref, b, qi, ki, q_start, k_start,
+            keep = _keep_mask(seed_ref, b, qi, ki, pl.num_programs(1),
+                              pl.num_programs(2), q_start, k_start,
                               p.shape, dropout_p, tpu_prng)
             p_use = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
         else:
@@ -154,7 +178,7 @@ def _fwd_kernel(*refs, block_q, block_k, causal, scale, dropout_p, has_mask,
 
 
 def _bwd_dq_kernel(*refs, block_q, block_k, causal, scale, dropout_p,
-                   has_mask, has_seqlens, tpu_prng=True):
+                   has_mask, has_seqlens, hq, tpu_prng=True):
     if has_mask:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, seq_ref,
          seed_ref, dq_ref, acc_ref) = refs
@@ -166,6 +190,7 @@ def _bwd_dq_kernel(*refs, block_q, block_k, causal, scale, dropout_p,
     nk = pl.num_programs(2)
     q_start = qi * block_q
     k_start = ki * block_k
+    sl = seq_ref[_idiv(b, hq)] if has_seqlens else None
 
     @pl.when(ki == 0)
     def _init():
@@ -178,14 +203,15 @@ def _bwd_dq_kernel(*refs, block_q, block_k, causal, scale, dropout_p,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
-        s = _tile_scores(q, k, mask_ref, seq_ref, q_start, k_start,
+        s = _tile_scores(q, k, mask_ref, sl, q_start, k_start,
                          causal=causal, has_mask=has_mask,
                          has_seqlens=has_seqlens)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
-            keep = _keep_mask(seed_ref, b, qi, ki, q_start, k_start,
+            keep = _keep_mask(seed_ref, b, qi, ki, pl.num_programs(1),
+                              pl.num_programs(2), q_start, k_start,
                               p.shape, dropout_p, tpu_prng)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - delta[:, None])
@@ -203,7 +229,7 @@ def _bwd_dq_kernel(*refs, block_q, block_k, causal, scale, dropout_p,
 
 
 def _bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, dropout_p,
-                    has_mask, has_seqlens, tpu_prng=True):
+                    has_mask, has_seqlens, hq, tpu_prng=True):
     if has_mask:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, seq_ref,
          seed_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -215,6 +241,7 @@ def _bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, dropout_p,
     nq = pl.num_programs(2)
     q_start = qj * block_q
     k_start = ki * block_k
+    sl = seq_ref[_idiv(b, hq)] if has_seqlens else None
 
     @pl.when(qj == 0)
     def _init():
@@ -228,15 +255,17 @@ def _bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, dropout_p,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
-        s = _tile_scores(q, k, mask_ref, seq_ref, q_start, k_start,
+        s = _tile_scores(q, k, mask_ref, sl, q_start, k_start,
                          causal=causal, has_mask=has_mask,
                          has_seqlens=has_seqlens)
         p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
-            # seed coords are (head, q-tile, k-tile) — identical to forward
-            keep = _keep_mask(seed_ref, b, qj, ki, q_start, k_start,
+            # seed coords are (head, q-tile, k-tile) — identical to forward;
+            # this grid is (bh, nk, nq), so nq/nk swap program axes
+            keep = _keep_mask(seed_ref, b, qj, ki, pl.num_programs(2),
+                              pl.num_programs(1), q_start, k_start,
                               p.shape, dropout_p, tpu_prng)
             inv = 1.0 / (1.0 - dropout_p)
             p_v = jnp.where(keep, p * inv, 0.0)
@@ -268,14 +297,14 @@ def _common_specs(hq, hkv, block_q, block_k, s, d, causal, has_mask, mask_hm):
     group = hq // hkv
 
     def kv_row(b):
-        return (b // hq) * hkv + (b % hq) // group
+        return _idiv(b, hq) * hkv + _idiv(_imod(b, hq), group)
 
     def ki_eff(qi, ki):
         if not causal:
             return ki
         # alias fully-masked tiles to the diagonal tile: the pipeline sees a
         # repeated block index and skips the DMA
-        return jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k)
+        return jnp.minimum(ki, _idiv(qi * block_q + block_q - 1, block_k))
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0))
     k_spec = pl.BlockSpec((1, block_k, d),
@@ -286,12 +315,13 @@ def _common_specs(hq, hkv, block_q, block_k, s, d, causal, has_mask, mask_hm):
     if has_mask:
         mask_spec = pl.BlockSpec(
             (1, 1, block_q, block_k),
-            lambda b, qi, ki: (b // hq, (b % hq) if mask_hm > 1 else 0,
+            lambda b, qi, ki: (_idiv(b, hq),
+                               _imod(b, hq) if mask_hm > 1 else 0,
                                qi, ki_eff(qi, ki)))
-    seq_spec = pl.BlockSpec((1,), lambda b, qi, ki: (b // hq,),
-                            memory_space=pltpu.SMEM)
-    seed_spec = pl.BlockSpec((1,), lambda b, qi, ki: (0,),
-                             memory_space=pltpu.SMEM)
+    # per-batch scalars ride SMEM whole (rank-1 blocked specs violate the
+    # Mosaic lane-tiling rule); kernels index them by _idiv(b, hq)
+    seq_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, qi, ki: (b, 0, qi))
     return q_spec, k_spec, v_spec, mask_spec, seq_spec, seed_spec, row_spec
 
@@ -319,7 +349,7 @@ def _fwd_call(q, k, v, mask, seqlens, seed_arr, causal, dropout_p, hq, hkv,
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
         scale=scale, dropout_p=dropout_p, has_mask=has_mask,
-        has_seqlens=has_seqlens, tpu_prng=not interpret)
+        has_seqlens=has_seqlens, hq=hq, tpu_prng=not interpret)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, s // block_q, s // block_k),
@@ -373,7 +403,7 @@ def _bwd_call(q, k, v, o, do, lse, mask, seqlens, seed_arr, causal,
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, scale=scale, dropout_p=dropout_p,
                           has_mask=has_mask, has_seqlens=has_seqlens,
-                          tpu_prng=not interpret),
+                          hq=hq, tpu_prng=not interpret),
         grid=(bh, s // block_q, s // block_k),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -387,12 +417,12 @@ def _bwd_call(q, k, v, o, do, lse, mask, seqlens, seed_arr, causal,
     # dk/dv: grid over K/V tiles, Q stream innermost. Outputs are per Q-head;
     # the GQA group-sum happens outside the kernel (one cheap XLA reduce).
     def kv_row(b):
-        return (b // hq) * hkv + (b % hq) // group
+        return _idiv(b, hq) * hkv + _idiv(_imod(b, hq), group)
 
     def qj_eff(ki, qj):
         if not causal:
             return qj
-        return jnp.maximum(qj, (ki * block_k) // block_q)
+        return jnp.maximum(qj, _idiv(ki * block_k, block_q))
 
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, d),
@@ -410,13 +440,13 @@ def _bwd_call(q, k, v, o, do, lse, mask, seqlens, seed_arr, causal,
     if has_mask:
         dkv_in_specs.append(pl.BlockSpec(
             (1, 1, block_q, block_k),
-            lambda b, ki, qj: (b // hq, (b % hq) if mask_hm > 1 else 0,
+            lambda b, ki, qj: (_idiv(b, hq),
+                               _imod(b, hq) if mask_hm > 1 else 0,
                                qj_eff(ki, qj), ki)))
         dkv_args.append(mask)
     dkv_in_specs += [
-        pl.BlockSpec((1,), lambda b, ki, qj: (b // hq,),
-                     memory_space=pltpu.SMEM),
-        pl.BlockSpec((1,), lambda b, ki, qj: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
     ]
     dkv_args += [seqlens, seed_arr]
 
@@ -424,7 +454,7 @@ def _bwd_call(q, k, v, o, do, lse, mask, seqlens, seed_arr, causal,
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, scale=scale, dropout_p=dropout_p,
                           has_mask=has_mask, has_seqlens=has_seqlens,
-                          tpu_prng=not interpret),
+                          hq=hq, tpu_prng=not interpret),
         grid=(bh, s // block_k, s // block_q),
         in_specs=dkv_in_specs,
         out_specs=[
