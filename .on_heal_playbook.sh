@@ -1,0 +1,42 @@
+#!/bin/bash
+# TPU-window playbook: launched by .probe_daemon.sh ONCE per wedged->healthy
+# transition. Burns the window in priority order, SIGTERM-first (timeout's
+# default) so a hung stage can't leave a dead pool claim the way a KILLed
+# allocation does. Everything logs to TPU_WINDOW.log for the round report.
+set -u
+LOG=/root/repo/TPU_WINDOW.log
+ts() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+echo "$(ts) window opened — playbook start" >> "$LOG"
+
+cd /root/repo
+
+# 1) headline bench (its orchestrator probes + falls back internally)
+echo "$(ts) stage 1: bench.py" >> "$LOG"
+timeout 1500 python bench.py > /tmp/.window_bench.json 2>/tmp/.window_bench.log
+rc=$?
+echo "$(ts) bench rc=$rc: $(cat /tmp/.window_bench.json 2>/dev/null)" >> "$LOG"
+cp /tmp/.window_bench.json /root/repo/BENCH_TPU_SNAPSHOT.json 2>/dev/null
+
+# stop if the relay died mid-stage (don't pile more claims on a wedge)
+timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1 || {
+  echo "$(ts) relay unhealthy after bench; playbook stops" >> "$LOG"; exit 0; }
+
+# 2) real-TPU test tier: Mosaic-compile every Pallas kernel, hardware-PRNG
+#    dropout checks, profile captures
+echo "$(ts) stage 2: pytest -m tpu" >> "$LOG"
+timeout 2400 python -m pytest tests/ -m tpu -q \
+    > /tmp/.window_tputests.log 2>&1
+rc=$?
+echo "$(ts) pytest -m tpu rc=$rc: $(tail -1 /tmp/.window_tputests.log)" >> "$LOG"
+cp /tmp/.window_tputests.log /root/repo/TPU_TESTS.log 2>/dev/null
+
+timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1 || {
+  echo "$(ts) relay unhealthy after tpu tests; playbook stops" >> "$LOG"; exit 0; }
+
+# 3) serving decode benchmark on the chip
+echo "$(ts) stage 3: bench_decode" >> "$LOG"
+timeout 900 python benchmarks/bench_decode.py > /tmp/.window_decode.log 2>&1
+rc=$?
+echo "$(ts) bench_decode rc=$rc: $(tail -2 /tmp/.window_decode.log | tr '\n' ' ')" >> "$LOG"
+
+echo "$(ts) playbook complete" >> "$LOG"

@@ -1,10 +1,11 @@
 #!/bin/bash
-# TPU relay probe daemon v3: pure jax.devices() probe (no allocations — safe
-# to kill), 300s budget, every 10 min. Touches .tpu_healthy on success.
-# Captures the probe's own exit code before piping (a pipeline would report
-# tail's rc) and keeps the stderr tail so failure modes are diagnosable from
-# TPU_PROBES.log alone.
+# TPU relay probe daemon v4: pure jax.devices() probe (no allocations — safe
+# to kill), 300s budget, every 10 min. Touches .tpu_healthy on success and
+# fires .on_heal_playbook.sh ONCE per wedged->healthy transition (detached),
+# so a window that opens while no one is watching still gets burned on the
+# priority list (bench -> tpu test tier -> serving bench).
 ERRF=/tmp/.tpu_probe_err
+PREV=wedged
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   raw=$(timeout 300 python -c "import jax; print('DEV', jax.devices())" 2>"$ERRF")
@@ -13,10 +14,16 @@ while true; do
   if [ "$rc" -eq 0 ] && [ -n "$out" ]; then
     echo "$ts rc=0 ${out:0:160}" >> /root/repo/TPU_PROBES.log
     touch /root/repo/.tpu_healthy
+    if [ "$PREV" = wedged ]; then
+      echo "$ts heal transition: launching playbook" >> /root/repo/TPU_PROBES.log
+      nohup /root/repo/.on_heal_playbook.sh >/dev/null 2>&1 &
+    fi
+    PREV=healthy
   else
     err=$(tail -c 200 "$ERRF" | tr '\n' ' ')
     echo "$ts rc=$rc out='${out:0:80}' err='${err}'" >> /root/repo/TPU_PROBES.log
     rm -f /root/repo/.tpu_healthy
+    PREV=wedged
   fi
   sleep 600
 done
