@@ -5,7 +5,14 @@
 # allocation does. Everything logs to TPU_WINDOW.log for the round report.
 set -u
 LOG=/root/repo/TPU_WINDOW.log
+LOCK=/tmp/.on_heal_playbook.lock
 ts() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+# single-instance guard: a health flap mid-run must not stack a second burn
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "$(ts) playbook already running (lock held); exiting" >> "$LOG"
+  exit 0
+fi
+trap 'rmdir "$LOCK"' EXIT
 echo "$(ts) window opened — playbook start" >> "$LOG"
 
 cd /root/repo
@@ -15,7 +22,10 @@ echo "$(ts) stage 1: bench.py" >> "$LOG"
 timeout 1500 python bench.py > /tmp/.window_bench.json 2>/tmp/.window_bench.log
 rc=$?
 echo "$(ts) bench rc=$rc: $(cat /tmp/.window_bench.json 2>/dev/null)" >> "$LOG"
-cp /tmp/.window_bench.json /root/repo/BENCH_TPU_SNAPSHOT.json 2>/dev/null
+# keep the last GOOD snapshot: only overwrite on success with parseable JSON
+if [ $rc -eq 0 ] && python -c "import json,sys; json.load(open('/tmp/.window_bench.json'))" 2>/dev/null; then
+  cp /tmp/.window_bench.json /root/repo/BENCH_TPU_SNAPSHOT.json
+fi
 
 # stop if the relay died mid-stage (don't pile more claims on a wedge)
 timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1 || {

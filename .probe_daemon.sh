@@ -5,7 +5,10 @@
 # so a window that opens while no one is watching still gets burned on the
 # priority list (bench -> tpu test tier -> serving bench).
 ERRF=/tmp/.tpu_probe_err
+# seed from the persisted marker so a daemon restart while healthy does not
+# count as a heal transition (the window was already burned)
 PREV=wedged
+[ -f /root/repo/.tpu_healthy ] && PREV=healthy
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   raw=$(timeout 300 python -c "import jax; print('DEV', jax.devices())" 2>"$ERRF")
@@ -15,8 +18,13 @@ while true; do
     echo "$ts rc=0 ${out:0:160}" >> /root/repo/TPU_PROBES.log
     touch /root/repo/.tpu_healthy
     if [ "$PREV" = wedged ]; then
-      echo "$ts heal transition: launching playbook" >> /root/repo/TPU_PROBES.log
-      nohup /root/repo/.on_heal_playbook.sh >/dev/null 2>&1 &
+      if pgrep -f on_heal_playbook.sh >/dev/null 2>&1; then
+        echo "$ts heal transition: playbook already running, not relaunching" \
+          >> /root/repo/TPU_PROBES.log
+      else
+        echo "$ts heal transition: launching playbook" >> /root/repo/TPU_PROBES.log
+        nohup /root/repo/.on_heal_playbook.sh >/dev/null 2>&1 &
+      fi
     fi
     PREV=healthy
   else
