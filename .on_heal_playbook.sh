@@ -5,14 +5,15 @@
 # allocation does. Everything logs to TPU_WINDOW.log for the round report.
 set -u
 LOG=/root/repo/TPU_WINDOW.log
-LOCK=/tmp/.on_heal_playbook.lock
 ts() { date -u +%Y-%m-%dT%H:%M:%SZ; }
-# single-instance guard: a health flap mid-run must not stack a second burn
-if ! mkdir "$LOCK" 2>/dev/null; then
+# single-instance guard: flock on a held fd releases on ANY process death
+# (SIGKILL/OOM included), so a killed burn can never wedge future windows
+exec 9>/tmp/.on_heal_playbook.lock
+if ! flock -n 9; then
   echo "$(ts) playbook already running (lock held); exiting" >> "$LOG"
   exit 0
 fi
-trap 'rmdir "$LOCK"' EXIT
+touch /tmp/.window_burned
 echo "$(ts) window opened — playbook start" >> "$LOG"
 
 cd /root/repo

@@ -6,9 +6,12 @@
 # priority list (bench -> tpu test tier -> serving bench).
 ERRF=/tmp/.tpu_probe_err
 # seed from the persisted marker so a daemon restart while healthy does not
-# count as a heal transition (the window was already burned)
+# count as a heal transition — UNLESS no burn was ever recorded on this
+# boot (/tmp/.window_burned is stamped by the playbook and cleared by
+# reboot), which covers a wedge+heal cycle that happened while the daemon
+# was down. Missing a window costs a round; a duplicate burn costs minutes.
 PREV=wedged
-[ -f /root/repo/.tpu_healthy ] && PREV=healthy
+[ -f /root/repo/.tpu_healthy ] && [ -f /tmp/.window_burned ] && PREV=healthy
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   raw=$(timeout 300 python -c "import jax; print('DEV', jax.devices())" 2>"$ERRF")
@@ -18,13 +21,10 @@ while true; do
     echo "$ts rc=0 ${out:0:160}" >> /root/repo/TPU_PROBES.log
     touch /root/repo/.tpu_healthy
     if [ "$PREV" = wedged ]; then
-      if pgrep -f on_heal_playbook.sh >/dev/null 2>&1; then
-        echo "$ts heal transition: playbook already running, not relaunching" \
-          >> /root/repo/TPU_PROBES.log
-      else
-        echo "$ts heal transition: launching playbook" >> /root/repo/TPU_PROBES.log
-        nohup /root/repo/.on_heal_playbook.sh >/dev/null 2>&1 &
-      fi
+      # launch unconditionally: the playbook's flock is the single
+      # instance guard (one mechanism, self-releasing on death)
+      echo "$ts heal transition: launching playbook" >> /root/repo/TPU_PROBES.log
+      nohup /root/repo/.on_heal_playbook.sh >/dev/null 2>&1 &
     fi
     PREV=healthy
   else

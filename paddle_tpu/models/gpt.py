@@ -457,10 +457,18 @@ class GPT2ForCausalLM(Layer):
 
     @staticmethod
     def _generate_loop(prefill_fn, step_fn, input_ids, max_new_tokens,
-                       do_sample, temperature, top_k, top_p, seed):
+                       do_sample, temperature, top_k, top_p, seed,
+                       eos_id=None, pad_id=None):
         """Shared incremental-decode driver (GPT-2 and Llama): prefill,
         then step/pick until the budget, with greedy selection staying on
         device and sampling reading logits to host.
+
+        eos_id: per-row early stop (reference generation_utils'
+        eos_token_id semantics) — once a row emits EOS, its later
+        positions emit ``pad_id`` (default: eos_id) and the loop exits
+        as soon as EVERY row has finished. The finished test is the one
+        host sync per step; greedy decoding without eos_id stays fully
+        on device.
 
         NOTE on the hot path: each step's returned caches are fresh
         buffers (functional update); true in-place reuse needs donation
@@ -469,6 +477,9 @@ class GPT2ForCausalLM(Layer):
         from .. import ops
         b = input_ids.shape[0]
         rng = np.random.RandomState(seed)
+        if pad_id is None:
+            pad_id = eos_id
+        done = np.zeros((b,), bool)
 
         def pick(lg):
             if not do_sample:
@@ -479,17 +490,38 @@ class GPT2ForCausalLM(Layer):
                 top_p, rng)
             return paddle.to_tensor(sel.reshape(b, 1))
 
+        def apply_eos(tok):
+            """Mask finished rows to pad and fold this step's EOS hits
+            into `done` (host-side: the mask drives python control flow)."""
+            tok_np = np.asarray(tok._data).reshape(b)
+            out = np.where(done, pad_id, tok_np)
+            done[:] = done | (out == eos_id)
+            return paddle.to_tensor(out.reshape(b, 1))
+
         logits, caches, t = prefill_fn()
         toks = [input_ids]
         tok = pick(logits)
+        if eos_id is not None:
+            tok = apply_eos(tok)
         for i in range(max_new_tokens):
             toks.append(tok)
-            if i + 1 == max_new_tokens:
+            if i + 1 == max_new_tokens or (eos_id is not None
+                                           and bool(done.all())):
                 break
             logits, caches, t = step_fn(tok.astype(input_ids.dtype),
                                         caches, t)
             tok = pick(logits)
-        return ops.concat([x.astype("int64") for x in toks], axis=1)
+            if eos_id is not None:
+                tok = apply_eos(tok)
+        out = ops.concat([x.astype("int64") for x in toks], axis=1)
+        if eos_id is not None and len(toks) - 1 < max_new_tokens:
+            # every row finished early: right-pad to the requested length
+            # so the output shape stays [B, S + max_new_tokens]
+            short = max_new_tokens - (len(toks) - 1)
+            pad = paddle.to_tensor(
+                np.full((b, short), pad_id, np.int64))
+            out = ops.concat([out, pad], axis=1)
+        return out
 
     @staticmethod
     def _resolve_s_max(config, s, max_new_tokens, s_max):
@@ -587,10 +619,14 @@ class GPT2ForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens, s_max=None,
                  decode_fn=None, do_sample=False, temperature=1.0,
-                 top_k=0, top_p=None, seed=None):
+                 top_k=0, top_p=None, seed=None, eos_id=None, pad_id=None):
         """Incremental decode over the KV cache — greedy by default;
         ``do_sample=True`` draws with temperature / top-k / top-p
         (nucleus) truncation, seeded via ``seed`` for reproducibility.
+        ``eos_id`` stops each row at its end-of-sequence token (later
+        positions emit ``pad_id``, default eos_id) and ends the loop
+        early once every row is done; output shape stays
+        [B, S + max_new_tokens].
 
         decode_fn: optionally a compiled decode step (e.g.
         ``jit.to_static(model.decode_step)``) so every token reuses one
@@ -603,7 +639,8 @@ class GPT2ForCausalLM(Layer):
         step = decode_fn if decode_fn is not None else self.decode_step
         return self._generate_loop(
             lambda: self.prefill(input_ids, s_max), step, input_ids,
-            max_new_tokens, do_sample, temperature, top_k, top_p, seed)
+            max_new_tokens, do_sample, temperature, top_k, top_p, seed,
+            eos_id=eos_id, pad_id=pad_id)
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in self.parameters())

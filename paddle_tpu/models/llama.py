@@ -745,10 +745,10 @@ class LlamaForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens, s_max=None,
                  decode_fn=None, do_sample=False, temperature=1.0,
-                 top_k=0, top_p=None, seed=None):
+                 top_k=0, top_p=None, seed=None, eos_id=None, pad_id=None):
         """Incremental decode over the KV cache — greedy by default;
-        ``do_sample`` draws with temperature / top-k / top-p (shared
-        sampling semantics with the GPT-2 zoo)."""
+        ``do_sample`` draws with temperature / top-k / top-p, ``eos_id``
+        stops rows early (shared driver semantics with the GPT-2 zoo)."""
         from .gpt import GPT2ForCausalLM
         _, s = input_ids.shape
         s_max = GPT2ForCausalLM._resolve_s_max(self.config, s,
@@ -756,7 +756,8 @@ class LlamaForCausalLM(Layer):
         step = decode_fn if decode_fn is not None else self.decode_step
         return GPT2ForCausalLM._generate_loop(
             lambda: self.prefill(input_ids, s_max), step, input_ids,
-            max_new_tokens, do_sample, temperature, top_k, top_p, seed)
+            max_new_tokens, do_sample, temperature, top_k, top_p, seed,
+            eos_id=eos_id, pad_id=pad_id)
 
     # -- paged-KV serving route (vLLM-style block cache, GQA-native) --------
 

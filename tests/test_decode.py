@@ -155,3 +155,48 @@ def test_sampling_generate():
         p0 = m.generate(ids, max_new_tokens=6, do_sample=True,
                         top_p=1e-9, seed=7).numpy().tolist()
     assert p0 == greedy
+
+
+def test_eos_early_stop():
+    """eos_id parity with the reference's generation loop: rows stop at
+    their EOS, later positions pad, the loop exits early when every row
+    is done, and the output shape is unchanged."""
+    m, cfg = _tiny()
+    ids = paddle.to_tensor(np.random.RandomState(9).randint(0, 128, (2, 6)))
+    with paddle.no_grad():
+        base = m.generate(ids, max_new_tokens=8).numpy()
+    # pick each row's first greedy token as its "EOS" so row 0 stops at
+    # step 1; use a token row 1 never emits to keep it running
+    eos = int(base[0, 6])
+    with paddle.no_grad():
+        out = m.generate(ids, max_new_tokens=8, eos_id=eos,
+                         pad_id=0).numpy()
+    assert out.shape == base.shape
+    row0 = out[0, 6:]
+    assert row0[0] == eos
+    assert (row0[1:] == 0).all()          # padded after EOS
+    # rows that never hit EOS match the plain greedy continuation
+    row1_plain = base[1, 6:]
+    if eos not in row1_plain:
+        np.testing.assert_array_equal(out[1, 6:], row1_plain)
+    # default pad is the EOS token itself: every position from the EOS on
+    # must be eos (row 0 stops at its FIRST generated token)
+    with paddle.no_grad():
+        out2 = m.generate(ids, max_new_tokens=8, eos_id=eos).numpy()
+    assert (out2[0, 6:] == eos).all()
+
+
+def test_eos_all_rows_early_exit_pads_to_shape():
+    """A 1-row batch whose first token is its EOS forces the all-rows-done
+    early exit; the output must still be right-padded to
+    [B, S + max_new_tokens]."""
+    m, cfg = _tiny()
+    ids = paddle.to_tensor(np.random.RandomState(10).randint(0, 128, (1, 5)))
+    with paddle.no_grad():
+        base = m.generate(ids, max_new_tokens=6).numpy()
+    e0 = int(base[0, 5])
+    with paddle.no_grad():
+        out = m.generate(ids, max_new_tokens=6, eos_id=e0, pad_id=1).numpy()
+    assert out.shape == base.shape
+    assert out[0, 5] == e0
+    assert (out[0, 6:] == 1).all()
