@@ -46,24 +46,67 @@ def _token_timeline(cu_q, dec, token_num):
     return seq_of, local, pos
 
 
-def _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, block_size):
-    """Write each token's k/v row at (block_tables[seq, pos//bs], pos%bs)."""
+def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant):
+    """Validate the static cachekv-int8 contract and return the four
+    scale arrays. All-or-nothing: partial scale sets would silently skip
+    quantization, and an int8 pool without scales would astype-truncate
+    raw fp rows into int8 codes — both are loud errors instead."""
+    scales = (_arr(k_quant), _arr(v_quant), _arr(k_dequant),
+              _arr(v_dequant))
+    given = [s is not None for s in scales]
+    if any(given) and not all(given):
+        raise ValueError("cachekv int8 needs all four scale tensors "
+                         "(k/v quant + k/v dequant)")
+    is_int8 = jnp.issubdtype(kc.dtype, jnp.integer)
+    if is_int8 and not all(given):
+        raise ValueError(
+            "int8 cache pool but no quant scales: calibrate first (a raw "
+            "astype would truncate fp rows into int8 codes)")
+    if all(given) and not is_int8:
+        raise ValueError("cachekv quant scales given but the cache pool "
+                         f"dtype is {kc.dtype}; allocate int8 pools")
+    return scales
+
+
+def _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, block_size,
+                   k_quant=None, v_quant=None):
+    """Write each token's k/v row at (block_tables[seq, pos//bs], pos%bs).
+
+    k_quant/v_quant: optional per-head STATIC quant scales [H] (reference
+    cache_k_quant_scales) — rows are quantized to int8 on the way in, so
+    the pool holds int8 and cache HBM halves vs bf16 (quarters vs fp32).
+    """
+    if k_quant is not None:
+        kt = jnp.clip(jnp.round(kt.astype(jnp.float32)
+                                * k_quant[None, :, None]),
+                      -127, 127).astype(jnp.int8)
+        vt = jnp.clip(jnp.round(vt.astype(jnp.float32)
+                                * v_quant[None, :, None]),
+                      -127, 127).astype(jnp.int8)
     phys = bt[seq_of, pos // block_size]
     off = pos % block_size
     return (kc.at[phys, :, off].set(kt.astype(kc.dtype)),
             vc.at[phys, :, off].set(vt.astype(vc.dtype)))
 
 
-def _gather_paged(kc, vc, bt, heads):
+def _gather_paged(kc, vc, bt, heads, k_dequant=None, v_dequant=None,
+                  out_dtype=None):
     """Assemble every sequence's kv timeline from its pages:
-    [B, heads, blocks_per_seq*block_size, D]."""
+    [B, heads, blocks_per_seq*block_size, D]. k_dequant/v_dequant [H]
+    undo a quantized pool (reference cache_k_dequant_scales)."""
     bsz, blocks_per_seq = bt.shape
     bs_, hd = kc.shape[2], kc.shape[3]
     s_kv = blocks_per_seq * bs_
     gk = kc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, heads, bs_, hd)
     gv = vc[bt.reshape(-1)].reshape(bsz, blocks_per_seq, heads, bs_, hd)
-    return (jnp.moveaxis(gk, 2, 1).reshape(bsz, heads, s_kv, hd),
-            jnp.moveaxis(gv, 2, 1).reshape(bsz, heads, s_kv, hd), s_kv)
+    gk = jnp.moveaxis(gk, 2, 1).reshape(bsz, heads, s_kv, hd)
+    gv = jnp.moveaxis(gv, 2, 1).reshape(bsz, heads, s_kv, hd)
+    if k_dequant is not None:
+        scale_k = k_dequant[None, :, None, None]
+        scale_v = v_dequant[None, :, None, None]
+        gk = (gk.astype(jnp.float32) * scale_k).astype(out_dtype)
+        gv = (gv.astype(jnp.float32) * scale_v).astype(out_dtype)
+    return gk, gv, s_kv
 
 
 def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
@@ -161,14 +204,24 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     seq_lens_this_time[i] == 1 (appends at seq_lens_decoder[i], attends to
     the full prefix through the block table).
 
+    Cache-KV int8 (static): pass cache_k/v_quant_scales + dequant_scales
+    of shape [num_head] and int8 cache pools — rows quantize on the
+    scatter, the gathered timeline dequantizes before the dot (reference
+    static cachekv-int8 mode; dynamic per-step scale search is gated).
+
     Returns (out [token_num, H*D], qkv, key_cache_out, value_cache_out).
     """
-    if qkv_out_scale is not None or out_scale != -1 \
-            or cache_k_quant_scales is not None:
+    if qkv_out_scale is not None or out_scale != -1:
         raise NotImplementedError(
-            "quantized cache path: use paddle_tpu.quantization")
+            "quantized activation path: use paddle_tpu.quantization")
+    if use_dynamic_cachekv_quant and cache_k_quant_scales is not None:
+        raise NotImplementedError(
+            "dynamic cachekv quant: static per-head scales only")
     qkv_a = _arr(qkv)
     kc, vc = _arr(key_cache), _arr(value_cache)
+    kq, vq, kdq, vdq = _cachekv_scales(
+        kc, cache_k_quant_scales, cache_v_quant_scales,
+        cache_k_dequant_scales, cache_v_dequant_scales)
     enc = _arr(seq_lens_encoder).reshape(-1).astype(jnp.int32)
     dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
     this = _arr(seq_lens_this_time).reshape(-1).astype(jnp.int32)
@@ -205,9 +258,11 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                              axis=-1).reshape(u.shape).astype(u.dtype)
         qt, kt = _rope(qt), _rope(kt)
 
-    kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_)
+    kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_,
+                            k_quant=kq, v_quant=vq)
     kv_len = jnp.where(enc > 0, enc, dec + this)               # [B]
-    gk, gv, s_kv = _gather_paged(kc, vc, bt, nh)
+    gk, gv, s_kv = _gather_paged(kc, vc, bt, nh, k_dequant=kdq,
+                                 v_dequant=vdq, out_dtype=qt.dtype)
 
     # dense scores per token over its sequence's timeline
     scores = jnp.einsum("thd,tshd->ths", qt,
@@ -239,7 +294,10 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
 def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
                         seq_lens_decoder, seq_lens_this_time, cu_seqlens_q,
                         block_tables, block_size=64, rope_cos=None,
-                        rope_sin=None):
+                        rope_sin=None, cache_k_quant_scales=None,
+                        cache_v_quant_scales=None,
+                        cache_k_dequant_scales=None,
+                        cache_v_dequant_scales=None):
     """Paged-KV attention with UNEXPANDED grouped-query heads (the GQA
     sibling of block_multihead_attention; reference analog:
     block_multihead_attention.py:19 serving Llama-family models, where
@@ -258,10 +316,17 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
     against the gathered [T, KV, S_kv, D] timeline, which is both the
     memory win of GQA and an MXU-friendly batched matmul.
 
+    Cache-KV int8: same static per-[KV]-head scale contract as
+    block_multihead_attention (quantize on scatter, dequantize the
+    gathered timeline).
+
     Returns (out [T, H*D], key_cache_out, value_cache_out).
     """
     qt, kt, vt = _arr(q), _arr(k), _arr(v)
     kc, vc = _arr(key_cache), _arr(value_cache)
+    kq, vq, kdq, vdq = _cachekv_scales(
+        kc, cache_k_quant_scales, cache_v_quant_scales,
+        cache_k_dequant_scales, cache_v_dequant_scales)
     enc = _arr(seq_lens_encoder).reshape(-1).astype(jnp.int32)
     dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
     this = _arr(seq_lens_this_time).reshape(-1).astype(jnp.int32)
@@ -286,9 +351,11 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
                              axis=-1).reshape(u.shape).astype(u.dtype)
         qt, kt = _rope(qt), _rope(kt)
 
-    kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_)
+    kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_,
+                            k_quant=kq, v_quant=vq)
     kv_len = jnp.where(enc > 0, enc, dec + this)
-    gk, gv, s_kv = _gather_paged(kc, vc, bt, kvh)
+    gk, gv, s_kv = _gather_paged(kc, vc, bt, kvh, k_dequant=kdq,
+                                 v_dequant=vdq, out_dtype=qt.dtype)
 
     # grouped scores: q regrouped [T, KV, rep, D] vs timeline [T, KV, S, D]
     qg = qt.reshape(token_num, kvh, rep, hd).astype(jnp.float32)

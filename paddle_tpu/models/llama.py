@@ -773,15 +773,43 @@ class LlamaForCausalLM(Layer):
         """Physical KV page pool: per layer, (kc, vc) of
         [n_pages, KV, block_size, D] — GQA caches at kv-head count
         (unexpanded), so the pool is H/KV times smaller than an
-        MHA-equivalent one."""
+        MHA-equivalent one. After calibrate_cachekv_int8 the pools
+        allocate int8 (half of bf16, quarter of fp32 cache HBM)."""
         import paddle_tpu as paddle
         cfg = self.config
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
-        return [(paddle.zeros([n_pages, kvh, block_size, d],
-                              dtype=cfg.dtype),
-                 paddle.zeros([n_pages, kvh, block_size, d],
-                              dtype=cfg.dtype))
+        dtype = "int8" if self._cachekv_scales is not None else cfg.dtype
+        return [(paddle.zeros([n_pages, kvh, block_size, d], dtype=dtype),
+                 paddle.zeros([n_pages, kvh, block_size, d], dtype=dtype))
                 for _ in range(cfg.num_hidden_layers)]
+
+    _cachekv_scales = None
+
+    def calibrate_cachekv_int8(self, sample_ids):
+        """Install STATIC per-kv-head int8 cache scales from a calibration
+        batch (reference cache_k_quant_scales surface, static mode): run
+        the dense prefill, take each layer's per-head |K|/|V| amax over
+        the post-RoPE rows, and store (quant=127/amax, dequant=amax/127)
+        per layer. Afterwards every paged route — generate_paged and
+        PagedContinuousBatcher — reads/writes an int8 page pool.
+        Call with eval-mode weights; pass None to disable again."""
+        if sample_ids is None:
+            self._cachekv_scales = None
+            return None
+        import paddle_tpu as paddle
+        b, s = sample_ids.shape
+        with paddle.no_grad():
+            _, caches = self.model.forward_prefill(sample_ids, s)
+        arr = caches._data            # [L, 2, B, KV, s, D]
+        amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=(2, 4, 5))
+        amax = jnp.maximum(amax, 1e-6)                    # [L, 2, KV]
+        scales = []
+        for li in range(arr.shape[0]):
+            ka, va = amax[li, 0], amax[li, 1]
+            scales.append({"kq": 127.0 / ka, "vq": 127.0 / va,
+                           "kdq": ka / 127.0, "vdq": va / 127.0})
+        self._cachekv_scales = scales
+        return scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
                            block_size=64):
@@ -807,7 +835,7 @@ class LlamaForCausalLM(Layer):
 
         hidden = model.embed_tokens(input_ids)         # [B, s, E]
         layers_state = []
-        for layer, (kc, vc) in zip(model.layers, layers):
+        for li, (layer, (kc, vc)) in enumerate(zip(model.layers, layers)):
             attn = layer.self_attn
             x = layer.input_layernorm(hidden)
             q = attn.q_proj(x).reshape([b * s, h, d])
@@ -816,13 +844,24 @@ class LlamaForCausalLM(Layer):
             out, kc, vc = block_gqa_attention(
                 q, k, v, kc, vc, enc, dec, enc, cu_q, block_tables,
                 block_size=block_size, rope_cos=Tensor(cos_tab),
-                rope_sin=Tensor(sin_tab))
+                rope_sin=Tensor(sin_tab), **self._layer_cache_scales(li))
             hidden = hidden + attn.o_proj(out.reshape([b, s, h * d]))
             hidden = hidden + layer.mlp(
                 layer.post_attention_layernorm(hidden))
             layers_state.append((kc, vc))
         hidden = model.norm(hidden)
         return self._lm_logits(hidden[:, s - 1]), layers_state
+
+    def _layer_cache_scales(self, li):
+        """block_gqa_attention kwargs for layer li's cache quantization
+        (empty when the int8 cache is disabled)."""
+        if self._cachekv_scales is None:
+            return {}
+        sc = self._cachekv_scales[li]
+        return {"cache_k_quant_scales": sc["kq"],
+                "cache_v_quant_scales": sc["vq"],
+                "cache_k_dequant_scales": sc["kdq"],
+                "cache_v_dequant_scales": sc["vdq"]}
 
     def paged_prefill(self, input_ids, block_size=64, blocks_per_seq=None):
         """Prompt pass through a freshly allocated paged cache. Returns
@@ -854,7 +893,8 @@ class LlamaForCausalLM(Layer):
 
         hidden = model.embed_tokens(tok.reshape([b, 1]))   # [B, 1, E]
         new_layers = []
-        for layer, (kc, vc) in zip(model.layers, state["layers"]):
+        for li, (layer, (kc, vc)) in enumerate(zip(model.layers,
+                                                   state["layers"])):
             attn = layer.self_attn
             x = layer.input_layernorm(hidden)
             q = attn.q_proj(x).reshape([b, h, d])
@@ -863,7 +903,7 @@ class LlamaForCausalLM(Layer):
             out, kc, vc = block_gqa_attention(
                 q, k, v, kc, vc, enc, t, this, cu_q, bt,
                 block_size=state["block_size"], rope_cos=Tensor(cos_tab),
-                rope_sin=Tensor(sin_tab))
+                rope_sin=Tensor(sin_tab), **self._layer_cache_scales(li))
             hidden = hidden + attn.o_proj(out.reshape([b, 1, h * d]))
             hidden = hidden + layer.mlp(
                 layer.post_attention_layernorm(hidden))

@@ -103,3 +103,136 @@ def test_int4_serving_runs():
         dense = m.generate(ids, max_new_tokens=5).numpy()
         paged = m.generate_paged(ids, max_new_tokens=5, block_size=8).numpy()
     np.testing.assert_array_equal(dense, paged)
+
+
+# -- cache-KV int8 (reference block_multihead_attention static quant mode) --
+
+def _llama_eval():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny_config(vocab_size=128))
+    m.eval()
+    return m
+
+
+def test_cachekv_int8_close_to_fp_cache():
+    """Static per-head int8 cache: paged logits track the fp-cache paged
+    logits; pools actually hold int8."""
+    m = _llama_eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 128, (2, 8)).astype(np.int64))
+    with paddle.no_grad():
+        fp_logits, _ = m.paged_prefill(ids, block_size=8)
+        scales = m.calibrate_cachekv_int8(ids)
+        assert len(scales) == m.config.num_hidden_layers
+        q_logits, q_state = m.paged_prefill(ids, block_size=8)
+    assert str(q_state["layers"][0][0].dtype) in ("paddle.int8", "int8")
+    rel = (np.abs(q_logits.numpy() - fp_logits.numpy()).max()
+           / (np.abs(fp_logits.numpy()).max() + 1e-9))
+    assert rel < 0.05, rel
+    m.calibrate_cachekv_int8(None)      # disable restores fp pools
+    with paddle.no_grad():
+        _, state2 = m.paged_prefill(ids, block_size=8)
+    assert "int8" not in str(state2["layers"][0][0].dtype)
+
+
+@pytest.mark.smoke
+def test_cachekv_int8_serving_algebra_exact():
+    """Quantized-cache generate_paged vs the quantized-cache batcher must
+    be token-exact (the int8 cache changes logits, never the scheduler)."""
+    m = _llama_eval()
+    rng = np.random.RandomState(1)
+    calib = paddle.to_tensor(rng.randint(0, 128, (2, 10)).astype(np.int64))
+    with paddle.no_grad():
+        m.calibrate_cachekv_int8(calib)
+    prompts = [rng.randint(0, 128, (s,)) for s in (5, 8)]
+
+    def solo(p, n):
+        ids = paddle.to_tensor(np.asarray(p, np.int64)[None])
+        with paddle.no_grad():
+            return m.generate_paged(ids, max_new_tokens=n,
+                                    block_size=8).numpy()[0]
+
+    b = PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               compile=False)
+    assert str(b._state["layers"][0][0].dtype).endswith("int8")
+    rids = [b.submit(p, 5) for p in prompts]
+    outs = b.run_until_done()
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(outs[rid], solo(p, 5))
+
+
+def test_cachekv_int8_mha_functional():
+    """block_multihead_attention's static cachekv-int8 mode: int8 pools +
+    per-head scales reproduce the fp-cache output within quant noise."""
+    import jax.numpy as jnp
+    from paddle_tpu.incubate.nn.functional.decode_attention import \
+        block_multihead_attention
+    rng = np.random.RandomState(2)
+    b, h, d, bs, bps, s = 2, 4, 16, 8, 2, 6
+    n_blocks = b * bps
+    qkv = paddle.to_tensor(rng.randn(b * s, 3 * h * d).astype(np.float32))
+    bt = paddle.to_tensor(
+        np.arange(n_blocks, dtype=np.int32).reshape(b, bps))
+    enc = paddle.to_tensor(np.full((b,), s, np.int32))
+    dec = paddle.to_tensor(np.zeros((b,), np.int32))
+    cu = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
+
+    kc = paddle.zeros([n_blocks, h, bs, d], dtype="float32")
+    vc = paddle.zeros([n_blocks, h, bs, d], dtype="float32")
+    fp_out, _, fkc, fvc = block_multihead_attention(
+        qkv, kc, vc, enc, dec, enc, None, None, cu, cu, bt, block_size=bs)
+
+    amax_k = np.abs(np.asarray(fkc._data)).max(axis=(0, 2, 3)) + 1e-6
+    amax_v = np.abs(np.asarray(fvc._data)).max(axis=(0, 2, 3)) + 1e-6
+    kq = paddle.to_tensor((127.0 / amax_k).astype(np.float32))
+    vq = paddle.to_tensor((127.0 / amax_v).astype(np.float32))
+    kdq = paddle.to_tensor((amax_k / 127.0).astype(np.float32))
+    vdq = paddle.to_tensor((amax_v / 127.0).astype(np.float32))
+    kc8 = paddle.zeros([n_blocks, h, bs, d], dtype="int8")
+    vc8 = paddle.zeros([n_blocks, h, bs, d], dtype="int8")
+    q_out, _, qkc, qvc = block_multihead_attention(
+        qkv, kc8, vc8, enc, dec, enc, None, None, cu, cu, bt,
+        cache_k_quant_scales=kq, cache_v_quant_scales=vq,
+        cache_k_dequant_scales=kdq, cache_v_dequant_scales=vdq,
+        block_size=bs)
+    assert str(qkc.dtype).endswith("int8")
+    rel = (np.abs(q_out.numpy() - fp_out.numpy()).max()
+           / (np.abs(fp_out.numpy()).max() + 1e-9))
+    assert rel < 0.05, rel
+
+
+def test_cachekv_scale_contract_errors():
+    """Partial scale sets and int8-pool-without-scales are loud errors,
+    never silent truncation (review finding)."""
+    from paddle_tpu.incubate.nn.functional.decode_attention import \
+        block_gqa_attention
+    rng = np.random.RandomState(3)
+    b, h, kvh, d, bs, bps, s = 1, 4, 2, 8, 4, 2, 3
+    q = paddle.to_tensor(rng.randn(b * s, h, d).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(b * s, kvh, d).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(b * s, kvh, d).astype(np.float32))
+    bt = paddle.to_tensor(np.arange(b * bps, dtype=np.int32).reshape(b, bps))
+    enc = paddle.to_tensor(np.full((b,), s, np.int32))
+    dec = paddle.to_tensor(np.zeros((b,), np.int32))
+    cu = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
+    sc = paddle.to_tensor(np.ones((kvh,), np.float32))
+    kc8 = paddle.zeros([b * bps, kvh, bs, d], dtype="int8")
+    vc8 = paddle.zeros([b * bps, kvh, bs, d], dtype="int8")
+    kcf = paddle.zeros([b * bps, kvh, bs, d], dtype="float32")
+    vcf = paddle.zeros([b * bps, kvh, bs, d], dtype="float32")
+    # int8 pool, no scales
+    with pytest.raises(ValueError, match="int8 cache pool"):
+        block_gqa_attention(q, k, v, kc8, vc8, enc, dec, enc, cu, bt,
+                            block_size=bs)
+    # partial scales
+    with pytest.raises(ValueError, match="all four"):
+        block_gqa_attention(q, k, v, kc8, vc8, enc, dec, enc, cu, bt,
+                            block_size=bs, cache_k_dequant_scales=sc)
+    # scales against an fp pool
+    with pytest.raises(ValueError, match="allocate int8"):
+        block_gqa_attention(q, k, v, kcf, vcf, enc, dec, enc, cu, bt,
+                            block_size=bs, cache_k_quant_scales=sc,
+                            cache_v_quant_scales=sc,
+                            cache_k_dequant_scales=sc,
+                            cache_v_dequant_scales=sc)
