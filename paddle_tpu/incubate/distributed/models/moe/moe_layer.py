@@ -37,12 +37,13 @@ from paddle_tpu.ops.registry import defop
 from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
 
 
-@defop("moe_dispatch_masks")
-def _moe_masks_op(topk_val, topk_idx, num_experts=1, capacity=1,
+def moe_masks_jnp(topk_val, topk_idx, num_experts=1, capacity=1,
                   norm_mode="softmax"):
-    """combine weights [N, E, C] + boolean dispatch mask from top-k routing.
-    Choice j consumes capacity before choice j+1 (GShard priority policy).
-    Differentiable in topk_val only (the routing indicator is constant).
+    """Pure-jnp mask builder (also used inside the scanned Llama body,
+    which runs below the op-dispatch layer): combine weights [N, E, C] +
+    boolean dispatch mask from top-k routing. Choice j consumes capacity
+    before choice j+1 (GShard priority policy). Differentiable in
+    topk_val only (the routing indicator is constant).
 
     norm_mode: how the k selected scores become combine weights —
     "softmax" for raw router logits (NaiveGate; the reference combines raw
@@ -68,6 +69,13 @@ def _moe_masks_op(topk_val, topk_idx, num_experts=1, capacity=1,
         combine = combine.at[jnp.arange(n), e, pos_c].add(w)
     dispatch = combine > 0.0
     return combine, dispatch
+
+
+@defop("moe_dispatch_masks")
+def _moe_masks_op(topk_val, topk_idx, num_experts=1, capacity=1,
+                  norm_mode="softmax"):
+    return moe_masks_jnp(topk_val, topk_idx, num_experts=num_experts,
+                         capacity=capacity, norm_mode=norm_mode)
 
 
 def _compute_capacity(num_tokens: int, num_experts: int, top_k: int,

@@ -401,6 +401,7 @@ class ScannedLlamaLayers(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
         self.config = config
+        self.l_aux = None
         L = config.num_hidden_layers
         hs = config.hidden_size
         h, kv, d = (config.num_attention_heads, config.num_key_value_heads,
@@ -417,9 +418,26 @@ class ScannedLlamaLayers(Layer):
         self.k_w = p([L, hs, kv * d])
         self.v_w = p([L, hs, kv * d])
         self.o_w = p([L, h * d, hs])
-        self.gate_w = p([L, hs, ims])
-        self.up_w = p([L, hs, ims])
-        self.down_w = p([L, ims, hs])
+        if config.num_experts > 1:
+            # routed SwiGLU expert bank, stacked over layers AND experts:
+            # the scan body routes with this layer's [E, ...] slices (same
+            # gshard top-2 + capacity machinery as the unrolled
+            # _LlamaExpertBank, in pure jnp)
+            if config.moe_top_k != 2:
+                # same contract the unrolled path enforces via
+                # GShardGate.__init__ — the gshard aux loss is a top-1
+                # indicator over top-2 routing
+                raise AssertionError("gshard gate requires top_k = 2")
+            E = config.num_experts
+            self.router_w = p([L, hs, E])
+            self.router_b = p([L, E], I.Constant(0.0))
+            self.moe_gate_w = p([L, E, hs, ims])
+            self.moe_up_w = p([L, E, hs, ims])
+            self.moe_down_w = p([L, E, ims, hs])
+        else:
+            self.gate_w = p([L, hs, ims])
+            self.up_w = p([L, hs, ims])
+            self.down_w = p([L, ims, hs])
         self.ln1_w = p([L, hs], ones)
         self.ln2_w = p([L, hs], ones)
 
@@ -472,9 +490,21 @@ class ScannedLlamaLayers(Layer):
             from ..ops.pallas.flash_attention import supported
             use_flash = supported(seq, d)
         remat = cfg.use_recompute and self.training
+        moe = cfg.num_experts > 1
+        if moe:
+            from ..incubate.distributed.models.moe.moe_layer import (
+                _compute_capacity, moe_masks_jnp)
+            E, top_k = cfg.num_experts, cfg.moe_top_k
+            cap_factor = cfg.moe_capacity_factor
 
-        def _impl(hidden, cos, sin, mask, qw, kw, vw, ow, gw, uw, dw,
-                  ln1, ln2):
+        def _impl(hidden, cos, sin, mask, qw, kw, vw, ow, *mlp_and_ln):
+            if moe:
+                rw, rb, mgw, muw, mdw, ln1, ln2 = mlp_and_ln
+                mlp_ws = (rw, rb, mgw, muw, mdw)
+            else:
+                gw, uw, dw, ln1, ln2 = mlp_and_ln
+                mlp_ws = (gw, uw, dw)
+
             def rms(x, w):
                 xf = x.astype(jnp.float32)
                 r = jax.lax.rsqrt(
@@ -485,8 +515,43 @@ class ScannedLlamaLayers(Layer):
                 # same pure-jnp RoPE as the unrolled path — ONE definition
                 return apply_rotary_pos_emb(x, cos, sin)
 
-            def body_fn(h_, per_layer):
-                qw_, kw_, vw_, ow_, gw_, uw_, dw_, l1, l2 = per_layer
+            def mlp_dense(x2, ws):
+                gw_, uw_, dw_ = ws
+                return (jax.nn.silu(x2 @ gw_) * (x2 @ uw_)) @ dw_, 0.0
+
+            def mlp_moe(x2, ws):
+                """Routed SwiGLU experts — pure-jnp mirror of the unrolled
+                _LlamaExpertBank (gshard top-2 probs, capacity priority
+                masks, dense dispatch/combine einsums). Returns
+                (mlp_out, this layer's aux loss)."""
+                rw_, rb_, mgw_, muw_, mdw_ = ws
+                b, s, hs_ = x2.shape
+                n = b * s
+                x2d = x2.reshape(n, hs_)
+                probs = jax.nn.softmax(x2d @ rw_ + rb_, axis=-1)
+                topk_val, topk_idx = jax.lax.top_k(probs, top_k)
+                # gshard load-balance loss (top-1 indicator is constant)
+                me = probs.astype(jnp.float32).mean(axis=0)
+                ce = jax.lax.stop_gradient(jax.nn.one_hot(
+                    topk_idx[:, 0], E, dtype=jnp.float32).mean(axis=0))
+                aux_l = (me * ce).sum() * float(E)
+                capacity = _compute_capacity(n, E, top_k, cap_factor)
+                combine, dispatchm = moe_masks_jnp(
+                    topk_val, topk_idx, num_experts=E, capacity=capacity,
+                    norm_mode="sum")
+                ein = jnp.einsum("nec,nd->ecd",
+                                 dispatchm.astype(x2d.dtype), x2d)
+                g = jax.nn.silu(jnp.einsum("ecd,edh->ech", ein, mgw_))
+                u = jnp.einsum("ecd,edh->ech", ein, muw_)
+                eo = jnp.einsum("ech,ehd->ecd", g * u, mdw_)
+                out = jnp.einsum("nec,ecd->nd", combine.astype(eo.dtype), eo)
+                return out.reshape(b, s, hs_), aux_l
+
+            mlp_fn = mlp_moe if moe else mlp_dense
+
+            def body_fn(carry, per_layer):
+                h_, aux = carry
+                (qw_, kw_, vw_, ow_, l1, l2), ws = per_layer
                 b, s, _ = h_.shape
                 x = rms(h_, l1)
                 q = rope((x @ qw_).reshape(b, s, h, d))
@@ -530,8 +595,8 @@ class ScannedLlamaLayers(Layer):
                     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
                 h1 = h_ + ctx.reshape(b, s, h * d) @ ow_
                 x2 = rms(h1, l2)
-                mlp = (jax.nn.silu(x2 @ gw_) * (x2 @ uw_)) @ dw_
-                return h1 + mlp, None
+                mlp, aux_l = mlp_fn(x2, ws)
+                return (h1 + mlp, aux + aux_l), None
 
             if remat:
                 gran = getattr(cfg, "recompute_granularity", "full")
@@ -548,16 +613,26 @@ class ScannedLlamaLayers(Layer):
                         f"(use 'full' or 'selective')")
             else:
                 body = body_fn
-            out, _ = jax.lax.scan(
-                body, hidden, (qw, kw, vw, ow, gw, uw, dw, ln1, ln2))
-            return out
+            xs = ((qw, kw, vw, ow, ln1, ln2), mlp_ws)
+            (out, aux), _ = jax.lax.scan(
+                body, (hidden, jnp.float32(0.0)), xs)
+            return out, aux
 
-        return dispatch(
+        if moe:
+            mlp_params = (self.router_w, self.router_b, self.moe_gate_w,
+                          self.moe_up_w, self.moe_down_w)
+        else:
+            mlp_params = (self.gate_w, self.up_w, self.down_w)
+        out, aux = dispatch(
             _impl,
             (hidden, Tensor(cos), Tensor(sin), attn_mask, self.q_w,
-             self.k_w, self.v_w, self.o_w, self.gate_w, self.up_w,
-             self.down_w, self.ln1_w, self.ln2_w),
+             self.k_w, self.v_w, self.o_w, *mlp_params,
+             self.ln1_w, self.ln2_w),
             {}, op_name="llama_scanned_layers")
+        # summed load-balance aux across the scanned stack; the LM head
+        # adds moe_aux_coeff * l_aux exactly like the unrolled path
+        self.l_aux = aux if moe else None
+        return out
 
 
 class LlamaModel(Layer):
@@ -567,11 +642,6 @@ class LlamaModel(Layer):
         self.embed_tokens = Embedding(
             config.vocab_size, config.hidden_size,
             weight_attr=I.Normal(std=config.initializer_range))
-        if config.scan_layers and config.num_experts > 1:
-            raise ValueError(
-                "scan_layers + num_experts > 1 is not supported yet: the "
-                "routed expert bank is per-layer state the scan body can't "
-                "stack; use the unrolled path for MoE")
         if config.scan_layers:
             self.layers_scanned = ScannedLlamaLayers(config)
             self.layers = []
@@ -696,10 +766,15 @@ class LlamaForCausalLM(Layer):
             logits.reshape([-1, self.config.vocab_size]).astype("float32"),
             labels.reshape([-1]))
         if self.config.num_experts > 1 and self.config.moe_aux_coeff:
-            for layer in self.model.layers:
-                aux = getattr(layer.mlp, "l_aux", None)
+            if self.config.scan_layers:
+                aux = self.model.layers_scanned.l_aux
                 if aux is not None:
                     loss = loss + self.config.moe_aux_coeff * aux
+            else:
+                for layer in self.model.layers:
+                    aux = getattr(layer.mlp, "l_aux", None)
+                    if aux is not None:
+                        loss = loss + self.config.moe_aux_coeff * aux
         return logits, loss
 
     # -- incremental (KV-cache) decode — the serving path -------------------
@@ -990,10 +1065,23 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp",
         # stacked [L, in, out] weights: the layer dim leads, so the 2D
         # placements shift by one (same TP plan, scan-compatible)
         sc = model.model.layers_scanned
-        for col in (sc.q_w, sc.k_w, sc.v_w, sc.gate_w, sc.up_w):
-            place(col, mp_dim=2, fsdp_dim=1)
-        for row in (sc.o_w, sc.down_w):
-            place(row, mp_dim=1, fsdp_dim=2)
+        if model.config.num_experts > 1:
+            # stacked [L, E, in, out] expert banks: expert dim Shard(1)
+            # over ep, TP/FSDP shift one more for the leading layer dim;
+            # the router stays replicated (same invariant as unrolled)
+            for col in (sc.q_w, sc.k_w, sc.v_w):
+                place(col, mp_dim=2, fsdp_dim=1)
+            place(sc.o_w, mp_dim=1, fsdp_dim=2)
+            place(sc.moe_gate_w, mp_dim=3, fsdp_dim=2, ep_dim=1)
+            place(sc.moe_up_w, mp_dim=3, fsdp_dim=2, ep_dim=1)
+            place(sc.moe_down_w, mp_dim=2, fsdp_dim=3, ep_dim=1)
+            place(sc.router_w)
+            place(sc.router_b)
+        else:
+            for col in (sc.q_w, sc.k_w, sc.v_w, sc.gate_w, sc.up_w):
+                place(col, mp_dim=2, fsdp_dim=1)
+            for row in (sc.o_w, sc.down_w):
+                place(row, mp_dim=1, fsdp_dim=2)
         place(sc.ln1_w)
         place(sc.ln2_w)
     else:

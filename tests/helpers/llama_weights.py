@@ -1,0 +1,36 @@
+"""Copy unrolled Llama weights into a scanned model's stacked layout.
+
+One helper for both parity suites (dense in test_models.py, MoE in
+test_llama_moe.py) so the attention/ln/embed/head copying can't drift
+between them when the stacked layout changes.
+"""
+import jax.numpy as jnp
+
+
+def copy_unrolled_to_scanned(m_u, m_s):
+    sc = m_s.model.layers_scanned
+
+    def stack(getter):
+        return jnp.stack([getter(l)._data for l in m_u.model.layers])
+
+    sc.q_w._set_data(stack(lambda l: l.self_attn.q_proj.weight))
+    sc.k_w._set_data(stack(lambda l: l.self_attn.k_proj.weight))
+    sc.v_w._set_data(stack(lambda l: l.self_attn.v_proj.weight))
+    sc.o_w._set_data(stack(lambda l: l.self_attn.o_proj.weight))
+    if m_s.config.num_experts > 1:
+        sc.router_w._set_data(stack(lambda l: l.mlp.moe.gate.gate.weight))
+        sc.router_b._set_data(stack(lambda l: l.mlp.moe.gate.gate.bias))
+        sc.moe_gate_w._set_data(stack(lambda l: l.mlp.moe.gate_w))
+        sc.moe_up_w._set_data(stack(lambda l: l.mlp.moe.up_w))
+        sc.moe_down_w._set_data(stack(lambda l: l.mlp.moe.down_w))
+    else:
+        sc.gate_w._set_data(stack(lambda l: l.mlp.gate_proj.weight))
+        sc.up_w._set_data(stack(lambda l: l.mlp.up_proj.weight))
+        sc.down_w._set_data(stack(lambda l: l.mlp.down_proj.weight))
+    sc.ln1_w._set_data(stack(lambda l: l.input_layernorm.weight))
+    sc.ln2_w._set_data(stack(lambda l: l.post_attention_layernorm.weight))
+    m_s.model.embed_tokens.weight._set_data(
+        m_u.model.embed_tokens.weight._data)
+    m_s.model.norm.weight._set_data(m_u.model.norm.weight._data)
+    if m_s.lm_head is not None:
+        m_s.lm_head.weight._set_data(m_u.lm_head.weight._data)

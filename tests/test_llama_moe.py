@@ -3,7 +3,8 @@
 Reference surface: incubate/distributed/models/moe composed into the
 decoder MLP — the reference trains MoE transformers through the same
 machinery. Numerics here: routing/capacity on the CPU mesh, aux loss in
-the LM loss, EP+TP+DP sharded step, scan incompatibility guard.
+the LM loss, EP+TP+DP sharded steps (unrolled AND scanned), and exact
+scanned-vs-unrolled parity.
 """
 import numpy as np
 import pytest
@@ -88,11 +89,65 @@ def test_moe_llama_ep_tp_dp_sharded_step():
     assert gw is not None and bool(np.isfinite(gw.numpy()).all())
 
 
-def test_moe_scan_layers_rejected():
-    cfg = _moe_cfg()
-    cfg.scan_layers = True
-    with pytest.raises(ValueError, match="scan_layers"):
-        LlamaForCausalLM(cfg)
+def test_moe_scanned_ep_tp_sharded_step():
+    """Scanned MoE under the same mesh: stacked [L, E, ...] expert banks
+    Shard(1) over ep + TP over mp compile and step on the CPU mesh."""
+    from paddle_tpu.models import shard_llama
+    paddle.seed(5)
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["dp", "ep", "mp"])
+    cfg = _moe_cfg(scan_layers=True)
+    m = LlamaForCausalLM(cfg)
+    shard_llama(m, mesh, mp_axis="mp", batch_axes=("dp",), ep_axis="ep")
+    ids = shard_tensor(
+        paddle.to_tensor(np.random.RandomState(5).randint(0, 64, (4, 16))),
+        mesh, [Shard(0), Replicate(), Replicate()])
+    logits, loss = m(ids, labels=ids)
+    loss.backward()
+    assert np.isfinite(float(loss))
+    gw = m.model.layers_scanned.moe_down_w.grad
+    assert gw is not None and bool(np.isfinite(gw.numpy()).all())
+
+
+from tests.helpers.llama_weights import \
+    copy_unrolled_to_scanned as _copy_moe_unrolled_to_scanned  # noqa: E402
+
+
+def test_moe_scanned_matches_unrolled():
+    """scan_layers + MoE: the scanned routed-expert body (pure-jnp gshard
+    gate + capacity masks) must reproduce the unrolled _LlamaExpertBank
+    numerics exactly, aux loss included."""
+    paddle.seed(0)
+    m_u = LlamaForCausalLM(_moe_cfg())
+    m_s = LlamaForCausalLM(_moe_cfg(scan_layers=True))
+    assert m_u.num_params() == m_s.num_params()
+    _copy_moe_unrolled_to_scanned(m_u, m_s)
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(0, 64, (2, 16)))
+    m_u.eval()
+    m_s.eval()
+    with paddle.no_grad():
+        lu, loss_u = m_u(ids, labels=ids)
+        ls, loss_s = m_s(ids, labels=ids)
+    np.testing.assert_allclose(np.asarray(lu._data), np.asarray(ls._data),
+                               atol=1e-4)
+    assert abs(float(loss_u) - float(loss_s)) < 1e-4
+    # aux landed in both paths
+    aux_u = sum(float(l.mlp.l_aux) for l in m_u.model.layers)
+    aux_s = float(m_s.model.layers_scanned.l_aux)
+    assert abs(aux_u - aux_s) < 1e-4
+
+
+def test_moe_scanned_trains():
+    from paddle_tpu import jit
+    paddle.seed(2)
+    m = LlamaForCausalLM(_moe_cfg(scan_layers=True))
+    opt = optimizer.AdamW(learning_rate=3e-3, parameters=m.parameters())
+    step = jit.TrainStep(lambda i, l: m(i, labels=l)[1], opt)
+    rng = np.random.RandomState(2)
+    ids = paddle.to_tensor(rng.randint(0, 64, (2, 16)))
+    losses = [float(step(ids, ids)._data) for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
 
 
 def test_moe_routing_covers_experts():

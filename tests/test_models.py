@@ -136,26 +136,8 @@ class TestScanLayers:
     must match the unrolled stack exactly (compile-time optimization only)."""
 
     def _copy_unrolled_to_scanned(self, m_u, m_s):
-        import jax.numpy as jnp
-        sc = m_s.model.layers_scanned
-
-        def stack(getter):
-            return jnp.stack([getter(l)._data for l in m_u.model.layers])
-
-        sc.q_w._set_data(stack(lambda l: l.self_attn.q_proj.weight))
-        sc.k_w._set_data(stack(lambda l: l.self_attn.k_proj.weight))
-        sc.v_w._set_data(stack(lambda l: l.self_attn.v_proj.weight))
-        sc.o_w._set_data(stack(lambda l: l.self_attn.o_proj.weight))
-        sc.gate_w._set_data(stack(lambda l: l.mlp.gate_proj.weight))
-        sc.up_w._set_data(stack(lambda l: l.mlp.up_proj.weight))
-        sc.down_w._set_data(stack(lambda l: l.mlp.down_proj.weight))
-        sc.ln1_w._set_data(stack(lambda l: l.input_layernorm.weight))
-        sc.ln2_w._set_data(stack(lambda l: l.post_attention_layernorm.weight))
-        m_s.model.embed_tokens.weight._set_data(
-            m_u.model.embed_tokens.weight._data)
-        m_s.model.norm.weight._set_data(m_u.model.norm.weight._data)
-        if m_s.lm_head is not None:
-            m_s.lm_head.weight._set_data(m_u.lm_head.weight._data)
+        from tests.helpers.llama_weights import copy_unrolled_to_scanned
+        copy_unrolled_to_scanned(m_u, m_s)
 
     def test_matches_unrolled(self):
         from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
