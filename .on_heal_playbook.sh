@@ -44,9 +44,11 @@ cp /tmp/.window_tputests.log /root/repo/TPU_TESTS.log 2>/dev/null
 timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1 || {
   echo "$(ts) relay unhealthy after tpu tests; playbook stops" >> "$LOG"; exit 0; }
 
-# 3) serving decode benchmark on the chip
+# 3) serving decode benchmark on the chip (repo root on the path — the
+# ambient PYTHONPATH only carries the axon sitecustomize)
 echo "$(ts) stage 3: bench_decode" >> "$LOG"
-timeout 900 python benchmarks/bench_decode.py > /tmp/.window_decode.log 2>&1
+timeout 900 env PYTHONPATH="/root/repo:${PYTHONPATH:-}" \
+    python benchmarks/bench_decode.py > /tmp/.window_decode.log 2>&1
 rc=$?
 echo "$(ts) bench_decode rc=$rc: $(tail -2 /tmp/.window_decode.log | tr '\n' ' ')" >> "$LOG"
 

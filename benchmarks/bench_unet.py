@@ -1,9 +1,11 @@
-"""BASELINE.md config 5 proxy: diffusion-UNet-style block throughput —
-conv + group-norm + attention, the Stable-Diffusion kernel mix.
+"""BASELINE.md config 5 proxy: diffusion-UNet training throughput —
+conv + group-norm + self/cross attention, the Stable-Diffusion kernel mix.
 
 The reference lists the full SD UNet as an external-model config; this
-stands up the kernel tier it exercises (conv2d / GroupNorm / self-attn
-fused by XLA, flash kernel on TPU).
+trains the in-tree diffusion family (models/unet.py: time-conditioned
+UNet + DDPM noise-prediction loss) so the bench exercises exactly the
+kernels the family ships (conv2d / GroupNorm / attention fused by XLA,
+flash kernel on TPU).
 """
 import json
 import sys
@@ -16,82 +18,43 @@ def main():
     import jax
 
     import paddle_tpu as paddle
-    from paddle_tpu import jit, nn, optimizer
-    import paddle_tpu.nn.functional as F
-
-    class ResBlock(nn.Layer):
-        def __init__(self, ch):
-            super().__init__()
-            self.n1 = nn.GroupNorm(8, ch)
-            self.c1 = nn.Conv2D(ch, ch, 3, padding=1)
-            self.n2 = nn.GroupNorm(8, ch)
-            self.c2 = nn.Conv2D(ch, ch, 3, padding=1)
-
-        def forward(self, x):
-            h = self.c1(F.silu(self.n1(x)))
-            return x + self.c2(F.silu(self.n2(h)))
-
-    class AttnBlock(nn.Layer):
-        def __init__(self, ch):
-            super().__init__()
-            self.norm = nn.GroupNorm(8, ch)
-            self.qkv = nn.Conv2D(ch, 3 * ch, 1)
-            self.proj = nn.Conv2D(ch, ch, 1)
-            self.ch = ch
-
-        def forward(self, x):
-            b, c, hgt, wid = x.shape
-            qkv = self.qkv(self.norm(x))
-            qkv = qkv.reshape([b, 3, c, hgt * wid]).transpose([1, 0, 3, 2])
-            q, k, v = qkv[0], qkv[1], qkv[2]        # [b, hw, c]
-            att = F.scaled_dot_product_attention(
-                q.unsqueeze(2), k.unsqueeze(2), v.unsqueeze(2))
-            att = att.squeeze(2).transpose([0, 2, 1]).reshape(
-                [b, c, hgt, wid])
-            return x + self.proj(att)
-
-    class MiniUNet(nn.Layer):
-        def __init__(self, ch=64):
-            super().__init__()
-            self.inc = nn.Conv2D(3, ch, 3, padding=1)
-            self.down = nn.Conv2D(ch, ch * 2, 3, stride=2, padding=1)
-            self.mid1 = ResBlock(ch * 2)
-            self.attn = AttnBlock(ch * 2)
-            self.mid2 = ResBlock(ch * 2)
-            self.up = nn.Conv2DTranspose(ch * 2, ch, 4, stride=2, padding=1)
-            self.out = nn.Conv2D(ch, 3, 3, padding=1)
-
-        def forward(self, x):
-            h = self.inc(x)
-            m = self.mid2(self.attn(self.mid1(self.down(h))))
-            return self.out(self.up(m) + h)
+    from paddle_tpu import jit, optimizer
+    from paddle_tpu.models import UNetModel, ddpm_loss, unet_tiny_config
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    ch, size, batch, iters = (128, 64, 8, 10) if on_tpu else (32, 16, 2, 2)
+    if on_tpu:
+        cfg = unet_tiny_config(base_channels=128, channel_mults=(1, 2, 4),
+                               num_res_blocks=2, attn_levels=(1, 2),
+                               num_heads=8, groups=32)
+        size, batch, iters = 64, 8, 10
+    else:
+        cfg = unet_tiny_config()
+        size, batch, iters = 16, 2, 2
     paddle.seed(0)
-    model = MiniUNet(ch)
+    model = UNetModel(cfg)
     opt = optimizer.AdamW(learning_rate=1e-4,
                           parameters=model.parameters())
     step = jit.TrainStep(
-        lambda x, t: ((model(x) - t) ** 2).mean(), opt)
+        lambda x, t, n: ddpm_loss(model, x, t, n), opt)
 
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype("float32"))
-    t = paddle.to_tensor(rng.randn(batch, 3, size, size).astype("float32"))
-    step(x, t)
-    float(step(x, t))
+    t = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
+    n = paddle.to_tensor(rng.randn(batch, 3, size, size).astype("float32"))
+    step(x, t, n)
+    float(step(x, t, n))
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss = step(x, t)
+        loss = step(x, t, n)
     final = float(loss)
     dt = time.perf_counter() - t0
     print(json.dumps({
-        "metric": "unet_block_train_images_per_sec",
+        "metric": "unet_ddpm_train_images_per_sec",
         "value": round(batch * iters / dt, 2),
         "unit": "images/s",
-        "detail": {"channels": ch, "size": size, "batch": batch,
-                   "final_loss": round(final, 5),
+        "detail": {"params": model.num_params(), "size": size,
+                   "batch": batch, "final_loss": round(final, 5),
                    "device": jax.devices()[0].platform},
     }))
 
@@ -100,7 +63,7 @@ if __name__ == "__main__":
     try:
         main()
     except Exception as e:
-        print(json.dumps({"metric": "unet_block_train_images_per_sec",
+        print(json.dumps({"metric": "unet_ddpm_train_images_per_sec",
                           "value": 0.0, "unit": "images/s",
                           "detail": {"error": str(e)[:200]}}))
         sys.exit(0)

@@ -16,6 +16,8 @@ from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
 from .gpt import GPT2Config, GPT2ForCausalLM, GPT2Model, gpt2_124m_config
 from .resnet import (BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34,
                      resnet50, resnet101, resnet152)
+from .unet import (UNetConfig, UNetModel, ddim_sample, ddpm_loss,
+                   sd_unet_config, unet_tiny_config)
 
 __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama2_7b_config",
@@ -25,4 +27,6 @@ __all__ = [
     "BertForMaskedLM", "bert_base_config", "bert_tiny_config", "shard_bert",
     "ResNet", "BasicBlock", "BottleneckBlock", "resnet18", "resnet34",
     "resnet50", "resnet101", "resnet152",
+    "UNetConfig", "UNetModel", "unet_tiny_config", "sd_unet_config",
+    "ddpm_loss", "ddim_sample",
 ]

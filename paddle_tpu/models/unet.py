@@ -1,0 +1,277 @@
+"""Diffusion UNet model family (the Stable-Diffusion kernel mix).
+
+Reference surface: BASELINE.md config 5 — the reference benchmarks an
+SD-style UNet (conv + GroupNorm/SiLU + self/cross attention) as an
+external-model config; paddle serves it through the same nn.Conv2D /
+GroupNorm / attention ops this file composes. TPU-first: NCHW convs XLA
+lays out for the MXU, GroupNorm/SiLU fused by XLA, attention through the
+shared scaled_dot_product_attention path (flash kernel when eligible),
+static shapes throughout so one compile serves every step.
+
+Pieces:
+- timestep_embedding: sinusoidal features -> 2-layer MLP (DDPM/SD form)
+- ResBlock: GroupNorm/SiLU conv pair + time-emb injection + skip
+- TransformerBlock: self-attn, optional cross-attn over a context
+  sequence (text conditioning), gelu MLP — the SD "spatial transformer"
+- UNetModel: down path with skips, attended middle, up path, out conv
+- ddpm_loss / ddim_sample: the training objective and a deterministic
+  sampler so the family is usable end to end
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+
+__all__ = ["UNetConfig", "UNetModel", "ddpm_loss", "ddim_sample",
+           "unet_tiny_config", "sd_unet_config"]
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    base_channels: int = 64
+    channel_mults: Sequence[int] = (1, 2, 4)
+    num_res_blocks: int = 2
+    attn_levels: Sequence[int] = (1, 2)   # indices into channel_mults
+    num_heads: int = 4
+    context_dim: Optional[int] = None     # cross-attention width (None = off)
+    groups: int = 8
+    dtype: str = "float32"
+
+
+def unet_tiny_config(**over) -> UNetConfig:
+    cfg = UNetConfig(base_channels=32, channel_mults=(1, 2),
+                     num_res_blocks=1, attn_levels=(1,), num_heads=2,
+                     groups=4)
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def sd_unet_config(**over) -> UNetConfig:
+    """SD-1.x-shaped config (4-ch latents, 320 base, cross-attn 768)."""
+    cfg = UNetConfig(in_channels=4, out_channels=4, base_channels=320,
+                     channel_mults=(1, 2, 4, 4), num_res_blocks=2,
+                     attn_levels=(0, 1, 2), num_heads=8, context_dim=768,
+                     groups=32)
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep features [B, dim] (DDPM §3.3 / SD form)."""
+    import paddle_tpu as paddle
+    half = dim // 2
+    freqs = paddle.to_tensor(
+        np.exp(-math.log(max_period) * np.arange(half, dtype=np.float32)
+               / half))
+    ang = t.astype("float32").unsqueeze(-1) * freqs.unsqueeze(0)
+    emb = paddle.concat([paddle.cos(ang), paddle.sin(ang)], axis=-1)
+    if dim % 2:
+        emb = paddle.concat([emb, paddle.zeros([emb.shape[0], 1])], axis=-1)
+    return emb
+
+
+class ResBlock(Layer):
+    """GroupNorm/SiLU conv pair with time-embedding injection."""
+
+    def __init__(self, cfg: UNetConfig, ch_in: int, ch_out: int,
+                 temb_dim: int):
+        super().__init__(dtype=cfg.dtype)
+        g = min(cfg.groups, ch_in)
+        self.n1 = nn.GroupNorm(g, ch_in)
+        self.c1 = nn.Conv2D(ch_in, ch_out, 3, padding=1)
+        self.temb = nn.Linear(temb_dim, ch_out)
+        self.n2 = nn.GroupNorm(min(cfg.groups, ch_out), ch_out)
+        self.c2 = nn.Conv2D(ch_out, ch_out, 3, padding=1)
+        self.skip = (nn.Conv2D(ch_in, ch_out, 1) if ch_in != ch_out
+                     else None)
+
+    def forward(self, x, temb):
+        h = self.c1(F.silu(self.n1(x)))
+        h = h + self.temb(F.silu(temb)).unsqueeze(-1).unsqueeze(-1)
+        h = self.c2(F.silu(self.n2(h)))
+        return (x if self.skip is None else self.skip(x)) + h
+
+
+class TransformerBlock(Layer):
+    """SD spatial transformer: self-attn (+ optional cross-attn over a
+    context sequence) + gelu MLP over the flattened spatial tokens."""
+
+    def __init__(self, cfg: UNetConfig, ch: int):
+        super().__init__(dtype=cfg.dtype)
+        self.ch = ch
+        self.heads = cfg.num_heads
+        self.norm = nn.GroupNorm(min(cfg.groups, ch), ch)
+        self.ln1 = nn.LayerNorm(ch)
+        self.to_qkv = nn.Linear(ch, 3 * ch, bias_attr=False)
+        self.proj1 = nn.Linear(ch, ch)
+        self.cross = cfg.context_dim is not None
+        if self.cross:
+            self.ln_x = nn.LayerNorm(ch)
+            self.to_q = nn.Linear(ch, ch, bias_attr=False)
+            self.to_kv = nn.Linear(cfg.context_dim, 2 * ch, bias_attr=False)
+            self.proj_x = nn.Linear(ch, ch)
+        self.ln2 = nn.LayerNorm(ch)
+        self.mlp1 = nn.Linear(ch, 4 * ch)
+        self.mlp2 = nn.Linear(4 * ch, ch)
+
+    def _attn(self, q, k, v):
+        """[B, T, ch] x [B, S, ch] heads-split sdpa (flash when eligible)."""
+        b, t, _ = q.shape
+        s = k.shape[1]
+        hd = self.ch // self.heads
+        q = q.reshape([b, t, self.heads, hd])
+        k = k.reshape([b, s, self.heads, hd])
+        v = v.reshape([b, s, self.heads, hd])
+        out = F.scaled_dot_product_attention(q, k, v)
+        return out.reshape([b, t, self.ch])
+
+    def forward(self, x, context=None):
+        b, c, hh, ww = x.shape
+        tokens = self.norm(x).reshape([b, c, hh * ww]).transpose([0, 2, 1])
+        t1 = self.ln1(tokens)
+        qkv = self.to_qkv(t1).reshape([b, hh * ww, 3, c])
+        tokens = tokens + self.proj1(
+            self._attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]))
+        if self.cross and context is not None:
+            tx = self.ln_x(tokens)
+            kv = self.to_kv(context)
+            tokens = tokens + self.proj_x(self._attn(
+                self.to_q(tx), kv[:, :, :c], kv[:, :, c:]))
+        t2 = self.ln2(tokens)
+        tokens = tokens + self.mlp2(F.gelu(self.mlp1(t2)))
+        return x + tokens.transpose([0, 2, 1]).reshape([b, c, hh, ww])
+
+
+class UNetModel(Layer):
+    """Time-conditioned UNet with skip connections (the SD denoiser
+    shape). forward(x [B, C, H, W], t [B], context [B, S, ctx]) ->
+    predicted noise [B, out_channels, H, W]."""
+
+    def __init__(self, config: UNetConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        ch0 = config.base_channels
+        temb = 4 * ch0
+        self.temb_dim = ch0
+        self.t1 = nn.Linear(ch0, temb)
+        self.t2 = nn.Linear(temb, temb)
+        self.inc = nn.Conv2D(config.in_channels, ch0, 3, padding=1)
+
+        downs: List[Layer] = []
+        skips = [ch0]
+        ch = ch0
+        for li, mult in enumerate(config.channel_mults):
+            out = ch0 * mult
+            for _ in range(config.num_res_blocks):
+                blk = [ResBlock(config, ch, out, temb)]
+                if li in config.attn_levels:
+                    blk.append(TransformerBlock(config, out))
+                downs.append(nn.LayerList(blk))
+                ch = out
+                skips.append(ch)
+            if li != len(config.channel_mults) - 1:
+                downs.append(nn.Conv2D(ch, ch, 3, stride=2, padding=1))
+                skips.append(ch)
+        self.downs = nn.LayerList(downs)
+
+        self.mid1 = ResBlock(config, ch, ch, temb)
+        self.mid_attn = TransformerBlock(config, ch)
+        self.mid2 = ResBlock(config, ch, ch, temb)
+
+        ups: List[Layer] = []
+        for li, mult in reversed(tuple(enumerate(config.channel_mults))):
+            out = ch0 * mult
+            for _ in range(config.num_res_blocks + 1):
+                blk = [ResBlock(config, ch + skips.pop(), out, temb)]
+                if li in config.attn_levels:
+                    blk.append(TransformerBlock(config, out))
+                ups.append(nn.LayerList(blk))
+                ch = out
+            if li != 0:
+                ups.append(nn.Conv2DTranspose(ch, ch, 4, stride=2,
+                                              padding=1))
+        self.ups = nn.LayerList(ups)
+        self.out_norm = nn.GroupNorm(min(config.groups, ch), ch)
+        self.out_conv = nn.Conv2D(ch, config.out_channels, 3, padding=1)
+
+    def forward(self, x, t, context=None):
+        import paddle_tpu as paddle
+        temb = self.t2(F.silu(self.t1(
+            timestep_embedding(t, self.temb_dim).astype(x.dtype))))
+        h = self.inc(x)
+        skips = [h]
+        for blk in self.downs:
+            if isinstance(blk, nn.LayerList):
+                h = blk[0](h, temb)
+                if len(blk) > 1:
+                    h = blk[1](h, context)
+            else:
+                h = blk(h)                      # strided downsample
+            skips.append(h)
+        h = self.mid2(self.mid_attn(self.mid1(h, temb), context), temb)
+        for blk in self.ups:
+            if isinstance(blk, nn.LayerList):
+                h = paddle.concat([h, skips.pop()], axis=1)
+                h = blk[0](h, temb)
+                if len(blk) > 1:
+                    h = blk[1](h, context)
+            else:
+                h = blk(h)                      # transposed upsample
+        return self.out_conv(F.silu(self.out_norm(h)))
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+def _ddpm_alphas(num_steps: int, beta_start=1e-4, beta_end=2e-2):
+    betas = np.linspace(beta_start, beta_end, num_steps, dtype=np.float32)
+    return np.cumprod(1.0 - betas)
+
+
+def ddpm_loss(model, x0, t, noise, context=None, num_steps: int = 1000):
+    """Noise-prediction MSE at timesteps t (DDPM eq. 14): the training
+    objective of the diffusion family. x0 [B, C, H, W]; t [B] int;
+    noise ~ N(0, 1) like x0."""
+    import paddle_tpu as paddle
+    abar = paddle.to_tensor(_ddpm_alphas(num_steps))
+    a = abar[t].reshape([-1, 1, 1, 1]).astype(x0.dtype)
+    xt = x0 * a.sqrt() + noise * (1.0 - a).sqrt()
+    pred = model(xt, t, context)
+    return ((pred - noise.astype(pred.dtype)) ** 2).mean()
+
+
+def ddim_sample(model, shape, num_steps: int = 50, train_steps: int = 1000,
+                context=None, seed: int = 0):
+    """Deterministic DDIM sampler (eta=0) over a trained noise predictor.
+    Returns x0 [B, C, H, W]. Serving-side: every model call has the same
+    static shape, so one compiled forward serves all steps."""
+    import paddle_tpu as paddle
+    rng = np.random.RandomState(seed)
+    abar = _ddpm_alphas(train_steps)
+    ts = np.linspace(train_steps - 1, 0, num_steps).round().astype(np.int64)
+    x = paddle.to_tensor(rng.randn(*shape).astype(np.float32))
+    with paddle.no_grad():
+        for i, ti in enumerate(ts):
+            t = paddle.to_tensor(np.full((shape[0],), ti, np.int64))
+            eps = model(x, t, context)
+            a_t = float(abar[ti])
+            x0 = (x - math.sqrt(1.0 - a_t) * eps) / math.sqrt(a_t)
+            if i + 1 == len(ts):
+                x = x0
+            else:
+                a_prev = float(abar[ts[i + 1]])
+                x = (math.sqrt(a_prev) * x0
+                     + math.sqrt(1.0 - a_prev) * eps)
+    return x
