@@ -13,6 +13,7 @@ no per-occupancy recompilation ever happens.
 """
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -49,6 +50,35 @@ class _BatcherBase:
         self._pending: List[Request] = []
         self._finished: Dict[int, Request] = {}
         self._next_rid = 0
+        # serving observability (reference analog: the predictor's
+        # benchmark counters): totals since construction
+        self._stat_steps = 0
+        self._stat_tokens = 0
+        self._stat_occupancy_sum = 0
+        self._stat_completed = 0
+        self._stat_preempted = 0
+        self._stat_t0 = _time.perf_counter()
+
+    def stats(self) -> Dict[str, float]:
+        """Throughput/occupancy counters for monitoring: decode steps,
+        generated tokens, tokens/sec since construction, mean active
+        slots per step, utilization (active/max_batch), completions,
+        preemptions, queue depth right now."""
+        dt = max(_time.perf_counter() - self._stat_t0, 1e-9)
+        steps = max(self._stat_steps, 1)
+        return {
+            "steps": self._stat_steps,
+            "generated_tokens": self._stat_tokens,
+            "tokens_per_sec": self._stat_tokens / dt,
+            "mean_active_slots": self._stat_occupancy_sum / steps,
+            "slot_utilization": (self._stat_occupancy_sum / steps
+                                 / self.max_batch),
+            "completed_requests": self._stat_completed,
+            "preemptions": self._stat_preempted,
+            "pending_now": len(self._pending),
+            "active_now": len(self._slot_req),
+            "elapsed_s": dt,
+        }
 
     @staticmethod
     def _check_window(cfg, s_max: int):
@@ -91,6 +121,7 @@ class _BatcherBase:
             del self._slot_req[slot]
             self._release_slot(slot)
             self._finished[req.rid] = req
+            self._stat_completed += 1
             return True
         return False
 
@@ -204,6 +235,7 @@ class ContinuousBatcher(_BatcherBase):
             tok = int(self._pick(np.asarray(logits._data)[:, -1])[0])
             req.slot = slot
             req.tokens.append(tok)
+            self._stat_tokens += 1
             self._slot_req[slot] = req
             self._t[slot, 0] = len(req.prompt)
             self._last_tok[slot, 0] = tok
@@ -220,6 +252,8 @@ class ContinuousBatcher(_BatcherBase):
         finished = self._admit()
         if not self._slot_req:
             return finished
+        self._stat_steps += 1
+        self._stat_occupancy_sum += len(self._slot_req)
         tok_t = paddle.to_tensor(self._last_tok)
         t_t = paddle.to_tensor(self._t)
         # serving is inference by construction: the batcher supplies the
@@ -232,6 +266,7 @@ class ContinuousBatcher(_BatcherBase):
             tok = int(next_tok[slot])
             self._t[slot, 0] += 1
             req.tokens.append(tok)
+            self._stat_tokens += 1
             self._last_tok[slot, 0] = tok
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)
@@ -401,6 +436,7 @@ class PagedContinuousBatcher(_BatcherBase):
             tok = int(self._pick(np.asarray(logits._data))[0])
             req.slot = slot
             req.tokens.append(tok)
+            self._stat_tokens += 1
             self._slot_req[slot] = req
             self._admit_order.append(slot)
             self._dec[slot] = len(ids_np)
@@ -425,6 +461,7 @@ class PagedContinuousBatcher(_BatcherBase):
             req.slot = None
             self._release_slot(slot)
             self._pending.insert(0, req)
+            self._stat_preempted += 1
             return True
         return False
 
@@ -451,6 +488,8 @@ class PagedContinuousBatcher(_BatcherBase):
             return finished
         if self.policy == "ondemand":
             self._grow_for_step()
+        self._stat_steps += 1
+        self._stat_occupancy_sum += len(self._slot_req)
         # the HOST owns the block table and the timeline: re-upload both
         # every step (two tiny int32 arrays) so parked slots never drift —
         # the device step increments dec_lens for all B slots, the host
@@ -464,6 +503,7 @@ class PagedContinuousBatcher(_BatcherBase):
         for slot, req in list(self._slot_req.items()):
             tok = int(next_tok[slot])
             req.tokens.append(tok)
+            self._stat_tokens += 1
             self._last_tok[slot] = tok
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)

@@ -19,15 +19,15 @@ Pieces:
 """
 from __future__ import annotations
 
+import functools
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass, replace
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .. import nn
 from ..nn import functional as F
-from ..nn import initializer as I
 from ..nn.layer import Layer
 
 __all__ = ["UNetConfig", "UNetModel", "ddpm_loss", "ddim_sample",
@@ -52,9 +52,9 @@ def unet_tiny_config(**over) -> UNetConfig:
     cfg = UNetConfig(base_channels=32, channel_mults=(1, 2),
                      num_res_blocks=1, attn_levels=(1,), num_heads=2,
                      groups=4)
-    for k, v in over.items():
-        setattr(cfg, k, v)
-    return cfg
+    # dataclasses.replace rejects unknown fields — a typo'd kwarg errors
+    # instead of silently building the default architecture
+    return replace(cfg, **over)
 
 
 def sd_unet_config(**over) -> UNetConfig:
@@ -63,18 +63,24 @@ def sd_unet_config(**over) -> UNetConfig:
                      channel_mults=(1, 2, 4, 4), num_res_blocks=2,
                      attn_levels=(0, 1, 2), num_heads=8, context_dim=768,
                      groups=32)
-    for k, v in over.items():
-        setattr(cfg, k, v)
-    return cfg
+    return replace(cfg, **over)
+
+
+@functools.lru_cache(maxsize=8)
+def _freqs_table(half: int, max_period: float):
+    """Device-resident sinusoid frequencies (built once per (dim, period),
+    not per forward)."""
+    import paddle_tpu as paddle
+    return paddle.to_tensor(
+        np.exp(-math.log(max_period) * np.arange(half, dtype=np.float32)
+               / half))
 
 
 def timestep_embedding(t, dim: int, max_period: float = 10000.0):
     """Sinusoidal timestep features [B, dim] (DDPM §3.3 / SD form)."""
     import paddle_tpu as paddle
     half = dim // 2
-    freqs = paddle.to_tensor(
-        np.exp(-math.log(max_period) * np.arange(half, dtype=np.float32)
-               / half))
+    freqs = _freqs_table(half, max_period)
     ang = t.astype("float32").unsqueeze(-1) * freqs.unsqueeze(0)
     emb = paddle.concat([paddle.cos(ang), paddle.sin(ang)], axis=-1)
     if dim % 2:
@@ -235,17 +241,24 @@ class UNetModel(Layer):
         return sum(int(np.prod(p.shape)) for p in self.parameters())
 
 
+@functools.lru_cache(maxsize=8)
 def _ddpm_alphas(num_steps: int, beta_start=1e-4, beta_end=2e-2):
     betas = np.linspace(beta_start, beta_end, num_steps, dtype=np.float32)
     return np.cumprod(1.0 - betas)
+
+
+@functools.lru_cache(maxsize=8)
+def _ddpm_alphas_t(num_steps: int):
+    """Device-resident cumulative-alpha table (one upload per schedule)."""
+    import paddle_tpu as paddle
+    return paddle.to_tensor(_ddpm_alphas(num_steps))
 
 
 def ddpm_loss(model, x0, t, noise, context=None, num_steps: int = 1000):
     """Noise-prediction MSE at timesteps t (DDPM eq. 14): the training
     objective of the diffusion family. x0 [B, C, H, W]; t [B] int;
     noise ~ N(0, 1) like x0."""
-    import paddle_tpu as paddle
-    abar = paddle.to_tensor(_ddpm_alphas(num_steps))
+    abar = _ddpm_alphas_t(num_steps)
     a = abar[t].reshape([-1, 1, 1, 1]).astype(x0.dtype)
     xt = x0 * a.sqrt() + noise * (1.0 - a).sqrt()
     pred = model(xt, t, context)

@@ -225,3 +225,22 @@ def test_sampled_paged_batching_runs():
     for rid, p in zip(rids, prompts):
         assert outs[rid].shape == (len(p) + 6,)
     assert b.free_page_count == b.n_pages
+
+
+def test_batcher_stats():
+    """Serving observability: counters reflect steps, tokens, occupancy,
+    completions, and preemptions."""
+    m = _model()
+    rng = np.random.RandomState(6)
+    b = PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               compile=False)
+    rids = [b.submit(rng.randint(0, 128, (5,)), 4) for _ in range(2)]
+    b.run_until_done()
+    s = b.stats()
+    assert s["completed_requests"] == 2
+    assert s["generated_tokens"] == 8          # 2 requests x 4 tokens
+    assert s["steps"] == 3                     # admission tok + 3 decode steps
+    assert s["mean_active_slots"] == 2.0
+    assert s["slot_utilization"] == 1.0
+    assert s["tokens_per_sec"] > 0
+    assert s["pending_now"] == 0 and s["active_now"] == 0
