@@ -17,6 +17,30 @@ from ..nn.common import Dropout, Embedding, Linear
 from ..nn.norm import LayerNorm
 
 
+def _cachekv_scales_from(arr):
+    """Per-layer static cachekv-int8 scale dicts from a dense cache
+    [L, 2, B, H, S, D]: per-head |K|/|V| amax -> (quant=127/amax,
+    dequant=amax/127). Shared by the GPT-2 and Llama calibrations."""
+    import jax.numpy as jnp
+    amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=(2, 4, 5))
+    amax = jnp.maximum(amax, 1e-6)                    # [L, 2, H]
+    return [{"kq": 127.0 / amax[li, 0], "vq": 127.0 / amax[li, 1],
+             "kdq": amax[li, 0] / 127.0, "vdq": amax[li, 1] / 127.0}
+            for li in range(arr.shape[0])]
+
+
+def _cache_scale_kwargs(scales, li):
+    """block attention kwargs for layer li's cache quantization (empty
+    when the int8 cache is disabled)."""
+    if scales is None:
+        return {}
+    sc = scales[li]
+    return {"cache_k_quant_scales": sc["kq"],
+            "cache_v_quant_scales": sc["vq"],
+            "cache_k_dequant_scales": sc["kdq"],
+            "cache_v_dequant_scales": sc["vdq"]}
+
+
 @dataclass
 class GPT2Config:
     vocab_size: int = 50257
@@ -247,13 +271,31 @@ class GPT2ForCausalLM(Layer):
         """Allocate the physical KV page pool: per layer, (kc, vc) of
         [n_pages, H, block_size, D]. Pages are position-free storage —
         a block table maps (sequence, logical block) -> pool row, so the
-        same pool serves many sequences of different lengths."""
+        same pool serves many sequences of different lengths. After
+        calibrate_cachekv_int8 the pools allocate int8."""
         import paddle_tpu as paddle
         cfg = self.config
         h, d = cfg.num_attention_heads, cfg.head_dim
-        return [(paddle.zeros([n_pages, h, block_size, d], dtype=cfg.dtype),
-                 paddle.zeros([n_pages, h, block_size, d], dtype=cfg.dtype))
+        dtype = "int8" if self._cachekv_scales is not None else cfg.dtype
+        return [(paddle.zeros([n_pages, h, block_size, d], dtype=dtype),
+                 paddle.zeros([n_pages, h, block_size, d], dtype=dtype))
                 for _ in range(cfg.num_hidden_layers)]
+
+    _cachekv_scales = None
+
+    def calibrate_cachekv_int8(self, sample_ids):
+        """Static per-head int8 cache scales from a calibration batch
+        (reference cache_k_quant_scales, static mode) — mirrors the Llama
+        API; see _cachekv_scales_from. Pass None to disable."""
+        if sample_ids is None:
+            self._cachekv_scales = None
+            return None
+        import paddle_tpu as paddle
+        b, s = sample_ids.shape
+        with paddle.no_grad():
+            _, caches, _ = self.prefill(sample_ids, s)
+        self._cachekv_scales = _cachekv_scales_from(caches._data)
+        return self._cachekv_scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
                            block_size=64):
@@ -282,12 +324,14 @@ class GPT2ForCausalLM(Layer):
             pos_flat)
         hidden = self.transformer.drop(hidden)
         layers_state = []
-        for blk, (kc, vc) in zip(self.transformer.h, layers):
+        for li, (blk, (kc, vc)) in enumerate(zip(self.transformer.h,
+                                                 layers)):
             x = blk.ln_1(hidden)
             qkv = blk.attn.c_attn(x)                     # [T, 3*H*D]
             out, _, kc, vc = block_multihead_attention(
                 qkv, kc, vc, enc, dec, enc, None, None, cu_q, cu_q, bt,
-                block_size=block_size)
+                block_size=block_size,
+                **_cache_scale_kwargs(self._cachekv_scales, li))
             hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
             hidden = hidden + blk.mlp(blk.ln_2(hidden))
             layers_state.append((kc, vc))
@@ -396,12 +440,14 @@ class GPT2ForCausalLM(Layer):
         hidden = self.transformer.wte(tok) + self.transformer.wpe(t)
         hidden = self.transformer.drop(hidden)
         new_layers = []
-        for blk, (kc, vc) in zip(self.transformer.h, state["layers"]):
+        for li, (blk, (kc, vc)) in enumerate(zip(self.transformer.h,
+                                                 state["layers"])):
             x = blk.ln_1(hidden)
             qkv = blk.attn.c_attn(x)                     # [B, 3*H*D]
             out, _, kc, vc = block_multihead_attention(
                 qkv, kc, vc, enc, t, this, None, None, cu_q, cu_q, bt,
-                block_size=state["block_size"])
+                block_size=state["block_size"],
+                **_cache_scale_kwargs(self._cachekv_scales, li))
             hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
             hidden = hidden + blk.mlp(blk.ln_2(hidden))
             new_layers.append((kc, vc))

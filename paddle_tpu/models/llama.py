@@ -872,19 +872,14 @@ class LlamaForCausalLM(Layer):
             self._cachekv_scales = None
             return None
         import paddle_tpu as paddle
+        from .gpt import _cachekv_scales_from
         b, s = sample_ids.shape
         with paddle.no_grad():
             _, caches = self.model.forward_prefill(sample_ids, s)
-        arr = caches._data            # [L, 2, B, KV, s, D]
-        amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=(2, 4, 5))
-        amax = jnp.maximum(amax, 1e-6)                    # [L, 2, KV]
-        scales = []
-        for li in range(arr.shape[0]):
-            ka, va = amax[li, 0], amax[li, 1]
-            scales.append({"kq": 127.0 / ka, "vq": 127.0 / va,
-                           "kdq": ka / 127.0, "vdq": va / 127.0})
-        self._cachekv_scales = scales
-        return scales
+        # caches [L, 2, B, KV, s, D] (post-RoPE rows, matching what the
+        # paged route quantizes)
+        self._cachekv_scales = _cachekv_scales_from(caches._data)
+        return self._cachekv_scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
                            block_size=64):
@@ -930,13 +925,8 @@ class LlamaForCausalLM(Layer):
     def _layer_cache_scales(self, li):
         """block_gqa_attention kwargs for layer li's cache quantization
         (empty when the int8 cache is disabled)."""
-        if self._cachekv_scales is None:
-            return {}
-        sc = self._cachekv_scales[li]
-        return {"cache_k_quant_scales": sc["kq"],
-                "cache_v_quant_scales": sc["vq"],
-                "cache_k_dequant_scales": sc["kdq"],
-                "cache_v_dequant_scales": sc["vdq"]}
+        from .gpt import _cache_scale_kwargs
+        return _cache_scale_kwargs(self._cachekv_scales, li)
 
     def paged_prefill(self, input_ids, block_size=64, blocks_per_seq=None):
         """Prompt pass through a freshly allocated paged cache. Returns
