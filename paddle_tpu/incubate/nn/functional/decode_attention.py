@@ -46,6 +46,30 @@ def _token_timeline(cu_q, dec, token_num):
     return seq_of, local, pos
 
 
+def cachekv_scales_from_dense(arr):
+    """Per-layer static cachekv-int8 scale dicts from a dense cache
+    [L, 2, B, H, S, D]: per-head |K|/|V| amax -> (quant=127/amax,
+    dequant=amax/127). Model-agnostic (GPT-2 and Llama calibrations both
+    feed their prefill caches through this)."""
+    amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=(2, 4, 5))
+    amax = jnp.maximum(amax, 1e-6)                    # [L, 2, H]
+    return [{"kq": 127.0 / amax[li, 0], "vq": 127.0 / amax[li, 1],
+             "kdq": amax[li, 0] / 127.0, "vdq": amax[li, 1] / 127.0}
+            for li in range(arr.shape[0])]
+
+
+def cachekv_scale_kwargs(scales, li):
+    """Block-attention kwargs for layer li's cache quantization (empty
+    when the int8 cache is disabled)."""
+    if scales is None:
+        return {}
+    sc = scales[li]
+    return {"cache_k_quant_scales": sc["kq"],
+            "cache_v_quant_scales": sc["vq"],
+            "cache_k_dequant_scales": sc["kdq"],
+            "cache_v_dequant_scales": sc["vdq"]}
+
+
 def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant):
     """Validate the static cachekv-int8 contract and return the four
     scale arrays. All-or-nothing: partial scale sets would silently skip
@@ -408,5 +432,6 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
 
 
 __all__ = ["masked_multihead_attention", "block_multihead_attention",
-           "block_gqa_attention",
+           "block_gqa_attention", "cachekv_scales_from_dense",
+           "cachekv_scale_kwargs",
            "variable_length_memory_efficient_attention"]

@@ -306,6 +306,7 @@ class PagedContinuousBatcher(_BatcherBase):
                  block_size: int = 16, n_pages: Optional[int] = None,
                  eos_id: Optional[int] = None, compile: bool = True,
                  policy: str = "reserve",
+                 prefill_chunk: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: Optional[float] = None,
                  seed: Optional[int] = None):
@@ -313,6 +314,8 @@ class PagedContinuousBatcher(_BatcherBase):
 
         if policy not in ("reserve", "ondemand"):
             raise ValueError(f"unknown policy {policy!r}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         cfg = model.config
         self._check_window(cfg, s_max)
         self.model = model
@@ -353,6 +356,7 @@ class PagedContinuousBatcher(_BatcherBase):
             "cu_b": paddle.to_tensor(np.arange(max_batch + 1,
                                                dtype=np.int32)),
         }
+        self.prefill_chunk = prefill_chunk
         if compile:
             from .. import jit
             # donate the state pytree (arg 1): the page pool is the big
@@ -361,6 +365,21 @@ class PagedContinuousBatcher(_BatcherBase):
                                           donate_args=(1,))
         else:
             self._step_fn = model.paged_decode_step
+        if prefill_chunk is not None:
+            # one fixed-width append executable serves EVERY prompt
+            # length (vLLM chunked prefill); without it each distinct
+            # prompt length costs a fresh prefill compile
+            def _chunk(ids, layers, bt_row, dec):
+                return model.paged_prefill_into(
+                    ids, layers, bt_row, block_size, dec_base=dec,
+                    return_all_logits=True)
+            if compile:
+                from .. import jit
+                # donate the pool (arg 1) exactly like the decode step —
+                # chunked prefill must not double-buffer the cache HBM
+                self._chunk_fn = jit.to_static(_chunk, donate_args=(1,))
+            else:
+                self._chunk_fn = _chunk
 
     # -- page accounting ----------------------------------------------------
     def _pages_for(self, n_rows: int) -> int:
@@ -414,25 +433,36 @@ class PagedContinuousBatcher(_BatcherBase):
             ids_np = np.concatenate(
                 [req.prompt, np.asarray(req.tokens, np.int64)]) \
                 if req.tokens else req.prompt
+            L = len(ids_np)
+            # chunked prefill writes rows up to the padded length, capped
+            # at the slot's capacity (the tail chunk shortens instead of
+            # overflowing the block table)
+            padded = (min(-(-L // self.prefill_chunk) * self.prefill_chunk,
+                          self.blocks_per_seq * self.block_size)
+                      if self.prefill_chunk else L)
             if self.policy == "reserve":
-                need = self._pages_for(len(ids_np) + req.max_new_tokens
-                                       - len(req.tokens))
+                upto = max(padded,
+                           L + req.max_new_tokens - len(req.tokens))
             else:
-                need = self._pages_for(len(ids_np) + 1)
+                upto = max(padded, L + 1)
+            need = self._pages_for(upto)
             if need > len(self._free_pages):
                 break
             self._pending.pop(0)
             slot = self._free_slots.pop(0)
-            upto = len(ids_np) + (req.max_new_tokens - len(req.tokens)
-                                  if self.policy == "reserve" else 1)
             if not self._alloc_pages(slot, upto):
                 raise RuntimeError("page accounting bug: admission gate "
                                    "passed but allocation failed")
             bt_row = paddle.to_tensor(self._bt[slot:slot + 1])
-            ids = paddle.to_tensor(ids_np[None, :])
             with paddle.no_grad():
-                logits, self._state["layers"] = self.model.paged_prefill_into(
-                    ids, self._state["layers"], bt_row, self.block_size)
+                if self.prefill_chunk:
+                    logits = self._prefill_chunked(ids_np, bt_row)
+                else:
+                    ids = paddle.to_tensor(ids_np[None, :])
+                    logits, self._state["layers"] = \
+                        self.model.paged_prefill_into(
+                            ids, self._state["layers"], bt_row,
+                            self.block_size)
             tok = int(self._pick(np.asarray(logits._data))[0])
             req.slot = slot
             req.tokens.append(tok)
@@ -444,6 +474,34 @@ class PagedContinuousBatcher(_BatcherBase):
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)
         return finished
+
+    def _prefill_chunked(self, ids_np, bt_row):
+        """Feed the prompt through fixed-width append chunks (ONE compiled
+        executable for every prompt length). The tail chunk is zero-padded;
+        pad rows land past the true timeline and are overwritten by decode
+        before any bounded read reaches them. Returns the last REAL
+        position's logits [1, V]."""
+        import paddle_tpu as paddle
+        C = self.prefill_chunk
+        L = len(ids_np)
+        cap = self.blocks_per_seq * self.block_size
+        padded_len = min(-(-L // C) * C, cap)
+        padded = np.zeros((padded_len,), np.int64)
+        padded[:L] = ids_np
+        dec = 0
+        logits_all = None
+        while dec < padded_len:
+            w = min(C, padded_len - dec)     # tail shortens at capacity
+            ids_t = paddle.to_tensor(padded[None, dec:dec + w])
+            dec_t = paddle.to_tensor(np.array([dec], np.int32))
+            logits_all, self._state["layers"] = self._chunk_fn(
+                ids_t, self._state["layers"], bt_row, dec_t)
+            dec += w
+        # logits at the last REAL position within the final chunk (the
+        # final chunk always contains it: its start k*C < L by the
+        # ceil-padding construction)
+        last_chunk_start = padded_len - logits_all.shape[1]
+        return logits_all[:, (L - 1) - last_chunk_start]
 
     def _sync_tables(self):
         import paddle_tpu as paddle

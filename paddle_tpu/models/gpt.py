@@ -17,28 +17,11 @@ from ..nn.common import Dropout, Embedding, Linear
 from ..nn.norm import LayerNorm
 
 
-def _cachekv_scales_from(arr):
-    """Per-layer static cachekv-int8 scale dicts from a dense cache
-    [L, 2, B, H, S, D]: per-head |K|/|V| amax -> (quant=127/amax,
-    dequant=amax/127). Shared by the GPT-2 and Llama calibrations."""
-    import jax.numpy as jnp
-    amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=(2, 4, 5))
-    amax = jnp.maximum(amax, 1e-6)                    # [L, 2, H]
-    return [{"kq": 127.0 / amax[li, 0], "vq": 127.0 / amax[li, 1],
-             "kdq": amax[li, 0] / 127.0, "vdq": amax[li, 1] / 127.0}
-            for li in range(arr.shape[0])]
-
-
-def _cache_scale_kwargs(scales, li):
-    """block attention kwargs for layer li's cache quantization (empty
-    when the int8 cache is disabled)."""
-    if scales is None:
-        return {}
-    sc = scales[li]
-    return {"cache_k_quant_scales": sc["kq"],
-            "cache_v_quant_scales": sc["vq"],
-            "cache_k_dequant_scales": sc["kdq"],
-            "cache_v_dequant_scales": sc["vdq"]}
+# shared cachekv-int8 calibration helpers live beside the scale contract
+# in incubate.nn.functional.decode_attention (model-agnostic)
+from ..incubate.nn.functional.decode_attention import (  # noqa: E402
+    cachekv_scale_kwargs as _cache_scale_kwargs,
+    cachekv_scales_from_dense as _cachekv_scales_from)
 
 
 @dataclass
@@ -298,7 +281,8 @@ class GPT2ForCausalLM(Layer):
         return self._cachekv_scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
-                           block_size=64):
+                           block_size=64, dec_base=None,
+                           return_all_logits=False):
         """Prompt pass writing KV into a CALLER-OWNED page pool.
 
         input_ids [B, s]; layers: ``paged_alloc`` pool; block_tables
@@ -306,6 +290,13 @@ class GPT2ForCausalLM(Layer):
         Returns (last_logits [B, V], new_layers). This is the admission
         primitive continuous batchers use: the pool persists across
         requests, only the named pages are written.
+
+        dec_base [B] int32 (optional): CHUNKED-prefill mode — this call
+        appends s tokens after an existing prefix of dec_base rows
+        (multi-token decode-mode append: pos = dec_base + local, causal
+        within the chunk, attending the whole prefix). A fixed chunk
+        width makes prompt processing reuse ONE executable for every
+        prompt length instead of compiling per length.
         """
         import paddle_tpu as paddle
         from ..incubate.nn.functional.decode_attention import \
@@ -313,29 +304,42 @@ class GPT2ForCausalLM(Layer):
 
         b, s = input_ids.shape
         bt = block_tables
-        enc = paddle.to_tensor(np.full((b,), s, np.int32))
-        dec = paddle.to_tensor(np.zeros((b,), np.int32))
+        if dec_base is None:
+            enc = paddle.to_tensor(np.full((b,), s, np.int32))
+            dec = paddle.to_tensor(np.zeros((b,), np.int32))
+            pos_row = paddle.to_tensor(
+                np.tile(np.arange(s, dtype=np.int32), (b, 1)))
+        else:
+            enc = paddle.to_tensor(np.zeros((b,), np.int32))
+            dec = dec_base
+            pos_row = dec_base.reshape([b, 1]) + paddle.to_tensor(
+                np.arange(s, dtype=np.int32)).reshape([1, s])
         cu_q = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
 
         # packed-token forward: hidden is [T, E] (sequences concatenated)
         ids_flat = input_ids.reshape([b * s])
-        pos_flat = paddle.to_tensor(np.tile(np.arange(s, dtype=np.int32), b))
+        pos_flat = pos_row.reshape([b * s])
         hidden = self.transformer.wte(ids_flat) + self.transformer.wpe(
             pos_flat)
         hidden = self.transformer.drop(hidden)
+        this = paddle.to_tensor(np.full((b,), s, np.int32))
         layers_state = []
         for li, (blk, (kc, vc)) in enumerate(zip(self.transformer.h,
                                                  layers)):
             x = blk.ln_1(hidden)
             qkv = blk.attn.c_attn(x)                     # [T, 3*H*D]
             out, _, kc, vc = block_multihead_attention(
-                qkv, kc, vc, enc, dec, enc, None, None, cu_q, cu_q, bt,
+                qkv, kc, vc, enc, dec, this, None, None, cu_q, cu_q, bt,
                 block_size=block_size,
                 **_cache_scale_kwargs(self._cachekv_scales, li))
             hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
             hidden = hidden + blk.mlp(blk.ln_2(hidden))
             layers_state.append((kc, vc))
         hidden = self.transformer.ln_f(hidden)
+        if return_all_logits:
+            # chunked prefill: the caller picks the last REAL position
+            return (self._logits(hidden.reshape([b, s, -1])),
+                    layers_state)
         # last token of each sequence
         last = hidden.reshape([b, s, -1])[:, s - 1]
         return self._logits(last), layers_state

@@ -872,7 +872,8 @@ class LlamaForCausalLM(Layer):
             self._cachekv_scales = None
             return None
         import paddle_tpu as paddle
-        from .gpt import _cachekv_scales_from
+        from ..incubate.nn.functional.decode_attention import \
+            cachekv_scales_from_dense as _cachekv_scales_from
         b, s = sample_ids.shape
         with paddle.no_grad():
             _, caches = self.model.forward_prefill(sample_ids, s)
@@ -882,11 +883,16 @@ class LlamaForCausalLM(Layer):
         return self._cachekv_scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
-                           block_size=64):
+                           block_size=64, dec_base=None,
+                           return_all_logits=False):
         """Prompt pass writing post-RoPE K / raw V into a CALLER-OWNED page
         pool (block_gqa_attention in encoder mode). input_ids [B, s];
         block_tables [B, blocks_per_seq]. Returns (last_logits [B, V],
         new_layers) — the admission primitive for PagedContinuousBatcher.
+
+        dec_base [B] int32 (optional): chunked-prefill append mode — see
+        the GPT-2 docstring; RoPE positions follow the timeline
+        (dec_base + local) inside the op, so chunks are exact.
         """
         import paddle_tpu as paddle
         from ..incubate.nn.functional.decode_attention import \
@@ -897,8 +903,13 @@ class LlamaForCausalLM(Layer):
         b, s = input_ids.shape
         h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                      cfg.head_dim)
-        enc = paddle.to_tensor(np.full((b,), s, np.int32))
-        dec = paddle.to_tensor(np.zeros((b,), np.int32))
+        if dec_base is None:
+            enc = paddle.to_tensor(np.full((b,), s, np.int32))
+            dec = paddle.to_tensor(np.zeros((b,), np.int32))
+        else:
+            enc = paddle.to_tensor(np.zeros((b,), np.int32))
+            dec = dec_base
+        this = paddle.to_tensor(np.full((b,), s, np.int32))
         cu_q = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
         model = self.model
         cos_tab, sin_tab = model._cos, model._sin
@@ -912,7 +923,7 @@ class LlamaForCausalLM(Layer):
             k = attn.k_proj(x).reshape([b * s, kvh, d])
             v = attn.v_proj(x).reshape([b * s, kvh, d])
             out, kc, vc = block_gqa_attention(
-                q, k, v, kc, vc, enc, dec, enc, cu_q, block_tables,
+                q, k, v, kc, vc, enc, dec, this, cu_q, block_tables,
                 block_size=block_size, rope_cos=Tensor(cos_tab),
                 rope_sin=Tensor(sin_tab), **self._layer_cache_scales(li))
             hidden = hidden + attn.o_proj(out.reshape([b, s, h * d]))
@@ -920,13 +931,17 @@ class LlamaForCausalLM(Layer):
                 layer.post_attention_layernorm(hidden))
             layers_state.append((kc, vc))
         hidden = model.norm(hidden)
+        if return_all_logits:
+            # chunked prefill: the caller picks the last REAL position
+            return self._lm_logits(hidden), layers_state
         return self._lm_logits(hidden[:, s - 1]), layers_state
 
     def _layer_cache_scales(self, li):
         """block_gqa_attention kwargs for layer li's cache quantization
         (empty when the int8 cache is disabled)."""
-        from .gpt import _cache_scale_kwargs
-        return _cache_scale_kwargs(self._cachekv_scales, li)
+        from ..incubate.nn.functional.decode_attention import \
+            cachekv_scale_kwargs
+        return cachekv_scale_kwargs(self._cachekv_scales, li)
 
     def paged_prefill(self, input_ids, block_size=64, blocks_per_seq=None):
         """Prompt pass through a freshly allocated paged cache. Returns
