@@ -52,6 +52,11 @@ class _BatcherBase:
         self._next_rid = 0
         # serving observability (reference analog: the predictor's
         # benchmark counters): totals since construction
+        self.reset_stats()
+
+    def reset_stats(self):
+        """Zero the counters and restart the clock — call after warmup so
+        steady-state throughput excludes compile time."""
         self._stat_steps = 0
         self._stat_tokens = 0
         self._stat_occupancy_sum = 0
@@ -369,10 +374,10 @@ class PagedContinuousBatcher(_BatcherBase):
             # one fixed-width append executable serves EVERY prompt
             # length (vLLM chunked prefill); without it each distinct
             # prompt length costs a fresh prefill compile
-            def _chunk(ids, layers, bt_row, dec):
+            def _chunk(ids, layers, bt_row, dec, at):
                 return model.paged_prefill_into(
                     ids, layers, bt_row, block_size, dec_base=dec,
-                    return_all_logits=True)
+                    logits_at=at)
             if compile:
                 from .. import jit
                 # donate the pool (arg 1) exactly like the decode step —
@@ -415,9 +420,17 @@ class PagedContinuousBatcher(_BatcherBase):
     # -- request lifecycle --------------------------------------------------
     def _validate(self, prompt: np.ndarray, max_new_tokens: int):
         super()._validate(prompt, max_new_tokens)
-        worst = self._pages_for(len(prompt) + max_new_tokens)
-        if worst > self.n_pages:
-            raise ValueError(f"request needs {worst} pages but the pool "
+        worst = len(prompt) + max_new_tokens
+        if self.prefill_chunk:
+            # chunk padding can demand more rows than the timeline (a
+            # preemption-resume prompt pads up to one chunk beyond);
+            # reject now rather than livelock admission later
+            worst = max(worst, min(
+                -(-worst // self.prefill_chunk) * self.prefill_chunk,
+                self.blocks_per_seq * self.block_size))
+        pages = self._pages_for(worst)
+        if pages > self.n_pages:
+            raise ValueError(f"request needs {pages} pages but the pool "
                              f"holds {self.n_pages}")
 
     def _admit(self) -> List[int]:
@@ -489,19 +502,22 @@ class PagedContinuousBatcher(_BatcherBase):
         padded = np.zeros((padded_len,), np.int64)
         padded[:L] = ids_np
         dec = 0
-        logits_all = None
+        logits = None
         while dec < padded_len:
             w = min(C, padded_len - dec)     # tail shortens at capacity
+            has_last = 0 <= (L - 1) - dec < w
+            at = (L - 1) - dec if has_last else 0
             ids_t = paddle.to_tensor(padded[None, dec:dec + w])
             dec_t = paddle.to_tensor(np.array([dec], np.int32))
-            logits_all, self._state["layers"] = self._chunk_fn(
-                ids_t, self._state["layers"], bt_row, dec_t)
+            at_t = paddle.to_tensor(np.array([at], np.int32))
+            lg, self._state["layers"] = self._chunk_fn(
+                ids_t, self._state["layers"], bt_row, dec_t, at_t)
+            if has_last:
+                # the final chunk always contains position L-1 (its start
+                # k*C < L by the ceil-padding construction)
+                logits = lg
             dec += w
-        # logits at the last REAL position within the final chunk (the
-        # final chunk always contains it: its start k*C < L by the
-        # ceil-padding construction)
-        last_chunk_start = padded_len - logits_all.shape[1]
-        return logits_all[:, (L - 1) - last_chunk_start]
+        return logits
 
     def _sync_tables(self):
         import paddle_tpu as paddle

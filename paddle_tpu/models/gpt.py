@@ -281,8 +281,7 @@ class GPT2ForCausalLM(Layer):
         return self._cachekv_scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
-                           block_size=64, dec_base=None,
-                           return_all_logits=False):
+                           block_size=64, dec_base=None, logits_at=None):
         """Prompt pass writing KV into a CALLER-OWNED page pool.
 
         input_ids [B, s]; layers: ``paged_alloc`` pool; block_tables
@@ -336,12 +335,16 @@ class GPT2ForCausalLM(Layer):
             hidden = hidden + blk.mlp(blk.ln_2(hidden))
             layers_state.append((kc, vc))
         hidden = self.transformer.ln_f(hidden)
-        if return_all_logits:
-            # chunked prefill: the caller picks the last REAL position
-            return (self._logits(hidden.reshape([b, s, -1])),
-                    layers_state)
-        # last token of each sequence
-        last = hidden.reshape([b, s, -1])[:, s - 1]
+        h3 = hidden.reshape([b, s, -1])
+        if logits_at is not None:
+            # chunked prefill: project ONLY the requested position (the
+            # lm head over all C positions would be C x the needed FLOPs)
+            import paddle_tpu as paddle
+            oh = F.one_hot(logits_at.reshape([b]).astype("int64"),
+                           s).astype(h3.dtype)
+            last = paddle.einsum("bs,bse->be", oh, h3)
+        else:
+            last = h3[:, s - 1]          # last token of each sequence
         return self._logits(last), layers_state
 
     @staticmethod

@@ -883,8 +883,7 @@ class LlamaForCausalLM(Layer):
         return self._cachekv_scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
-                           block_size=64, dec_base=None,
-                           return_all_logits=False):
+                           block_size=64, dec_base=None, logits_at=None):
         """Prompt pass writing post-RoPE K / raw V into a CALLER-OWNED page
         pool (block_gqa_attention in encoder mode). input_ids [B, s];
         block_tables [B, blocks_per_seq]. Returns (last_logits [B, V],
@@ -931,9 +930,13 @@ class LlamaForCausalLM(Layer):
                 layer.post_attention_layernorm(hidden))
             layers_state.append((kc, vc))
         hidden = model.norm(hidden)
-        if return_all_logits:
-            # chunked prefill: the caller picks the last REAL position
-            return self._lm_logits(hidden), layers_state
+        if logits_at is not None:
+            # chunked prefill: project ONLY the requested position (the
+            # lm head over all C positions would be C x the needed FLOPs)
+            oh = F.one_hot(logits_at.reshape([b]).astype("int64"),
+                           s).astype(hidden.dtype)
+            last = paddle.einsum("bs,bse->be", oh, hidden)
+            return self._lm_logits(last), layers_state
         return self._lm_logits(hidden[:, s - 1]), layers_state
 
     def _layer_cache_scales(self, li):
