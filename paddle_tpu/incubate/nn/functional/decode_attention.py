@@ -70,11 +70,14 @@ def cachekv_scale_kwargs(scales, li):
             "cache_v_dequant_scales": sc["vdq"]}
 
 
-def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant):
-    """Validate the static cachekv-int8 contract and return the four
-    scale arrays. All-or-nothing: partial scale sets would silently skip
+def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant,
+                    dynamic=False):
+    """Validate the cachekv-int8 contract and return the four scale
+    arrays. All-or-nothing: partial scale sets would silently skip
     quantization, and an int8 pool without scales would astype-truncate
-    raw fp rows into int8 codes — both are loud errors instead."""
+    raw fp rows into int8 codes — both are loud errors instead. In
+    dynamic mode an int8 pool with NO scales is legal: the op computes
+    per-(sequence, head) scales from this call's rows (prefill)."""
     scales = (_arr(k_quant), _arr(v_quant), _arr(k_dequant),
               _arr(v_dequant))
     given = [s is not None for s in scales]
@@ -82,7 +85,7 @@ def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant):
         raise ValueError("cachekv int8 needs all four scale tensors "
                          "(k/v quant + k/v dequant)")
     is_int8 = jnp.issubdtype(kc.dtype, jnp.integer)
-    if is_int8 and not all(given):
+    if is_int8 and not all(given) and not dynamic:
         raise ValueError(
             "int8 cache pool but no quant scales: calibrate first (a raw "
             "astype would truncate fp rows into int8 codes)")
@@ -92,20 +95,72 @@ def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant):
     return scales
 
 
+def _dynamic_prefill_scales(kt, vt, seq_of, bsz):
+    """Per-(sequence, head) amax scales from THIS call's K/V rows — the
+    reference's DynamicQuantCacheKernel: prefill fills [B, H] quant
+    (127/amax) and dequant (amax/127) tensors that decode then consumes.
+    kt/vt [T, H, D]."""
+    ka = jax.ops.segment_max(jnp.abs(kt.astype(jnp.float32)).max(-1),
+                             seq_of, num_segments=bsz)        # [B, H]
+    va = jax.ops.segment_max(jnp.abs(vt.astype(jnp.float32)).max(-1),
+                             seq_of, num_segments=bsz)
+    ka = jnp.maximum(ka, 1e-6)
+    va = jnp.maximum(va, 1e-6)
+    return {"kq": 127.0 / ka, "vq": 127.0 / va,
+            "kdq": ka / 127.0, "vdq": va / 127.0}
+
+
+def _per_token_scale(scale, seq_of):
+    """Broadcastable quant scale for [T, H, D] rows: [H] static or
+    [B, H] dynamic (indexed per token's sequence)."""
+    if scale.ndim == 2:
+        return scale[seq_of][:, :, None]
+    return scale[None, :, None]
+
+
+def _per_seq_scale(scale, bsz):
+    """Broadcastable dequant scale for the gathered [B, H, S, D]
+    timeline: [H] static or [B, H] dynamic."""
+    if scale.ndim == 2:
+        if scale.shape[0] != bsz:
+            raise ValueError(f"dynamic cachekv scales are per sequence: "
+                             f"got {scale.shape[0]} rows for batch {bsz}")
+        return scale[:, :, None, None]
+    return scale[None, :, None, None]
+
+
+def _dynamic_compute_allowed(enc):
+    """Dynamic-mode scale computation is a PREFILL-call contract: a
+    decode call that forgot to thread the prefill's scales must not
+    silently re-derive them from one token. With concrete lengths
+    (host-driven serving loops) this is enforced loudly; under jit
+    tracing the values are unknowable and the documented contract
+    governs."""
+    try:
+        if not bool((enc > 0).any()):
+            raise ValueError(
+                "use_dynamic_cachekv_quant with no scales on a "
+                "decode-shaped call (all seq_lens_encoder == 0): thread "
+                "the scales the prefill call returned")
+    except jax.errors.TracerBoolConversionError:
+        pass
+
+
 def _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, block_size,
                    k_quant=None, v_quant=None):
     """Write each token's k/v row at (block_tables[seq, pos//bs], pos%bs).
 
-    k_quant/v_quant: optional per-head STATIC quant scales [H] (reference
-    cache_k_quant_scales) — rows are quantized to int8 on the way in, so
-    the pool holds int8 and cache HBM halves vs bf16 (quarters vs fp32).
+    k_quant/v_quant: optional quant scales — per-head STATIC [H]
+    (reference cache_k_quant_scales) or per-(sequence, head) DYNAMIC
+    [B, H]. Rows are quantized to int8 on the way in, so the pool holds
+    int8 and cache HBM halves vs bf16 (quarters vs fp32).
     """
     if k_quant is not None:
         kt = jnp.clip(jnp.round(kt.astype(jnp.float32)
-                                * k_quant[None, :, None]),
+                                * _per_token_scale(k_quant, seq_of)),
                       -127, 127).astype(jnp.int8)
         vt = jnp.clip(jnp.round(vt.astype(jnp.float32)
-                                * v_quant[None, :, None]),
+                                * _per_token_scale(v_quant, seq_of)),
                       -127, 127).astype(jnp.int8)
     phys = bt[seq_of, pos // block_size]
     off = pos % block_size
@@ -126,8 +181,8 @@ def _gather_paged(kc, vc, bt, heads, k_dequant=None, v_dequant=None,
     gk = jnp.moveaxis(gk, 2, 1).reshape(bsz, heads, s_kv, hd)
     gv = jnp.moveaxis(gv, 2, 1).reshape(bsz, heads, s_kv, hd)
     if k_dequant is not None:
-        scale_k = k_dequant[None, :, None, None]
-        scale_v = v_dequant[None, :, None, None]
+        scale_k = _per_seq_scale(k_dequant, bsz)
+        scale_v = _per_seq_scale(v_dequant, bsz)
         gk = (gk.astype(jnp.float32) * scale_k).astype(out_dtype)
         gv = (gv.astype(jnp.float32) * scale_v).astype(out_dtype)
     return gk, gv, s_kv
@@ -228,24 +283,28 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     seq_lens_this_time[i] == 1 (appends at seq_lens_decoder[i], attends to
     the full prefix through the block table).
 
-    Cache-KV int8 (static): pass cache_k/v_quant_scales + dequant_scales
-    of shape [num_head] and int8 cache pools — rows quantize on the
-    scatter, the gathered timeline dequantizes before the dot (reference
-    static cachekv-int8 mode; dynamic per-step scale search is gated).
+    Cache-KV int8: pass cache_k/v_quant_scales + dequant_scales of shape
+    [num_head] (static mode) or [B, num_head]
+    (use_dynamic_cachekv_quant=True: per-sequence scales the reference's
+    DynamicQuantCacheKernel fills at prefill) with int8 cache pools —
+    rows quantize on the scatter, the gathered timeline dequantizes
+    before the dot. In dynamic mode with NO scales given (the prefill
+    call), the op computes them from this call's K/V and RETURNS them as
+    a fifth element: a (kq, vq, kdq, vdq) tuple of [B, H] tensors for
+    the decode calls to consume.
 
-    Returns (out [token_num, H*D], qkv, key_cache_out, value_cache_out).
+    Returns (out [token_num, H*D], qkv, key_cache_out, value_cache_out
+    [, scales]).
     """
     if qkv_out_scale is not None or out_scale != -1:
         raise NotImplementedError(
             "quantized activation path: use paddle_tpu.quantization")
-    if use_dynamic_cachekv_quant and cache_k_quant_scales is not None:
-        raise NotImplementedError(
-            "dynamic cachekv quant: static per-head scales only")
     qkv_a = _arr(qkv)
     kc, vc = _arr(key_cache), _arr(value_cache)
     kq, vq, kdq, vdq = _cachekv_scales(
         kc, cache_k_quant_scales, cache_v_quant_scales,
-        cache_k_dequant_scales, cache_v_dequant_scales)
+        cache_k_dequant_scales, cache_v_dequant_scales,
+        dynamic=use_dynamic_cachekv_quant)
     enc = _arr(seq_lens_encoder).reshape(-1).astype(jnp.int32)
     dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
     this = _arr(seq_lens_this_time).reshape(-1).astype(jnp.int32)
@@ -282,6 +341,12 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                              axis=-1).reshape(u.shape).astype(u.dtype)
         qt, kt = _rope(qt), _rope(kt)
 
+    new_scales = None
+    if use_dynamic_cachekv_quant and kq is None:
+        _dynamic_compute_allowed(enc)
+        new_scales = _dynamic_prefill_scales(kt, vt, seq_of, bsz)
+        kq, vq, kdq, vdq = (new_scales["kq"], new_scales["vq"],
+                            new_scales["kdq"], new_scales["vdq"])
     kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_,
                             k_quant=kq, v_quant=vq)
     kv_len = jnp.where(enc > 0, enc, dec + this)               # [B]
@@ -311,8 +376,13 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("ths,tshd->thd", probs.astype(qt.dtype),
                      jnp.moveaxis(gv[seq_of], 1, 2))
-    return (Tensor(out.reshape(token_num, nh * hd)), Tensor(qkv_a),
-            Tensor(kc), Tensor(vc))
+    result = (Tensor(out.reshape(token_num, nh * hd)), Tensor(qkv_a),
+              Tensor(kc), Tensor(vc))
+    if new_scales is not None:
+        result += ((Tensor(new_scales["kq"]), Tensor(new_scales["vq"]),
+                    Tensor(new_scales["kdq"]),
+                    Tensor(new_scales["vdq"])),)
+    return result
 
 
 def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
@@ -321,7 +391,8 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
                         rope_sin=None, cache_k_quant_scales=None,
                         cache_v_quant_scales=None,
                         cache_k_dequant_scales=None,
-                        cache_v_dequant_scales=None):
+                        cache_v_dequant_scales=None,
+                        use_dynamic_cachekv_quant=False):
     """Paged-KV attention with UNEXPANDED grouped-query heads (the GQA
     sibling of block_multihead_attention; reference analog:
     block_multihead_attention.py:19 serving Llama-family models, where
@@ -340,17 +411,19 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
     against the gathered [T, KV, S_kv, D] timeline, which is both the
     memory win of GQA and an MXU-friendly batched matmul.
 
-    Cache-KV int8: same static per-[KV]-head scale contract as
-    block_multihead_attention (quantize on scatter, dequantize the
-    gathered timeline).
+    Cache-KV int8: same scale contract as block_multihead_attention —
+    static [KV] per-head scales, or dynamic [B, KV] per-sequence scales
+    (use_dynamic_cachekv_quant=True; the prefill call with no scales
+    computes and RETURNS them as a fourth element).
 
-    Returns (out [T, H*D], key_cache_out, value_cache_out).
+    Returns (out [T, H*D], key_cache_out, value_cache_out [, scales]).
     """
     qt, kt, vt = _arr(q), _arr(k), _arr(v)
     kc, vc = _arr(key_cache), _arr(value_cache)
     kq, vq, kdq, vdq = _cachekv_scales(
         kc, cache_k_quant_scales, cache_v_quant_scales,
-        cache_k_dequant_scales, cache_v_dequant_scales)
+        cache_k_dequant_scales, cache_v_dequant_scales,
+        dynamic=use_dynamic_cachekv_quant)
     enc = _arr(seq_lens_encoder).reshape(-1).astype(jnp.int32)
     dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
     this = _arr(seq_lens_this_time).reshape(-1).astype(jnp.int32)
@@ -375,6 +448,12 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
                              axis=-1).reshape(u.shape).astype(u.dtype)
         qt, kt = _rope(qt), _rope(kt)
 
+    new_scales = None
+    if use_dynamic_cachekv_quant and kq is None:
+        _dynamic_compute_allowed(enc)
+        new_scales = _dynamic_prefill_scales(kt, vt, seq_of, bsz)
+        kq, vq, kdq, vdq = (new_scales["kq"], new_scales["vq"],
+                            new_scales["kdq"], new_scales["vdq"])
     kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_,
                             k_quant=kq, v_quant=vq)
     kv_len = jnp.where(enc > 0, enc, dec + this)
@@ -393,8 +472,13 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("tgrs,tgsd->tgrd", probs,
                      gv[seq_of].astype(jnp.float32))
-    return (Tensor(out.reshape(token_num, nh * hd).astype(qt.dtype)),
-            Tensor(kc), Tensor(vc))
+    result = (Tensor(out.reshape(token_num, nh * hd).astype(qt.dtype)),
+              Tensor(kc), Tensor(vc))
+    if new_scales is not None:
+        result += ((Tensor(new_scales["kq"]), Tensor(new_scales["vq"]),
+                    Tensor(new_scales["kdq"]),
+                    Tensor(new_scales["vdq"])),)
+    return result
 
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens,

@@ -339,7 +339,6 @@ class GPT2ForCausalLM(Layer):
         if logits_at is not None:
             # chunked prefill: project ONLY the requested position (the
             # lm head over all C positions would be C x the needed FLOPs)
-            import paddle_tpu as paddle
             oh = F.one_hot(logits_at.reshape([b]).astype("int64"),
                            s).astype(h3.dtype)
             last = paddle.einsum("bs,bse->be", oh, h3)

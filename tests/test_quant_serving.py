@@ -268,3 +268,102 @@ def test_cachekv_int8_gpt2_paged():
                                 max_new_tokens=5, block_size=8).numpy()[0]
     np.testing.assert_array_equal(outs[rid], solo)
     m.calibrate_cachekv_int8(None)
+
+
+def test_cachekv_dynamic_quant_gqa():
+    """Dynamic cachekv-int8 (reference DynamicQuantCacheKernel): prefill
+    with no scales computes per-(sequence, head) scales and returns them;
+    decode consumes them; output tracks the fp path within quant noise."""
+    from paddle_tpu.incubate.nn.functional.decode_attention import \
+        block_gqa_attention
+    rng = np.random.RandomState(7)
+    b, h, kvh, d, bs, bps, s = 2, 4, 2, 16, 8, 3, 6
+    n_blocks = b * bps
+
+    def mk(shape):
+        return paddle.to_tensor(rng.randn(*shape).astype(np.float32))
+
+    q, k, v = mk((b * s, h, d)), mk((b * s, kvh, d)), mk((b * s, kvh, d))
+    bt = paddle.to_tensor(np.arange(n_blocks, dtype=np.int32).reshape(b, bps))
+    enc = paddle.to_tensor(np.full((b,), s, np.int32))
+    dec0 = paddle.to_tensor(np.zeros((b,), np.int32))
+    cu = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
+
+    # fp reference: prefill + one decode step
+    kcf = paddle.zeros([n_blocks, kvh, bs, d], dtype="float32")
+    vcf = paddle.zeros([n_blocks, kvh, bs, d], dtype="float32")
+    fp_out, kcf, vcf = block_gqa_attention(q, k, v, kcf, vcf, enc, dec0,
+                                           enc, cu, bt, block_size=bs)
+    q1, k1, v1 = mk((b, h, d)), mk((b, kvh, d)), mk((b, kvh, d))
+    dec1 = paddle.to_tensor(np.full((b,), s, np.int32))
+    one = paddle.to_tensor(np.ones((b,), np.int32))
+    cu1 = paddle.to_tensor(np.arange(b + 1, dtype=np.int32))
+    zero = paddle.to_tensor(np.zeros((b,), np.int32))
+    fp_dec, _, _ = block_gqa_attention(q1, k1, v1, kcf, vcf, zero, dec1,
+                                       one, cu1, bt, block_size=bs)
+
+    # dynamic int8: prefill computes + returns [B, KV] scales
+    kc8 = paddle.zeros([n_blocks, kvh, bs, d], dtype="int8")
+    vc8 = paddle.zeros([n_blocks, kvh, bs, d], dtype="int8")
+    q_out, kc8, vc8, scales = block_gqa_attention(
+        q, k, v, kc8, vc8, enc, dec0, enc, cu, bt, block_size=bs,
+        use_dynamic_cachekv_quant=True)
+    kq, vq, kdq, vdq = scales
+    assert list(kq.shape) == [b, kvh]
+    rel = (np.abs(q_out.numpy() - fp_out.numpy()).max()
+           / (np.abs(fp_out.numpy()).max() + 1e-9))
+    assert rel < 0.05, rel
+    # decode consumes the prefill's scales
+    q_dec, kc8, vc8 = block_gqa_attention(
+        q1, k1, v1, kc8, vc8, zero, dec1, one, cu1, bt, block_size=bs,
+        cache_k_quant_scales=kq, cache_v_quant_scales=vq,
+        cache_k_dequant_scales=kdq, cache_v_dequant_scales=vdq,
+        use_dynamic_cachekv_quant=True)
+    rel = (np.abs(q_dec.numpy() - fp_dec.numpy()).max()
+           / (np.abs(fp_dec.numpy()).max() + 1e-9))
+    assert rel < 0.08, rel
+
+
+def test_cachekv_dynamic_quant_mha_prefill_returns_scales():
+    from paddle_tpu.incubate.nn.functional.decode_attention import \
+        block_multihead_attention
+    rng = np.random.RandomState(8)
+    b, h, d, bs, bps, s = 2, 4, 16, 8, 2, 5
+    n_blocks = b * bps
+    qkv = paddle.to_tensor(rng.randn(b * s, 3 * h * d).astype(np.float32))
+    bt = paddle.to_tensor(np.arange(n_blocks, dtype=np.int32).reshape(b, bps))
+    enc = paddle.to_tensor(np.full((b,), s, np.int32))
+    dec = paddle.to_tensor(np.zeros((b,), np.int32))
+    cu = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
+    kc8 = paddle.zeros([n_blocks, h, bs, d], dtype="int8")
+    vc8 = paddle.zeros([n_blocks, h, bs, d], dtype="int8")
+    out = block_multihead_attention(
+        qkv, kc8, vc8, enc, dec, enc, None, None, cu, cu, bt,
+        block_size=bs, use_dynamic_cachekv_quant=True)
+    assert len(out) == 5
+    kq, vq, kdq, vdq = out[4]
+    assert list(kq.shape) == [b, h]
+    np.testing.assert_allclose(kq.numpy() * kdq.numpy(),
+                               np.ones((b, h)), rtol=1e-5)
+
+
+def test_cachekv_dynamic_decode_without_scales_raises():
+    """A decode-shaped dynamic call that forgot the prefill's scales must
+    error loudly, not silently re-derive scales from one token."""
+    from paddle_tpu.incubate.nn.functional.decode_attention import \
+        block_gqa_attention
+    rng = np.random.RandomState(9)
+    b, h, kvh, d, bs, bps = 1, 2, 2, 8, 4, 2
+    q = paddle.to_tensor(rng.randn(b, h, d).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(b, kvh, d).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(b, kvh, d).astype(np.float32))
+    bt = paddle.to_tensor(np.arange(b * bps, dtype=np.int32).reshape(b, bps))
+    zero = paddle.to_tensor(np.zeros((b,), np.int32))
+    dec = paddle.to_tensor(np.full((b,), 3, np.int32))
+    one = paddle.to_tensor(np.ones((b,), np.int32))
+    cu = paddle.to_tensor(np.arange(b + 1, dtype=np.int32))
+    kc8 = paddle.zeros([b * bps, kvh, bs, d], dtype="int8")
+    vc8 = paddle.zeros([b * bps, kvh, bs, d], dtype="int8")
+    with pytest.raises(ValueError, match="decode-shaped"):
+        block_gqa_attention(q, k, v, kc8, vc8, zero, dec, one, cu, bt,
+                            block_size=bs, use_dynamic_cachekv_quant=True)
