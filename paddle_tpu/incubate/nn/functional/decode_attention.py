@@ -92,6 +92,11 @@ def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant,
     if all(given) and not is_int8:
         raise ValueError("cachekv quant scales given but the cache pool "
                          f"dtype is {kc.dtype}; allocate int8 pools")
+    if dynamic and not is_int8:
+        raise ValueError(
+            "use_dynamic_cachekv_quant with a non-int8 cache pool "
+            f"({kc.dtype}): quantized codes in fp rows would pay the "
+            f"quant noise with zero memory saving; allocate int8 pools")
     return scales
 
 
@@ -137,10 +142,13 @@ def _dynamic_compute_allowed(enc):
     tracing the values are unknowable and the documented contract
     governs."""
     try:
-        if not bool((enc > 0).any()):
+        if not bool((enc > 0).all()):
+            # any() would let a MIXED prefill+decode batch derive the
+            # decode rows' scales from one token — scale computation is a
+            # pure-prefill contract
             raise ValueError(
-                "use_dynamic_cachekv_quant with no scales on a "
-                "decode-shaped call (all seq_lens_encoder == 0): thread "
+                "use_dynamic_cachekv_quant with no scales on a call with "
+                "decode-mode sequences (seq_lens_encoder == 0): thread "
                 "the scales the prefill call returned")
     except jax.errors.TracerBoolConversionError:
         pass

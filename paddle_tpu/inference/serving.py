@@ -312,6 +312,7 @@ class PagedContinuousBatcher(_BatcherBase):
                  eos_id: Optional[int] = None, compile: bool = True,
                  policy: str = "reserve",
                  prefill_chunk: Optional[int] = None,
+                 cache_quant: Optional[str] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: Optional[float] = None,
                  seed: Optional[int] = None):
@@ -321,6 +322,15 @@ class PagedContinuousBatcher(_BatcherBase):
             raise ValueError(f"unknown policy {policy!r}")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if cache_quant not in (None, "dynamic_int8"):
+            raise ValueError(f"unknown cache_quant {cache_quant!r} "
+                             f"(use None or 'dynamic_int8'; static int8 "
+                             f"comes from model.calibrate_cachekv_int8)")
+        if cache_quant and prefill_chunk:
+            # the compiled chunk signature is scale-free; the first chunk
+            # would compute scales later chunks can't consume
+            raise ValueError("cache_quant='dynamic_int8' and "
+                             "prefill_chunk are mutually exclusive")
         cfg = model.config
         self._check_window(cfg, s_max)
         self.model = model
@@ -349,7 +359,10 @@ class PagedContinuousBatcher(_BatcherBase):
         self._admit_order: List[int] = []           # slots, oldest first
         self._last_tok = np.zeros((max_batch,), np.int64)
 
-        pool = model.paged_alloc(n_pages + 1, block_size)
+        self.cache_quant = cache_quant
+        pool = model.paged_alloc(
+            n_pages + 1, block_size,
+            cache_dtype="int8" if cache_quant else None)
         self._state = {
             "layers": pool,
             "block_tables": paddle.to_tensor(self._bt),
@@ -361,6 +374,18 @@ class PagedContinuousBatcher(_BatcherBase):
             "cu_b": paddle.to_tensor(np.arange(max_batch + 1,
                                                dtype=np.int32)),
         }
+        if cache_quant:
+            # per-(slot, kv-head) dynamic scales, host-owned like the
+            # block table; each sequence's prefill fills its slot row
+            cfg = model.config
+            kvh = getattr(cfg, "num_key_value_heads", None) \
+                or cfg.num_attention_heads
+            self._scales_np = [
+                {k: np.ones((max_batch, kvh), np.float32)
+                 for k in ("kq", "vq", "kdq", "vdq")}
+                for _ in range(cfg.num_hidden_layers)]
+            self._state["cache_scales"] = None  # filled by _sync_tables
+            self._scales_dirty = True
         self.prefill_chunk = prefill_chunk
         if compile:
             from .. import jit
@@ -410,6 +435,11 @@ class PagedContinuousBatcher(_BatcherBase):
                 self._free_pages.append(int(self._bt[slot, b]))
                 self._bt[slot, b] = self._scratch
         self._dec[slot] = 0
+        if self.cache_quant:
+            for layer in self._scales_np:
+                for k in layer:
+                    layer[k][slot] = 1.0
+            self._scales_dirty = True
         self._free_slots.append(slot)
         self._admit_order.remove(slot)
 
@@ -470,6 +500,17 @@ class PagedContinuousBatcher(_BatcherBase):
             with paddle.no_grad():
                 if self.prefill_chunk:
                     logits = self._prefill_chunked(ids_np, bt_row)
+                elif self.cache_quant:
+                    ids = paddle.to_tensor(ids_np[None, :])
+                    logits, self._state["layers"], seq_scales = \
+                        self.model.paged_prefill_into(
+                            ids, self._state["layers"], bt_row,
+                            self.block_size, dynamic_cache_scales=True)
+                    for li, sc in enumerate(seq_scales):
+                        for k in ("kq", "vq", "kdq", "vdq"):
+                            self._scales_np[li][k][slot] = \
+                                np.asarray(sc[k]._data)[0]
+                    self._scales_dirty = True
                 else:
                     ids = paddle.to_tensor(ids_np[None, :])
                     logits, self._state["layers"] = \
@@ -523,6 +564,13 @@ class PagedContinuousBatcher(_BatcherBase):
         import paddle_tpu as paddle
         self._state["block_tables"] = paddle.to_tensor(self._bt)
         self._state["dec_lens"] = paddle.to_tensor(self._dec)
+        if self.cache_quant and self._scales_dirty:
+            # scales change only at admit/release — skip the L x 4
+            # re-uploads on the steady-state decode path
+            self._state["cache_scales"] = [
+                {k: paddle.to_tensor(layer[k]) for k in layer}
+                for layer in self._scales_np]
+            self._scales_dirty = False
 
     def _preempt_latest(self, protect: int) -> bool:
         """Evict the most-recently admitted active request (≠ protect) back

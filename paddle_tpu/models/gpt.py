@@ -250,16 +250,18 @@ class GPT2ForCausalLM(Layer):
 
     # -- paged-KV serving route (vLLM-style block cache) --------------------
 
-    def paged_alloc(self, n_pages, block_size=64):
+    def paged_alloc(self, n_pages, block_size=64, cache_dtype=None):
         """Allocate the physical KV page pool: per layer, (kc, vc) of
         [n_pages, H, block_size, D]. Pages are position-free storage —
         a block table maps (sequence, logical block) -> pool row, so the
         same pool serves many sequences of different lengths. After
-        calibrate_cachekv_int8 the pools allocate int8."""
+        calibrate_cachekv_int8 the pools allocate int8; cache_dtype
+        overrides explicitly (dynamic-quant callers)."""
         import paddle_tpu as paddle
         cfg = self.config
         h, d = cfg.num_attention_heads, cfg.head_dim
-        dtype = "int8" if self._cachekv_scales is not None else cfg.dtype
+        dtype = cache_dtype or (
+            "int8" if self._cachekv_scales is not None else cfg.dtype)
         return [(paddle.zeros([n_pages, h, block_size, d], dtype=dtype),
                  paddle.zeros([n_pages, h, block_size, d], dtype=dtype))
                 for _ in range(cfg.num_hidden_layers)]
@@ -281,7 +283,8 @@ class GPT2ForCausalLM(Layer):
         return self._cachekv_scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
-                           block_size=64, dec_base=None, logits_at=None):
+                           block_size=64, dec_base=None, logits_at=None,
+                           dynamic_cache_scales=False):
         """Prompt pass writing KV into a CALLER-OWNED page pool.
 
         input_ids [B, s]; layers: ``paged_alloc`` pool; block_tables
@@ -323,14 +326,24 @@ class GPT2ForCausalLM(Layer):
         hidden = self.transformer.drop(hidden)
         this = paddle.to_tensor(np.full((b,), s, np.int32))
         layers_state = []
+        scales_out = [] if dynamic_cache_scales else None
         for li, (blk, (kc, vc)) in enumerate(zip(self.transformer.h,
                                                  layers)):
             x = blk.ln_1(hidden)
             qkv = blk.attn.c_attn(x)                     # [T, 3*H*D]
-            out, _, kc, vc = block_multihead_attention(
-                qkv, kc, vc, enc, dec, this, None, None, cu_q, cu_q, bt,
-                block_size=block_size,
-                **_cache_scale_kwargs(self._cachekv_scales, li))
+            if dynamic_cache_scales:
+                out, _, kc, vc, (kq, vq, kdq, vdq) = \
+                    block_multihead_attention(
+                        qkv, kc, vc, enc, dec, this, None, None, cu_q,
+                        cu_q, bt, block_size=block_size,
+                        use_dynamic_cachekv_quant=True)
+                scales_out.append({"kq": kq, "vq": vq,
+                                   "kdq": kdq, "vdq": vdq})
+            else:
+                out, _, kc, vc = block_multihead_attention(
+                    qkv, kc, vc, enc, dec, this, None, None, cu_q, cu_q,
+                    bt, block_size=block_size,
+                    **_cache_scale_kwargs(self._cachekv_scales, li))
             hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
             hidden = hidden + blk.mlp(blk.ln_2(hidden))
             layers_state.append((kc, vc))
@@ -344,7 +357,10 @@ class GPT2ForCausalLM(Layer):
             last = paddle.einsum("bs,bse->be", oh, h3)
         else:
             last = h3[:, s - 1]          # last token of each sequence
-        return self._logits(last), layers_state
+        logits = self._logits(last)
+        if dynamic_cache_scales:
+            return logits, layers_state, scales_out
+        return logits, layers_state
 
     @staticmethod
     def _paged_state(layers_state, bt, b, s, block_size, blocks_per_seq):
@@ -445,15 +461,21 @@ class GPT2ForCausalLM(Layer):
         enc, this, cu_q = state["zeros_b"], state["ones_b"], state["cu_b"]
         hidden = self.transformer.wte(tok) + self.transformer.wpe(t)
         hidden = self.transformer.drop(hidden)
+        dyn = state.get("cache_scales")
         new_layers = []
         for li, (blk, (kc, vc)) in enumerate(zip(self.transformer.h,
                                                  state["layers"])):
             x = blk.ln_1(hidden)
             qkv = blk.attn.c_attn(x)                     # [B, 3*H*D]
+            if dyn is not None:
+                # per-(slot, head) scales ride the state (dynamic int8)
+                kwargs = dict(_cache_scale_kwargs(dyn, li),
+                              use_dynamic_cachekv_quant=True)
+            else:
+                kwargs = _cache_scale_kwargs(self._cachekv_scales, li)
             out, _, kc, vc = block_multihead_attention(
                 qkv, kc, vc, enc, t, this, None, None, cu_q, cu_q, bt,
-                block_size=state["block_size"],
-                **_cache_scale_kwargs(self._cachekv_scales, li))
+                block_size=state["block_size"], **kwargs)
             hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
             hidden = hidden + blk.mlp(blk.ln_2(hidden))
             new_layers.append((kc, vc))

@@ -844,16 +844,18 @@ class LlamaForCausalLM(Layer):
             raise ValueError("paged decode is mesh-free: clear "
                              "config.sep_mesh for serving")
 
-    def paged_alloc(self, n_pages, block_size=64):
+    def paged_alloc(self, n_pages, block_size=64, cache_dtype=None):
         """Physical KV page pool: per layer, (kc, vc) of
         [n_pages, KV, block_size, D] — GQA caches at kv-head count
         (unexpanded), so the pool is H/KV times smaller than an
         MHA-equivalent one. After calibrate_cachekv_int8 the pools
-        allocate int8 (half of bf16, quarter of fp32 cache HBM)."""
+        allocate int8 (half of bf16, quarter of fp32 cache HBM);
+        cache_dtype overrides explicitly (dynamic-quant callers)."""
         import paddle_tpu as paddle
         cfg = self.config
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
-        dtype = "int8" if self._cachekv_scales is not None else cfg.dtype
+        dtype = cache_dtype or (
+            "int8" if self._cachekv_scales is not None else cfg.dtype)
         return [(paddle.zeros([n_pages, kvh, block_size, d], dtype=dtype),
                  paddle.zeros([n_pages, kvh, block_size, d], dtype=dtype))
                 for _ in range(cfg.num_hidden_layers)]
@@ -883,7 +885,8 @@ class LlamaForCausalLM(Layer):
         return self._cachekv_scales
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
-                           block_size=64, dec_base=None, logits_at=None):
+                           block_size=64, dec_base=None, logits_at=None,
+                           dynamic_cache_scales=False):
         """Prompt pass writing post-RoPE K / raw V into a CALLER-OWNED page
         pool (block_gqa_attention in encoder mode). input_ids [B, s];
         block_tables [B, blocks_per_seq]. Returns (last_logits [B, V],
@@ -892,6 +895,12 @@ class LlamaForCausalLM(Layer):
         dec_base [B] int32 (optional): chunked-prefill append mode — see
         the GPT-2 docstring; RoPE positions follow the timeline
         (dec_base + local) inside the op, so chunks are exact.
+
+        dynamic_cache_scales: dynamic cachekv-int8 prefill — the pools
+        must be int8, each layer's op computes per-(sequence, head)
+        scales from the prompt, and the return gains a third element:
+        a per-layer list of scale dicts for paged_decode_step's
+        state["cache_scales"].
         """
         import paddle_tpu as paddle
         from ..incubate.nn.functional.decode_attention import \
@@ -915,16 +924,27 @@ class LlamaForCausalLM(Layer):
 
         hidden = model.embed_tokens(input_ids)         # [B, s, E]
         layers_state = []
+        scales_out = [] if dynamic_cache_scales else None
         for li, (layer, (kc, vc)) in enumerate(zip(model.layers, layers)):
             attn = layer.self_attn
             x = layer.input_layernorm(hidden)
             q = attn.q_proj(x).reshape([b * s, h, d])
             k = attn.k_proj(x).reshape([b * s, kvh, d])
             v = attn.v_proj(x).reshape([b * s, kvh, d])
-            out, kc, vc = block_gqa_attention(
-                q, k, v, kc, vc, enc, dec, this, cu_q, block_tables,
-                block_size=block_size, rope_cos=Tensor(cos_tab),
-                rope_sin=Tensor(sin_tab), **self._layer_cache_scales(li))
+            if dynamic_cache_scales:
+                out, kc, vc, (kq, vq, kdq, vdq) = block_gqa_attention(
+                    q, k, v, kc, vc, enc, dec, this, cu_q, block_tables,
+                    block_size=block_size, rope_cos=Tensor(cos_tab),
+                    rope_sin=Tensor(sin_tab),
+                    use_dynamic_cachekv_quant=True)
+                scales_out.append({"kq": kq, "vq": vq,
+                                   "kdq": kdq, "vdq": vdq})
+            else:
+                out, kc, vc = block_gqa_attention(
+                    q, k, v, kc, vc, enc, dec, this, cu_q, block_tables,
+                    block_size=block_size, rope_cos=Tensor(cos_tab),
+                    rope_sin=Tensor(sin_tab),
+                    **self._layer_cache_scales(li))
             hidden = hidden + attn.o_proj(out.reshape([b, s, h * d]))
             hidden = hidden + layer.mlp(
                 layer.post_attention_layernorm(hidden))
@@ -936,8 +956,12 @@ class LlamaForCausalLM(Layer):
             oh = F.one_hot(logits_at.reshape([b]).astype("int64"),
                            s).astype(hidden.dtype)
             last = paddle.einsum("bs,bse->be", oh, hidden)
-            return self._lm_logits(last), layers_state
-        return self._lm_logits(hidden[:, s - 1]), layers_state
+        else:
+            last = hidden[:, s - 1]
+        logits = self._lm_logits(last)
+        if dynamic_cache_scales:
+            return logits, layers_state, scales_out
+        return logits, layers_state
 
     def _layer_cache_scales(self, li):
         """block_gqa_attention kwargs for layer li's cache quantization
@@ -975,6 +999,7 @@ class LlamaForCausalLM(Layer):
         cos_tab, sin_tab = model._cos, model._sin
 
         hidden = model.embed_tokens(tok.reshape([b, 1]))   # [B, 1, E]
+        dyn = state.get("cache_scales")
         new_layers = []
         for li, (layer, (kc, vc)) in enumerate(zip(model.layers,
                                                    state["layers"])):
@@ -983,10 +1008,19 @@ class LlamaForCausalLM(Layer):
             q = attn.q_proj(x).reshape([b, h, d])
             k = attn.k_proj(x).reshape([b, kvh, d])
             v = attn.v_proj(x).reshape([b, kvh, d])
+            if dyn is not None:
+                # dynamic cachekv int8: per-(slot, head) scales ride the
+                # state, fixed by each sequence's prefill
+                from ..incubate.nn.functional.decode_attention import \
+                    cachekv_scale_kwargs
+                kwargs = dict(cachekv_scale_kwargs(dyn, li),
+                              use_dynamic_cachekv_quant=True)
+            else:
+                kwargs = self._layer_cache_scales(li)
             out, kc, vc = block_gqa_attention(
                 q, k, v, kc, vc, enc, t, this, cu_q, bt,
                 block_size=state["block_size"], rope_cos=Tensor(cos_tab),
-                rope_sin=Tensor(sin_tab), **self._layer_cache_scales(li))
+                rope_sin=Tensor(sin_tab), **kwargs)
             hidden = hidden + attn.o_proj(out.reshape([b, 1, h * d]))
             hidden = hidden + layer.mlp(
                 layer.post_attention_layernorm(hidden))

@@ -364,6 +364,49 @@ def test_cachekv_dynamic_decode_without_scales_raises():
     cu = paddle.to_tensor(np.arange(b + 1, dtype=np.int32))
     kc8 = paddle.zeros([b * bps, kvh, bs, d], dtype="int8")
     vc8 = paddle.zeros([b * bps, kvh, bs, d], dtype="int8")
-    with pytest.raises(ValueError, match="decode-shaped"):
+    with pytest.raises(ValueError, match="decode-mode"):
         block_gqa_attention(q, k, v, kc8, vc8, zero, dec, one, cu, bt,
                             block_size=bs, use_dynamic_cachekv_quant=True)
+
+
+def test_dynamic_int8_batcher_end_to_end():
+    """cache_quant='dynamic_int8': each sequence's prefill computes its
+    own per-(slot, head) scales, decode consumes them from the state,
+    eviction resets the rows — across slot reuse and compiled steps."""
+    m = _llama_eval()
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, 128, (s,)) for s in (5, 9, 7, 12)]
+
+    def ref(p, n):
+        ids = paddle.to_tensor(np.asarray(p, np.int64)[None])
+        with paddle.no_grad():
+            return m.generate(ids, max_new_tokens=n).numpy()[0]
+
+    # more requests than slots: slot + scale-row reuse under compile
+    b = PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               cache_quant="dynamic_int8", compile=True)
+    assert str(b._state["layers"][0][0].dtype).endswith("int8")
+    rids = [b.submit(p, 6) for p in prompts]
+    outs = b.run_until_done()
+    agrees = []
+    for rid, p in zip(rids, prompts):
+        r = ref(p, 6)
+        agrees.append((outs[rid][len(p):] == r[len(p):]).mean())
+    assert np.mean(agrees) > 0.8, agrees
+    # pool + scale rows fully reclaimed
+    assert b.free_page_count == b.n_pages
+    for layer in b._scales_np:
+        for k in layer:
+            np.testing.assert_array_equal(layer[k],
+                                          np.ones_like(layer[k]))
+
+
+def test_dynamic_int8_rejects_chunked_prefill():
+    m = _llama_eval()
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               cache_quant="dynamic_int8",
+                               prefill_chunk=8, compile=False)
+    with pytest.raises(ValueError, match="unknown cache_quant"):
+        PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               cache_quant="int4", compile=False)
