@@ -146,6 +146,9 @@ class _BatcherBase:
         del self._finished[rid]
         return out
 
+    def _has_work(self) -> bool:
+        return bool(self._pending or self._slot_req)
+
     def run_until_done(self, max_steps: int = 10000) -> Dict[int, np.ndarray]:
         """Drive until every submitted request completes; returns (and
         releases) exactly THIS run's results. Raises if the step budget
@@ -154,7 +157,7 @@ class _BatcherBase:
         done: List[int] = []
         for _ in range(max_steps):
             done += self.step()
-            if not self._pending and not self._slot_req:
+            if not self._has_work():
                 break
         else:
             raise RuntimeError(
@@ -313,6 +316,7 @@ class PagedContinuousBatcher(_BatcherBase):
                  policy: str = "reserve",
                  prefill_chunk: Optional[int] = None,
                  cache_quant: Optional[str] = None,
+                 fused_admission: bool = False,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: Optional[float] = None,
                  seed: Optional[int] = None):
@@ -331,6 +335,24 @@ class PagedContinuousBatcher(_BatcherBase):
             # would compute scales later chunks can't consume
             raise ValueError("cache_quant='dynamic_int8' and "
                              "prefill_chunk are mutually exclusive")
+        if fused_admission and not prefill_chunk:
+            raise ValueError("fused_admission needs prefill_chunk (the "
+                             "chunk width of the fused executable)")
+        if fused_admission and cache_quant:
+            raise ValueError("fused_admission + dynamic cachekv quant is "
+                             "not supported; use static calibration")
+        if prefill_chunk is not None and prefill_chunk > s_max:
+            raise ValueError(f"prefill_chunk={prefill_chunk} exceeds "
+                             f"s_max={s_max}")
+        if fused_admission:
+            cap = -(-s_max // block_size) * block_size
+            if cap % prefill_chunk:
+                # the fused chunk is FIXED-width; a capacity-clamped tail
+                # would re-pad past the block table and (via jnp's index
+                # clamping) overwrite the sequence's real last page
+                raise ValueError(
+                    f"fused_admission needs the slot capacity ({cap}) to "
+                    f"be a multiple of prefill_chunk ({prefill_chunk})")
         cfg = model.config
         self._check_window(cfg, s_max)
         self.model = model
@@ -387,6 +409,23 @@ class PagedContinuousBatcher(_BatcherBase):
             self._state["cache_scales"] = None  # filled by _sync_tables
             self._scales_dirty = True
         self.prefill_chunk = prefill_chunk
+        self.fused_admission = fused_admission
+        self._admitting: Optional[dict] = None
+        if fused_admission:
+            # idle chunk inputs are byte-identical every step: build once
+            self._idle_chunk = (
+                paddle.to_tensor(np.zeros((prefill_chunk,), np.int64)),
+                paddle.to_tensor(np.full((1, self.blocks_per_seq),
+                                         self._scratch, np.int32)),
+                paddle.to_tensor(np.array([0], np.int32)),
+                paddle.to_tensor(np.array([0], np.int32)))
+        if fused_admission:
+            if compile:
+                from .. import jit
+                self._fused_fn = jit.to_static(model.paged_fused_step,
+                                               donate_args=(5,))
+            else:
+                self._fused_fn = model.paged_fused_step
         if compile:
             from .. import jit
             # donate the state pytree (arg 1): the page pool is the big
@@ -415,25 +454,32 @@ class PagedContinuousBatcher(_BatcherBase):
     def _pages_for(self, n_rows: int) -> int:
         return -(-n_rows // self.block_size)
 
-    def _alloc_pages(self, slot: int, upto_row: int) -> bool:
-        """Grow slot's block table so rows [0, upto_row) are backed.
-        Returns False (allocating nothing) if the pool can't cover it."""
+    def _alloc_pages_row(self, row: np.ndarray, upto_row: int) -> bool:
+        """Grow a block-table row (a view into self._bt or a detached
+        admission row) so rows [0, upto_row) are backed. Returns False
+        (allocating nothing) if the pool can't cover it."""
         need_blocks = self._pages_for(upto_row)
-        have = int(np.sum(self._bt[slot] != self._scratch))
+        have = int(np.sum(row != self._scratch))
         grow = need_blocks - have
         if grow <= 0:
             return True
         if grow > len(self._free_pages):
             return False
         for b in range(have, need_blocks):
-            self._bt[slot, b] = self._free_pages.pop()
+            row[b] = self._free_pages.pop()
         return True
 
-    def _release_slot(self, slot: int):
+    def _alloc_pages(self, slot: int, upto_row: int) -> bool:
+        return self._alloc_pages_row(self._bt[slot], upto_row)
+
+    def _release_row(self, row: np.ndarray):
         for b in range(self.blocks_per_seq):
-            if self._bt[slot, b] != self._scratch:
-                self._free_pages.append(int(self._bt[slot, b]))
-                self._bt[slot, b] = self._scratch
+            if row[b] != self._scratch:
+                self._free_pages.append(int(row[b]))
+                row[b] = self._scratch
+
+    def _release_slot(self, slot: int):
+        self._release_row(self._bt[slot])
         self._dec[slot] = 0
         if self.cache_quant:
             for layer in self._scales_np:
@@ -472,22 +518,9 @@ class PagedContinuousBatcher(_BatcherBase):
         finished = []
         while self._pending and self._free_slots:
             req = self._pending[0]
-            # a preempted request resumes from prompt ⧺ generated
-            ids_np = np.concatenate(
-                [req.prompt, np.asarray(req.tokens, np.int64)]) \
-                if req.tokens else req.prompt
-            L = len(ids_np)
-            # chunked prefill writes rows up to the padded length, capped
-            # at the slot's capacity (the tail chunk shortens instead of
-            # overflowing the block table)
-            padded = (min(-(-L // self.prefill_chunk) * self.prefill_chunk,
-                          self.blocks_per_seq * self.block_size)
-                      if self.prefill_chunk else L)
-            if self.policy == "reserve":
-                upto = max(padded,
-                           L + req.max_new_tokens - len(req.tokens))
-            else:
-                upto = max(padded, L + 1)
+            # a preempted request resumes from prompt ⧺ generated; chunked
+            # prefill pads to the chunk width (capacity-clamped)
+            ids_np, L, _padded, upto = self._admission_plan(req)
             need = self._pages_for(upto)
             if need > len(self._free_pages):
                 break
@@ -564,6 +597,11 @@ class PagedContinuousBatcher(_BatcherBase):
         import paddle_tpu as paddle
         self._state["block_tables"] = paddle.to_tensor(self._bt)
         self._state["dec_lens"] = paddle.to_tensor(self._dec)
+        # a compiled step returns the pass-through python ints as 0-d
+        # arrays; restore them so the NEXT call's signature (and its
+        # executable) stays identical
+        self._state["block_size"] = self.block_size
+        self._state["capacity"] = self.blocks_per_seq * self.block_size
         if self.cache_quant and self._scales_dirty:
             # scales change only at admit/release — skip the L x 4
             # re-uploads on the steady-state decode path
@@ -589,22 +627,160 @@ class PagedContinuousBatcher(_BatcherBase):
 
     def _grow_for_step(self):
         """ondemand: every active slot is about to write kv row dec[slot];
-        back it with a page, preempting if the pool is dry."""
+        back it with a page, preempting (slots, then any in-flight fused
+        admission) if the pool is dry."""
         for slot in list(self._admit_order):
             if slot not in self._slot_req:
                 continue
             while not self._alloc_pages(slot, int(self._dec[slot]) + 1):
-                if not self._preempt_latest(protect=slot):
-                    raise RuntimeError(
-                        f"page pool exhausted: slot {slot} needs a page at "
-                        f"row {int(self._dec[slot])}, no free pages and no "
-                        f"other request to preempt (n_pages={self.n_pages})")
+                if self._preempt_latest(protect=slot):
+                    continue
+                if self._admitting is not None:
+                    # the admission's detached row holds pages too —
+                    # evict it rather than failing a live decode
+                    self._abort_admission()
+                    continue
+                raise RuntimeError(
+                    f"page pool exhausted: slot {slot} needs a page at "
+                    f"row {int(self._dec[slot])}, no free pages and no "
+                    f"other request to preempt (n_pages={self.n_pages})")
+
+    # -- fused admission (vLLM unified scheduling) --------------------------
+    def _has_work(self) -> bool:
+        return bool(self._pending or self._slot_req or self._admitting)
+
+    def _admission_plan(self, req: Request):
+        """The ONE home of the resume-ids / chunk-padding / page-budget
+        arithmetic (used by synchronous admission and the fused path)."""
+        ids_np = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int64)]) \
+            if req.tokens else req.prompt
+        L = len(ids_np)
+        padded_len = (min(-(-L // self.prefill_chunk) * self.prefill_chunk,
+                          self.blocks_per_seq * self.block_size)
+                      if self.prefill_chunk else L)
+        if self.policy == "reserve":
+            upto = max(padded_len, L + req.max_new_tokens - len(req.tokens))
+        else:
+            upto = max(padded_len, L + 1)
+        return ids_np, L, padded_len, upto
+
+    def _start_admission(self) -> bool:
+        """Reserve a slot + pages for the next pending request; its
+        prompt then streams through the fused step one chunk per step
+        while the other slots keep decoding."""
+        if self._admitting or not self._pending or not self._free_slots:
+            return False
+        req = self._pending[0]
+        ids_np, L, padded_len, upto = self._admission_plan(req)
+        if self._pages_for(upto) > len(self._free_pages):
+            return False
+        self._pending.pop(0)
+        slot = self._free_slots.pop(0)
+        row = np.full((self.blocks_per_seq,), self._scratch, np.int32)
+        if not self._alloc_pages_row(row, upto):
+            raise RuntimeError("page accounting bug: admission gate "
+                               "passed but allocation failed")
+        padded = np.zeros((padded_len,), np.int64)
+        padded[:L] = ids_np
+        # the slot's MAIN row stays scratch until admission completes, so
+        # its garbage decode writes land in the scratch page instead of
+        # the rows the chunks are filling
+        self._admitting = {"req": req, "slot": slot, "row": row,
+                           "ids": padded, "L": L, "offset": 0}
+        return True
+
+    def _abort_admission(self):
+        """Preempt the in-flight admission: pages back to the pool, the
+        request to the FRONT of the queue (offset resets; recompute on
+        resume is exact, same as slot preemption)."""
+        adm = self._admitting
+        self._release_row(adm["row"])
+        self._free_slots.append(adm["slot"])
+        self._pending.insert(0, adm["req"])
+        self._admitting = None
+        self._stat_preempted += 1
+
+    def _fused_chunk_inputs(self):
+        import paddle_tpu as paddle
+        adm = self._admitting
+        if adm is None:
+            return self._idle_chunk
+        C = self.prefill_chunk
+        o = adm["offset"]
+        ids = adm["ids"][o:o + C]   # always full width: cap % C == 0
+        at = adm["L"] - 1 - o
+        at = at if 0 <= at < C else 0
+        return (paddle.to_tensor(ids),
+                paddle.to_tensor(adm["row"][None, :]),
+                paddle.to_tensor(np.array([o], np.int32)),
+                paddle.to_tensor(np.array([at], np.int32)))
+
+    def _finish_admission(self, chunk_logits, finished: List[int]):
+        """Advance the in-flight admission by one chunk; on the final
+        chunk, install the block-table row and promote the request to a
+        decoding slot."""
+        adm = self._admitting
+        if adm is None:
+            return
+        C = self.prefill_chunk
+        o, L = adm["offset"], adm["L"]
+        had_last = o <= L - 1 < o + C
+        adm["offset"] = o + C
+        if not had_last:
+            return
+        req, slot = adm["req"], adm["slot"]
+        tok = int(self._pick(np.asarray(chunk_logits._data))[0])
+        self._bt[slot] = adm["row"]
+        self._dec[slot] = L
+        self._last_tok[slot] = tok
+        req.slot = slot
+        req.tokens.append(tok)
+        self._stat_tokens += 1
+        self._slot_req[slot] = req
+        self._admit_order.append(slot)
+        self._admitting = None
+        if self._maybe_finish(req, tok):
+            finished.append(req.rid)
+
+    def _step_fused(self) -> List[int]:
+        """One fused executable call: every decode slot advances AND the
+        in-flight admission streams its next chunk — decode throughput
+        never pauses for a prefill."""
+        import paddle_tpu as paddle
+        finished: List[int] = []
+        self._start_admission()
+        if not self._slot_req and self._admitting is None:
+            return finished
+        if self.policy == "ondemand":
+            self._grow_for_step()
+        self._stat_steps += 1
+        self._stat_occupancy_sum += len(self._slot_req)
+        self._sync_tables()
+        tok_t = paddle.to_tensor(self._last_tok)
+        ids_t, row_t, dec_t, at_t = self._fused_chunk_inputs()
+        with paddle.no_grad():
+            dec_logits, chunk_logits, self._state = self._fused_fn(
+                tok_t, ids_t, row_t, dec_t, at_t, self._state)
+        self._dec += np.asarray(self._slot_active_mask(), np.int32)
+        next_tok = self._pick(np.asarray(dec_logits._data))
+        for slot, req in list(self._slot_req.items()):
+            tok = int(next_tok[slot])
+            req.tokens.append(tok)
+            self._stat_tokens += 1
+            self._last_tok[slot] = tok
+            if self._maybe_finish(req, tok):
+                finished.append(req.rid)
+        self._finish_admission(chunk_logits, finished)
+        return finished
 
     # -- the engine ---------------------------------------------------------
     def step(self) -> List[int]:
         """Admit, grow pages (ondemand), decode one token per active slot,
         evict finished. Returns rids finishing during THIS call."""
         import paddle_tpu as paddle
+        if self.fused_admission:
+            return self._step_fused()
         finished = self._admit()
         if not self._slot_req:
             return finished

@@ -136,6 +136,9 @@ def test_compiled_paged_batcher_matches_eager():
     oc = bc.run_until_done()
     for a, b_ in zip(re_, rc):
         np.testing.assert_array_equal(oe[a], oc[b_])
+    # one decode executable across every step/occupancy (the state's
+    # static ints must survive the compiled-call round trip)
+    assert len(bc._step_fn._cache) == 1
 
 
 def test_paged_capacity_errors():
@@ -278,7 +281,8 @@ def test_chunked_prefill_single_executable():
     prompts = [rng.randint(0, 128, (s,)) for s in (3, 7, 9, 14)]
     rids = [b.submit(p, 4) for p in prompts]
     outs = b.run_until_done()
-    assert len(b._chunk_fn._cache) == 1          # one signature ever
+    assert len(b._chunk_fn._cache) == 1, \
+        list(b._chunk_fn._cache)      # one signature ever
     for rid, p in zip(rids, prompts):
         np.testing.assert_array_equal(outs[rid], _ref(m, p, 4))
 
@@ -314,3 +318,91 @@ def test_chunked_prefill_tail_clamped_to_capacity():
     outs = b.run_until_done()
     np.testing.assert_array_equal(outs[rid], _ref(m, p, 5))
     assert b.free_page_count == b.n_pages
+
+
+# -- fused admission (vLLM unified scheduling) -----------------------------
+
+def test_fused_admission_token_exact_both_families():
+    """One fused executable advances all decode slots AND one admission
+    chunk per step; every request still matches its solo decode."""
+    for mk in (_model, _llama):
+        m = mk()
+        rng = np.random.RandomState(12)
+        prompts = [rng.randint(0, 128, (s,)) for s in (5, 11, 17, 8, 22)]
+        b = PagedContinuousBatcher(m, max_batch=3, s_max=40, block_size=8,
+                                   prefill_chunk=8, fused_admission=True,
+                                   compile=False)
+        rids = [b.submit(p, 6) for p in prompts]
+        outs = b.run_until_done()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(outs[rid], _ref(m, p, 6),
+                                          err_msg=f"{mk.__name__} {rid}")
+        assert b.free_page_count == b.n_pages
+
+
+@pytest.mark.smoke
+def test_fused_admission_single_executable_and_overlap():
+    """The fused step is ONE compiled executable at every occupancy and
+    prompt length, and decode genuinely progresses while a prompt
+    admits (total steps ~ max of the two, not their sum)."""
+    m = _model()
+    rng = np.random.RandomState(13)
+    long_decode = rng.randint(0, 128, (4,))
+    long_prompt = rng.randint(0, 128, (32,))   # 4 chunks at C=8
+    b = PagedContinuousBatcher(m, max_batch=2, s_max=48, block_size=8,
+                               prefill_chunk=8, fused_admission=True,
+                               compile=True)
+    r0 = b.submit(long_decode, 12)
+    b.step()                                   # r0 admitted (4-token, 1 chunk)
+    r1 = b.submit(long_prompt, 4)
+    outs = b.run_until_done()
+    assert len(b._fused_fn._cache) == 1, list(b._fused_fn._cache)
+    np.testing.assert_array_equal(outs[r0], _ref(m, long_decode, 12))
+    np.testing.assert_array_equal(outs[r1], _ref(m, long_prompt, 4))
+    # overlap: r0's 12 decode steps cover r1's 4 admission chunks — the
+    # whole run fits in far fewer steps than the sequential sum (~13 vs 21)
+    assert b.stats()["steps"] <= 16
+
+
+def test_fused_admission_guards():
+    m = _model()
+    with pytest.raises(ValueError, match="fused_admission needs"):
+        PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               fused_admission=True, compile=False)
+    with pytest.raises(ValueError, match="exceeds s_max"):
+        PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               prefill_chunk=64, compile=False)
+
+
+def test_fused_admission_abort_under_pool_pressure():
+    """ondemand + fused: when a live decode needs a page and only the
+    in-flight admission holds them, the admission is aborted (requeued,
+    pages freed) instead of failing the step — and everything still
+    finishes token-exact."""
+    m = _model()
+    rng = np.random.RandomState(14)
+    p0 = rng.randint(0, 128, (4,))
+    p1 = rng.randint(0, 128, (13,))
+    # 6 pages of 4 rows: p0 admits with 2 pages and must grow to 4;
+    # p1's 2-chunk admission reserves 4 — the pool cannot hold both
+    # timelines (4 + 5 > 6), forcing preemption/abort mid-run
+    b = PagedContinuousBatcher(m, max_batch=2, s_max=24, block_size=4,
+                               n_pages=6, policy="ondemand",
+                               prefill_chunk=8, fused_admission=True,
+                               compile=False)
+    r0 = b.submit(p0, 10)
+    r1 = b.submit(p1, 4)
+    outs = b.run_until_done(max_steps=300)
+    assert b.stats()["preemptions"] >= 1
+    np.testing.assert_array_equal(outs[r0], _ref(m, p0, 10))
+    np.testing.assert_array_equal(outs[r1], _ref(m, p1, 4))
+    assert b.free_page_count == b.n_pages
+
+
+def test_fused_admission_capacity_divisibility_guard():
+    m = _model()
+    # cap = ceil(40/8)*8 = 40, C=12 does not divide it
+    with pytest.raises(ValueError, match="multiple of prefill_chunk"):
+        PagedContinuousBatcher(m, max_batch=2, s_max=40, block_size=8,
+                               prefill_chunk=12, fused_admission=True,
+                               compile=False)
