@@ -140,6 +140,25 @@ def main():
     detail["batcher_slot_utilization"] = round(s["slot_utilization"], 3)
     detail["batcher_requests"] = s["completed_requests"]
 
+    # fused admission (vLLM unified scheduling): decode + prefill share
+    # one executable, so admission no longer pauses decoding
+    paddle.seed(0)
+    fused_model = GPT2ForCausalLM(cfg)
+    fused_model.eval()
+    bf = PagedContinuousBatcher(fused_model, max_batch=batch, s_max=s_max,
+                                block_size=64, prefill_chunk=64,
+                                policy="ondemand", fused_admission=True,
+                                compile=True)
+    bf.submit(rng.randint(0, cfg.vocab_size, (ctx,)), 8)
+    bf.run_until_done()
+    bf.reset_stats()
+    for ln in req_lens:
+        bf.submit(rng.randint(0, cfg.vocab_size, (ln,)), 32)
+    bf.run_until_done()
+    sf = bf.stats()
+    detail["fused_batcher_tokens_per_s"] = round(sf["tokens_per_sec"], 2)
+    detail["fused_batcher_steps"] = sf["steps"]
+
     toks_per_s = rate * batch
     print(json.dumps({
         "metric": "gpt2_kv_cache_decode_throughput",

@@ -746,56 +746,28 @@ class PagedContinuousBatcher(_BatcherBase):
     def _step_fused(self) -> List[int]:
         """One fused executable call: every decode slot advances AND the
         in-flight admission streams its next chunk — decode throughput
-        never pauses for a prefill."""
+        never pauses for a prefill. With NO admission in flight the plain
+        decode executable runs instead: an idle chunk would still compute
+        C token positions through the model for nothing."""
         import paddle_tpu as paddle
         finished: List[int] = []
         self._start_admission()
-        if not self._slot_req and self._admitting is None:
+        if self._admitting is None:
+            self._decode_tail(finished)
             return finished
-        if self.policy == "ondemand":
-            self._grow_for_step()
-        self._stat_steps += 1
-        self._stat_occupancy_sum += len(self._slot_req)
-        self._sync_tables()
+        self._step_prologue()
         tok_t = paddle.to_tensor(self._last_tok)
         ids_t, row_t, dec_t, at_t = self._fused_chunk_inputs()
         with paddle.no_grad():
             dec_logits, chunk_logits, self._state = self._fused_fn(
                 tok_t, ids_t, row_t, dec_t, at_t, self._state)
-        self._dec += np.asarray(self._slot_active_mask(), np.int32)
-        next_tok = self._pick(np.asarray(dec_logits._data))
-        for slot, req in list(self._slot_req.items()):
-            tok = int(next_tok[slot])
-            req.tokens.append(tok)
-            self._stat_tokens += 1
-            self._last_tok[slot] = tok
-            if self._maybe_finish(req, tok):
-                finished.append(req.rid)
+        self._advance_decoders(dec_logits, finished)
         self._finish_admission(chunk_logits, finished)
         return finished
 
-    # -- the engine ---------------------------------------------------------
-    def step(self) -> List[int]:
-        """Admit, grow pages (ondemand), decode one token per active slot,
-        evict finished. Returns rids finishing during THIS call."""
-        import paddle_tpu as paddle
-        if self.fused_admission:
-            return self._step_fused()
-        finished = self._admit()
-        if not self._slot_req:
-            return finished
-        if self.policy == "ondemand":
-            self._grow_for_step()
-        self._stat_steps += 1
-        self._stat_occupancy_sum += len(self._slot_req)
-        # the HOST owns the block table and the timeline: re-upload both
-        # every step (two tiny int32 arrays) so parked slots never drift —
-        # the device step increments dec_lens for all B slots, the host
-        # only for active ones
-        self._sync_tables()
-        tok_t = paddle.to_tensor(self._last_tok)
-        with paddle.no_grad():
-            logits, self._state = self._step_fn(tok_t, self._state)
+    def _advance_decoders(self, logits, finished: List[int]):
+        """Consume a step's decode logits: advance timelines, append the
+        picked tokens, evict finished slots."""
         self._dec += np.asarray(self._slot_active_mask(), np.int32)
         next_tok = self._pick(np.asarray(logits._data))
         for slot, req in list(self._slot_req.items()):
@@ -805,6 +777,40 @@ class PagedContinuousBatcher(_BatcherBase):
             self._last_tok[slot] = tok
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)
+
+    def _step_prologue(self):
+        """Shared pre-decode bookkeeping: on-demand page growth, step
+        counters, and the host->device table sync. The HOST owns the
+        block table and the timeline: re-uploading both every step (tiny
+        int32 arrays) keeps parked slots from drifting — the device step
+        increments dec_lens for all B slots, the host only for active
+        ones."""
+        if self.policy == "ondemand":
+            self._grow_for_step()
+        self._stat_steps += 1
+        self._stat_occupancy_sum += len(self._slot_req)
+        self._sync_tables()
+
+    def _decode_tail(self, finished: List[int]):
+        """The decode-only step body (shared by the plain engine and the
+        fused engine's idle steps)."""
+        import paddle_tpu as paddle
+        if not self._slot_req:
+            return
+        self._step_prologue()
+        tok_t = paddle.to_tensor(self._last_tok)
+        with paddle.no_grad():
+            logits, self._state = self._step_fn(tok_t, self._state)
+        self._advance_decoders(logits, finished)
+
+    # -- the engine ---------------------------------------------------------
+    def step(self) -> List[int]:
+        """Admit, grow pages (ondemand), decode one token per active slot,
+        evict finished. Returns rids finishing during THIS call."""
+        if self.fused_admission:
+            return self._step_fused()
+        finished = self._admit()
+        self._decode_tail(finished)
         return finished
 
     def _slot_active_mask(self):

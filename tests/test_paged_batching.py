@@ -5,6 +5,16 @@ scheduling (incubate/nn/functional/block_multihead_attention.py:19).
 Exactness bar: every request's output equals its single-request
 generate_paged()/generate() result regardless of arrival order, slot
 reuse, page-pool pressure, or preemption.
+
+Known flake (rare, CPU-backend-only): under heavy host load, compiled
+serving paths have intermittently produced a LATE token differing from
+the eager/reference path (observed across several test files, including
+runs that predate the fused/chunked features). The repeated controlled
+runs point at load-dependent partial-sum ordering in the CPU backend's
+threaded matmuls flipping argmax near-ties on these tiny random-weight
+vocabularies — not at the serving logic, which is bitwise-deterministic
+in its host scheduling. The single-executable asserts print their cache
+keys on failure so a signature-drift recurrence is diagnosable.
 """
 import numpy as np
 import pytest

@@ -433,3 +433,40 @@ def test_ring_attention_overlap_trace():
     ring_attention(q, k, v, mesh=mesh, causal=True)  # compile outside
     _profile("ring_overlap",
              lambda: ring_attention(q, k, v, mesh=mesh, causal=True))
+
+
+def test_fused_serving_on_tpu():
+    """The serving crown on real hardware: fused-admission continuous
+    batching (decode + prefill chunks in one executable) stays
+    token-exact on the chip and reports steady-state throughput."""
+    _require_tpu()
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import PagedContinuousBatcher
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(vocab_size=1024, hidden_size=256,
+                            num_hidden_layers=4,
+                            max_position_embeddings=512)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 1024, (s,)) for s in (17, 64, 128, 41)]
+    b = PagedContinuousBatcher(m, max_batch=4, s_max=256, block_size=32,
+                               prefill_chunk=64, fused_admission=True,
+                               compile=True)
+    rids = [b.submit(p, 16) for p in prompts]
+    t0 = time.perf_counter()
+    outs = b.run_until_done()
+    dt = time.perf_counter() - t0
+    for rid, p in zip(rids, prompts):
+        ids = paddle.to_tensor(np.asarray(p, np.int64)[None])
+        with paddle.no_grad():
+            ref = m.generate(ids, max_new_tokens=16).numpy()[0]
+        np.testing.assert_array_equal(outs[rid], ref)
+    s = b.stats()
+    print(f"[tpu] fused serving: {s['generated_tokens']} tokens in "
+          f"{dt:.1f}s ({s['generated_tokens']/dt:.1f} tok/s), "
+          f"occupancy {s['mean_active_slots']:.2f}")
