@@ -141,11 +141,9 @@ def main():
     detail["batcher_requests"] = s["completed_requests"]
 
     # fused admission (vLLM unified scheduling): decode + prefill share
-    # one executable, so admission no longer pauses decoding
-    paddle.seed(0)
-    fused_model = GPT2ForCausalLM(cfg)
-    fused_model.eval()
-    bf = PagedContinuousBatcher(fused_model, max_batch=batch, s_max=s_max,
+    # one executable, so admission no longer pauses decoding. The batcher
+    # never mutates weights, so the fp serving model is reusable.
+    bf = PagedContinuousBatcher(serving_model, max_batch=batch, s_max=s_max,
                                 block_size=64, prefill_chunk=64,
                                 policy="ondemand", fused_admission=True,
                                 compile=True)

@@ -176,7 +176,9 @@ class BucketBatchingPredictor:
         return results
 
 
-from .serving import ContinuousBatcher, Request  # noqa: E402
+from .serving import (ContinuousBatcher, PagedContinuousBatcher,  # noqa: E402
+                      Request)
 
 __all__ = ["Config", "Predictor", "BucketBatchingPredictor",
-           "ContinuousBatcher", "Request", "create_predictor"]
+           "ContinuousBatcher", "PagedContinuousBatcher", "Request",
+           "create_predictor"]

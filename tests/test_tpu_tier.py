@@ -436,9 +436,11 @@ def test_ring_attention_overlap_trace():
 
 
 def test_fused_serving_on_tpu():
-    """The serving crown on real hardware: fused-admission continuous
-    batching (decode + prefill chunks in one executable) stays
-    token-exact on the chip and reports steady-state throughput."""
+    """Fused-admission continuous batching (decode + prefill chunks in
+    one executable) token-exact with throughput reporting. PRE-STAGED
+    for hardware (validated in interpret/CPU mode; the heal playbook's
+    `pytest -m tpu` stage gives it its first on-chip run — the relay
+    was wedged when this landed, see TPU_PROBES.log)."""
     _require_tpu()
     import time
 
