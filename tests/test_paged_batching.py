@@ -44,14 +44,20 @@ def _retry_load_flake(body, attempts=2):
     """Run an exact-token scenario up to `attempts` times (see the module
     docstring: heavy host load can flip argmax near-ties in the CPU
     backend's threaded matmuls). A LOGIC regression fails every attempt
-    and still fails the test; a load flip passes the retry."""
+    and still fails the test; a load flip passes the retry — but LOUDLY,
+    so flake frequency stays observable in the -W output."""
+    import warnings
     for i in range(attempts):
         try:
             body()
             return
-        except AssertionError:
+        except AssertionError as e:
             if i + 1 == attempts:
                 raise
+            warnings.warn(
+                f"exact-token attempt {i + 1} failed and was retried "
+                f"(documented CPU load flake — investigate if frequent): "
+                f"{str(e)[:300]}")
 
 
 @pytest.mark.smoke
@@ -146,6 +152,8 @@ def test_ondemand_preemption_is_exact():
 
 @pytest.mark.smoke
 def test_compiled_paged_batcher_matches_eager():
+    # the ONE compiled-serving exactness test kept in the smoke tier
+    # (the heavier chunked/fused compiled tests run in the full suite)
     m = _model()
     rng = np.random.RandomState(4)
     prompts = [rng.randint(0, 128, (s,)) for s in (5, 9, 7)]
@@ -297,7 +305,6 @@ def test_chunked_prefill_token_exact_mixed_lengths():
         assert b.free_page_count == b.n_pages
 
 
-@pytest.mark.smoke
 def test_chunked_prefill_single_executable():
     """The point of chunking: serving many distinct prompt lengths
     compiles exactly ONE prefill executable (vs one per length on the
@@ -372,7 +379,6 @@ def test_fused_admission_token_exact_both_families():
         assert b.free_page_count == b.n_pages
 
 
-@pytest.mark.smoke
 def test_fused_admission_single_executable_and_overlap():
     """The fused step is ONE compiled executable at every occupancy and
     prompt length, and decode genuinely progresses while a prompt

@@ -136,7 +136,6 @@ def test_cachekv_int8_close_to_fp_cache():
     assert "int8" not in str(state2["layers"][0][0].dtype)
 
 
-@pytest.mark.smoke
 def test_cachekv_int8_serving_algebra_exact():
     """Quantized-cache generate_paged vs the quantized-cache batcher must
     be token-exact (the int8 cache changes logits, never the scheduler)."""
