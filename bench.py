@@ -29,9 +29,14 @@ import time
 _T0 = time.perf_counter()
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
-PROBE_TIMEOUT = int(os.environ.get("GRAFT_BENCH_PROBE_TIMEOUT", "150"))
+# 150 s proved too thin: cold plugin init alone exceeds 90 s (round-3
+# window log), so a healthy-but-cold relay read as wedged and the round
+# artifact recorded the CPU proxy. 330 s = cold init + jax.devices() with
+# margin, still far under the TPU budget.
+PROBE_TIMEOUT = int(os.environ.get("GRAFT_BENCH_PROBE_TIMEOUT", "330"))
 TPU_TIMEOUT = int(os.environ.get("GRAFT_BENCH_TPU_TIMEOUT", "1080"))
 CPU_TIMEOUT = int(os.environ.get("GRAFT_BENCH_CPU_TIMEOUT", "240"))
+SNAPSHOT_PATH = os.path.join(_REPO_DIR, "BENCH_TPU_SNAPSHOT.json")
 
 
 def _progress(msg):
@@ -45,6 +50,7 @@ def _peak_bf16_flops(device) -> float:
         "v6e": 918e12, "v6": 918e12,
         "v5p": 459e12,
         "v5e": 197e12, "v5litepod": 197e12, "v5lite": 197e12,
+        "v5 lite": 197e12,  # axon reports device_kind "TPU v5 lite"
         "v4": 275e12,
         "v3": 123e12,
         "v2": 45e12,
@@ -72,18 +78,26 @@ def main(scan_layers=True, size="large"):
     on_tpu = dev.platform == "tpu"
 
     if on_tpu and size == "large":
-        # Sized to the chip (VERDICT r2 weak #1): ~0.56B params ≈ 10 GB of
-        # param+master+optimizer state on a 16 GB v5e, seq 2048 through the
-        # flash-attention Pallas kernel, head_dim 128 to fill the MXU.
+        # Sized to the chip (VERDICT r3 #1): ~0.55B params → 7.7 GB of
+        # bf16 weight + fp32 master + Adam m/v on a 16 GB v5e; seq 2048
+        # through the flash-attention Pallas kernel; head_dim 128 and
+        # hidden 1536 (12×128 lanes) to fill the MXU.
+        # recompute "selective" (dots_with_no_batch_dims_saveable), NOT
+        # "full": full remat replays the whole forward in the backward —
+        # ~25% of the step is uncounted FLOPs and measured MFU caps at
+        # 0.75× the hardware utilization. Selective keeps matmul outputs
+        # resident (~4.2 GB at batch 4 × seq 2048) and replays only the
+        # cheap elementwise chains, so measured MFU ≈ true MFU.
         # scan_layers: the decoder stack compiles as ONE lax.scan body, so
         # compile time (the remote-compile tunnel's bottleneck) is O(1) in
-        # depth instead of O(24 layers).
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1280,
-                          intermediate_size=3456, num_hidden_layers=24,
-                          num_attention_heads=10, num_key_value_heads=10,
+        # depth instead of O(16 layers).
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=12,
                           max_position_embeddings=2048,
-                          scan_layers=scan_layers, use_recompute=True)
-        batch, seq, iters = 8, 2048, 15
+                          scan_layers=scan_layers, use_recompute=True,
+                          recompute_granularity="selective")
+        batch, seq, iters = 4, 2048, 15
     elif on_tpu:
         # smaller fallback config (OOM / compile-budget self-heal)
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
@@ -160,23 +174,32 @@ def main(scan_layers=True, size="large"):
     if not on_tpu:
         mfu = 0.0  # CPU MFU vs TPU peak is meaningless
 
+    detail = {
+        "model": "llama",
+        "tpu": on_tpu,
+        "params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "iters": iters,
+        "final_loss": round(final_loss, 4),
+        "mfu": round(mfu, 4),
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+        "amp": "O2 bf16 + fp32 master",
+        "recompute": getattr(cfg, "recompute_granularity", None)
+        if cfg.use_recompute else "off",
+        # the Pallas kernel only routes on TPU; off-TPU the flag is moot
+        "flash": bool(on_tpu and paddle.get_flags(
+            ["FLAGS_use_pallas_attention"])["FLAGS_use_pallas_attention"]),
+    }
+    if on_tpu:
+        detail["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime())
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.50, 4),
-        "detail": {
-            "model": "llama",
-            "tpu": on_tpu,
-            "params": n_params,
-            "batch": batch,
-            "seq": seq,
-            "iters": iters,
-            "final_loss": round(final_loss, 4),
-            "mfu": round(mfu, 4),
-            "device": str(getattr(dev, "device_kind", dev.platform)),
-            "amp": "O2 bf16 + fp32 master",
-        },
+        "detail": detail,
     }), flush=True)
 
 
@@ -327,6 +350,38 @@ def _run_child(env, timeout):
     return None
 
 
+def _persist_snapshot(result):
+    """Keep the newest real-TPU number on disk so a later wedged window can
+    still report it (VERDICT r3 #2)."""
+    try:
+        # atomic replace: a mid-write kill must not destroy the previous
+        # good snapshot (the whole point of keeping it)
+        tmp = SNAPSHOT_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f)
+            f.write("\n")
+        os.replace(tmp, SNAPSHOT_PATH)
+    except OSError as e:
+        _progress(f"could not persist TPU snapshot: {e}")
+
+
+def _last_snapshot():
+    """Most recent TPU snapshot (or None), stamped with a capture time —
+    from its own detail if the run recorded one, else the file mtime."""
+    try:
+        with open(SNAPSHOT_PATH) as f:
+            snap = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not snap.get("detail", {}).get("tpu"):
+        return None
+    snap.setdefault("detail", {}).setdefault(
+        "captured_at",
+        time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                      time.gmtime(os.path.getmtime(SNAPSHOT_PATH))))
+    return snap
+
+
 def _orchestrate():
     tpu_ok = _probe_tpu()
     result = None
@@ -337,6 +392,8 @@ def _orchestrate():
         result = _run_child(dict(os.environ), budget)
         if result is None:
             _progress("TPU bench produced no line; falling back to CPU proxy")
+        elif result.get("detail", {}).get("tpu"):
+            _persist_snapshot(result)
     if result is None:
         _progress(f"running CPU-proxy bench (timeout {CPU_TIMEOUT}s)")
         result = _run_child(_sanitized_env(), CPU_TIMEOUT)
@@ -352,6 +409,18 @@ def _orchestrate():
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
             "detail": {"error": "all bench paths failed", "tpu": False},
         }
+    if not result.get("detail", {}).get("tpu"):
+        # a wedged window must not erase the hardware evidence: carry the
+        # last healthy-window TPU number (honestly labeled with its capture
+        # time) inside the fallback artifact
+        snap = _last_snapshot()
+        if snap is not None:
+            result.setdefault("detail", {})["last_tpu"] = {
+                "value": snap.get("value"),
+                "unit": snap.get("unit"),
+                "vs_baseline": snap.get("vs_baseline"),
+                "detail": snap.get("detail"),
+            }
     print(json.dumps(result), flush=True)
 
 

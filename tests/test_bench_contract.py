@@ -1,0 +1,84 @@
+"""Bench artifact contract (VERDICT r3 #2).
+
+The driver records exactly one JSON line from `python bench.py` per round.
+Round 3 lost its TPU number because the relay was wedged at bench time and
+the CPU fallback carried no pointer to the healthy-window snapshot. These
+tests pin the contract so that can never happen silently again:
+
+- the orchestrator always emits one parseable line with the metric fields;
+- a non-TPU fallback line embeds the most recent BENCH_TPU_SNAPSHOT.json
+  (honestly labeled, with its capture timestamp) as detail.last_tpu.
+
+Runs the real orchestrator in a subprocess with a 5 s probe budget — the
+probe fails fast whether the relay is wedged or merely cold, so the run
+deterministically exercises the fallback path on any host.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+SNAPSHOT = os.path.join(REPO, "BENCH_TPU_SNAPSHOT.json")
+
+pytestmark = pytest.mark.slow
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env["GRAFT_BENCH_PROBE_TIMEOUT"] = "5"
+    # if a warm healthy relay lets the 5s probe pass, cap the TPU leg too
+    # (the orchestrator clamps the budget at >=300s) so the subprocess
+    # timeout below is never exceeded on any host
+    env["GRAFT_BENCH_TPU_TIMEOUT"] = "60"
+    env["GRAFT_BENCH_CPU_TIMEOUT"] = "240"
+    # the bench parent must stay wedge-immune regardless of this pytest
+    # process's own backend setup
+    env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+def test_fallback_line_carries_last_tpu_snapshot():
+    out = subprocess.run([sys.executable, BENCH], env=_clean_env(),
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=800)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {out.stdout!r}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "detail"):
+        assert key in rec, rec
+    assert rec["metric"] == "llama_train_tokens_per_sec_per_chip"
+    if rec["detail"].get("tpu"):
+        pytest.skip("relay healthy — this run produced a real TPU line")
+    # the 5s probe cannot pass even on a healthy relay (cold init >90s),
+    # so from here the line is the CPU fallback: it must carry the last
+    # hardware number when a snapshot exists on disk.
+    if os.path.exists(SNAPSHOT) and json.load(open(SNAPSHOT)).get(
+            "detail", {}).get("tpu"):
+        last = rec["detail"].get("last_tpu")
+        assert last is not None, rec
+        assert last["detail"]["tpu"] is True
+        assert last["detail"].get("captured_at"), last
+        assert last["value"] > 0
+
+
+def test_snapshot_loader_rejects_non_tpu_files(tmp_path, monkeypatch):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    fake = tmp_path / "snap.json"
+    fake.write_text(json.dumps({"value": 1.0, "detail": {"tpu": False}}))
+    monkeypatch.setattr(bench, "SNAPSHOT_PATH", str(fake))
+    assert bench._last_snapshot() is None
+    fake.write_text("not json")
+    assert bench._last_snapshot() is None
+    fake.write_text(json.dumps(
+        {"value": 2.0, "detail": {"tpu": True}}))
+    snap = bench._last_snapshot()
+    assert snap is not None and snap["detail"]["captured_at"]
