@@ -3,6 +3,14 @@
 # transition. Burns the window in priority order, SIGTERM-first (timeout's
 # default) so a hung stage can't leave a dead pool claim the way a KILLed
 # allocation does. Everything logs to TPU_WINDOW.log for the round report.
+#
+# Stage order (VERDICT r3 #1/#3/#6 + weak #7): the headline bench first,
+# then the SAFE tier (previously-hardware-validated flash units + profile
+# captures), then the serving throughput number, and the risky first-contact
+# Mosaic compiles LAST — tools/tpu_burndown.py runs those one subprocess at
+# a time, health-probing after each, with the known relay-killer (dropout
+# hardware PRNG) at the very end. A wedge mid-burndown can no longer take
+# the bench/profile/serving artifacts down with it.
 set -u
 LOG=/root/repo/TPU_WINDOW.log
 ts() { date -u +%Y-%m-%dT%H:%M:%SZ; }
@@ -18,38 +26,61 @@ echo "$(ts) window opened — playbook start" >> "$LOG"
 
 cd /root/repo
 
-# 1) headline bench (its orchestrator probes + falls back internally)
+probe_or_stop() {
+  timeout 300 python -c "import jax; jax.devices()" >/dev/null 2>&1 || {
+    echo "$(ts) relay unhealthy after $1; playbook stops" >> "$LOG"; exit 0; }
+}
+
+# 1) headline bench (its orchestrator probes + falls back internally and
+#    persists BENCH_TPU_SNAPSHOT.json itself on a real TPU number)
 echo "$(ts) stage 1: bench.py" >> "$LOG"
 timeout 1500 python bench.py > /tmp/.window_bench.json 2>/tmp/.window_bench.log
 rc=$?
 echo "$(ts) bench rc=$rc: $(cat /tmp/.window_bench.json 2>/dev/null)" >> "$LOG"
-# keep the last GOOD snapshot: only overwrite on success with parseable JSON
-if [ $rc -eq 0 ] && python -c "import json,sys; json.load(open('/tmp/.window_bench.json'))" 2>/dev/null; then
-  cp /tmp/.window_bench.json /root/repo/BENCH_TPU_SNAPSHOT.json
-fi
+probe_or_stop "bench"
 
-# stop if the relay died mid-stage (don't pile more claims on a wedge)
-timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1 || {
-  echo "$(ts) relay unhealthy after bench; playbook stops" >> "$LOG"; exit 0; }
-
-# 2) real-TPU test tier: Mosaic-compile every Pallas kernel, hardware-PRNG
-#    dropout checks, profile captures
-echo "$(ts) stage 2: pytest -m tpu" >> "$LOG"
-timeout 2400 python -m pytest tests/ -m tpu -q \
-    > /tmp/.window_tputests.log 2>&1
+# 2) safe tier: hardware-validated flash kernels + xplane profile captures +
+#    fused-serving correctness — per-unit subprocesses, health-probed.
+#    Outer timeout = budget + 400s headroom (post-unit wedge probe 300s +
+#    SIGTERM grace) so a wedge at the budget edge still records its culprit.
+echo "$(ts) stage 2: burndown --phase safe" >> "$LOG"
+timeout 2400 python tools/tpu_burndown.py --phase safe --budget 1800 \
+    >> "$LOG" 2>&1
 rc=$?
-echo "$(ts) pytest -m tpu rc=$rc: $(tail -1 /tmp/.window_tputests.log)" >> "$LOG"
-cp /tmp/.window_tputests.log /root/repo/TPU_TESTS.log 2>/dev/null
+echo "$(ts) burndown safe rc=$rc" >> "$LOG"
+[ $rc -eq 2 ] && { echo "$(ts) relay wedged in safe tier; stop" >> "$LOG"; exit 0; }
+probe_or_stop "safe tier"
 
-timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1 || {
-  echo "$(ts) relay unhealthy after tpu tests; playbook stops" >> "$LOG"; exit 0; }
-
-# 3) serving decode benchmark on the chip (repo root on the path — the
-# ambient PYTHONPATH only carries the axon sitecustomize)
+# 3) serving decode benchmark on the chip -> SERVING_TPU_SNAPSHOT.json
+#    (repo root on the path — ambient PYTHONPATH only carries axon)
 echo "$(ts) stage 3: bench_decode" >> "$LOG"
-timeout 900 env PYTHONPATH="/root/repo:${PYTHONPATH:-}" \
-    python benchmarks/bench_decode.py > /tmp/.window_decode.log 2>&1
+timeout 1200 env PYTHONPATH="/root/repo:${PYTHONPATH:-}" \
+    python benchmarks/bench_decode.py > /tmp/.window_decode.json \
+    2>/tmp/.window_decode.log
 rc=$?
-echo "$(ts) bench_decode rc=$rc: $(tail -2 /tmp/.window_decode.log | tr '\n' ' ')" >> "$LOG"
+echo "$(ts) bench_decode rc=$rc: $(tail -c 400 /tmp/.window_decode.json 2>/dev/null)" >> "$LOG"
+# validate + extract + atomically persist in ONE python process so a
+# half-valid output can never clobber the last good serving snapshot
+[ $rc -eq 0 ] && python - <<'EOF' >> "$LOG" 2>&1
+import json, os
+lines = [l.strip() for l in open('/tmp/.window_decode.json')
+         if l.strip().startswith('{')]
+rec = json.loads(lines[-1])
+assert rec.get('detail', {}).get('tpu') is True, 'not a TPU record'
+tmp = '/root/repo/SERVING_TPU_SNAPSHOT.json.tmp'
+with open(tmp, 'w') as f:
+    json.dump(rec, f); f.write('\n')
+os.replace(tmp, '/root/repo/SERVING_TPU_SNAPSHOT.json')
+print('serving snapshot persisted')
+EOF
+probe_or_stop "bench_decode"
+
+# 4) risky first-contact Mosaic compiles, safest->riskiest, dropout PRNG
+#    (the 2026-07-31 relay-wedger) LAST; aborts itself on a wedge
+echo "$(ts) stage 4: burndown --phase risky" >> "$LOG"
+timeout 3000 python tools/tpu_burndown.py --phase risky --budget 2500 \
+    >> "$LOG" 2>&1
+rc=$?
+echo "$(ts) burndown risky rc=$rc" >> "$LOG"
 
 echo "$(ts) playbook complete" >> "$LOG"

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Per-kernel TPU hardware burndown (VERDICT r3 #3).
+
+Round 3 burned an 8-hour relay window because one bad Mosaic compile (the
+flash-dropout hardware-PRNG path) wedged the axon relay from *inside* a
+monolithic `pytest -m tpu` run — every later kernel in the tier lost its
+first hardware contact. This runner replaces that stage:
+
+- each tier unit runs in its OWN subprocess (pytest node id), SIGTERM-first
+  on timeout so a hung compile never leaves a dead pool claim;
+- units are ordered safest -> riskiest: kernels that already compiled on
+  hardware first, first-contact compiles after, and the known relay-killer
+  (pltpu.prng_*) LAST;
+- a `jax.devices()` health probe runs after every unit; if the relay
+  stopped answering, the run ABORTS and the report names the culprit;
+- results merge into TPU_BURNDOWN.json (per-unit status across windows)
+  and append to TPU_TESTS.log for the round report.
+
+Phases let the heal playbook interleave other artifacts between the safe
+and risky halves (bench -> safe tier -> serving bench -> risky tier), so a
+wedge in a first-contact compile can no longer take the serving number
+down with it.
+
+Reference analog: the per-arch device validation the reference runs for
+every kernel (test/legacy_test/test_flash_attention.py over
+phi/kernels/gpu/flash_attn_kernel.cu; autotune cache at
+phi/kernels/autotune/cache.h:42) — here the device is one axon-relayed
+v5e chip whose compile service wedges on certain failures, so validation
+must be incremental and health-checked.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT = os.environ.get("GRAFT_BURNDOWN_REPORT",
+                        os.path.join(REPO, "TPU_BURNDOWN.json"))
+LOG = os.environ.get("GRAFT_BURNDOWN_LOG",
+                     os.path.join(REPO, "TPU_TESTS.log"))
+
+# (name, pytest node id under tests/test_tpu_tier.py, phase, timeout_s)
+# safe  = compiled on hardware in a previous window (round-3 flash fixes),
+#         or skips without >=2 chips; first in line so profiles/serving
+#         evidence lands before any first-contact compile can wedge.
+# risky = first-contact Mosaic compiles, safest first; the dropout
+#         hardware-PRNG units are LAST — that exact compile 500'd and
+#         wedged the relay for 8+ hours on 2026-07-31 (TPU_PROBES.log).
+UNITS = [
+    ("flash_fwd", "test_flash_mosaic_forward", "safe", 480),
+    ("flash_grads", "test_flash_mosaic_grads", "safe", 480),
+    ("flash_gqa_mask_varlen", "test_flash_mosaic_gqa_mask_varlen",
+     "safe", 480),
+    ("flash_shapes", "test_flash_mosaic_arbitrary_and_short_seq",
+     "safe", 480),
+    ("serving_fused", "test_fused_serving_on_tpu", "safe", 600),
+    ("profile_flagship", "test_flagship_attention_step_profile",
+     "safe", 600),
+    ("profile_pipeline", "test_pipeline_bubble_profiles", "safe", 480),
+    ("profile_ring", "test_ring_attention_overlap_trace", "safe", 480),
+    ("rmsnorm", "test_rmsnorm_mosaic", "risky", 480),
+    ("adamw", "test_adamw_mosaic", "risky", 480),
+    ("block_sparse", "test_block_sparse_mosaic", "risky", 600),
+    ("autotune", "test_flash_autotune_sweep", "risky", 900),
+    ("dropout_prng_fwd",
+     "test_flash_dropout_hw_prng_determinism_and_keep_rate", "risky", 480),
+    ("dropout_prng_bwd",
+     "test_flash_dropout_hw_prng_fwd_bwd_seed_coordinates", "risky", 480),
+]
+
+PROBE_TIMEOUT = int(os.environ.get("GRAFT_BURNDOWN_PROBE_TIMEOUT", "300"))
+
+
+def _ts():
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _log(msg):
+    line = f"{_ts()} [burndown] {msg}"
+    print(line, flush=True)
+    try:
+        with open(LOG, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+sys.path.insert(0, REPO)
+# one copy of the SIGTERM-first bounded wait (a SIGKILL mid-TPU-use leaves
+# a dead pool claim) — bench.py owns it; stdlib-only at import time
+from bench import _communicate  # noqa: E402
+
+
+def _probe(interpret: bool) -> bool:
+    """Relay (or, interpreted, CPU backend) still answering?"""
+    cmd = os.environ.get("GRAFT_BURNDOWN_PROBE_CMD")
+    if cmd:  # test hook: orchestration tests script the health sequence
+        return subprocess.run(cmd, shell=True, cwd=REPO,
+                              timeout=PROBE_TIMEOUT or 30).returncode == 0
+    if interpret:
+        code = "import jax; assert jax.devices()"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    else:
+        code = ("import jax; ds = jax.devices(); "
+                "assert ds[0].platform == 'tpu', ds")
+        env = dict(os.environ)
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL, env=env, cwd=REPO)
+    _, timed_out = _communicate(proc, PROBE_TIMEOUT)
+    return (not timed_out) and proc.returncode == 0
+
+
+def _run_unit(name, node, timeout, interpret):
+    env = dict(os.environ)
+    if interpret:
+        env["PADDLE_TPU_TIER_INTERPRET"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+    else:
+        env["PADDLE_TPU_RUN_TPU_TESTS"] = "1"
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pytest",
+         f"tests/test_tpu_tier.py::{node}", "-q", "--no-header", "-rA"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    out, timed_out = _communicate(proc, timeout)
+    secs = round(time.perf_counter() - t0, 1)
+    tail = (out or "").strip().splitlines()[-15:]
+    if timed_out:
+        status = "timeout"
+    elif proc.returncode == 0:
+        # an all-skip unit (e.g. multi-chip profiles on one chip) exits 0
+        # with only 'N skipped' in the summary
+        status = "passed" if " passed" in (out or "") else "skipped"
+    else:
+        status = "failed"
+    return {"name": name, "node": node, "status": status,
+            "rc": proc.returncode, "seconds": secs, "at": _ts(),
+            "tail": "\n".join(tail)[-2000:]}
+
+
+def _load_report():
+    try:
+        with open(REPORT) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"units": {}}
+
+
+def _save_report(report):
+    tmp = REPORT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, REPORT)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phase", choices=["safe", "risky", "all"],
+                    default="all")
+    ap.add_argument("--units", help="comma-separated unit names (overrides "
+                    "--phase)")
+    ap.add_argument("--budget", type=int, default=3600,
+                    help="overall wall-clock budget (s); remaining units "
+                    "are marked not_run when it runs out")
+    ap.add_argument("--interpret", action="store_true",
+                    help="CPU self-check: run the same orchestration with "
+                    "PADDLE_TPU_TIER_INTERPRET=1 (no hardware needed)")
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args()
+
+    selected = [u for u in UNITS
+                if (args.units and u[0] in args.units.split(","))
+                or (not args.units and args.phase in ("all", u[2]))]
+    if args.list:
+        for name, node, phase, tmo in selected:
+            print(f"{phase:5s} {name:24s} {node} ({tmo}s)")
+        return 0
+
+    mode = "interpret" if args.interpret else "hardware"
+    _log(f"start phase={args.phase} units={[u[0] for u in selected]} "
+         f"mode={mode}")
+    report = _load_report()
+    report["last_run"] = {"at": _ts(), "phase": args.phase, "mode": mode}
+
+    if not _probe(args.interpret):
+        _log("initial probe failed — relay wedged/unreachable; nothing run")
+        report["last_run"]["result"] = "relay_down"
+        _save_report(report)
+        return 0
+
+    deadline = time.perf_counter() + args.budget
+    aborted = None
+    for name, node, phase, tmo in selected:
+        remaining = deadline - time.perf_counter()
+        if remaining < 120:
+            _log(f"budget exhausted before {name}; stopping")
+            # never clobber a prior window's real result with 'not_run'
+            if name not in report["units"]:
+                report["units"][name] = {"name": name, "node": node,
+                                         "status": "not_run", "at": _ts(),
+                                         "why": "budget"}
+            report["last_run"].setdefault("not_run", []).append(name)
+            continue
+        _log(f"unit {name} ({phase}) starting, timeout "
+             f"{min(tmo, int(remaining))}s")
+        res = _run_unit(name, node, min(tmo, int(remaining)), args.interpret)
+        res["mode"] = mode
+        report["units"][name] = res
+        _log(f"unit {name}: {res['status']} ({res['seconds']}s)")
+        _save_report(report)
+        if not _probe(args.interpret):
+            aborted = name
+            res["wedged_relay"] = True
+            _log(f"HEALTH PROBE FAILED after unit {name} — relay wedged; "
+                 f"aborting (culprit recorded)")
+            break
+    report["last_run"]["result"] = (
+        f"aborted_after={aborted}" if aborted else "completed")
+    _save_report(report)
+    _log(f"done: {report['last_run']['result']}")
+    return 2 if aborted else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
