@@ -49,7 +49,9 @@ timeout 2400 python tools/tpu_burndown.py --phase safe --budget 1800 \
 rc=$?
 echo "$(ts) burndown safe rc=$rc" >> "$LOG"
 [ $rc -eq 2 ] && { echo "$(ts) relay wedged in safe tier; stop" >> "$LOG"; exit 0; }
-probe_or_stop "safe tier"
+# rc=0 means the burndown's own final health probe just passed — only
+# re-probe when the stage ended abnormally (e.g. outer-timeout kill)
+[ $rc -ne 0 ] && probe_or_stop "safe tier"
 
 # 3) serving decode benchmark on the chip -> SERVING_TPU_SNAPSHOT.json
 #    (repo root on the path — ambient PYTHONPATH only carries axon)
@@ -73,7 +75,8 @@ with open(tmp, 'w') as f:
 os.replace(tmp, '/root/repo/SERVING_TPU_SNAPSHOT.json')
 print('serving snapshot persisted')
 EOF
-probe_or_stop "bench_decode"
+# no probe here: stage 4's burndown begins with its own health probe and
+# exits cleanly (relay_down) if bench_decode wedged the relay
 
 # 4) risky first-contact Mosaic compiles, safest->riskiest, dropout PRNG
 #    (the 2026-07-31 relay-wedger) LAST; aborts itself on a wedge

@@ -33,6 +33,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -95,12 +96,36 @@ sys.path.insert(0, REPO)
 from bench import _communicate  # noqa: E402
 
 
+# the subprocess currently holding (or probing) the TPU claim — the SIGTERM
+# handler must pass the signal down before dying, or the playbook's outer
+# `timeout` would orphan a pytest child mid-allocation: the exact dead-claim
+# wedge this runner exists to prevent
+_ACTIVE = {"proc": None}
+
+
+def _on_sigterm(signum, frame):
+    p = _ACTIVE.get("proc")
+    if p is not None and p.poll() is None:
+        try:
+            p.send_signal(signal.SIGTERM)
+            p.wait(timeout=25)
+        except Exception:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    raise SystemExit(143)
+
+
 def _probe(interpret: bool) -> bool:
     """Relay (or, interpreted, CPU backend) still answering?"""
     cmd = os.environ.get("GRAFT_BURNDOWN_PROBE_CMD")
     if cmd:  # test hook: orchestration tests script the health sequence
-        return subprocess.run(cmd, shell=True, cwd=REPO,
-                              timeout=PROBE_TIMEOUT or 30).returncode == 0
+        try:
+            return subprocess.run(cmd, shell=True, cwd=REPO,
+                                  timeout=PROBE_TIMEOUT or 30).returncode == 0
+        except subprocess.TimeoutExpired:
+            return False
     if interpret:
         code = "import jax; assert jax.devices()"
         env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
@@ -111,7 +136,11 @@ def _probe(interpret: bool) -> bool:
     proc = subprocess.Popen([sys.executable, "-c", code],
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL, env=env, cwd=REPO)
-    _, timed_out = _communicate(proc, PROBE_TIMEOUT)
+    _ACTIVE["proc"] = proc
+    try:
+        _, timed_out = _communicate(proc, PROBE_TIMEOUT)
+    finally:
+        _ACTIVE["proc"] = None
     return (not timed_out) and proc.returncode == 0
 
 
@@ -129,7 +158,11 @@ def _run_unit(name, node, timeout, interpret):
          f"tests/test_tpu_tier.py::{node}", "-q", "--no-header", "-rA"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env, cwd=REPO)
-    out, timed_out = _communicate(proc, timeout)
+    _ACTIVE["proc"] = proc
+    try:
+        out, timed_out = _communicate(proc, timeout)
+    finally:
+        _ACTIVE["proc"] = None
     secs = round(time.perf_counter() - t0, 1)
     tail = (out or "").strip().splitlines()[-15:]
     if timed_out:
@@ -176,9 +209,15 @@ def main():
     ap.add_argument("--list", action="store_true")
     args = ap.parse_args()
 
-    selected = [u for u in UNITS
-                if (args.units and u[0] in args.units.split(","))
-                or (not args.units and args.phase in ("all", u[2]))]
+    if args.units:
+        wanted = [n.strip() for n in args.units.split(",") if n.strip()]
+        known = {u[0] for u in UNITS}
+        unknown = [n for n in wanted if n not in known]
+        if unknown:
+            ap.error(f"unknown unit(s) {unknown}; known: {sorted(known)}")
+        selected = [u for u in UNITS if u[0] in wanted]
+    else:
+        selected = [u for u in UNITS if args.phase in ("all", u[2])]
     if args.list:
         for name, node, phase, tmo in selected:
             print(f"{phase:5s} {name:24s} {node} ({tmo}s)")
@@ -187,6 +226,9 @@ def main():
     mode = "interpret" if args.interpret else "hardware"
     _log(f"start phase={args.phase} units={[u[0] for u in selected]} "
          f"mode={mode}")
+    # the playbook's outer `timeout` SIGTERMs us at the stage edge: forward
+    # it to the child still holding the TPU claim, then record what happened
+    signal.signal(signal.SIGTERM, _on_sigterm)
     report = _load_report()
     report["last_run"] = {"at": _ts(), "phase": args.phase, "mode": mode}
 
@@ -198,6 +240,22 @@ def main():
 
     deadline = time.perf_counter() + args.budget
     aborted = None
+    try:
+        _run_selected(selected, deadline, report, args)
+        aborted = report.pop("_aborted_on", None)
+    except SystemExit:
+        report["last_run"]["result"] = "terminated"
+        _save_report(report)
+        _log("SIGTERM: child cleaned up, report saved")
+        raise
+    report["last_run"]["result"] = (
+        f"aborted_after={aborted}" if aborted else "completed")
+    _save_report(report)
+    _log(f"done: {report['last_run']['result']}")
+    return 2 if aborted else 0
+
+
+def _run_selected(selected, deadline, report, args):
     for name, node, phase, tmo in selected:
         remaining = deadline - time.perf_counter()
         if remaining < 120:
@@ -212,21 +270,16 @@ def main():
         _log(f"unit {name} ({phase}) starting, timeout "
              f"{min(tmo, int(remaining))}s")
         res = _run_unit(name, node, min(tmo, int(remaining)), args.interpret)
-        res["mode"] = mode
+        res["mode"] = report["last_run"]["mode"]
         report["units"][name] = res
         _log(f"unit {name}: {res['status']} ({res['seconds']}s)")
         _save_report(report)
         if not _probe(args.interpret):
-            aborted = name
+            report["_aborted_on"] = name
             res["wedged_relay"] = True
             _log(f"HEALTH PROBE FAILED after unit {name} — relay wedged; "
                  f"aborting (culprit recorded)")
-            break
-    report["last_run"]["result"] = (
-        f"aborted_after={aborted}" if aborted else "completed")
-    _save_report(report)
-    _log(f"done: {report['last_run']['result']}")
-    return 2 if aborted else 0
+            return
 
 
 if __name__ == "__main__":
