@@ -71,13 +71,16 @@ def cachekv_scale_kwargs(scales, li):
 
 
 def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant,
-                    dynamic=False):
+                    dynamic=False, compute=False):
     """Validate the cachekv-int8 contract and return the four scale
     arrays. All-or-nothing: partial scale sets would silently skip
     quantization, and an int8 pool without scales would astype-truncate
     raw fp rows into int8 codes — both are loud errors instead. In
-    dynamic mode an int8 pool with NO scales is legal: the op computes
-    per-(sequence, head) scales from this call's rows (prefill)."""
+    dynamic mode, computing scales from this call's rows is an EXPLICIT
+    prefill-caller opt-in (compute=True); a call with neither scales nor
+    the opt-in errors even under jit tracing, so a compiled decode that
+    forgot to thread the prefill's scales can never silently re-derive
+    them from one token and dequantize the cached timeline wrong."""
     scales = (_arr(k_quant), _arr(v_quant), _arr(k_dequant),
               _arr(v_dequant))
     given = [s is not None for s in scales]
@@ -85,10 +88,18 @@ def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant,
         raise ValueError("cachekv int8 needs all four scale tensors "
                          "(k/v quant + k/v dequant)")
     is_int8 = jnp.issubdtype(kc.dtype, jnp.integer)
-    if is_int8 and not all(given) and not dynamic:
+    if compute and not dynamic:
+        raise ValueError("compute_dynamic_scales requires "
+                         "use_dynamic_cachekv_quant=True")
+    if compute and all(given):
+        raise ValueError("compute_dynamic_scales with scales already "
+                         "given is ambiguous: drop one of them")
+    if is_int8 and not all(given) and not (dynamic and compute):
         raise ValueError(
-            "int8 cache pool but no quant scales: calibrate first (a raw "
-            "astype would truncate fp rows into int8 codes)")
+            "int8 cache pool but no quant scales: calibrate first, thread "
+            "the prefill's scales, or opt in with compute_dynamic_scales="
+            "True on the prefill call (a raw astype would truncate fp "
+            "rows into int8 codes)")
     if all(given) and not is_int8:
         raise ValueError("cachekv quant scales given but the cache pool "
                          f"dtype is {kc.dtype}; allocate int8 pools")
@@ -100,15 +111,20 @@ def _cachekv_scales(kc, k_quant, v_quant, k_dequant, v_dequant,
     return scales
 
 
-def _dynamic_prefill_scales(kt, vt, seq_of, bsz):
+def _dynamic_prefill_scales(kt, vt, seq_of, bsz, valid_mask=None):
     """Per-(sequence, head) amax scales from THIS call's K/V rows — the
     reference's DynamicQuantCacheKernel: prefill fills [B, H] quant
     (127/amax) and dequant (amax/127) tensors that decode then consumes.
-    kt/vt [T, H, D]."""
-    ka = jax.ops.segment_max(jnp.abs(kt.astype(jnp.float32)).max(-1),
-                             seq_of, num_segments=bsz)        # [B, H]
-    va = jax.ops.segment_max(jnp.abs(vt.astype(jnp.float32)).max(-1),
-                             seq_of, num_segments=bsz)
+    kt/vt [T, H, D]. valid_mask [T] (optional) drops rows from the amax
+    statistics — chunked prefill's zero-pad tail must not contaminate a
+    sequence's scales (the unchunked path sees no padding)."""
+    ak = jnp.abs(kt.astype(jnp.float32)).max(-1)              # [T, H]
+    av = jnp.abs(vt.astype(jnp.float32)).max(-1)
+    if valid_mask is not None:
+        ak = jnp.where(valid_mask[:, None], ak, 0.0)
+        av = jnp.where(valid_mask[:, None], av, 0.0)
+    ka = jax.ops.segment_max(ak, seq_of, num_segments=bsz)    # [B, H]
+    va = jax.ops.segment_max(av, seq_of, num_segments=bsz)
     ka = jnp.maximum(ka, 1e-6)
     va = jnp.maximum(va, 1e-6)
     return {"kq": 127.0 / ka, "vq": 127.0 / va,
@@ -134,22 +150,24 @@ def _per_seq_scale(scale, bsz):
     return scale[None, :, None, None]
 
 
-def _dynamic_compute_allowed(enc):
-    """Dynamic-mode scale computation is a PREFILL-call contract: a
-    decode call that forgot to thread the prefill's scales must not
-    silently re-derive them from one token. With concrete lengths
-    (host-driven serving loops) this is enforced loudly; under jit
-    tracing the values are unknowable and the documented contract
-    governs."""
+def _dynamic_compute_allowed(enc, this):
+    """Dynamic-mode scale computation is a PREFILL-caller contract
+    (explicit compute_dynamic_scales opt-in): a decode step that wrongly
+    opts in must not derive a sequence's scales from one token. Prefill
+    shapes are enc > 0 (whole-prompt call) or enc == 0 with this > 1
+    (chunked-prefill append); a single-token call (enc == 0, this == 1)
+    is decode-shaped and rejected. With concrete lengths (host-driven
+    serving loops) this is enforced loudly; under jit tracing the values
+    are unknowable and the documented contract governs."""
     try:
-        if not bool((enc > 0).all()):
-            # any() would let a MIXED prefill+decode batch derive the
-            # decode rows' scales from one token — scale computation is a
-            # pure-prefill contract
+        if not bool(((enc > 0) | (this > 1)).all()):
+            # any() would let a MIXED batch derive the decode rows'
+            # scales from one token — scale computation is a pure-prefill
+            # contract
             raise ValueError(
-                "use_dynamic_cachekv_quant with no scales on a call with "
-                "decode-mode sequences (seq_lens_encoder == 0): thread "
-                "the scales the prefill call returned")
+                "compute_dynamic_scales on a call with decode-mode "
+                "sequences (seq_lens_encoder == 0, seq_lens_this_time == "
+                "1): thread the scales the prefill call returned")
     except jax.errors.TracerBoolConversionError:
         pass
 
@@ -276,6 +294,8 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                               max_seq_len=-1, block_size=64,
                               use_neox_style=False,
                               use_dynamic_cachekv_quant=False,
+                              compute_dynamic_scales=False,
+                              dynamic_scale_valid=None,
                               quant_round_type=1, quant_max_bound=127.0,
                               quant_min_bound=-127.0, out_scale=-1,
                               compute_dtype="default"):
@@ -296,10 +316,14 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     (use_dynamic_cachekv_quant=True: per-sequence scales the reference's
     DynamicQuantCacheKernel fills at prefill) with int8 cache pools —
     rows quantize on the scatter, the gathered timeline dequantizes
-    before the dot. In dynamic mode with NO scales given (the prefill
-    call), the op computes them from this call's K/V and RETURNS them as
-    a fifth element: a (kq, vq, kdq, vdq) tuple of [B, H] tensors for
-    the decode calls to consume.
+    before the dot. Computing scales from this call's K/V is an EXPLICIT
+    prefill-caller opt-in: pass compute_dynamic_scales=True (and no
+    scale tensors) and the op RETURNS them as a fifth element, a
+    (kq, vq, kdq, vdq) tuple of [B, H] tensors for later chunk/decode
+    calls to consume. dynamic_scale_valid [B] int32 (optional) limits
+    the scale statistics to each sequence's leading N rows of THIS call
+    — chunked prefill passes the unpadded length so the zero-pad tail
+    cannot contaminate the scales.
 
     Returns (out [token_num, H*D], qkv, key_cache_out, value_cache_out
     [, scales]).
@@ -312,7 +336,8 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     kq, vq, kdq, vdq = _cachekv_scales(
         kc, cache_k_quant_scales, cache_v_quant_scales,
         cache_k_dequant_scales, cache_v_dequant_scales,
-        dynamic=use_dynamic_cachekv_quant)
+        dynamic=use_dynamic_cachekv_quant,
+        compute=compute_dynamic_scales)
     enc = _arr(seq_lens_encoder).reshape(-1).astype(jnp.int32)
     dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
     this = _arr(seq_lens_this_time).reshape(-1).astype(jnp.int32)
@@ -350,9 +375,14 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         qt, kt = _rope(qt), _rope(kt)
 
     new_scales = None
-    if use_dynamic_cachekv_quant and kq is None:
-        _dynamic_compute_allowed(enc)
-        new_scales = _dynamic_prefill_scales(kt, vt, seq_of, bsz)
+    if compute_dynamic_scales:
+        _dynamic_compute_allowed(enc, this)
+        valid_mask = None
+        if dynamic_scale_valid is not None:
+            nv = _arr(dynamic_scale_valid).reshape(-1).astype(jnp.int32)
+            valid_mask = local < nv[seq_of]
+        new_scales = _dynamic_prefill_scales(kt, vt, seq_of, bsz,
+                                             valid_mask)
         kq, vq, kdq, vdq = (new_scales["kq"], new_scales["vq"],
                             new_scales["kdq"], new_scales["vdq"])
     kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_,
@@ -400,7 +430,9 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
                         cache_v_quant_scales=None,
                         cache_k_dequant_scales=None,
                         cache_v_dequant_scales=None,
-                        use_dynamic_cachekv_quant=False):
+                        use_dynamic_cachekv_quant=False,
+                        compute_dynamic_scales=False,
+                        dynamic_scale_valid=None):
     """Paged-KV attention with UNEXPANDED grouped-query heads (the GQA
     sibling of block_multihead_attention; reference analog:
     block_multihead_attention.py:19 serving Llama-family models, where
@@ -421,8 +453,11 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
 
     Cache-KV int8: same scale contract as block_multihead_attention —
     static [KV] per-head scales, or dynamic [B, KV] per-sequence scales
-    (use_dynamic_cachekv_quant=True; the prefill call with no scales
-    computes and RETURNS them as a fourth element).
+    (use_dynamic_cachekv_quant=True). A prefill call opting in with
+    compute_dynamic_scales=True (and no scale tensors) computes them
+    and RETURNS them as a fourth element; dynamic_scale_valid [B]
+    limits the statistics to each sequence's leading rows (chunked
+    prefill's pad-tail guard).
 
     Returns (out [T, H*D], key_cache_out, value_cache_out [, scales]).
     """
@@ -431,7 +466,8 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
     kq, vq, kdq, vdq = _cachekv_scales(
         kc, cache_k_quant_scales, cache_v_quant_scales,
         cache_k_dequant_scales, cache_v_dequant_scales,
-        dynamic=use_dynamic_cachekv_quant)
+        dynamic=use_dynamic_cachekv_quant,
+        compute=compute_dynamic_scales)
     enc = _arr(seq_lens_encoder).reshape(-1).astype(jnp.int32)
     dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
     this = _arr(seq_lens_this_time).reshape(-1).astype(jnp.int32)
@@ -442,7 +478,7 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
     token_num, nh, _ = qt.shape
     rep = nh // kvh
 
-    seq_of, _local, pos = _token_timeline(cu_q, dec, token_num)
+    seq_of, local, pos = _token_timeline(cu_q, dec, token_num)
 
     if rope_cos is not None:
         cos_t = _arr(rope_cos)[pos].astype(jnp.float32)        # [T, D/2]
@@ -457,9 +493,14 @@ def block_gqa_attention(q, k, v, key_cache, value_cache, seq_lens_encoder,
         qt, kt = _rope(qt), _rope(kt)
 
     new_scales = None
-    if use_dynamic_cachekv_quant and kq is None:
-        _dynamic_compute_allowed(enc)
-        new_scales = _dynamic_prefill_scales(kt, vt, seq_of, bsz)
+    if compute_dynamic_scales:
+        _dynamic_compute_allowed(enc, this)
+        valid_mask = None
+        if dynamic_scale_valid is not None:
+            nv = _arr(dynamic_scale_valid).reshape(-1).astype(jnp.int32)
+            valid_mask = local < nv[seq_of]
+        new_scales = _dynamic_prefill_scales(kt, vt, seq_of, bsz,
+                                             valid_mask)
         kq, vq, kdq, vdq = (new_scales["kq"], new_scales["vq"],
                             new_scales["kdq"], new_scales["vdq"])
     kc, vc = _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, bs_,

@@ -284,7 +284,8 @@ class GPT2ForCausalLM(Layer):
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
                            block_size=64, dec_base=None, logits_at=None,
-                           dynamic_cache_scales=False):
+                           dynamic_cache_scales=False, cache_scales=None,
+                           dynamic_scale_valid=None):
         """Prompt pass writing KV into a CALLER-OWNED page pool.
 
         input_ids [B, s]; layers: ``paged_alloc`` pool; block_tables
@@ -299,11 +300,24 @@ class GPT2ForCausalLM(Layer):
         within the chunk, attending the whole prefix). A fixed chunk
         width makes prompt processing reuse ONE executable for every
         prompt length instead of compiling per length.
+
+        Dynamic cachekv-int8 x chunked composition (reference analog:
+        block_multihead_attention takes cache quant scales AND chunked
+        input in one op): dynamic_cache_scales=True computes per-
+        (sequence, head) scales from this call (the FIRST chunk /
+        unchunked prompt; dynamic_scale_valid [B] masks a pad tail out
+        of the statistics) and returns them third; cache_scales (the
+        per-layer scale dicts a first chunk returned) makes LATER chunks
+        quantize with those same scales, so the whole chunk loop is
+        bit-consistent with a single-call prefill given the same scales.
         """
         import paddle_tpu as paddle
         from ..incubate.nn.functional.decode_attention import \
             block_multihead_attention
 
+        if dynamic_cache_scales and cache_scales is not None:
+            raise ValueError("dynamic_cache_scales computes scales; "
+                             "cache_scales consumes them — pass one")
         b, s = input_ids.shape
         bt = block_tables
         if dec_base is None:
@@ -332,18 +346,22 @@ class GPT2ForCausalLM(Layer):
             x = blk.ln_1(hidden)
             qkv = blk.attn.c_attn(x)                     # [T, 3*H*D]
             if dynamic_cache_scales:
-                out, _, kc, vc, (kq, vq, kdq, vdq) = \
-                    block_multihead_attention(
-                        qkv, kc, vc, enc, dec, this, None, None, cu_q,
-                        cu_q, bt, block_size=block_size,
-                        use_dynamic_cachekv_quant=True)
+                extra = dict(use_dynamic_cachekv_quant=True,
+                             compute_dynamic_scales=True,
+                             dynamic_scale_valid=dynamic_scale_valid)
+            else:
+                extra = _cache_scale_kwargs(
+                    cache_scales if cache_scales is not None
+                    else self._cachekv_scales, li)
+            res = block_multihead_attention(
+                qkv, kc, vc, enc, dec, this, None, None, cu_q, cu_q,
+                bt, block_size=block_size, **extra)
+            if dynamic_cache_scales:
+                out, _, kc, vc, (kq, vq, kdq, vdq) = res
                 scales_out.append({"kq": kq, "vq": vq,
                                    "kdq": kdq, "vdq": vdq})
             else:
-                out, _, kc, vc = block_multihead_attention(
-                    qkv, kc, vc, enc, dec, this, None, None, cu_q, cu_q,
-                    bt, block_size=block_size,
-                    **_cache_scale_kwargs(self._cachekv_scales, li))
+                out, _, kc, vc = res
             hidden = hidden + blk.attn.resid_dropout(blk.attn.c_proj(out))
             hidden = hidden + blk.mlp(blk.ln_2(hidden))
             layers_state.append((kc, vc))

@@ -886,7 +886,8 @@ class LlamaForCausalLM(Layer):
 
     def paged_prefill_into(self, input_ids, layers, block_tables,
                            block_size=64, dec_base=None, logits_at=None,
-                           dynamic_cache_scales=False):
+                           dynamic_cache_scales=False, cache_scales=None,
+                           dynamic_scale_valid=None):
         """Prompt pass writing post-RoPE K / raw V into a CALLER-OWNED page
         pool (block_gqa_attention in encoder mode). input_ids [B, s];
         block_tables [B, blocks_per_seq]. Returns (last_logits [B, V],
@@ -900,12 +901,20 @@ class LlamaForCausalLM(Layer):
         must be int8, each layer's op computes per-(sequence, head)
         scales from the prompt, and the return gains a third element:
         a per-layer list of scale dicts for paged_decode_step's
-        state["cache_scales"].
+        state["cache_scales"]. dynamic_scale_valid [B] masks a chunked
+        pad tail out of the scale statistics; cache_scales (per-layer
+        dicts a first chunk returned) makes LATER chunks quantize with
+        those same scales — the chunked x dynamic-int8 composition
+        (reference: block_multihead_attention.py takes quant scales and
+        chunked input in one op).
         """
         import paddle_tpu as paddle
-        from ..incubate.nn.functional.decode_attention import \
-            block_gqa_attention
+        from ..incubate.nn.functional.decode_attention import (
+            block_gqa_attention, cachekv_scale_kwargs as _scale_kwargs)
 
+        if dynamic_cache_scales and cache_scales is not None:
+            raise ValueError("dynamic_cache_scales computes scales; "
+                             "cache_scales consumes them — pass one")
         self._check_paged_servable()
         cfg = self.config
         b, s = input_ids.shape
@@ -932,19 +941,23 @@ class LlamaForCausalLM(Layer):
             k = attn.k_proj(x).reshape([b * s, kvh, d])
             v = attn.v_proj(x).reshape([b * s, kvh, d])
             if dynamic_cache_scales:
-                out, kc, vc, (kq, vq, kdq, vdq) = block_gqa_attention(
-                    q, k, v, kc, vc, enc, dec, this, cu_q, block_tables,
-                    block_size=block_size, rope_cos=Tensor(cos_tab),
-                    rope_sin=Tensor(sin_tab),
-                    use_dynamic_cachekv_quant=True)
+                extra = dict(use_dynamic_cachekv_quant=True,
+                             compute_dynamic_scales=True,
+                             dynamic_scale_valid=dynamic_scale_valid)
+            else:
+                extra = _scale_kwargs(
+                    cache_scales if cache_scales is not None
+                    else self._cachekv_scales, li)
+            res = block_gqa_attention(
+                q, k, v, kc, vc, enc, dec, this, cu_q, block_tables,
+                block_size=block_size, rope_cos=Tensor(cos_tab),
+                rope_sin=Tensor(sin_tab), **extra)
+            if dynamic_cache_scales:
+                out, kc, vc, (kq, vq, kdq, vdq) = res
                 scales_out.append({"kq": kq, "vq": vq,
                                    "kdq": kdq, "vdq": vdq})
             else:
-                out, kc, vc = block_gqa_attention(
-                    q, k, v, kc, vc, enc, dec, this, cu_q, block_tables,
-                    block_size=block_size, rope_cos=Tensor(cos_tab),
-                    rope_sin=Tensor(sin_tab),
-                    **self._layer_cache_scales(li))
+                out, kc, vc = res
             hidden = hidden + attn.o_proj(out.reshape([b, s, h * d]))
             hidden = hidden + layer.mlp(
                 layer.post_attention_layernorm(hidden))

@@ -306,7 +306,7 @@ def test_cachekv_dynamic_quant_gqa():
     vc8 = paddle.zeros([n_blocks, kvh, bs, d], dtype="int8")
     q_out, kc8, vc8, scales = block_gqa_attention(
         q, k, v, kc8, vc8, enc, dec0, enc, cu, bt, block_size=bs,
-        use_dynamic_cachekv_quant=True)
+        use_dynamic_cachekv_quant=True, compute_dynamic_scales=True)
     kq, vq, kdq, vdq = scales
     assert list(kq.shape) == [b, kvh]
     rel = (np.abs(q_out.numpy() - fp_out.numpy()).max()
@@ -338,7 +338,8 @@ def test_cachekv_dynamic_quant_mha_prefill_returns_scales():
     vc8 = paddle.zeros([n_blocks, h, bs, d], dtype="int8")
     out = block_multihead_attention(
         qkv, kc8, vc8, enc, dec, enc, None, None, cu, cu, bt,
-        block_size=bs, use_dynamic_cachekv_quant=True)
+        block_size=bs, use_dynamic_cachekv_quant=True,
+        compute_dynamic_scales=True)
     assert len(out) == 5
     kq, vq, kdq, vdq = out[4]
     assert list(kq.shape) == [b, h]
@@ -347,8 +348,11 @@ def test_cachekv_dynamic_quant_mha_prefill_returns_scales():
 
 
 def test_cachekv_dynamic_decode_without_scales_raises():
-    """A decode-shaped dynamic call that forgot the prefill's scales must
-    error loudly, not silently re-derive scales from one token."""
+    """A dynamic call that forgot the prefill's scales must error loudly
+    — EVEN under jit tracing (ADVICE r3: scale computation is an explicit
+    compute_dynamic_scales opt-in, not inferred from scale absence), and
+    a decode-shaped call that wrongly opts in is caught by the
+    concrete-length guard."""
     from paddle_tpu.incubate.nn.functional.decode_attention import \
         block_gqa_attention
     rng = np.random.RandomState(9)
@@ -363,9 +367,25 @@ def test_cachekv_dynamic_decode_without_scales_raises():
     cu = paddle.to_tensor(np.arange(b + 1, dtype=np.int32))
     kc8 = paddle.zeros([b * bps, kvh, bs, d], dtype="int8")
     vc8 = paddle.zeros([b * bps, kvh, bs, d], dtype="int8")
-    with pytest.raises(ValueError, match="decode-mode"):
+    # no scales, no opt-in: static python error (survives tracing)
+    with pytest.raises(ValueError, match="compute_dynamic_scales"):
         block_gqa_attention(q, k, v, kc8, vc8, zero, dec, one, cu, bt,
                             block_size=bs, use_dynamic_cachekv_quant=True)
+    # decode-shaped call that wrongly opts in: concrete-length guard
+    with pytest.raises(ValueError, match="decode-mode"):
+        block_gqa_attention(q, k, v, kc8, vc8, zero, dec, one, cu, bt,
+                            block_size=bs, use_dynamic_cachekv_quant=True,
+                            compute_dynamic_scales=True)
+    # opt-in together with given scales: ambiguous, rejected
+    ones = paddle.to_tensor(np.ones((b, kvh), np.float32))
+    with pytest.raises(ValueError, match="ambiguous"):
+        block_gqa_attention(q, k, v, kc8, vc8, zero, dec, one, cu, bt,
+                            block_size=bs, use_dynamic_cachekv_quant=True,
+                            compute_dynamic_scales=True,
+                            cache_k_quant_scales=ones,
+                            cache_v_quant_scales=ones,
+                            cache_k_dequant_scales=ones,
+                            cache_v_dequant_scales=ones)
 
 
 def test_dynamic_int8_batcher_end_to_end():
@@ -400,12 +420,118 @@ def test_dynamic_int8_batcher_end_to_end():
                                           np.ones_like(layer[k]))
 
 
-def test_dynamic_int8_rejects_chunked_prefill():
+def test_dynamic_int8_chunked_short_prompts_match_unchunked():
+    """VERDICT r3 #5: dynamic cachekv-int8 composes with chunked prefill.
+    For prompts no longer than the chunk width, chunk 1 IS the whole
+    prompt (pad tail masked out of the scale stats), so the chunked
+    batcher must be TOKEN-EXACT against the unchunked dynamic batcher."""
     m = _llama_eval()
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(0, 128, (s,)) for s in (5, 8, 3, 7)]
+
+    def run(chunk):
+        paddle.seed(0)
+        b = PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                                   cache_quant="dynamic_int8",
+                                   prefill_chunk=chunk, compile=True)
+        rids = [b.submit(p, 6) for p in prompts]
+        outs = b.run_until_done()
+        return [outs[r] for r in rids], b
+
+    chunked, cb = run(8)
+    unchunked, _ = run(None)
+    for c, u in zip(chunked, unchunked):
+        np.testing.assert_array_equal(c, u)
+    # pool + scale rows fully reclaimed after the chunked run
+    assert cb.free_page_count == cb.n_pages
+    for layer in cb._scales_np:
+        for k in layer:
+            np.testing.assert_array_equal(layer[k], np.ones_like(layer[k]))
+
+
+def test_dynamic_int8_chunked_long_prompts_scale_consistent():
+    """Prompts LONGER than the chunk width: scales come from the first
+    chunk's rows and every later chunk + decode quantizes with them.
+    Pin the batcher against a manual model-level chunk loop implementing
+    the same contract (first chunk computes, rest consume), and sanity-
+    check agreement with the fp solo path."""
+    m = _llama_eval()
+    rng = np.random.RandomState(13)
+    C, bs = 8, 8
+    prompt = rng.randint(0, 128, (19,))
+    new = 5
+
+    # -- manual reference: chunked prefill + greedy paged decode ---------
+    bps = 32 // bs
+    bt = paddle.to_tensor(np.arange(bps, dtype=np.int32).reshape(1, bps))
+    pool = m.paged_alloc(bps + 1, bs, cache_dtype="int8")
+    L = len(prompt)
+    padded_len = -(-L // C) * C
+    padded = np.zeros((padded_len,), np.int64)
+    padded[:L] = prompt
+    scales = None
+    logits = None
+    with paddle.no_grad():
+        dec = 0
+        while dec < padded_len:
+            w = min(C, padded_len - dec)
+            has_last = 0 <= (L - 1) - dec < w
+            at = (L - 1) - dec if has_last else 0
+            ids_t = paddle.to_tensor(padded[None, dec:dec + w])
+            dec_t = paddle.to_tensor(np.array([dec], np.int32))
+            at_t = paddle.to_tensor(np.array([at], np.int32))
+            if scales is None:
+                lg, pool, scales = m.paged_prefill_into(
+                    ids_t, pool, bt, bs, dec_base=dec_t, logits_at=at_t,
+                    dynamic_cache_scales=True,
+                    dynamic_scale_valid=paddle.to_tensor(
+                        np.array([min(L - dec, w)], np.int32)))
+            else:
+                lg, pool = m.paged_prefill_into(
+                    ids_t, pool, bt, bs, dec_base=dec_t, logits_at=at_t,
+                    cache_scales=scales)
+            if has_last:
+                logits = lg
+            dec += w
+        toks = [int(np.argmax(logits.numpy()[0]))]
+        state = {"layers": pool, "block_tables": bt,
+                 "dec_lens": paddle.to_tensor(np.array([L], np.int32)),
+                 "block_size": bs, "capacity": bps * bs,
+                 "zeros_b": paddle.to_tensor(np.zeros((1,), np.int32)),
+                 "ones_b": paddle.to_tensor(np.ones((1,), np.int32)),
+                 "cu_b": paddle.to_tensor(np.arange(2, dtype=np.int32)),
+                 "cache_scales": scales}
+        for _ in range(new - 1):
+            lg, state = m.paged_decode_step(
+                paddle.to_tensor(np.array([toks[-1]], np.int64)), state)
+            toks.append(int(np.argmax(lg.numpy()[0])))
+    expected = np.concatenate([prompt, np.asarray(toks)])
+
+    b = PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=bs,
                                cache_quant="dynamic_int8",
-                               prefill_chunk=8, compile=False)
+                               prefill_chunk=C, compile=True)
+    rid = b.submit(prompt, new)
+    outs = b.run_until_done()
+    np.testing.assert_array_equal(outs[rid], expected)
+
+    # quant noise must not derail generation vs the fp model
+    ids = paddle.to_tensor(np.asarray(prompt, np.int64)[None])
+    with paddle.no_grad():
+        ref = m.generate(ids, max_new_tokens=new).numpy()[0]
+    agree = (outs[rid][L:] == ref[L:]).mean()
+    assert agree >= 0.6, (outs[rid][L:], ref[L:])
+
+
+def test_dynamic_int8_rejects_bad_configs():
+    m = _llama_eval()
     with pytest.raises(ValueError, match="unknown cache_quant"):
         PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
                                cache_quant="int4", compile=False)
+    with pytest.raises(ValueError, match="not supported"):
+        PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               cache_quant="dynamic_int8", prefill_chunk=8,
+                               fused_admission=True, compile=False)
+    with pytest.raises(ValueError, match="prefill_chunk >= 2"):
+        PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                               cache_quant="dynamic_int8", prefill_chunk=1,
+                               compile=False)
