@@ -46,8 +46,22 @@ def _annotate(t: Tensor, shard_dim: Optional[int], mesh=None) -> Tensor:
     return shard_tensor(t, mesh, _mp_placements(mesh, shard_dim))
 
 
+def _pad_to_multiple(n: int, ws: int) -> int:
+    """Megatron-style padded size: jax shardings need every sharded dim
+    divisible by its mesh axis, so an uneven partition (e.g. vocab 130
+    over mp=4) pads the PARAMETER to the next multiple — the reference
+    instead computes a ragged last shard explicitly
+    (fleet/layers/mpu/mp_layers.py:46); padding is the established
+    Megatron-LM practice and what a static SPMD partitioner wants."""
+    return -(-n // max(ws, 1)) * max(ws, 1)
+
+
 class VocabParallelEmbedding(Layer):
-    """Embedding with the vocab dim sharded over mp (mp_layers.py:46)."""
+    """Embedding with the vocab dim sharded over mp (mp_layers.py:46).
+
+    A vocab not divisible by mp is padded to the next multiple (the
+    weight holds unused tail rows; lookups never reach them since ids
+    are < num_embeddings)."""
 
     def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
                  mp_group=None, name=None):
@@ -56,8 +70,9 @@ class VocabParallelEmbedding(Layer):
         self.embedding_dim = embedding_dim
         hcg = get_hybrid_communicate_group()
         self.world_size = hcg.get_model_parallel_world_size() if hcg else 1
+        rows = _pad_to_multiple(num_embeddings, self.world_size)
         self.weight = self.create_parameter(
-            [num_embeddings, embedding_dim], attr=weight_attr,
+            [rows, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 0.02))
         _annotate(self.weight, 0)
 
@@ -80,12 +95,22 @@ class ColumnParallelLinear(Layer):
         self.gather_output = gather_output
         hcg = get_hybrid_communicate_group()
         self.world_size = hcg.get_model_parallel_world_size() if hcg else 1
+        cols = _pad_to_multiple(out_features, self.world_size)
+        if cols != out_features and not gather_output:
+            # sharded-output mode hands downstream layers the raw shard;
+            # a padded tail inside it would silently corrupt their math
+            raise ValueError(
+                f"out_features={out_features} is not divisible by the mp "
+                f"degree {self.world_size}; uneven column parallelism "
+                f"needs gather_output=True (the padded tail is sliced "
+                f"off after the gather)")
+        self._padded_out = cols
         self.weight = self.create_parameter(
-            [in_features, out_features], attr=weight_attr,
+            [in_features, cols], attr=weight_attr,
             default_initializer=I.XavierNormal())
         _annotate(self.weight, 1)
         if has_bias:
-            self.bias = self.create_parameter([out_features], attr=None,
+            self.bias = self.create_parameter([cols], attr=None,
                                               is_bias=True)
             _annotate(self.bias, 0)
         else:
@@ -96,6 +121,8 @@ class ColumnParallelLinear(Layer):
         if not self.gather_output:
             # keep the feature dim sharded over mp
             out = _annotate(out, out.ndim - 1)
+        elif self._padded_out != self.out_features:
+            out = out[..., :self.out_features]
         return out
 
 
@@ -112,8 +139,10 @@ class RowParallelLinear(Layer):
         self.input_is_parallel = input_is_parallel
         hcg = get_hybrid_communicate_group()
         self.world_size = hcg.get_model_parallel_world_size() if hcg else 1
+        rows = _pad_to_multiple(in_features, self.world_size)
+        self._padded_in = rows
         self.weight = self.create_parameter(
-            [in_features, out_features], attr=weight_attr,
+            [rows, out_features], attr=weight_attr,
             default_initializer=I.XavierNormal())
         _annotate(self.weight, 0)
         if has_bias:
@@ -123,6 +152,14 @@ class RowParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        if self._padded_in != self.in_features:
+            # zero-pad the contraction dim: pad rows of the weight are
+            # multiplied by zeros, so the product is exact
+            import paddle_tpu as paddle
+            pad = paddle.zeros(list(x.shape[:-1])
+                               + [self._padded_in - self.in_features],
+                               dtype=x.dtype)
+            x = paddle.concat([x, pad], axis=-1)
         if self.input_is_parallel:
             x = _annotate(x, x.ndim - 1)
         out = F.linear(x, self.weight, self.bias)

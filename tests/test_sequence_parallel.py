@@ -453,3 +453,24 @@ def test_scanned_llama_selective_recompute_matches_full():
     ids = paddle.to_tensor(np.arange(16).reshape(1, 16) % 64)
     with pytest.raises(ValueError, match="recompute_granularity"):
         m(ids, labels=ids)
+
+
+def test_ring_attention_sep4_mask_and_seqlens():
+    """EXPLICIT 4-way sep ring on a (dp, sep) grid (VERDICT r3 #7):
+    per-batch kv_seqlens + causality through a 4-hop K/V rotation match
+    the dense reference on every valid row."""
+    rng = np.random.RandomState(21)
+    b, s, h, d = 2, 24, 2, 8
+    q = rng.randn(b, s, h, d).astype("float32")
+    k = rng.randn(b, s, h, d).astype("float32")
+    v = rng.randn(b, s, h, d).astype("float32")
+    lens = np.array([20, 24], np.int64)
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "sep"])
+    out = ring_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                         paddle.to_tensor(v), mesh=mesh, axis_name="sep",
+                         causal=True,
+                         kv_seqlens=paddle.to_tensor(lens)).numpy()
+    ref = _dense_masked(q, k, v, True, seqlens=lens)
+    for i, L in enumerate(lens):
+        np.testing.assert_allclose(out[i, :L], ref[i, :L],
+                                   rtol=2e-4, atol=2e-5)
