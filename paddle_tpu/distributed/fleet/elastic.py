@@ -144,20 +144,33 @@ def launch_elastic(ctx, manager: Optional[ElasticManager] = None):
     restarts = 0
     try:
         while True:
-            controller = CollectiveController(run_ctx).build_pod()
+            controller = CollectiveController(run_ctx)
+            # pod incarnation: restarted ranks must not read the previous
+            # attempt's control-plane records (watchdog progress keys)
+            controller.attempt = restarts
+            controller.build_pod()
             code = controller.run()
             if code == 0:
                 return 0
-            elastic_exit = (code == ELASTIC_EXIT_CODE or manager.need_scale())
-            if not elastic_exit or restarts >= ctx.max_restarts:
+            if restarts >= ctx.max_restarts:
                 return code
             restarts += 1
+            elastic_exit = (code == ELASTIC_EXIT_CODE or manager.need_scale())
+            from ..launch.controllers import announce_restart
+            announce_restart(restarts, ctx.max_restarts, code,
+                             elastic=elastic_exit)
+            if not elastic_exit:
+                # FAULT level (reference launch/controllers/collective.py
+                # :272): a dead/hung trainer redeploys at the same
+                # membership immediately
+                continue
+            # ELASTIC level: wait for membership, re-form at the surviving
+            # world size — compact ranks and update the envs the next pod
+            # will receive
             manager.wait_for_np(manager.min_np)
             alive = manager.alive_nodes()
             if manager.rank not in alive:
                 alive = sorted(alive + [manager.rank])
-            # re-form at the surviving world size: compact ranks and update
-            # the envs the next pod will receive
             manager.np = len(alive)
             run_ctx.nnodes = len(alive)
             run_ctx.node_rank = alive.index(manager.rank)

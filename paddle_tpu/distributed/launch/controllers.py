@@ -157,6 +157,15 @@ class Watcher:
         return dead
 
 
+def announce_restart(restarts: int, budget: int, code: int,
+                     elastic: bool = False) -> None:
+    """One format for the fault/elastic restart notice (both restart
+    loops emit it; logs and tests grep for it)."""
+    sys.stderr.write(
+        f"restarting pod (attempt {restarts}/{budget}) after exit {code}"
+        f"{' [elastic re-form]' if elastic else ''}\n")
+
+
 class CollectiveController:
     """launch/controllers/collective.py analog: build the pod, deploy,
     watch, restart up to max_restarts (the reference's replicas/elastic
@@ -165,6 +174,7 @@ class CollectiveController:
     def __init__(self, ctx):
         self.ctx = ctx
         self.pod = Pod()
+        self.attempt = 0
 
     def build_pod(self):
         ctx = self.ctx
@@ -178,6 +188,10 @@ class CollectiveController:
                 "PADDLE_RANK_IN_NODE": str(local_rank),
                 "PADDLE_MASTER": ctx.master or "",
                 "PADDLE_JOB_ID": ctx.job_id,
+                # pod incarnation: restarted ranks must not read a
+                # previous attempt's control-plane records (e.g. the
+                # collective watchdog's progress keys) as live peers
+                "PADDLE_RESTART_ATTEMPT": str(self.attempt),
                 # jax multi-host coordination (the TCPStore analog)
                 "JAX_COORDINATOR_ADDRESS": ctx.coordinator or "",
                 "JAX_PROCESS_ID": str(rank),
@@ -188,7 +202,11 @@ class CollectiveController:
                 else None
             self.pod.add_container(Container(
                 entrypoint=[sys.executable] + ctx.training_script_args,
-                env=env, log_path=log_path, rank=rank))
+                env=env, log_path=log_path, rank=rank,
+                # restart attempts APPEND so the failed attempt's evidence
+                # (e.g. the watchdog's dead-peer report) survives into the
+                # final logs; a fresh launch truncates stale files
+                log_mode="w" if self.attempt == 0 else "a"))
         return self
 
     def _collate_logs(self):
@@ -227,7 +245,7 @@ class CollectiveController:
                             f"---- rank {c.rank} (exit {c.exit_code}) "
                             f"last log ----\n{c.logs()}\n")
                 return code
-            sys.stderr.write(f"restarting pod (attempt {restarts}/"
-                             f"{ctx.max_restarts}) after exit {code}\n")
+            announce_restart(restarts, ctx.max_restarts, code)
             self.pod = Pod()
+            self.attempt = restarts
             self.build_pod()

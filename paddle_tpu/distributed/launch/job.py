@@ -17,11 +17,13 @@ class Container:
     """launch/job/container.py analog: one process + env + log file."""
 
     def __init__(self, entrypoint: List[str], env: Dict[str, str],
-                 log_path: Optional[str] = None, rank: int = -1):
+                 log_path: Optional[str] = None, rank: int = -1,
+                 log_mode: str = "w"):
         self.entrypoint = entrypoint
         self.env = env
         self.log_path = log_path
         self.rank = rank
+        self.log_mode = log_mode
         self.proc: Optional[subprocess.Popen] = None
         self._log_file = None
 
@@ -33,7 +35,7 @@ class Container:
             log_dir = os.path.dirname(self.log_path)
             if log_dir:
                 os.makedirs(log_dir, exist_ok=True)
-            self._log_file = open(self.log_path, "w")
+            self._log_file = open(self.log_path, self.log_mode)
             out = self._log_file
         self.proc = subprocess.Popen(self.entrypoint, env=full_env,
                                      stdout=out, stderr=subprocess.STDOUT)

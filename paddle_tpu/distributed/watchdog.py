@@ -60,10 +60,17 @@ class CollectiveWatchdog:
     def __init__(self, store, rank: int, world_size: int,
                  timeout: float = 120.0, poll: Optional[float] = None,
                  on_desync: Optional[Callable[[dict], None]] = None,
-                 prefix: str = "collective_wd"):
+                 prefix: str = "collective_wd", attempt: int = 0):
         self.store = store
         self.rank = rank
         self.world_size = world_size
+        # pod incarnation (PADDLE_RESTART_ATTEMPT): published in every
+        # record; peers whose records carry a DIFFERENT attempt are
+        # excluded from desync decisions — an elastic restart must not
+        # read the previous attempt's frozen seq as a live peer, and a
+        # node whose restart count skews must not see its peers as
+        # permanently missing (which a per-attempt key namespace would)
+        self.attempt = attempt
         self.timeout = timeout
         self.poll = poll if poll is not None else max(1.0, timeout / 4)
         self.prefix = prefix
@@ -84,7 +91,7 @@ class CollectiveWatchdog:
 
     def _publish(self, done: bool):
         rec = {"seq": self._seq, "op": self._cur[0], "spec": self._cur[1],
-               "ts": time.time(), "done": done}
+               "ts": time.time(), "done": done, "attempt": self.attempt}
         self.store.set(self._key(self.rank), json.dumps(rec))
 
     def enter(self, op: str, spec: str = ""):
@@ -136,8 +143,12 @@ class CollectiveWatchdog:
             p = self._peer(r)
             if p is None:
                 missing.append(r)
-            else:
+            elif p.get("attempt", 0) == self.attempt:
                 peers[r] = p
+            # records from another pod incarnation are benign: a lower
+            # attempt means the peer has not finished restarting yet, a
+            # higher one means WE are the stale rank about to be
+            # replaced — neither is a same-program desync
         report = None
         if cur[0] not in self._ASYMMETRIC:
             for r, p in peers.items():
@@ -208,6 +219,8 @@ def enable_collective_watchdog(timeout: float = 120.0,
                                on_desync=None) -> Optional[CollectiveWatchdog]:
     """Arm the watchdog over the job's bootstrap store (multi-process
     worlds only; returns None — with a note — in single-controller runs)."""
+    import os
+
     import jax
 
     from .collective import get_bootstrap_store
@@ -215,8 +228,14 @@ def enable_collective_watchdog(timeout: float = 120.0,
     if store is None or jax.process_count() <= 1:
         return None
     disable_collective_watchdog()  # re-arming must not leak a poller
+    # pod incarnation: after an elastic pod restart the control-plane
+    # store still holds the previous attempt's progress records; a
+    # freshly restarted rank reading them would flag its (still booting)
+    # peers as frozen at the old attempt's seq and abort the new pod
+    attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0") or 0)
     wd = CollectiveWatchdog(store, jax.process_index(), jax.process_count(),
-                            timeout=timeout, poll=poll, on_desync=on_desync)
+                            timeout=timeout, poll=poll, on_desync=on_desync,
+                            attempt=attempt)
     wd.start()
     _ACTIVE[0] = wd
     return wd

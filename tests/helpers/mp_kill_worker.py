@@ -1,0 +1,73 @@
+"""Kill-a-rank E2E worker (VERDICT r3 #8).
+
+Launched by paddle_tpu.distributed.launch (2 ranks, CPU) with a marker
+directory as argv[1]. First pod attempt: rank 1 stops participating
+mid-training (writes the marker, then hangs — the canonical dead/stuck
+peer, invisible to process-exit watching alone); rank 0 blocks in the
+next all_reduce, its collective watchdog flags the frozen peer within
+its timeout and ABORTS the process, which the launch controller's watch
+loop sees as a pod failure and restarts. Second attempt (marker
+present): every rank trains to completion.
+
+Reference seam: comm_task_manager.cc's watchdog paired with
+launch/controllers/collective.py:272's restart-on-failure watch loop.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    marker_dir = sys.argv[1]
+    os.makedirs(marker_dir, exist_ok=True)
+    marker = os.path.join(marker_dir, "rank1_died_once")
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    n = dist.get_world_size()
+    assert jax.process_count() == n
+
+    def abort_on_desync(report):
+        kind = report.get("kind")
+        print(f"MPKILL_WATCHDOG rank={rank} {report}", flush=True)
+        # abort ONLY on a definitively dead/frozen peer: strictly behind
+        # my seq, or missing from the store. A same-seq done=True peer
+        # (classified 'behind' by the scanner) is just a transient
+        # straggler window on a loaded box — aborting there would burn
+        # the restart budget on a healthy world.
+        frozen = [r for r, s in report.get("peers_behind", {}).items()
+                  if s < report["seq"]] + report.get("peers_missing", [])
+        if kind != "stuck" or not frozen:
+            return
+        # surface the hang as a process failure the launcher's watch
+        # loop can act on (the rank itself is stuck inside the gloo
+        # collective and can never raise from python)
+        os._exit(3)
+
+    wd = dist.enable_collective_watchdog(timeout=4.0, poll=0.5,
+                                         on_desync=abort_on_desync)
+    assert wd is not None
+
+    for step in range(5):
+        if rank == 1 and step == 3 and not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("1")
+            print(f"MPKILL_DYING rank={rank} step={step}", flush=True)
+            sys.stdout.flush()
+            time.sleep(120)  # a hung rank, not a clean exit; the pod
+            os._exit(9)      # teardown SIGTERMs this sleep
+        t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+        dist.all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), np.full((4,), n * (n + 1) / 2))
+
+    print(f"MPKILL_OK rank={rank}/{n}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,12 +32,12 @@ def _launch_env():
     return env
 
 
-def _run_launch(tmp_path, script, *args):
+def _run_launch(tmp_path, script, *args, launch_args=()):
     """Launch `script` across 2 ranks; return (proc, merged worker logs)."""
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
-         script, *args],
+         *launch_args, script, *args],
         capture_output=True, text=True, timeout=300, cwd=REPO,
         env=_launch_env())
     logs = ""
@@ -179,3 +179,29 @@ def test_two_node_launch(tmp_path):
         f"out0:{outs[0][-1500:]}\nout1:{outs[1][-1500:]}\nlogs:{logs[-4000:]}")
     for r in range(2):
         assert f"MPWORKER_OK rank={r}/2" in logs, logs[-4000:]
+
+
+KILL_WORKER = os.path.join(REPO, "tests", "helpers", "mp_kill_worker.py")
+
+
+def test_kill_a_rank_watchdog_detects_and_elastic_restarts(tmp_path):
+    """VERDICT r3 #8: rank 1 goes dead mid-step (hangs — no clean exit);
+    rank 0's collective watchdog flags the frozen peer and aborts; the
+    launch controller's watch loop restarts the pod; the restarted world
+    completes training. Reference: comm_task_manager.cc +
+    launch/controllers/collective.py:272."""
+    marker_dir = str(tmp_path / "markers")
+    proc, logs = _run_launch(tmp_path, KILL_WORKER, marker_dir,
+                             launch_args=("--max_restarts", "2"))
+    assert proc.returncode == 0, (
+        f"launch failed rc={proc.returncode}\nstdout:{proc.stdout[-2000:]}\n"
+        f"stderr:{proc.stderr[-2000:]}\nlogs:{logs[-4000:]}")
+    # attempt 1: rank 1 died, rank 0's watchdog named the frozen peer
+    assert "MPKILL_DYING rank=1" in logs, logs[-4000:]
+    assert "MPKILL_WATCHDOG rank=0" in logs, logs[-4000:]
+    assert "'kind': 'stuck'" in logs, logs[-4000:]
+    # the controller restarted rather than giving up
+    assert "restarting pod (attempt 1" in proc.stderr, proc.stderr[-2000:]
+    # attempt 2: the restarted world trained to completion on every rank
+    for r in range(2):
+        assert f"MPKILL_OK rank={r}/2" in logs, logs[-4000:]
