@@ -137,6 +137,7 @@ class CollectiveWatchdog:
             return None
         peers: Dict[int, dict] = {}
         missing: List[int] = []
+        stale: List[int] = []
         for r in range(self.world_size):
             if r == self.rank:
                 continue
@@ -145,10 +146,14 @@ class CollectiveWatchdog:
                 missing.append(r)
             elif p.get("attempt", 0) == self.attempt:
                 peers[r] = p
-            # records from another pod incarnation are benign: a lower
-            # attempt means the peer has not finished restarting yet, a
-            # higher one means WE are the stale rank about to be
-            # replaced — neither is a same-program desync
+            else:
+                # records from another pod incarnation are benign WHILE
+                # the peer could still be restarting (a lower attempt
+                # means it has not republished yet; a higher one means WE
+                # are the stale rank about to be replaced) — but a peer
+                # that never republishes is dead, so past a generous
+                # grace window it escalates like a missing rank
+                stale.append(r)
         report = None
         if cur[0] not in self._ASYMMETRIC:
             for r, p in peers.items():
@@ -169,6 +174,11 @@ class CollectiveWatchdog:
                                             and p.get("done"))}
             base = {"rank": self.rank, "seq": seq, "op": cur[0],
                     "spec": cur[1], "stuck_for_s": round(stuck_for, 1)}
+            if stale and stuck_for > 3 * self.timeout:
+                # restart-boot grace expired: an other-attempt record
+                # that never refreshed is a dead rank, not a slow boot
+                missing = missing + stale
+                base["peers_stale_attempt"] = stale
             if ahead or behind or missing:
                 # a dead rank freezes at an older seq (behind) or loses
                 # its store record (missing) — the canonical hang

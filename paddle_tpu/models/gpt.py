@@ -330,6 +330,13 @@ class GPT2ForCausalLM(Layer):
             dec = dec_base
             pos_row = dec_base.reshape([b, 1]) + paddle.to_tensor(
                 np.arange(s, dtype=np.int32)).reshape([1, s])
+            # chunked pad rows can run past the position table when slot
+            # capacity (blocks_per_seq*block_size) exceeds
+            # max_position_embeddings; clamp EXPLICITLY — pad rows are
+            # masked/overwritten before any bounded read, but the safety
+            # must not hang on jnp's silent gather clamping (ADVICE r3)
+            pos_row = paddle.clip(
+                pos_row, 0, self.config.max_position_embeddings - 1)
         cu_q = paddle.to_tensor(np.arange(b + 1, dtype=np.int32) * s)
 
         # packed-token forward: hidden is [T, E] (sequences concatenated)

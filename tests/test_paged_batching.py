@@ -40,13 +40,31 @@ def _ref(m, prompt, n):
         return m.generate(ids, max_new_tokens=n).numpy()[0]
 
 
+# session-wide retry accounting: one or two load flips across a whole
+# heavy parallel run are the documented CPU symptom; MORE than that in
+# one session is evidence of a real nondeterminism/scheduling bug that
+# retries must not paper over (ADVICE r3).
+_RETRY_BUDGET = [3]
+
+
 def _retry_load_flake(body, attempts=2):
     """Run an exact-token scenario up to `attempts` times (see the module
     docstring: heavy host load can flip argmax near-ties in the CPU
-    backend's threaded matmuls). A LOGIC regression fails every attempt
-    and still fails the test; a load flip passes the retry — but LOUDLY,
-    so flake frequency stays observable in the -W output."""
+    backend's threaded matmuls — a CPU-ONLY symptom). A LOGIC regression
+    fails every attempt and still fails the test; a load flip passes the
+    retry — but LOUDLY, debited from a small per-session budget.
+
+    Gating (VERDICT r3 #9): on TPU the same scenarios must be exact on
+    the first try, so the helper never retries there; setting
+    PADDLE_EXACT_STRICT=1 disables retries everywhere (CI strict mode).
+    """
+    import os
     import warnings
+
+    import jax
+    if (os.environ.get("PADDLE_EXACT_STRICT") == "1"
+            or jax.devices()[0].platform == "tpu"):
+        attempts = 1
     for i in range(attempts):
         try:
             body()
@@ -54,13 +72,18 @@ def _retry_load_flake(body, attempts=2):
         except AssertionError as e:
             if i + 1 == attempts:
                 raise
+            if _RETRY_BUDGET[0] <= 0:
+                raise AssertionError(
+                    "exact-token retry budget exhausted this session — "
+                    "this is no longer the rare CPU load flake; "
+                    "investigate as a real bug") from e
+            _RETRY_BUDGET[0] -= 1
             warnings.warn(
                 f"exact-token attempt {i + 1} failed and was retried "
-                f"(documented CPU load flake — investigate if frequent): "
-                f"{str(e)[:300]}")
+                f"(documented CPU load flake; {_RETRY_BUDGET[0]} session "
+                f"retries left): {str(e)[:300]}")
 
 
-@pytest.mark.smoke
 def test_paged_batch_matches_solo_generate():
     m = _model()
     rng = np.random.RandomState(0)

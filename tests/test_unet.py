@@ -16,7 +16,6 @@ def _model(**over):
     return UNetModel(unet_tiny_config(**over))
 
 
-@pytest.mark.smoke
 def test_forward_shapes_and_time_conditioning():
     m = _model()
     m.eval()
@@ -60,6 +59,7 @@ def test_ddpm_training_reduces_loss():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.smoke  # the diffusion-family smoke representative (light)
 def test_ddim_sampler_shapes():
     m = _model()
     m.eval()

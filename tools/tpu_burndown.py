@@ -59,6 +59,8 @@ UNITS = [
     ("flash_shapes", "test_flash_mosaic_arbitrary_and_short_seq",
      "safe", 480),
     ("serving_fused", "test_fused_serving_on_tpu", "safe", 600),
+    ("serving_exact_no_retry", "test_paged_exactness_retry_free_on_tpu",
+     "safe", 600),
     ("profile_flagship", "test_flagship_attention_step_profile",
      "safe", 600),
     ("profile_pipeline", "test_pipeline_bubble_profiles", "safe", 480),
