@@ -53,6 +53,11 @@ echo "$(ts) burndown safe rc=$rc" >> "$LOG"
 # re-probe when the stage ended abnormally (e.g. outer-timeout kill)
 [ $rc -ne 0 ] && probe_or_stop "safe tier"
 
+# 2b) summarize any xplane captures the safe tier produced (pure file
+#     reads — cannot touch the relay); bubble ratios + top ops land in
+#     PROFILES_SUMMARY.json for the round report
+timeout 300 python tools/analyze_xplane.py >> "$LOG" 2>&1
+
 # 3) serving decode benchmark on the chip -> SERVING_TPU_SNAPSHOT.json
 #    (repo root on the path — ambient PYTHONPATH only carries axon)
 echo "$(ts) stage 3: bench_decode" >> "$LOG"

@@ -98,8 +98,21 @@ def main(scan_layers=True, size="large"):
                           scan_layers=scan_layers, use_recompute=True,
                           recompute_granularity="selective")
         batch, seq, iters = 4, 2048, 15
+    elif on_tpu and size == "medium":
+        # memory-safe middle tier (~0.35B, ≈9 GB est.): keeps flash +
+        # selective remat + seq 2048 — the MFU-carrying features — so an
+        # OOM on the large config still produces a flash-enabled number
+        # at the HBM-relevant sequence length
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1152,
+                          intermediate_size=3072, num_hidden_layers=16,
+                          num_attention_heads=9, num_key_value_heads=9,
+                          max_position_embeddings=2048,
+                          scan_layers=scan_layers, use_recompute=True,
+                          recompute_granularity="selective")
+        batch, seq, iters = 4, 2048, 15
     elif on_tpu:
-        # smaller fallback config (OOM / compile-budget self-heal)
+        # smallest fallback config (OOM / compile-budget self-heal); the
+        # round-3 snapshot config — known to run on the chip
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=24,
                           num_attention_heads=16, num_key_value_heads=16,
@@ -232,12 +245,13 @@ class _deadline:
 
 
 def _inproc():
-    """Child entry: self-heal chain large -> small -> unrolled -> no-Pallas.
+    """Child entry: self-heal chain large -> medium -> small -> unrolled
+    -> no-Pallas.
 
-    The large tier only exists on TPU (the CPU proxy ignores `size`, so
-    retrying it off-TPU would just run the identical config twice). The
-    large attempt gets ~55% of the TPU budget; a timeout advances the
-    chain instead of eating the whole child deadline.
+    The large/medium tiers only exist on TPU (the CPU proxy ignores
+    `size`, so retrying them off-TPU would just run the identical config
+    twice). Large gets ~45% of the TPU budget and medium ~25%; a timeout
+    advances the chain instead of eating the whole child deadline.
     """
     import traceback
 
@@ -250,7 +264,8 @@ def _inproc():
 
     attempts = []
     if on_tpu:
-        attempts.append(("large", True, int(TPU_TIMEOUT * 0.55)))
+        attempts.append(("large", True, int(TPU_TIMEOUT * 0.45)))
+        attempts.append(("medium", True, int(TPU_TIMEOUT * 0.25)))
     attempts += [("small", True, 0), ("small", False, 0)]
     for size, scan, bound in attempts:
         try:

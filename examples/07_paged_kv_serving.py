@@ -76,6 +76,9 @@ def main():
             for n in (37, 100, 180, 64)]
 
     def run_batched():
+        # fresh counters per scenario run: the retry below reuses this
+        # batcher, and blended two-run stats would skew the JSON line
+        batcher.reset_stats()
         rids = [batcher.submit(p, 24) for p in reqs]
         outs = batcher.run_until_done()
         return [outs[r] for r in rids]

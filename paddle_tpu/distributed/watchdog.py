@@ -78,6 +78,7 @@ class CollectiveWatchdog:
         self._seq = 0
         self._inside = False
         self._enter_ts = 0.0
+        self._enter_ts0 = 0.0
         self._cur = ("", "")
         self._poison: Optional[dict] = None
         self._stop = threading.Event()
@@ -102,6 +103,7 @@ class CollectiveWatchdog:
             self._seq += 1
             self._cur = (op, spec)
             self._enter_ts = time.time()
+            self._enter_ts0 = self._enter_ts  # never re-armed (see SLOW)
             self._inside = True
             self._publish(done=False)
 
@@ -133,6 +135,7 @@ class CollectiveWatchdog:
             seq = self._seq
             cur = self._cur
             enter_ts = self._enter_ts
+            enter_ts0 = self._enter_ts0
         if not inside:
             return None
         peers: Dict[int, dict] = {}
@@ -174,9 +177,12 @@ class CollectiveWatchdog:
                                             and p.get("done"))}
             base = {"rank": self.rank, "seq": seq, "op": cur[0],
                     "spec": cur[1], "stuck_for_s": round(stuck_for, 1)}
-            if stale and stuck_for > 3 * self.timeout:
+            if stale and time.time() - enter_ts0 > 3 * self.timeout:
                 # restart-boot grace expired: an other-attempt record
-                # that never refreshed is a dead rank, not a slow boot
+                # that never refreshed is a dead rank, not a slow boot.
+                # Measured from the UN-re-armed enter time (enter_ts0) —
+                # the SLOW branch below re-arms enter_ts every ~timeout,
+                # which would otherwise keep this horizon unreachable.
                 missing = missing + stale
                 base["peers_stale_attempt"] = stale
             if ahead or behind or missing:

@@ -17,6 +17,7 @@ fusion kernels). This is a TPU-first redesign, not a port:
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -51,6 +52,10 @@ class LlamaConfig:
     # axis (sequence sharded; exact global attention via ICI ppermute)
     sep_mesh: Optional[object] = None
     sep_axis: str = "sep"
+    # sep_impl: "ring" (ppermute K/V rotation, any head count) or
+    # "ulysses" (all-to-all heads<->sequence — needs heads divisible by
+    # the sep axis; one dense full-seq contraction per head subset)
+    sep_impl: str = "ring"
     # activation recompute: re-run each decoder layer's forward in the
     # backward instead of keeping its residuals (fleet/recompute analog —
     # trades ~30% step FLOPs for O(layers) less activation HBM)
@@ -167,13 +172,20 @@ class LlamaAttention(Layer):
             # heads stay unexpanded — the ring ships h/kv less K/V traffic.
             # Masked/padded batches ride the ring too: the mask's query rows
             # are sequence-sharded, each step slices the block's columns.
-            from ..ops.ring_attention import ring_attention
             # an explicit mask is the COMPLETE attention spec (callers bake
             # causality into it), matching the dense path's is_causal rule
-            out = ring_attention(q, k, v, mesh=cfg.sep_mesh,
-                                 axis_name=cfg.sep_axis,
-                                 causal=attn_mask is None,
-                                 attn_mask=attn_mask)
+            if getattr(cfg, "sep_impl", "ring") == "ulysses":
+                from ..ops.ulysses_attention import ulysses_attention
+                out = ulysses_attention(q, k, v, mesh=cfg.sep_mesh,
+                                        axis_name=cfg.sep_axis,
+                                        causal=attn_mask is None,
+                                        attn_mask=attn_mask)
+            else:
+                from ..ops.ring_attention import ring_attention
+                out = ring_attention(q, k, v, mesh=cfg.sep_mesh,
+                                     axis_name=cfg.sep_axis,
+                                     causal=attn_mask is None,
+                                     attn_mask=attn_mask)
         else:
             from ..nn.functional import _pallas_attention_eligible
             mask_arr = None if attn_mask is None else attn_mask._data
@@ -481,10 +493,33 @@ class ScannedLlamaLayers(Layer):
             # matching the dense branch's `mask is None` causality rule.
             # Flags passed positionally to share lru_cache slots with the
             # public ring_attention() call sites.
-            ring_impl = _cached_impl(jmesh, cfg.sep_axis, attn_mask is None,
-                                     batch_axis, head_axis,
-                                     attn_mask is not None, False)
-        use_flash = (ring_impl is None and attn_mask is None and _pl.on_tpu()
+            if getattr(cfg, "sep_impl", "ring") == "ulysses":
+                # all-to-all CP (heads<->sequence): wins when heads are
+                # plentiful (h, kv divisible by the sep axis) and a
+                # P-step ring's per-hop latency would dominate
+                from ..ops.ulysses_attention import (
+                    _cached_impl as _ulysses_impl, validate_ulysses)
+                validate_ulysses(
+                    jmesh, cfg.sep_axis, h, kv, seq,
+                    attn_mask.shape[1] if attn_mask is not None else None)
+                ring_impl = _ulysses_impl(
+                    jmesh, cfg.sep_axis, attn_mask is None, batch_axis,
+                    attn_mask is not None,
+                    attn_mask is not None and attn_mask.shape[1] > 1,
+                    False)
+            else:
+                ring_impl = _cached_impl(jmesh, cfg.sep_axis,
+                                         attn_mask is None,
+                                         batch_axis, head_axis,
+                                         attn_mask is not None, False)
+        # PADDLE_TPU_FLASH_INTERPRET=1 routes the flash kernel interpreted
+        # on the CPU mesh — the only way to exercise the exact bench
+        # composition (flash x selective remat x scan) before a hardware
+        # window; production routing stays TPU-only
+        flash_interp = (os.environ.get("PADDLE_TPU_FLASH_INTERPRET") == "1"
+                        and not _pl.on_tpu())
+        use_flash = (ring_impl is None and attn_mask is None
+                     and (_pl.on_tpu() or flash_interp)
                      and get_flag("FLAGS_use_pallas_attention"))
         if use_flash:
             from ..ops.pallas.flash_attention import supported
@@ -567,7 +602,8 @@ class ScannedLlamaLayers(Layer):
                     # (the index map expands the group in-kernel)
                     from ..ops.pallas.flash_attention import \
                         flash_attention_pallas
-                    ctx = flash_attention_pallas(q, k, v, causal=True)
+                    ctx = flash_attention_pallas(q, k, v, causal=True,
+                                                 interpret=flash_interp)
                 else:
                     if kv != h:
                         rep = h // kv

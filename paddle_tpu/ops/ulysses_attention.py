@@ -1,0 +1,172 @@
+"""Ulysses (all-to-all head/sequence) context-parallel attention.
+
+The reference snapshot has NO ring/Ulysses context parallelism (SURVEY.md
+§2.8.8); ring attention (ops/ring_attention.py) fills that gap the
+streaming way. This is the COMPLEMENTARY strategy (DeepSpeed-Ulysses,
+arXiv:2309.14509): with the sequence sharded over a mesh axis of size P,
+one all-to-all re-shards heads<->sequence so each device computes FULL
+attention for h/P heads, then an inverse all-to-all restores the
+sequence sharding.
+
+Trade-off vs the ring: Ulysses moves activations twice over ICI
+(2 all-to-alls, O(b*s*h*d/P) bytes each) but runs each device's
+attention as ONE dense full-sequence contraction — no P-step pipeline,
+no per-step softmax rescaling — so it wins when heads are plentiful
+(h >= P) and the per-step latency of P ppermutes would dominate; the
+ring wins when h < P or when S^2/P^2 tiles must stay small. Both are
+exact; both are GQA-aware.
+
+Differentiable end-to-end: lax.all_to_all and the einsums have native
+transposes, so jax.vjp handles the backward (the all-to-alls transpose
+into all-to-alls).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from .registry import dispatch
+from .ring_attention import _axes_size, _pick_axis, _DP_NAMES
+
+_NEG = -1e30
+
+
+def _full_attention(q, k, v, causal, mask, seqlens, scale):
+    """Dense attention over the full sequence for a local head subset.
+    q: [b, s, hl, d]; k/v: [b, s, kvl, d]; mask: [b, 1|hl, s, s];
+    fp32 softmax accumulation (matches the ring's numerics)."""
+    b, s, hl, d = q.shape
+    kvl = k.shape[2]
+    rep = hl // kvl
+    qg = q.reshape(b, s, kvl, rep, d)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(jnp.float32)
+    scores = scores * scale
+    if mask is not None:
+        hm = mask.shape[1]
+        if hm == 1:
+            mb = mask[:, :, None]                       # [b, 1, 1, s, s]
+        else:
+            mb = mask.reshape(b, kvl, rep, s, s)
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mb, scores, _NEG)
+        else:
+            scores = scores + mb.astype(jnp.float32)
+    rows = jnp.arange(s)[:, None]
+    cols = jnp.arange(s)[None, :]
+    if causal:
+        scores = jnp.where(cols <= rows, scores, _NEG)
+    if seqlens is not None:
+        ok = ((cols < seqlens[:, None, None, None, None])
+              & (rows < seqlens[:, None, None, None, None]))
+        scores = jnp.where(ok, scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(q.dtype), v)
+    return out.reshape(b, s, hl, d)
+
+
+def validate_ulysses(jax_mesh, axis_name, h, kv, seq, mask_heads=None):
+    """Shape contract shared by the public wrapper and the in-model
+    (scanned Llama) call site — a violation must fail with THIS message,
+    not a shard_map shape error from deep inside a scan trace."""
+    P = jax_mesh.shape[axis_name]
+    if h % P or kv % P:
+        raise ValueError(
+            f"ulysses_attention needs heads divisible by the context axis: "
+            f"h={h}, kv={kv}, |{axis_name}|={P} (use ring_attention for "
+            f"h < P or ragged head counts)")
+    if seq % P:
+        raise ValueError(f"sequence {seq} not divisible by "
+                         f"|{axis_name}|={P}")
+    if mask_heads is not None and mask_heads > 1 and mask_heads % P:
+        raise ValueError(f"per-head mask ({mask_heads} heads) not "
+                         f"divisible by |{axis_name}|={P}")
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_impl(jax_mesh, axis_name, causal, batch_axis, has_mask,
+                 mask_headed, has_seqlens):
+    P = jax_mesh.shape[axis_name]
+    bspec = batch_axis if batch_axis is None else batch_axis[0] \
+        if len(batch_axis) == 1 else batch_axis
+
+    qkv_spec = PartitionSpec(bspec, axis_name, None, None)
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    if has_mask:
+        in_specs.append(PartitionSpec(
+            bspec, axis_name if mask_headed else None, None, None))
+    if has_seqlens:
+        in_specs.append(PartitionSpec(bspec))
+
+    def body(q, k, v, *extras):
+        mask = extras[0] if has_mask else None
+        seqlens = extras[-1] if has_seqlens else None
+        d = q.shape[-1]
+        scale = 1.0 / (d ** 0.5)
+        # heads -> devices, sequence -> full: [b, s/P, h, d] -> [b, s, h/P, d]
+        a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                                split_axis=2, concat_axis=1, tiled=True)
+        qf, kf, vf = a2a(q), a2a(k), a2a(v)
+        out = _full_attention(qf, kf, vf, causal, mask, seqlens, scale)
+        # inverse: sequence -> shards, heads -> full
+        return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def impl(q, k, v, *extras):
+        # version-bridging wrapper (jax.shard_map on >=0.8, experimental
+        # before) — one copy, owned by distributed.collective
+        from ..distributed.collective import shard_map
+        return shard_map(body, jax_mesh, tuple(in_specs), qkv_spec)(
+            q, k, v, *extras)
+
+    return impl
+
+
+def ulysses_attention(query, key, value, mesh=None, axis_name: str = "sep",
+                      causal: bool = True, batch_axis: Optional[str] = None,
+                      attn_mask=None, kv_seqlens=None):
+    """All-to-all context-parallel attention (see module docstring).
+
+    query: [b, s, h, d]; key/value: [b, s, kv, d]. Requires h % P == 0 and
+    kv % P == 0 for the head<->sequence exchange (P = size of
+    ``axis_name``); use ring_attention when heads are scarcer than the
+    context axis. attn_mask: [b, 1|h, s, s] bool keep / float additive;
+    kv_seqlens: [b] valid lengths. Returns [b, s, h, d] sequence-sharded
+    over ``axis_name`` — drop-in interchangeable with ring_attention.
+    """
+    from ..distributed.auto_parallel import ProcessMesh, get_default_mesh
+    if mesh is None:
+        from ..distributed.fleet.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        mesh = hcg.mesh if hcg is not None else get_default_mesh()
+    if mesh is None:
+        raise ValueError("ulysses_attention needs a mesh (or initialized "
+                         "fleet)")
+    jmesh = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else mesh
+    validate_ulysses(jmesh, axis_name, query.shape[2], key.shape[2],
+                     query.shape[1],
+                     attn_mask.shape[1] if attn_mask is not None else None)
+    if batch_axis is None:
+        batch_axis = _pick_axis(jmesh.axis_names, _DP_NAMES, axis_name)
+    if isinstance(batch_axis, str):
+        batch_axis = (batch_axis,)
+    if batch_axis is not None and \
+            query.shape[0] % _axes_size(jmesh, batch_axis):
+        batch_axis = None
+
+    mask_headed = attn_mask is not None and attn_mask.shape[1] > 1
+    impl = _cached_impl(jmesh, axis_name, bool(causal), batch_axis,
+                        attn_mask is not None, mask_headed,
+                        kv_seqlens is not None)
+    args = [query, key, value]
+    if attn_mask is not None:
+        args.append(attn_mask)
+    if kv_seqlens is not None:
+        args.append(kv_seqlens)
+    return dispatch(impl, tuple(args), {}, "ulysses_attention")
+
+
+__all__ = ["ulysses_attention", "validate_ulysses"]

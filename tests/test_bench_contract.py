@@ -82,3 +82,23 @@ def test_snapshot_loader_rejects_non_tpu_files(tmp_path, monkeypatch):
         {"value": 2.0, "detail": {"tpu": True}}))
     snap = bench._last_snapshot()
     assert snap is not None and snap["detail"]["captured_at"]
+
+
+def test_roofline_model_runs_and_is_compute_bound():
+    """tools/roofline.py: the analysis pre-staged for VERDICT r3 #1's
+    'where does the time go' deliverable. Pin the schema and the headline
+    conclusion: every bench tier is COMPUTE-bound on v5e with a
+    measured-MFU ceiling far above the 0.50 bar — so a sub-0.5
+    measurement indicts kernel/fusion efficiency, not HBM bandwidth."""
+    out = subprocess.run([sys.executable,
+                          os.path.join(REPO, "tools", "roofline.py")],
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=60)
+    assert out.returncode == 0, out.stderr
+    rec = json.load(open(os.path.join(REPO, "ROOFLINE.json")))
+    names = {c["config"] for c in rec["configs"]}
+    assert {"large", "medium", "small"} <= names
+    for c in rec["configs"]:
+        assert c["bound"] == "compute", c
+        assert c["measured_mfu_ceiling"] > 0.5, c
+        assert c["hbm_bytes"]["total"] > 0

@@ -203,3 +203,40 @@ def test_fused_rope_half_style():
     ref = np.concatenate([x1 * c - x2 * sn, x2 * c + x1 * sn], axis=-1)
     np.testing.assert_allclose(np.asarray(qo._data), ref, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_bench_composition_flash_selective_scan(monkeypatch):
+    """The EXACT bench.py headline composition — Pallas flash attention
+    INSIDE a jax.checkpoint(selective)-wrapped lax.scan body with a full
+    TrainStep — has to trace/compile/train as one program. This runs it
+    interpreted on the CPU mesh (PADDLE_TPU_FLASH_INTERPRET=1) so a
+    composition break (e.g. checkpoint-over-custom_vjp-in-scan) surfaces
+    before a hardware window instead of burning one."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+
+    def losses(flash: bool):
+        if flash:
+            monkeypatch.setenv("PADDLE_TPU_FLASH_INTERPRET", "1")
+        else:
+            monkeypatch.delenv("PADDLE_TPU_FLASH_INTERPRET", raising=False)
+        paddle.seed(0)
+        cfg = llama_tiny_config(scan_layers=True, use_recompute=True,
+                                recompute_granularity="selective")
+        m = LlamaForCausalLM(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=m.parameters())
+        step = jit.TrainStep(lambda i, l: m(i, labels=l)[1], opt)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 64)))
+        lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 64)))
+        return [float(step(ids, lbl)) for _ in range(3)]
+
+    flash_losses = losses(True)
+    dense_losses = losses(False)
+    assert flash_losses[-1] < flash_losses[0]
+    # flash vs dense attention are numerically close, not bit-equal
+    np.testing.assert_allclose(flash_losses, dense_losses, rtol=5e-3)

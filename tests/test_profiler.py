@@ -81,3 +81,33 @@ def test_ops_not_recorded_when_profiler_off():
     before = len(profiler._ACTIVE)
     paddle.ones([2]) + 1
     assert len(profiler._ACTIVE) == before == 0
+
+
+def test_analyze_xplane_summarizes_capture(tmp_path):
+    """tools/analyze_xplane.py (VERDICT r3 weak #7): an xplane capture
+    becomes quotable numbers — busy/span/duty/bubble + top ops."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cap = tmp_path / "cap"
+
+    # capture in a FRESH process: earlier tests in this file drive the
+    # Profiler's own jax.profiler sessions, after which a same-process
+    # trace comes back without device event lines
+    gen = subprocess.run(
+        [sys.executable, "-c", (
+            "import jax, jax.numpy as jnp\n"
+            "f = jax.jit(lambda x: jnp.tanh(x @ x).sum())\n"
+            "x = jnp.ones((256, 256)); f(x)\n"
+            f"with jax.profiler.trace({str(cap)!r}):\n"
+            "    f(x).block_until_ready()\n")],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert gen.returncode == 0, gen.stderr
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "analyze_xplane.py"),
+         str(cap)],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert out.returncode == 0, out.stderr
+    assert "duty" in out.stdout and "dot_general" in out.stdout, out.stdout

@@ -137,3 +137,27 @@ def test_collective_entry_points_call_watchdog(monkeypatch):
     rec = json.loads(store.d["collective_wd/0"].decode())
     assert rec["op"] == "all_reduce" and rec["done"] is True
     assert rec["seq"] == 1
+
+
+def test_stale_attempt_peer_benign_then_escalates():
+    """Pod-incarnation filtering (round 4): a peer whose record carries an
+    older attempt is benign while it could still be restarting — but if it
+    NEVER republishes, the 3x-timeout grace expires and it escalates into
+    a stuck report (measured from the un-re-armed enter time, so the SLOW
+    branch's re-arm cannot push the horizon away forever)."""
+    store = _DictStore()
+    old = CollectiveWatchdog(store, 1, 2, timeout=0.3, poll=999, attempt=0)
+    old.enter("all_reduce", "x")   # rank 1 publishes under attempt 0...
+    old.stop()                     # ...and dies without republishing
+    a = CollectiveWatchdog(store, 0, 2, timeout=0.3, poll=999, attempt=1)
+    a.enter("all_reduce", "x")
+    seen = []
+    a.on_desync = seen.append
+    time.sleep(0.4)                # > timeout, well under 3x=0.9: benign
+    assert a.check_once() is None
+    assert seen and seen[-1]["kind"] == "slow"
+    time.sleep(0.7)                # past 3x timeout since enter
+    report = a.check_once()
+    assert report is not None and report["kind"] == "stuck", report
+    assert report["peers_stale_attempt"] == [1]
+    assert 1 in report["peers_missing"]
