@@ -56,10 +56,14 @@ def analyze_capture(root: str, top_k: int = 12) -> dict:
                      or "CPU" in pname)
         if not is_device or pname.startswith("/host:metadata"):
             continue
-        # pick the busiest line as the op timeline (other lines carry
-        # step markers / thread scaffolding and would double-count)
+        # pick the busiest OP line as the timeline. The 'python' line is
+        # the host callstack sampler: its events NEST (sum > wall span),
+        # so it must never win the busy contest — under host load it can
+        # out-sum the actual executor line.
         best = None
         for line in plane.lines:
+            if line.name == "python":
+                continue
             evs = [(e.name, e.start_ns, e.duration_ns)
                    for e in line.events]
             busy = sum(d for _, _, d in evs)
