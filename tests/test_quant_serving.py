@@ -557,3 +557,38 @@ def test_dynamic_int8_rejects_bad_configs():
         PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
                                cache_quant="dynamic_int8", prefill_chunk=1,
                                compile=False)
+
+
+def test_static_cachekv_int8_with_fused_admission_token_exact():
+    """The fused-admission executable already threads STATIC per-head
+    cache scales (paged_fused_step passes _cachekv_scales); pin the whole
+    combination end-to-end: a calibrated model served through the fused
+    decode+prefill batcher is token-exact vs its own solo paged generate
+    (dynamic x fused remains excluded; static calibration is the
+    documented route)."""
+    from test_paged_batching import _retry_load_flake
+    m = _llama_eval()
+    rng = np.random.RandomState(17)
+    calib = paddle.to_tensor(rng.randint(0, 128, (2, 12)).astype(np.int64))
+    with paddle.no_grad():
+        m.calibrate_cachekv_int8(calib)
+    try:
+        prompts = [rng.randint(0, 128, (s,)) for s in (5, 11, 8)]
+
+        def body():
+            b = PagedContinuousBatcher(m, max_batch=2, s_max=32,
+                                       block_size=8, prefill_chunk=8,
+                                       fused_admission=True, compile=True)
+            assert str(b._state["layers"][0][0].dtype).endswith("int8")
+            rids = [b.submit(p, 5) for p in prompts]
+            outs = b.run_until_done()
+            for rid, p in zip(rids, prompts):
+                ids = paddle.to_tensor(np.asarray(p, np.int64)[None])
+                with paddle.no_grad():
+                    ref = m.generate_paged(ids, max_new_tokens=5,
+                                           block_size=8).numpy()[0]
+                np.testing.assert_array_equal(outs[rid], ref)
+
+        _retry_load_flake(body, attempts=3)
+    finally:
+        m.calibrate_cachekv_int8(None)

@@ -56,17 +56,24 @@ def analyze_capture(root: str, top_k: int = 12) -> dict:
                      or "CPU" in pname)
         if not is_device or pname.startswith("/host:metadata"):
             continue
-        # pick the busiest OP line as the timeline. The 'python' line is
-        # the host callstack sampler: its events NEST (sum > wall span),
-        # so it must never win the busy contest — under host load it can
-        # out-sum the actual executor line.
+        # pick the busiest OP line as the timeline. Callstack-sampler
+        # lines (e.g. 'python') carry NESTED events whose durations sum
+        # past the wall span — any such line is not an op timeline and
+        # must never win the busy contest, whatever its name.
         best = None
         for line in plane.lines:
             if line.name == "python":
-                continue
+                continue  # the host callstack sampler, never a timeline
             evs = [(e.name, e.start_ns, e.duration_ns)
                    for e in line.events]
             busy = sum(d for _, _, d in evs)
+            timed = [(s, s + d) for _, s, d in evs if d > 0]
+            line_span = (max(e for _, e in timed)
+                         - min(s for s, _ in timed)) if timed else 0
+            # op timelines tile (+ ~% of bookkeeping overlap like 'end:'
+            # markers); heavily nested durations mean a sampler line
+            if line_span and busy > line_span * 1.1:
+                continue
             if evs and (best is None or busy > best[0]):
                 best = (busy, line.name, evs)
         if best is None:
