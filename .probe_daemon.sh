@@ -5,6 +5,10 @@
 # so a window that opens while no one is watching still gets burned on the
 # priority list (bench -> tpu test tier -> serving bench).
 ERRF=/tmp/.tpu_probe_err
+# single-instance guard (round 4): session handoffs/restarts kept
+# spawning duplicate daemons; the flock releases on any process death
+exec 8>/tmp/.probe_daemon.lock
+flock -n 8 || exit 0
 # seed from the persisted marker so a daemon restart while healthy does not
 # count as a heal transition — UNLESS no burn was ever recorded on this
 # boot (/tmp/.window_burned is stamped by the playbook and cleared by
@@ -14,7 +18,7 @@ PREV=wedged
 [ -f /root/repo/.tpu_healthy ] && [ -f /tmp/.window_burned ] && PREV=healthy
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  raw=$(timeout 300 python -c "import jax; print('DEV', jax.devices())" 2>"$ERRF")
+  raw=$(timeout 300 python -c "import jax; print('DEV', jax.devices())" 2>"$ERRF" 8>&-)
   rc=$?
   out=$(printf '%s\n' "$raw" | grep DEV | tail -1)
   if [ "$rc" -eq 0 ] && [ -n "$out" ]; then
@@ -24,7 +28,9 @@ while true; do
       # launch unconditionally: the playbook's flock is the single
       # instance guard (one mechanism, self-releasing on death)
       echo "$ts heal transition: launching playbook" >> /root/repo/TPU_PROBES.log
-      nohup /root/repo/.on_heal_playbook.sh >/dev/null 2>&1 &
+      # 8>&-: children must NOT inherit the daemon's lock FD, or a
+      # long-running playbook would block the daemon's own restart
+      nohup /root/repo/.on_heal_playbook.sh >/dev/null 2>&1 8>&- &
     fi
     PREV=healthy
   else
