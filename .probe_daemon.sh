@@ -8,7 +8,11 @@ ERRF=/tmp/.tpu_probe_err
 # single-instance guard (round 4): session handoffs/restarts kept
 # spawning duplicate daemons; the flock releases on any process death
 exec 8>/tmp/.probe_daemon.lock
-flock -n 8 || exit 0
+flock -n 8 || {
+  echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) duplicate daemon start suppressed" \
+    >> /root/repo/TPU_PROBES.log
+  exit 0
+}
 # seed from the persisted marker so a daemon restart while healthy does not
 # count as a heal transition — UNLESS no burn was ever recorded on this
 # boot (/tmp/.window_burned is stamped by the playbook and cleared by
@@ -39,5 +43,5 @@ while true; do
     rm -f /root/repo/.tpu_healthy
     PREV=wedged
   fi
-  sleep 600
+  sleep 600 8>&-  # no lock FD: an orphaned sleep must not block restart
 done

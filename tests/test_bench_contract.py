@@ -102,3 +102,47 @@ def test_roofline_model_runs_and_is_compute_bound():
         assert c["bound"] == "compute", c
         assert c["measured_mfu_ceiling"] > 0.5, c
         assert c["hbm_bytes"]["total"] > 0
+
+
+def test_roofline_configs_mirror_bench():
+    """tools/roofline.py hardcodes the bench tier dimensions; if bench.py
+    is retuned without updating the mirror, the roofline table silently
+    describes a config that no longer runs. Parse bench.py's LlamaConfig
+    literals and pin the correspondence."""
+    import re
+
+    src = open(BENCH).read()
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "roofline_mod", os.path.join(REPO, "tools", "roofline.py"))
+    roof = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(roof)
+    mirror = {name: dict(V=V, H=H, I=I, L=L, heads=heads, kvh=kvh,
+                         batch=batch, seq=seq)
+              for (name, V, H, I, L, heads, kvh, batch, seq, _remat)
+              in roof.BENCH_CONFIGS}
+
+    # every TPU-tier LlamaConfig literal in bench.py main(), in chain
+    # order large -> medium -> small
+    pat = re.compile(
+        r"LlamaConfig\(vocab_size=(\d+), hidden_size=(\d+),\s*"
+        r"intermediate_size=(\d+), num_hidden_layers=(\d+),\s*"
+        r"num_attention_heads=(\d+), num_key_value_heads=(\d+)")
+    found = [tuple(map(int, m.groups())) for m in pat.finditer(src)]
+    # drop the CPU-proxy config (vocab 256)
+    found = [f for f in found if f[0] != 256]
+    assert len(found) == 3, found
+    # the batch/seq assignments follow the same large/medium/small order
+    # (the CPU proxy's is last)
+    bs_pat = re.compile(r"batch, seq, iters = (\d+), (\d+), (\d+)")
+    bs = [tuple(map(int, m.groups())) for m in bs_pat.finditer(src)][:3]
+    assert len(bs) == 3, bs
+    for name, f, (batch, seq, _iters) in zip(
+            ("large", "medium", "small"), found, bs):
+        V, H, I, L, heads, kvh = f
+        m = mirror[name]
+        assert (V, H, I, L, heads, kvh, batch, seq) == (
+            m["V"], m["H"], m["I"], m["L"], m["heads"], m["kvh"],
+            m["batch"], m["seq"]), (
+            f"{name}: bench.py={f}+{(batch, seq)} roofline={m} — "
+            f"update tools/roofline.py")
