@@ -22,7 +22,9 @@ PREV=wedged
 [ -f /root/repo/.tpu_healthy ] && [ -f /tmp/.window_burned ] && PREV=healthy
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  raw=$(timeout 300 python -c "import jax; print('DEV', jax.devices())" 2>"$ERRF" 8>&-)
+  # exec 8>&- closes the lock FD for the SUBSHELL itself, not just the
+  # probe child — an orphaned in-flight probe must not hold the lock
+  raw=$(exec 8>&-; timeout 300 python -c "import jax; print('DEV', jax.devices())" 2>"$ERRF")
   rc=$?
   out=$(printf '%s\n' "$raw" | grep DEV | tail -1)
   if [ "$rc" -eq 0 ] && [ -n "$out" ]; then
