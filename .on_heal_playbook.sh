@@ -39,6 +39,16 @@ rc=$?
 echo "$(ts) bench rc=$rc: $(cat /tmp/.window_bench.json 2>/dev/null)" >> "$LOG"
 probe_or_stop "bench"
 
+# 1b) measured peaks (VERDICT r4 #3): plain jitted matmul + stream —
+#     the safest op class — then re-emit ROOFLINE.json with measured
+#     peaks.  Roofline itself is pure CPU arithmetic.
+echo "$(ts) stage 1b: measure_peaks" >> "$LOG"
+timeout 900 python tools/measure_peaks.py >> "$LOG" 2>&1
+rc=$?
+echo "$(ts) measure_peaks rc=$rc" >> "$LOG"
+[ $rc -eq 0 ] && timeout 120 python tools/roofline.py >> "$LOG" 2>&1
+probe_or_stop "measure_peaks"
+
 # 2) safe tier: hardware-validated flash kernels + xplane profile captures +
 #    fused-serving correctness — per-unit subprocesses, health-probed.
 #    Outer timeout = budget + 400s headroom (post-unit wedge probe 300s +
