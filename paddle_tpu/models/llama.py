@@ -496,17 +496,24 @@ class ScannedLlamaLayers(Layer):
             if getattr(cfg, "sep_impl", "ring") == "ulysses":
                 # all-to-all CP (heads<->sequence): wins when heads are
                 # plentiful (h, kv divisible by the sep axis) and a
-                # P-step ring's per-hop latency would dominate
+                # P-step ring's per-hop latency would dominate; heads
+                # shard jointly over (mp, sep) when divisible
                 from ..ops.ulysses_attention import (
-                    _cached_impl as _ulysses_impl, validate_ulysses)
+                    resolve_ulysses_head_axis, ulysses_attention_impl,
+                    validate_ulysses)
+                u_head_axis = resolve_ulysses_head_axis(
+                    jmesh, cfg.sep_axis, head_axis, h, kv)
                 validate_ulysses(
                     jmesh, cfg.sep_axis, h, kv, seq,
-                    attn_mask.shape[1] if attn_mask is not None else None)
-                ring_impl = _ulysses_impl(
-                    jmesh, cfg.sep_axis, attn_mask is None, batch_axis,
-                    attn_mask is not None,
-                    attn_mask is not None and attn_mask.shape[1] > 1,
-                    False)
+                    attn_mask.shape[1] if attn_mask is not None else None,
+                    head_axis=u_head_axis)
+                ring_impl = ulysses_attention_impl(
+                    jmesh, cfg.sep_axis, causal=attn_mask is None,
+                    batch_axis=batch_axis, head_axis=u_head_axis,
+                    has_mask=attn_mask is not None,
+                    mask_headed=attn_mask is not None
+                    and attn_mask.shape[1] > 1,
+                    has_seqlens=False)
             else:
                 ring_impl = _cached_impl(jmesh, cfg.sep_axis,
                                          attn_mask is None,

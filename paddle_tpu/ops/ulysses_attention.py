@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
 from .registry import dispatch
-from .ring_attention import _axes_size, _pick_axis, _DP_NAMES
+from .ring_attention import _axes_size, _pick_axis, _DP_NAMES, _MP_NAMES
 
 _NEG = -1e30
 
@@ -68,36 +68,68 @@ def _full_attention(q, k, v, causal, mask, seqlens, scale):
     return out.reshape(b, s, hl, d)
 
 
-def validate_ulysses(jax_mesh, axis_name, h, kv, seq, mask_heads=None):
+def validate_ulysses(jax_mesh, axis_name, h, kv, seq, mask_heads=None,
+                     head_axis=None):
     """Shape contract shared by the public wrapper and the in-model
     (scanned Llama) call site — a violation must fail with THIS message,
-    not a shard_map shape error from deep inside a scan trace."""
+    not a shard_map shape error from deep inside a scan trace.
+
+    When ``head_axis`` names a tensor-parallel mesh axis, heads shard
+    jointly over (head_axis, sep): the divisibility requirement becomes
+    h % (|head_axis| * |sep|) == 0 (likewise kv and a per-head mask)."""
     P = jax_mesh.shape[axis_name]
-    if h % P or kv % P:
+    hp = P * (_axes_size(jax_mesh, head_axis) if head_axis else 1)
+    label = (f"|{head_axis}|x|{axis_name}|={hp}" if head_axis
+             else f"|{axis_name}|={P}")
+    if h % hp or kv % hp:
         raise ValueError(
             f"ulysses_attention needs heads divisible by the context axis: "
-            f"h={h}, kv={kv}, |{axis_name}|={P} (use ring_attention for "
+            f"h={h}, kv={kv}, {label} (use ring_attention for "
             f"h < P or ragged head counts)")
     if seq % P:
         raise ValueError(f"sequence {seq} not divisible by "
                          f"|{axis_name}|={P}")
-    if mask_heads is not None and mask_heads > 1 and mask_heads % P:
+    if mask_heads is not None and mask_heads > 1 and mask_heads % hp:
         raise ValueError(f"per-head mask ({mask_heads} heads) not "
-                         f"divisible by |{axis_name}|={P}")
+                         f"divisible by {label}")
+
+
+def resolve_ulysses_head_axis(jax_mesh, axis_name, head_axis, h, kv):
+    """Joint (head_axis, sep) sharding rule, in ONE place for every call
+    site: heads shard over both axes only when h and kv divide
+    |head_axis| * |sep|; otherwise the head dim replicates over
+    head_axis (returns None) and the caller may prefer ring_attention.
+    ``head_axis`` is a tuple of mesh-axis names or None."""
+    if head_axis is None:
+        return None
+    hp = _axes_size(jax_mesh, head_axis) * jax_mesh.shape[axis_name]
+    if h % hp or kv % hp:
+        return None
+    return head_axis
 
 
 @functools.lru_cache(maxsize=16)
 def _cached_impl(jax_mesh, axis_name, causal, batch_axis, has_mask,
-                 mask_headed, has_seqlens):
+                 mask_headed, has_seqlens, head_axis=None):
     P = jax_mesh.shape[axis_name]
     bspec = batch_axis if batch_axis is None else batch_axis[0] \
         if len(batch_axis) == 1 else batch_axis
+    # heads shard jointly over (tp, sep) when a head_axis is threaded
+    # (ADVICE r4: without it a hybrid mp x sep mesh replicates the head
+    # dim over mp, forcing an all-gather at the attention boundary);
+    # after the in-body all-to-all the global head layout is
+    # [head_axis major][sep minor], so a headed mask shards the same way
+    hspec = head_axis if head_axis is None else head_axis[0] \
+        if len(head_axis) == 1 else head_axis
+    mask_hspec = None
+    if mask_headed:
+        mask_hspec = ((head_axis or ()) + (axis_name,))
+        mask_hspec = mask_hspec[0] if len(mask_hspec) == 1 else mask_hspec
 
-    qkv_spec = PartitionSpec(bspec, axis_name, None, None)
+    qkv_spec = PartitionSpec(bspec, axis_name, hspec, None)
     in_specs = [qkv_spec, qkv_spec, qkv_spec]
     if has_mask:
-        in_specs.append(PartitionSpec(
-            bspec, axis_name if mask_headed else None, None, None))
+        in_specs.append(PartitionSpec(bspec, mask_hspec, None, None))
     if has_seqlens:
         in_specs.append(PartitionSpec(bspec))
 
@@ -136,6 +168,10 @@ def ulysses_attention(query, key, value, mesh=None, axis_name: str = "sep",
     context axis. attn_mask: [b, 1|h, s, s] bool keep / float additive;
     kv_seqlens: [b] valid lengths. Returns [b, s, h, d] sequence-sharded
     over ``axis_name`` — drop-in interchangeable with ring_attention.
+    On a hybrid mp x sep mesh, heads shard jointly over (mp, sep) when
+    h and kv divide |mp|*|sep| (otherwise the head dim replicates over
+    mp and ring_attention — whose head_axis has no divisibility coupling
+    with sep — is usually the better pick).
     """
     from ..distributed.auto_parallel import ProcessMesh, get_default_mesh
     if mesh is None:
@@ -146,9 +182,6 @@ def ulysses_attention(query, key, value, mesh=None, axis_name: str = "sep",
         raise ValueError("ulysses_attention needs a mesh (or initialized "
                          "fleet)")
     jmesh = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else mesh
-    validate_ulysses(jmesh, axis_name, query.shape[2], key.shape[2],
-                     query.shape[1],
-                     attn_mask.shape[1] if attn_mask is not None else None)
     if batch_axis is None:
         batch_axis = _pick_axis(jmesh.axis_names, _DP_NAMES, axis_name)
     if isinstance(batch_axis, str):
@@ -156,11 +189,18 @@ def ulysses_attention(query, key, value, mesh=None, axis_name: str = "sep",
     if batch_axis is not None and \
             query.shape[0] % _axes_size(jmesh, batch_axis):
         batch_axis = None
+    head_axis = resolve_ulysses_head_axis(
+        jmesh, axis_name, _pick_axis(jmesh.axis_names, _MP_NAMES, axis_name),
+        query.shape[2], key.shape[2])
+    validate_ulysses(jmesh, axis_name, query.shape[2], key.shape[2],
+                     query.shape[1],
+                     attn_mask.shape[1] if attn_mask is not None else None,
+                     head_axis=head_axis)
 
     mask_headed = attn_mask is not None and attn_mask.shape[1] > 1
     impl = _cached_impl(jmesh, axis_name, bool(causal), batch_axis,
                         attn_mask is not None, mask_headed,
-                        kv_seqlens is not None)
+                        kv_seqlens is not None, head_axis)
     args = [query, key, value]
     if attn_mask is not None:
         args.append(attn_mask)
@@ -169,4 +209,36 @@ def ulysses_attention(query, key, value, mesh=None, axis_name: str = "sep",
     return dispatch(impl, tuple(args), {}, "ulysses_attention")
 
 
-__all__ = ["ulysses_attention", "validate_ulysses"]
+def ulysses_attention_impl(mesh, axis_name: str = "sep", *,
+                           causal: bool = True, batch_axis=None,
+                           head_axis=None, has_mask: bool = False,
+                           mask_headed: bool = False,
+                           has_seqlens: bool = False):
+    """Scan-safe public seam (VERDICT r4 item 6): return the raw
+    shard_map'd callable ``impl(q, k, v, [mask], [seqlens])`` for a
+    FIXED mesh/flag combination, bypassing the per-call mesh discovery
+    and validation of :func:`ulysses_attention`.
+
+    Intended for call sites that bake the impl into a traced region —
+    e.g. ``lax.scan`` over transformer layers (models/llama.py), where
+    re-entering the public wrapper per layer would re-validate shapes
+    against a mesh captured outside the trace.  Call
+    :func:`validate_ulysses` once before tracing; the returned impl is
+    cached (same ``functools.lru_cache`` slots as the public wrapper).
+
+    ``batch_axis``/``head_axis`` are tuples of mesh-axis names (or
+    None); heads shard jointly over (head_axis, sep) when supplied.
+    """
+    from ..distributed.auto_parallel import ProcessMesh
+    jmesh = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else mesh
+    if isinstance(batch_axis, str):
+        batch_axis = (batch_axis,)
+    if isinstance(head_axis, str):
+        head_axis = (head_axis,)
+    return _cached_impl(jmesh, axis_name, bool(causal), batch_axis,
+                        bool(has_mask), bool(mask_headed),
+                        bool(has_seqlens), head_axis)
+
+
+__all__ = ["ulysses_attention", "ulysses_attention_impl",
+           "resolve_ulysses_head_axis", "validate_ulysses"]

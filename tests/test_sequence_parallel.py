@@ -549,6 +549,90 @@ def test_ulysses_gqa_mask_seqlens_and_grads():
     np.testing.assert_allclose(g, np.asarray(gd), rtol=2e-3, atol=2e-4)
 
 
+def test_ulysses_hybrid_mp_sep_shards_heads_jointly():
+    """ADVICE r4: on a hybrid (mp, sep) mesh, heads shard jointly over
+    (mp, sep) — the head dim must not replicate over mp. Numerics must
+    still match dense, including a per-head additive mask."""
+    rng = np.random.RandomState(34)
+    b, s, h, d = 2, 16, 8, 8          # h divisible by |mp|*|sep| = 8
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["mp", "sep"])
+    q = rng.randn(b, s, h, d).astype("float32")
+    k = rng.randn(b, s, h, d).astype("float32")
+    v = rng.randn(b, s, h, d).astype("float32")
+    out = _ulysses(paddle.to_tensor(q), paddle.to_tensor(k),
+                   paddle.to_tensor(v), mesh=mesh, axis_name="sep",
+                   causal=True).numpy()
+    np.testing.assert_allclose(out, _dense_attention(q, k, v, True),
+                               rtol=2e-4, atol=2e-5)
+    # per-head mask shards over (mp, sep) too
+    mask = (rng.randn(b, h, s, s) * 2).astype("float32")
+    out2 = _ulysses(paddle.to_tensor(q), paddle.to_tensor(k),
+                    paddle.to_tensor(v), mesh=mesh, axis_name="sep",
+                    causal=False,
+                    attn_mask=paddle.to_tensor(mask)).numpy()
+    ref = _dense_masked(q, k, v, False, mask=mask)
+    np.testing.assert_allclose(out2, ref, rtol=2e-4, atol=2e-5)
+    # h=4 < |mp|*|sep|: joint sharding impossible -> head_axis dropped,
+    # still correct (replicated-over-mp fallback)
+    q4 = rng.randn(b, s, 4, d).astype("float32")
+    k4 = rng.randn(b, s, 4, d).astype("float32")
+    v4 = rng.randn(b, s, 4, d).astype("float32")
+    out3 = _ulysses(paddle.to_tensor(q4), paddle.to_tensor(k4),
+                    paddle.to_tensor(v4), mesh=mesh, axis_name="sep",
+                    causal=True).numpy()
+    np.testing.assert_allclose(out3, _dense_attention(q4, k4, v4, True),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_hybrid_gqa_headed_mask():
+    """GQA (rep=2) with heads jointly sharded over (mp, sep): the
+    riskiest layout — kv heads all-to-all split + q/mask head-block
+    alignment with rep > 1 on a hybrid mesh — plus a per-head mask."""
+    rng = np.random.RandomState(36)
+    b, s, h, kv, d = 2, 16, 16, 8, 8  # both divisible by |mp|*|sep|=8
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["mp", "sep"])
+    q = rng.randn(b, s, h, d).astype("float32")
+    k = rng.randn(b, s, kv, d).astype("float32")
+    v = rng.randn(b, s, kv, d).astype("float32")
+    out = _ulysses(paddle.to_tensor(q), paddle.to_tensor(k),
+                   paddle.to_tensor(v), mesh=mesh, axis_name="sep",
+                   causal=True).numpy()
+    ref = _dense_attention(q, np.repeat(k, h // kv, 2),
+                           np.repeat(v, h // kv, 2), True)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+    mask = (rng.randn(b, h, s, s) * 2).astype("float32")
+    out2 = _ulysses(paddle.to_tensor(q), paddle.to_tensor(k),
+                    paddle.to_tensor(v), mesh=mesh, axis_name="sep",
+                    causal=False,
+                    attn_mask=paddle.to_tensor(mask)).numpy()
+    ref2 = _dense_masked(q, np.repeat(k, h // kv, 2),
+                         np.repeat(v, h // kv, 2), False, mask=mask)
+    np.testing.assert_allclose(out2, ref2, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_public_impl_seam():
+    """VERDICT r4 item 6: ulysses_attention_impl is the scan-safe public
+    entry — same cache slots as the wrapper, callable directly."""
+    from paddle_tpu.ops.ulysses_attention import (
+        _cached_impl, ulysses_attention_impl, validate_ulysses)
+    import jax.numpy as jnp
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "sep"])
+    jmesh = mesh.jax_mesh
+    validate_ulysses(jmesh, "sep", 8, 8, 16)
+    impl = ulysses_attention_impl(mesh, "sep", causal=True,
+                                  batch_axis=("dp",))
+    # identical lru_cache slot as the private constructor
+    assert impl is _cached_impl(jmesh, "sep", True, ("dp",), False,
+                                False, False, None)
+    rng = np.random.RandomState(35)
+    q = rng.randn(2, 16, 8, 8).astype("float32")
+    k = rng.randn(2, 16, 8, 8).astype("float32")
+    v = rng.randn(2, 16, 8, 8).astype("float32")
+    out = np.asarray(impl(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(out, _dense_attention(q, k, v, True),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_ulysses_rejects_ragged_heads():
     mesh = ProcessMesh(np.arange(8), ["sep"])
     rng = np.random.RandomState(32)
