@@ -74,6 +74,14 @@ class VocabParallelEmbedding(Layer):
         self.weight = self.create_parameter(
             [rows, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 0.02))
+        if rows != num_embeddings:
+            # Megatron practice: phantom vocab rows must be EXACTLY zero —
+            # a tied lm-head matmul (emb.weight used directly as the
+            # output projection) would otherwise leak softmax mass onto
+            # padded vocab entries
+            self.weight._set_data(
+                self.weight._data.at[num_embeddings:].set(0))
+        self._register_padded_param("weight", 0, num_embeddings)
         _annotate(self.weight, 0)
 
     def forward(self, x):
@@ -108,10 +116,18 @@ class ColumnParallelLinear(Layer):
         self.weight = self.create_parameter(
             [in_features, cols], attr=weight_attr,
             default_initializer=I.XavierNormal())
+        if cols != out_features:
+            # zero pad columns: output is sliced after the gather anyway,
+            # but zeroing keeps saved/loaded checkpoints bit-identical
+            # across mp degrees (pad-on-load fills zeros)
+            self.weight._set_data(
+                self.weight._data.at[:, out_features:].set(0))
+        self._register_padded_param("weight", 1, out_features)
         _annotate(self.weight, 1)
         if has_bias:
             self.bias = self.create_parameter([cols], attr=None,
                                               is_bias=True)
+            self._register_padded_param("bias", 0, out_features)
             _annotate(self.bias, 0)
         else:
             self.bias = None
@@ -144,6 +160,12 @@ class RowParallelLinear(Layer):
         self.weight = self.create_parameter(
             [rows, out_features], attr=weight_attr,
             default_initializer=I.XavierNormal())
+        if rows != in_features:
+            # zero pad rows: they multiply the zero-padded activation
+            # tail, and zeros keep checkpoints canonical across degrees
+            self.weight._set_data(
+                self.weight._data.at[in_features:].set(0))
+        self._register_padded_param("weight", 0, in_features)
         _annotate(self.weight, 0)
         if has_bias:
             self.bias = self.create_parameter([out_features], attr=None,

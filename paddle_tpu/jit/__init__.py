@@ -746,8 +746,13 @@ class TrainStep:
 
 def _pure_layer_forward(layer):
     """Stage layer.__call__ as a pure fn(param_arrays, *input_arrays):
-    the state-threading trick TrainStep uses, for inference export."""
-    named = list(layer.state_dict().items())  # params + buffers
+    the state-threading trick TrainStep uses, for inference export.
+
+    Uses _state_dict_raw(): the LIVE tensors (padded shapes intact) —
+    state_dict() returns sliced COPIES for Megatron-padded params, and
+    assigning t._data on a copy would bake the live weight into the
+    trace as a constant."""
+    named = list(layer._state_dict_raw().items())  # params + buffers
 
     def fn(param_arrays, *input_arrays):
         saved = [(t, t._data) for _, t in named]
@@ -829,10 +834,19 @@ def save(layer, path, input_spec=None, **kwargs):
         param_structs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
                          for a in param_arrays]
         exported = jexport.export(jax.jit(fn))(param_structs, *arg_shapes)
+        # pdiparams stores LOGICAL shapes (state_dict slices pad tails,
+        # so checkpoints interchange across mp degrees); the exported
+        # program's param inputs are the live PADDED shapes — record the
+        # pad map so load() can zero-fill before binding
+        pads = {name: {"dim": pad[0], "logical": pad[1],
+                       "padded": int(p.shape[pad[0]])}
+                for name, p, pad in layer._named_param_entries()
+                if pad is not None and p.shape[pad[0]] != pad[1]}
         with open(path + ".pdmodel", "wb") as f:
             f.write(exported.serialize())
         with open(path + ".json", "w") as f:
             json.dump({"format": "stablehlo",
+                       "param_pads": pads,
                        "param_names": [n for n, _ in named],
                        "input_specs": [{"shape": list(s.shape),
                                         "dtype": s.dtype,
@@ -884,6 +898,19 @@ def load(path, **kwargs):
         state = fio.load(path + ".pdiparams")
         params = [state[n]._data if _is_tensor(state[n])
                   else jnp.asarray(state[n]) for n in meta["param_names"]]
+        # re-pad logical-shape params to the exported program's padded
+        # input shapes (zero tails, matching the layers' init contract)
+        pads = meta.get("param_pads", {})
+        if pads:
+            by_name = dict(zip(meta["param_names"], range(len(params))))
+            for name, info in pads.items():
+                i = by_name[name]
+                a = params[i]
+                dim, padded = info["dim"], info["padded"]
+                if a.shape[dim] < padded:
+                    widths = [(0, 0)] * a.ndim
+                    widths[dim] = (0, padded - a.shape[dim])
+                    params[i] = jnp.pad(a, widths)
         return TranslatedLayer(exported, params, meta)
     # params-only (or legacy .pdparams) save
     for suffix in (".pdiparams", ".pdparams"):

@@ -50,6 +50,9 @@ class Layer:
         object.__setattr__(self, "_sub_layers", OrderedDict())
         object.__setattr__(self, "_buffers", OrderedDict())
         self._non_persistable_buffer_names = set()
+        # attr name -> (dim, logical_size) for Megatron-padded parameters
+        # (see _register_padded_param)
+        self._padded_params = {}
         self.training = True
         self._dtype = dtype_mod.to_jax_dtype(dtype)
         self._forward_pre_hooks = OrderedDict()
@@ -225,10 +228,52 @@ class Layer:
         return self._full_name
 
     # -- state dict ---------------------------------------------------------
+    def _register_padded_param(self, name, dim, logical_size):
+        """Declare parameter ``name`` Megatron-padded along ``dim`` beyond
+        its logical size (tensor-parallel uneven shards). state_dict then
+        saves the TRUE (sliced) shape and set_state_dict re-pads with
+        zeros on load, so checkpoints interchange across mp degrees and
+        with true-shape external/reference checkpoints."""
+        self._padded_params[name] = (int(dim), int(logical_size))
+
+    def _named_param_entries(self, include_sublayers=True):
+        """(key, param, pad_info) triples; pad_info is (dim, logical) or
+        None. Single source for state_dict/set_state_dict so save-side
+        slicing can never desynchronize from load-side padding."""
+        seen = set()
+        for name, layer in self._traverse("", include_sublayers):
+            for pname, p in layer._parameters.items():
+                if id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = f"{name}.{pname}" if name else pname
+                yield full, p, getattr(layer, "_padded_params", {}).get(pname)
+
+    def _state_dict_raw(self, include_sublayers=True):
+        """LIVE parameter/buffer objects, padded shapes intact — for
+        callers that mutate tensors in place (jit's state threading).
+        state_dict() instead slices Megatron-padded params into copies
+        for checkpoint I/O, so its entries must never be mutated."""
+        dest = OrderedDict()
+        for name, p, _ in self._named_param_entries(include_sublayers):
+            dest[name] = p
+        for name, layer in self._traverse("", include_sublayers):
+            for bname, b in layer._buffers.items():
+                if bname not in layer._non_persistable_buffer_names:
+                    full = f"{name}.{bname}" if name else bname
+                    dest.setdefault(full, b)
+        return dest
+
     def state_dict(self, destination=None, include_sublayers=True,
                    structured_name_prefix="", use_hook=True):
         dest = destination if destination is not None else OrderedDict()
-        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+        for name, p, pad in self._named_param_entries(include_sublayers):
+            if pad is not None and p.shape[pad[0]] != pad[1]:
+                # slice-on-save: the checkpoint carries the logical shape
+                # (the zero pad tail is an artifact of THIS mp degree)
+                idx = [slice(None)] * len(p.shape)
+                idx[pad[0]] = slice(0, pad[1])
+                p = Tensor(p._data[tuple(idx)])
             dest[structured_name_prefix + name] = p
         for name, layer in self._traverse("", include_sublayers):
             for bname, b in layer._buffers.items():
@@ -239,20 +284,48 @@ class Layer:
 
     def set_state_dict(self, state_dict, use_structured_name=True):
         """Returns (missing_keys, unexpected_keys) like the reference."""
-        own = self.state_dict()
-        missing, matched = [], set()
-        for key, target in own.items():
-            if key in state_dict:
-                value = state_dict[key]
-                arr = value.numpy() if hasattr(value, "numpy") else np.asarray(value)
-                if list(arr.shape) != list(target.shape):
-                    raise ValueError(
-                        f"shape mismatch for {key}: {list(arr.shape)} vs "
-                        f"{list(target.shape)}")
-                target._set_data(jnp.asarray(arr, target.dtype))
-                matched.add(key)
-            else:
+        own = OrderedDict()
+        for key, p, pad in self._named_param_entries():
+            own[key] = (p, pad)
+        for name, layer in self._traverse("", True):
+            for bname, b in layer._buffers.items():
+                if bname not in layer._non_persistable_buffer_names:
+                    full = f"{name}.{bname}" if name else bname
+                    own.setdefault(full, (b, None))
+        missing = []
+        for key, (target, pad) in own.items():
+            if key not in state_dict:
                 missing.append(key)
+                continue
+            value = state_dict[key]
+            arr = value.numpy() if hasattr(value, "numpy") \
+                else np.asarray(value)
+            if pad is not None and arr.ndim == target.ndim:
+                dim, logical = pad
+                if arr.shape[dim] > logical:
+                    # possibly another degree's pad tail — strip it, but
+                    # ONLY if it is all-zero: a nonzero tail means a
+                    # genuinely different logical size (e.g. a real
+                    # 132-vocab model into a 130-vocab layer) and must
+                    # fail the shape check below, not be truncated
+                    idx = [slice(None)] * arr.ndim
+                    idx[dim] = slice(logical, None)
+                    if not np.any(arr[tuple(idx)]):
+                        idx[dim] = slice(0, logical)
+                        arr = arr[tuple(idx)]
+                if arr.shape[dim] == logical and \
+                        logical < target.shape[dim]:
+                    # pad-on-load: zero-fill this degree's tail (only
+                    # from the EXACT logical size — anything else is a
+                    # real mismatch and falls through to the error)
+                    widths = [(0, 0)] * arr.ndim
+                    widths[dim] = (0, target.shape[dim] - logical)
+                    arr = np.pad(arr, widths)
+            if list(arr.shape) != list(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {key}: {list(arr.shape)} vs "
+                    f"{list(target.shape)}")
+            target._set_data(jnp.asarray(arr, target.dtype))
         unexpected = [k for k in state_dict if k not in own]
         return missing, unexpected
 

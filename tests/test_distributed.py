@@ -466,3 +466,61 @@ def test_tp4_uneven_vocab_embedding_head_and_parallel_ce():
     # the uneven vocab dim really is sharded over mp
     assert emb.weight._data.sharding.spec[0] == "mp"
     assert head.bias._data.sharding.spec[0] == "mp"
+
+
+def test_tp_padded_checkpoint_interchange():
+    """ADVICE r4 (mp_layers.py:73,76): padded TP checkpoints interchange
+    — state_dict saves the LOGICAL shape (pad tail sliced off),
+    set_state_dict accepts true-shape external checkpoints (zero-fills
+    the tail) and other-degree padded ones (strips then re-pads), and
+    phantom vocab rows are exactly zero so a tied lm-head leaks no
+    softmax mass."""
+    _init_fleet(dp=2, mp=4)
+    V, E = 130, 32                       # 130 % 4 != 0 -> padded to 132
+    paddle.seed(11)
+    emb = fleet.VocabParallelEmbedding(V, E)
+    head = fleet.ColumnParallelLinear(E, V, gather_output=True)
+    row = fleet.RowParallelLinear(V, E)
+    assert emb.weight.shape == [132, E]
+    # pad regions are exactly zero after init (Megatron practice)
+    np.testing.assert_array_equal(emb.weight.numpy()[V:], 0.0)
+    np.testing.assert_array_equal(head.weight.numpy()[:, V:], 0.0)
+    np.testing.assert_array_equal(head.bias.numpy()[V:], 0.0)
+    np.testing.assert_array_equal(row.weight.numpy()[V:], 0.0)
+    # state_dict carries the TRUE shapes
+    assert list(emb.state_dict()["weight"].shape) == [V, E]
+    hsd = head.state_dict()
+    assert list(hsd["weight"].shape) == [E, V]
+    assert list(hsd["bias"].shape) == [V]
+    assert list(row.state_dict()["weight"].shape) == [V, E]
+    # a true-shape external/reference checkpoint loads (pad-on-load)
+    rng = np.random.RandomState(0)
+    ext = rng.randn(V, E).astype("float32")
+    missing, unexpected = emb.set_state_dict({"weight": ext})
+    assert not missing and not unexpected
+    np.testing.assert_array_equal(emb.weight.numpy()[:V], ext)
+    np.testing.assert_array_equal(emb.weight.numpy()[V:], 0.0)
+    # another degree's padded checkpoint (e.g. mp=8 -> 136 rows) loads:
+    # its zero tail is stripped to the logical shape, then re-padded
+    padded8 = np.concatenate([ext, np.zeros((6, E), "float32")])
+    emb.set_state_dict({"weight": padded8})
+    np.testing.assert_array_equal(emb.weight.numpy()[:V], ext)
+    # save -> load roundtrip across layers preserves logical content
+    sd = head.state_dict()
+    w_logical = sd["weight"].numpy().copy()
+    head2 = fleet.ColumnParallelLinear(E, V, gather_output=True)
+    head2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    np.testing.assert_array_equal(head2.weight.numpy()[:, :V], w_logical)
+    # a GENUINE mismatch (wrong non-pad dim) still fails loudly
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="shape mismatch"):
+        emb.set_state_dict({"weight": rng.randn(V, E + 1).astype("float32")})
+    # a smaller vocab is NOT silently zero-padded (code-review r5): only
+    # the exact logical size pads on load
+    with _pytest.raises(ValueError, match="shape mismatch"):
+        emb.set_state_dict({"weight": rng.randn(5, E).astype("float32")})
+    # a larger array with a NONZERO tail is a real 136-vocab model, not
+    # another degree's pad — truncating it would discard real rows
+    big = rng.randn(136, E).astype("float32")
+    with _pytest.raises(ValueError, match="shape mismatch"):
+        emb.set_state_dict({"weight": big})
