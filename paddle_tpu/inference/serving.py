@@ -62,6 +62,12 @@ class _BatcherBase:
         self._stat_occupancy_sum = 0
         self._stat_completed = 0
         self._stat_preempted = 0
+        # cachekv-int8 saturation telemetry (ADVICE r4): entries written
+        # at exactly +/-127 by later prefill chunks, whose values the
+        # first-window scales clipped silently
+        self._stat_cachekv_elems = 0
+        self._stat_cachekv_clipped = 0
+        self._warned_cachekv_clip = False
         self._stat_t0 = _time.perf_counter()
 
     def stats(self) -> Dict[str, float]:
@@ -83,6 +89,8 @@ class _BatcherBase:
             "pending_now": len(self._pending),
             "active_now": len(self._slot_req),
             "elapsed_s": dt,
+            "cachekv_clip_rate": (self._stat_cachekv_clipped
+                                  / max(self._stat_cachekv_elems, 1)),
         }
 
     @staticmethod
@@ -614,6 +622,8 @@ class PagedContinuousBatcher(_BatcherBase):
         dec = 0
         logits = None
         scales = None
+        last_rest = None          # (dec, nvalid) of the last rest chunk
+        first_nvalid = 0          # valid rows in the scale-setting chunk
         while dec < padded_len:
             w = min(C, padded_len - dec)     # tail shortens at capacity
             has_last = 0 <= (L - 1) - dec < w
@@ -625,8 +635,9 @@ class PagedContinuousBatcher(_BatcherBase):
                 lg, self._state["layers"] = self._chunk_fn(
                     ids_t, self._state["layers"], bt_row, dec_t, at_t)
             elif scales is None:
+                first_nvalid = min(L - dec, w)
                 nvalid = paddle.to_tensor(
-                    np.array([min(L - dec, w)], np.int32))
+                    np.array([first_nvalid], np.int32))
                 lg, self._state["layers"], scales = \
                     self._chunk_dyn_first_fn(
                         ids_t, self._state["layers"], bt_row, dec_t,
@@ -635,14 +646,80 @@ class PagedContinuousBatcher(_BatcherBase):
                 lg, self._state["layers"] = self._chunk_dyn_rest_fn(
                     ids_t, self._state["layers"], bt_row, dec_t, at_t,
                     scales)
+                if L - dec > 0:
+                    last_rest = (dec, min(L - dec, w))
             if has_last:
                 # the final chunk always contains position L-1 (its start
                 # k*C < L by the ceil-padding construction)
                 logits = lg
             dec += w
         if scales is not None:
+            if last_rest is not None:
+                # sampled saturation telemetry: one baseline read of the
+                # scale-setting chunk, one read of the final rest chunk
+                base = self._topbin_counts(bt_row, 0, first_nvalid)
+                self._record_chunk_saturation(
+                    bt_row, last_rest[0], last_rest[1],
+                    baseline=None if base is None
+                    else base[0] / max(base[1], 1))
             self._store_slot_scales(slot, scales)
         return logits
+
+    def _topbin_counts(self, bt_row, dec, nvalid):
+        """(top_bin_entries, total_entries) over the int8 K/V rows at
+        positions [dec, dec+nvalid) of this slot, or None if the pool is
+        not quantized. |q| >= 127 is a PROXY: true saturation and
+        legitimately-in-range values within ~0.4% of amax both land in
+        the top bin, which is why the warning below is baseline-relative
+        rather than absolute."""
+        if nvalid <= 0:
+            return None
+        bt = np.asarray(getattr(bt_row, "_data", bt_row))[0]
+        pos = np.arange(dec, dec + nvalid)
+        phys = bt[pos // self.block_size]
+        off = pos % self.block_size
+        clipped = total = 0
+        for kc, vc in self._state["layers"]:
+            for pool in (kc, vc):
+                arr = np.asarray(getattr(pool, "_data", pool)[phys, :, off])
+                if arr.dtype != np.int8:
+                    return None
+                clipped += int((np.abs(arr.astype(np.int32)) >= 127).sum())
+                total += arr.size
+        return clipped, total
+
+    def _record_chunk_saturation(self, bt_row, dec, nvalid,
+                                 baseline=None):
+        """First-window telemetry (ADVICE r4, serving.py:605): later
+        chunks quantize with chunk-1 scales, so K/V values above the
+        stored amax saturate at +/-127 with no other trace. SAMPLED —
+        the chunk loop calls this once per prompt (its last rest chunk,
+        plus one baseline read of the scale-setting first chunk), so the
+        cost is two small device->host reads per prompt, not per chunk.
+        Warns ONCE when the rest-chunk top-bin rate exceeds
+        max(1%, 3 x the first chunk's own top-bin rate) — the first
+        chunk's rate is the legitimate near-amax baseline, so growth
+        beyond it indicates real saturation, not a peaked distribution."""
+        counts = self._topbin_counts(bt_row, dec, nvalid)
+        if counts is None:
+            return
+        clipped, total = counts
+        self._stat_cachekv_elems += total
+        self._stat_cachekv_clipped += clipped
+        rate = clipped / max(total, 1)
+        threshold = max(0.01, 3.0 * (baseline or 0.0))
+        if rate > threshold and not self._warned_cachekv_clip:
+            self._warned_cachekv_clip = True
+            import warnings
+            warnings.warn(
+                f"cachekv-int8 chunked prefill: {rate:.1%} of a later "
+                f"chunk's K/V entries sit in the top quantization bin "
+                f"(baseline {0.0 if baseline is None else baseline:.1%}) "
+                f"— values likely exceed the first-chunk scales "
+                f"(documented first-window semantics); long-prompt "
+                f"accuracy may degrade. stats()['cachekv_clip_rate'] "
+                f"tracks the sampled rate.",
+                RuntimeWarning, stacklevel=2)
 
     def _store_slot_scales(self, slot, seq_scales):
         """Copy a 1-sequence prefill's per-layer scale dicts into the

@@ -544,6 +544,53 @@ def _long_prompt_body():
     assert agree >= 0.6, (outs[rid][L:], ref[L:])
 
 
+def test_chunked_int8_clip_telemetry():
+    """ADVICE r4 (serving.py:605): later-chunk K/V saturation against
+    first-window scales must be observable — a running clip-rate counter
+    in stats() and a one-time RuntimeWarning above 1% saturation."""
+    import warnings
+    m = _llama_eval()
+    bs, C = 8, 8
+    b = PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=bs,
+                               cache_quant="dynamic_int8",
+                               prefill_chunk=C, compile=True)
+    # the counter exists and starts clean
+    assert b.stats()["cachekv_clip_rate"] == 0.0
+    # long prompt -> rest chunks run -> elements get counted
+    rng = np.random.RandomState(14)
+    rid = b.submit(rng.randint(0, 128, (19,)), 3)
+    b.run_until_done()
+    assert b._stat_cachekv_elems > 0
+    rate = b.stats()["cachekv_clip_rate"]
+    assert 0.0 <= rate <= 1.0
+    # plant a fully-saturated chunk and drive the recorder directly: the
+    # running rate must move and the warning must fire exactly once
+    kc, vc = b._state["layers"][0]
+    sat = kc._data.at[:].set(127)
+    kc._set_data(sat)
+    bt_row = paddle.to_tensor(np.arange(4, dtype=np.int32).reshape(1, 4))
+    before = b._stat_cachekv_clipped
+    b._warned_cachekv_clip = False
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        b._record_chunk_saturation(bt_row, dec=8, nvalid=8)
+        b._record_chunk_saturation(bt_row, dec=8, nvalid=8)
+    assert b._stat_cachekv_clipped > before
+    clip_warns = [w for w in caught
+                  if issubclass(w.category, RuntimeWarning)
+                  and "top quantization bin" in str(w.message)]
+    assert len(clip_warns) == 1, [str(w.message) for w in caught]
+    # baseline-relative threshold: a peaked-but-unclipped distribution
+    # (rest rate <= 3x the first chunk's own top-bin rate) must NOT warn
+    b._warned_cachekv_clip = False
+    with warnings.catch_warnings(record=True) as caught2:
+        warnings.simplefilter("always")
+        b._record_chunk_saturation(bt_row, dec=8, nvalid=8, baseline=0.9)
+    assert not [w for w in caught2
+                if issubclass(w.category, RuntimeWarning)
+                and "top quantization bin" in str(w.message)]
+
+
 def test_dynamic_int8_rejects_bad_configs():
     m = _llama_eval()
     with pytest.raises(ValueError, match="unknown cache_quant"):
