@@ -33,7 +33,6 @@ def _greedy_full_recompute(m, ids, n):
     return cur.tolist()
 
 
-@pytest.mark.quick
 def test_kv_cache_decode_matches_full_recompute():
     m, cfg = _tiny()
     ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 128, (2, 10)))
@@ -55,6 +54,7 @@ def test_compiled_decode_step_matches_eager():
     assert out == ref
 
 
+@pytest.mark.quick
 def test_prefill_cache_layout():
     m, cfg = _tiny()
     b, s, s_max = 2, 7, 16

@@ -34,7 +34,6 @@ def _greedy_full(m, ids, n):
     return cur.tolist()
 
 
-@pytest.mark.quick
 def test_llama_kv_decode_matches_full_recompute_gqa():
     m, cfg = _tiny()
     ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 128, (2, 10)))

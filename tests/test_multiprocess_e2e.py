@@ -48,7 +48,6 @@ def _run_launch(tmp_path, script, *args, launch_args=()):
     return proc, logs
 
 
-@pytest.mark.quick
 def test_two_rank_world(tmp_path):
     ckpt_dir = str(tmp_path / "ckpt")
     proc, logs = _run_launch(tmp_path, WORKER, ckpt_dir)
