@@ -44,6 +44,24 @@ def _steady_rate(step_fn, iters=32, warmup=4):
     return iters / dt
 
 
+SERVING_SNAPSHOT_PATH = os.path.join(_REPO_DIR, "SERVING_TPU_SNAPSHOT.json")
+
+
+def _last_serving_snapshot():
+    """Newest hardware serving record, or None. Only a record the heal
+    playbook persisted from a real chip (detail.tpu true + captured_at)
+    qualifies — a CPU line must never masquerade as hardware evidence."""
+    try:
+        with open(SERVING_SNAPSHOT_PATH) as f:
+            snap = json.load(f)
+    except (OSError, ValueError):
+        return None
+    det = snap.get("detail", {})
+    if det.get("tpu") is True and det.get("captured_at"):
+        return snap
+    return None
+
+
 def main():
     paddle.seed(0)
     on_tpu = False
@@ -193,6 +211,14 @@ def main():
     if on_tpu:
         detail["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                               time.gmtime())
+    else:
+        # CPU fallback carries the last hardware number (VERDICT r4 #8,
+        # mirroring bench.py's last_tpu pattern): a wedged-relay round
+        # still surfaces the newest real serving snapshot, honestly
+        # timestamped by its own captured_at.
+        snap = _last_serving_snapshot()
+        if snap is not None:
+            detail["last_tpu"] = snap
     # headline = the fused paged batcher, ALWAYS — taking a max would let a
     # fused-admission regression silently hide behind the plain batcher.
     # vs_baseline stays 0.0: the reference publishes no serving figure to

@@ -84,6 +84,35 @@ def test_snapshot_loader_rejects_non_tpu_files(tmp_path, monkeypatch):
     assert snap is not None and snap["detail"]["captured_at"]
 
 
+def test_serving_snapshot_loader_simulated_wedge(tmp_path, monkeypatch):
+    """VERDICT r4 #8: when bench_decode falls back to CPU (wedged relay),
+    its JSON must embed the last SERVING_TPU_SNAPSHOT.json — and the
+    loader must reject CPU lines, junk, and missing timestamps."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_decode_mod", os.path.join(REPO, "benchmarks",
+                                         "bench_decode.py"))
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+    fake = tmp_path / "serving_snap.json"
+    monkeypatch.setattr(bd, "SERVING_SNAPSHOT_PATH", str(fake))
+    # no file -> no snapshot
+    assert bd._last_serving_snapshot() is None
+    # CPU record must never masquerade as hardware evidence
+    fake.write_text(json.dumps({"value": 1.0, "detail": {"tpu": False}}))
+    assert bd._last_serving_snapshot() is None
+    # hardware record without a capture timestamp is not trustworthy
+    fake.write_text(json.dumps({"value": 2.0, "detail": {"tpu": True}}))
+    assert bd._last_serving_snapshot() is None
+    fake.write_text("not json")
+    assert bd._last_serving_snapshot() is None
+    good = {"metric": "paged_serving_decode_tokens_per_sec", "value": 3.5,
+            "detail": {"tpu": True, "captured_at": "2026-08-01T00:00:00Z"}}
+    fake.write_text(json.dumps(good))
+    snap = bd._last_serving_snapshot()
+    assert snap is not None and snap["value"] == 3.5
+
+
 def test_roofline_model_runs_and_is_compute_bound():
     """tools/roofline.py: the analysis pre-staged for VERDICT r3 #1's
     'where does the time go' deliverable. Pin the schema and the headline
