@@ -52,9 +52,11 @@ class LlamaConfig:
     # axis (sequence sharded; exact global attention via ICI ppermute)
     sep_mesh: Optional[object] = None
     sep_axis: str = "sep"
-    # sep_impl: "ring" (ppermute K/V rotation, any head count) or
+    # sep_impl: "ring" (ppermute K/V rotation, any head count),
     # "ulysses" (all-to-all heads<->sequence — needs heads divisible by
-    # the sep axis; one dense full-seq contraction per head subset)
+    # the sep axis; one dense full-seq contraction per head subset), or
+    # "auto" (ulysses when its shape contract holds, else ring —
+    # ops.ulysses_attention.choose_sep_impl)
     sep_impl: str = "ring"
     # activation recompute: re-run each decoder layer's forward in the
     # backward instead of keeping its residuals (fleet/recompute analog —
@@ -174,7 +176,17 @@ class LlamaAttention(Layer):
             # are sequence-sharded, each step slices the block's columns.
             # an explicit mask is the COMPLETE attention spec (callers bake
             # causality into it), matching the dense path's is_causal rule
-            if getattr(cfg, "sep_impl", "ring") == "ulysses":
+            impl = getattr(cfg, "sep_impl", "ring")
+            if impl == "auto":
+                from ..distributed.auto_parallel import ProcessMesh
+                from ..ops.ulysses_attention import choose_sep_impl
+                jm = (cfg.sep_mesh.jax_mesh
+                      if isinstance(cfg.sep_mesh, ProcessMesh)
+                      else cfg.sep_mesh)
+                impl = choose_sep_impl(
+                    jm, cfg.sep_axis, h, kv, int(q.shape[1]),
+                    attn_mask.shape[1] if attn_mask is not None else None)
+            if impl == "ulysses":
                 from ..ops.ulysses_attention import ulysses_attention
                 out = ulysses_attention(q, k, v, mesh=cfg.sep_mesh,
                                         axis_name=cfg.sep_axis,
@@ -493,7 +505,13 @@ class ScannedLlamaLayers(Layer):
             # matching the dense branch's `mask is None` causality rule.
             # Flags passed positionally to share lru_cache slots with the
             # public ring_attention() call sites.
-            if getattr(cfg, "sep_impl", "ring") == "ulysses":
+            sep_impl = getattr(cfg, "sep_impl", "ring")
+            if sep_impl == "auto":
+                from ..ops.ulysses_attention import choose_sep_impl
+                sep_impl = choose_sep_impl(
+                    jmesh, cfg.sep_axis, h, kv, seq,
+                    attn_mask.shape[1] if attn_mask is not None else None)
+            if sep_impl == "ulysses":
                 # all-to-all CP (heads<->sequence): wins when heads are
                 # plentiful (h, kv divisible by the sep axis) and a
                 # P-step ring's per-hop latency would dominate; heads

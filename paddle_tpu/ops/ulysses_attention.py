@@ -209,6 +209,25 @@ def ulysses_attention(query, key, value, mesh=None, axis_name: str = "sep",
     return dispatch(impl, tuple(args), {}, "ulysses_attention")
 
 
+def choose_sep_impl(jax_mesh, axis_name, h, kv, seq, mask_heads=None):
+    """``sep_impl="auto"`` resolution, ONE rule for every model: prefer
+    ulysses (each device runs one dense full-sequence contraction for
+    its head subset; two all-to-alls total) when its shape contract
+    holds — heads/seq divisible by the context axis, jointly with an mp
+    axis when one shards heads — else fall back to the ring (any head
+    count; P-step K/V rotation). Returns "ulysses" or "ring"."""
+    from .ring_attention import _MP_NAMES
+    head_axis = resolve_ulysses_head_axis(
+        jax_mesh, axis_name,
+        _pick_axis(jax_mesh.axis_names, _MP_NAMES, axis_name), h, kv)
+    try:
+        validate_ulysses(jax_mesh, axis_name, h, kv, seq, mask_heads,
+                         head_axis=head_axis)
+    except ValueError:
+        return "ring"
+    return "ulysses"
+
+
 def ulysses_attention_impl(mesh, axis_name: str = "sep", *,
                            causal: bool = True, batch_axis=None,
                            head_axis=None, has_mask: bool = False,
@@ -240,5 +259,6 @@ def ulysses_attention_impl(mesh, axis_name: str = "sep", *,
                         bool(has_seqlens), head_axis)
 
 
-__all__ = ["ulysses_attention", "ulysses_attention_impl",
-           "resolve_ulysses_head_axis", "validate_ulysses"]
+__all__ = ["choose_sep_impl", "resolve_ulysses_head_axis",
+           "ulysses_attention", "ulysses_attention_impl",
+           "validate_ulysses"]

@@ -667,6 +667,43 @@ def test_llama_with_ulysses_matches_dense(scan):
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("scan", [False, True])
+def test_llama_sep_impl_auto_selects_and_matches(scan):
+    """sep_impl='auto': ulysses when the shape contract holds (h=kv=8
+    over sep=8), ring when it cannot (kv=2 not divisible) — both paths
+    must run WITHOUT error and match the dense model."""
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    from paddle_tpu.ops.ulysses_attention import choose_sep_impl
+    rng = np.random.RandomState(37)
+    ids = rng.randint(0, 128, (2, 32))
+    for heads, kvh in ((8, 8), (8, 2)):
+        paddle.seed(0)
+        dense = LlamaForCausalLM(llama_tiny_config(
+            num_attention_heads=heads, num_key_value_heads=kvh,
+            scan_layers=scan))
+        with paddle.no_grad():
+            ref = dense(paddle.to_tensor(ids)).numpy()
+        paddle.seed(0)
+        cfg = llama_tiny_config(num_attention_heads=heads,
+                                num_key_value_heads=kvh, scan_layers=scan)
+        cfg.sep_mesh = ProcessMesh(np.arange(8), ["sep"])
+        cfg.sep_axis = "sep"
+        cfg.sep_impl = "auto"
+        m = LlamaForCausalLM(cfg)
+        with paddle.no_grad():
+            out = m(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+    # the chooser itself: divisible -> ulysses; ragged kv -> ring
+    jm = ProcessMesh(np.arange(8), ["sep"]).jax_mesh
+    assert choose_sep_impl(jm, "sep", 8, 8, 32) == "ulysses"
+    assert choose_sep_impl(jm, "sep", 8, 2, 32) == "ring"
+    # hybrid mesh: joint rule governs (h=8 over |mp|*|sep|=8 ok; seq
+    # indivisible by sep -> ring)
+    jm2 = ProcessMesh(np.arange(8).reshape(2, 4), ["mp", "sep"]).jax_mesh
+    assert choose_sep_impl(jm2, "sep", 8, 8, 32) == "ulysses"
+    assert choose_sep_impl(jm2, "sep", 8, 8, 30) == "ring"
+
+
 def test_llama_ulysses_ragged_heads_error_is_loud():
     """A config ulysses cannot serve (kv not divisible by the sep axis)
     must fail with the documented ValueError, not a shard_map shape
