@@ -346,8 +346,12 @@ def run_plan(n_devices=16, batch=16, seq=2048, execute=False,
         with open(OUT) as f:
             prev = json.load(f)
         report["variants"] = prev.get("variants", [])
-        if "scaled_execute" in prev:
-            report["scaled_execute"] = prev["scaled_execute"]
+        # evidence blocks owned by sibling tools must survive a re-plan
+        # (tools/slice_7b.py writes slice_7b; erasing it would let this
+        # tool's own test delete the measured per-layer record)
+        for carry in ("scaled_execute", "slice_7b"):
+            if carry in prev:
+                report[carry] = prev[carry]
     except (OSError, json.JSONDecodeError):
         pass
     wanted = variants or list(VARIANTS)

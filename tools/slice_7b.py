@@ -116,8 +116,13 @@ def _measure_execute(n_mesh, seq, steps):
     return recs
 
 
-def _measure_memory(n_devices, batch, seq):
-    """AOT-compile L=1/L=2 slices at the flagship config; per-chip live."""
+def _measure_memory(n_devices, batch, seq, ls=(2, 4, 8)):
+    """AOT-compile L-layer slices at the flagship config; per-chip live.
+
+    L=1 is deliberately excluded: XLA buffer assignment at trivial scan
+    depth is non-monotone (an L=1 scan schedules differently enough that
+    its live total can EXCEED L=2's — observed 5.14 vs 4.84 GiB), so the
+    linear-in-L fit uses L >= 2 where the per-layer slope is stable."""
     import numpy as np
     import jax
     from jax.sharding import Mesh
@@ -128,7 +133,7 @@ def _measure_memory(n_devices, batch, seq):
     mesh = Mesh(np.array(devs[:n_devices]), ("z",))
     recs = {}
     with mesh:
-        for L in (1, 2):
+        for L in ls:
             d = _slice_dims(L)
             rec = plan_7b._compile_variant(d, mesh, "s3", "full", batch, seq)
             recs[L] = {"L": L, "per_chip_live_gib": rec["per_chip_live_gib"],
@@ -138,24 +143,37 @@ def _measure_memory(n_devices, batch, seq):
     return recs
 
 
-def run(n_mesh, seq, steps, n_devices, batch, full_l=32):
-    ex = _measure_execute(n_mesh, seq, steps)
+def run(n_mesh, seq, steps, n_devices, batch, full_l=32,
+        skip_execute=False):
+    try:
+        with open(OUT) as f:
+            prev = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        prev = {}
+    ex = None
+    if skip_execute:
+        # reuse a prior run's executed records (the expensive leg) when
+        # only the AOT memory fit changed
+        prior = {r.get("L"): r
+                 for r in prev.get("slice_7b", {}).get("executed", [])}
+        if 1 in prior and 2 in prior:
+            ex = prior
+    if ex is None:
+        ex = _measure_execute(n_mesh, seq, steps)
     mem = _measure_memory(n_devices, batch, seq=2048)
 
     executed_ok = bool(ex[1]["ok"] and ex[2]["ok"])
     t1, t2 = ex[1]["t_step_s"], ex[2]["t_step_s"]
     t_layer = t2 - t1
     t_embed = t1 - t_layer
-    m1 = mem[1]["per_chip_live_gib"]
-    m2 = mem[2]["per_chip_live_gib"]
-    m_layer = m2 - m1
-    m_base = m1 - m_layer
+    # least-squares linear fit live(L) = m_base + L * m_layer over the
+    # compiled depths (L >= 2; see _measure_memory on why L=1 is out)
+    import numpy as _np
+    xs = _np.array(sorted(mem))
+    ys = _np.array([mem[L]["per_chip_live_gib"] for L in sorted(mem)])
+    m_layer, m_base = _np.polyfit(xs, ys, 1)
     extrap_mem = m_base + full_l * m_layer
 
-    try:
-        prev = json.load(open(OUT))
-    except (OSError, json.JSONDecodeError):
-        prev = {}
     full = next((v for v in prev.get("variants", [])
                  if v.get("name") == "s3_full"), None)
     recorded = full["per_chip_live_gib"] if full else None
@@ -171,12 +189,12 @@ def run(n_mesh, seq, steps, n_devices, batch, full_l=32):
         "embed_logits_residue_s": round(t_embed, 3),
         "extrapolated_32L_step_s": round(t_embed + full_l * t_layer, 2),
         "aot_memory_batch16_seq2048": list(mem.values()),
-        "per_layer_live_gib": round(m_layer, 4),
-        "base_live_gib": round(m_base, 4),
-        "extrapolated_32L_live_gib": round(extrap_mem, 3),
+        "per_layer_live_gib": round(float(m_layer), 4),
+        "base_live_gib": round(float(m_base), 4),
+        "extrapolated_32L_live_gib": round(float(extrap_mem), 3),
         "recorded_full_32L_live_gib": recorded,
         "linear_extrapolation_error_gib":
-            round(extrap_mem - recorded, 3) if recorded else None,
+            round(float(extrap_mem) - recorded, 3) if recorded else None,
     }
     if not executed_ok:
         # a diverged slice must not masquerade as clean extrapolation
@@ -205,6 +223,7 @@ def main():
     ap.add_argument("--mesh", type=int, default=8)
     ap.add_argument("--devices", type=int, default=16)
     ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--skip-execute", action="store_true")
     args = ap.parse_args()
 
     if not args.inproc:
@@ -218,9 +237,12 @@ def main():
                "--seq", str(args.seq), "--steps", str(args.steps),
                "--mesh", str(args.mesh), "--devices", str(args.devices),
                "--batch", str(args.batch)]
+        if args.skip_execute:
+            cmd.append("--skip-execute")
         return subprocess.run(cmd, env=env, cwd=REPO, timeout=3600).returncode
 
-    run(args.mesh, args.seq, args.steps, args.devices, args.batch)
+    run(args.mesh, args.seq, args.steps, args.devices, args.batch,
+        skip_execute=args.skip_execute)
     return 0
 
 
