@@ -68,6 +68,8 @@ class _BatcherBase:
         self._stat_cachekv_elems = 0
         self._stat_cachekv_clipped = 0
         self._warned_cachekv_clip = False
+        # K-step decode blocks dispatched (decode_block engines only)
+        self._stat_decode_blocks = 0
         self._stat_t0 = _time.perf_counter()
 
     def stats(self) -> Dict[str, float]:
@@ -91,6 +93,7 @@ class _BatcherBase:
             "elapsed_s": dt,
             "cachekv_clip_rate": (self._stat_cachekv_clipped
                                   / max(self._stat_cachekv_elems, 1)),
+            "decode_blocks": self._stat_decode_blocks,
         }
 
     @staticmethod
@@ -306,6 +309,15 @@ class PagedContinuousBatcher(_BatcherBase):
     reserved SCRATCH page (pool row n_pages) with dec_len 0, so their
     garbage decode writes land in scratch and never touch a live page.
 
+    decode_block=K (greedy only): pure-decode phases run K steps as ONE
+    compiled executable with on-device argmax feedback — one dispatch
+    and one K*B-token download per K tokens instead of K dispatches
+    each hauling [B, V] logits to the host. On a remote-relayed device
+    the per-dispatch latency dominates a small model's decode compute,
+    so this is the serving-throughput lever there. Token-exact vs the
+    per-step path; EOS/budget overshoot inside a block is discarded on
+    the host and its K/V rows land in the slot's own pages or scratch.
+
     policy:
       * ``"reserve"`` — admission reserves the worst-case page count
         (ceil((prompt+max_new)/bs)) up front; head-of-line blocks when
@@ -327,11 +339,21 @@ class PagedContinuousBatcher(_BatcherBase):
                  fused_admission: bool = False,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: Optional[float] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 decode_block: Optional[int] = None):
         import paddle_tpu as paddle
 
         if policy not in ("reserve", "ondemand"):
             raise ValueError(f"unknown policy {policy!r}")
+        if decode_block is not None:
+            if decode_block < 2:
+                raise ValueError("decode_block must be >= 2 (1 is the "
+                                 "plain per-step path)")
+            if do_sample:
+                # the in-block feedback is an on-device argmax; sampled
+                # selection stays on the host path
+                raise ValueError("decode_block requires greedy decoding "
+                                 "(do_sample=False)")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         if cache_quant not in (None, "dynamic_int8"):
@@ -444,6 +466,27 @@ class PagedContinuousBatcher(_BatcherBase):
                                           donate_args=(1,))
         else:
             self._step_fn = model.paged_decode_step
+        self.decode_block = decode_block
+        if decode_block:
+            # K decode steps unrolled into ONE executable with on-device
+            # greedy feedback: one dispatch (and one host round trip for
+            # K*B token ids instead of K full [B, V] logits downloads)
+            # per K tokens. Through a remote-relay device the per-call
+            # latency dominates the decode step's compute, so this is
+            # the serving-throughput lever for pure-decode phases.
+            def _block_body(tok, state, _K=decode_block, _m=model):
+                toks = []
+                for _ in range(_K):
+                    logits, state = _m.paged_decode_step(tok, state)
+                    tok = paddle.argmax(logits, axis=-1)
+                    toks.append(tok)
+                return paddle.stack(toks), state          # [K, B]
+            if compile:
+                from .. import jit
+                self._block_fn = jit.to_static(_block_body,
+                                               donate_args=(1,))
+            else:
+                self._block_fn = _block_body
         if prefill_chunk is not None:
             # one fixed-width append executable serves EVERY prompt
             # length (vLLM chunked prefill); without it each distinct
@@ -933,11 +976,86 @@ class PagedContinuousBatcher(_BatcherBase):
         import paddle_tpu as paddle
         if not self._slot_req:
             return
+        if self.decode_block and not self._pending \
+                and self._admitting is None \
+                and self._block_backed(self.decode_block):
+            self._decode_block_tail(finished)
+            return
         self._step_prologue()
         tok_t = paddle.to_tensor(self._last_tok)
         with paddle.no_grad():
             logits, self._state = self._step_fn(tok_t, self._state)
         self._advance_decoders(logits, finished)
+
+    def _block_backed(self, K: int) -> bool:
+        """A K-step block is safe when, for every active slot, the rows
+        it will KEEP are page-backed and dec+K stays inside the slot
+        window. Rows a slot writes past its remaining budget (it gets
+        evicted at max_new anyway) or past its backed pages land in the
+        SCRATCH page (unbacked block-table entries stay scratch), so
+        only the keep-rows need real pages. Growth here never preempts —
+        a dry pool falls back to the per-step path, whose preemption
+        logic stays the single source of that policy. Feasibility is
+        probed for ALL slots before ANY page moves: a declined block
+        must not leave earlier slots hoarding pages they will not use
+        for K more steps (that would push the per-step path into
+        preemptions the probe itself caused)."""
+        cap = self.blocks_per_seq * self.block_size
+        plan = []                      # (slot, upto) to allocate on pass
+        need = 0
+        for slot in list(self._admit_order):
+            req = self._slot_req.get(slot)
+            if req is None:
+                continue
+            if int(self._dec[slot]) + K > cap:
+                return False
+            keep = min(K, req.max_new_tokens - len(req.tokens))
+            if keep <= 0:
+                continue
+            upto = int(self._dec[slot]) + keep
+            have = int(np.sum(self._bt[slot] != self._scratch))
+            need += max(0, self._pages_for(upto) - have)
+            plan.append((slot, upto))
+        if self.policy != "ondemand":
+            return True                # reserve backed everything upfront
+        if need > len(self._free_pages):
+            return False
+        for slot, upto in plan:
+            if not self._alloc_pages(slot, upto):   # pragma: no cover
+                raise RuntimeError("page accounting bug: block probe "
+                                   "passed but allocation failed")
+        return True
+
+    def _decode_block_tail(self, finished: List[int]):
+        """Run one compiled K-step decode block and consume its K*B
+        tokens on the host: per sub-step, append to each still-live
+        request, finishing/evicting exactly as the per-step path would.
+        A slot that finishes mid-block decoded garbage for the remaining
+        sub-steps — those tokens are discarded here, and their K/V rows
+        went to its own (about-to-be-freed) pages or scratch."""
+        import paddle_tpu as paddle
+        K = self.decode_block
+        self._stat_steps += K
+        self._stat_decode_blocks += 1
+        self._sync_tables()
+        tok_t = paddle.to_tensor(self._last_tok)
+        with paddle.no_grad():
+            toks, self._state = self._block_fn(tok_t, self._state)
+        toks_np = np.asarray(toks._data)                  # [K, B]
+        # survivors consumed all K rows; evicted slots' counters are
+        # reset at their next admission
+        self._dec += K * np.asarray(self._slot_active_mask(), np.int32)
+        for k in range(K):
+            # occupancy at each sub-step's ENTRY (post prior evictions),
+            # matching the per-step path's _step_prologue accounting
+            self._stat_occupancy_sum += len(self._slot_req)
+            for slot, req in list(self._slot_req.items()):
+                tok = int(toks_np[k, slot])
+                req.tokens.append(tok)
+                self._stat_tokens += 1
+                self._last_tok[slot] = tok
+                if self._maybe_finish(req, tok):
+                    finished.append(req.rid)
 
     # -- the engine ---------------------------------------------------------
     def step(self) -> List[int]:

@@ -471,3 +471,147 @@ def test_fused_admission_capacity_divisibility_guard():
         PagedContinuousBatcher(m, max_batch=2, s_max=40, block_size=8,
                                prefill_chunk=12, fused_admission=True,
                                compile=False)
+
+
+# -- multi-step decode blocks (decode_block=K) -------------------------------
+
+def test_decode_block_token_exact_vs_single_step():
+    """decode_block=K runs K decode steps in ONE executable with
+    on-device greedy feedback; tokens must equal the per-step engine's
+    exactly — including an EOS finish and a budget (< K) truncation
+    mid-block."""
+    _retry_load_flake(_decode_block_body, attempts=3)
+
+
+def _decode_block_body():
+    m = _model()
+    rng = np.random.RandomState(40)
+    prompts = [rng.randint(0, 128, (n,)) for n in (7, 12, 5)]
+    budgets = [9, 3, 14]               # 3 < K exercises truncation
+    kw = dict(max_batch=4, s_max=32, block_size=8, compile=True)
+
+    ref = PagedContinuousBatcher(m, **kw)
+    rids = [ref.submit(p, n) for p, n in zip(prompts, budgets)]
+    expected = ref.run_until_done()
+
+    blk = PagedContinuousBatcher(m, decode_block=4, **kw)
+    rids2 = [blk.submit(p, n) for p, n in zip(prompts, budgets)]
+    outs = blk.run_until_done()
+    for r1, r2 in zip(rids, rids2):
+        np.testing.assert_array_equal(outs[r2], expected[r1])
+    # the block path actually ran (a fallback-only run would also be
+    # token-exact, which must not mask a dead feature)
+    assert blk.stats()["decode_blocks"] > 0
+    assert blk.stats()["generated_tokens"] == sum(budgets)
+    assert blk.free_page_count == blk.n_pages
+
+
+def test_decode_block_eos_mid_block():
+    """A request hitting EOS inside a K-block is finished at the EOS
+    position; the block's overshoot tokens are discarded."""
+    _retry_load_flake(_decode_block_eos_body, attempts=3)
+
+
+def _decode_block_eos_body():
+    m = _model()
+    rng = np.random.RandomState(41)
+    p = rng.randint(0, 128, (9,))
+    ref = PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                                 eos_id=None, compile=True)
+    r = ref.submit(p, 12)
+    full = ref.run_until_done()[r]
+    gen = full[len(p):]
+    # pick the 3rd generated token as a forced EOS: it lands mid-block
+    eos = int(gen[2])
+    want = full[:len(p) + 3]
+
+    blk = PagedContinuousBatcher(m, max_batch=2, s_max=32, block_size=8,
+                                 eos_id=eos, decode_block=4, compile=True)
+    r2 = blk.submit(p, 12)
+    out = blk.run_until_done()[r2]
+    np.testing.assert_array_equal(out, want)
+    assert blk.stats()["decode_blocks"] > 0
+
+
+def test_decode_block_ondemand_pool_pressure_falls_back():
+    """With a pool too small to back a whole K-block, _block_backed
+    declines (never preempts) and the per-step path serves the work —
+    exactness holds either way."""
+    _retry_load_flake(_decode_block_pressure_body, attempts=3)
+
+
+def _decode_block_pressure_body():
+    m = _model()
+    rng = np.random.RandomState(42)
+    p0 = rng.randint(0, 128, (9,))
+    p1 = rng.randint(0, 128, (9,))
+    b = PagedContinuousBatcher(m, max_batch=2, s_max=24, block_size=4,
+                               n_pages=7, policy="ondemand",
+                               decode_block=8, compile=True)
+    r0 = b.submit(p0, 8)
+    r1 = b.submit(p1, 8)
+    outs = b.run_until_done(max_steps=400)
+    np.testing.assert_array_equal(outs[r0], _ref(m, p0, 8))
+    np.testing.assert_array_equal(outs[r1], _ref(m, p1, 8))
+    assert b.free_page_count == b.n_pages
+
+
+def test_decode_block_guards():
+    m = _model()
+    with pytest.raises(ValueError, match="decode_block must be >= 2"):
+        PagedContinuousBatcher(m, decode_block=1, compile=False)
+    with pytest.raises(ValueError, match="greedy"):
+        PagedContinuousBatcher(m, decode_block=4, do_sample=True,
+                               compile=False)
+
+
+def test_decode_block_composes_with_fused_admission():
+    """fused_admission drains admissions through the fused executable;
+    once the queue is empty its idle steps flow through _decode_tail,
+    where the K-block takes over. Tokens must match the non-block fused
+    engine."""
+    _retry_load_flake(_decode_block_fused_body, attempts=3)
+
+
+def _decode_block_fused_body():
+    m = _model()
+    rng = np.random.RandomState(43)
+    prompts = [rng.randint(0, 128, (n,)) for n in (9, 14)]
+    kw = dict(max_batch=2, s_max=32, block_size=8, prefill_chunk=8,
+              fused_admission=True, compile=True)
+    ref = PagedContinuousBatcher(m, **kw)
+    rids = [ref.submit(p, 10) for p in prompts]
+    expected = ref.run_until_done()
+    blk = PagedContinuousBatcher(m, decode_block=4, **kw)
+    rids2 = [blk.submit(p, 10) for p in prompts]
+    outs = blk.run_until_done()
+    for r1, r2 in zip(rids, rids2):
+        np.testing.assert_array_equal(outs[r2], expected[r1])
+    assert blk.stats()["decode_blocks"] > 0
+
+
+def test_decode_block_llama_family():
+    """The K-block executable is model-agnostic: the Llama paged decode
+    step (GQA + RoPE through the block cache) must be token-exact under
+    decode_block too — this is the composition the TPU tier runs on
+    hardware (test_tpu_tier.py::test_fused_serving_on_tpu)."""
+    _retry_load_flake(_decode_block_llama_body, attempts=3)
+
+
+def _decode_block_llama_body():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny_config())
+    m.eval()
+    rng = np.random.RandomState(44)
+    prompts = [rng.randint(0, 128, (n,)) for n in (9, 13)]
+    kw = dict(max_batch=2, s_max=32, block_size=8, compile=True)
+    ref = PagedContinuousBatcher(m, **kw)
+    rids = [ref.submit(p, 8) for p in prompts]
+    expected = ref.run_until_done()
+    blk = PagedContinuousBatcher(m, decode_block=4, **kw)
+    rids2 = [blk.submit(p, 8) for p in prompts]
+    outs = blk.run_until_done()
+    for r1, r2 in zip(rids, rids2):
+        np.testing.assert_array_equal(outs[r2], expected[r1])
+    assert blk.stats()["decode_blocks"] > 0
