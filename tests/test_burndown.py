@@ -85,3 +85,34 @@ def test_interpret_run_and_abort_on_wedge(tmp_path):
     assert rec["last_run"]["result"] == "aborted_after=adamw"
     assert rec["units"]["adamw"]["wedged_relay"] is True
     assert "block_sparse" not in rec["units"]
+
+
+def test_heal_playbook_references_exist():
+    """Every python entry the heal playbook invokes must exist — a
+    dangling reference would burn the round's only hardware window on a
+    file-not-found. Also pin the stage order contract: bench first,
+    measured peaks + roofline before the burndown tiers, risky last."""
+    import re
+    lines = [ln for ln in
+             open(os.path.join(REPO, ".on_heal_playbook.sh"))
+             if not ln.lstrip().startswith("#")]   # comments don't run
+    order = []
+    for ln in lines:
+        # any interpreter invocation counts; a path the file-exists
+        # check can't see (unmatchable chars) must FAIL, not be skipped
+        for m in re.finditer(r"python3?\s+(\S+\.py)", ln):
+            path = m.group(1)
+            assert re.fullmatch(r"[A-Za-z0-9_/.-]+", path), \
+                f"unparseable playbook entry: {path!r}"
+            order.append(path)
+    assert order, "playbook parses no python entries?"
+    for path in order:
+        assert os.path.exists(os.path.join(REPO, path)), path
+    assert order.index("bench.py") < order.index("tools/measure_peaks.py")
+    assert order.index("tools/measure_peaks.py") \
+        < order.index("tools/roofline.py")
+    # burndown runs twice (safe then risky), after the roofline re-emit
+    burn = [i for i, r in enumerate(order) if r == "tools/tpu_burndown.py"]
+    assert len(burn) == 2
+    assert order.index("tools/roofline.py") < burn[0]
+    assert order.index("benchmarks/bench_decode.py") < burn[1]

@@ -69,6 +69,25 @@ def measure_matmul(iters, sizes=(2048, 4096, 6144, 8192)):
     return results
 
 
+def measure_dispatch(iters):
+    """Median wall time of a trivially-small jitted op, i.e. the
+    per-dispatch overhead (through the axon relay this is network
+    round-trip latency). This is the cost the serving engine's
+    decode_block=K amortizes: with per-token dispatch the ceiling is
+    1/dispatch_latency tokens/s/slot regardless of model size."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((8, 8), jnp.float32)
+
+    @jax.jit
+    def tiny(x):
+        return x + 1.0
+
+    t = _time_fn(tiny, x, iters=max(iters, 10))
+    return {"t_ms": round(t * 1e3, 3)}
+
+
 def measure_stream(iters, mib):
     import jax
     import jax.numpy as jnp
@@ -104,12 +123,14 @@ def main(argv=None):
 
     mm = measure_matmul(args.iters, sizes)
     st = measure_stream(args.iters, args.stream_mib)
+    disp = measure_dispatch(args.iters)
     rec = {
         "tpu": on_tpu,
         "device": str(getattr(dev, "device_kind", dev.platform)),
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "matmul_tflops": max(r["tflops"] for r in mm),
         "hbm_gbps": st["gbps"],
+        "dispatch_ms": disp["t_ms"],
         "matmul_sweep": mm,
         "stream": st,
     }
@@ -119,7 +140,8 @@ def main(argv=None):
         f.write("\n")
     os.replace(tmp, OUT)
     print(json.dumps({k: rec[k] for k in
-                      ("tpu", "device", "matmul_tflops", "hbm_gbps")}))
+                      ("tpu", "device", "matmul_tflops", "hbm_gbps",
+                       "dispatch_ms")}))
     return 0
 
 
