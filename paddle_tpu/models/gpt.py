@@ -285,7 +285,7 @@ class GPT2ForCausalLM(Layer):
     def paged_prefill_into(self, input_ids, layers, block_tables,
                            block_size=64, dec_base=None, logits_at=None,
                            dynamic_cache_scales=False, cache_scales=None,
-                           dynamic_scale_valid=None):
+                           dynamic_scale_valid=None, logits_all=False):
         """Prompt pass writing KV into a CALLER-OWNED page pool.
 
         input_ids [B, s]; layers: ``paged_alloc`` pool; block_tables
@@ -374,15 +374,19 @@ class GPT2ForCausalLM(Layer):
             layers_state.append((kc, vc))
         hidden = self.transformer.ln_f(hidden)
         h3 = hidden.reshape([b, s, -1])
-        if logits_at is not None:
+        if logits_all:
+            # speculative verify: the target scores EVERY appended
+            # position in one pass (s = draft_k + 1, so the full lm
+            # head over s positions is the point, not a waste)
+            logits = self._logits(h3)                    # [b, s, V]
+        elif logits_at is not None:
             # chunked prefill: project ONLY the requested position (the
             # lm head over all C positions would be C x the needed FLOPs)
             oh = F.one_hot(logits_at.reshape([b]).astype("int64"),
                            s).astype(h3.dtype)
-            last = paddle.einsum("bs,bse->be", oh, h3)
+            logits = self._logits(paddle.einsum("bs,bse->be", oh, h3))
         else:
-            last = h3[:, s - 1]          # last token of each sequence
-        logits = self._logits(last)
+            logits = self._logits(h3[:, s - 1])
         if dynamic_cache_scales:
             return logits, layers_state, scales_out
         return logits, layers_state
@@ -457,6 +461,178 @@ class GPT2ForCausalLM(Layer):
             logits, state = step(tok.astype(input_ids.dtype), state)
             tok = ops.argmax(logits, axis=-1).reshape([b])
         return ops.concat([x.astype("int64") for x in toks], axis=1)
+
+    @staticmethod
+    def _speculative_loop(target, draft, input_ids, max_new_tokens,
+                          draft_k, block_size, eos_id, compile,
+                          return_stats):
+        """Greedy speculative decoding over the paged cache (beyond the
+        reference, which has no in-tree speculative decoding; the serving
+        analog is the draft/verify split in modern engines).
+
+        The cheap DRAFT model proposes ``draft_k`` tokens autoregressively;
+        the TARGET scores all proposals in ONE forward (paged_prefill_into
+        with logits_all=True) and accepts the longest prefix matching its
+        own greedy choices, plus its correction token — so each target
+        dispatch yields 1..draft_k+1 tokens, and the output is EXACTLY the
+        target's greedy sequence. Rollback after a rejection is free by
+        construction: the host owns ``dec_lens``, bounded attention never
+        reads rows past it, and stale rows are overwritten on the next
+        append. Works across families (any draft/target pair sharing a
+        vocab — both implement the shared paged-state convention)."""
+        import paddle_tpu as paddle
+        from .. import ops
+
+        if input_ids.shape[0] != 1:
+            raise ValueError("speculative decoding is single-sequence "
+                             "(batch it at the serving layer)")
+        if draft_k < 1:
+            raise ValueError("draft_k must be >= 1")
+        if draft.config.vocab_size != target.config.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft.config.vocab_size} != target vocab "
+                f"{target.config.vocab_size}")
+        _, L = input_ids.shape
+        if max_new_tokens <= 0:
+            # generate(ids, 0) returns the prompt unchanged — match it
+            out = paddle.to_tensor(
+                np.asarray(input_ids._data).astype(np.int64))
+            if not return_stats:
+                return out
+            return out, {"rounds": 0, "proposed": 0, "matched": 0,
+                         "acceptance_rate": 0.0,
+                         "tokens_per_target_dispatch": 0.0}
+        needed = L + max_new_tokens
+        for m, who in ((target, "target"), (draft, "draft")):
+            if needed > m.config.max_position_embeddings:
+                raise ValueError(
+                    f"prompt {L} + {max_new_tokens} exceeds the {who}'s "
+                    f"max_position_embeddings="
+                    f"{m.config.max_position_embeddings}")
+        bps = (needed + block_size - 1) // block_size
+
+        with paddle.no_grad():
+            t_logits, t_state = target.paged_prefill(input_ids, block_size,
+                                                     bps)
+            d_logits, d_state = draft.paged_prefill(input_ids, block_size,
+                                                    bps)
+        def _verify_body(ids, layers, bt, dec):
+            return target.paged_prefill_into(
+                ids, layers, bt, block_size, dec_base=dec,
+                logits_all=True)
+
+        def _catchup_body(ids, layers, bt, dec, at):
+            # variable-length draft append (1 token after a rejection, 2
+            # after a fully-accepted round — see d_rows below); returns
+            # the LAST position's logits, i.e. the first proposal
+            return draft.paged_prefill_into(
+                ids, layers, bt, block_size, dec_base=dec, logits_at=at)
+
+        if compile:
+            from .. import jit
+            t_step = jit.to_static(target.paged_decode_step,
+                                   donate_args=(1,))
+            d_step = jit.to_static(draft.paged_decode_step,
+                                   donate_args=(1,))
+            verify = jit.to_static(_verify_body, donate_args=(1,))
+            catchup = jit.to_static(_catchup_body, donate_args=(1,))
+        else:
+            t_step, d_step = target.paged_decode_step, draft.paged_decode_step
+            verify, catchup = _verify_body, _catchup_body
+
+        # invariants: the TARGET cache holds rows for prompt +
+        # accepted[:-1] (``accepted[-1]`` is pending, the next input);
+        # the DRAFT cache holds correct rows for the first ``d_rows``
+        # positions of prompt + accepted — after a fully-accepted round
+        # it runs one short (the last proposal was never fed back), so
+        # each round starts by appending accepted[d_rows - L:] to the
+        # draft (1 token after a rejection, 2 after a full accept),
+        # whose last-position logits ARE the first proposal.
+        accepted = [int(np.asarray(t_logits._data)[0].argmax())]
+        d_rows = L
+        rounds = proposed = matched = 0
+        with paddle.no_grad():
+            while True:
+                if eos_id is not None and eos_id in accepted:
+                    accepted = accepted[:accepted.index(eos_id) + 1]
+                    break
+                remaining = max_new_tokens - len(accepted)
+                if remaining <= 0:
+                    break
+                valid = L + len(accepted) - 1
+                k = min(draft_k, remaining - 1)
+                if k == 0:
+                    # budget for exactly one more: plain target step
+                    t_state["dec_lens"] = paddle.to_tensor(
+                        np.array([valid], np.int32))
+                    lg, t_state = t_step(paddle.to_tensor(
+                        np.array([accepted[-1]], np.int64)), t_state)
+                    accepted.append(int(np.asarray(lg._data)[0].argmax()))
+                    continue
+                # draft catch-up append ending at pending -> proposal 1
+                cu = accepted[d_rows - L:]
+                dl, d_state["layers"] = catchup(
+                    paddle.to_tensor(np.array([cu], np.int64)),
+                    d_state["layers"], d_state["block_tables"],
+                    paddle.to_tensor(np.array([d_rows], np.int32)),
+                    paddle.to_tensor(np.array([len(cu) - 1], np.int32)))
+                d_rows += len(cu)
+                tok = int(np.asarray(dl._data)[0].argmax())
+                props = [tok]
+                # k-1 single draft steps propose the rest
+                d_state["dec_lens"] = paddle.to_tensor(
+                    np.array([d_rows], np.int32))
+                for _ in range(k - 1):
+                    dl, d_state = d_step(paddle.to_tensor(
+                        np.array([tok], np.int64)), d_state)
+                    tok = int(np.asarray(dl._data)[0].argmax())
+                    props.append(tok)
+                d_rows += k - 1              # rows for props[:k-1] inputs
+                # target scores pending + all k proposals in one pass
+                ids_v = paddle.to_tensor(
+                    np.array([[accepted[-1]] + props], np.int64))
+                vlogits, t_state["layers"] = verify(
+                    ids_v, t_state["layers"], t_state["block_tables"],
+                    paddle.to_tensor(np.array([valid], np.int32)))
+                g = np.asarray(vlogits._data)[0].argmax(-1)   # [k+1]
+                j = 0
+                while j < k and props[j] == int(g[j]):
+                    j += 1
+                accepted += props[:j] + [int(g[j])]
+                rounds += 1
+                proposed += k
+                matched += j
+                # draft rows correct through prompt + accepted[:-1] at
+                # most (rejected proposals' rows are stale); a full
+                # accept leaves it one short of even that
+                d_rows = min(d_rows, L + len(accepted) - 1)
+        if eos_id is not None and eos_id in accepted:
+            accepted = accepted[:accepted.index(eos_id) + 1]
+        out = paddle.to_tensor(np.concatenate(
+            [np.asarray(input_ids._data).reshape(-1),
+             np.asarray(accepted, np.int64)])[None])
+        if not return_stats:
+            return out
+        return out, {
+            "rounds": rounds, "proposed": proposed, "matched": matched,
+            "acceptance_rate": matched / max(proposed, 1),
+            "tokens_per_target_dispatch":
+                len(accepted) / max(rounds, 1) if rounds else 1.0,
+        }
+
+    def generate_paged_speculative(self, input_ids, max_new_tokens,
+                                   draft_model, draft_k=4, block_size=64,
+                                   eos_id=None, compile=True,
+                                   return_stats=False):
+        """Greedy speculative decoding: ``draft_model`` proposes
+        ``draft_k`` tokens per round, this model verifies them in one
+        forward — token-exact vs ``generate``/``generate_paged`` while
+        spending 1 target dispatch per 1..draft_k+1 accepted tokens (the
+        dispatch-latency lever, complementary to decode_block which
+        amortizes dispatches without a draft). See _speculative_loop."""
+        return self._speculative_loop(self, draft_model, input_ids,
+                                      max_new_tokens, draft_k, block_size,
+                                      eos_id, compile, return_stats)
 
     def paged_prefill(self, input_ids, block_size=64, blocks_per_seq=None):
         """Prompt pass through the paged block cache

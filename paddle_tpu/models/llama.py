@@ -948,7 +948,7 @@ class LlamaForCausalLM(Layer):
     def paged_prefill_into(self, input_ids, layers, block_tables,
                            block_size=64, dec_base=None, logits_at=None,
                            dynamic_cache_scales=False, cache_scales=None,
-                           dynamic_scale_valid=None):
+                           dynamic_scale_valid=None, logits_all=False):
         """Prompt pass writing post-RoPE K / raw V into a CALLER-OWNED page
         pool (block_gqa_attention in encoder mode). input_ids [B, s];
         block_tables [B, blocks_per_seq]. Returns (last_logits [B, V],
@@ -1024,15 +1024,19 @@ class LlamaForCausalLM(Layer):
                 layer.post_attention_layernorm(hidden))
             layers_state.append((kc, vc))
         hidden = model.norm(hidden)
-        if logits_at is not None:
+        if logits_all:
+            # speculative verify: score every appended position in one
+            # pass (s = draft_k + 1)
+            logits = self._lm_logits(hidden)             # [b, s, V]
+        elif logits_at is not None:
             # chunked prefill: project ONLY the requested position (the
             # lm head over all C positions would be C x the needed FLOPs)
             oh = F.one_hot(logits_at.reshape([b]).astype("int64"),
                            s).astype(hidden.dtype)
-            last = paddle.einsum("bs,bse->be", oh, hidden)
+            logits = self._lm_logits(paddle.einsum("bs,bse->be", oh,
+                                                   hidden))
         else:
-            last = hidden[:, s - 1]
-        logits = self._lm_logits(last)
+            logits = self._lm_logits(hidden[:, s - 1])
         if dynamic_cache_scales:
             return logits, layers_state, scales_out
         return logits, layers_state
@@ -1189,6 +1193,19 @@ class LlamaForCausalLM(Layer):
         return GPT2ForCausalLM._paged_generate_loop(
             self, input_ids, max_new_tokens, block_size, blocks_per_seq,
             decode_fn)
+
+    def generate_paged_speculative(self, input_ids, max_new_tokens,
+                                   draft_model, draft_k=4, block_size=64,
+                                   eos_id=None, compile=True,
+                                   return_stats=False):
+        """Greedy speculative decoding (shared loop with GPT-2): any
+        draft sharing this model's vocab works — including a GPT-2-family
+        draft for a Llama target, since both speak the shared paged-state
+        convention. Token-exact vs generate()/generate_paged()."""
+        from .gpt import GPT2ForCausalLM
+        return GPT2ForCausalLM._speculative_loop(
+            self, draft_model, input_ids, max_new_tokens, draft_k,
+            block_size, eos_id, compile, return_stats)
 
     def generate_beam(self, input_ids, max_new_tokens, num_beams=4,
                       s_max=None, decode_fn=None, length_penalty=0.0):
