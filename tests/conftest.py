@@ -155,12 +155,13 @@ _SMOKE_EXCLUDE = {
 
 
 # -- strict exactness lane (VERDICT r4 #5): the token-exact serving/
-# paged/quant suites, run with PADDLE_EXACT_STRICT=1 so the CPU load-
-# flake retry is OFF and exactness must hold first-try:
+# paged/quant/speculative suites, run with PADDLE_EXACT_STRICT=1 so the
+# CPU load-flake retry is OFF and exactness must hold first-try:
 #   PADDLE_EXACT_STRICT=1 python -m pytest -m exact -q
 _EXACT_FILES = {
     "test_paged_batching.py",
     "test_quant_serving.py",
+    "test_speculative.py",
 }
 
 
