@@ -172,9 +172,12 @@ def main(scan_layers=True, size="large"):
     float(loss)
     _progress("compiled; timing")
 
+    from paddle_tpu.observability import span
+
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss = step(ids, labels)
+        with span("bench_train_step"):
+            loss = step(ids, labels)
     final_loss = float(loss)  # blocks on the device
     elapsed = time.perf_counter() - t0
 
@@ -207,6 +210,17 @@ def main(scan_layers=True, size="large"):
     if on_tpu:
         detail["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                               time.gmtime())
+    # telemetry snapshot rides alongside (stderr + file only — stdout is
+    # the one-JSON-line contract)
+    try:
+        from paddle_tpu.observability import load_jsonl, write_jsonl
+        snap_path = os.path.join(_REPO_DIR, "BENCH_TELEMETRY.jsonl")
+        write_jsonl(snap_path, extra={"bench": "llama", "tpu": on_tpu})
+        detail["telemetry_series"] = len(load_jsonl(snap_path))
+        _progress(f"telemetry snapshot: {snap_path} "
+                  f"({detail['telemetry_series']} series)")
+    except Exception as e:  # telemetry must never sink the bench number
+        _progress(f"telemetry snapshot failed: {type(e).__name__}: {e}")
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),

@@ -56,6 +56,7 @@ from . import metric
 from . import nn
 from . import optimizer
 from . import profiler
+from . import observability
 from . import geometric
 from . import hub
 from . import inference

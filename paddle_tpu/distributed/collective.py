@@ -232,20 +232,55 @@ def destroy_process_group(group=None):
 
 
 
+_COLL_METRICS = [None]  # lazy (calls, bytes, seconds) families
+
+
+def _coll_metrics():
+    fams = _COLL_METRICS[0]
+    if fams is None:
+        from ..observability.metrics import get_registry
+        reg = get_registry()
+        fams = (
+            reg.counter("collective_calls_total",
+                        "collective invocations by op", labelnames=("op",)),
+            reg.counter("collective_bytes_total",
+                        "tensor payload bytes entering collectives by op",
+                        labelnames=("op",)),
+            reg.histogram("collective_seconds",
+                          "collective wall time by op (host-side, includes "
+                          "dispatch + any blocking)", labelnames=("op",)),
+        )
+        _COLL_METRICS[0] = fams
+    return fams
+
+
 def _watched(name):
-    """Wrap a collective entry point with the desync watchdog (no-op —
-    one attribute read — unless enable_collective_watchdog armed it)."""
+    """Wrap a collective entry point with telemetry (per-op call/bytes
+    counters + latency histogram, always on) and the desync watchdog
+    (no-op — one attribute read — unless enable_collective_watchdog
+    armed it)."""
     import functools
+    import time as _time
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            from . import watchdog as _wd
-            if _wd.get_watchdog() is None:
-                return fn(*args, **kwargs)
+            calls, bytes_c, seconds = _coll_metrics()
+            calls.labels(op=name).inc()
             t = next((a for a in args if hasattr(a, "shape")), None)
-            with _wd.watch(name, t):
-                return fn(*args, **kwargs)
+            if t is not None:
+                nb = getattr(getattr(t, "_data", t), "nbytes", 0)
+                if nb:
+                    bytes_c.labels(op=name).inc(int(nb))
+            t0 = _time.perf_counter()
+            try:
+                from . import watchdog as _wd
+                if _wd.get_watchdog() is None:
+                    return fn(*args, **kwargs)
+                with _wd.watch(name, t):
+                    return fn(*args, **kwargs)
+            finally:
+                seconds.labels(op=name).observe(_time.perf_counter() - t0)
         return wrapper
     return deco
 

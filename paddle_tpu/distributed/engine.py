@@ -354,22 +354,33 @@ class DistModel:
         return batch[:-1], batch[-1:]
 
     def __call__(self, *args):
+        import time as _time
+        from ..observability.metrics import get_registry
         args = tuple(a if isinstance(a, Tensor) else Tensor(np.asarray(a))
                      for a in args)
-        if self._mode == "train":
-            if self._acc_steps > 1:
-                # gradient-merge: accumulate locally, step every k batches.
-                # (reference: gradient_merge pass wrapping the update in a
-                # conditional block — here the eager tape accumulates and
-                # the optimizer steps on the boundary)
-                loss = self._train_micro(args)
-                return loss
-            return self._train_step(*args)
-        if self._mode == "eval":
-            return self._eval_fn(*args)
-        if self._mode == "predict":
+        if self._mode not in ("train", "eval", "predict"):
+            raise RuntimeError("mode not set; call train()/eval()/predict()")
+        reg = get_registry()
+        reg.counter("dist_steps_total", "DistModel steps by mode",
+                    labelnames=("mode",)).labels(mode=self._mode).inc()
+        t0 = _time.perf_counter()
+        try:
+            if self._mode == "train":
+                if self._acc_steps > 1:
+                    # gradient-merge: accumulate locally, step every k
+                    # batches. (reference: gradient_merge pass wrapping the
+                    # update in a conditional block — here the eager tape
+                    # accumulates and the optimizer steps on the boundary)
+                    return self._train_micro(args)
+                return self._train_step(*args)
+            if self._mode == "eval":
+                return self._eval_fn(*args)
             return self._predict_fn(*args)
-        raise RuntimeError("mode not set; call train()/eval()/predict()")
+        finally:
+            reg.histogram(
+                "dist_step_seconds", "DistModel step wall time by mode",
+                labelnames=("mode",)).labels(
+                    mode=self._mode).observe(_time.perf_counter() - t0)
 
     def _train_micro(self, args):
         import contextlib

@@ -84,8 +84,10 @@ class Model:
 
     def train_batch(self, inputs, labels=None, update=True):
         """model.py train_batch analog: one eager forward/backward/(step)."""
+        import time as _time
         assert self._prepared, "call prepare() first"
         self.network.train()
+        t0 = _time.perf_counter()
         outputs = self._forward(inputs)
         loss, labels_t = self._compute_loss(outputs, labels)
         loss.backward()
@@ -93,7 +95,46 @@ class Model:
             self._optimizer.step()
             self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels_t)
+        self._observe_train_step(_time.perf_counter() - t0, inputs)
         return self._wrap_loss(loss, metrics)
+
+    def _observe_train_step(self, dt, inputs):
+        """Feed the telemetry registry: step latency, throughput, MFU."""
+        from ..observability.metrics import get_registry
+        reg = get_registry()
+        reg.counter("train_steps_total", "hapi train_batch calls").inc()
+        reg.histogram("train_step_seconds",
+                      "hapi train_batch wall time").observe(dt)
+        ins = _to_list(inputs)
+        shapes = tuple(tuple(getattr(x, "shape", None)
+                             or np.asarray(x).shape) for x in ins)
+        tokens = int(np.prod(shapes[0])) if shapes and shapes[0] else 0
+        if tokens and dt > 0:
+            reg.gauge("train_tokens_per_sec",
+                      "input elements consumed per second by "
+                      "train_batch").set(tokens / dt)
+        fwd = self._fwd_flops_estimate(shapes)
+        if fwd and dt > 0:
+            from ..utils.flops import peak_device_flops
+            # train ≈ 3× forward (fwd + ~2× bwd), the usual MFU convention
+            reg.gauge("train_mfu",
+                      "model FLOPs utilization of the train step").set(
+                          3.0 * fwd / (dt * peak_device_flops()))
+
+    def _fwd_flops_estimate(self, shapes):
+        """Per-input-shape forward-FLOPs estimate via utils.flops; 0 when
+        the hook walker can't drive this net (e.g. int-id inputs)."""
+        cache = getattr(self, "_flops_cache", None)
+        if cache is None:
+            cache = self._flops_cache = {}
+        if shapes not in cache:
+            try:
+                from ..utils.flops import flops as _flops
+                cache[shapes] = _flops(self.network,
+                                       [list(s) for s in shapes])
+            except Exception:
+                cache[shapes] = 0
+        return cache[shapes]
 
     def eval_batch(self, inputs, labels=None):
         assert self._prepared, "call prepare() first"

@@ -32,10 +32,139 @@ class Request:
     # explicit flag: a PREEMPTED request also has slot None + partial
     # tokens while it waits for re-admission — it is not done
     finished: bool = False
+    submit_t: float = 0.0       # perf_counter at submit (TTFT anchor)
 
     @property
     def done(self) -> bool:
         return self.finished
+
+
+class _ServingStats:
+    """Per-batcher serving telemetry, reported TWICE: local counters keep
+    the ``stats()`` contract exact per instance (and resettable after
+    warmup), while every event also lands in the process-wide metrics
+    registry as ``serving_*`` series labeled by engine — the pipe the
+    Prometheus/JSONL exporters and ``tools/telemetry_dump.py`` read."""
+
+    def __init__(self, engine: str):
+        from .. import observability as obs
+        reg = obs.get_registry()
+        eng = ("engine",)
+
+        def c(name, help):
+            return reg.counter(name, help, labelnames=eng).labels(
+                engine=engine)
+
+        def g(name, help):
+            return reg.gauge(name, help, labelnames=eng).labels(
+                engine=engine)
+
+        def h(name, help):
+            return reg.histogram(name, help, labelnames=eng).labels(
+                engine=engine)
+
+        self.requests = c("serving_requests_total", "requests submitted")
+        self.admissions = c("serving_admissions_total",
+                            "requests admitted into slots")
+        self.completions = c("serving_completions_total",
+                             "requests finished")
+        self.preempt_c = c("serving_preemptions_total",
+                           "requests preempted back to the queue")
+        self.tokens_c = c("serving_tokens_total", "tokens generated")
+        self.steps_c = c("serving_steps_total", "decode steps")
+        self.blocks_c = c("serving_decode_blocks_total",
+                          "K-step decode blocks dispatched")
+        self.queue_depth = g("serving_queue_depth",
+                             "pending requests right now")
+        self.active_slots = g("serving_active_slots",
+                              "occupied slots right now")
+        self.ttft = h("serving_ttft_seconds",
+                      "submit to first generated token")
+        self.step_seconds = h("serving_step_seconds",
+                              "one decode dispatch wall time")
+        self.token_seconds = h("serving_per_token_seconds",
+                               "per-token decode latency")
+        self.reset()
+
+    def reset(self):
+        """Re-baseline the per-instance counters (the registry series are
+        process-cumulative by design and keep running)."""
+        self.steps = 0
+        self.tokens = 0
+        self.occupancy_sum = 0
+        self.completed = 0
+        self.preempted = 0
+        self.cachekv_elems = 0
+        self.cachekv_clipped = 0
+        self.warned_cachekv_clip = False
+        self.decode_blocks = 0
+        self.t0 = _time.perf_counter()
+
+    # -- events -------------------------------------------------------------
+    def on_submit(self, pending_now: int):
+        self.requests.inc()
+        self.queue_depth.set(pending_now)
+
+    def on_admit(self):
+        self.admissions.inc()
+
+    def on_token(self, req: Request):
+        self.tokens += 1
+        self.tokens_c.inc()
+        if len(req.tokens) == 1 and req.submit_t:
+            self.ttft.observe(_time.perf_counter() - req.submit_t)
+
+    def on_step(self, substeps: int = 1):
+        self.steps += substeps
+        self.steps_c.inc(substeps)
+
+    def on_occupancy(self, n: int):
+        self.occupancy_sum += n
+
+    def on_decode_time(self, dt: float, substeps: int = 1):
+        self.step_seconds.observe(dt)
+        self.token_seconds.observe(dt / max(substeps, 1))
+
+    def on_complete(self):
+        self.completed += 1
+        self.completions.inc()
+
+    def on_preempt(self):
+        self.preempted += 1
+        self.preempt_c.inc()
+
+    def on_decode_block(self):
+        self.decode_blocks += 1
+        self.blocks_c.inc()
+
+    def on_cachekv(self, clipped: int, total: int):
+        self.cachekv_elems += total
+        self.cachekv_clipped += clipped
+
+    def set_gauges(self, pending: int, active: int):
+        self.queue_depth.set(pending)
+        self.active_slots.set(active)
+
+    # -- the stats() contract -----------------------------------------------
+    def snapshot(self, max_batch: int, pending: int,
+                 active: int) -> Dict[str, float]:
+        dt = max(_time.perf_counter() - self.t0, 1e-9)
+        steps = max(self.steps, 1)
+        return {
+            "steps": self.steps,
+            "generated_tokens": self.tokens,
+            "tokens_per_sec": self.tokens / dt,
+            "mean_active_slots": self.occupancy_sum / steps,
+            "slot_utilization": self.occupancy_sum / steps / max_batch,
+            "completed_requests": self.completed,
+            "preemptions": self.preempted,
+            "pending_now": pending,
+            "active_now": active,
+            "elapsed_s": dt,
+            "cachekv_clip_rate": (self.cachekv_clipped
+                                  / max(self.cachekv_elems, 1)),
+            "decode_blocks": self.decode_blocks,
+        }
 
 
 class _BatcherBase:
@@ -45,56 +174,48 @@ class _BatcherBase:
     ``_release_slot(slot)`` (return the slot's memory to their pool) plus
     ``step()``."""
 
+    _engine = "serving"        # registry label; subclasses override
+
     def _init_queues(self):
         self._slot_req: Dict[int, Request] = {}
         self._pending: List[Request] = []
         self._finished: Dict[int, Request] = {}
         self._next_rid = 0
         # serving observability (reference analog: the predictor's
-        # benchmark counters): totals since construction
-        self.reset_stats()
+        # benchmark counters): per-instance totals via stats(), process-
+        # wide serving_* series via the observability registry
+        self._tele = _ServingStats(self._engine)
 
     def reset_stats(self):
         """Zero the counters and restart the clock — call after warmup so
         steady-state throughput excludes compile time."""
-        self._stat_steps = 0
-        self._stat_tokens = 0
-        self._stat_occupancy_sum = 0
-        self._stat_completed = 0
-        self._stat_preempted = 0
-        # cachekv-int8 saturation telemetry (ADVICE r4): entries written
-        # at exactly +/-127 by later prefill chunks, whose values the
-        # first-window scales clipped silently
-        self._stat_cachekv_elems = 0
-        self._stat_cachekv_clipped = 0
-        self._warned_cachekv_clip = False
-        # K-step decode blocks dispatched (decode_block engines only)
-        self._stat_decode_blocks = 0
-        self._stat_t0 = _time.perf_counter()
+        self._tele.reset()
 
     def stats(self) -> Dict[str, float]:
         """Throughput/occupancy counters for monitoring: decode steps,
         generated tokens, tokens/sec since construction, mean active
         slots per step, utilization (active/max_batch), completions,
         preemptions, queue depth right now."""
-        dt = max(_time.perf_counter() - self._stat_t0, 1e-9)
-        steps = max(self._stat_steps, 1)
-        return {
-            "steps": self._stat_steps,
-            "generated_tokens": self._stat_tokens,
-            "tokens_per_sec": self._stat_tokens / dt,
-            "mean_active_slots": self._stat_occupancy_sum / steps,
-            "slot_utilization": (self._stat_occupancy_sum / steps
-                                 / self.max_batch),
-            "completed_requests": self._stat_completed,
-            "preemptions": self._stat_preempted,
-            "pending_now": len(self._pending),
-            "active_now": len(self._slot_req),
-            "elapsed_s": dt,
-            "cachekv_clip_rate": (self._stat_cachekv_clipped
-                                  / max(self._stat_cachekv_elems, 1)),
-            "decode_blocks": self._stat_decode_blocks,
-        }
+        return self._tele.snapshot(self.max_batch, len(self._pending),
+                                   len(self._slot_req))
+
+    # back-compat handles: these private counters moved into _ServingStats;
+    # external probes (tests, notebooks) still reach them at the old names
+    @property
+    def _stat_cachekv_elems(self) -> int:
+        return self._tele.cachekv_elems
+
+    @property
+    def _stat_cachekv_clipped(self) -> int:
+        return self._tele.cachekv_clipped
+
+    @property
+    def _warned_cachekv_clip(self) -> bool:
+        return self._tele.warned_cachekv_clip
+
+    @_warned_cachekv_clip.setter
+    def _warned_cachekv_clip(self, v: bool):
+        self._tele.warned_cachekv_clip = v
 
     @staticmethod
     def _check_window(cfg, s_max: int):
@@ -117,7 +238,9 @@ class _BatcherBase:
         self._validate(prompt, max_new_tokens)
         rid = self._next_rid
         self._next_rid += 1
-        self._pending.append(Request(rid, prompt, max_new_tokens))
+        self._pending.append(Request(rid, prompt, max_new_tokens,
+                                     submit_t=_time.perf_counter()))
+        self._tele.on_submit(len(self._pending))
         return rid
 
     def _pick(self, logits_np):
@@ -137,7 +260,7 @@ class _BatcherBase:
             del self._slot_req[slot]
             self._release_slot(slot)
             self._finished[req.rid] = req
-            self._stat_completed += 1
+            self._tele.on_complete()
             return True
         return False
 
@@ -192,6 +315,8 @@ class ContinuousBatcher(_BatcherBase):
     early-stop token. compile: jit.to_static the decode step (recommended;
     disable for debugging).
     """
+
+    _engine = "dense"
 
     def __init__(self, model, max_batch: int = 8, s_max: int = 256,
                  eos_id: Optional[int] = None, compile: bool = True,
@@ -254,7 +379,8 @@ class ContinuousBatcher(_BatcherBase):
             tok = int(self._pick(np.asarray(logits._data)[:, -1])[0])
             req.slot = slot
             req.tokens.append(tok)
-            self._stat_tokens += 1
+            self._tele.on_admit()
+            self._tele.on_token(req)
             self._slot_req[slot] = req
             self._t[slot, 0] = len(req.prompt)
             self._last_tok[slot, 0] = tok
@@ -269,10 +395,12 @@ class ContinuousBatcher(_BatcherBase):
         that finished at admission)."""
         import paddle_tpu as paddle
         finished = self._admit()
+        self._tele.set_gauges(len(self._pending), len(self._slot_req))
         if not self._slot_req:
             return finished
-        self._stat_steps += 1
-        self._stat_occupancy_sum += len(self._slot_req)
+        self._tele.on_step()
+        self._tele.on_occupancy(len(self._slot_req))
+        t0 = _time.perf_counter()
         tok_t = paddle.to_tensor(self._last_tok)
         t_t = paddle.to_tensor(self._t)
         # serving is inference by construction: the batcher supplies the
@@ -285,10 +413,12 @@ class ContinuousBatcher(_BatcherBase):
             tok = int(next_tok[slot])
             self._t[slot, 0] += 1
             req.tokens.append(tok)
-            self._stat_tokens += 1
+            self._tele.on_token(req)
             self._last_tok[slot, 0] = tok
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)
+        self._tele.on_decode_time(_time.perf_counter() - t0)
+        self._tele.set_gauges(len(self._pending), len(self._slot_req))
         return finished
 
 
@@ -329,6 +459,8 @@ class PagedContinuousBatcher(_BatcherBase):
         prompt ⧺ generated-so-far, so a later re-prefill recomputes its
         state exactly (greedy decode reproduces the same continuation).
     """
+
+    _engine = "paged"
 
     def __init__(self, model, max_batch: int = 8, s_max: int = 256,
                  block_size: int = 16, n_pages: Optional[int] = None,
@@ -628,7 +760,8 @@ class PagedContinuousBatcher(_BatcherBase):
             tok = int(self._pick(np.asarray(logits._data))[0])
             req.slot = slot
             req.tokens.append(tok)
-            self._stat_tokens += 1
+            self._tele.on_admit()
+            self._tele.on_token(req)
             self._slot_req[slot] = req
             self._admit_order.append(slot)
             self._dec[slot] = len(ids_np)
@@ -747,12 +880,11 @@ class PagedContinuousBatcher(_BatcherBase):
         if counts is None:
             return
         clipped, total = counts
-        self._stat_cachekv_elems += total
-        self._stat_cachekv_clipped += clipped
+        self._tele.on_cachekv(clipped, total)
         rate = clipped / max(total, 1)
         threshold = max(0.01, 3.0 * (baseline or 0.0))
-        if rate > threshold and not self._warned_cachekv_clip:
-            self._warned_cachekv_clip = True
+        if rate > threshold and not self._tele.warned_cachekv_clip:
+            self._tele.warned_cachekv_clip = True
             import warnings
             warnings.warn(
                 f"cachekv-int8 chunked prefill: {rate:.1%} of a later "
@@ -800,7 +932,7 @@ class PagedContinuousBatcher(_BatcherBase):
             req.slot = None
             self._release_slot(slot)
             self._pending.insert(0, req)
-            self._stat_preempted += 1
+            self._tele.on_preempt()
             return True
         return False
 
@@ -878,7 +1010,7 @@ class PagedContinuousBatcher(_BatcherBase):
         self._free_slots.append(adm["slot"])
         self._pending.insert(0, adm["req"])
         self._admitting = None
-        self._stat_preempted += 1
+        self._tele.on_preempt()
 
     def _fused_chunk_inputs(self):
         import paddle_tpu as paddle
@@ -915,7 +1047,8 @@ class PagedContinuousBatcher(_BatcherBase):
         self._last_tok[slot] = tok
         req.slot = slot
         req.tokens.append(tok)
-        self._stat_tokens += 1
+        self._tele.on_admit()
+        self._tele.on_token(req)
         self._slot_req[slot] = req
         self._admit_order.append(slot)
         self._admitting = None
@@ -935,6 +1068,7 @@ class PagedContinuousBatcher(_BatcherBase):
             self._decode_tail(finished)
             return finished
         self._step_prologue()
+        t0 = _time.perf_counter()
         tok_t = paddle.to_tensor(self._last_tok)
         ids_t, row_t, dec_t, at_t = self._fused_chunk_inputs()
         with paddle.no_grad():
@@ -942,6 +1076,7 @@ class PagedContinuousBatcher(_BatcherBase):
                 tok_t, ids_t, row_t, dec_t, at_t, self._state)
         self._advance_decoders(dec_logits, finished)
         self._finish_admission(chunk_logits, finished)
+        self._tele.on_decode_time(_time.perf_counter() - t0)
         return finished
 
     def _advance_decoders(self, logits, finished: List[int]):
@@ -952,7 +1087,7 @@ class PagedContinuousBatcher(_BatcherBase):
         for slot, req in list(self._slot_req.items()):
             tok = int(next_tok[slot])
             req.tokens.append(tok)
-            self._stat_tokens += 1
+            self._tele.on_token(req)
             self._last_tok[slot] = tok
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)
@@ -966,8 +1101,9 @@ class PagedContinuousBatcher(_BatcherBase):
         ones."""
         if self.policy == "ondemand":
             self._grow_for_step()
-        self._stat_steps += 1
-        self._stat_occupancy_sum += len(self._slot_req)
+        self._tele.on_step()
+        self._tele.on_occupancy(len(self._slot_req))
+        self._tele.set_gauges(len(self._pending), len(self._slot_req))
         self._sync_tables()
 
     def _decode_tail(self, finished: List[int]):
@@ -982,10 +1118,12 @@ class PagedContinuousBatcher(_BatcherBase):
             self._decode_block_tail(finished)
             return
         self._step_prologue()
+        t0 = _time.perf_counter()
         tok_t = paddle.to_tensor(self._last_tok)
         with paddle.no_grad():
             logits, self._state = self._step_fn(tok_t, self._state)
         self._advance_decoders(logits, finished)
+        self._tele.on_decode_time(_time.perf_counter() - t0)
 
     def _block_backed(self, K: int) -> bool:
         """A K-step block is safe when, for every active slot, the rows
@@ -1035,24 +1173,27 @@ class PagedContinuousBatcher(_BatcherBase):
         went to its own (about-to-be-freed) pages or scratch."""
         import paddle_tpu as paddle
         K = self.decode_block
-        self._stat_steps += K
-        self._stat_decode_blocks += 1
+        self._tele.on_step(K)
+        self._tele.on_decode_block()
+        self._tele.set_gauges(len(self._pending), len(self._slot_req))
         self._sync_tables()
+        t0 = _time.perf_counter()
         tok_t = paddle.to_tensor(self._last_tok)
         with paddle.no_grad():
             toks, self._state = self._block_fn(tok_t, self._state)
         toks_np = np.asarray(toks._data)                  # [K, B]
+        self._tele.on_decode_time(_time.perf_counter() - t0, K)
         # survivors consumed all K rows; evicted slots' counters are
         # reset at their next admission
         self._dec += K * np.asarray(self._slot_active_mask(), np.int32)
         for k in range(K):
             # occupancy at each sub-step's ENTRY (post prior evictions),
             # matching the per-step path's _step_prologue accounting
-            self._stat_occupancy_sum += len(self._slot_req)
+            self._tele.on_occupancy(len(self._slot_req))
             for slot, req in list(self._slot_req.items()):
                 tok = int(toks_np[k, slot])
                 req.tokens.append(tok)
-                self._stat_tokens += 1
+                self._tele.on_token(req)
                 self._last_tok[slot] = tok
                 if self._maybe_finish(req, tok):
                     finished.append(req.rid)

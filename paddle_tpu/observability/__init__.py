@@ -1,0 +1,33 @@
+"""Unified runtime telemetry: metrics registry, trace spans, exporters.
+
+The one pipe every subsystem reports through (reference analog:
+platform/monitor.h STATS_INT + the host profiler, fused):
+
+  * ``metrics`` — process-wide Counter / Gauge / Histogram registry with
+    labeled series; counters ride the C++ stat tier when available.
+  * ``tracing`` — nested, context-propagated spans that feed BOTH the
+    profiler's chrome-trace recorder and span-duration histograms.
+  * ``export`` — Prometheus text format + JSONL snapshots
+    (``tools/telemetry_dump.py`` is the CLI over these).
+
+Instrumented out of the box: serving batchers (queue depth, admissions,
+preemptions, TTFT / per-token latency), collectives (bytes/count/latency
+per op), the hapi training loop (step time, tokens/sec, MFU), and the
+Pallas flash-attention autotune cache.
+"""
+from __future__ import annotations
+
+from . import export, metrics, tracing
+from .export import load_jsonl, render_prometheus, write_jsonl
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_registry)
+from .tracing import (Span, attach_context, capture_context, current_span,
+                      span, span_path, traced)
+
+__all__ = [
+    "metrics", "tracing", "export",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "Span", "span", "current_span", "span_path", "capture_context",
+    "attach_context", "traced",
+    "render_prometheus", "write_jsonl", "load_jsonl",
+]

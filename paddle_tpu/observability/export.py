@@ -1,0 +1,160 @@
+"""Telemetry exporters: Prometheus text format + JSONL snapshots.
+
+Both render the plain-dict series produced by ``MetricsRegistry.snapshot``
+so a snapshot written in one process (``write_jsonl``) re-renders in
+another (``tools/telemetry_dump.py --snapshot``) byte-for-value identical
+— the round trip tests pin that.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import List, Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["render_prometheus", "write_jsonl", "load_jsonl",
+           "snapshot_series"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def snapshot_series(registry: Optional[MetricsRegistry] = None,
+                    include_native: bool = True) -> List[dict]:
+    return (registry or get_registry()).snapshot(
+        include_native=include_native)
+
+
+def _san(name: str) -> str:
+    """Prometheus metric-name sanitizer (dots etc. -> underscores)."""
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _labelstr(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = dict(labels or {})
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{_san(k)}="{_esc(v)}"'
+                     for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(series: Optional[List[dict]] = None,
+                      registry: Optional[MetricsRegistry] = None) -> str:
+    """Prometheus text exposition of a snapshot (or the live registry).
+
+    Histograms render the standard cumulative ``_bucket{le=...}`` series
+    plus ``_sum``/``_count``; the reservoir quantile estimates ride along
+    as a separate ``<name>_quantile`` gauge family (mixing summary-style
+    quantile lines into a histogram family is invalid exposition).
+    """
+    if series is None:
+        series = snapshot_series(registry)
+    # group by (name, type) so HELP/TYPE headers emit once per family
+    by_family: dict = {}
+    for s in series:
+        by_family.setdefault((s["name"], s["type"]), []).append(s)
+    lines: List[str] = []
+    for (name, kind), members in by_family.items():
+        pname = _san(name)
+        lines.append(f"# TYPE {pname} {kind}")
+        for s in members:
+            labels = s.get("labels") or {}
+            if kind == "histogram":
+                cum = 0
+                bounds = list(s.get("buckets") or [])
+                counts = list(s.get("bucket_counts") or [])
+                for bound, c in zip(bounds, counts):
+                    cum += c
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_labelstr(labels, {'le': _fmt(bound)})} {cum}")
+                cum += counts[len(bounds)] if len(counts) > len(bounds) else 0
+                lines.append(
+                    f"{pname}_bucket{_labelstr(labels, {'le': '+Inf'})} "
+                    f"{cum}")
+                lines.append(f"{pname}_sum{_labelstr(labels)} "
+                             f"{_fmt(s.get('sum', 0.0))}")
+                lines.append(f"{pname}_count{_labelstr(labels)} "
+                             f"{s.get('count', 0)}")
+                for qname, qv in (s.get("quantiles") or {}).items():
+                    if qv is None:
+                        continue
+                    q = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}.get(
+                        qname, qname)
+                    lines.append(
+                        f"{pname}_quantile"
+                        f"{_labelstr(labels, {'quantile': q})} {_fmt(qv)}")
+            else:
+                lines.append(
+                    f"{pname}{_labelstr(labels)} {_fmt(s.get('value'))}")
+                if kind == "gauge" and "peak" in s:
+                    lines.append(
+                        f"{pname}_peak{_labelstr(labels)} "
+                        f"{_fmt(s.get('peak'))}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_jsonl(path: str, registry: Optional[MetricsRegistry] = None,
+                series: Optional[List[dict]] = None,
+                extra: Optional[dict] = None) -> str:
+    """One JSON object per line: a meta header, then every series.
+
+    Atomic replace — a mid-write kill must not leave a truncated snapshot
+    where a previous good one stood.
+    """
+    if series is None:
+        series = snapshot_series(registry)
+    meta = {"__meta__": {
+        "format": "paddle_tpu.observability/1",
+        "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "series": len(series)}}
+    if extra:
+        meta["__meta__"].update(extra)
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(meta) + "\n")
+        for s in series:
+            f.write(json.dumps(s) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Read a write_jsonl snapshot back into its series list (meta line
+    dropped; corrupt lines raise — a half-snapshot must not parse as a
+    smaller healthy one)."""
+    series: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if "__meta__" in obj:
+                continue
+            series.append(obj)
+    return series

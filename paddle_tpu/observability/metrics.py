@@ -1,0 +1,470 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram with labels.
+
+Reference: paddle/fluid/platform/monitor.h (STATS_INT — named int64 gauges
+registered once and sampled framework-wide) generalized into the three
+Prometheus instrument kinds every serving/training stack ends up needing:
+
+  * Counter   — monotone int64, backed by the C++ stat registry
+                (csrc/native.cc, shared with the data-loader and tracer
+                tiers) when available, with the same pure-python fallback
+                ``utils/monitor.py`` uses;
+  * Gauge     — settable float with a tracked peak (PEAK_VALUE analog);
+                integer-valued gauges may opt into the native tier so
+                cross-thread writers (the C++ dataloader) share the cell;
+  * Histogram — fixed buckets + a bounded reservoir for streaming
+                p50/p95/p99 estimates (pure python; observations are
+                floats the int registry can't carry).
+
+Labeled series: ``registry.counter(name, labelnames=("engine",))`` returns
+a family; ``family.labels(engine="dense")`` returns the per-series child.
+All instruments are thread-safe. ``registry.snapshot()`` renders every
+series (plus, optionally, native-registry names owned by other tiers) as
+plain dicts that ``observability.export`` serializes.
+"""
+from __future__ import annotations
+
+import bisect
+import random
+import threading
+import zlib
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core import native as _native
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "DEFAULT_BUCKETS", "DEFAULT_QUANTILES"]
+
+# latency-shaped default buckets (seconds); +Inf is implicit
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+DEFAULT_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+_RESERVOIR_CAP = 512
+
+# -- native-tier plumbing ----------------------------------------------------
+# One tier per process (the monitor.py divergence fix lives on this choice):
+# probe once, then every native-backed cell uses the chosen tier forever. A
+# native call failing AFTER the probe is logged once and the delta dropped —
+# never silently split across tiers.
+_TIER_LOCK = threading.Lock()
+_TIER: Optional[str] = None          # "native" | "py" once probed
+_TIER_FAIL_LOGGED = False
+
+
+def _tier() -> str:
+    global _TIER
+    if _TIER is None:
+        with _TIER_LOCK:
+            if _TIER is None:
+                try:
+                    _native.stat_update("__observability_probe__", 0)
+                    _TIER = "native"
+                except Exception:
+                    _TIER = "py"
+    return _TIER
+
+
+def _log_tier_failure_once(exc: Exception) -> None:
+    global _TIER_FAIL_LOGGED
+    with _TIER_LOCK:
+        if _TIER_FAIL_LOGGED:
+            return
+        _TIER_FAIL_LOGGED = True
+    import logging
+    logging.getLogger(__name__).warning(
+        "native stat tier failed mid-run (%s: %s); the registry sticks "
+        "with the native tier — this delta (and any later failing ones) "
+        "is dropped rather than silently diverging into a python shadow "
+        "store", type(exc).__name__, exc)
+
+
+class _NativeCell:
+    """Int cell in the cross-thread stat registry (current + peak)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def add(self, delta: int) -> int:
+        try:
+            return int(_native.stat_update(self.key, int(delta)))
+        except Exception as exc:  # noqa: BLE001 — see _log_tier_failure_once
+            _log_tier_failure_once(exc)
+            return self.get_int()
+
+    def get_int(self) -> int:
+        try:
+            v = _native.stat_get(self.key)
+        except Exception:
+            return 0
+        return int(v[0] if isinstance(v, tuple) else v)
+
+    def peak_int(self) -> int:
+        try:
+            v = _native.stat_get(self.key)
+        except Exception:
+            return 0
+        return int(v[1] if isinstance(v, tuple) else v)
+
+    def reset(self) -> None:
+        try:
+            _native.stat_reset(self.key)
+        except Exception:
+            pass
+
+
+class _PyCell:
+    """Float cell (current + peak) guarded by its own lock."""
+
+    __slots__ = ("_lock", "cur", "pk")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cur = 0.0
+        self.pk = 0.0
+
+    def add(self, delta: float) -> float:
+        with self._lock:
+            self.cur += delta
+            self.pk = max(self.pk, self.cur)
+            return self.cur
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.cur = value
+            self.pk = max(self.pk, value)
+
+    def get(self) -> float:
+        return self.cur
+
+    def peak(self) -> float:
+        return self.pk
+
+    def reset(self) -> None:
+        with self._lock:
+            self.cur = 0.0
+            self.pk = 0.0
+
+
+def _series_key(name: str, labels: Dict[str, str]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+# -- instruments -------------------------------------------------------------
+
+class Counter:
+    """Monotone int64 counter; rides the native stat tier when available."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        key = _series_key(name, self.labels)
+        self._cell = _NativeCell(key) if _tier() == "native" else _PyCell()
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        self._cell.add(int(n))
+
+    @property
+    def value(self) -> int:
+        if isinstance(self._cell, _NativeCell):
+            return self._cell.get_int()
+        return int(self._cell.get())
+
+    def _reset(self) -> None:
+        self._cell.reset()
+
+    def _series(self) -> dict:
+        return {"name": self.name, "type": self.kind,
+                "labels": self.labels, "value": self.value}
+
+
+class Gauge:
+    """Settable float gauge with a tracked peak (PEAK_VALUE analog).
+
+    ``native=True`` keeps the cell in the cross-thread int registry (the
+    monitor.py shim uses this so C++-tier writers share it); the default
+    python cell carries floats (MFU, rates).
+    """
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None,
+                 native: bool = False):
+        self.name = name
+        self.labels = dict(labels or {})
+        key = _series_key(name, self.labels)
+        self._cell = (_NativeCell(key)
+                      if native and _tier() == "native" else _PyCell())
+
+    def add(self, delta: float) -> float:
+        if isinstance(self._cell, _NativeCell):
+            return self._cell.add(int(delta))
+        return self._cell.add(delta)
+
+    def set(self, value: float) -> None:
+        if isinstance(self._cell, _NativeCell):
+            self._cell.add(int(value) - self._cell.get_int())
+        else:
+            self._cell.set(value)
+
+    @property
+    def value(self) -> float:
+        if isinstance(self._cell, _NativeCell):
+            return float(self._cell.get_int())
+        return self._cell.get()
+
+    @property
+    def peak(self) -> float:
+        if isinstance(self._cell, _NativeCell):
+            return float(self._cell.peak_int())
+        return self._cell.peak()
+
+    def _reset(self) -> None:
+        self._cell.reset()
+
+    def _series(self) -> dict:
+        return {"name": self.name, "type": self.kind,
+                "labels": self.labels, "value": self.value,
+                "peak": self.peak}
+
+
+class Histogram:
+    """Fixed-bucket histogram + bounded-reservoir streaming quantiles.
+
+    Buckets are upper bounds (ascending; +Inf implicit). The reservoir
+    (uniform, seeded from the series name so test runs are reproducible)
+    keeps a bounded sample of observations for p50/p95/p99 estimates —
+    exact below ``_RESERVOIR_CAP`` observations, an unbiased estimate
+    above it.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        bs = tuple(sorted(buckets if buckets is not None else
+                          DEFAULT_BUCKETS))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bs) + 1)       # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+        self._reservoir: List[float] = []
+        # crc32, not hash(): str hashing is salted per process and the
+        # reservoir must behave identically run to run
+        self._rng = random.Random(zlib.crc32(
+            _series_key(name, self.labels).encode()))
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._counts[bisect.bisect_left(self.buckets, v)] += 1
+            self._sum += v
+            self._count += 1
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+            if len(self._reservoir) < _RESERVOIR_CAP:
+                self._reservoir.append(v)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < _RESERVOIR_CAP:
+                    self._reservoir[j] = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Streaming quantile estimate from the reservoir (None if empty)."""
+        with self._lock:
+            if not self._reservoir:
+                return None
+            s = sorted(self._reservoir)
+        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return s[idx]
+
+    def bucket_counts(self) -> List[int]:
+        """Raw per-bucket counts (len(buckets)+1; the tail is +Inf)."""
+        with self._lock:
+            return list(self._counts)
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+            self._min = self._max = None
+            self._reservoir = []
+
+    def _series(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, ssum = self._count, self._sum
+            mn, mx = self._min, self._max
+        return {"name": self.name, "type": self.kind,
+                "labels": self.labels,
+                "buckets": list(self.buckets),
+                "bucket_counts": counts,
+                "count": total, "sum": ssum,
+                "min": mn, "max": mx,
+                "quantiles": {f"p{int(q * 100)}": self.quantile(q)
+                              for q in DEFAULT_QUANTILES}}
+
+
+class _Family:
+    """Labeled metric family: one (name, labelnames) entry in the registry
+    fanning out to per-label-value child instruments."""
+
+    def __init__(self, name: str, kind: str, help: str,
+                 labelnames: Tuple[str, ...], make_child):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = labelnames
+        self._make_child = make_child
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **kw):
+        if set(kw) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kw)} != declared "
+                f"{sorted(self.labelnames)}")
+        key = tuple(str(kw[k]) for k in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._make_child(
+                        dict(zip(self.labelnames, key)))
+                    self._children[key] = child
+        return child
+
+    def children(self):
+        with self._lock:
+            return list(self._children.values())
+
+
+class MetricsRegistry:
+    """Name -> instrument/family map; the process-wide telemetry root."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries: Dict[str, object] = {}
+        self._kinds: Dict[str, str] = {}
+
+    # -- registration (idempotent; kind mismatch is an error) ---------------
+    def _get_or_make(self, name: str, kind: str, help: str,
+                     labelnames: Sequence[str], make_child):
+        labelnames = tuple(labelnames or ())
+        with self._lock:
+            if name in self._entries:
+                if self._kinds[name] != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{self._kinds[name]}, not {kind}")
+                entry = self._entries[name]
+                if labelnames and not isinstance(entry, _Family):
+                    raise ValueError(f"metric {name!r} is unlabeled")
+                if isinstance(entry, _Family) \
+                        and entry.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} labelnames {entry.labelnames} "
+                        f"!= {labelnames}")
+                return entry
+            if labelnames:
+                entry = _Family(name, kind, help, labelnames, make_child)
+            else:
+                entry = make_child({})
+                entry.help = help
+            self._entries[name] = entry
+            self._kinds[name] = kind
+            return entry
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()):
+        return self._get_or_make(
+            name, "counter", help, labelnames,
+            lambda labels: Counter(name, labels))
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = (), native: bool = False):
+        return self._get_or_make(
+            name, "gauge", help, labelnames,
+            lambda labels: Gauge(name, labels, native=native))
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None):
+        return self._get_or_make(
+            name, "histogram", help, labelnames,
+            lambda labels: Histogram(name, labels, buckets=buckets))
+
+    def get(self, name: str):
+        return self._entries.get(name)
+
+    # -- snapshot -----------------------------------------------------------
+    def _instruments(self) -> Iterable:
+        with self._lock:
+            entries = list(self._entries.values())
+        for entry in entries:
+            if isinstance(entry, _Family):
+                for child in entry.children():
+                    yield child
+            else:
+                yield entry
+
+    def snapshot(self, include_native: bool = True) -> List[dict]:
+        """Every live series as plain dicts (export.py serializes these).
+
+        include_native also surfaces native-registry names written by
+        OTHER tiers (the C++ dataloader, monitor gauges predating the
+        registry) as gauge series, so one snapshot covers the process.
+        """
+        out = [inst._series() for inst in self._instruments()]
+        if include_native:
+            owned = {_series_key(s["name"], s["labels"]) for s in out}
+            try:
+                native_all = _native.stat_all() or {}
+            except Exception:
+                native_all = {}
+            for key, v in sorted(native_all.items()):
+                if key in owned or key.startswith("__observability"):
+                    continue
+                cur, pk = (v if isinstance(v, tuple) else (v, v))
+                out.append({"name": key, "type": "gauge", "labels": {},
+                            "value": float(cur), "peak": float(pk),
+                            "external": True})
+        return out
+
+    def reset(self) -> None:
+        """Zero every registered series (tests); external tiers untouched."""
+        for inst in self._instruments():
+            inst._reset()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem reports through."""
+    return _REGISTRY

@@ -48,8 +48,18 @@ def _sig(q, k, causal, has_mask, dropout_p):
             bool(dropout_p))
 
 
+def _cache_counter(outcome: str):
+    from ...observability.metrics import get_registry
+    return get_registry().counter(
+        "flash_autotune_cache_total",
+        "autotune tiling-cache lookups by outcome (hit/miss)",
+        labelnames=("outcome",)).labels(outcome=outcome)
+
+
 def cached_blocks(q, k, causal, has_mask, dropout_p):
-    return _BEST.get(_sig(q, k, causal, has_mask, dropout_p))
+    best = _BEST.get(_sig(q, k, causal, has_mask, dropout_p))
+    _cache_counter("hit" if best is not None else "miss").inc()
+    return best
 
 
 def set_best(q, k, causal, has_mask, dropout_p, blocks: Tuple[int, int]):
@@ -107,6 +117,10 @@ def tune_flash_blocks(q, k, v, causal: bool = True, attn_mask=None,
         raise RuntimeError(
             f"sequence length {s} below every candidate tiling's lcm — "
             f"the kernel's short-sequence shrink governs; nothing to tune")
+    from ...observability.metrics import get_registry
+    get_registry().counter(
+        "flash_autotune_tunes_total",
+        "on-device flash-attention tuning sweeps run").inc()
     results: Dict[Tuple[int, int], Optional[float]] = {}
 
     def run(bq, bk):

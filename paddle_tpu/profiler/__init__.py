@@ -13,6 +13,7 @@ Chrome-trace export and the summary table keep the reference's UX.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -68,19 +69,22 @@ def _default_scheduler(step: int) -> ProfilerState:
     return ProfilerState.RECORD
 
 
+# shared across every handler: two Profilers exporting with the same
+# worker_name within the same second must not overwrite each other (a
+# per-handler counter restarts at 1 for each, colliding on the filename)
+_EXPORT_SEQ = itertools.count(1)
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     """profiler.py export_chrome_tracing:215 analog: on_trace_ready handler
     writing <dir>/<worker>_<time>.json."""
 
-    counter = [0]
-
     def handler(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_{os.getpid()}"
-        counter[0] += 1
         path = os.path.join(
             dir_name, f"{name}_time_{int(time.time())}_"
-                      f"{counter[0]}.paddle_trace.json")
+                      f"{next(_EXPORT_SEQ)}.paddle_trace.json")
         prof.export(path)
 
     return handler

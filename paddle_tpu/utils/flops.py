@@ -75,4 +75,32 @@ def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
     return total[0]
 
 
-__all__ = ["flops"]
+def peak_device_flops(device=None) -> float:
+    """Peak bf16 FLOP/s of the active accelerator (MFU denominator).
+
+    TPU generations from the public spec sheets; non-TPU backends get a
+    nominal 1e12 so MFU stays finite (and obviously not meaningful) when
+    tests run on the CPU mesh.
+    """
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    if getattr(device, "platform", "") != "tpu":
+        return 1e12
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v6e": 918e12, "v6": 918e12,
+        "v5p": 459e12,
+        "v5e": 197e12, "v5litepod": 197e12, "v5lite": 197e12,
+        "v5 lite": 197e12,  # axon reports device_kind "TPU v5 lite"
+        "v4": 275e12,
+        "v3": 123e12,
+        "v2": 45e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12  # default to v5e-class
+
+
+__all__ = ["flops", "peak_device_flops"]

@@ -1,84 +1,58 @@
-"""Global monitor gauges + peak trackers.
+"""Global monitor gauges + peak trackers — shim over the metrics registry.
 
 Reference: paddle/fluid/platform/monitor.h (STATS_INT registry — named
 int64 gauges sampled by the framework and exported for observability) and
 fluid/memory/stats.h peak trackers (DEVICE_MEMORY_STAT_CURRENT_VALUE /
-PEAK_VALUE). TPU-native: gauges live in the C++ stat registry
-(csrc/native.cc — cross-thread, shared with the data-loader and tracer
-tiers) with a pure-python fallback; peaks track alongside; device memory
-gauges sample PJRT's memory_stats.
+PEAK_VALUE).
+
+TPU-native: every gauge is a native-backed Gauge in
+``paddle_tpu.observability.metrics`` — the SAME cross-thread cell the C++
+dataloader tier writes and the exporters snapshot, so there is exactly one
+store per process. (Historically this module kept its own python shadow
+dict that silently diverged from the C++ tier whenever a single native
+call failed; the registry's sticky-tier rule — probe once, log once on a
+later failure, never fork — replaced that.) The int-valued API below is
+kept verbatim for callers of the old surface.
 """
 from __future__ import annotations
 
 from typing import Dict
 
-from ..core import native as _native
+from ..observability import metrics as _metrics
 
-_PEAKS: Dict[str, int] = {}
-_PY_STATS: Dict[str, int] = {}  # fallback when the C++ tier is unavailable
+_HELP = "monitor gauge (STATS_INT analog)"
 
 
-def _update_raw(name: str, delta: int) -> int:
-    try:
-        v = _native.stat_update(name, delta)
-        return v[0] if isinstance(v, tuple) else v
-    except Exception:
-        _PY_STATS[name] = _PY_STATS.get(name, 0) + delta
-        return _PY_STATS[name]
+def _gauge(name: str):
+    return _metrics.get_registry().gauge(name, _HELP, native=True)
 
 
 def stat_update(name: str, delta: int = 1) -> int:
     """Add delta to gauge `name`; tracks the peak (STATS_INT analog)."""
-    cur = _update_raw(name, int(delta))
-    if cur > _PEAKS.get(name, cur - 1):
-        _PEAKS[name] = cur
-    return cur
-
-
-def _native_get(name: str):
-    """Native registry entry as (current, peak), or None."""
-    try:
-        v = _native.stat_get(name)
-    except Exception:
-        return None
-    if isinstance(v, tuple):
-        return v
-    return (v, v) if v is not None else None
+    return int(_gauge(name).add(int(delta)))
 
 
 def stat_get(name: str) -> int:
-    v = _native_get(name)
-    if v is not None:
-        return v[0]
-    return _PY_STATS.get(name, 0)
+    return int(_gauge(name).value)
 
 
 def stat_peak(name: str) -> int:
-    """Peak value seen through stat_update (PEAK_VALUE analog — the C++
-    registry tracks it natively; the python fallback tracks it here)."""
-    v = _native_get(name)
-    if v is not None:
-        return max(v[1], _PEAKS.get(name, v[1]))
-    return _PEAKS.get(name, stat_get(name))
+    """Peak value seen through stat_update (PEAK_VALUE analog)."""
+    return int(_gauge(name).peak)
 
 
 def stat_reset(name: str) -> None:
-    try:
-        _native.stat_reset(name)
-    except Exception:
-        pass
-    _PY_STATS.pop(name, None)
-    _PEAKS.pop(name, None)
+    _gauge(name)._reset()
 
 
 def get_monitor_values() -> Dict[str, int]:
-    """Snapshot every gauge's current value (native + python merged)."""
-    out = dict(_PY_STATS)
-    try:
-        for name, v in (_native.stat_all() or {}).items():
-            out[name] = v[0] if isinstance(v, tuple) else v
-    except Exception:
-        pass
+    """Snapshot every gauge's current value (shared native store, so this
+    includes names written by other tiers, e.g. the C++ dataloader)."""
+    out: Dict[str, int] = {}
+    for s in _metrics.get_registry().snapshot(include_native=True):
+        if s["type"] != "gauge" or s["labels"]:
+            continue
+        out[s["name"]] = int(s["value"])
     return out
 
 

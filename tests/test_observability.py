@@ -1,0 +1,303 @@
+"""Unified telemetry: registry semantics, spans, exporters, integration.
+
+The registry is process-global (native-tier cells are keyed by series
+name in the cross-thread stat store), so tests use per-test metric names
+or fresh MetricsRegistry instances plus delta assertions — never absolute
+values of shared series.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import (attach_context, capture_context,
+                                      load_jsonl, render_prometheus, span,
+                                      span_path, write_jsonl)
+from paddle_tpu.observability.metrics import (MetricsRegistry, get_registry)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_labels_and_monotonicity():
+    reg = MetricsRegistry()
+    fam = reg.counter("obs_t1_reqs", "x", labelnames=("engine",))
+    fam.labels(engine="dense").inc()
+    fam.labels(engine="dense").inc(4)
+    fam.labels(engine="paged").inc(2)
+    assert fam.labels(engine="dense").value == 5
+    assert fam.labels(engine="paged").value == 2
+    with pytest.raises(ValueError):
+        fam.labels(engine="dense").inc(-1)
+    with pytest.raises(ValueError):
+        fam.labels(wrong="dense")
+
+
+def test_registration_is_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("obs_t2_c", "x")
+    assert reg.counter("obs_t2_c") is a
+    with pytest.raises(ValueError):
+        reg.gauge("obs_t2_c")
+    reg.counter("obs_t2_lab", labelnames=("a",))
+    with pytest.raises(ValueError):
+        reg.counter("obs_t2_lab", labelnames=("b",))
+
+
+def test_gauge_tracks_peak():
+    reg = MetricsRegistry()
+    g = reg.gauge("obs_t3_depth", "x")
+    g.set(3)
+    g.set(9)
+    g.set(2)
+    assert g.value == 2
+    assert g.peak == 9
+
+
+def test_histogram_buckets_and_quantiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("obs_t4_lat", "x", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(56.05)
+    assert h.bucket_counts() == [1, 2, 1, 1]   # last = +Inf overflow
+    # exact below the reservoir cap: quantiles come from the sorted sample
+    assert h.quantile(0.5) == 0.5
+    assert h.quantile(0.99) == 50.0
+
+
+def test_histogram_quantile_sane_past_reservoir_cap():
+    from paddle_tpu.observability.metrics import _RESERVOIR_CAP
+    reg = MetricsRegistry()
+    h = reg.histogram("obs_t5_big", "x", buckets=(0.5,))
+    n = _RESERVOIR_CAP * 4
+    for i in range(n):
+        h.observe(i / n)   # uniform on [0, 1)
+    assert h.count == n
+    q50 = h.quantile(0.5)
+    assert 0.3 < q50 < 0.7  # unbiased estimate of the true 0.5
+
+
+def test_thread_safety_counter():
+    reg = MetricsRegistry()
+    c = reg.counter("obs_t6_mt", "x")
+
+    def burst():
+        for _ in range(1000):
+            c.inc()
+
+    ts = [threading.Thread(target=burst) for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert c.value == 8000
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_builds_path():
+    assert span_path() == ""
+    with span("outer"):
+        assert span_path() == "outer"
+        with span("inner") as s:
+            assert span_path() == "outer/inner"
+            assert s.path == "outer/inner"
+        assert span_path() == "outer"
+    assert span_path() == ""
+
+
+def test_span_durations_reach_registry():
+    hist = get_registry().get("span_duration_seconds")
+    with span("obs_t7_marker"):
+        time.sleep(0.01)
+    child = hist.labels(span="obs_t7_marker")
+    assert child.count >= 1
+    assert child.sum >= 0.009
+
+
+def test_span_context_propagates_across_threads():
+    seen = {}
+
+    def worker(token):
+        with attach_context(token):
+            with span("stage"):
+                seen["path"] = span_path()
+        seen["after"] = span_path()
+
+    with span("producer"):
+        t = threading.Thread(target=worker, args=(capture_context(),))
+        t.start()
+        t.join()
+    assert seen["path"] == "producer/stage"
+    assert seen["after"] == ""
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def _sample_registry():
+    reg = MetricsRegistry()
+    reg.counter("obs_exp_reqs", "reqs", labelnames=("engine",)) \
+        .labels(engine="dense").inc(7)
+    g = reg.gauge("obs_exp_depth", "depth")
+    g.set(4)
+    g.set(1)
+    h = reg.histogram("obs_exp_lat", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    return reg
+
+
+def test_prometheus_rendering():
+    text = render_prometheus(registry=_sample_registry())
+    assert '# TYPE obs_exp_reqs counter' in text
+    assert 'obs_exp_reqs{engine="dense"} 7' in text
+    assert 'obs_exp_depth 1' in text
+    assert 'obs_exp_depth_peak 4' in text
+    # cumulative buckets + +Inf + sum/count
+    assert 'obs_exp_lat_bucket{le="0.1"} 1' in text
+    assert 'obs_exp_lat_bucket{le="1"} 2' in text
+    assert 'obs_exp_lat_bucket{le="+Inf"} 3' in text
+    assert 'obs_exp_lat_count 3' in text
+    assert 'obs_exp_lat_quantile{quantile="0.5"} 0.5' in text
+
+
+def test_jsonl_round_trip(tmp_path):
+    reg = _sample_registry()
+    path = str(tmp_path / "snap.jsonl")
+    write_jsonl(path, registry=reg, series=reg.snapshot(
+        include_native=False))
+    series = load_jsonl(path)
+    # re-rendered snapshot is value-identical to the live render
+    assert render_prometheus(series=series) == render_prometheus(
+        series=reg.snapshot(include_native=False))
+    with open(path) as f:
+        meta = json.loads(f.readline())
+    assert meta["__meta__"]["format"] == "paddle_tpu.observability/1"
+    assert meta["__meta__"]["series"] == len(series)
+
+
+def test_jsonl_rejects_corrupt_lines(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    path.write_text('{"name": "ok", "type": "counter", "value": 1}\n'
+                    '{"name": "trunc', encoding="utf-8")
+    with pytest.raises(json.JSONDecodeError):
+        load_jsonl(str(path))
+
+
+def test_exporter_overhead_under_one_percent():
+    """bench guard: rendering a snapshot must cost <1% of a tight 100k
+    counter-inc loop — exporting may never be the hot path."""
+    reg = MetricsRegistry()
+    c = reg.counter("obs_overhead_c", "x")
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c.inc()
+    loop = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    render_prometheus(registry=reg)
+    render = time.perf_counter() - t0
+    assert render < 0.01 * loop, (
+        f"render {render * 1e6:.0f}us vs loop {loop * 1e6:.0f}us")
+
+
+# ---------------------------------------------------------------------------
+# monitor shim — one store per process
+# ---------------------------------------------------------------------------
+
+def test_monitor_shim_shares_registry_store():
+    from paddle_tpu.utils import monitor
+    monitor.stat_reset("obs_shim_g")
+    assert monitor.stat_update("obs_shim_g", 5) == 5
+    assert monitor.stat_update("obs_shim_g", -2) == 3
+    assert monitor.stat_peak("obs_shim_g") == 5
+    # the registry snapshot sees the same cell (no shadow store)
+    series = {s["name"]: s for s in get_registry().snapshot()}
+    assert series["obs_shim_g"]["value"] == 3.0
+    assert monitor.get_monitor_values()["obs_shim_g"] == 3
+    monitor.stat_reset("obs_shim_g")
+    assert monitor.stat_get("obs_shim_g") == 0
+
+
+# ---------------------------------------------------------------------------
+# profiler export filename collision fix
+# ---------------------------------------------------------------------------
+
+def test_chrome_export_handlers_never_collide(tmp_path):
+    from paddle_tpu import profiler
+
+    d = str(tmp_path)
+    for _ in range(2):   # two handlers, same worker name, same second
+        p = profiler.Profiler(
+            on_trace_ready=profiler.export_chrome_tracing(d, "w"))
+        p.start()
+        with profiler.RecordEvent("e"):
+            pass
+        p.stop()
+    traces = list(tmp_path.glob("w_time_*.paddle_trace.json"))
+    assert len(traces) == 2, [t.name for t in traces]
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+def test_continuous_batcher_populates_serving_metrics():
+    from paddle_tpu.inference.serving import ContinuousBatcher
+    from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+
+    reg = get_registry()
+
+    def dense(name):
+        return reg.get(name).labels(engine="dense")
+
+    before_reqs = dense("serving_requests_total").value \
+        if reg.get("serving_requests_total") else 0
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=128, hidden_size=32, num_hidden_layers=1,
+                     num_attention_heads=2, max_position_embeddings=64,
+                     dropout=0.0)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(3)
+    with paddle.no_grad():
+        b = ContinuousBatcher(m, max_batch=2, s_max=32, compile=False)
+        rids = [b.submit(rng.randint(0, 128, (5,)), 4) for _ in range(3)]
+        outs = b.run_until_done()
+    assert set(outs) == set(rids)
+
+    assert dense("serving_requests_total").value == before_reqs + 3
+    # all drained: depth gauge back to zero, but its peak saw the queue
+    assert dense("serving_queue_depth").value == 0
+    assert dense("serving_queue_depth").peak >= 1
+    ttft = dense("serving_ttft_seconds")
+    assert ttft.count >= 3
+    assert sum(ttft.bucket_counts()) == ttft.count
+    assert dense("serving_tokens_total").value >= 12
+    # the local stats() contract survived the refactor
+    s = b.stats()
+    assert s["completed_requests"] == 3
+    assert s["generated_tokens"] == 12
+    assert s["pending_now"] == 0 and s["active_now"] == 0
+    b.reset_stats()
+    assert b.stats()["completed_requests"] == 0
+    # per-instance reset must NOT clear the process-wide cumulative series
+    assert dense("serving_requests_total").value == before_reqs + 3
+
+
+def test_prometheus_dump_after_serving_has_populated_families():
+    from paddle_tpu.inference.serving import _ServingStats
+    _ServingStats("dense")   # idempotent: children are shared by series key
+    text = render_prometheus()
+    assert "# TYPE serving_requests_total counter" in text
+    assert "# TYPE serving_ttft_seconds histogram" in text
+    assert "# TYPE serving_queue_depth gauge" in text

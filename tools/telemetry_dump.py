@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Dump the paddle_tpu telemetry registry (Prometheus text or JSONL).
+
+Two modes:
+
+  * default — run a small demo workload in-process (a ContinuousBatcher
+    decode over a tiny GPT-2 plus a few hapi train steps) so the dump
+    shows every instrumented subsystem populated, then render the live
+    registry. This is the zero-to-metrics smoke path:
+
+        python tools/telemetry_dump.py --format prometheus
+
+  * --snapshot PATH — skip the workload and re-render a JSONL snapshot a
+    previous run wrote (bench.py writes BENCH_TELEMETRY.jsonl; any
+    process can via paddle_tpu.observability.write_jsonl).
+
+Registries are per-process: a dump can only show series recorded in THIS
+process (live mode) or captured in a snapshot file — there is no cross-
+process scrape endpoint here.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# CPU by default so the tool runs anywhere (flag through to TPU by
+# exporting JAX_PLATFORMS yourself); must precede the jax import chain
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _demo_workload():
+    """Touch every instrumented subsystem once: serving + training."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ContinuousBatcher
+    from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64,
+                     dropout=0.0)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    with paddle.no_grad():
+        b = ContinuousBatcher(m, max_batch=2, s_max=32, compile=False)
+        for s, n in ((5, 6), (9, 4), (7, 5)):
+            b.submit(rng.randint(0, 128, (s,)), n)
+        b.run_until_done()
+
+    from paddle_tpu import hapi, nn, optimizer
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = hapi.Model(net)
+    model.prepare(optimizer=optimizer.SGD(learning_rate=0.1,
+                                          parameters=net.parameters()),
+                  loss=nn.MSELoss())
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randn(16, 1).astype(np.float32)
+    for _ in range(4):
+        model.train_batch(x, y)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--format", choices=("prometheus", "jsonl"),
+                    default="prometheus")
+    ap.add_argument("--snapshot", metavar="PATH", default=None,
+                    help="render this JSONL snapshot instead of running "
+                         "the demo workload")
+    ap.add_argument("--out", metavar="PATH", default=None,
+                    help="write here instead of stdout")
+    ap.add_argument("--no-workload", action="store_true",
+                    help="live mode without the demo workload (dumps "
+                         "whatever this process has recorded, i.e. "
+                         "nothing unless you imported + ran paddle_tpu "
+                         "code first)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability import export as _export
+
+    if args.snapshot:
+        series = _export.load_jsonl(args.snapshot)
+    else:
+        if not args.no_workload:
+            _demo_workload()
+        series = _export.snapshot_series()
+
+    if args.format == "prometheus":
+        text = _export.render_prometheus(series=series)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+    else:
+        if args.out:
+            _export.write_jsonl(args.out, series=series)
+        else:
+            import json
+            for s in series:
+                sys.stdout.write(json.dumps(s) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
