@@ -70,7 +70,8 @@ from .collective import (ParallelMode, ReduceType, alltoall_single,  # noqa: E40
                          scatter_object_list)
 from . import launch  # noqa: E402
 from .watchdog import (CollectiveWatchdog, disable_collective_watchdog,  # noqa: E402
-                       enable_collective_watchdog)
+                       enable_collective_watchdog, get_watchdog,
+                       reset_watchdog)
 from ..framework import io  # noqa: E402  (paddle.distributed.io alias)
 
 

@@ -6,8 +6,17 @@ Reference: distributed/checkpoint/metadata.py:20-40 — LocalTensorMetadata
 """
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
+
+
+def chunk_crc(arr) -> int:
+    """crc32 over a chunk's raw bytes — the ONE checksum definition the
+    saver (save_load.py) and validator (resilience.checkpoint_manager)
+    share."""
+    import numpy as np
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
 @dataclass
@@ -16,6 +25,10 @@ class LocalTensorMetadata:
     global_offset: Tuple[int, ...]
     local_shape: Tuple[int, ...]
     dtype: str
+    # crc32 of the stored bytes; None on checkpoints written before
+    # checksums existed (loaders must getattr — old pickles restore
+    # without this attribute at all)
+    checksum: Optional[int] = None
 
 
 @dataclass

@@ -25,10 +25,22 @@ import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...resilience.chaos import torn_write_bytes
 from .metadata import (LocalTensorIndex, LocalTensorMetadata, Metadata,
-                       TensorMetadata)
+                       TensorMetadata, chunk_crc)
 
 _METADATA_FILE = "metadata.pkl"
+
+
+def _atomic_write(final_path: str, data: bytes):
+    """Crash-safe file publish: bytes land in a sibling temp file (through
+    the ``checkpoint.write`` chaos point, so torn-write drills cut THERE)
+    and only a complete temp file is renamed over the final name — a
+    mid-write kill can no longer leave a corrupt file at the path a
+    loader trusts."""
+    tmp = final_path + ".tmp"
+    torn_write_bytes(tmp, data, point="checkpoint.write")
+    os.replace(tmp, final_path)
 
 
 def _flatten(state_dict, prefix=""):
@@ -99,7 +111,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             arrays[cid] = data                   # rides the metadata
             tmeta.chunks.append(LocalTensorMetadata(
                 global_offset=offset, local_shape=local,
-                dtype=str(arr.dtype)))
+                dtype=str(arr.dtype), checksum=chunk_crc(data)))
             meta.storage_metadata[cid] = fname
         meta.state_dict_metadata[key] = tmeta
 
@@ -109,9 +121,13 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
     meta_name = f"metadata.{jax.process_index()}.pkl"
 
     def write():
-        np.savez(os.path.join(path, fname), **arrays)
-        with open(os.path.join(path, meta_name), "wb") as f:
-            pickle.dump(meta, f)
+        import io as _io
+        buf = _io.BytesIO()
+        np.savez(buf, **arrays)
+        # data first, metadata last: a crash between the two leaves a
+        # data file no metadata references — dead bytes, not corruption
+        _atomic_write(os.path.join(path, fname), buf.getvalue())
+        _atomic_write(os.path.join(path, meta_name), pickle.dumps(meta))
 
     if async_save:
         # device->host copies already happened above (np.asarray); only the

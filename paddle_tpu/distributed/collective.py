@@ -272,6 +272,8 @@ def _watched(name):
                 nb = getattr(getattr(t, "_data", t), "nbytes", 0)
                 if nb:
                     bytes_c.labels(op=name).inc(int(nb))
+            from ..resilience.chaos import fault_point
+            fault_point("collective.enter")  # chaos drills; no-op unarmed
             t0 = _time.perf_counter()
             try:
                 from . import watchdog as _wd

@@ -17,6 +17,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
+from ...resilience.retry import RetryGiveUp, RetryPolicy
 from ..launch.controllers import KVClient, Watcher
 
 ELASTIC_EXIT_CODE = 101  # reference's elastic restart exit code
@@ -35,8 +36,12 @@ class ElasticManager:
 
     def __init__(self, master_endpoint: str, job_id: str, rank: int,
                  np: int, min_np: Optional[int] = None,
-                 max_np: Optional[int] = None, heartbeat_ttl: float = 30.0):
-        self.client = KVClient(master_endpoint)
+                 max_np: Optional[int] = None, heartbeat_ttl: float = 30.0,
+                 retry: Optional[RetryPolicy] = None):
+        # the shared retry policy rides INSIDE the KVClient: every
+        # membership request backs off through master blips instead of
+        # propagating and killing the trainer
+        self.client = KVClient(master_endpoint, retry=retry)
         self.job_id = job_id
         self.rank = rank
         self.np = np
@@ -45,32 +50,69 @@ class ElasticManager:
         self.ttl = heartbeat_ttl
         self.enable = True
         self._prefix = f"elastic/{job_id}"
+        self._endpoint: Optional[str] = None   # what we registered as
+        self._master_was_down = False
+        self._last_alive: List[int] = []
+        self.reregistrations = 0
 
     # -- membership (manager.py:192-197 register path) ----------------------
     def register(self, endpoint: str):
+        self._endpoint = endpoint
         self.client.put(f"{self._prefix}/nodes/{self.rank}", endpoint)
         self.heartbeat()
 
     def deregister(self):
-        import urllib.request
-        req = urllib.request.Request(
-            f"{self.client.endpoint}/{self._prefix}/nodes/{self.rank}",
-            method="DELETE")
-        urllib.request.urlopen(req, timeout=5).read()
+        self._endpoint = None
+        self.client.delete(f"{self._prefix}/nodes/{self.rank}")
 
-    def heartbeat(self):
-        self.client.put(f"{self._prefix}/heartbeat/{self.rank}",
-                        str(time.time()))
+    def _reregister_if_lost(self):
+        """A master that died and came back serves an EMPTY store: our
+        nodes/<rank> key is gone even though this host never left. Put it
+        back instead of letting the next scale decision read this rank as
+        departed."""
+        if self._endpoint is None:
+            return
+        if self.client.get(f"{self._prefix}/nodes/{self.rank}") is None:
+            self.client.put(f"{self._prefix}/nodes/{self.rank}",
+                            self._endpoint)
+            self.reregistrations += 1
+            from ...observability.metrics import get_registry
+            get_registry().counter(
+                "recoveries_total", "successful recovery actions, by kind",
+                labelnames=("kind",)).labels(kind="reregister").inc()
+
+    def heartbeat(self) -> bool:
+        """Publish liveness; tolerate a down master (returns False — the
+        beat thread keeps trying; registration is restored on the first
+        beat that gets through after an outage)."""
+        try:
+            if self._master_was_down:
+                self._master_was_down = False
+                self._reregister_if_lost()
+            self.client.put(f"{self._prefix}/heartbeat/{self.rank}",
+                            str(time.time()))
+            return True
+        except (RetryGiveUp, OSError):
+            self._master_was_down = True
+            return False
 
     def alive_nodes(self) -> List[int]:
         now = time.time()
         alive = []
-        for key, val in self.client.get_all().items():
+        try:
+            kv = self.client.get_all()
+        except (RetryGiveUp, OSError):
+            # master unreachable: report the last observed membership —
+            # an empty answer would read as "everyone died" and trigger a
+            # pointless scale decision during a master restart
+            return list(self._last_alive)
+        for key, val in kv.items():
             if key.startswith(f"{self._prefix}/heartbeat/"):
                 rank = int(key.rsplit("/", 1)[1])
                 if now - float(val) <= self.ttl:
                     alive.append(rank)
-        return sorted(alive)
+        self._last_alive = sorted(alive)
+        return self._last_alive
 
     # -- scale decisions (manager.py watch loop) ----------------------------
     def need_scale(self) -> bool:

@@ -98,29 +98,61 @@ class KVServer:
 
 
 class KVClient:
-    """Peer side of the master KV."""
+    """Peer side of the master KV.
 
-    def __init__(self, endpoint: str):
+    Requests run under the shared resilience RetryPolicy: transient
+    connection failures (a master mid-restart, an injected ``kv.request``
+    chaos fault) back off and retry instead of killing the caller.
+    HTTPError is a deliberate give-up (it IS a server answer — 404 has
+    semantics here), and the ``kv.request`` fault point fires inside the
+    retried body so chaos drills exercise the loop."""
+
+    def __init__(self, endpoint: str, retry=None):
         self.endpoint = endpoint.rstrip("/")
+        if retry is None:
+            from ...resilience.retry import RetryPolicy
+            retry = RetryPolicy(max_attempts=4, base_delay=0.05,
+                                max_delay=1.0, deadline=10.0)
+        if urllib.error.HTTPError not in retry.giveup:
+            # the 404 -> None contract must hold under ANY policy: an HTTP
+            # status is a server answer, never a transient to retry here
+            import dataclasses
+            retry = dataclasses.replace(
+                retry,
+                giveup=tuple(retry.giveup) + (urllib.error.HTTPError,))
+        self.retry = retry
+
+    def _open(self, req_or_url):
+        from ...resilience.chaos import fault_point
+        fault_point("kv.request")
+        return urllib.request.urlopen(req_or_url, timeout=5)
 
     def put(self, key: str, value: str):
         req = urllib.request.Request(f"{self.endpoint}/{key}",
                                      data=value.encode(), method="PUT")
-        urllib.request.urlopen(req, timeout=5).read()
+        self.retry.call(lambda: self._open(req).read(), point="kv.put")
 
     def get(self, key: str) -> Optional[str]:
-        try:
-            with urllib.request.urlopen(f"{self.endpoint}/{key}",
-                                        timeout=5) as r:
+        def fetch():
+            with self._open(f"{self.endpoint}/{key}") as r:
                 return r.read().decode()
+        try:
+            return self.retry.call(fetch, point="kv.get")
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 return None
             raise
 
+    def delete(self, key: str):
+        req = urllib.request.Request(f"{self.endpoint}/{key}",
+                                     method="DELETE")
+        self.retry.call(lambda: self._open(req).read(), point="kv.delete")
+
     def get_all(self) -> Dict[str, str]:
-        with urllib.request.urlopen(self.endpoint + "/", timeout=5) as r:
-            return json.loads(r.read().decode())
+        def fetch():
+            with self._open(self.endpoint + "/") as r:
+                return json.loads(r.read().decode())
+        return self.retry.call(fetch, point="kv.get_all")
 
     def wait(self, key: str, timeout: float = 60.0,
              interval: float = 0.5) -> str:

@@ -112,10 +112,21 @@ def get_current_worker_info() -> WorkerInfo:
     return get_worker_info(_state["name"])
 
 
+# Connection ESTABLISHMENT retries under the shared policy (a peer whose
+# RPC server is still booting, or an injected transient fault); the
+# payload exchange itself is NOT retried — an RPC body is not known to be
+# idempotent, and replaying one on a flaky link could run it twice.
+def _connect_retry():
+    from ..resilience.retry import RetryPolicy
+    return RetryPolicy(max_attempts=4, base_delay=0.05, max_delay=1.0,
+                       deadline=10.0)
+
+
 def _call(to, fn, args, kwargs, timeout):
     info = _state["peers"][to]
-    with socket.create_connection((info.ip, info.port),
-                                  timeout=timeout or None) as s:
+    with _connect_retry().call(
+            socket.create_connection, (info.ip, info.port),
+            timeout=timeout or None, point="rpc.connect") as s:
         wfile = s.makefile("wb")
         rfile = s.makefile("rb")
         pickle.dump((fn, args or (), kwargs or {}), wfile)

@@ -24,7 +24,7 @@ from typing import Callable, Dict, List, Optional
 
 __all__ = ["CollectiveWatchdog", "DesyncError",
            "enable_collective_watchdog", "disable_collective_watchdog",
-           "get_watchdog"]
+           "get_watchdog", "reset_watchdog"]
 
 _ACTIVE: List[Optional["CollectiveWatchdog"]] = [None]
 
@@ -111,6 +111,20 @@ class CollectiveWatchdog:
         with self._lock:
             self._inside = False
             self._publish(done=True)
+
+    def reset(self) -> Optional[dict]:
+        """Clear the poisoned desync state after the application handled
+        it (re-formed the group, restarted the straggler, ...). Without
+        this, one report makes EVERY later enter() raise — the watchdog
+        could flag but never participate in recovery. Returns the report
+        it cleared (None if it wasn't poisoned) and republishes this
+        rank's record as idle so peers don't read the stale in-collective
+        entry as a hang."""
+        with self._lock:
+            report, self._poison = self._poison, None
+            self._inside = False
+            self._publish(done=True)
+        return report
 
     @property
     def seq(self) -> int:
@@ -266,6 +280,14 @@ def disable_collective_watchdog():
 
 def get_watchdog() -> Optional[CollectiveWatchdog]:
     return _ACTIVE[0]
+
+
+def reset_watchdog() -> Optional[dict]:
+    """reset() on the active watchdog (no-op, returning None, when none
+    is armed) — the recovery path's counterpart to
+    enable_collective_watchdog."""
+    wd = _ACTIVE[0]
+    return wd.reset() if wd is not None else None
 
 
 def watch(op_name: str, tensor=None):

@@ -50,6 +50,8 @@ class Model:
         self._metrics: List[Metric] = []
         self._prepared = False
         self.stop_training = False
+        self._step_guard = None
+        self._ckpt_include_optimizer = True
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -83,13 +85,33 @@ class Model:
         return loss, labels
 
     def train_batch(self, inputs, labels=None, update=True):
-        """model.py train_batch analog: one eager forward/backward/(step)."""
+        """model.py train_batch analog: one eager forward/backward/(step).
+
+        With a step guard enabled (enable_step_guard), a non-finite loss
+        SKIPS backward + optimizer.step (NaN gradients would poison every
+        weight), counts the skip, and after K consecutive bad steps rolls
+        the model back to the last valid checkpoint."""
         import time as _time
         assert self._prepared, "call prepare() first"
         self.network.train()
         t0 = _time.perf_counter()
         outputs = self._forward(inputs)
         loss, labels_t = self._compute_loss(outputs, labels)
+        from ..resilience.chaos import fault_point
+        spec = fault_point("train.step")
+        if spec is not None and spec.kind == "nan_grad":
+            # the injected divergence: a NaN loss whose backward would
+            # produce NaN gradients — exactly what the guard exists for
+            loss = loss * float("nan")
+        if self._step_guard is not None \
+                and self._step_guard.observe(float(loss)) != "ok":
+            # skip: no backward, no step; drop any accumulated gradients
+            # (they may predate the rollback's restored weights)
+            if self._optimizer is not None:
+                self._optimizer.clear_grad()
+            metrics = self._update_metrics(outputs, labels_t)
+            self._observe_train_step(_time.perf_counter() - t0, inputs)
+            return self._wrap_loss(loss, metrics)
         loss.backward()
         if update:
             self._optimizer.step()
@@ -97,6 +119,43 @@ class Model:
         metrics = self._update_metrics(outputs, labels_t)
         self._observe_train_step(_time.perf_counter() - t0, inputs)
         return self._wrap_loss(loss, metrics)
+
+    # -- resilience ----------------------------------------------------------
+    def _checkpoint_state(self):
+        """The ONE state-dict shape save_checkpoint and the rollback
+        restore share (live tensors: restore fills them in place)."""
+        sd = {"model": self.network.state_dict()}
+        if self._ckpt_include_optimizer and self._optimizer is not None:
+            sd["opt"] = self._optimizer.state_dict()
+        return sd
+
+    def save_checkpoint(self, manager, step: int, blocking: bool = True):
+        """Publish model (+ optimizer) state through a resilience
+        CheckpointManager (atomic, checksummed, retained)."""
+        return manager.save(self._checkpoint_state(), step,
+                            blocking=blocking)
+
+    def enable_step_guard(self, rollback_after: Optional[int] = None,
+                          checkpoint_manager=None,
+                          include_optimizer: bool = True):
+        """Arm the non-finite-loss policy on train_batch: skip + count
+        every bad step; with `checkpoint_manager` (and `rollback_after`
+        = K), the K-th CONSECUTIVE bad step restores the newest valid
+        checkpoint saved via save_checkpoint. Returns the StepGuard (its
+        ``skipped`` / ``rollbacks`` counters are the test surface)."""
+        from ..resilience.recovery import StepGuard
+        self._ckpt_include_optimizer = include_optimizer
+        restore_fn = None
+        if checkpoint_manager is not None:
+            def restore_fn():
+                return checkpoint_manager.restore_latest(
+                    self._checkpoint_state())
+        self._step_guard = StepGuard(rollback_after=rollback_after,
+                                     restore_fn=restore_fn)
+        return self._step_guard
+
+    def disable_step_guard(self):
+        self._step_guard = None
 
     def _observe_train_step(self, dt, inputs):
         """Feed the telemetry registry: step latency, throughput, MFU."""

@@ -33,6 +33,7 @@ class Request:
     # tokens while it waits for re-admission — it is not done
     finished: bool = False
     submit_t: float = 0.0       # perf_counter at submit (TTFT anchor)
+    deadline_t: Optional[float] = None  # perf_counter; None = no deadline
 
     @property
     def done(self) -> bool:
@@ -84,6 +85,10 @@ class _ServingStats:
                               "one decode dispatch wall time")
         self.token_seconds = h("serving_per_token_seconds",
                                "per-token decode latency")
+        self.shed_c = c("requests_shed_total",
+                        "requests rejected at admission (queue full)")
+        self.expired_c = c("serving_deadline_expired_total",
+                           "requests abandoned on an expired deadline")
         self.reset()
 
     def reset(self):
@@ -98,6 +103,8 @@ class _ServingStats:
         self.cachekv_clipped = 0
         self.warned_cachekv_clip = False
         self.decode_blocks = 0
+        self.shed = 0
+        self.expired = 0
         self.t0 = _time.perf_counter()
 
     # -- events -------------------------------------------------------------
@@ -137,6 +144,14 @@ class _ServingStats:
         self.decode_blocks += 1
         self.blocks_c.inc()
 
+    def on_shed(self):
+        self.shed += 1
+        self.shed_c.inc()
+
+    def on_deadline_expired(self):
+        self.expired += 1
+        self.expired_c.inc()
+
     def on_cachekv(self, clipped: int, total: int):
         self.cachekv_elems += total
         self.cachekv_clipped += clipped
@@ -164,27 +179,39 @@ class _ServingStats:
             "cachekv_clip_rate": (self.cachekv_clipped
                                   / max(self.cachekv_elems, 1)),
             "decode_blocks": self.decode_blocks,
+            "requests_shed": self.shed,
+            "deadline_expired": self.expired,
         }
 
 
 class _BatcherBase:
     """Request lifecycle shared by the dense-slot and paged batchers:
-    FIFO submission, finish-on-EOS-or-budget, result retrieval, and the
-    drive loop. Subclasses own the cache layout and implement
-    ``_release_slot(slot)`` (return the slot's memory to their pool) plus
-    ``step()``."""
+    FIFO submission, finish-on-EOS-or-budget, result retrieval, deadline
+    expiry + load shedding, health reporting, and the drive loop.
+    Subclasses own the cache layout and implement ``_release_slot(slot)``
+    (return the slot's memory to their pool) plus ``_step_impl()`` (one
+    engine step; the base ``step()`` wraps it with deadline/health/chaos
+    policy)."""
 
     _engine = "serving"        # registry label; subclasses override
 
-    def _init_queues(self):
+    def _init_queues(self, max_queue_depth: Optional[int] = None,
+                     default_deadline_s: Optional[float] = None):
         self._slot_req: Dict[int, Request] = {}
         self._pending: List[Request] = []
         self._finished: Dict[int, Request] = {}
+        self._failed: Dict[int, Exception] = {}
         self._next_rid = 0
+        self._max_queue_depth = max_queue_depth
+        self._default_deadline_s = default_deadline_s
         # serving observability (reference analog: the predictor's
         # benchmark counters): per-instance totals via stats(), process-
         # wide serving_* series via the observability registry
         self._tele = _ServingStats(self._engine)
+        from ..resilience.recovery import HealthStateMachine
+        self.health = HealthStateMachine(
+            capacity=max_queue_depth or 2 * self.max_batch,
+            engine=self._engine)
 
     def reset_stats(self):
         """Zero the counters and restart the clock — call after warmup so
@@ -233,15 +260,88 @@ class _BatcherBase:
             raise ValueError(f"prompt {len(prompt)} + {max_new_tokens} "
                              f"exceeds slot capacity {self.s_max}")
 
-    def submit(self, prompt_ids, max_new_tokens: int) -> int:
+    def submit(self, prompt_ids, max_new_tokens: int,
+               deadline_s: Optional[float] = None) -> int:
+        """Queue a request. Raises typed ``Overloaded`` when the pending
+        queue is at ``max_queue_depth`` (load shedding — a fronting layer
+        maps it to 429). ``deadline_s`` (or the batcher's default) bounds
+        the request's total latency: an expired request is abandoned at
+        the next step boundary and its result() raises
+        ``DeadlineExceeded``."""
         prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
         self._validate(prompt, max_new_tokens)
+        if self._max_queue_depth is not None \
+                and len(self._pending) >= self._max_queue_depth:
+            from ..resilience.recovery import Overloaded
+            self._tele.on_shed()
+            self.health.on_shed()
+            raise Overloaded(
+                f"pending queue at capacity "
+                f"({len(self._pending)}/{self._max_queue_depth})")
         rid = self._next_rid
         self._next_rid += 1
-        self._pending.append(Request(rid, prompt, max_new_tokens,
-                                     submit_t=_time.perf_counter()))
+        budget = deadline_s if deadline_s is not None \
+            else self._default_deadline_s
+        now = _time.perf_counter()
+        self._pending.append(Request(
+            rid, prompt, max_new_tokens, submit_t=now,
+            deadline_t=None if budget is None else now + budget))
         self._tele.on_submit(len(self._pending))
         return rid
+
+    def _fail(self, req: Request, exc: Exception):
+        req.slot = None
+        req.finished = True
+        self._failed[req.rid] = exc
+
+    def _expire_deadlines(self):
+        """Abandon requests whose deadline passed — pending ones silently
+        leave the queue, active ones release their slot (and cache
+        memory) so live traffic gets the capacity back."""
+        from ..resilience.recovery import DeadlineExceeded
+        now = _time.perf_counter()
+
+        def expired(r: Request) -> bool:
+            return r.deadline_t is not None and now > r.deadline_t
+
+        for req in [r for r in self._pending if expired(r)]:
+            self._pending.remove(req)
+            self._fail(req, DeadlineExceeded(
+                f"request {req.rid} expired while queued"))
+            self._tele.on_deadline_expired()
+        for slot, req in list(self._slot_req.items()):
+            if expired(req):
+                del self._slot_req[slot]
+                self._release_slot(slot)
+                self._fail(req, DeadlineExceeded(
+                    f"request {req.rid} expired after "
+                    f"{len(req.tokens)} tokens"))
+                self._tele.on_deadline_expired()
+        adm = getattr(self, "_admitting", None)
+        if adm is not None and expired(adm["req"]):
+            # in-flight fused admission: pages back to the pool
+            self._release_row(adm["row"])
+            self._free_slots.append(adm["slot"])
+            self._admitting = None
+            self._fail(adm["req"], DeadlineExceeded(
+                f"request {adm['req'].rid} expired during admission"))
+            self._tele.on_deadline_expired()
+
+    def step(self) -> List[int]:
+        """Expire deadlines, then run one engine step (subclass
+        ``_step_impl``); feeds the health state machine and the
+        ``serving.step`` chaos point. Returns rids finishing during THIS
+        call."""
+        self._expire_deadlines()
+        try:
+            from ..resilience.chaos import fault_point
+            fault_point("serving.step")
+            finished = self._step_impl()
+        except Exception:
+            self.health.on_step_error()
+            raise
+        self.health.on_step_ok(len(self._pending))
+        return finished
 
     def _pick(self, logits_np):
         """Next-token selection (greedy or sampled) on host logits [B, V];
@@ -268,7 +368,11 @@ class _BatcherBase:
         raise NotImplementedError
 
     def result(self, rid: int) -> np.ndarray:
-        """Full sequence (prompt + generated) of a finished request."""
+        """Full sequence (prompt + generated) of a finished request.
+        Raises the request's typed failure (``DeadlineExceeded``) if it
+        was abandoned instead of completed."""
+        if rid in self._failed:
+            raise self._failed[rid]
         req = self._finished[rid]
         return np.concatenate([req.prompt, np.asarray(req.tokens)])
 
@@ -276,6 +380,8 @@ class _BatcherBase:
         """result() + release the request's memory — long-lived batchers
         must pop (or use run_until_done, which pops) or _finished grows
         with every request ever served."""
+        if rid in self._failed:
+            raise self._failed.pop(rid)
         out = self.result(rid)
         del self._finished[rid]
         return out
@@ -322,7 +428,9 @@ class ContinuousBatcher(_BatcherBase):
                  eos_id: Optional[int] = None, compile: bool = True,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: Optional[float] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None):
         import paddle_tpu as paddle
 
         self.model = model
@@ -344,7 +452,8 @@ class ContinuousBatcher(_BatcherBase):
                                     dtype=cfg.dtype)
         self._t = np.full((max_batch, 1), s_max - 1, np.int32)  # parked
         self._free = list(range(max_batch))
-        self._init_queues()
+        self._init_queues(max_queue_depth=max_queue_depth,
+                          default_deadline_s=default_deadline_s)
         self._last_tok = np.zeros((max_batch, 1), np.int64)
         if compile:
             from .. import jit
@@ -389,7 +498,7 @@ class ContinuousBatcher(_BatcherBase):
         return finished
 
     # -- the engine ---------------------------------------------------------
-    def step(self) -> List[int]:
+    def _step_impl(self) -> List[int]:
         """Admit, decode one token for every active slot, evict finished.
         Returns the rids that finished during THIS call (including ones
         that finished at admission)."""
@@ -472,7 +581,9 @@ class PagedContinuousBatcher(_BatcherBase):
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: Optional[float] = None,
                  seed: Optional[int] = None,
-                 decode_block: Optional[int] = None):
+                 decode_block: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None):
         import paddle_tpu as paddle
 
         if policy not in ("reserve", "ondemand"):
@@ -541,7 +652,8 @@ class PagedContinuousBatcher(_BatcherBase):
                            np.int32)
         self._dec = np.zeros((max_batch,), np.int32)
         self._free_slots = list(range(max_batch))
-        self._init_queues()
+        self._init_queues(max_queue_depth=max_queue_depth,
+                          default_deadline_s=default_deadline_s)
         self._admit_order: List[int] = []           # slots, oldest first
         self._last_tok = np.zeros((max_batch,), np.int64)
 
@@ -1199,7 +1311,7 @@ class PagedContinuousBatcher(_BatcherBase):
                     finished.append(req.rid)
 
     # -- the engine ---------------------------------------------------------
-    def step(self) -> List[int]:
+    def _step_impl(self) -> List[int]:
         """Admit, grow pages (ondemand), decode one token per active slot,
         evict finished. Returns rids finishing during THIS call."""
         if self.fused_admission:

@@ -169,6 +169,8 @@ class _MultiprocessIter:
                             f"{self._loader.timeout}s waiting for a batch")
 
     def __next__(self):
+        from ..resilience.chaos import fault_point
+        fault_point("dataloader.next")  # chaos drills; no-op unarmed
         while True:
             if self._outstanding == 0:
                 self._stop()
@@ -224,6 +226,8 @@ class _SingleProcessIter:
             self._stream = iter(self._dataset)
 
     def __next__(self):
+        from ..resilience.chaos import fault_point
+        fault_point("dataloader.next")  # chaos drills; no-op unarmed
         indices = next(self._batches)
         if self._iterable:
             samples = list(itertools.islice(self._stream, len(indices)))

@@ -1,0 +1,46 @@
+"""paddle_tpu.resilience — fault injection, retry, and recovery.
+
+The resilience layer ties the pieces the distributed stack already has
+(watchdog, elastic manager, restart budgets, sharded checkpointing) into
+recovery loops that are PROVABLE under injected failures on CPU today:
+
+  * ``chaos``              — deterministic, seed-driven fault injection
+    at named points (``checkpoint.write``, ``collective.enter``,
+    ``serving.step``, ``kv.request``, ``dataloader.next``,
+    ``train.step``), armed via ``PADDLE_CHAOS`` scenario specs.
+  * ``retry``              — the shared exponential-backoff/deadline
+    policy the KVClient, rpc, elastic heartbeats, and checkpoint I/O use.
+  * ``checkpoint_manager`` — crash-safe checkpoint lifecycle: atomic
+    publish, per-array checksums, keep-last-N retention, async save,
+    and fallback ``restore_latest()``.
+  * ``recovery``           — ``StepGuard`` (non-finite-loss skip +
+    rollback), typed serving rejections (``Overloaded``,
+    ``DeadlineExceeded``), and the serving ``HealthStateMachine``.
+
+Everything reports through ``paddle_tpu.observability``
+(``faults_injected_total``, ``recoveries_total``,
+``checkpoint_restore_seconds``, ``requests_shed_total``, ...).
+"""
+from __future__ import annotations
+
+from . import chaos, checkpoint_manager, recovery, retry
+from .chaos import (ChaosError, ChaosRegistry, FaultSpec,
+                    TransientChaosError, TornWrite, arm_from_env,
+                    arm_scenario, disarm, fault_point, get_chaos,
+                    parse_scenario, torn_write_bytes)
+from .checkpoint_manager import (COMMITTED_MARKER, CheckpointManager,
+                                 validate_checkpoint)
+from .recovery import (DeadlineExceeded, HealthState, HealthStateMachine,
+                       Overloaded, StepGuard)
+from .retry import DEFAULT_RETRYABLE, RetryGiveUp, RetryPolicy
+
+__all__ = [
+    "chaos", "retry", "checkpoint_manager", "recovery",
+    "ChaosError", "TransientChaosError", "TornWrite", "FaultSpec",
+    "ChaosRegistry", "get_chaos", "fault_point", "arm_scenario",
+    "arm_from_env", "disarm", "parse_scenario", "torn_write_bytes",
+    "RetryPolicy", "RetryGiveUp", "DEFAULT_RETRYABLE",
+    "CheckpointManager", "COMMITTED_MARKER", "validate_checkpoint",
+    "StepGuard", "Overloaded", "DeadlineExceeded", "HealthState",
+    "HealthStateMachine",
+]

@@ -1,0 +1,243 @@
+"""Crash-safe checkpoint lifecycle over the sharded save/load.
+
+``CheckpointManager`` owns a checkpoint ROOT holding one directory per
+step and composes the guarantees the raw ``save_state_dict`` /
+``load_state_dict`` pair (distributed/checkpoint/save_load.py) does not:
+
+  * ATOMIC PUBLISH — a save writes into a hidden temp directory, drops a
+    terminal ``COMMITTED`` marker as its last file, then ``os.replace``s
+    the whole directory to its final ``step_N`` name. A kill at ANY byte
+    offset of any file leaves either (a) a garbage temp dir the next
+    save sweeps away, or (b) a fully-published checkpoint — never a
+    half-written "latest".
+  * INTEGRITY — per-array crc32 checksums ride the chunk metadata
+    (LocalTensorMetadata.checksum); ``validate()`` re-hashes every chunk.
+  * FALLBACK RESTORE — ``restore_latest()`` walks steps newest-first and
+    restores the newest checkpoint that VALIDATES, silently skipping
+    corrupt/uncommitted ones (counted, and surfaced in telemetry as
+    ``checkpoint_invalid_total`` + ``recoveries_total{kind=
+    checkpoint_fallback}``).
+  * RETENTION — keep-last-N published steps; temp debris is swept.
+  * ASYNC — ``save(..., blocking=False)`` publishes on a background
+    thread (``wait()`` joins and re-raises the first failure).
+  * RETRY — transient I/O failures (including injected
+    ``transient_error`` chaos at ``checkpoint.write``) retry under the
+    shared ``RetryPolicy``; torn writes are crashes and propagate.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .retry import RetryPolicy
+
+__all__ = ["CheckpointManager", "COMMITTED_MARKER", "validate_checkpoint"]
+
+COMMITTED_MARKER = "COMMITTED"
+_STEP_PREFIX = "step_"
+_TMP_PREFIX = ".tmp-"
+
+
+def validate_checkpoint(path: str) -> Tuple[bool, str]:
+    """(ok, reason). ok=True means the directory is committed, every
+    metadata file unpickles, and every chunk matches its stored checksum
+    (chunks saved before checksums existed — no ``checksum`` attribute —
+    pass, preserving old-checkpoint compatibility)."""
+    import numpy as np
+
+    from ..distributed.checkpoint.metadata import Metadata, chunk_crc
+    if not os.path.isdir(path):
+        return False, "not a directory"
+    if not os.path.exists(os.path.join(path, COMMITTED_MARKER)):
+        return False, "no COMMITTED marker"
+    meta_files = sorted(glob.glob(os.path.join(path, "metadata.*.pkl")))
+    legacy = os.path.join(path, "metadata.pkl")
+    if os.path.exists(legacy):
+        meta_files.append(legacy)
+    if not meta_files:
+        return False, "no metadata files"
+    try:
+        npz_cache: Dict[str, object] = {}
+        for fn in meta_files:
+            with open(fn, "rb") as f:
+                meta: Metadata = pickle.load(f)
+            for key, tmeta in meta.state_dict_metadata.items():
+                for chunk in tmeta.chunks:
+                    want = getattr(chunk, "checksum", None)
+                    if want is None:
+                        continue  # pre-checksum checkpoint
+                    cid = Metadata.chunk_id(key, chunk.global_offset)
+                    fname = meta.storage_metadata[cid]
+                    if fname not in npz_cache:
+                        npz_cache[fname] = np.load(
+                            os.path.join(path, fname))
+                    got = chunk_crc(npz_cache[fname][cid])
+                    if got != want:
+                        return False, (f"checksum mismatch for {cid} "
+                                       f"({got:#x} != {want:#x})")
+    except Exception as exc:  # noqa: BLE001 — any unreadable byte = invalid
+        return False, f"unreadable ({type(exc).__name__}: {exc})"
+    finally:
+        for f in npz_cache.values():
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001
+                pass
+    return True, "ok"
+
+
+class CheckpointManager:
+    """Atomic-publish checkpoint store rooted at one directory."""
+
+    def __init__(self, root: str, keep_last: int = 3,
+                 retry: Optional[RetryPolicy] = None):
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        self.root = root
+        self.keep_last = keep_last
+        self.retry = retry or RetryPolicy(max_attempts=3, base_delay=0.02,
+                                          max_delay=0.5)
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._errors: List[BaseException] = []
+        self._tmp_seq = 0
+        self.invalid_skipped = 0      # corrupt checkpoints seen by restore
+
+    # -- directory layout ---------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"{_STEP_PREFIX}{step:012d}")
+
+    def steps(self) -> List[int]:
+        """Published steps, ascending (committed or not — see validate)."""
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    out.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        s = self.steps()
+        return s[-1] if s else None
+
+    # -- save ----------------------------------------------------------------
+    def save(self, state_dict: Dict, step: int,
+             blocking: bool = True) -> str:
+        """Publish `state_dict` as step `step`. blocking=False snapshots
+        device arrays to host NOW (inside save_state_dict) but runs the
+        file I/O + publish on a background thread; join with wait()."""
+        final = self._step_dir(step)
+        if blocking:
+            self._publish(state_dict, step, final)
+            return final
+
+        def run():
+            try:
+                self._publish(state_dict, step, final)
+            except BaseException as exc:  # noqa: BLE001 — surfaced by wait()
+                self._errors.append(exc)
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"ckpt-save-{step}")
+        t.start()
+        self._threads.append(t)
+        return final
+
+    def wait(self):
+        """Join outstanding async saves; re-raise the first failure."""
+        while self._threads:
+            self._threads.pop().join()
+        if self._errors:
+            raise self._errors.pop(0)
+
+    def _publish(self, state_dict: Dict, step: int, final: str):
+        from ..distributed.checkpoint.save_load import save_state_dict
+        with self._lock:
+            self._tmp_seq += 1
+            tmp = os.path.join(
+                self.root,
+                f"{_TMP_PREFIX}{_STEP_PREFIX}{step}-{os.getpid()}"
+                f"-{self._tmp_seq}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        try:
+            # transient I/O errors retry (each inner file write is itself
+            # temp+replace, so a retried save just overwrites); a torn
+            # write is a CRASH and propagates out of the retry filter
+            self.retry.call(save_state_dict, state_dict, tmp,
+                            point="checkpoint.write")
+            # terminal marker: written LAST inside the temp dir, so any
+            # directory carrying it holds a complete file set
+            marker = os.path.join(tmp, COMMITTED_MARKER)
+            with open(marker, "w") as f:
+                json.dump({"step": step}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            with self._lock:
+                if os.path.exists(final):
+                    shutil.rmtree(final)   # idempotent re-save of a step
+                os.replace(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._apply_retention()
+
+    def _apply_retention(self):
+        with self._lock:
+            steps = self.steps()
+            for s in steps[:-self.keep_last]:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            # sweep temp debris from crashed saves of THIS root
+            for d in glob.glob(os.path.join(self.root, _TMP_PREFIX + "*")):
+                try:
+                    age = time.time() - os.path.getmtime(d)
+                except OSError:
+                    continue
+                if age > 60.0:   # live async saves are younger than this
+                    shutil.rmtree(d, ignore_errors=True)
+
+    # -- restore -------------------------------------------------------------
+    def validate(self, step: int) -> Tuple[bool, str]:
+        return validate_checkpoint(self._step_dir(step))
+
+    def restore_latest(self, state_dict: Dict) -> Optional[int]:
+        """Fill `state_dict` in place from the newest VALID checkpoint;
+        returns its step, or None when no valid checkpoint exists.
+        Corrupt/uncommitted newer checkpoints are skipped (counted)."""
+        from ..distributed.checkpoint.save_load import load_state_dict
+        restore_h, invalid_c, recoveries_c = self._metrics()
+        skipped = 0
+        for step in reversed(self.steps()):
+            ok, reason = self.validate(step)
+            if not ok:
+                skipped += 1
+                self.invalid_skipped += 1
+                invalid_c.inc()
+                continue
+            t0 = time.perf_counter()
+            load_state_dict(state_dict, self._step_dir(step))
+            restore_h.observe(time.perf_counter() - t0)
+            if skipped:
+                recoveries_c.labels(kind="checkpoint_fallback").inc()
+            return step
+        return None
+
+    def _metrics(self):
+        from ..observability.metrics import get_registry
+        reg = get_registry()
+        return (reg.histogram("checkpoint_restore_seconds",
+                              "restore_latest load time"),
+                reg.counter("checkpoint_invalid_total",
+                            "corrupt/uncommitted checkpoints skipped"),
+                reg.counter("recoveries_total",
+                            "successful recovery actions, by kind",
+                            labelnames=("kind",)))

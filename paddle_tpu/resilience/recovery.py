@@ -1,0 +1,183 @@
+"""Recovery policies at the training and serving seams.
+
+Three small, reusable pieces the subsystems compose:
+
+  * ``StepGuard`` — the hapi train loop's non-finite-loss policy: skip
+    the optimizer step (gradients from a NaN/Inf loss are poison), count
+    the skip, and after K CONSECUTIVE bad steps optionally roll the model
+    back to the last valid checkpoint via a ``CheckpointManager``.
+  * ``Overloaded`` / ``DeadlineExceeded`` — the serving batchers' typed
+    rejections (queue-depth shedding, per-request deadlines). Typed so a
+    fronting layer can map them to 429/504 without string-matching.
+  * ``HealthStateMachine`` — STARTING → READY ⇄ DEGRADED → UNREADY, the
+    readiness/liveness surface a load balancer polls. DEGRADED means
+    still serving but shedding or saturated; UNREADY means stop sending
+    traffic (drained or persistently failing).
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Optional
+
+__all__ = ["Overloaded", "DeadlineExceeded", "StepGuard",
+           "HealthStateMachine", "HealthState"]
+
+
+class Overloaded(RuntimeError):
+    """Request rejected at admission: the queue is at capacity."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request abandoned: its deadline expired before completion."""
+
+
+# -- training: non-finite step guard -----------------------------------------
+
+class StepGuard:
+    """Non-finite-loss step policy for a training loop.
+
+    ``observe(loss_value)`` returns one of:
+      * ``"ok"``       — finite loss, take the step;
+      * ``"skip"``     — non-finite, skip the optimizer step;
+      * ``"rollback"`` — the K-th consecutive non-finite step AND a
+        restore hook is configured: the guard already invoked it; the
+        caller should also skip this step (the restored weights take
+        over from the next batch).
+
+    The consecutive counter resets on any finite loss, so isolated
+    spikes only cost their own step. Counters (``skipped``, ``total``,
+    ``rollbacks``) are mirrored into the registry as
+    ``train_nonfinite_steps_total`` / ``recoveries_total``.
+    """
+
+    def __init__(self, rollback_after: Optional[int] = None,
+                 restore_fn: Optional[Callable[[], object]] = None):
+        if rollback_after is not None and rollback_after < 1:
+            raise ValueError("rollback_after must be >= 1")
+        self.rollback_after = rollback_after
+        self.restore_fn = restore_fn
+        self.consecutive = 0
+        self.skipped = 0
+        self.steps = 0
+        self.rollbacks = 0
+
+    def _metrics(self):
+        from ..observability.metrics import get_registry
+        reg = get_registry()
+        return (reg.counter("train_nonfinite_steps_total",
+                            "train steps skipped on a non-finite loss"),
+                reg.counter("recoveries_total",
+                            "successful recovery actions, by kind",
+                            labelnames=("kind",)))
+
+    def observe(self, loss_value: float) -> str:
+        self.steps += 1
+        if math.isfinite(loss_value):
+            self.consecutive = 0
+            return "ok"
+        self.skipped += 1
+        self.consecutive += 1
+        skipped_c, recoveries_c = self._metrics()
+        skipped_c.inc()
+        if (self.rollback_after is not None
+                and self.consecutive >= self.rollback_after
+                and self.restore_fn is not None):
+            self.restore_fn()
+            self.rollbacks += 1
+            self.consecutive = 0
+            recoveries_c.labels(kind="rollback").inc()
+            return "rollback"
+        return "skip"
+
+
+# -- serving: health/readiness state machine ---------------------------------
+
+class HealthState:
+    STARTING = "starting"
+    READY = "ready"
+    DEGRADED = "degraded"
+    UNREADY = "unready"
+
+
+_STATE_CODE = {HealthState.STARTING: 0, HealthState.READY: 1,
+               HealthState.DEGRADED: 2, HealthState.UNREADY: 3}
+
+
+class HealthStateMachine:
+    """Readiness surface for a serving engine.
+
+    STARTING until the first successful step; READY while healthy;
+    DEGRADED while the queue sits above ``degraded_queue_frac`` of
+    capacity or a shed/deadline event happened within ``degraded_hold_s``
+    (hysteresis — one shed must not flap the probe); UNREADY after
+    ``unready_after`` CONSECUTIVE step failures, or on ``drain()``.
+    A later successful step recovers UNREADY → READY (drained engines
+    stay down until ``reset()``).
+    """
+
+    def __init__(self, capacity: int, degraded_queue_frac: float = 0.8,
+                 degraded_hold_s: float = 5.0, unready_after: int = 3,
+                 engine: str = "serving"):
+        self.capacity = max(1, capacity)
+        self.degraded_queue_frac = degraded_queue_frac
+        self.degraded_hold_s = degraded_hold_s
+        self.unready_after = unready_after
+        self.state = HealthState.STARTING
+        self._consecutive_failures = 0
+        self._last_degrade_event = -float("inf")
+        self._drained = False
+        from ..observability.metrics import get_registry
+        self._gauge = get_registry().gauge(
+            "serving_health_state",
+            "0=starting 1=ready 2=degraded 3=unready",
+            labelnames=("engine",)).labels(engine=engine)
+        self._gauge.set(_STATE_CODE[self.state])
+
+    # -- event feeds --------------------------------------------------------
+    def on_step_ok(self, queue_depth: int):
+        self._consecutive_failures = 0
+        if self._drained:
+            return
+        now = time.monotonic()
+        over = queue_depth >= self.degraded_queue_frac * self.capacity
+        if over:
+            self._last_degrade_event = now
+        # over-capacity RIGHT NOW is degraded regardless of hold_s; the
+        # hold only stretches how long a past event keeps us degraded
+        degraded = over or (
+            (now - self._last_degrade_event) < self.degraded_hold_s)
+        self._set(HealthState.DEGRADED if degraded else HealthState.READY)
+
+    def on_step_error(self):
+        self._consecutive_failures += 1
+        if self._consecutive_failures >= self.unready_after:
+            self._set(HealthState.UNREADY)
+        elif self.state != HealthState.STARTING:
+            self._set(HealthState.DEGRADED)
+            self._last_degrade_event = time.monotonic()
+
+    def on_shed(self):
+        self._last_degrade_event = time.monotonic()
+        if self.state in (HealthState.READY, HealthState.STARTING):
+            self._set(HealthState.DEGRADED)
+
+    def drain(self):
+        """Administrative: stop advertising readiness permanently (until
+        reset) — the restart/upgrade path."""
+        self._drained = True
+        self._set(HealthState.UNREADY)
+
+    def reset(self):
+        self._drained = False
+        self._consecutive_failures = 0
+        self._last_degrade_event = -float("inf")
+        self._set(HealthState.STARTING)
+
+    # -- probes -------------------------------------------------------------
+    def ready(self) -> bool:
+        return self.state in (HealthState.READY, HealthState.DEGRADED)
+
+    def _set(self, state: str):
+        self.state = state
+        self._gauge.set(_STATE_CODE[state])
