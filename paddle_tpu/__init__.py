@@ -68,6 +68,7 @@ from . import sparse
 from . import utils
 from . import vision
 from . import static
+from . import analysis  # registers the DF* diagnostic passes in static.ir
 from .hapi import Model, callbacks, summary
 from .distributed.parallel import DataParallel
 from .framework.io import async_save, load, save

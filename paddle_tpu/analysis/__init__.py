@@ -1,0 +1,54 @@
+"""Static-analysis subsystem: jaxpr dataflow diagnostics + trace-safety lint.
+
+Two engines over two IRs (rule catalog in ``findings.RULES``):
+
+* **DF rules** (``dataflow.py``) analyze traced jaxprs (``static.ir
+  .IrProgram``): structural/type consistency, dead code, unused inputs,
+  cross-rank collective ordering (the SPMD deadlock lint), NaN-prone
+  numerics, and the inplace/donation alias audit of the op registry.
+  Registered as read-only *diagnostic passes* in the static.ir pass
+  registry (``passes.py``) — ``apply_pass(prog, "check_dead_code")``
+  returns the program with ``prog.findings`` populated.
+* **TS rules** (``ast_lint.py``) lint python source for jit-context
+  hazards: host syncs, data-dependent control flow, jit-in-loop, and
+  trace-time side effects. CLI: ``python tools/tpu_lint.py <paths>``
+  (runs under tier-1 via the ``lint`` pytest marker).
+
+Suppress accepted findings inline (``# tpu-lint: disable=TS101``) or via
+the checked-in baseline (``tools/tpu_lint_baseline.json``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .findings import (ERROR, WARNING, Finding, RULES, has_errors,
+                       summarize)
+from .ast_lint import lint_file, lint_paths, lint_source
+from .dataflow import (audit_inplace_aliases, check_collective_order,
+                       check_dead_code, check_nan_prone, check_shapes,
+                       check_unused_inputs, collective_schedule, run_all)
+from . import passes as _passes  # registers the diagnostic passes
+from .passes import DIAGNOSTIC_PASS_NAMES
+
+__all__ = [
+    "Finding", "RULES", "ERROR", "WARNING", "has_errors", "summarize",
+    "lint_source", "lint_file", "lint_paths",
+    "check_shapes", "check_dead_code", "check_unused_inputs",
+    "check_collective_order", "check_nan_prone", "collective_schedule",
+    "audit_inplace_aliases", "run_all", "analyze",
+    "DIAGNOSTIC_PASS_NAMES",
+]
+
+
+def analyze(program, passes: Optional[Sequence[str]] = None
+            ) -> List[Finding]:
+    """Run the diagnostic passes (all by default) over an IrProgram or
+    ClosedJaxpr and return the findings."""
+    from ..static import ir
+    names = list(passes) if passes is not None else DIAGNOSTIC_PASS_NAMES
+    if hasattr(program, "closed"):
+        return ir.apply_pass(program, names).findings
+    findings: List[Finding] = []
+    for n in names:
+        findings.extend(ir.PASS_REGISTRY[n](program))
+    return findings

@@ -1,0 +1,337 @@
+"""Python AST trace-safety linter (TS* rules).
+
+Scans source for the jit-context hazards that burn TPU users, informed by
+the graph-break/mutation hooks in ``jit/sot.py`` (bool/int/float/item/numpy
+materializations are the breaks; outer-state mutation is the bake-in):
+
+* TS101  host sync on a traced value inside a @jit/@to_static function
+* TS102  data-dependent python if/while on a traced value
+* TS103  jax.jit / to_static constructed inside a loop
+* TS104  side effects during trace (print of traced values, outer-state
+         mutation, Tensor._set_data)
+
+Heuristic taint model: function parameters are assumed traced unless they
+carry a python-literal default or an int/bool/str annotation (static config
+by convention); any name assigned from an expression that reads a tainted
+name becomes tainted. No cross-function propagation — this is a linter,
+not an abstract interpreter; precision tuning happens through inline
+``# tpu-lint: disable=RULE`` suppressions and the checked-in baseline.
+
+Stdlib-only on purpose: ``tools/tpu_lint.py`` imports this file directly
+(without the ``paddle_tpu`` package, so without jax) to stay fast.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+try:
+    from .findings import (Finding, is_suppressed, parse_suppressions)
+except ImportError:  # standalone import by tools/tpu_lint.py
+    from findings import (Finding, is_suppressed,  # type: ignore
+                          parse_suppressions)
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "iter_py_files"]
+
+# decorator spellings that put a function body into a trace context
+_TRACED_SUFFIXES = (".to_static", ".jit")
+_TRACED_EXACT = {"jit", "to_static"}
+_NOT_TRACED = {"not_to_static"}
+
+# jit-constructor spellings for TS103
+_JIT_CTORS_EXACT = {"to_static", "jit"}
+_JIT_CTOR_SUFFIXES = (".to_static", "jax.jit")
+
+_HOST_SYNC_ATTRS = {"item", "numpy", "tolist"}
+_HOST_SYNC_BUILTINS = {"float", "int", "bool"}
+_HOST_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_STATIC_ANNOTATIONS = {"int", "bool", "str"}
+
+
+def _dotted(node) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything else."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def _names_in(node) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_traced_decorator(dec) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    name = _dotted(target)
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    if last in _NOT_TRACED:
+        return False
+    return name in _TRACED_EXACT or any(
+        name.endswith(s) for s in _TRACED_SUFFIXES)
+
+
+def _is_jit_ctor(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    if not name:
+        return False
+    return (name in _JIT_CTORS_EXACT
+            or any(name.endswith(s) for s in _JIT_CTOR_SUFFIXES))
+
+
+def _initial_taint(fn: ast.FunctionDef) -> Set[str]:
+    """Params assumed traced, minus literal-defaulted / static-annotated."""
+    a = fn.args
+    params = list(a.posonlyargs) + list(a.args)
+    taint = set()
+    n_def = len(a.defaults)
+    defaulted = {p.arg for p in params[len(params) - n_def:]} if n_def else set()
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            defaulted.add(p.arg)
+    for p in params + list(a.kwonlyargs):
+        if p.arg in ("self", "cls"):
+            continue
+        if p.arg in defaulted:
+            continue
+        ann = getattr(p, "annotation", None)
+        if ann is not None and _dotted(ann) in _STATIC_ANNOTATIONS:
+            continue
+        taint.add(p.arg)
+    if a.vararg:
+        taint.add(a.vararg.arg)
+    return taint
+
+
+class _TracedBodyLinter(ast.NodeVisitor):
+    """Lints one traced function body with a flow-insensitive taint pass."""
+
+    def __init__(self, fn: ast.FunctionDef, path: str,
+                 src_lines: Sequence[str]):
+        self.fn = fn
+        self.path = path
+        self.src_lines = src_lines
+        self.taint = _initial_taint(fn)
+        self.local_defs = set(self.taint)
+        self.findings: List[Finding] = []
+
+    # -- helpers ------------------------------------------------------------
+    def _line_text(self, node) -> str:
+        ln = getattr(node, "lineno", 0)
+        return self.src_lines[ln - 1] if 0 < ln <= len(self.src_lines) else ""
+
+    def _emit(self, rule: str, node, message: str):
+        self.findings.append(Finding(
+            rule=rule, message=message, file=self.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            source_line=self._line_text(node)))
+
+    def _tainted(self, expr) -> bool:
+        return bool(_names_in(expr) & self.taint)
+
+    # -- taint propagation ---------------------------------------------------
+    def _note_assign(self, targets, value):
+        names = set()
+        for t in targets:
+            names |= {n.id for n in ast.walk(t) if isinstance(n, ast.Name)}
+        self.local_defs |= names
+        if value is not None and self._tainted(value):
+            self.taint |= names
+
+    def visit_Assign(self, node):
+        self._note_assign(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._note_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._note_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        if self._tainted(node.iter):
+            self._note_assign([node.target], node.iter)
+        else:
+            self._note_assign([node.target], None)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested defs share the trace context; their params join the taint
+        if node is not self.fn:
+            self.taint |= _initial_taint(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- rules ---------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if (func.attr in _HOST_SYNC_ATTRS
+                    and self._tainted(func.value)):
+                self._emit("TS101", node,
+                           f".{func.attr}() on a traced value forces a "
+                           "host sync inside the jit context")
+            elif func.attr == "_set_data" and self._tainted(func.value):
+                self._emit("TS104", node,
+                           "Tensor._set_data during trace rebinds the "
+                           "buffer at trace time only")
+            elif (func.attr in ("append", "extend", "update", "add")
+                  and isinstance(func.value, ast.Name)
+                  and func.value.id not in self.local_defs):
+                self._emit("TS104", node,
+                           f"mutating enclosing-scope '{func.value.id}' "
+                           "during trace happens once at trace time, not "
+                           "per call")
+        else:
+            name = _dotted(func)
+            if (name in _HOST_SYNC_BUILTINS and node.args
+                    and self._tainted(node.args[0])):
+                self._emit("TS101", node,
+                           f"{name}() on a traced value materializes it "
+                           "on host (graph break / ConcretizationTypeError)")
+            elif (name in _HOST_SYNC_NP and node.args
+                  and self._tainted(node.args[0])):
+                self._emit("TS101", node,
+                           f"{name}() on a traced value pulls it to host "
+                           "memory inside the jit context")
+            elif name == "print" and any(self._tainted(a)
+                                         for a in node.args):
+                self._emit("TS104", node,
+                           "print of a traced value runs at trace time "
+                           "only; use jax.debug.print / callbacks")
+        self.generic_visit(node)
+
+    def _check_control(self, node, kind: str):
+        test = node.test
+        # isinstance()/hasattr() tests are static dispatch, not data flow
+        if isinstance(test, ast.Call) and _dotted(test.func) in (
+                "isinstance", "hasattr", "callable"):
+            return
+        if self._tainted(test):
+            self._emit("TS102", node,
+                       f"python '{kind}' on a traced value; use lax.cond/"
+                       "jnp.where, or accept the SOT graph break knowingly")
+
+    def visit_If(self, node):
+        self._check_control(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_control(node, "while")
+        self.generic_visit(node)
+
+    def visit_Global(self, node):
+        self._emit("TS104", node,
+                   "global statement inside a traced function: the "
+                   "mutation runs at trace time only")
+
+    def visit_Nonlocal(self, node):
+        self._emit("TS104", node,
+                   "nonlocal statement inside a traced function: the "
+                   "mutation runs at trace time only")
+
+
+class _ModuleLinter(ast.NodeVisitor):
+    """Module-wide rules: traced-function discovery + TS103."""
+
+    def __init__(self, path: str, src_lines: Sequence[str]):
+        self.path = path
+        self.src_lines = src_lines
+        self.findings: List[Finding] = []
+        #: finding-id -> alt suppression lines (enclosing def/decorator)
+        self.alt_lines: Dict[int, Tuple[int, ...]] = {}
+        self._loop_depth = 0
+
+    def visit_FunctionDef(self, node):
+        if any(_is_traced_decorator(d) for d in node.decorator_list):
+            sub = _TracedBodyLinter(node, self.path, self.src_lines)
+            sub.visit(node)
+            alts = tuple({node.lineno,
+                          *(d.lineno for d in node.decorator_list)})
+            for f in sub.findings:
+                self.alt_lines[id(f)] = alts
+            self.findings.extend(sub.findings)
+            # don't descend again: the body linter already walked it,
+            # but TS103 loops inside still need a look
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _visit_loop
+
+    def visit_Call(self, node):
+        if self._loop_depth and _is_jit_ctor(node):
+            ln = node.lineno
+            text = (self.src_lines[ln - 1]
+                    if 0 < ln <= len(self.src_lines) else "")
+            self.findings.append(Finding(
+                rule="TS103",
+                message=f"'{_dotted(node.func)}(...)' constructed inside "
+                        "a loop: every iteration builds (and may compile) "
+                        "a fresh callable; hoist it out",
+                file=self.path, line=ln, col=node.col_offset,
+                source_line=text))
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>",
+                apply_suppressions: bool = True) -> List[Finding]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="TS101", severity="error",
+                        message=f"syntax error: {e.msg}", file=path,
+                        line=e.lineno or 0)]
+    src_lines = source.splitlines()
+    linter = _ModuleLinter(path, src_lines)
+    linter.visit(tree)
+    findings = linter.findings
+    if apply_suppressions:
+        per_line, file_wide = parse_suppressions(source)
+        findings = [f for f in findings
+                    if not is_suppressed(f, per_line, file_wide,
+                                         linter.alt_lines.get(id(f), ()))]
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root) if root else path
+    return lint_source(source, rel)
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+    return sorted(out)
+
+
+def lint_paths(paths: Iterable[str],
+               root: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, root=root))
+    return findings

@@ -1,0 +1,184 @@
+"""Finding model + rule catalog + suppression/baseline machinery.
+
+Deliberately dependency-free (stdlib only): ``tools/tpu_lint.py`` imports
+this module *without* importing ``paddle_tpu`` (and therefore without
+importing jax), so the CLI lints the whole tree in a couple of seconds.
+The jaxpr-side analyses (dataflow.py) import jax; they attach here only
+through the shared ``Finding`` type.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+ERROR = "error"
+WARNING = "warning"
+
+#: Stable rule catalog. IDs never change meaning once shipped; retire by
+#: leaving a tombstone comment, never by reusing the number.
+#: DF* rules run over traced jaxprs (analysis/dataflow.py, also exposed as
+#: read-only diagnostic passes in the static.ir pass registry); TS* rules
+#: run over python source (analysis/ast_lint.py + tools/tpu_lint.py).
+RULES: Dict[str, dict] = {
+    "DF001": dict(severity=ERROR, name="shape-dtype-consistency",
+                  doc="jaxpr is structurally broken: a variable is used "
+                      "before definition, defined twice, or fails jax's "
+                      "own type re-check (typically a corrupt hand-written "
+                      "transform pass)."),
+    "DF002": dict(severity=WARNING, name="dead-code",
+                  doc="equation results never reach the program outputs; "
+                      "run the dead_code_elimination pass."),
+    "DF003": dict(severity=WARNING, name="unused-input",
+                  doc="a program input is never read; dead arguments "
+                      "still cost transfer + donation slots."),
+    "DF004": dict(severity=ERROR, name="collective-mismatch",
+                  doc="ranks disagree on the collective sequence over a "
+                      "mesh axis (or cond branches carry different "
+                      "collectives) — the classic SPMD deadlock."),
+    "DF005": dict(severity=WARNING, name="nan-prone",
+                  doc="log/sqrt/rsqrt/div fed by an unclamped subtraction; "
+                      "clamp or add an epsilon before the transcendental."),
+    "DF006": dict(severity=ERROR, name="inplace-alias",
+                  doc="an op exposed as an inplace variant has missing or "
+                      "wrong alias/donation metadata in the op registry."),
+    "TS101": dict(severity=ERROR, name="host-sync-in-jit",
+                  doc=".item()/.numpy()/float()/np.asarray on a traced "
+                      "value inside a @jit/to_static function forces a "
+                      "host sync (ConcretizationTypeError or a silent "
+                      "graph break)."),
+    "TS102": dict(severity=WARNING, name="data-dependent-control-flow",
+                  doc="python if/while on a traced value inside a jit "
+                      "context; use lax.cond/where or accept the SOT "
+                      "graph break knowingly."),
+    "TS103": dict(severity=WARNING, name="jit-in-loop",
+                  doc="jax.jit / to_static constructed inside a loop "
+                      "defeats the executable cache (one compile per "
+                      "iteration)."),
+    "TS104": dict(severity=WARNING, name="side-effect-in-trace",
+                  doc="side effect inside a traced function (print of a "
+                      "traced value, mutation of outer python state) runs "
+                      "at trace time only — replay will not repeat it."),
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    message: str
+    file: str = "<jaxpr>"
+    line: int = 0
+    col: int = 0
+    severity: str = ""          # defaulted from RULES when empty
+    source_line: str = ""       # text of the offending line, for baselining
+    extra: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.severity:
+            self.severity = RULES.get(self.rule, {}).get("severity", WARNING)
+
+    @property
+    def location(self) -> str:
+        return f"{self.file}:{self.line}" if self.line else self.file
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "severity": self.severity,
+             "file": self.file, "line": self.line, "col": self.col,
+             "message": self.message}
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    def __str__(self):
+        return (f"{self.location}: {self.severity}: [{self.rule}] "
+                f"{self.message}")
+
+
+def summarize(findings: Sequence[Finding]) -> str:
+    n_err = sum(1 for f in findings if f.severity == ERROR)
+    n_warn = len(findings) - n_err
+    return f"{len(findings)} finding(s): {n_err} error(s), {n_warn} warning(s)"
+
+
+def has_errors(findings: Iterable[Finding]) -> bool:
+    return any(f.severity == ERROR for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Inline suppressions:  # tpu-lint: disable=TS101[,TS102]
+#   * on the offending line (or the decorated ``def`` line of the enclosing
+#     traced function — ast_lint passes that line through as an alternate)
+#   * whole file:        # tpu-lint: disable-file=TS102
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\s]+)")
+
+
+def parse_suppressions(source: str):
+    """-> (line_no -> set(rules), file-wide set(rules)). 'all' wildcard ok."""
+    per_line: Dict[int, set] = {}
+    file_wide: set = set()
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip().upper() for r in m.group("rules").split(",")
+                 if r.strip()}
+        if m.group("scope"):
+            file_wide |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, file_wide
+
+
+def is_suppressed(finding: Finding, per_line: Dict[int, set],
+                  file_wide: set, alt_lines: Sequence[int] = ()) -> bool:
+    for rules in (file_wide,):
+        if "ALL" in rules or finding.rule in rules:
+            return True
+    for ln in (finding.line, *alt_lines):
+        rules = per_line.get(ln, ())
+        if "ALL" in rules or finding.rule in rules:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Baseline: accepted findings checked into the repo. Keys hash the rule +
+# path + normalized source text of the flagged line, so ordinary edits that
+# shift line numbers don't invalidate the baseline, while changing the
+# flagged code itself does.
+# ---------------------------------------------------------------------------
+
+def baseline_key(finding: Finding) -> str:
+    norm = " ".join(finding.source_line.split())
+    h = hashlib.sha1(
+        f"{finding.rule}|{finding.file}|{norm}".encode()).hexdigest()[:16]
+    return h
+
+
+def write_baseline(findings: Sequence[Finding], path: str):
+    entries = [{"key": baseline_key(f), "rule": f.rule, "file": f.file,
+                "line": f.line, "message": f.message} for f in findings]
+    entries.sort(key=lambda e: (e["file"], e["rule"], e["key"]))
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> set:
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return set()
+    return {e["key"] for e in data.get("findings", ())}
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: set) -> List[Finding]:
+    return [f for f in findings if baseline_key(f) not in baseline]

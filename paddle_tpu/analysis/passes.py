@@ -1,0 +1,29 @@
+"""Diagnostic passes: DF* analyses registered in the static.ir pass
+registry (the reference registers diagnostic graph passes alongside the
+transform passes; here ``list_passes()`` surfaces both kinds and
+``apply_pass`` attaches findings instead of rewriting the jaxpr).
+
+    prog = ir.IrProgram.trace(fn, x)
+    prog = ir.apply_pass(prog, ["check_dead_code", "check_nan_prone"])
+    for f in prog.findings: print(f)
+"""
+from __future__ import annotations
+
+from ..static.ir import register_pass
+from . import dataflow
+
+DIAGNOSTIC_PASS_NAMES = [
+    "check_shape_consistency",   # DF001
+    "check_dead_code",           # DF002
+    "check_unused_inputs",       # DF003
+    "check_collective_order",    # DF004 (single-program: cond branches)
+    "check_nan_prone",           # DF005
+]
+
+register_pass("check_shape_consistency", analysis=True)(dataflow.check_shapes)
+register_pass("check_dead_code", analysis=True)(dataflow.check_dead_code)
+register_pass("check_unused_inputs", analysis=True)(
+    dataflow.check_unused_inputs)
+register_pass("check_collective_order", analysis=True)(
+    dataflow.check_collective_order)
+register_pass("check_nan_prone", analysis=True)(dataflow.check_nan_prone)

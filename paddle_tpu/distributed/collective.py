@@ -52,6 +52,18 @@ except (ImportError, TypeError):  # pragma: no cover
                               out_specs=out_specs, check_rep=False)
 
 
+#: jaxpr primitive names that are cross-rank collectives. This is the
+#: canonical set the static analyzer keys on (analysis/dataflow.py rule
+#: DF004, collective-ordering lint): every mesh axis must observe an
+#: identical sequence of these primitives on all ranks or the mesh
+#: deadlocks. Keep in sync with the lax collectives the eager API below
+#: emits through its shard_map bodies.
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter", "pbroadcast",
+})
+
+
 class ReduceOp:
     SUM = "sum"
     MAX = "max"

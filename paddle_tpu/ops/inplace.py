@@ -6,12 +6,25 @@ semantic only — arrays are immutable, so each variant runs the functional
 op and rebinds the tensor's buffer via _set_data (donation in the compiled
 path gives the real memory reuse). Autograd follows the reference rule:
 inplace on a leaf that requires grad raises.
+
+The donation contract is EXPLICIT: ``build`` declares alias metadata on
+every inplace-capable registry entry (registry.declare_alias). Ops whose
+output can differ from the operand's layout are declared below —
+``_SHAPE_CHANGING`` (reshape-family: semantic inplace only, never a
+donation candidate) and ``_DTYPE_CHANGING`` (cast/compare/logical: the
+write-back intentionally changes the tensor's dtype, reference semantics).
+Shape-preserving variants enforce the contract at call time: a broadcast
+that would GROW the tensor raises instead of silently rebinding a larger
+buffer (matches the reference inplace shape check). The DF006 analysis
+rule (analysis.audit_inplace_aliases) cross-checks all declarations
+against each op's actual abstract behavior.
 """
 from __future__ import annotations
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from .registry import OP_REGISTRY, declare_alias
 
 _INPLACE_NAMES = [
     # unary math
@@ -38,6 +51,23 @@ _INPLACE_NAMES = [
 ]
 
 
+# ops whose output layout may legitimately differ from the operand's:
+# never donation candidates, and exempt from the call-time shape check.
+_SHAPE_CHANGING = {
+    "reshape", "squeeze", "unsqueeze", "transpose", "flatten", "t",
+    "addmm", "cumsum", "cumprod",
+}
+# write-back intentionally changes dtype (reference semantics for the
+# inplace compare/logical/cast variants) — donation would reinterpret
+# the buffer, so these are semantic-only too.
+_DTYPE_CHANGING = {
+    "cast",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal",
+}
+
+
 def _check_inplace_ok(x):
     if isinstance(x, Tensor) and not x.stop_gradient and x.is_leaf:
         raise RuntimeError(
@@ -45,11 +75,18 @@ def _check_inplace_ok(x):
             "allowed (matches the reference's inplace check)")
 
 
-def _make_inplace(op_fn, name):
+def _make_inplace(op_fn, name, check_shape=True):
     def inplace(x, *args, **kwargs):
         _check_inplace_ok(x)
         out = op_fn(x, *args, **kwargs)
-        x._set_data(out._data if isinstance(out, Tensor) else out)
+        data = out._data if isinstance(out, Tensor) else out
+        if check_shape and tuple(data.shape) != tuple(x.shape):
+            raise ValueError(
+                f"{name}_: result shape {tuple(data.shape)} differs from "
+                f"operand shape {tuple(x.shape)} — an in-place op cannot "
+                "grow its tensor via broadcasting (reference inplace "
+                "shape check)")
+        x._set_data(data)
         return x
     inplace.__name__ = name + "_"
     inplace.__doc__ = f"In-place variant of paddle.{name} (x is rebound)."
@@ -57,13 +94,21 @@ def _make_inplace(op_fn, name):
 
 
 def build(namespace: dict):
-    """Install `op_` for every available functional op in `namespace`."""
+    """Install `op_` for every available functional op in `namespace`,
+    declaring the op's alias/donation metadata in the registry."""
     made = []
     for name in _INPLACE_NAMES:
         fn = namespace.get(name)
         if fn is None or not callable(fn):
             continue
-        namespace[name + "_"] = _make_inplace(fn, name)
+        preserves_shape = name not in _SHAPE_CHANGING
+        namespace[name + "_"] = _make_inplace(fn, name,
+                                              check_shape=preserves_shape)
+        op_name = getattr(fn, "op_name", name)
+        if op_name in OP_REGISTRY:
+            declare_alias(op_name,
+                          preserves_shape=preserves_shape,
+                          preserves_dtype=name not in _DTYPE_CHANGING)
         made.append(name + "_")
     return made
 
@@ -72,12 +117,23 @@ def build(namespace: dict):
 
 def make_where_(where_fn):
     """paddle.where_(condition, x, y) is inplace on X (the second arg),
-    not the condition — needs its own wrapper."""
+    not the condition — needs its own wrapper (and its own alias
+    declaration: inplace_input=1)."""
+
+    op_name = getattr(where_fn, "op_name", "where")
+    if op_name in OP_REGISTRY:
+        declare_alias(op_name, inplace_input=1)
 
     def where_(condition, x, y):
         _check_inplace_ok(x)
         out = where_fn(condition, x, y)
-        x._set_data(out._data if isinstance(out, Tensor) else out)
+        data = out._data if isinstance(out, Tensor) else out
+        if tuple(data.shape) != tuple(x.shape):
+            raise ValueError(
+                f"where_: result shape {tuple(data.shape)} differs from "
+                f"operand shape {tuple(x.shape)} — an in-place op cannot "
+                "grow its tensor via broadcasting")
+        x._set_data(data)
         return x
 
     return where_

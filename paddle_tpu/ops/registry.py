@@ -166,10 +166,13 @@ def replay_node_vjp(node: GradNode, cotangents):
                     op_name=node.name + "_grad")
 
 
-def defop(name: Optional[str] = None, differentiable: bool = True):
+def defop(name: Optional[str] = None, differentiable: bool = True,
+          alias: Optional[dict] = None):
     """Register a pure jax function `fn(*arrays, **attrs)` as a framework op.
 
     differentiable=False ops (argmax, comparisons, ...) never record tape nodes.
+    ``alias`` is the explicit inplace/donation contract (see declare_alias);
+    ops exposed as ``op_`` inplace variants must carry one.
     """
 
     def deco(fn: Callable):
@@ -181,11 +184,43 @@ def defop(name: Optional[str] = None, differentiable: bool = True):
 
         OP_REGISTRY[op_name] = {"fn": fn, "wrapper": wrapper,
                                 "differentiable": differentiable}
+        if alias is not None:
+            declare_alias(op_name, **alias)
         wrapper.op_name = op_name
         wrapper.raw_fn = fn
         return wrapper
 
     return deco
+
+
+def declare_alias(op_name: str, *, inplace_input: int = 0,
+                  preserves_shape: bool = True,
+                  preserves_dtype: bool = True):
+    """Declare the inplace/donation aliasing contract of a registered op.
+
+    ``op_`` inplace variants rebind input ``inplace_input``'s buffer to the
+    op's output; under jit that buffer is a donation candidate, so XLA may
+    write the result into the input's memory. That is only sound when the
+    output matches the input's layout — ops that change shape
+    (``preserves_shape=False``: reshape/squeeze/...) or dtype
+    (``preserves_dtype=False``: cast/comparisons/...) still get a semantic
+    inplace variant, but their buffers must NOT be donated, and the
+    inplace wrapper enforces the declared shape contract at call time.
+    ``analysis.audit_inplace_aliases`` (rule DF006) cross-checks these
+    declarations against each op's actual abstract behavior.
+    """
+    entry = OP_REGISTRY.get(op_name)
+    if entry is None:
+        raise KeyError(f"declare_alias: unknown op '{op_name}'")
+    entry["alias"] = {"inplace_input": inplace_input,
+                      "preserves_shape": preserves_shape,
+                      "preserves_dtype": preserves_dtype}
+    return entry["alias"]
+
+
+def get_alias(op_name: str) -> Optional[dict]:
+    entry = OP_REGISTRY.get(op_name)
+    return entry.get("alias") if entry else None
 
 
 def _wrap_outputs(out, stop_gradient):

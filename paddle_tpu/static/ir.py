@@ -28,7 +28,8 @@ except ImportError:  # pragma: no cover
                           Var)
     from jax.extend.core import jaxpr_as_fun  # type: ignore
 
-__all__ = ["IrProgram", "register_pass", "apply_pass", "list_passes"]
+__all__ = ["IrProgram", "register_pass", "apply_pass", "list_passes",
+           "is_analysis_pass"]
 
 
 class IrProgram:
@@ -41,11 +42,14 @@ class IrProgram:
     """
 
     def __init__(self, closed: ClosedJaxpr, in_tree, out_tree,
-                 passes: Sequence[str] = ()):
+                 passes: Sequence[str] = (), findings: Sequence = ()):
         self.closed = closed
         self._in_tree = in_tree
         self._out_tree = out_tree
         self.applied_passes = list(passes)
+        # diagnostic findings accumulated by analysis passes (apply_pass
+        # with a name registered via register_pass(..., analysis=True))
+        self.findings = list(findings)
 
     # -- construction -------------------------------------------------------
     @classmethod
@@ -126,19 +130,34 @@ class IrProgram:
 
     def _with(self, closed: ClosedJaxpr, pass_name: str) -> "IrProgram":
         return IrProgram(closed, self._in_tree, self._out_tree,
-                         self.applied_passes + [pass_name])
+                         self.applied_passes + [pass_name], self.findings)
+
+    def _with_findings(self, findings, pass_name: str) -> "IrProgram":
+        """Analysis passes leave the program untouched; their findings
+        accumulate on the returned program (``prog.findings``)."""
+        return IrProgram(self.closed, self._in_tree, self._out_tree,
+                         self.applied_passes + [pass_name],
+                         self.findings + list(findings))
 
 
 # ---------------------------------------------------------------------------
-# Pass registry (PassRegistry / REGISTER_PASS analog)
+# Pass registry (PassRegistry / REGISTER_PASS analog). Two pass kinds:
+#   transform passes:  ClosedJaxpr -> ClosedJaxpr  (the original contract)
+#   analysis passes:   ClosedJaxpr -> [Finding]    (register_pass(...,
+#       analysis=True); read-only diagnostics, the reference's diagnostic
+#       graph passes) — apply_pass attaches the findings to the program
+#       instead of replacing its jaxpr.
 # ---------------------------------------------------------------------------
 
 PASS_REGISTRY: Dict[str, Callable[[ClosedJaxpr], ClosedJaxpr]] = {}
+ANALYSIS_PASSES: set = set()
 
 
-def register_pass(name: str):
+def register_pass(name: str, analysis: bool = False):
     def deco(fn):
         PASS_REGISTRY[name] = fn
+        if analysis:
+            ANALYSIS_PASSES.add(name)
         return fn
     return deco
 
@@ -147,14 +166,24 @@ def list_passes() -> List[str]:
     return sorted(PASS_REGISTRY)
 
 
+def is_analysis_pass(name: str) -> bool:
+    return name in ANALYSIS_PASSES
+
+
 def apply_pass(program: IrProgram,
                name: Union[str, Sequence[str]]) -> IrProgram:
-    """Run one named pass (or a list, in order) over the program."""
+    """Run one named pass (or a list, in order) over the program.
+    Transform passes rewrite the jaxpr; analysis passes append their
+    findings to ``program.findings`` and leave the jaxpr alone."""
     names = [name] if isinstance(name, str) else list(name)
     for n in names:
         if n not in PASS_REGISTRY:
             raise KeyError(f"unknown pass '{n}'; known: {list_passes()}")
-        program = program._with(PASS_REGISTRY[n](program.closed), n)
+        if n in ANALYSIS_PASSES:
+            program = program._with_findings(
+                PASS_REGISTRY[n](program.closed), n)
+        else:
+            program = program._with(PASS_REGISTRY[n](program.closed), n)
     return program
 
 

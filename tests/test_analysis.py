@@ -1,0 +1,475 @@
+"""Static-analysis subsystem (paddle_tpu.analysis).
+
+Both engines, one flagging and one passing fixture per rule:
+  DF001..DF006 — jaxpr dataflow analyses / registry alias audit
+  TS101..TS104 — AST trace-safety lint
+plus the pass-registry integration (diagnostic passes via apply_pass),
+the suppression/baseline machinery, and the tier-1 lint gate
+(``pytest -m lint``) that runs tools/tpu_lint.py over the shipped tree
+with a <10s runtime guard.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import ast_lint
+from paddle_tpu.analysis import findings as findings_mod
+from paddle_tpu.static import ir
+
+try:
+    from jax._src.core import ClosedJaxpr, Jaxpr
+except ImportError:  # pragma: no cover
+    from jax.core import ClosedJaxpr, Jaxpr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _tensor(shape, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(*shape).astype("float32"))
+
+
+# ---------------------------------------------------------------------------
+# DF001 — shape/dtype + structural consistency
+# ---------------------------------------------------------------------------
+
+def test_df001_flags_corrupt_jaxpr():
+    closed = jax.make_jaxpr(lambda x: jnp.tanh(jnp.exp(x)))(1.0)
+    jp = closed.jaxpr
+    # "a transform pass dropped a producer": first eqn removed by hand
+    bad = ClosedJaxpr(Jaxpr(jp.constvars, jp.invars, jp.outvars,
+                            jp.eqns[1:], jp.effects), closed.consts)
+    fs = analysis.check_shapes(bad)
+    assert "DF001" in _rules(fs)
+    assert any("before it is defined" in f.message for f in fs)
+
+
+@pytest.mark.quick
+def test_df001_passes_healthy_program():
+    def fn(x):
+        return paddle.tanh(x) + 1.0
+    prog = ir.IrProgram.trace(fn, _tensor((3, 4)))
+    assert analysis.check_shapes(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# DF002 — dead code
+# ---------------------------------------------------------------------------
+
+def test_df002_flags_dead_eqns_and_passes_after_dce():
+    def fn(x):
+        dead = paddle.exp(x) * 3.0  # never reaches the output
+        return paddle.tanh(x)
+    prog = ir.IrProgram.trace(fn, _tensor((3, 4)))
+    fs = analysis.check_dead_code(prog)
+    assert "DF002" in _rules(fs)
+    clean = ir.apply_pass(prog, "dead_code_elimination")
+    assert analysis.check_dead_code(clean) == []
+
+
+# ---------------------------------------------------------------------------
+# DF003 — unused inputs
+# ---------------------------------------------------------------------------
+
+def test_df003_flags_unused_input_and_passes_when_used():
+    def uses_one(x, y):
+        return paddle.tanh(x)
+    prog = ir.IrProgram.trace(uses_one, _tensor((2, 2)), _tensor((2, 2), 1))
+    fs = analysis.check_unused_inputs(prog)
+    assert "DF003" in _rules(fs)
+    assert any("input #1" in f.message for f in fs)
+
+    def uses_both(x, y):
+        return x + y
+    prog2 = ir.IrProgram.trace(uses_both, _tensor((2, 2)),
+                               _tensor((2, 2), 1))
+    assert analysis.check_unused_inputs(prog2) == []
+
+
+# ---------------------------------------------------------------------------
+# DF004 — collective ordering (the SPMD deadlock lint)
+# ---------------------------------------------------------------------------
+
+def _rank_jaxpr(fn, *args):
+    return jax.make_jaxpr(fn, axis_env=[("i", 2)])(*args)
+
+
+def test_df004_flags_mismatched_two_rank_program():
+    # rank0: psum; psum      rank1: ppermute; psum  -> deadlock at #0
+    r0 = _rank_jaxpr(lambda v: lax.psum(lax.psum(v, "i"), "i"), 1.0)
+    r1 = _rank_jaxpr(
+        lambda v: lax.psum(
+            jnp.sum(lax.ppermute(v, "i", [(0, 1), (1, 0)])), "i"),
+        jnp.ones((2,)))
+    fs = analysis.check_collective_order([r0, r1])
+    assert "DF004" in _rules(fs)
+    assert any(f.severity == "error" and "deadlock" in f.message
+               for f in fs)
+
+
+def test_df004_passes_identical_rank_schedules():
+    mk = lambda: _rank_jaxpr(
+        lambda v: lax.psum(v, "i") + lax.pmax(v, "i"), 1.0)
+    assert analysis.check_collective_order([mk(), mk()]) == []
+
+
+def test_df004_flags_divergent_cond_branches():
+    closed = _rank_jaxpr(
+        lambda p, x: lax.cond(p, lambda v: lax.psum(v, "i"),
+                              lambda v: v, x), True, 1.0)
+    fs = analysis.check_collective_order(closed)
+    assert "DF004" in _rules(fs)
+    assert any("branch" in f.message for f in fs)
+
+
+def test_df004_passes_agreeing_cond_branches():
+    closed = _rank_jaxpr(
+        lambda p, x: lax.cond(p, lambda v: lax.psum(v, "i"),
+                              lambda v: lax.psum(v * 2.0, "i"), x),
+        True, 1.0)
+    assert analysis.check_collective_order(closed) == []
+
+
+def test_collective_schedule_recurses_into_pjit():
+    closed = _rank_jaxpr(
+        lambda x: jax.jit(lambda v: lax.psum(v, "i"))(x), 1.0)
+    sched = analysis.collective_schedule(closed)
+    assert [(prim, axes) for _, prim, axes in sched] == [("psum", ("i",))]
+
+
+# ---------------------------------------------------------------------------
+# DF005 — NaN-prone patterns
+# ---------------------------------------------------------------------------
+
+def test_df005_flags_log_of_unclamped_sub():
+    closed = jax.make_jaxpr(lambda a, b: jnp.log(a - b))(1.0, 2.0)
+    assert "DF005" in _rules(analysis.check_nan_prone(closed))
+
+
+def test_df005_flags_div_by_unclamped_sub():
+    closed = jax.make_jaxpr(lambda a, b: a / (a - b))(1.0, 2.0)
+    assert "DF005" in _rules(analysis.check_nan_prone(closed))
+
+
+def test_df005_passes_clamped_sub():
+    closed = jax.make_jaxpr(
+        lambda a, b: jnp.log(jnp.maximum(a - b, 1e-6)))(1.0, 2.0)
+    assert analysis.check_nan_prone(closed) == []
+
+
+# ---------------------------------------------------------------------------
+# DF006 — inplace/donation alias audit
+# ---------------------------------------------------------------------------
+
+def test_df006_shipped_registry_is_clean():
+    assert analysis.audit_inplace_aliases() == []
+
+
+def test_df006_metadata_is_explicit_on_registry_entries():
+    from paddle_tpu.ops.registry import get_alias
+    exp_alias = get_alias(paddle.exp.op_name)
+    assert exp_alias["preserves_shape"] and exp_alias["preserves_dtype"]
+    cast_alias = get_alias(paddle.cast.op_name)
+    assert not cast_alias["preserves_dtype"]
+    reshape_alias = get_alias(paddle.reshape.op_name)
+    assert not reshape_alias["preserves_shape"]
+
+
+def test_df006_flags_wrong_and_missing_metadata(monkeypatch):
+    from paddle_tpu.ops import inplace as inplace_mod
+    from paddle_tpu.ops import registry
+
+    @registry.defop(name="_lint_probe_tobool", differentiable=False)
+    def _tobool(x):
+        return x > 0
+
+    @registry.defop(name="_lint_probe_plain", differentiable=False)
+    def _plain(x):
+        return x * 2
+
+    try:
+        # wrong: claims dtype-preserving but maps float32 -> bool
+        registry.declare_alias("_lint_probe_tobool", preserves_dtype=True)
+        ns = {"tobool": registry.get_op("_lint_probe_tobool"),
+              "plain": registry.get_op("_lint_probe_plain")}
+        monkeypatch.setattr(inplace_mod, "_INPLACE_NAMES",
+                            ["tobool", "plain"])
+        fs = analysis.audit_inplace_aliases(namespace=ns)
+        assert any(f.rule == "DF006" and "preserves_dtype" in f.message
+                   for f in fs)
+        assert any(f.rule == "DF006" and "no alias metadata" in f.message
+                   for f in fs)
+    finally:
+        registry.OP_REGISTRY.pop("_lint_probe_tobool", None)
+        registry.OP_REGISTRY.pop("_lint_probe_plain", None)
+
+
+def test_inplace_shape_contract_enforced():
+    # the declared-metadata fix: a broadcast that would GROW the tensor
+    # now raises instead of silently rebinding a larger buffer
+    x = paddle.to_tensor(np.ones((1,), dtype="float32"))
+    y = paddle.to_tensor(np.ones((3,), dtype="float32"))
+    with pytest.raises(ValueError, match="grow"):
+        paddle.add_(x, y)
+    # the legitimate same-shape path still works
+    z = paddle.to_tensor(np.ones((3,), dtype="float32"))
+    paddle.add_(z, y)
+    np.testing.assert_allclose(np.asarray(z._data), 2.0)
+
+
+# ---------------------------------------------------------------------------
+# pass-registry integration
+# ---------------------------------------------------------------------------
+
+@pytest.mark.quick
+def test_diagnostic_passes_registered_and_applied():
+    for name in analysis.DIAGNOSTIC_PASS_NAMES:
+        assert name in ir.list_passes()
+        assert ir.is_analysis_pass(name)
+    assert not ir.is_analysis_pass("dead_code_elimination")
+
+    def fn(x, y):
+        dead = paddle.exp(x)
+        return paddle.tanh(x)
+    prog = ir.IrProgram.trace(fn, _tensor((2, 3)), _tensor((2, 3), 1))
+    out = ir.apply_pass(prog, ["check_dead_code", "check_unused_inputs"])
+    assert out.closed is prog.closed          # analysis never rewrites
+    assert {"DF002", "DF003"} <= _rules(out.findings)
+    assert out.applied_passes == ["check_dead_code", "check_unused_inputs"]
+    # transform passes still transform, and keep accumulated findings
+    opt = ir.apply_pass(out, "dead_code_elimination")
+    assert opt.num_ops() < prog.num_ops()
+    assert _rules(opt.findings) == _rules(out.findings)
+
+
+def test_analyze_helper_runs_all_rules():
+    def fn(x):
+        return paddle.log(x - 1.0)
+    prog = ir.IrProgram.trace(fn, _tensor((2, 2)))
+    fs = analysis.analyze(prog)
+    assert "DF005" in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# TS101..TS104 — AST trace-safety lint
+# ---------------------------------------------------------------------------
+
+TS101_BAD = """
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def f(x):
+    s = x * 2
+    return float(s.sum())
+"""
+
+TS101_ITEM_BAD = """
+from paddle_tpu import jit
+
+@jit.to_static
+def f(x):
+    return x.mean().item()
+"""
+
+TS101_GOOD = """
+def f(x):
+    return float(x.sum())   # eager: host sync is fine outside jit
+"""
+
+
+def test_ts101_flags_host_sync_in_jit():
+    assert "TS101" in _rules(ast_lint.lint_source(TS101_BAD))
+    assert "TS101" in _rules(ast_lint.lint_source(TS101_ITEM_BAD))
+
+
+def test_ts101_passes_outside_jit():
+    assert ast_lint.lint_source(TS101_GOOD) == []
+
+
+TS102_BAD = """
+import jax
+
+@jax.jit
+def f(x):
+    if x.sum() > 0:
+        return x + 1
+    return x - 1
+"""
+
+TS102_GOOD = """
+import jax
+
+@jax.jit
+def f(x, training=True):
+    if training:              # literal-defaulted param: static config
+        return x + 1
+    return x - 1
+"""
+
+
+def test_ts102_flags_data_dependent_branch():
+    fs = ast_lint.lint_source(TS102_BAD)
+    assert "TS102" in _rules(fs)
+
+
+def test_ts102_passes_static_config_branch():
+    assert "TS102" not in _rules(ast_lint.lint_source(TS102_GOOD))
+
+
+TS103_BAD = """
+import jax
+
+def serve(fns, x):
+    outs = []
+    for fn in fns:
+        step = jax.jit(fn)    # one compile per iteration
+        outs.append(step(x))
+    return outs
+"""
+
+TS103_GOOD = """
+import jax
+
+def serve(fns, x):
+    steps = [jax.jit(f) for f in fns]
+    return None
+"""
+
+
+def test_ts103_flags_jit_in_loop():
+    assert "TS103" in _rules(ast_lint.lint_source(TS103_BAD))
+
+
+def test_ts103_passes_hoisted_jit():
+    assert "TS103" not in _rules(ast_lint.lint_source(TS103_GOOD))
+
+
+TS104_BAD = """
+import jax
+
+TRACE_LOG = []
+
+@jax.jit
+def f(x):
+    print(x)
+    TRACE_LOG.append(x)
+    return x * 2
+"""
+
+TS104_GOOD = """
+import jax
+
+@jax.jit
+def f(x):
+    print("entering f")       # constant print: harmless trace-time noise
+    return x * 2
+"""
+
+
+def test_ts104_flags_trace_side_effects():
+    fs = [f for f in ast_lint.lint_source(TS104_BAD) if f.rule == "TS104"]
+    msgs = " ".join(f.message for f in fs)
+    assert "print" in msgs and "TRACE_LOG" in msgs
+
+
+def test_ts104_passes_constant_print():
+    assert "TS104" not in _rules(ast_lint.lint_source(TS104_GOOD))
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_on_line():
+    src = TS101_BAD.replace("return float(s.sum())",
+                            "return float(s.sum())  # tpu-lint: disable=TS101")
+    assert "TS101" not in _rules(ast_lint.lint_source(src))
+
+
+def test_inline_suppression_on_def_line_covers_function():
+    src = TS101_BAD.replace("def f(x):",
+                            "def f(x):  # tpu-lint: disable=TS101")
+    assert "TS101" not in _rules(ast_lint.lint_source(src))
+
+
+def test_file_wide_suppression():
+    src = "# tpu-lint: disable-file=TS101\n" + TS101_BAD
+    assert "TS101" not in _rules(ast_lint.lint_source(src))
+
+
+def test_baseline_roundtrip(tmp_path):
+    fs = ast_lint.lint_source(TS101_BAD, path="pkg/mod.py")
+    assert fs
+    path = str(tmp_path / "baseline.json")
+    findings_mod.write_baseline(fs, path)
+    baseline = findings_mod.load_baseline(path)
+    assert findings_mod.apply_baseline(fs, baseline) == []
+    # a different finding is NOT masked by the baseline
+    other = ast_lint.lint_source(TS102_BAD, path="pkg/other.py")
+    assert findings_mod.apply_baseline(other, baseline) == other
+
+
+def test_rule_catalog_is_stable():
+    assert set(findings_mod.RULES) >= {
+        "DF001", "DF002", "DF003", "DF004", "DF005", "DF006",
+        "TS101", "TS102", "TS103", "TS104"}
+    for rule, meta in findings_mod.RULES.items():
+        assert meta["severity"] in ("error", "warning")
+        assert meta["doc"]
+
+
+# ---------------------------------------------------------------------------
+# CLI + tier-1 lint gate
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py"),
+         *args], cwd=cwd, capture_output=True, text=True)
+
+
+@pytest.mark.lint
+@pytest.mark.quick
+def test_lint_gate_shipped_tree_is_clean_and_fast():
+    t0 = time.monotonic()
+    proc = _run_cli("paddle_tpu", "examples")
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # runtime guard: the gate must never threaten the tier-1 timeout
+    assert elapsed < 10.0, f"lint gate took {elapsed:.1f}s"
+
+
+def test_cli_flags_errors_nonzero_and_emits_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(TS101_BAD)
+    proc = _run_cli("--json", "--baseline", "none", str(bad),
+                    cwd=str(tmp_path))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert any(f["rule"] == "TS101" for f in payload["findings"])
+
+
+def test_cli_baseline_accepts_known_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(TS101_BAD)
+    base = tmp_path / "base.json"
+    proc = _run_cli("--write-baseline", "--baseline", str(base), str(bad),
+                    cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_cli("--baseline", str(base), str(bad), cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Trace-safety linter CLI (TS* rules of paddle_tpu.analysis).
+
+    python tools/tpu_lint.py paddle_tpu examples            # text report
+    python tools/tpu_lint.py --json paddle_tpu              # machine output
+    python tools/tpu_lint.py --write-baseline paddle_tpu examples
+    python tools/tpu_lint.py --audit-ops                    # DF006 registry audit
+
+Exit status: 0 when no ERROR-severity findings survive suppressions and
+the baseline; 1 otherwise. Warnings are reported but never fail the run
+(use --strict to fail on warnings too).
+
+Deliberately does NOT import the paddle_tpu package (and therefore not
+jax): the rule engine (analysis/ast_lint.py, analysis/findings.py) is
+stdlib-only and loaded straight off the source tree, so the tier-1 lint
+gate runs in a couple of seconds. --audit-ops is the exception — it
+imports the live op registry.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_ANALYSIS_DIR = os.path.join(_REPO, "paddle_tpu", "analysis")
+sys.path.insert(0, _ANALYSIS_DIR)
+
+import ast_lint      # noqa: E402  (stdlib-only modules, loaded directly)
+import findings as findings_mod  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(_HERE, "tpu_lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu_lint",
+        description="paddle_tpu trace-safety linter (TS rules)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of accepted findings "
+                         "(default: tools/tpu_lint_baseline.json; "
+                         "pass 'none' to disable)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule IDs to restrict to")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--audit-ops", action="store_true",
+                    help="also run the DF006 inplace/donation alias audit "
+                         "over the live op registry (imports paddle_tpu)")
+    args = ap.parse_args(argv)
+
+    if not args.paths and not args.audit_ops:
+        ap.error("no paths given")
+
+    paths = [p if os.path.isabs(p) else os.path.join(os.getcwd(), p)
+             for p in args.paths]
+    results = ast_lint.lint_paths(paths, root=os.getcwd())
+
+    if args.audit_ops:
+        sys.path.insert(0, _REPO)
+        from paddle_tpu.analysis import audit_inplace_aliases
+        results.extend(audit_inplace_aliases())
+
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        results = [f for f in results if f.rule in wanted]
+
+    if args.write_baseline:
+        path = (args.baseline if args.baseline.lower() != "none"
+                else DEFAULT_BASELINE)
+        findings_mod.write_baseline(results, path)
+        print(f"wrote {len(results)} finding(s) to {path}")
+        return 0
+
+    if args.baseline.lower() != "none":
+        baseline = findings_mod.load_baseline(args.baseline)
+        if baseline:
+            results = findings_mod.apply_baseline(results, baseline)
+
+    if args.json:
+        print(json.dumps({"findings": [f.to_dict() for f in results],
+                          "summary": findings_mod.summarize(results)},
+                         indent=2))
+    else:
+        for f in results:
+            print(f)
+        print(findings_mod.summarize(results))
+
+    if findings_mod.has_errors(results):
+        return 1
+    if args.strict and results:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
