@@ -57,6 +57,7 @@ from . import nn
 from . import optimizer
 from . import profiler
 from . import observability
+from . import perf
 from . import resilience
 from . import geometric
 from . import hub

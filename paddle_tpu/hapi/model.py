@@ -52,10 +52,22 @@ class Model:
         self.stop_training = False
         self._step_guard = None
         self._ckpt_include_optimizer = True
+        self._jit = False
+        self._train_step = None
+        self._fused_n_in = None
+        self._pending_eager_grads = False
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, jit=False):
+        """``jit=True`` compiles forward + backward + optimizer update into
+        ONE fused XLA executable (``paddle_tpu.jit.TrainStep``) with the
+        param/master/opt-state buffers DONATED by default — XLA updates
+        them in place, halving steady-state update HBM. The DF006 alias
+        audit is consulted first; any finding downgrades to non-donating.
+        ``train_batch`` falls back to the eager tape whenever the fused
+        step can't serve the call (metrics that need forward outputs, an
+        armed step guard, gradient accumulation)."""
         self._optimizer = optimizer
         if loss is not None and not (isinstance(loss, Layer)
                                      or callable(loss)):
@@ -66,6 +78,9 @@ class Model:
             if not isinstance(m, Metric):
                 raise TypeError(f"metric {m} is not a paddle_tpu.metric.Metric")
         self._amp_configs = amp_configs
+        self._jit = bool(jit)
+        self._train_step = None
+        self._fused_n_in = None
         self._prepared = True
 
     def parameters(self, *args, **kwargs):
@@ -94,11 +109,13 @@ class Model:
         import time as _time
         assert self._prepared, "call prepare() first"
         self.network.train()
+        from ..resilience.chaos import fault_point
+        spec = fault_point("train.step")
+        if spec is None and self._can_fuse(update):
+            return self._train_batch_fused(inputs, labels)
         t0 = _time.perf_counter()
         outputs = self._forward(inputs)
         loss, labels_t = self._compute_loss(outputs, labels)
-        from ..resilience.chaos import fault_point
-        spec = fault_point("train.step")
         if spec is not None and spec.kind == "nan_grad":
             # the injected divergence: a NaN loss whose backward would
             # produce NaN gradients — exactly what the guard exists for
@@ -116,9 +133,61 @@ class Model:
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
+            self._pending_eager_grads = False
+        else:
+            self._pending_eager_grads = True
         metrics = self._update_metrics(outputs, labels_t)
         self._observe_train_step(_time.perf_counter() - t0, inputs)
         return self._wrap_loss(loss, metrics)
+
+    # -- fused (compiled) train step ------------------------------------------
+    def _can_fuse(self, update):
+        """The fused TrainStep serves only the plain steady-state step:
+        no metrics (they need eager forward outputs), no armed step guard
+        (it inspects the loss BEFORE backward), no gradient accumulation
+        in flight (the fused step fuses backward+update, it cannot add to
+        an eager tape's accumulated grads)."""
+        return (self._jit and update and not self._metrics
+                and self._step_guard is None
+                and not self._pending_eager_grads
+                and self._loss is not None and self._optimizer is not None)
+
+    def _ensure_train_step(self, n_in):
+        if self._train_step is not None and self._fused_n_in == n_in:
+            return self._train_step
+        from .. import jit as jit_mod
+        from ..perf.compile_cache import donation_safe
+        donate, findings = donation_safe()
+        if not donate:
+            warnings.warn(
+                f"DF006 alias audit reported {len(findings)} finding(s); "
+                "the fused train step will NOT donate param/opt-state "
+                "buffers (donation with a wrong alias declaration corrupts "
+                "memory on hardware)")
+        network, loss = self.network, self._loss
+
+        def loss_fn(*batch):
+            outputs = _to_list(network(*batch[:n_in]))
+            return loss(*(outputs + list(batch[n_in:])))
+
+        amp = self._amp_configs if isinstance(self._amp_configs, dict) \
+            else None
+        self._fused_n_in = n_in
+        self._train_step = jit_mod.TrainStep(
+            loss_fn, self._optimizer, amp=amp, donate=donate)
+        return self._train_step
+
+    def _train_batch_fused(self, inputs, labels):
+        import time as _time
+        t0 = _time.perf_counter()
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in _to_list(inputs)]
+        lbls = [y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+                for y in _to_list(labels)]
+        step = self._ensure_train_step(len(ins))
+        loss = step(*(ins + lbls))
+        self._observe_train_step(_time.perf_counter() - t0, inputs)
+        return self._wrap_loss(loss, [])
 
     # -- resilience ----------------------------------------------------------
     def _checkpoint_state(self):
@@ -234,12 +303,13 @@ class Model:
 
     # -- loops ----------------------------------------------------------------
     def _make_loader(self, data, batch_size, shuffle, num_workers,
-                     drop_last=False):
+                     drop_last=False, prefetch=False):
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset) or hasattr(data, "__getitem__"):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              num_workers=num_workers, drop_last=drop_last)
+                              num_workers=num_workers, drop_last=drop_last,
+                              prefetch_to_device=prefetch)
         return data  # any iterable of batches
 
     def _split_batch(self, batch, has_labels=True):
@@ -261,11 +331,18 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
-        """model.py fit analog."""
+            accumulate_grad_batches=1, num_iters=None,
+            prefetch_to_device=True):
+        """model.py fit analog.
+
+        ``prefetch_to_device`` (default on) double-buffers host-to-device
+        transfers for loaders fit constructs itself: batch N+1 lands on
+        device while step N runs. Pass a pre-built DataLoader to control
+        prefetching yourself."""
         assert self._prepared, "call prepare() first"
         loader = self._make_loader(train_data, batch_size, shuffle,
-                                   num_workers, drop_last=drop_last)
+                                   num_workers, drop_last=drop_last,
+                                   prefetch=prefetch_to_device)
         eval_loader = self._make_loader(eval_data, batch_size, False,
                                         num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
@@ -301,6 +378,7 @@ class Model:
                 # the next epoch's window (works for len-less loaders too)
                 self._optimizer.step()
                 self._optimizer.clear_grad()
+                self._pending_eager_grads = False
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self._run_eval(eval_loader, cbks)

@@ -430,7 +430,8 @@ class ContinuousBatcher(_BatcherBase):
                  top_k: int = 0, top_p: Optional[float] = None,
                  seed: Optional[int] = None,
                  max_queue_depth: Optional[int] = None,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 prompt_buckets="pow2"):
         import paddle_tpu as paddle
 
         self.model = model
@@ -455,14 +456,22 @@ class ContinuousBatcher(_BatcherBase):
         self._init_queues(max_queue_depth=max_queue_depth,
                           default_deadline_s=default_deadline_s)
         self._last_tok = np.zeros((max_batch, 1), np.int64)
+        # Admission pads prompts up this ladder (perf.buckets spec; None
+        # disables): O(#buckets) prefill signatures instead of one per
+        # distinct prompt length. Capped at s_max so the top rung is
+        # always admissible.
+        from ..perf.buckets import resolve_ladder
+        self._prompt_ladder = resolve_ladder(prompt_buckets, hi=s_max)
         if compile:
             from .. import jit
             # donate the caches argument (tensor arg index 1): XLA reuses
             # the cache HBM in place instead of double-buffering per step
             self._step_fn = jit.to_static(model.decode_step,
                                           donate_args=(1,))
+            self._prefill_fn = jit.to_static(model.prefill)
         else:
             self._step_fn = model.decode_step
+            self._prefill_fn = model.prefill
 
     # -- request lifecycle --------------------------------------------------
     def _release_slot(self, slot: int):
@@ -471,18 +480,42 @@ class ContinuousBatcher(_BatcherBase):
 
     def _admit(self) -> List[int]:
         """Move pending requests into free slots (prefill writes the slot's
-        cache rows; one prefill compile per prompt length — callers who
-        need fewer compiles can pad prompts to buckets themselves).
-        Returns rids that finished AT admission (max_new_tokens == 1 or
-        EOS on the prefill token)."""
+        cache rows). Prompts are right-padded up the shared bucket ladder
+        (``prompt_buckets``), so steady state runs O(#buckets) prefill
+        signatures instead of one per distinct prompt length; the model
+        gathers the true last-token logits at ``n_valid - 1``. Padded
+        tokens are counted in ``serving.bucket_pad_waste``. Returns rids
+        that finished AT admission (max_new_tokens == 1 or EOS on the
+        prefill token)."""
         import paddle_tpu as paddle
         finished = []
         while self._pending and self._free:
             req = self._pending.pop(0)
             slot = self._free.pop(0)
-            ids = paddle.to_tensor(req.prompt[None, :])
+            prompt = req.prompt
+            n = len(prompt)
+            if self._prompt_ladder is not None:
+                bucket = self._prompt_ladder.bucket(n)
+                if bucket != n:
+                    from ..observability.metrics import get_registry
+                    get_registry().counter(
+                        "serving.bucket_pad_waste",
+                        "pad tokens admission added to reach the prompt "
+                        "bucket").inc(bucket - n)
+                    prompt = np.concatenate(
+                        [prompt, np.zeros(bucket - n, prompt.dtype)])
+                n_valid = paddle.to_tensor(np.full((1, 1), n, np.int32))
+            else:
+                n_valid = None
+            ids = paddle.to_tensor(prompt[None, :])
             with paddle.no_grad():
-                logits, cache, _t = self.model.prefill(ids, self.s_max)
+                if n_valid is not None:
+                    # n_valid is passed even for exact-rung prompts so every
+                    # admission in a bucket shares ONE prefill signature
+                    logits, cache, _t = self._prefill_fn(
+                        ids, self.s_max, n_valid)
+                else:
+                    logits, cache, _t = self._prefill_fn(ids, self.s_max)
             # write the slot: caches[:, :, slot] = cache[:, :, 0]
             self._caches[:, :, slot] = cache[:, :, 0]
             tok = int(self._pick(np.asarray(logits._data)[:, -1])[0])

@@ -49,13 +49,21 @@ def default_convert_fn(batch):
     return batch
 
 
-def to_tensor_tree(batch):
-    """numpy tree -> Tensor tree (one H2D per leaf)."""
-    from ..core.tensor import Tensor
-    if isinstance(batch, np.ndarray):
-        return Tensor(batch)
+def _as_lists(batch):
+    """Normalize tuples to lists (the tree shape to_tensor_tree always
+    produced) so the coalesced transfer round-trips the same structure."""
     if isinstance(batch, dict):
-        return {k: to_tensor_tree(v) for k, v in batch.items()}
+        return {k: _as_lists(v) for k, v in batch.items()}
     if isinstance(batch, (list, tuple)):
-        return [to_tensor_tree(v) for v in batch]
+        return [_as_lists(v) for v in batch]
     return batch
+
+
+def to_tensor_tree(batch):
+    """numpy tree -> device Tensor tree in ONE coalesced transfer.
+
+    Every array leaf in the batch ships in a single batched
+    ``jax.device_put`` call (perf.prefetch.coalesced_device_put): one H2D
+    round trip per BATCH, not one per field."""
+    from ..perf.prefetch import coalesced_device_put
+    return coalesced_device_put(_as_lists(batch))

@@ -30,6 +30,28 @@ from .dataset import Dataset, IterableDataset
 _worker_info = threading.local()
 
 
+def _ndarray_leaves(tree):
+    """Yield every np.ndarray leaf of a collated (dict/list/tuple) batch."""
+    if isinstance(tree, np.ndarray):
+        yield tree
+    elif isinstance(tree, dict):
+        for v in tree.values():
+            yield from _ndarray_leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _ndarray_leaves(v)
+
+
+def _map_ndarray_leaves(tree, fn):
+    if isinstance(tree, np.ndarray):
+        return fn(tree)
+    if isinstance(tree, dict):
+        return {k: _map_ndarray_leaves(v, fn) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_map_ndarray_leaves(v, fn) for v in tree)
+    return tree
+
+
 class WorkerInfo:
     def __init__(self, id, num_workers, dataset, seed):
         self.id = id
@@ -128,6 +150,9 @@ class _MultiprocessIter:
         for _ in range(n * loader.prefetch_factor):
             self._dispatch()
 
+    def __iter__(self):
+        return self
+
     def _dispatch(self):
         if not self._alive:
             return False
@@ -224,6 +249,9 @@ class _SingleProcessIter:
         self._iterable = isinstance(loader.dataset, IterableDataset)
         if self._iterable:
             self._stream = iter(self._dataset)
+
+    def __iter__(self):
+        return self
 
     def __next__(self):
         from ..resilience.chaos import fault_point
@@ -339,7 +367,18 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, prefetch_to_device=False,
+                 batch_buckets=None):
+        """``prefetch_to_device=True`` replaces the host-side buffer reader
+        with perf.prefetch.DevicePrefetcher: a background thread lands
+        batch N+1 on device (one coalesced transfer) while the consumer is
+        still stepping on batch N. ``batch_buckets`` (a perf.buckets ladder
+        spec: "pow2", "fixed:K" needs no hi here — capped at batch_size —
+        or an explicit list) pads the TAIL batch up to a bucket rung by
+        repeating the last sample, so the final partial batch reuses an
+        already-compiled program instead of forcing a fresh XLA compile.
+        Padding duplicates samples: with ``batch_buckets`` prefer mean-type
+        losses (a sum-type loss counts the duplicated rows twice)."""
         self.dataset = dataset
         self.collate_fn = collate_fn
         self.num_workers = int(num_workers)
@@ -349,6 +388,7 @@ class DataLoader:
         self.return_list = return_list
         self.return_numpy = False
         self.use_buffer_reader = bool(use_buffer_reader)
+        self.prefetch_to_device = bool(prefetch_to_device)
 
         self.drop_last = bool(drop_last)
         if isinstance(dataset, IterableDataset):
@@ -367,17 +407,60 @@ class DataLoader:
                                               batch_size=batch_size,
                                               drop_last=drop_last)
             self.batch_size = batch_size
+        from ..perf.buckets import resolve_ladder
+        hi = self.batch_size if isinstance(self.batch_size, int) else None
+        self._batch_ladder = resolve_ladder(batch_buckets, hi)
 
     def _batches(self):
         if self.batch_sampler is None:
             return _InfiniteCounter(self.batch_size)
         return self.batch_sampler
 
+    def _pad_tail_batch(self, batch):
+        """Pad a partial batch's leading dim up to the bucket rung by
+        repeating the last sample (host-side, numpy)."""
+        sizes = {a.shape[0] for a in _ndarray_leaves(batch) if a.ndim > 0}
+        if len(sizes) != 1:
+            return batch  # ragged or array-free batch: leave it alone
+        b = sizes.pop()
+        target = self._batch_ladder.bucket(b)
+        if target == b:
+            return batch
+        pad = target - b
+
+        def pad_leaf(x):
+            if isinstance(x, np.ndarray) and x.ndim > 0:
+                return np.concatenate(
+                    [x, np.repeat(x[-1:], pad, axis=0)], axis=0)
+            return x
+
+        from ..observability.metrics import get_registry
+        get_registry().counter(
+            "dataloader.bucket_pad_rows",
+            "duplicated rows added to tail batches by bucket "
+            "padding").inc(pad)
+        return _map_ndarray_leaves(batch, pad_leaf)
+
+    def _host_postprocess(self, batch):
+        """Host-side (numpy) half of batch postprocessing — runs on the
+        iterator thread; the device transfer can then happen elsewhere
+        (the prefetcher thread)."""
+        if self._batch_ladder is not None:
+            batch = self._pad_tail_batch(batch)
+        return batch
+
+    def _to_device(self, batch):
+        return to_tensor_tree(batch)
+
     def _postprocess(self, batch):
+        batch = self._host_postprocess(batch)
         if self.return_numpy:
             return batch
-        out = to_tensor_tree(batch)
-        return out
+        if self.prefetch_to_device:
+            # stay numpy here: the DevicePrefetcher's feeder thread owns
+            # the (coalesced) host-to-device transfer
+            return batch
+        return self._to_device(batch)
 
     def __iter__(self):
         batches = self._batches()
@@ -385,6 +468,10 @@ class DataLoader:
             it = _SingleProcessIter(self, batches)
         else:
             it = _MultiprocessIter(self, batches)
+        if self.prefetch_to_device and not self.return_numpy:
+            from ..perf.prefetch import DevicePrefetcher
+            return DevicePrefetcher(it, depth=max(2, self.prefetch_factor),
+                                    transfer=self._to_device)
         if self.use_buffer_reader:
             return _BufferReader(it, depth=max(2, self.prefetch_factor))
 

@@ -28,6 +28,8 @@ from ..core import capture as _capture
 from ..core import random as _random
 from ..core.tensor import Tensor
 from ..optimizer.clip import ClipGradByGlobalNorm
+from ..perf import compile_cache as _cc
+from ..perf.buckets import resolve_ladder as _resolve_ladder
 
 __all__ = ["to_static", "not_to_static", "StaticFunction", "TrainStep",
            "enable_to_static"]
@@ -75,10 +77,11 @@ class StaticFunction:
         self._fn = fn
         self._cache: Dict[Any, dict] = {}
         self._full_graph = full_graph
-        self._buckets = tuple(sorted(batch_buckets)) if batch_buckets \
-            else None
-        self._seq_buckets = tuple(sorted(seq_buckets)) if seq_buckets \
-            else None
+        # bucket specs go through the shared perf ladder policy: a list is
+        # a custom ladder, "pow2"/"fixed:K" name the standard ones — the
+        # trace-cache key then quantizes to O(#buckets) signatures
+        self._buckets = _resolve_ladder(batch_buckets)
+        self._seq_buckets = _resolve_ladder(seq_buckets)
         self._seq_axis = seq_axis
         self._seq_mask_arg = seq_mask_arg
         self._seq_unpad_outputs = seq_unpad_outputs
@@ -120,10 +123,13 @@ class StaticFunction:
         key = _sig_of(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._trace(args, kwargs)
+            _cc.maybe_enable_persistent_cache()
+            with _cc.timed_miss():
+                entry = self._trace(args, kwargs)
             self._cache[key] = entry
             # pop so the cache doesn't pin the first call's autograd tape
             return entry.pop("first_out")
+        _cc.note_hit()
         return self._run(entry, args, kwargs)
 
     # -- bucketed dynamic-batch compilation (SURVEY §7 hard part (d)) -------
@@ -141,8 +147,8 @@ class StaticFunction:
         b = batched[0].shape[0]
         if any(t.shape[0] != b for t in batched):
             return self._dispatch(args, kwargs)  # mixed leading dims
-        bucket = next((k for k in self._buckets if b <= k), None)
-        if bucket is None or bucket == b:
+        bucket = self._buckets.bucket(b)
+        if bucket == b:  # exact rung, or above the ladder (identity)
             return self._dispatch(args, kwargs)
 
         from .. import concat
@@ -192,8 +198,8 @@ class StaticFunction:
         if not seqful:
             return self._inner_dispatch(args, kwargs)
         s = seqful[0].shape[ax]
-        bucket = next((k for k in self._seq_buckets if s <= k), None)
-        if bucket is None or bucket == s:
+        bucket = self._seq_buckets.bucket(s)
+        if bucket == s:  # exact rung, or above the ladder (identity)
             return self._inner_dispatch(args, kwargs)
 
         from .. import concat, zeros
@@ -397,6 +403,16 @@ class StaticFunction:
                 entry["sot"] = sot_cache
             return sot_cache.run(args, kwargs)
         try:
+            if not entry.get("warm"):
+                # first compiled execution at this signature pays the XLA
+                # compile — attribute its wall time to compile.elapsed
+                # (the signature's miss was already counted at trace time)
+                import time as _t
+                t0 = _t.perf_counter()
+                out = self._run_compiled(entry, args, kwargs)
+                _cc.observe_elapsed(_t.perf_counter() - t0)
+                entry["warm"] = True
+                return out
             return self._run_compiled(entry, args, kwargs)
         except self._graph_break_errors() as e:
             # Data-dependent python control flow (bool()/int()/float() of a
@@ -553,6 +569,7 @@ class TrainStep:
         key = _sig_of(args, {})
         entry = self._cache.get(key)
         if entry is None:
+            _cc.maybe_enable_persistent_cache()
             if self._cache:
                 # The pure step re-executes the model under tracing, so it is
                 # shape-polymorphic: a new batch shape only needs an XLA
@@ -566,10 +583,25 @@ class TrainStep:
                 # model zoo does) or run one eager step per shape first.
                 entry = next(iter(self._cache.values()))
                 self._cache[key] = entry
+                # the shared entry is shape-polymorphic but jax.jit still
+                # XLA-retraces at the new signature: a compile miss
+                with _cc.timed_miss():
+                    return self._run(entry, args)
             else:
-                entry = self._build(args)
+                with _cc.timed_miss():
+                    entry = self._build(args)
                 self._cache[key] = entry
                 return entry.pop("first_loss")
+        if not entry.get("warm"):
+            # first compiled execution after the eager discovery pass pays
+            # the XLA compile (the miss itself was counted at build time)
+            import time as _t
+            t0 = _t.perf_counter()
+            out = self._run(entry, args)
+            _cc.observe_elapsed(_t.perf_counter() - t0)
+            entry["warm"] = True
+            return out
+        _cc.note_hit()
         return self._run(entry, args)
 
     def _loss_fn(self, *args):

@@ -215,17 +215,31 @@ class GPT2ForCausalLM(Layer):
                               transpose_y=True)
         return self.lm_head(hidden)
 
-    def prefill(self, input_ids, s_max):
+    def prefill(self, input_ids, s_max, n_valid=None):
         """Prompt pass for incremental decode (the serving path).
 
         Returns (last_logits [B, 1, V], caches [L, 2, B, H, s_max, D],
         t [B, 1] int32 — the next write position).
+
+        ``n_valid`` ([B, 1] int32) marks the true prompt length when
+        ``input_ids`` is right-padded onto a bucket ladder: the last-token
+        hidden state is gathered at position n_valid-1 (a dynamic gather,
+        so ONE executable per bucket serves every prompt length) and decode
+        resumes at t = n_valid, overwriting the pad rows of the cache
+        before any step can attend them.
         """
         import paddle_tpu as paddle
         b, s = input_ids.shape
         hidden, caches = self.transformer.forward_prefill(input_ids, s_max)
-        logits = self._logits(hidden[:, s - 1:s])
-        t = paddle.full([b, 1], s, dtype="int32")
+        if n_valid is None:
+            last = hidden[:, s - 1:s]
+            t = paddle.full([b, 1], s, dtype="int32")
+        else:
+            from .. import ops
+            idx = (n_valid - 1).astype("int32").reshape([b, 1, 1])
+            last = ops.take_along_axis(hidden, idx, axis=1)
+            t = n_valid.astype("int32")
+        logits = self._logits(last)
         return logits, caches, t
 
     def decode_step(self, tok, caches, t):

@@ -840,16 +840,28 @@ class LlamaForCausalLM(Layer):
 
     # -- incremental (KV-cache) decode — the serving path -------------------
 
-    def prefill(self, input_ids, s_max):
+    def prefill(self, input_ids, s_max, n_valid=None):
         """Prompt pass for incremental decode. Returns
-        (last_logits [B, 1, V], caches [L, 2, B, KV, s_max, D], t [B])."""
+        (last_logits [B, 1, V], caches [L, 2, B, KV, s_max, D], t [B]).
+
+        ``n_valid`` ([B, 1] int32): true prompt lengths when ``input_ids``
+        is right-padded onto a bucket ladder — the final hidden state is
+        gathered at n_valid-1 and decode resumes at t = n_valid (pad cache
+        rows are overwritten before any decode step can attend them)."""
         import paddle_tpu as paddle
         b, s = input_ids.shape
         hidden, caches = self.model.forward_prefill(input_ids, s_max)
-        logits = self._lm_logits(hidden[:, s - 1:s])
-        # t is [B, 1] — the shared decode-state convention (GPT-2 and the
-        # serving batcher use the same shape)
-        t = paddle.to_tensor(np.full((b, 1), s, np.int32))
+        if n_valid is None:
+            last = hidden[:, s - 1:s]
+            # t is [B, 1] — the shared decode-state convention (GPT-2 and
+            # the serving batcher use the same shape)
+            t = paddle.to_tensor(np.full((b, 1), s, np.int32))
+        else:
+            from .. import ops
+            idx = (n_valid - 1).astype("int32").reshape([b, 1, 1])
+            last = ops.take_along_axis(hidden, idx, axis=1)
+            t = n_valid.astype("int32")
+        logits = self._lm_logits(last)
         return logits, caches, t
 
     def _lm_logits(self, hidden):

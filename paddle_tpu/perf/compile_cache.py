@@ -1,0 +1,174 @@
+"""Compilation caching + compile observability.
+
+Two layers, one goal: recompiles become rare AND measurable.
+
+  * **Persistent cache** — ``enable_persistent_cache()`` turns on JAX's
+    on-disk compilation cache (XLA executables survive process restarts;
+    the round-1 Llama compile through the remote-compile tunnel exceeded
+    15 minutes, so this is the difference between a cold start and a warm
+    one). Activated automatically by the jit layer when the
+    ``PADDLE_COMPILE_CACHE`` env var names a directory (``0``/empty
+    disables), or explicitly with a path.
+
+  * **Dispatch-cache counters** — every program cache the framework keeps
+    (``jit.StaticFunction`` signatures, ``jit.TrainStep`` entries, the
+    serving prefill/decode wrappers) reports through ``note_hit`` /
+    ``note_miss`` here, keyed on the abstractified signature (shapes,
+    dtypes, donation mask — ``signature_of``). ``compile.miss`` rising in
+    steady state IS the recompile bug, now a regressable number
+    (tests/test_perf.py guards it); ``compile.elapsed`` accumulates the
+    seconds spent tracing/compiling.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional, Tuple
+
+__all__ = ["enable_persistent_cache", "maybe_enable_persistent_cache",
+           "note_hit", "note_miss", "observe_elapsed", "signature_of",
+           "compile_metrics", "donation_safe", "timed_miss"]
+
+_ENV_VAR = "PADDLE_COMPILE_CACHE"
+_LOCK = threading.Lock()
+_PERSISTENT_STATE: Optional[str] = None   # None=unprobed, ""=off, path=on
+
+
+# -- persistent (on-disk) XLA executable cache -------------------------------
+
+def enable_persistent_cache(path: Optional[str] = None) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` (or the
+    ``PADDLE_COMPILE_CACHE`` env var). Returns True when active. Safe to
+    call repeatedly; failures (old jax, read-only fs) disable quietly —
+    a missing cache is slower, never wrong."""
+    global _PERSISTENT_STATE
+    with _LOCK:
+        target = path or os.environ.get(_ENV_VAR, "")
+        if target in ("", "0", "off", "none"):
+            _PERSISTENT_STATE = ""
+            return False
+        if _PERSISTENT_STATE == target:
+            return True
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", target)
+            # cache even quick compiles: steady-state dispatch is the
+            # point, and tiny test programs compile in < 1 s
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            _PERSISTENT_STATE = ""
+            return False
+        _PERSISTENT_STATE = target
+        return True
+
+
+def maybe_enable_persistent_cache() -> bool:
+    """Env-gated activation (the jit layer calls this before compiling):
+    probes ``PADDLE_COMPILE_CACHE`` once and remembers the answer."""
+    if _PERSISTENT_STATE is not None:
+        return bool(_PERSISTENT_STATE)
+    return enable_persistent_cache()
+
+
+# -- in-process dispatch-cache observability ---------------------------------
+
+def _reg():
+    from ..observability.metrics import get_registry
+    return get_registry()
+
+
+def _counters():
+    reg = _reg()
+    return (reg.counter("compile.hit",
+                        "dispatches served by an existing compiled program"),
+            reg.counter("compile.miss",
+                        "dispatches that traced/compiled a new program"),
+            reg.histogram("compile.elapsed",
+                          "seconds spent in trace/compile work",
+                          buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                                   5.0, 10.0, 30.0, 60.0, 300.0, 900.0)))
+
+
+def note_hit(n: int = 1) -> None:
+    _counters()[0].inc(n)
+
+
+def note_miss(elapsed_s: Optional[float] = None) -> None:
+    _, miss, hist = _counters()
+    miss.inc()
+    if elapsed_s is not None:
+        hist.observe(float(elapsed_s))
+
+
+def observe_elapsed(elapsed_s: float) -> None:
+    """Add compile-attributed seconds without counting a new miss (the
+    first run of an already-counted signature pays the XLA compile)."""
+    _counters()[2].observe(float(elapsed_s))
+
+
+@contextmanager
+def timed_miss():
+    """Time a miss-path block (trace/build) and record it as one miss."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        note_miss(time.perf_counter() - t0)
+
+
+def compile_metrics() -> dict:
+    """Current counters as plain numbers (bench.py emits these)."""
+    hit, miss, hist = _counters()
+    return {"compile_cache_hits": hit.value,
+            "compile_cache_misses": miss.value,
+            "compile_time_s": round(hist.sum, 3)}
+
+
+def signature_of(tree, donated: Tuple[int, ...] = ()) -> tuple:
+    """Abstractified, hashable dispatch key: tensor/array leaves reduce to
+    (shape, dtype), everything else stays by value; the donation mask is
+    part of the key (the same shapes with different donation compile
+    different executables)."""
+    import jax
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    def is_leaf(x):
+        return isinstance(x, Tensor)
+
+    flat, treedef = jax.tree_util.tree_flatten(tree, is_leaf=is_leaf)
+    parts = []
+    for x in flat:
+        if isinstance(x, Tensor):
+            parts.append(("T", tuple(x.shape), str(x.dtype)))
+        elif isinstance(x, (jax.Array, np.ndarray)):
+            parts.append(("A", tuple(x.shape), str(x.dtype)))
+        else:
+            parts.append(("S", repr(x)))
+    return (treedef, tuple(parts), tuple(donated))
+
+
+# -- donation safety (DF006 alias audit) -------------------------------------
+
+_DONATION_AUDIT: Optional[Tuple[bool, tuple]] = None
+
+
+def donation_safe() -> Tuple[bool, tuple]:
+    """Run the DF006 inplace/donation alias audit once per process and
+    cache the verdict. Donation-by-default paths (the hapi fused train
+    step) consult this before handing XLA the right to overwrite param /
+    opt-state buffers: a wrong alias declaration plus donation corrupts
+    memory on hardware, so any DF006 finding downgrades to non-donating."""
+    global _DONATION_AUDIT
+    if _DONATION_AUDIT is None:
+        try:
+            from ..analysis.dataflow import audit_inplace_aliases
+            findings = tuple(audit_inplace_aliases())
+        except Exception:
+            findings = ()
+        _DONATION_AUDIT = (not findings, findings)
+    return _DONATION_AUDIT

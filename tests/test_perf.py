@@ -1,0 +1,296 @@
+"""Perf layer: bucket ladders, compile counters, recompile guards,
+coalesced transfer, device prefetcher.
+
+The recompile guards are the PR's acceptance tests: N steady-state train
+steps and a mixed-length serving run must stop compiling after warmup —
+``compile.miss`` flat IS the "kill the recompiles" contract, enforced
+here so a future change that reintroduces per-shape churn fails CI.
+
+Tier-1 lane (marker: perf) under a time budget — everything here runs on
+tiny shapes.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.perf import (BucketLadder, ShapeBuckets, compile_metrics,
+                             resolve_ladder)
+from paddle_tpu.perf.buckets import pad_amount
+
+pytestmark = pytest.mark.perf
+
+TIME_BUDGET_S = 60
+
+
+@pytest.fixture(autouse=True)
+def _time_budget():
+    t0 = time.perf_counter()
+    yield
+    assert time.perf_counter() - t0 < TIME_BUDGET_S, \
+        "perf test exceeded its time budget"
+
+
+def _misses():
+    return compile_metrics()["compile_cache_misses"]
+
+
+# -- bucket ladders ----------------------------------------------------------
+
+def test_pow2_ladder_rungs():
+    assert list(BucketLadder.pow2(1, 32)) == [1, 2, 4, 8, 16, 32]
+    # hi that is not a power of two becomes the top rung
+    assert list(BucketLadder.pow2(1, 48))[-1] == 48
+
+
+def test_fixed_ladder_rungs():
+    assert list(BucketLadder.fixed(16, 64)) == [16, 32, 48, 64]
+    assert list(BucketLadder.fixed(16, 40)) == [16, 32, 40]
+
+
+def test_bucket_lookup_and_identity_above_top():
+    ladder = BucketLadder([4, 8, 16])
+    assert ladder.bucket(1) == 4
+    assert ladder.bucket(8) == 8
+    assert ladder.bucket(9) == 16
+    # above the top rung: identity, never truncation
+    assert ladder.bucket(17) == 17
+    assert ladder.bucket(1000) == 1000
+    # non-positive sizes pass through
+    assert ladder.bucket(0) == 0
+    assert ladder.bucket(-3) == -3
+
+
+def test_custom_ladder_must_be_strictly_increasing():
+    with pytest.raises(ValueError):
+        BucketLadder([4, 4, 8])
+    with pytest.raises(ValueError):
+        BucketLadder([8, 4])
+    with pytest.raises(ValueError):
+        BucketLadder([])
+    with pytest.raises(ValueError):
+        BucketLadder([0, 4])
+
+
+def test_resolve_ladder_specs():
+    assert resolve_ladder(None) is None
+    assert list(resolve_ladder("pow2", hi=16)) == [1, 2, 4, 8, 16]
+    assert list(resolve_ladder("fixed:8", hi=24)) == [8, 16, 24]
+    assert list(resolve_ladder([16, 4, 8])) == [4, 8, 16]  # sorted
+    ladder = BucketLadder([2, 4, 64])
+    assert list(resolve_ladder(ladder, hi=8)) == [2, 4, 8]  # capped
+    with pytest.raises(ValueError):
+        resolve_ladder("fixed:8")  # needs hi
+    with pytest.raises(ValueError):
+        resolve_ladder("fibonacci", hi=8)
+
+
+def test_pad_amount():
+    ladder = BucketLadder([4, 8])
+    assert pad_amount(ladder, 3) == 1
+    assert pad_amount(ladder, 4) == 0
+    assert pad_amount(ladder, 100) == 0  # out of ladder: no padding
+    assert pad_amount(None, 3) == 0
+
+
+def test_shape_buckets_empty_and_per_axis():
+    sb = ShapeBuckets({0: "pow2", 1: [128, 256]}, hi={0: 8})
+    assert sb.bucket_for(()) == ()  # empty (scalar) shape maps to itself
+    assert sb.bucket_for((3, 100)) == (4, 128)
+    assert sb.bucket_for((3, 300, 7)) == (4, 300, 7)  # axis 1 above ladder;
+    # axis 2 has no ladder -> passthrough
+
+
+# -- recompile guards (the acceptance tests) ---------------------------------
+
+def test_train_steps_stop_compiling_after_warmup():
+    """10 steady-state fused train steps: compile.miss must be flat after
+    step 1 (one discovery/build miss, then pure cache hits)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = paddle.hapi.Model(net)
+    model.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                    parameters=net.parameters()),
+                  loss=nn.MSELoss(), jit=True)
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 4).astype("float32")
+    y = rng.rand(8, 1).astype("float32")
+
+    model.train_batch([x], [y])  # warmup: the one allowed miss
+    m_after_warmup = _misses()
+    losses = [model.train_batch([x], [y])[0] for _ in range(10)]
+    assert len(losses) == 10
+    assert all(np.isfinite(l) for l in losses)
+    assert _misses() == m_after_warmup, \
+        "steady-state train steps recompiled — the recompile bug is back"
+
+
+def test_serving_mixed_lengths_bounded_compiles():
+    """Mixed prompt lengths drawn from <= 3 buckets: after the first wave,
+    a second wave of new lengths from the SAME buckets adds zero misses."""
+    from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+    from paddle_tpu.inference.serving import ContinuousBatcher
+
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    bat = ContinuousBatcher(m, max_batch=4, s_max=32, compile=True)
+    rng = np.random.RandomState(0)
+
+    # lengths spanning exactly 3 pow2 buckets: {4}, {5..8}, {9..16}
+    for L in [3, 5, 9, 4, 6, 12]:
+        bat.submit(rng.randint(1, 96, size=L), max_new_tokens=3)
+    out = bat.run_until_done()
+    assert len(out) == 6
+    m_wave1 = _misses()
+
+    # new lengths, same buckets -> zero new compiles
+    for L in [4, 7, 11, 8, 16]:
+        bat.submit(rng.randint(1, 96, size=L), max_new_tokens=3)
+    out = bat.run_until_done()
+    assert len(out) == 5
+    assert _misses() == m_wave1, \
+        "serving recompiled for prompt lengths inside known buckets"
+
+
+def test_serving_pad_waste_metric_counts():
+    from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+    from paddle_tpu.inference.serving import ContinuousBatcher
+    from paddle_tpu.observability.metrics import get_registry
+
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    waste = get_registry().counter("serving.bucket_pad_waste", "test")
+    before = waste.value
+    bat = ContinuousBatcher(m, max_batch=2, s_max=32, compile=False)
+    bat.submit(np.arange(1, 6), max_new_tokens=2)   # len 5 -> bucket 8: +3
+    bat.submit(np.arange(1, 9), max_new_tokens=2)   # len 8 -> exact rung
+    bat.run_until_done()
+    assert waste.value - before == 3
+
+
+def test_bucketed_serving_matches_unbucketed():
+    """Bucket padding must not change generated tokens (greedy)."""
+    from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+    from paddle_tpu.inference.serving import ContinuousBatcher
+
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, 96, size=L) for L in (3, 5, 11)]
+
+    outs = {}
+    for buckets in ("pow2", None):
+        bat = ContinuousBatcher(m, max_batch=4, s_max=32, compile=False,
+                                prompt_buckets=buckets)
+        rids = [bat.submit(p, max_new_tokens=4) for p in prompts]
+        res = bat.run_until_done()
+        outs[buckets] = [res[r] for r in rids]
+    for a, b in zip(outs["pow2"], outs[None]):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- persistent cache env gate -----------------------------------------------
+
+def test_persistent_cache_env_gate(tmp_path, monkeypatch):
+    from paddle_tpu.perf import compile_cache as cc
+
+    monkeypatch.setattr(cc, "_PERSISTENT_STATE", None)
+    monkeypatch.setenv("PADDLE_COMPILE_CACHE", "")
+    assert cc.maybe_enable_persistent_cache() is False
+    monkeypatch.setattr(cc, "_PERSISTENT_STATE", None)
+    monkeypatch.setenv("PADDLE_COMPILE_CACHE", "0")
+    assert cc.maybe_enable_persistent_cache() is False
+    monkeypatch.setattr(cc, "_PERSISTENT_STATE", None)
+    monkeypatch.setenv("PADDLE_COMPILE_CACHE", str(tmp_path / "xla"))
+    assert cc.maybe_enable_persistent_cache() is True
+    import jax
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
+    # leave the process with the cache disabled again
+    monkeypatch.setattr(cc, "_PERSISTENT_STATE", None)
+    monkeypatch.setenv("PADDLE_COMPILE_CACHE", "")
+    assert cc.maybe_enable_persistent_cache() is False
+
+
+# -- input pipeline ----------------------------------------------------------
+
+def test_coalesced_device_put_roundtrip():
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.perf.prefetch import coalesced_device_put
+
+    batch = {"x": np.arange(6, dtype="float32").reshape(2, 3),
+             "y": [np.ones(2, dtype="int64"), "tag"],
+             "n": 7}
+    out = coalesced_device_put(batch)
+    assert isinstance(out["x"], Tensor)
+    np.testing.assert_array_equal(out["x"].numpy(), batch["x"])
+    assert isinstance(out["y"][0], Tensor)
+    np.testing.assert_array_equal(out["y"][0].numpy(), batch["y"][0])
+    assert out["y"][1] == "tag"
+    assert out["n"] == 7
+
+
+def test_device_prefetcher_delivers_in_order_and_closes():
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.perf.prefetch import DevicePrefetcher
+
+    batches = [{"x": np.full((2, 2), i, dtype="float32")} for i in range(6)]
+    pf = DevicePrefetcher(iter(batches), depth=2)
+    got = list(pf)
+    assert len(got) == 6
+    for i, b in enumerate(got):
+        assert isinstance(b["x"], Tensor)
+        assert float(b["x"].numpy()[0, 0]) == float(i)
+    pf.close()  # idempotent
+
+
+def test_device_prefetcher_surfaces_source_errors():
+    from paddle_tpu.perf.prefetch import DevicePrefetcher
+
+    def boom():
+        yield {"x": np.zeros(2, dtype="float32")}
+        raise RuntimeError("source died")
+
+    pf = DevicePrefetcher(boom(), depth=2)
+    next(pf)
+    with pytest.raises(RuntimeError, match="source died"):
+        while True:
+            next(pf)
+
+
+def test_dataloader_prefetch_to_device_yields_tensors():
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.io.dataloader import DataLoader
+
+    data = [(np.full(3, i, dtype="float32"), np.int64(i)) for i in range(10)]
+    dl = DataLoader(data, batch_size=4, prefetch_to_device=True)
+    seen = []
+    for xb, yb in dl:
+        assert isinstance(xb, Tensor) and isinstance(yb, Tensor)
+        seen += yb.numpy().tolist()
+    assert seen == list(range(10))
+
+
+def test_dataloader_tail_batch_bucketing():
+    from paddle_tpu.io.dataloader import DataLoader
+
+    data = [(np.full(2, i, dtype="float32"), np.int64(i)) for i in range(11)]
+    dl = DataLoader(data, batch_size=4, batch_buckets="pow2")
+    shapes = [tuple(xb.shape) for xb, _ in dl]
+    # tail of 3 pads to the bucket rung 4 by repeating the last sample
+    assert shapes == [(4, 2), (4, 2), (4, 2)]
+    *_, (xb, yb) = iter(DataLoader(data, batch_size=4,
+                                   batch_buckets="pow2"))
+    assert yb.numpy().tolist() == [8, 9, 10, 10]
