@@ -1,0 +1,130 @@
+"""Serving control-plane benchmark: routed throughput through the
+multi-replica gateway.
+
+Headline number = end-to-end tokens/sec of a 2-replica gateway
+(least-loaded routing, mixed-priority tenants) driving compiled
+ContinuousBatcher replicas — the full control-plane path: admission,
+quota charge, priority queue, routing, replica stepping, token delivery.
+detail carries the latency SLO surface (TTFT p50/p99, TPOT p50/p99, in
+milliseconds, from the gateway's own histograms) plus a per-policy
+routed-rate sweep (least_loaded / affinity / weighted_rr), and the
+gateway.* telemetry series snapshot to BENCH_TELEMETRY.jsonl.
+
+Same JSON contract as bench.py: ONE stdout line
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": ...}
+vs_baseline stays 0.0 — the reference publishes no gateway figure to
+normalize against (BASELINE.md).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_gateway(model, policy, n_replicas, max_batch, s_max,
+                   compile):
+    from paddle_tpu.inference.gateway import Gateway
+    from paddle_tpu.inference.serving import ContinuousBatcher
+    gw = Gateway(policy=policy)
+    for i in range(n_replicas):
+        gw.add_replica(f"r{i}", ContinuousBatcher(
+            model, max_batch=max_batch, s_max=s_max, compile=compile))
+    return gw
+
+
+def _drive(gw, rng, vocab, ctx, n_requests, new_toks):
+    """Warm the replicas' executables on one request, then push a
+    staggered mixed-priority load and measure the steady window."""
+    gw.submit(rng.randint(0, vocab, (ctx,)), 4, tenant="warmup")
+    gw.run_until_done()
+    gw.reset_stats()
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        ln = ctx + (i * 7) % 32 - 16
+        gw.submit(rng.randint(0, vocab, (ln,)), new_toks,
+                  tenant=("interactive", "batch")[i % 3 == 2],
+                  priority=("high", "low")[i % 3 == 2],
+                  session_id=f"s{i % 4}")
+    gw.run_until_done()
+    dt = time.perf_counter() - t0
+    s = gw.stats()
+    return s["delivered_tokens"] / dt, s
+
+
+def main():
+    paddle.seed(0)
+    on_tpu = False
+    try:
+        import jax
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        pass
+    if on_tpu:
+        cfg = GPT2Config(vocab_size=32000, hidden_size=768,
+                         num_hidden_layers=12, num_attention_heads=12,
+                         max_position_embeddings=1024, dropout=0.0)
+        ctx, s_max, max_batch, n_requests, new_toks = 256, 512, 4, 12, 32
+        compile = True
+    else:
+        cfg = GPT2Config(vocab_size=2048, hidden_size=256,
+                         num_hidden_layers=4, num_attention_heads=8,
+                         max_position_embeddings=512, dropout=0.0)
+        ctx, s_max, max_batch, n_requests, new_toks = 64, 192, 4, 9, 16
+        compile = True
+    model = GPT2ForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+
+    detail = {"params": model.num_params(), "replicas": 2,
+              "max_batch_per_replica": max_batch, "requests": n_requests,
+              "new_tokens": new_toks, "tpu": on_tpu}
+    with paddle.no_grad():
+        rates = {}
+        headline_stats = None
+        for policy in ("least_loaded", "affinity", "weighted_rr"):
+            gw = _build_gateway(model, policy, 2, max_batch, s_max,
+                                compile)
+            rate, s = _drive(gw, rng, cfg.vocab_size, ctx, n_requests,
+                             new_toks)
+            rates[policy] = round(rate, 2)
+            if policy == "least_loaded":
+                headline_stats = s
+    detail["routed_tokens_per_s"] = rates
+
+    from paddle_tpu.observability import get_registry, write_jsonl
+    reg = get_registry()
+    for name, key in (("gateway.ttft_seconds", "ttft"),
+                      ("gateway.tpot_seconds", "tpot")):
+        h = reg.histogram(name)
+        for q, tag in ((0.5, "p50"), (0.99, "p99")):
+            v = h.quantile(q)
+            detail[f"{key}_{tag}_ms"] = (None if v is None
+                                         else round(v * 1e3, 3))
+    detail["completions"] = headline_stats["completions"]
+    detail["requeued"] = headline_stats["requeued"]
+    if on_tpu:
+        detail["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime())
+    try:
+        snap_path = os.path.join(_REPO_DIR, "BENCH_TELEMETRY.jsonl")
+        write_jsonl(snap_path, extra={"bench": "gateway", "tpu": on_tpu})
+    except Exception:
+        pass  # telemetry must never sink the bench number
+
+    print(json.dumps({
+        "metric": "gateway_routed_tokens_per_sec",
+        "value": rates["least_loaded"],
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "detail": detail,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -178,7 +178,12 @@ class BucketBatchingPredictor:
 
 from .serving import (ContinuousBatcher, PagedContinuousBatcher,  # noqa: E402
                       Request)
+from .gateway import (Gateway, GatewayRequest, Replica,  # noqa: E402
+                      ReplicaPool, StreamingSession, TenantQuotas,
+                      TokenBucket)
 
 __all__ = ["Config", "Predictor", "BucketBatchingPredictor",
            "ContinuousBatcher", "PagedContinuousBatcher", "Request",
+           "Gateway", "GatewayRequest", "Replica", "ReplicaPool",
+           "StreamingSession", "TenantQuotas", "TokenBucket",
            "create_predictor"]

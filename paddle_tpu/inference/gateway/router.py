@@ -1,0 +1,223 @@
+"""Request routing: placement policies + the two-level dispatch queue.
+
+Placement policies pick a replica for each dispatchable request:
+
+  * ``least_loaded``      — fewest in-flight requests (queued + active);
+    the goodput-oriented default (DistServe/Splitwise-style placement
+    degenerates to this when every replica runs the same phase mix).
+  * ``affinity``          — session stickiness first (follow-up turns
+    land on the replica holding the warm KV/compile state), then
+    prompt-BUCKET warmth (a replica that already compiled this
+    ``perf.buckets`` prefill rung is preferred — route to the warm
+    executable, not a cold one), falling back to least-loaded.
+  * ``weighted_rr``       — smooth weighted round-robin over replica
+    weights (heterogeneous pools: a 2x-capacity replica takes 2x the
+    requests).
+
+Routing decisions are instrumented: ``gateway.route.affinity_hit`` when
+a session/bucket match carried the decision, ``gateway.route.fallback``
+when the affinity policy had to fall back.
+
+The dispatch queue is TWO-LEVEL (interactive=0 above batch=1) with an
+anti-starvation share: every ``low_share``-th dispatch serves the low
+queue first, so a saturating stream of high-priority work cannot starve
+batch tenants (the acceptance bar: the low-priority tenant still
+completes under mixed load).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["RoutePolicy", "LeastLoadedPolicy", "SessionAffinityPolicy",
+           "WeightedRoundRobinPolicy", "resolve_policy", "DispatchQueue",
+           "PRIORITY_HIGH", "PRIORITY_LOW"]
+
+PRIORITY_HIGH = 0
+PRIORITY_LOW = 1
+
+
+def _route_metrics():
+    from ...observability.metrics import get_registry
+    reg = get_registry()
+    return (reg.counter("gateway.route.affinity_hit",
+                        "dispatches placed by session/bucket affinity"),
+            reg.counter("gateway.route.fallback",
+                        "affinity dispatches that fell back to "
+                        "least-loaded"))
+
+
+class RoutePolicy:
+    """Pick one replica from the routable candidates for a request."""
+
+    name = "base"
+
+    def select(self, req, candidates: Sequence):
+        raise NotImplementedError
+
+    def on_dispatch(self, req, replica):
+        """Observe a completed placement (update affinity state)."""
+
+
+class LeastLoadedPolicy(RoutePolicy):
+    name = "least_loaded"
+
+    def select(self, req, candidates: Sequence):
+        # (load, name): deterministic tie-break by name
+        return min(candidates, key=lambda r: (r.load, r.name))
+
+
+class WeightedRoundRobinPolicy(RoutePolicy):
+    """Smooth WRR (nginx-style): each pick adds weight to every
+    candidate's running credit and the winner pays back the total, so a
+    weight-2 replica lands 2 of every 3 dispatches without bursts."""
+
+    name = "weighted_rr"
+
+    def __init__(self):
+        self._credit: Dict[str, float] = {}
+
+    def select(self, req, candidates: Sequence):
+        total = 0.0
+        for r in candidates:
+            self._credit[r.name] = self._credit.get(r.name, 0.0) + r.weight
+            total += r.weight
+        # deterministic: max credit, name tie-break
+        best = max(candidates,
+                   key=lambda r: (self._credit[r.name], r.name))
+        self._credit[best.name] -= total
+        return best
+
+
+class SessionAffinityPolicy(RoutePolicy):
+    """Session stickiness, then prompt-bucket warmth, then fallback.
+
+    A follow-up turn (same ``session_id``) routes to the replica that
+    served the session before — its paged KV pages and compiled prefill
+    signatures for the conversation are warm. Requests without a sticky
+    session prefer a replica whose compile cache already holds the
+    prompt's ``perf.buckets`` rung (``Replica.warm_buckets``, recorded at
+    dispatch). Both count ``gateway.route.affinity_hit``; a miss counts
+    ``gateway.route.fallback`` and defers to the fallback policy.
+    """
+
+    name = "affinity"
+
+    def __init__(self, fallback: Optional[RoutePolicy] = None):
+        self.fallback = fallback or LeastLoadedPolicy()
+        self._sessions: Dict[str, str] = {}     # session_id -> replica name
+
+    def select(self, req, candidates: Sequence):
+        hit_c, fb_c = _route_metrics()
+        by_name = {r.name: r for r in candidates}
+        sid = getattr(req, "session_id", None)
+        if sid is not None and self._sessions.get(sid) in by_name:
+            hit_c.inc()
+            return by_name[self._sessions[sid]]
+        bucket = getattr(req, "bucket", None)
+        if bucket is not None:
+            warm = [r for r in candidates if bucket in r.warm_buckets]
+            if warm:
+                hit_c.inc()
+                return min(warm, key=lambda r: (r.load, r.name))
+        fb_c.inc()
+        return self.fallback.select(req, candidates)
+
+    def on_dispatch(self, req, replica):
+        sid = getattr(req, "session_id", None)
+        if sid is not None:
+            self._sessions[sid] = replica.name
+        bucket = getattr(req, "bucket", None)
+        if bucket is not None:
+            replica.warm_buckets.add(bucket)
+
+    def forget_replica(self, name: str):
+        """Drop sticky sessions pointing at a dead/removed replica so
+        their next turn re-routes instead of falling through the
+        candidate filter forever."""
+        for sid in [s for s, n in self._sessions.items() if n == name]:
+            del self._sessions[sid]
+
+
+_POLICIES = {
+    "least_loaded": LeastLoadedPolicy,
+    "affinity": SessionAffinityPolicy,
+    "weighted_rr": WeightedRoundRobinPolicy,
+}
+
+
+def resolve_policy(spec) -> RoutePolicy:
+    """Normalize the policy specs the gateway accepts: a name, a
+    RoutePolicy instance, or None (-> least_loaded)."""
+    if spec is None:
+        return LeastLoadedPolicy()
+    if isinstance(spec, RoutePolicy):
+        return spec
+    if isinstance(spec, str):
+        cls = _POLICIES.get(spec.strip().lower())
+        if cls is None:
+            raise ValueError(f"unknown routing policy {spec!r} "
+                             f"(one of {sorted(_POLICIES)})")
+        return cls()
+    raise ValueError(f"bad routing policy spec {spec!r}")
+
+
+class DispatchQueue:
+    """Two FIFO lanes (high above low) with a guaranteed low-lane share.
+
+    ``low_share=K`` means every K-th dispatch serves the low lane first
+    (when it has work); K=0 disables the share (strict priority). Counts
+    are deterministic — no clocks, no randomness — so scheduling replays
+    exactly in tests.
+    """
+
+    def __init__(self, low_share: int = 4):
+        if low_share < 0:
+            raise ValueError("low_share must be >= 0")
+        self.low_share = low_share
+        self._lanes = (deque(), deque())
+        self._dispatched = 0
+
+    def push(self, req):
+        self._lanes[req.priority].append(req)
+
+    def push_front(self, req):
+        """Requeue (replica death, failed dispatch): back to the HEAD of
+        its lane, preserving arrival order among its peers."""
+        self._lanes[req.priority].appendleft(req)
+
+    def __len__(self):
+        return len(self._lanes[0]) + len(self._lanes[1])
+
+    def _lane_order(self):
+        if self.low_share and self._lanes[PRIORITY_LOW] and \
+                (self._dispatched + 1) % self.low_share == 0:
+            return (PRIORITY_LOW, PRIORITY_HIGH)
+        return (PRIORITY_HIGH, PRIORITY_LOW)
+
+    def peek(self):
+        for lane in self._lane_order():
+            if self._lanes[lane]:
+                return self._lanes[lane][0]
+        return None
+
+    def pop(self):
+        for lane in self._lane_order():
+            if self._lanes[lane]:
+                self._dispatched += 1
+                return self._lanes[lane].popleft()
+        return None
+
+    def remove(self, req) -> bool:
+        try:
+            self._lanes[req.priority].remove(req)
+            return True
+        except ValueError:
+            return False
+
+    def drain(self) -> List:
+        """Empty both lanes (gateway shutdown), high lane first."""
+        out = list(self._lanes[0]) + list(self._lanes[1])
+        self._lanes[0].clear()
+        self._lanes[1].clear()
+        return out

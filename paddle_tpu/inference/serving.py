@@ -270,6 +270,10 @@ class _BatcherBase:
         ``DeadlineExceeded``."""
         prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
         self._validate(prompt, max_new_tokens)
+        # purge already-expired queued requests BEFORE the capacity
+        # check: a dead-on-arrival queue entry must not cause a shed
+        # (shed and deadline_expired stay disjoint per request)
+        self._expire_pending()
         if self._max_queue_depth is not None \
                 and len(self._pending) >= self._max_queue_depth:
             from ..resilience.recovery import Overloaded
@@ -294,6 +298,20 @@ class _BatcherBase:
         req.finished = True
         self._failed[req.rid] = exc
 
+    def _expire_pending(self):
+        """Abandon QUEUED requests whose deadline passed. Runs both at
+        the step boundary and at submit time (before the capacity
+        check), so an expired queue entry frees its spot instead of
+        pushing a live request into a shed."""
+        from ..resilience.recovery import DeadlineExceeded
+        now = _time.perf_counter()
+        for req in [r for r in self._pending
+                    if r.deadline_t is not None and now > r.deadline_t]:
+            self._pending.remove(req)
+            self._fail(req, DeadlineExceeded(
+                f"request {req.rid} expired while queued"))
+            self._tele.on_deadline_expired()
+
     def _expire_deadlines(self):
         """Abandon requests whose deadline passed — pending ones silently
         leave the queue, active ones release their slot (and cache
@@ -304,11 +322,7 @@ class _BatcherBase:
         def expired(r: Request) -> bool:
             return r.deadline_t is not None and now > r.deadline_t
 
-        for req in [r for r in self._pending if expired(r)]:
-            self._pending.remove(req)
-            self._fail(req, DeadlineExceeded(
-                f"request {req.rid} expired while queued"))
-            self._tele.on_deadline_expired()
+        self._expire_pending()
         for slot, req in list(self._slot_req.items()):
             if expired(req):
                 del self._slot_req[slot]
@@ -409,6 +423,31 @@ class _BatcherBase:
     @property
     def active(self) -> int:
         return len(self._slot_req)
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def request(self, rid: int) -> Optional[Request]:
+        """The live ``Request`` record for ``rid`` — queued, active,
+        mid-admission, or finished-but-unpopped; None once popped or
+        failed. Read-only view for fronting layers (the gateway polls
+        ``.tokens`` off it for streaming delivery)."""
+        for req in self._pending:
+            if req.rid == rid:
+                return req
+        for req in self._slot_req.values():
+            if req.rid == rid:
+                return req
+        adm = getattr(self, "_admitting", None)
+        if adm is not None and adm["req"].rid == rid:
+            return adm["req"]
+        return self._finished.get(rid)
+
+    def failure(self, rid: int) -> Optional[Exception]:
+        """The stored typed failure for ``rid`` (``DeadlineExceeded``,
+        …) without raising/popping it; None while healthy."""
+        return self._failed.get(rid)
 
 
 class ContinuousBatcher(_BatcherBase):

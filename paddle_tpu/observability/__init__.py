@@ -11,9 +11,12 @@ platform/monitor.h STATS_INT + the host profiler, fused):
     (``tools/telemetry_dump.py`` is the CLI over these).
 
 Instrumented out of the box: serving batchers (queue depth, admissions,
-preemptions, TTFT / per-token latency), collectives (bytes/count/latency
-per op), the hapi training loop (step time, tokens/sec, MFU), and the
-Pallas flash-attention autotune cache.
+preemptions, TTFT / per-token latency), the multi-replica serving
+gateway (``gateway.*``: routing affinity hits, per-tenant sheds,
+requeues off dead replicas, end-to-end TTFT/TPOT — dump with
+``tools/telemetry_dump.py --prefix gateway.``), collectives
+(bytes/count/latency per op), the hapi training loop (step time,
+tokens/sec, MFU), and the Pallas flash-attention autotune cache.
 """
 from __future__ import annotations
 

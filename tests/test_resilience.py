@@ -454,6 +454,30 @@ def test_serving_deadline_expires_with_typed_error():
     assert st["completed_requests"] == 1
 
 
+def test_serving_expired_and_shed_counters_disjoint():
+    """A request that expires while QUEUED must not also cause (or count
+    as) a shed: submit purges dead-on-arrival queue entries before the
+    capacity check, so the freed spot admits live work instead of
+    rejecting it. Regression for the deadline-expiry × shed interaction."""
+    from paddle_tpu.inference.serving import ContinuousBatcher
+    b = ContinuousBatcher(_tiny_lm(), max_batch=2, s_max=32, compile=False,
+                          max_queue_depth=2)
+    rid_dead = b.submit(np.arange(4), 4, deadline_s=0.0)  # expires in queue
+    rid_live = b.submit(np.arange(4), 4)
+    time.sleep(0.001)
+    # queue reads full (2/2), but the expired entry must be purged — this
+    # submit is ADMITTED, not shed
+    rid_late = b.submit(np.arange(4), 4)
+    outs = b.run_until_done()
+    assert sorted(outs) == [rid_live, rid_late]
+    with pytest.raises(DeadlineExceeded):
+        b.result(rid_dead)
+    st = b.stats()
+    assert st["deadline_expired"] == 1
+    assert st["requests_shed"] == 0          # disjoint: expired ≠ shed
+    assert st["completed_requests"] == 2
+
+
 def test_serving_active_request_deadline_releases_slot():
     """A request expiring MID-DECODE frees its slot for the queue."""
     from paddle_tpu.inference.serving import ContinuousBatcher

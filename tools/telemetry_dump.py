@@ -53,6 +53,18 @@ def _demo_workload():
             b.submit(rng.randint(0, 128, (s,)), n)
         b.run_until_done()
 
+        # the serving control plane: a 2-replica gateway populates the
+        # gateway.* series (routing, quotas, TTFT/TPOT)
+        from paddle_tpu.inference.gateway import Gateway
+        gw = Gateway(policy="affinity")
+        for name in ("r0", "r1"):
+            gw.add_replica(name, ContinuousBatcher(
+                m, max_batch=2, s_max=32, compile=False))
+        for i, (s, n) in enumerate(((5, 4), (6, 4), (5, 3))):
+            gw.submit(rng.randint(0, 128, (s,)), n,
+                      tenant="demo", session_id=f"s{i % 2}")
+        gw.run_until_done()
+
     from paddle_tpu import hapi, nn, optimizer
     net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
     model = hapi.Model(net)
@@ -74,6 +86,10 @@ def main(argv=None) -> int:
                          "the demo workload")
     ap.add_argument("--out", metavar="PATH", default=None,
                     help="write here instead of stdout")
+    ap.add_argument("--prefix", metavar="DOTTED.", default=None,
+                    help="only series whose name starts with this "
+                         "prefix (e.g. --prefix gateway. for the "
+                         "serving control plane)")
     ap.add_argument("--no-workload", action="store_true",
                     help="live mode without the demo workload (dumps "
                          "whatever this process has recorded, i.e. "
@@ -89,6 +105,9 @@ def main(argv=None) -> int:
         if not args.no_workload:
             _demo_workload()
         series = _export.snapshot_series()
+
+    if args.prefix:
+        series = [s for s in series if s["name"].startswith(args.prefix)]
 
     if args.format == "prometheus":
         text = _export.render_prometheus(series=series)
