@@ -245,9 +245,28 @@ class Model:
         if fwd and dt > 0:
             from ..utils.flops import peak_device_flops
             # train ≈ 3× forward (fwd + ~2× bwd), the usual MFU convention
+            mfu = 3.0 * fwd / (dt * peak_device_flops())
             reg.gauge("train_mfu",
                       "model FLOPs utilization of the train step").set(
-                          3.0 * fwd / (dt * peak_device_flops()))
+                          mfu)
+            # join against ROOFLINE.json: publishes roofline.mfu_gap and
+            # the per-phase gap attribution (no-op without the file)
+            from ..observability import roofline_attr
+            roofline_attr.observe_train_step(
+                dt, observed_mfu=mfu, tokens=tokens or None,
+                params=self._param_count_estimate())
+
+    def _param_count_estimate(self) -> Optional[int]:
+        """Cached trainable-parameter count (roofline config matching)."""
+        n = getattr(self, "_param_count", None)
+        if n is None:
+            try:
+                n = sum(int(np.prod(p.shape))
+                        for p in self.network.parameters())
+            except Exception:
+                n = 0
+            self._param_count = n
+        return n or None
 
     def _fwd_flops_estimate(self, shapes):
         """Per-input-shape forward-FLOPs estimate via utils.flops; 0 when

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ...observability import trace_context as _trace
 from ...resilience.recovery import DeadlineExceeded, Overloaded
 from ...perf.buckets import resolve_ladder
 from .quota import TenantQuotas, TokenBucket
@@ -73,6 +74,8 @@ class GatewayRequest:
     failure: Optional[Exception] = None
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+    trace: Optional[object] = None  # observability.TraceContext
+    spans: Dict[str, object] = field(default_factory=dict)
 
     @property
     def remaining(self) -> int:
@@ -258,6 +261,14 @@ class Gateway:
                     if self._ladder is not None else None),
             submit_t=now,
             deadline_t=None if budget is None else now + budget)
+        if _trace.enabled():
+            # one trace per request, minted HERE: every downstream span
+            # (queue/admit/prefill/decode/stream) shares this trace_id,
+            # including after a requeue off a dead replica
+            req.trace = _trace.new_trace("gateway.request", gid=gid,
+                                         tenant=tenant)
+            req.spans["queue"] = req.trace.begin("queue",
+                                                 priority=req.priority)
         self._requests[gid] = req
         self._queue.push(req)
         self._tele.requests += 1
@@ -352,8 +363,12 @@ class Gateway:
         ids = (np.concatenate([req.prompt,
                                np.asarray(req.delivered, np.int64)])
                if req.delivered else req.prompt)
+        qs = req.spans.pop("queue", None)
+        if qs is not None:
+            qs.end(replica=rep.name, attempt=req.attempts + 1)
         req.rid = rep.batcher.submit(ids, req.remaining,
-                                     deadline_s=budget)
+                                     deadline_s=budget,
+                                     trace=req.trace)
         req.replica = rep.name
         req._consumed = 0
         req.attempts += 1
@@ -375,6 +390,15 @@ class Gateway:
                 self._poll_one(req, rep)
                 if req.gid not in self._requests:
                     continue
+            # close the dead replica's open batcher spans, then mark the
+            # trace so every span begun AFTER this point carries
+            # requeued=1 (baggage merges at begin time)
+            if breq is not None and breq.spans:
+                _trace.end_open_spans(breq.spans, interrupted=1)
+            if req.trace is not None:
+                req.trace.baggage["requeued"] = 1
+                req.trace.event("requeue", replica=rep.name,
+                                delivered=len(req.delivered))
             req.replica = None
             req.rid = None
             req._consumed = 0
@@ -385,6 +409,9 @@ class Gateway:
                     f"(replicas kept dying under it)"))
                 continue
             self._queue.push_front(req)
+            if req.trace is not None:
+                req.spans["queue"] = req.trace.begin("queue",
+                                                     priority=req.priority)
             self._tele.requeued += 1
             self._tele.requeued_c.inc()
 
@@ -441,6 +468,11 @@ class Gateway:
     def _finish(self, req: GatewayRequest):
         req.finished = True
         req.finish_t = _time.perf_counter()
+        if req.spans:
+            _trace.end_open_spans(req.spans)
+        if req.trace is not None:
+            req.trace.finish(tokens=len(req.delivered),
+                             attempts=req.attempts)
         del self._requests[req.gid]
         self._finished[req.gid] = req
         self._tele.completions += 1
@@ -454,6 +486,10 @@ class Gateway:
 
     def _fail(self, req: GatewayRequest, exc: Exception):
         req.failure = exc
+        if req.spans:
+            _trace.end_open_spans(req.spans, error=type(exc).__name__)
+        if req.trace is not None:
+            req.trace.finish(error=type(exc).__name__)
         self._requests.pop(req.gid, None)
         self._failed[req.gid] = exc
         if isinstance(exc, DeadlineExceeded):

@@ -75,4 +75,14 @@ class TenantQuotas:
         b = self._buckets.get(tenant)
         if b is None:
             return True
-        return b.try_take(cost)
+        ok = b.try_take(cost)
+        _quota_level_g().labels(tenant=tenant).set(b._level)
+        return ok
+
+
+def _quota_level_g():
+    from ...observability.metrics import get_registry
+    return get_registry().gauge(
+        "gateway.quota.level",
+        "tenant token-bucket level after the latest admit decision",
+        labelnames=("tenant",))

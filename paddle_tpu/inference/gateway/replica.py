@@ -18,6 +18,7 @@ contract the paged batcher's preemption path relies on).
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ...resilience.recovery import HealthState
@@ -34,6 +35,14 @@ def _pool_metrics():
             reg.counter("gateway.replica_deaths",
                         "replicas declared dead after step failures",
                         labelnames=("replica",)))
+
+
+def _step_seconds_h():
+    from ...observability.metrics import get_registry
+    return get_registry().histogram(
+        "gateway.replica.step_seconds",
+        "wall time of one replica engine step (incl. retries)",
+        labelnames=("replica",))
 
 
 class Replica:
@@ -153,9 +162,12 @@ class ReplicaPool:
         latter after marking the replica dead (health drained, gauges
         updated). The caller requeues the dead replica's requests.
         """
+        t0 = _time.perf_counter()
         try:
             rids = self.step_retry.call(rep.batcher.step,
                                         point=f"gateway.step.{rep.name}")
+            _step_seconds_h().labels(replica=rep.name).observe(
+                _time.perf_counter() - t0)
             return "ok", rids
         except RetryGiveUp as exc:
             self._kill(rep)

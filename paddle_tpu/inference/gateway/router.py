@@ -26,6 +26,7 @@ completes under mixed load).
 """
 from __future__ import annotations
 
+import time as _time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
@@ -45,6 +46,14 @@ def _route_metrics():
             reg.counter("gateway.route.fallback",
                         "affinity dispatches that fell back to "
                         "least-loaded"))
+
+
+def _queue_wait_h():
+    from ...observability.metrics import get_registry
+    return get_registry().histogram(
+        "gateway.queue_wait_seconds",
+        "gateway-queue residency from submit to dispatch pop",
+        labelnames=("lane",))
 
 
 class RoutePolicy:
@@ -205,7 +214,14 @@ class DispatchQueue:
         for lane in self._lane_order():
             if self._lanes[lane]:
                 self._dispatched += 1
-                return self._lanes[lane].popleft()
+                req = self._lanes[lane].popleft()
+                submit_t = getattr(req, "submit_t", None)
+                if submit_t:
+                    _queue_wait_h().labels(
+                        lane="high" if lane == PRIORITY_HIGH
+                        else "low").observe(
+                        max(0.0, _time.perf_counter() - submit_t))
+                return req
         return None
 
     def remove(self, req) -> bool:

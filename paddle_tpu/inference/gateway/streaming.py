@@ -54,6 +54,10 @@ class StreamingSession:
     def push(self, tokens: List[int]):
         if self.closed:
             return
+        if tokens and self._req.trace is not None \
+                and "stream" not in self._req.spans:
+            # delivery span: first buffered token -> finish/close
+            self._req.spans["stream"] = self._req.trace.begin("stream")
         self._buf.extend(tokens)
 
     @property
@@ -80,6 +84,9 @@ class StreamingSession:
         keeps running; its full result stays available via
         ``gateway.result``."""
         self.closed = True
+        sp = self._req.spans.pop("stream", None)
+        if sp is not None:
+            sp.end(delivered=len(self._req.delivered))
         self._gw._on_session_closed(self)
 
     def __iter__(self) -> Iterator[int]:

@@ -34,6 +34,11 @@ class Request:
     finished: bool = False
     submit_t: float = 0.0       # perf_counter at submit (TTFT anchor)
     deadline_t: Optional[float] = None  # perf_counter; None = no deadline
+    # propagated request trace (observability.trace_context): the
+    # gateway mints it; the batcher opens admit/prefill/decode spans
+    # under it; ``spans`` holds the OPEN ones so abort paths can close
+    trace: Optional[object] = None
+    spans: Dict[str, object] = field(default_factory=dict)
 
     @property
     def done(self) -> bool:
@@ -128,9 +133,15 @@ class _ServingStats:
     def on_occupancy(self, n: int):
         self.occupancy_sum += n
 
-    def on_decode_time(self, dt: float, substeps: int = 1):
+    def on_decode_time(self, dt: float, substeps: int = 1,
+                       tokens: int = 0):
         self.step_seconds.observe(dt)
         self.token_seconds.observe(dt / max(substeps, 1))
+        if tokens:
+            # join the dispatch against the roofline's serving token
+            # bound (roofline.serving.* gauges; no-op without a model)
+            from ..observability import roofline_attr
+            roofline_attr.observe_serving_step(dt, tokens)
 
     def on_complete(self):
         self.completed += 1
@@ -261,13 +272,16 @@ class _BatcherBase:
                              f"exceeds slot capacity {self.s_max}")
 
     def submit(self, prompt_ids, max_new_tokens: int,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               trace: Optional[object] = None) -> int:
         """Queue a request. Raises typed ``Overloaded`` when the pending
         queue is at ``max_queue_depth`` (load shedding — a fronting layer
         maps it to 429). ``deadline_s`` (or the batcher's default) bounds
         the request's total latency: an expired request is abandoned at
         the next step boundary and its result() raises
-        ``DeadlineExceeded``."""
+        ``DeadlineExceeded``. ``trace`` (a ``TraceContext``) propagates a
+        fronting layer's request trace: the batcher opens its
+        admit/prefill/decode spans under it."""
         prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
         self._validate(prompt, max_new_tokens)
         # purge already-expired queued requests BEFORE the capacity
@@ -289,13 +303,47 @@ class _BatcherBase:
         now = _time.perf_counter()
         self._pending.append(Request(
             rid, prompt, max_new_tokens, submit_t=now,
-            deadline_t=None if budget is None else now + budget))
+            deadline_t=None if budget is None else now + budget,
+            trace=trace))
         self._tele.on_submit(len(self._pending))
         return rid
+
+    # -- request-trace hooks (observability.trace_context) -------------------
+    # All no-ops when the request carries no TraceContext (standalone
+    # batchers, tracing disabled): one attribute check per event.
+    def _trace_admit_begin(self, req: Request):
+        if req.trace is not None:
+            req.spans["admit"] = req.trace.begin("admit",
+                                                 engine=self._engine)
+
+    def _trace_prefill_begin(self, req: Request):
+        if req.trace is not None:
+            req.spans["prefill"] = req.trace.begin(
+                "prefill", parent=req.spans.get("admit"))
+
+    def _trace_prefill_end(self, req: Request, **tags):
+        sp = req.spans.pop("prefill", None)
+        if sp is not None:
+            sp.end(**tags)
+
+    def _trace_admit_end(self, req: Request, slot: int):
+        """Close the admit span and open the decode span (which stays
+        open across batched steps until the request finishes)."""
+        sp = req.spans.pop("admit", None)
+        if sp is not None:
+            sp.end(slot=slot)
+        if req.trace is not None:
+            req.spans["decode"] = req.trace.begin("decode", slot=slot)
+
+    def _trace_close(self, req: Request, **tags):
+        if req.spans:
+            from ..observability.trace_context import end_open_spans
+            end_open_spans(req.spans, **tags)
 
     def _fail(self, req: Request, exc: Exception):
         req.slot = None
         req.finished = True
+        self._trace_close(req, error=type(exc).__name__)
         self._failed[req.rid] = exc
 
     def _expire_pending(self):
@@ -373,6 +421,7 @@ class _BatcherBase:
             req.finished = True
             del self._slot_req[slot]
             self._release_slot(slot)
+            self._trace_close(req, tokens=len(req.tokens))
             self._finished[req.rid] = req
             self._tele.on_complete()
             return True
@@ -531,6 +580,7 @@ class ContinuousBatcher(_BatcherBase):
         while self._pending and self._free:
             req = self._pending.pop(0)
             slot = self._free.pop(0)
+            self._trace_admit_begin(req)
             prompt = req.prompt
             n = len(prompt)
             if self._prompt_ladder is not None:
@@ -547,6 +597,7 @@ class ContinuousBatcher(_BatcherBase):
             else:
                 n_valid = None
             ids = paddle.to_tensor(prompt[None, :])
+            self._trace_prefill_begin(req)
             with paddle.no_grad():
                 if n_valid is not None:
                     # n_valid is passed even for exact-rung prompts so every
@@ -555,6 +606,8 @@ class ContinuousBatcher(_BatcherBase):
                         ids, self.s_max, n_valid)
                 else:
                     logits, cache, _t = self._prefill_fn(ids, self.s_max)
+            self._trace_prefill_end(req, prompt_tokens=n,
+                                    padded_to=len(prompt))
             # write the slot: caches[:, :, slot] = cache[:, :, 0]
             self._caches[:, :, slot] = cache[:, :, 0]
             tok = int(self._pick(np.asarray(logits._data)[:, -1])[0])
@@ -565,6 +618,7 @@ class ContinuousBatcher(_BatcherBase):
             self._slot_req[slot] = req
             self._t[slot, 0] = len(req.prompt)
             self._last_tok[slot, 0] = tok
+            self._trace_admit_end(req, slot)
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)
         return finished
@@ -581,6 +635,7 @@ class ContinuousBatcher(_BatcherBase):
             return finished
         self._tele.on_step()
         self._tele.on_occupancy(len(self._slot_req))
+        n_active = len(self._slot_req)
         t0 = _time.perf_counter()
         tok_t = paddle.to_tensor(self._last_tok)
         t_t = paddle.to_tensor(self._t)
@@ -598,7 +653,8 @@ class ContinuousBatcher(_BatcherBase):
             self._last_tok[slot, 0] = tok
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)
-        self._tele.on_decode_time(_time.perf_counter() - t0)
+        self._tele.on_decode_time(_time.perf_counter() - t0,
+                                  tokens=n_active)
         self._tele.set_gauges(len(self._pending), len(self._slot_req))
         return finished
 
@@ -924,6 +980,8 @@ class PagedContinuousBatcher(_BatcherBase):
             if not self._alloc_pages(slot, upto):
                 raise RuntimeError("page accounting bug: admission gate "
                                    "passed but allocation failed")
+            self._trace_admit_begin(req)
+            self._trace_prefill_begin(req)
             bt_row = paddle.to_tensor(self._bt[slot:slot + 1])
             with paddle.no_grad():
                 if self.prefill_chunk:
@@ -941,6 +999,8 @@ class PagedContinuousBatcher(_BatcherBase):
                         self.model.paged_prefill_into(
                             ids, self._state["layers"], bt_row,
                             self.block_size)
+            self._trace_prefill_end(req, prompt_tokens=len(ids_np),
+                                    pages=need)
             tok = int(self._pick(np.asarray(logits._data))[0])
             req.slot = slot
             req.tokens.append(tok)
@@ -950,6 +1010,7 @@ class PagedContinuousBatcher(_BatcherBase):
             self._admit_order.append(slot)
             self._dec[slot] = len(ids_np)
             self._last_tok[slot] = tok
+            self._trace_admit_end(req, slot)
             if self._maybe_finish(req, tok):
                 finished.append(req.rid)
         return finished
@@ -1116,6 +1177,7 @@ class PagedContinuousBatcher(_BatcherBase):
             req.slot = None
             self._release_slot(slot)
             self._pending.insert(0, req)
+            self._trace_close(req, preempted=1)
             self._tele.on_preempt()
             return True
         return False
@@ -1183,6 +1245,8 @@ class PagedContinuousBatcher(_BatcherBase):
         # the rows the chunks are filling
         self._admitting = {"req": req, "slot": slot, "row": row,
                            "ids": padded, "L": L, "offset": 0}
+        self._trace_admit_begin(req)
+        self._trace_prefill_begin(req)
         return True
 
     def _abort_admission(self):
@@ -1194,6 +1258,7 @@ class PagedContinuousBatcher(_BatcherBase):
         self._free_slots.append(adm["slot"])
         self._pending.insert(0, adm["req"])
         self._admitting = None
+        self._trace_close(adm["req"], preempted=1)
         self._tele.on_preempt()
 
     def _fused_chunk_inputs(self):
@@ -1225,6 +1290,7 @@ class PagedContinuousBatcher(_BatcherBase):
         if not had_last:
             return
         req, slot = adm["req"], adm["slot"]
+        self._trace_prefill_end(req, prompt_tokens=L, fused=1)
         tok = int(self._pick(np.asarray(chunk_logits._data))[0])
         self._bt[slot] = adm["row"]
         self._dec[slot] = L
@@ -1236,6 +1302,7 @@ class PagedContinuousBatcher(_BatcherBase):
         self._slot_req[slot] = req
         self._admit_order.append(slot)
         self._admitting = None
+        self._trace_admit_end(req, slot)
         if self._maybe_finish(req, tok):
             finished.append(req.rid)
 
@@ -1252,6 +1319,7 @@ class PagedContinuousBatcher(_BatcherBase):
             self._decode_tail(finished)
             return finished
         self._step_prologue()
+        n_active = len(self._slot_req)
         t0 = _time.perf_counter()
         tok_t = paddle.to_tensor(self._last_tok)
         ids_t, row_t, dec_t, at_t = self._fused_chunk_inputs()
@@ -1260,7 +1328,8 @@ class PagedContinuousBatcher(_BatcherBase):
                 tok_t, ids_t, row_t, dec_t, at_t, self._state)
         self._advance_decoders(dec_logits, finished)
         self._finish_admission(chunk_logits, finished)
-        self._tele.on_decode_time(_time.perf_counter() - t0)
+        self._tele.on_decode_time(_time.perf_counter() - t0,
+                                  tokens=n_active)
         return finished
 
     def _advance_decoders(self, logits, finished: List[int]):
@@ -1302,12 +1371,14 @@ class PagedContinuousBatcher(_BatcherBase):
             self._decode_block_tail(finished)
             return
         self._step_prologue()
+        n_active = len(self._slot_req)
         t0 = _time.perf_counter()
         tok_t = paddle.to_tensor(self._last_tok)
         with paddle.no_grad():
             logits, self._state = self._step_fn(tok_t, self._state)
         self._advance_decoders(logits, finished)
-        self._tele.on_decode_time(_time.perf_counter() - t0)
+        self._tele.on_decode_time(_time.perf_counter() - t0,
+                                  tokens=n_active)
 
     def _block_backed(self, K: int) -> bool:
         """A K-step block is safe when, for every active slot, the rows
@@ -1361,12 +1432,14 @@ class PagedContinuousBatcher(_BatcherBase):
         self._tele.on_decode_block()
         self._tele.set_gauges(len(self._pending), len(self._slot_req))
         self._sync_tables()
+        n_active = len(self._slot_req)
         t0 = _time.perf_counter()
         tok_t = paddle.to_tensor(self._last_tok)
         with paddle.no_grad():
             toks, self._state = self._block_fn(tok_t, self._state)
         toks_np = np.asarray(toks._data)                  # [K, B]
-        self._tele.on_decode_time(_time.perf_counter() - t0, K)
+        self._tele.on_decode_time(_time.perf_counter() - t0, K,
+                                  tokens=K * n_active)
         # survivors consumed all K rows; evicted slots' counters are
         # reset at their next admission
         self._dec += K * np.asarray(self._slot_active_mask(), np.int32)

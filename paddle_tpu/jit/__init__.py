@@ -602,7 +602,18 @@ class TrainStep:
             entry["warm"] = True
             return out
         _cc.note_hit()
-        return self._run(entry, args)
+        import time as _t
+        t0 = _t.perf_counter()
+        out = self._run(entry, args)
+        # steady-state (warm-hit) step latency feeds the roofline gap
+        tokens = None
+        shape = getattr(args[0], "shape", None) if args else None
+        if shape:
+            tokens = 1
+            for d in shape:
+                tokens *= int(d)
+        _cc.observe_steady_step(_t.perf_counter() - t0, tokens=tokens)
+        return out
 
     def _loss_fn(self, *args):
         if self._amp:

@@ -20,17 +20,24 @@ tokens/sec, MFU), and the Pallas flash-attention autotune cache.
 """
 from __future__ import annotations
 
-from . import export, metrics, tracing
+from . import export, metrics, roofline_attr, slo, trace_context, tracing
 from .export import load_jsonl, render_prometheus, write_jsonl
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
+from .slo import SLO, Alert, BurnWindow, SLOMonitor, default_gateway_slos
+from .trace_context import (TraceContext, TraceRecorder, TraceSpan,
+                            get_recorder, new_trace)
 from .tracing import (Span, attach_context, capture_context, current_span,
                       span, span_path, traced)
 
 __all__ = [
-    "metrics", "tracing", "export",
+    "metrics", "tracing", "export", "trace_context", "roofline_attr",
+    "slo",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Span", "span", "current_span", "span_path", "capture_context",
     "attach_context", "traced",
+    "TraceContext", "TraceSpan", "TraceRecorder", "get_recorder",
+    "new_trace",
+    "SLO", "Alert", "BurnWindow", "SLOMonitor", "default_gateway_slos",
     "render_prometheus", "write_jsonl", "load_jsonl",
 ]

@@ -293,11 +293,23 @@ class Histogram:
         return self._sum
 
     def quantile(self, q: float) -> Optional[float]:
-        """Streaming quantile estimate from the reservoir (None if empty)."""
+        """Streaming quantile estimate from the reservoir (None if empty).
+
+        q is validated into [0, 1]; the extremes return the EXACT
+        observed min/max (tracked over every observation — past the
+        reservoir cap the sampled extremes may have been evicted)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
         with self._lock:
             if not self._reservoir:
                 return None
+            if q == 0.0:
+                return self._min
+            if q == 1.0:
+                return self._max
             s = sorted(self._reservoir)
+        if len(s) == 1:
+            return s[0]
         idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
         return s[idx]
 

@@ -1,0 +1,179 @@
+"""Roofline attribution: WHY is MFU where it is, not just what it is.
+
+``ROOFLINE.json`` (tools/roofline.py output committed at the repo root)
+models each bench config's compute time (``t_compute_ms``), HBM time
+(``t_memory_ms``), binding resource, and the MFU ceiling the roofline
+permits (``measured_mfu_ceiling``). This module JOINS live step timings
+against those bounds and publishes the explanation as gauges:
+
+  * ``roofline.observed_mfu``   — the MFU the caller measured;
+  * ``roofline.mfu_ceiling``    — what the matched config's roofline
+                                  says is attainable;
+  * ``roofline.mfu_gap``        — ceiling minus observed: the number a
+                                  perf round is supposed to shrink;
+  * ``roofline.bound``          — 0 = compute-bound, 1 = memory-bound;
+  * ``roofline.gap_attribution{phase=...}`` — the observed step time
+    split into ``compute`` (roofline-mandated MXU time), ``memory``
+    (HBM time EXPOSED beyond compute overlap), and ``overhead``
+    (everything the roofline does not mandate: host gaps, dispatch,
+    recompiles — the attackable fraction), each as a fraction of the
+    observed step;
+  * ``roofline.serving.tokens_per_s`` / ``roofline.serving.bound_frac``
+    — serving decode throughput vs the config's token bound.
+
+Attribution scales the config's per-step bounds by the caller's token
+count, so a different batch/seq still attributes sensibly; on a CPU
+proxy the overhead fraction is honestly ~1.0 (the roofline models the
+TPU). Missing/unreadable ROOFLINE.json degrades to a silent no-op —
+attribution must never take down a train step.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["load_roofline", "match_config", "observe_train_step",
+           "observe_serving_step", "roofline_path"]
+
+_LOCK = threading.Lock()
+_CACHE: Dict[str, object] = {}
+
+
+def roofline_path() -> str:
+    """``PADDLE_ROOFLINE`` env override, else the repo-root file."""
+    env = os.environ.get("PADDLE_ROOFLINE")
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "ROOFLINE.json")
+
+
+def load_roofline(path: Optional[str] = None) -> Optional[dict]:
+    """Parse (and cache) the roofline model; None when unavailable."""
+    p = path or roofline_path()
+    with _LOCK:
+        if p in _CACHE:
+            return _CACHE[p]  # type: ignore[return-value]
+        try:
+            with open(p) as f:
+                data = json.load(f)
+            if not isinstance(data.get("configs"), list) \
+                    or not data["configs"]:
+                data = None
+        except (OSError, ValueError):
+            data = None
+        _CACHE[p] = data
+        return data
+
+
+def clear_cache() -> None:
+    with _LOCK:
+        _CACHE.clear()
+
+
+def match_config(roofline: dict, params: Optional[int] = None,
+                 name: Optional[str] = None) -> dict:
+    """Pick the config entry to attribute against: explicit name (or
+    ``PADDLE_ROOFLINE_CONFIG``) wins, else nearest by param count, else
+    the first entry."""
+    configs: List[dict] = roofline["configs"]
+    name = name or os.environ.get("PADDLE_ROOFLINE_CONFIG")
+    if name:
+        for c in configs:
+            if c.get("config") == name:
+                return c
+    if params:
+        return min(configs,
+                   key=lambda c: abs(c.get("params", 0) - params))
+    return configs[0]
+
+
+def _gauges():
+    from .metrics import get_registry
+    reg = get_registry()
+    return {
+        "observed": reg.gauge("roofline.observed_mfu",
+                              "measured model FLOPs utilization"),
+        "ceiling": reg.gauge("roofline.mfu_ceiling",
+                             "roofline-attainable MFU of the matched "
+                             "config"),
+        "gap": reg.gauge("roofline.mfu_gap",
+                         "mfu_ceiling minus observed_mfu — the "
+                         "closable distance"),
+        "bound": reg.gauge("roofline.bound",
+                           "binding resource of the matched config "
+                           "(0=compute, 1=memory)"),
+        "attr": reg.gauge("roofline.gap_attribution",
+                          "observed step time split by phase "
+                          "(fraction of the step)",
+                          labelnames=("phase",)),
+    }
+
+
+def observe_train_step(step_s: float, observed_mfu: float,
+                       tokens: Optional[int] = None,
+                       params: Optional[int] = None,
+                       config: Optional[str] = None) -> Optional[dict]:
+    """Join one train-step timing against the roofline; publish gauges.
+
+    Returns the attribution dict (also useful to callers/tests), or
+    None when no roofline model is available.
+    """
+    roofline = load_roofline()
+    if roofline is None or step_s <= 0:
+        return None
+    cfg = match_config(roofline, params=params, name=config)
+    ceiling = float(cfg.get("measured_mfu_ceiling", 1.0))
+    t_compute = float(cfg.get("t_compute_ms", 0.0)) / 1e3
+    t_memory = float(cfg.get("t_memory_ms", 0.0)) / 1e3
+    cfg_tokens = max(1, int(cfg.get("batch", 1)) * int(cfg.get("seq", 1)))
+    scale = (tokens / cfg_tokens) if tokens else 1.0
+    # roofline-mandated times for THIS step's token count
+    tc, tm = t_compute * scale, t_memory * scale
+    t_ideal = max(tc, tm)
+    compute_frac = min(1.0, tc / step_s)
+    memory_frac = min(1.0 - compute_frac, max(0.0, tm - tc) / step_s)
+    overhead_frac = max(0.0, (step_s - t_ideal) / step_s)
+    g = _gauges()
+    g["observed"].set(float(observed_mfu))
+    g["ceiling"].set(ceiling)
+    g["gap"].set(ceiling - float(observed_mfu))
+    g["bound"].set(1.0 if cfg.get("bound") == "memory" else 0.0)
+    g["attr"].labels(phase="compute").set(compute_frac)
+    g["attr"].labels(phase="memory").set(memory_frac)
+    g["attr"].labels(phase="overhead").set(overhead_frac)
+    return {"config": cfg.get("config"), "mfu_ceiling": ceiling,
+            "mfu_gap": ceiling - float(observed_mfu),
+            "bound": cfg.get("bound"),
+            "compute_frac": compute_frac, "memory_frac": memory_frac,
+            "overhead_frac": overhead_frac}
+
+
+def observe_serving_step(step_s: float, tokens: int,
+                         config: Optional[str] = None) -> None:
+    """Join one decode dispatch against the config's token-rate bound.
+
+    ``roofline.serving.bound_frac`` is observed decode tokens/s over the
+    roofline's ``tokens_per_s_bound`` — how much of the modeled ceiling
+    serving actually achieves (CPU proxies read near 0; that is the
+    honest answer).
+    """
+    if step_s <= 0 or tokens <= 0:
+        return
+    roofline = load_roofline()
+    if roofline is None:
+        return
+    cfg = match_config(roofline, name=config)
+    bound = float(cfg.get("tokens_per_s_bound", 0.0))
+    rate = tokens / step_s
+    from .metrics import get_registry
+    reg = get_registry()
+    reg.gauge("roofline.serving.tokens_per_s",
+              "decode tokens/sec of the latest serving dispatch"
+              ).set(rate)
+    if bound > 0:
+        reg.gauge("roofline.serving.bound_frac",
+                  "serving decode rate over the roofline token bound"
+                  ).set(rate / bound)

@@ -1,0 +1,251 @@
+"""SLO burn-rate monitoring over the serving latency histograms.
+
+SRE-workbook style multi-window alerting: an SLO ("99% of requests see
+TTFT <= 0.5 s") defines an error budget (1 - objective); the BURN RATE
+is the observed error rate divided by that budget (burn 1.0 = exactly
+spending the budget over the SLO period; burn 14.4 = the budget gone in
+1/14.4 of it). An alert fires only when BOTH a fast and a slow window
+burn above the threshold — the fast window gives low detection latency,
+the slow window keeps a short blip from paging.
+
+The monitor is PULL-based over the cumulative histograms the gateway
+already populates (``gateway.ttft_seconds`` / ``gateway.tpot_seconds``):
+each ``poll()`` snapshots (total, good-within-threshold) per SLO into a
+bounded ring, and window rates are deltas between snapshots — no second
+event pipe, no per-request cost. Good-count comes from the histogram's
+bucket counts, so thresholds should sit on a bucket bound (the default
+latency ladder covers the usual SLO points).
+
+Clock-injectable (tests replay deterministically); alerts are typed
+``Alert`` records kept on the monitor AND counted in the registry
+(``slo.alerts_total{slo,severity}``), with live burn gauges
+(``slo.burn_rate{slo,window}``) for dashboards.
+"""
+from __future__ import annotations
+
+import bisect
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import Histogram, get_registry
+
+__all__ = ["SLO", "BurnWindow", "Alert", "SLOMonitor",
+           "default_gateway_slos", "DEFAULT_WINDOWS"]
+
+
+@dataclass(frozen=True)
+class SLO:
+    """latency objective: ``objective`` of requests complete within
+    ``threshold_s`` on the histogram series ``metric``."""
+
+    name: str
+    metric: str
+    threshold_s: float
+    objective: float = 0.99
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1), "
+                             f"got {self.objective}")
+        if self.threshold_s <= 0:
+            raise ValueError("threshold_s must be positive")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+
+@dataclass(frozen=True)
+class BurnWindow:
+    """One multi-window burn-rate rule: alert when both the fast and the
+    slow window burn at >= ``burn_threshold``."""
+
+    fast_s: float
+    slow_s: float
+    burn_threshold: float
+    severity: str = "page"
+
+
+# the SRE-workbook defaults (1h/5m page at 14.4x, 6h/30m ticket at 6x),
+# fast window listed first
+DEFAULT_WINDOWS: Tuple[BurnWindow, ...] = (
+    BurnWindow(fast_s=300.0, slow_s=3600.0, burn_threshold=14.4,
+               severity="page"),
+    BurnWindow(fast_s=1800.0, slow_s=21600.0, burn_threshold=6.0,
+               severity="ticket"),
+)
+
+
+@dataclass
+class Alert:
+    """One fired burn-rate alert (typed record, kept on the monitor)."""
+
+    slo: str
+    severity: str
+    burn_fast: float
+    burn_slow: float
+    fast_window_s: float
+    slow_window_s: float
+    fired_at: float
+    message: str = ""
+
+
+def default_gateway_slos(ttft_s: float = 0.5, tpot_s: float = 0.1,
+                         objective: float = 0.99) -> List[SLO]:
+    """The two SLOs the gateway's admission control already speaks."""
+    return [SLO("gateway_ttft", "gateway.ttft_seconds", ttft_s,
+                objective),
+            SLO("gateway_tpot", "gateway.tpot_seconds", tpot_s,
+                objective)]
+
+
+@dataclass
+class _Snap:
+    t: float
+    total: int
+    good: int
+
+
+class SLOMonitor:
+    """Multi-window burn-rate evaluation over registry histograms."""
+
+    def __init__(self, slos: Sequence[SLO],
+                 windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
+                 registry=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_snapshots: int = 4096):
+        if not slos:
+            raise ValueError("need at least one SLO")
+        self.slos = list(slos)
+        self.windows = list(windows)
+        self._reg = registry or get_registry()
+        self._clock = clock
+        self._snaps: Dict[str, deque] = {
+            s.name: deque(maxlen=max_snapshots) for s in self.slos}
+        self.alerts: List[Alert] = []
+        self._active: set = set()       # (slo, severity) currently firing
+        self._burn_g = self._reg.gauge(
+            "slo.burn_rate", "error-budget burn rate by SLO and window",
+            labelnames=("slo", "window"))
+        self._alerts_c = self._reg.counter(
+            "slo.alerts_total", "burn-rate alerts fired",
+            labelnames=("slo", "severity"))
+
+    # -- histogram reading ----------------------------------------------------
+    def _counts(self, slo: SLO) -> Tuple[int, int]:
+        """(total, good-within-threshold) from the cumulative histogram;
+        label families sum across children."""
+        entry = self._reg.get(slo.metric)
+        if entry is None:
+            return 0, 0
+        children = (entry.children() if hasattr(entry, "children")
+                    else [entry])
+        total = good = 0
+        for h in children:
+            if not isinstance(h, Histogram):
+                continue
+            counts = h.bucket_counts()
+            # bucket i counts observations v with v <= buckets[i] (and
+            # > buckets[i-1]); good = every bucket whose bound fits
+            k = bisect.bisect_right(h.buckets, slo.threshold_s + 1e-12)
+            total += sum(counts)
+            good += sum(counts[:k])
+        return total, good
+
+    # -- window arithmetic ----------------------------------------------------
+    @staticmethod
+    def _at_or_before(snaps: deque, t: float) -> Optional[_Snap]:
+        """Newest snapshot taken at or before ``t`` (None if all are
+        newer — then the oldest is the best available partial window)."""
+        best = None
+        for s in snaps:
+            if s.t <= t:
+                best = s
+            else:
+                break
+        return best
+
+    def _error_rate(self, snaps: deque, window_s: float,
+                    now: float) -> float:
+        cur = snaps[-1]
+        base = self._at_or_before(snaps, now - window_s) or snaps[0]
+        d_total = cur.total - base.total
+        if d_total <= 0:
+            return 0.0
+        d_bad = d_total - (cur.good - base.good)
+        return max(0.0, d_bad / d_total)
+
+    # -- the evaluation tick --------------------------------------------------
+    def poll(self, now: Optional[float] = None) -> List[Alert]:
+        """Snapshot every SLO's histogram and evaluate all burn windows.
+        Returns alerts that fired DURING THIS CALL (edge-triggered: an
+        alert re-fires only after its condition clears and re-arms)."""
+        now = self._clock() if now is None else now
+        fired: List[Alert] = []
+        for slo in self.slos:
+            snaps = self._snaps[slo.name]
+            total, good = self._counts(slo)
+            snaps.append(_Snap(now, total, good))
+            for w in self.windows:
+                burn_fast = self._error_rate(snaps, w.fast_s,
+                                             now) / slo.budget
+                burn_slow = self._error_rate(snaps, w.slow_s,
+                                             now) / slo.budget
+                self._burn_g.labels(
+                    slo=slo.name,
+                    window=f"{int(w.fast_s)}s").set(burn_fast)
+                self._burn_g.labels(
+                    slo=slo.name,
+                    window=f"{int(w.slow_s)}s").set(burn_slow)
+                key = (slo.name, w.severity)
+                if burn_fast >= w.burn_threshold \
+                        and burn_slow >= w.burn_threshold:
+                    if key not in self._active:
+                        self._active.add(key)
+                        alert = Alert(
+                            slo=slo.name, severity=w.severity,
+                            burn_fast=burn_fast, burn_slow=burn_slow,
+                            fast_window_s=w.fast_s, slow_window_s=w.slow_s,
+                            fired_at=now,
+                            message=(f"{slo.name}: burning "
+                                     f"{burn_fast:.1f}x budget over "
+                                     f"{int(w.fast_s)}s and "
+                                     f"{burn_slow:.1f}x over "
+                                     f"{int(w.slow_s)}s (threshold "
+                                     f"{w.burn_threshold}x, objective "
+                                     f"{slo.objective})"))
+                        self.alerts.append(alert)
+                        fired.append(alert)
+                        self._alerts_c.labels(
+                            slo=slo.name, severity=w.severity).inc()
+                else:
+                    self._active.discard(key)
+        return fired
+
+    def summary(self) -> dict:
+        """Current state for dashboards / ``telemetry_dump --slo``."""
+        out: dict = {"slos": [], "alerts": [a.__dict__ for a in
+                                            self.alerts]}
+        for slo in self.slos:
+            snaps = self._snaps[slo.name]
+            cur = snaps[-1] if snaps else None
+            burns = {}
+            if cur is not None:
+                for w in self.windows:
+                    burns[f"{int(w.fast_s)}s"] = self._error_rate(
+                        snaps, w.fast_s, cur.t) / slo.budget
+                    burns[f"{int(w.slow_s)}s"] = self._error_rate(
+                        snaps, w.slow_s, cur.t) / slo.budget
+            out["slos"].append({
+                "name": slo.name, "metric": slo.metric,
+                "threshold_s": slo.threshold_s,
+                "objective": slo.objective,
+                "total": cur.total if cur else 0,
+                "good": cur.good if cur else 0,
+                "burn_rates": burns,
+                "firing": sorted(sev for (n, sev) in self._active
+                                 if n == slo.name),
+            })
+        return out

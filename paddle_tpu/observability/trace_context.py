@@ -1,0 +1,279 @@
+"""Request-scoped distributed tracing (Dapper-style propagated contexts).
+
+``tracing.py`` times NESTED scopes inside one thread; this module follows
+ONE REQUEST across subsystem boundaries: a ``TraceContext`` (trace_id +
+span ids + baggage) is minted at ``Gateway.submit``, handed through the
+dispatch queue, the router, the replica's batcher (which stores it on its
+per-request ``Request`` record), and the ``StreamingSession`` — surviving
+token-exact requeue off a dead replica, where the resumed request keeps
+the ORIGINAL trace_id and every later span carries the ``requeued=1``
+baggage tag. The result: a single request's TTFT decomposes into
+queue / admit / prefill / decode / stream spans you can open in
+``chrome://tracing``.
+
+Spans are recorded with explicit begin/end timestamps (not context
+managers) because serving spans open in one call and close several steps
+later — e.g. ``decode`` opens at admission and closes when the request
+finishes. ``TraceSpan.end`` is idempotent, so abort paths (replica
+death, deadline expiry, preemption) can close whatever is open without
+double-recording.
+
+Propagation across process boundaries uses the W3C ``traceparent``
+header shape (``00-<trace_id>-<span_id>-01``) plus a ``baggage``
+``k=v`` list — ``TraceContext.traceparent()`` /
+``TraceContext.from_traceparent`` round-trip it.
+
+Set ``PADDLE_TRACE=0`` to disable minting entirely (hot-path cost drops
+to one ``is None`` check per event).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+__all__ = ["TraceContext", "TraceSpan", "TraceRecorder", "get_recorder",
+           "new_trace", "enabled", "end_open_spans"]
+
+_TRACE_IDS = itertools.count(1)
+_SPAN_IDS = itertools.count(1)
+_RECORDER_CAP = int(os.environ.get("PADDLE_TRACE_CAP", "8192"))
+
+
+def enabled() -> bool:
+    """Tracing on/off switch (env ``PADDLE_TRACE``, default on)."""
+    return os.environ.get("PADDLE_TRACE", "1") != "0"
+
+
+def _trace_metrics():
+    from .metrics import get_registry
+    reg = get_registry()
+    return (reg.counter("trace.spans_total",
+                        "request-trace spans recorded"),
+            reg.counter("trace.spans_dropped",
+                        "spans evicted from the bounded trace ring"),
+            reg.histogram("trace.span_seconds",
+                          "request-trace span wall time by span name",
+                          labelnames=("span",)))
+
+
+class TraceSpan:
+    """One timed scope of one request's trace (explicit begin/end)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name",
+                 "start_ns", "end_ns", "tags")
+
+    def __init__(self, trace_id: str, name: str,
+                 parent_id: Optional[str] = None,
+                 tags: Optional[Dict[str, object]] = None):
+        self.trace_id = trace_id
+        self.span_id = f"{next(_SPAN_IDS):08x}"
+        self.parent_id = parent_id
+        self.name = name
+        self.start_ns = time.perf_counter_ns()
+        self.end_ns: Optional[int] = None
+        self.tags: Dict[str, object] = dict(tags or {})
+
+    @property
+    def open(self) -> bool:
+        return self.end_ns is None
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        if self.end_ns is None:
+            return None
+        return (self.end_ns - self.start_ns) / 1e9
+
+    def end(self, **tags) -> "TraceSpan":
+        """Close + record the span (idempotent: abort paths may race the
+        normal close; the first end wins, later calls only merge tags)."""
+        if self.end_ns is not None:
+            self.tags.update(tags)
+            return self
+        self.end_ns = time.perf_counter_ns()
+        self.tags.update(tags)
+        get_recorder().record(self)
+        spans_c, _, span_h = _trace_metrics()
+        spans_c.inc()
+        span_h.labels(span=self.name).observe(self.duration_s)
+        return self
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "start_ns": self.start_ns, "end_ns": self.end_ns,
+                "duration_s": self.duration_s, "tags": dict(self.tags)}
+
+    def __repr__(self):
+        return (f"TraceSpan({self.name!r}, trace={self.trace_id}, "
+                f"dur={self.duration_s})")
+
+
+class TraceContext:
+    """One request's identity: trace_id + root span + baggage.
+
+    ``baggage`` is the propagated tag set: every span begun through this
+    context inherits it AT BEGIN TIME, so a tag added mid-flight (the
+    requeue path sets ``requeued=1``) marks all LATER spans without
+    rewriting history — exactly what "which spans ran after the
+    failover" needs.
+    """
+
+    __slots__ = ("trace_id", "root", "baggage")
+
+    def __init__(self, trace_id: str, root: Optional[TraceSpan] = None,
+                 baggage: Optional[Dict[str, object]] = None):
+        self.trace_id = trace_id
+        self.root = root
+        self.baggage: Dict[str, object] = dict(baggage or {})
+
+    @property
+    def span_id(self) -> Optional[str]:
+        return self.root.span_id if self.root is not None else None
+
+    def begin(self, name: str, parent: Optional[TraceSpan] = None,
+              **tags) -> TraceSpan:
+        """Open a child span (parent defaults to the root span).
+        Baggage merges under explicit tags."""
+        merged = dict(self.baggage)
+        merged.update(tags)
+        pid = (parent or self.root)
+        return TraceSpan(self.trace_id, name,
+                         parent_id=pid.span_id if pid else None,
+                         tags=merged)
+
+    def event(self, name: str, **tags) -> TraceSpan:
+        """Instantaneous marker span (begin + immediate end)."""
+        return self.begin(name, **tags).end()
+
+    def finish(self, **tags) -> None:
+        if self.root is not None:
+            self.root.end(**tags)
+
+    # -- cross-process propagation (W3C traceparent shape) -------------------
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id or '0' * 8}-01"
+
+    def baggage_header(self) -> str:
+        return ",".join(f"{k}={v}" for k, v in sorted(self.baggage.items()))
+
+    @classmethod
+    def from_traceparent(cls, header: str,
+                         baggage: Optional[str] = None) -> "TraceContext":
+        parts = header.strip().split("-")
+        if len(parts) != 4 or parts[0] != "00":
+            raise ValueError(f"bad traceparent {header!r}")
+        bag: Dict[str, object] = {}
+        for item in (baggage or "").split(","):
+            if "=" in item:
+                k, v = item.split("=", 1)
+                bag[k.strip()] = v.strip()
+        return cls(parts[1], root=None, baggage=bag)
+
+
+def new_trace(name: str = "request", **tags) -> TraceContext:
+    """Mint a fresh trace: new trace_id + an OPEN root span."""
+    trace_id = f"{next(_TRACE_IDS):016x}"
+    ctx = TraceContext(trace_id)
+    ctx.root = TraceSpan(trace_id, name, tags=tags)
+    return ctx
+
+
+def end_open_spans(spans: Dict[str, TraceSpan], **tags) -> None:
+    """Close every open span in a request's span map (abort paths:
+    replica death, preemption, deadline expiry) and clear the map."""
+    for sp in list(spans.values()):
+        sp.end(**tags)
+    spans.clear()
+
+
+class TraceRecorder:
+    """Bounded ring of FINISHED spans + the trace-level export surface.
+
+    Chrome trace export maps each trace_id onto its own ``tid`` row, so
+    a multi-request dump renders one swimlane per request with the
+    queue/admit/prefill/decode/stream decomposition nested inside it.
+    """
+
+    def __init__(self, capacity: int = _RECORDER_CAP):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=max(1, capacity))
+        self._dropped = 0
+
+    def record(self, span: TraceSpan) -> None:
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+                _trace_metrics()[1].inc()
+            self._spans.append(span)
+
+    def spans(self, trace_id: Optional[str] = None) -> List[TraceSpan]:
+        with self._lock:
+            out = list(self._spans)
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        return sorted(out, key=lambda s: s.start_ns)
+
+    def trace_ids(self) -> List[str]:
+        """Distinct trace ids in recording order."""
+        seen: "OrderedDict[str, None]" = OrderedDict()
+        for s in self.spans():
+            seen.setdefault(s.trace_id, None)
+        return list(seen)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    # -- export --------------------------------------------------------------
+    def to_chrome(self, trace_id: Optional[str] = None) -> dict:
+        """Chrome trace-event JSON (``chrome://tracing`` / Perfetto).
+
+        Complete (``ph: X``) events; ``ts``/``dur`` in microseconds
+        relative to the earliest span so the viewer opens at t=0."""
+        spans = self.spans(trace_id)
+        tid_of = {t: i for i, t in enumerate(
+            OrderedDict((s.trace_id, None) for s in spans))}
+        t0 = spans[0].start_ns if spans else 0
+        events = []
+        for s in spans:
+            events.append({
+                "name": s.name, "ph": "X", "cat": "request",
+                "ts": (s.start_ns - t0) / 1e3,
+                "dur": ((s.end_ns or s.start_ns) - s.start_ns) / 1e3,
+                "pid": 1, "tid": tid_of[s.trace_id],
+                "args": {"trace_id": s.trace_id, "span_id": s.span_id,
+                         "parent_id": s.parent_id, **s.tags},
+            })
+        meta = [{"name": "thread_name", "ph": "M", "pid": 1,
+                 "tid": tid, "args": {"name": f"trace {t}"}}
+                for t, tid in tid_of.items()]
+        return {"traceEvents": meta + events,
+                "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str,
+                      trace_id: Optional[str] = None) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(trace_id), f)
+        return path
+
+    def export_jsonl(self, path: str,
+                     trace_id: Optional[str] = None) -> str:
+        """One span dict per line (joinable with metric snapshots)."""
+        with open(path, "w") as f:
+            for s in self.spans(trace_id):
+                f.write(json.dumps(s.to_dict()) + "\n")
+        return path
+
+
+_RECORDER = TraceRecorder()
+
+
+def get_recorder() -> TraceRecorder:
+    """The process-wide trace recorder (exporters read this)."""
+    return _RECORDER

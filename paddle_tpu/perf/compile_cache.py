@@ -28,7 +28,8 @@ from contextlib import contextmanager
 from typing import Optional, Tuple
 
 __all__ = ["enable_persistent_cache", "maybe_enable_persistent_cache",
-           "note_hit", "note_miss", "observe_elapsed", "signature_of",
+           "note_hit", "note_miss", "observe_elapsed",
+           "observe_steady_step", "signature_of",
            "compile_metrics", "donation_safe", "timed_miss"]
 
 _ENV_VAR = "PADDLE_COMPILE_CACHE"
@@ -107,6 +108,22 @@ def observe_elapsed(elapsed_s: float) -> None:
     """Add compile-attributed seconds without counting a new miss (the
     first run of an already-counted signature pays the XLA compile)."""
     _counters()[2].observe(float(elapsed_s))
+
+
+def observe_steady_step(elapsed_s: float,
+                        tokens: Optional[int] = None) -> None:
+    """Record one WARM fused-step execution (cache-hit path): the
+    steady-state latency the roofline gap is measured against, kept
+    separate from ``compile.elapsed`` so compile cost never pollutes the
+    steady-state distribution."""
+    reg = _reg()
+    reg.histogram("train.fused_step_seconds",
+                  "warm (cache-hit) fused train-step wall time"
+                  ).observe(float(elapsed_s))
+    if tokens and elapsed_s > 0:
+        reg.gauge("train.fused_tokens_per_sec",
+                  "steady-state fused-step token throughput").set(
+                      tokens / elapsed_s)
 
 
 @contextmanager

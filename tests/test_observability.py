@@ -71,6 +71,39 @@ def test_histogram_buckets_and_quantiles():
     assert h.quantile(0.99) == 50.0
 
 
+def test_histogram_quantile_edge_cases():
+    reg = MetricsRegistry()
+    h = reg.histogram("obs_t4b_edge", "x", buckets=(0.1, 1.0))
+    # empty: no estimate, not a crash
+    assert h.quantile(0.5) is None
+    assert h.quantile(0.0) is None and h.quantile(1.0) is None
+    # singleton: every quantile is the one sample
+    h.observe(0.7)
+    assert h.quantile(0.0) == h.quantile(0.5) == h.quantile(1.0) == 0.7
+    # extremes are the EXACT tracked min/max, not reservoir artifacts
+    for v in (0.2, 3.0, 0.05, 1.5):
+        h.observe(v)
+    assert h.quantile(0.0) == 0.05
+    assert h.quantile(1.0) == 3.0
+    # out-of-range q raises instead of silently clamping
+    with pytest.raises(ValueError):
+        h.quantile(-0.01)
+    with pytest.raises(ValueError):
+        h.quantile(1.01)
+
+
+def test_histogram_quantile_extremes_survive_reservoir_eviction():
+    from paddle_tpu.observability.metrics import _RESERVOIR_CAP
+    reg = MetricsRegistry()
+    h = reg.histogram("obs_t4c_extremes", "x", buckets=(0.5,))
+    h.observe(-123.0)                     # global min, observed FIRST
+    for i in range(_RESERVOIR_CAP * 4):   # likely evicts the early sample
+        h.observe(float(i % 100))
+    h.observe(9999.0)                     # global max
+    assert h.quantile(0.0) == -123.0
+    assert h.quantile(1.0) == 9999.0
+
+
 def test_histogram_quantile_sane_past_reservoir_cap():
     from paddle_tpu.observability.metrics import _RESERVOIR_CAP
     reg = MetricsRegistry()

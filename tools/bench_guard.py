@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression gate over the committed ``BENCH_*.json``.
+
+The driver appends one ``BENCH_rNN.json`` per round (a wrapper
+``{n, cmd, rc, tail, parsed}`` whose ``parsed`` field holds the bench
+line bench.py printed). This tool reads the ordered history, separates
+real-TPU points from CPU-proxy points (``detail.tpu`` — the two run on
+different hardware and must never be compared against each other), and
+fails loudly when the NEWEST point of a series regresses below a
+tolerance band fit to its own recent history:
+
+    lower_bound = (1 - tolerance) * median(previous k points)
+
+Median over a trailing window (not the single previous point) so one
+noisy round neither hides a real regression nor trips a false one; a
+linear trend fit is reported for context but never gates (trend is a
+narrative, the band is the contract). Records with ``rc != 0`` or an
+unparsable line (e.g. a timed-out round) are skipped with a note — a
+wedged round is not a regression.
+
+CI wiring: ``python tools/bench_guard.py --check`` exits 0 (pass, or
+nothing to check) / 1 (regression), printing the verdict per series.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+DEFAULT_TOLERANCE = 0.10
+DEFAULT_WINDOW = 4
+
+
+def discover(dirpath: str) -> List[dict]:
+    """Ordered bench records: ``BENCH_r*.json`` sorted by round number.
+    Each returned dict is the PARSED bench line plus ``_round``/``_file``
+    bookkeeping; unusable rounds appear with ``_skip`` set (reason)."""
+    out: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(dirpath, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError) as e:
+            out.append({"_round": rnd, "_file": path,
+                        "_skip": f"unreadable: {e}"})
+            continue
+        # driver wrapper {n, cmd, rc, parsed} or a bare bench line (test
+        # fixtures / manual runs)
+        if "parsed" in raw or "rc" in raw:
+            rc = raw.get("rc", 0)
+            parsed = raw.get("parsed")
+            if rc != 0 or not isinstance(parsed, dict):
+                out.append({"_round": rnd, "_file": path,
+                            "_skip": f"rc={rc}, parsed="
+                                     f"{'ok' if parsed else parsed}"})
+                continue
+            rec = dict(parsed)
+        elif "value" in raw:
+            rec = dict(raw)
+        else:
+            out.append({"_round": rnd, "_file": path,
+                        "_skip": "no parsed bench line"})
+            continue
+        if not isinstance(rec.get("value"), (int, float)):
+            out.append({"_round": rnd, "_file": path,
+                        "_skip": "non-numeric value"})
+            continue
+        rec["_round"] = rnd
+        rec["_file"] = path
+        out.append(rec)
+    return out
+
+
+def split_series(records: List[dict]) -> dict:
+    """Group usable points by (metric, hardware): CPU-proxy and TPU
+    points form separate series."""
+    series: dict = {}
+    for r in records:
+        if "_skip" in r:
+            continue
+        hw = "tpu" if r.get("detail", {}).get("tpu") else "cpu"
+        key = (r.get("metric", "unknown"), hw)
+        series.setdefault(key, []).append(r)
+    return series
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _trend(points: List[float]) -> Optional[float]:
+    """Least-squares slope per round (info only)."""
+    n = len(points)
+    if n < 2:
+        return None
+    xbar = (n - 1) / 2.0
+    ybar = sum(points) / n
+    num = sum((i - xbar) * (y - ybar) for i, y in enumerate(points))
+    den = sum((i - xbar) ** 2 for i in range(n))
+    return num / den if den else None
+
+
+def check_series(points: List[dict], tolerance: float,
+                 window: int) -> dict:
+    """Gate the NEWEST point against median(previous ``window``)."""
+    values = [float(p["value"]) for p in points]
+    result = {
+        "n_points": len(values),
+        "values": values,
+        "rounds": [p["_round"] for p in points],
+        "latest": values[-1] if values else None,
+        "trend_per_round": _trend(values),
+        "status": "pass",
+    }
+    if len(values) < 2:
+        result["status"] = "insufficient_history"
+        return result
+    prior = values[:-1][-window:]
+    baseline = _median(prior)
+    bound = (1.0 - tolerance) * baseline
+    result.update(baseline=baseline, lower_bound=bound)
+    if values[-1] < bound:
+        result["status"] = "regression"
+        result["drop_frac"] = 1.0 - values[-1] / baseline
+    return result
+
+
+def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
+              window: int = DEFAULT_WINDOW) -> dict:
+    records = discover(dirpath)
+    report = {
+        "dir": dirpath,
+        "tolerance": tolerance,
+        "window": window,
+        "skipped": [{"round": r["_round"], "reason": r["_skip"]}
+                    for r in records if "_skip" in r],
+        "series": {},
+        "status": "pass",
+    }
+    series = split_series(records)
+    if not series:
+        report["status"] = "no_history"
+        return report
+    for (metric, hw), pts in sorted(series.items()):
+        res = check_series(pts, tolerance, window)
+        report["series"][f"{metric}/{hw}"] = res
+        if res["status"] == "regression":
+            report["status"] = "regression"
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench-trajectory regression gate")
+    ap.add_argument("--check", action="store_true",
+                    help="gate: exit 1 on regression (default prints "
+                         "the report without gating)")
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="allowed drop below the trailing median "
+                         "(default 0.10)")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="trailing points in the median baseline "
+                         "(default 4)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    args = ap.parse_args(argv)
+
+    report = run_check(args.dir, tolerance=args.tolerance,
+                       window=args.window)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for s in report["skipped"]:
+            print(f"  skip r{s['round']:02d}: {s['reason']}")
+        for key, res in report["series"].items():
+            line = (f"{key}: {res['n_points']} point(s), "
+                    f"latest={res['latest']}")
+            if "baseline" in res:
+                line += (f", baseline(median{args.window})="
+                         f"{res['baseline']:.2f}, "
+                         f"bound={res['lower_bound']:.2f}")
+            if res["trend_per_round"] is not None:
+                line += f", trend={res['trend_per_round']:+.2f}/round"
+            print(f"  {line} -> {res['status'].upper()}")
+        print(f"bench_guard: {report['status'].upper()} "
+              f"(tolerance {args.tolerance:.0%}, dir {report['dir']})")
+    if args.check and report["status"] == "regression":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

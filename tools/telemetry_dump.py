@@ -1,5 +1,7 @@
 #!/usr/bin/env python
-"""Dump the paddle_tpu telemetry registry (Prometheus text or JSONL).
+"""Dump the paddle_tpu telemetry registry (Prometheus text or JSONL),
+the request-trace recorder (``--format chrome``), or an SLO burn-rate
+summary (``--slo``).
 
 Two modes:
 
@@ -79,8 +81,11 @@ def _demo_workload():
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--format", choices=("prometheus", "jsonl"),
-                    default="prometheus")
+    ap.add_argument("--format", choices=("prometheus", "jsonl", "chrome"),
+                    default="prometheus",
+                    help="chrome = the request-trace recorder as Chrome "
+                         "trace-event JSON (open in chrome://tracing / "
+                         "Perfetto); live mode only")
     ap.add_argument("--snapshot", metavar="PATH", default=None,
                     help="render this JSONL snapshot instead of running "
                          "the demo workload")
@@ -95,15 +100,51 @@ def main(argv=None) -> int:
                          "whatever this process has recorded, i.e. "
                          "nothing unless you imported + ran paddle_tpu "
                          "code first)")
+    ap.add_argument("--trace-id", metavar="ID", default=None,
+                    help="with --format chrome: export only this trace")
+    ap.add_argument("--slo", action="store_true",
+                    help="append an SLO burn-rate summary (default "
+                         "gateway TTFT/TPOT objectives, polled over the "
+                         "live registry) as JSON after the dump")
     args = ap.parse_args(argv)
 
     from paddle_tpu.observability import export as _export
 
-    if args.snapshot:
-        series = _export.load_jsonl(args.snapshot)
-    else:
+    if args.format == "chrome":
+        if args.snapshot:
+            ap.error("--format chrome reads the live trace recorder; "
+                     "it cannot render a metrics --snapshot")
         if not args.no_workload:
             _demo_workload()
+        import json
+        from paddle_tpu.observability import get_recorder
+        doc = get_recorder().to_chrome(args.trace_id)
+        text = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text + "\n")
+        return 0
+
+    slo_monitor = None
+    if args.snapshot:
+        if args.slo:
+            ap.error("--slo evaluates the live registry; it cannot "
+                     "render a metrics --snapshot")
+        series = _export.load_jsonl(args.snapshot)
+    else:
+        if args.slo:
+            # first poll BEFORE the workload so the window delta covers
+            # the demo traffic
+            from paddle_tpu.observability import (SLOMonitor,
+                                                  default_gateway_slos)
+            slo_monitor = SLOMonitor(default_gateway_slos())
+            slo_monitor.poll()
+        if not args.no_workload:
+            _demo_workload()
+        if slo_monitor is not None:
+            slo_monitor.poll()
         series = _export.snapshot_series()
 
     if args.prefix:
@@ -123,6 +164,11 @@ def main(argv=None) -> int:
             import json
             for s in series:
                 sys.stdout.write(json.dumps(s) + "\n")
+    if slo_monitor is not None:
+        import json
+        sys.stdout.write("# slo summary\n")
+        sys.stdout.write(json.dumps(slo_monitor.summary(), indent=2)
+                         + "\n")
     return 0
 
 
