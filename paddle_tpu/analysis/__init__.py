@@ -1,21 +1,34 @@
-"""Static-analysis subsystem: jaxpr dataflow diagnostics + trace-safety lint.
+"""Static-analysis subsystem: jaxpr dataflow diagnostics + trace-safety
+lint + SPMD shard-safety + HBM-footprint budgeting.
 
-Two engines over two IRs (rule catalog in ``findings.RULES``):
+Four rule families over three IRs (rule catalog in ``findings.RULES``):
 
 * **DF rules** (``dataflow.py``) analyze traced jaxprs (``static.ir
   .IrProgram``): structural/type consistency, dead code, unused inputs,
   cross-rank collective ordering (the SPMD deadlock lint), NaN-prone
   numerics, and the inplace/donation alias audit of the op registry.
-  Registered as read-only *diagnostic passes* in the static.ir pass
-  registry (``passes.py``) — ``apply_pass(prog, "check_dead_code")``
-  returns the program with ``prog.findings`` populated.
 * **TS rules** (``ast_lint.py``) lint python source for jit-context
-  hazards: host syncs, data-dependent control flow, jit-in-loop, and
-  trace-time side effects. CLI: ``python tools/tpu_lint.py <paths>``
-  (runs under tier-1 via the ``lint`` pytest marker).
+  hazards: host syncs, data-dependent control flow, jit-in-loop,
+  trace-time side effects, and fresh-closure-capture recompiles.
+  CLI: ``python tools/tpu_lint.py <paths>``.
+* **SH rules** (``sharding.py``) propagate Shard/Replicate/Partial
+  placements over a jaxpr against a declared mesh and audit the 7B
+  plan's declared shardings: axis divisibility, implicit reshards,
+  collective volume vs the ROOFLINE.json interconnect budget, and
+  FSDP replication waste.
+* **MEM rules** (``memory.py``) estimate peak per-chip HBM — a liveness
+  walk with donation credits from the op registry's alias metadata per
+  jaxpr, recorded-bytes scaling per PLAN_7B variant, and KV-cache
+  pricing per gateway serving bucket. CLI: ``python tools/shard_check.py``.
+
+DF/SH/MEM analyses are registered as read-only *diagnostic passes* in the
+static.ir pass registry (``passes.py``) — ``apply_pass(prog,
+"check_dead_code")`` returns the program with ``prog.findings`` populated
+— and every pass run feeds ``analysis.findings{rule=...}`` counters into
+the observability metrics registry.
 
 Suppress accepted findings inline (``# tpu-lint: disable=TS101``) or via
-the checked-in baseline (``tools/tpu_lint_baseline.json``).
+the checked-in baselines (``tools/tpu_lint_baseline.json``).
 """
 from __future__ import annotations
 
@@ -27,8 +40,13 @@ from .ast_lint import lint_file, lint_paths, lint_source
 from .dataflow import (audit_inplace_aliases, check_collective_order,
                        check_dead_code, check_nan_prone, check_shapes,
                        check_unused_inputs, collective_schedule, run_all)
+from .sharding import (MeshSpec, ShardSpec, check_fsdp_replication,
+                       check_plan_sharding, check_sharding, divisible_dim,
+                       interconnect_budget, propagate_placements)
+from .memory import (check_hbm, check_plan_memory, peak_hbm_estimate,
+                     serving_bucket_report, variant_live_gib)
 from . import passes as _passes  # registers the diagnostic passes
-from .passes import DIAGNOSTIC_PASS_NAMES
+from .passes import DIAGNOSTIC_PASS_NAMES, record_findings
 
 __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "has_errors", "summarize",
@@ -36,7 +54,12 @@ __all__ = [
     "check_shapes", "check_dead_code", "check_unused_inputs",
     "check_collective_order", "check_nan_prone", "collective_schedule",
     "audit_inplace_aliases", "run_all", "analyze",
-    "DIAGNOSTIC_PASS_NAMES",
+    "MeshSpec", "ShardSpec", "divisible_dim", "propagate_placements",
+    "check_sharding", "check_fsdp_replication", "check_plan_sharding",
+    "interconnect_budget",
+    "peak_hbm_estimate", "check_hbm", "variant_live_gib",
+    "check_plan_memory", "serving_bucket_report",
+    "DIAGNOSTIC_PASS_NAMES", "record_findings",
 ]
 
 

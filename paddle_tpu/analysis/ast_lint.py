@@ -9,6 +9,9 @@ materializations are the breaks; outer-state mutation is the bake-in):
 * TS103  jax.jit / to_static constructed inside a loop
 * TS104  side effects during trace (print of traced values, outer-state
          mutation, Tensor._set_data)
+* TS105  fresh array/tensor literal built in an enclosing function and
+         captured by a nested @jit/to_static closure — each rebuild
+         hashes as a new constant and silently recompiles per call
 
 Heuristic taint model: function parameters are assumed traced unless they
 carry a python-literal default or an int/bool/str annotation (static config
@@ -48,6 +51,12 @@ _HOST_SYNC_BUILTINS = {"float", "int", "bool"}
 _HOST_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _STATIC_ANNOTATIONS = {"int", "bool", "str"}
 
+# array/tensor constructors whose result hashes as a fresh jit constant
+# every time it is rebuilt (TS105)
+_FRESH_ARRAY_FNS = {"array", "asarray", "ones", "zeros", "full", "arange",
+                    "eye", "linspace", "tril", "triu"}
+_FRESH_ARRAY_BASES = {"np", "numpy", "jnp"}
+
 
 def _dotted(node) -> str:
     """'a.b.c' for Name/Attribute chains, '' for anything else."""
@@ -81,6 +90,21 @@ def _is_jit_ctor(call: ast.Call) -> bool:
         return False
     return (name in _JIT_CTORS_EXACT
             or any(name.endswith(s) for s in _JIT_CTOR_SUFFIXES))
+
+
+def _is_fresh_array_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    if parts[-1] == "to_tensor":
+        return True
+    if len(parts) >= 2 and parts[-1] in _FRESH_ARRAY_FNS:
+        return (parts[0] in _FRESH_ARRAY_BASES
+                or ".".join(parts[:-1]).endswith("jax.numpy"))
+    return False
 
 
 def _initial_taint(fn: ast.FunctionDef) -> Set[str]:
@@ -250,6 +274,10 @@ class _ModuleLinter(ast.NodeVisitor):
         self.alt_lines: Dict[int, Tuple[int, ...]] = {}
         self._loop_depth = 0
 
+    def _line_text(self, node) -> str:
+        ln = getattr(node, "lineno", 0)
+        return self.src_lines[ln - 1] if 0 < ln <= len(self.src_lines) else ""
+
     def visit_FunctionDef(self, node):
         if any(_is_traced_decorator(d) for d in node.decorator_list):
             sub = _TracedBodyLinter(node, self.path, self.src_lines)
@@ -261,9 +289,80 @@ class _ModuleLinter(ast.NodeVisitor):
             self.findings.extend(sub.findings)
             # don't descend again: the body linter already walked it,
             # but TS103 loops inside still need a look
+        self._check_fresh_capture(node)
         self.generic_visit(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- TS105: fresh array built here, captured by a nested traced fn ----
+    def _check_fresh_capture(self, node):
+        # array-ctor assignments in node's OWN scope (nested scopes are
+        # checked when their def is visited)
+        assigns: Dict[str, ast.Assign] = {}
+        stack = list(node.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Assign) and _is_fresh_array_call(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        assigns[t.id] = n
+            stack.extend(ast.iter_child_nodes(n))
+        if not assigns:
+            return
+
+        local_defs = {d.name: d for d in ast.walk(node)
+                      if isinstance(d, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and d is not node}
+        traced = [d for d in local_defs.values()
+                  if any(_is_traced_decorator(dec)
+                         for dec in d.decorator_list)]
+        for call in ast.walk(node):
+            if (isinstance(call, ast.Call) and _is_jit_ctor(call)
+                    and call.args and isinstance(call.args[0], ast.Name)
+                    and call.args[0].id in local_defs):
+                d = local_defs[call.args[0].id]
+                if d not in traced:
+                    traced.append(d)
+
+        seen = set()
+        for g in traced:
+            a = g.args
+            bound = {p.arg for p in (list(a.posonlyargs) + list(a.args)
+                                     + list(a.kwonlyargs))}
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            for n in ast.walk(g):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    bound.add(n.id)
+                elif isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and n is not g:
+                    bound.add(n.name)
+            loads = {n.id for n in ast.walk(g)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+            for name in sorted((loads - bound) & set(assigns)):
+                an = assigns[name]
+                if (name, an.lineno) in seen:
+                    continue
+                seen.add((name, an.lineno))
+                f = Finding(
+                    rule="TS105",
+                    message=f"fresh array '{name}' "
+                            f"({_dotted(an.value.func)}) built in "
+                            f"'{node.name}' is captured by jit-traced "
+                            f"'{g.name}': every call rebuilds it and the "
+                            "new constant silently recompiles — hoist it "
+                            "to module scope or pass it as an argument",
+                    file=self.path, line=an.lineno, col=an.col_offset,
+                    source_line=self._line_text(an))
+                self.alt_lines[id(f)] = (node.lineno, g.lineno)
+                self.findings.append(f)
 
     def _visit_loop(self, node):
         self._loop_depth += 1

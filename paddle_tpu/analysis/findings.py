@@ -21,7 +21,10 @@ WARNING = "warning"
 #: leaving a tombstone comment, never by reusing the number.
 #: DF* rules run over traced jaxprs (analysis/dataflow.py, also exposed as
 #: read-only diagnostic passes in the static.ir pass registry); TS* rules
-#: run over python source (analysis/ast_lint.py + tools/tpu_lint.py).
+#: run over python source (analysis/ast_lint.py + tools/tpu_lint.py);
+#: SH* rules check SPMD shard-safety (analysis/sharding.py) and MEM* rules
+#: check per-chip HBM budgets (analysis/memory.py) — both also run over
+#: PLAN_7B.json variants via tools/shard_check.py.
 RULES: Dict[str, dict] = {
     "DF001": dict(severity=ERROR, name="shape-dtype-consistency",
                   doc="jaxpr is structurally broken: a variable is used "
@@ -61,6 +64,40 @@ RULES: Dict[str, dict] = {
                   doc="side effect inside a traced function (print of a "
                       "traced value, mutation of outer python state) runs "
                       "at trace time only — replay will not repeat it."),
+    "TS105": dict(severity=WARNING, name="fresh-capture-recompile",
+                  doc="a fresh array/tensor literal built in an enclosing "
+                      "function is captured by a nested @jit/to_static "
+                      "closure; every rebuild hashes as a new constant and "
+                      "silently recompiles — hoist it to module scope or "
+                      "pass it as an argument."),
+    "SH201": dict(severity=ERROR, name="shard-axis-divisibility",
+                  doc="a dim declared Shard(axis) is not divisible by the "
+                      "mesh axis degree; the placement policy would fall "
+                      "back to replication, so the plan's per-chip math "
+                      "is wrong."),
+    "SH202": dict(severity=WARNING, name="sharding-mismatch",
+                  doc="operands of one equation disagree on placement "
+                      "(e.g. a contraction dim sharded on one side only); "
+                      "XLA inserts an implicit all-gather/reshard on the "
+                      "hot path."),
+    "SH203": dict(severity=WARNING, name="collective-over-interconnect",
+                  doc="estimated per-step collective bytes exceed the "
+                      "interconnect budget derived from ROOFLINE.json — "
+                      "the step is ICI-bound, not compute-bound."),
+    "SH204": dict(severity=WARNING, name="replicated-param-under-fsdp",
+                  doc="a parameter stays fully replicated over the FSDP "
+                      "axis although a divisible dim exists: (N-1)/N of "
+                      "its per-chip bytes are redundant."),
+    "MEM301": dict(severity=ERROR, name="plan-over-hbm-budget",
+                  doc="estimated per-chip peak HBM exceeds the declared "
+                      "hbm_per_chip_gib for a variant not already "
+                      "recorded infeasible — the plan would OOM on the "
+                      "first step."),
+    "MEM302": dict(severity=WARNING, name="missing-donation-or-remat",
+                  doc="headroom exists but is not taken: a large input "
+                      "dies at an alias-eligible op without being "
+                      "donated, or a sibling remat/sharding variant at "
+                      "the same batch fits the budget."),
 }
 
 

@@ -1,16 +1,26 @@
-"""Diagnostic passes: DF* analyses registered in the static.ir pass
-registry (the reference registers diagnostic graph passes alongside the
-transform passes; here ``list_passes()`` surfaces both kinds and
+"""Diagnostic passes: DF*/SH*/MEM* analyses registered in the static.ir
+pass registry (the reference registers diagnostic graph passes alongside
+the transform passes; here ``list_passes()`` surfaces both kinds and
 ``apply_pass`` attaches findings instead of rewriting the jaxpr).
 
     prog = ir.IrProgram.trace(fn, x)
     prog = ir.apply_pass(prog, ["check_dead_code", "check_nan_prone"])
     for f in prog.findings: print(f)
+
+Every registered analysis pass also feeds the observability metrics
+registry: each finding increments ``analysis.findings{rule=...}`` so
+``telemetry_dump`` shows what static analysis flagged, not just what the
+caller chose to print.
 """
 from __future__ import annotations
 
+import functools
+import os
+
 from ..static.ir import register_pass
 from . import dataflow
+from . import memory as memory_mod
+from . import sharding as sharding_mod
 
 DIAGNOSTIC_PASS_NAMES = [
     "check_shape_consistency",   # DF001
@@ -18,12 +28,69 @@ DIAGNOSTIC_PASS_NAMES = [
     "check_unused_inputs",       # DF003
     "check_collective_order",    # DF004 (single-program: cond branches)
     "check_nan_prone",           # DF005
+    "check_shard_safety",        # SH201/SH202 (needs a default mesh)
+    "check_hbm_footprint",       # MEM301/MEM302
 ]
 
-register_pass("check_shape_consistency", analysis=True)(dataflow.check_shapes)
-register_pass("check_dead_code", analysis=True)(dataflow.check_dead_code)
-register_pass("check_unused_inputs", analysis=True)(
-    dataflow.check_unused_inputs)
-register_pass("check_collective_order", analysis=True)(
-    dataflow.check_collective_order)
-register_pass("check_nan_prone", analysis=True)(dataflow.check_nan_prone)
+
+def record_findings(findings, source: str = "") -> None:
+    """Count findings into the observability registry (satellite of the
+    DF/SH/MEM gates: telemetry shows rule hit-rates across a run)."""
+    if not findings:
+        return
+    try:
+        from ..observability import get_registry
+    except Exception:  # partial-import contexts (standalone tooling)
+        return
+    fam = get_registry().counter(
+        "analysis.findings",
+        "findings emitted by static-analysis passes, by rule",
+        labelnames=("rule",))
+    for f in findings:
+        fam.labels(rule=f.rule).inc()
+
+
+def _diagnostic(name):
+    """Register ``fn(closed) -> findings`` as a read-only pass that also
+    reports its findings to the metrics registry."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def run(program):
+            findings = fn(program)
+            record_findings(findings, source=name)
+            return findings
+        register_pass(name, analysis=True)(run)
+        return fn
+    return deco
+
+
+_diagnostic("check_shape_consistency")(dataflow.check_shapes)
+_diagnostic("check_dead_code")(dataflow.check_dead_code)
+_diagnostic("check_unused_inputs")(dataflow.check_unused_inputs)
+_diagnostic("check_collective_order")(dataflow.check_collective_order)
+_diagnostic("check_nan_prone")(dataflow.check_nan_prone)
+
+
+@_diagnostic("check_shard_safety")
+def check_shard_safety(program):
+    """SH201/SH202 over the default mesh (no mesh declared -> nothing to
+    check); inputs are assumed replicated unless the program carries
+    explicit specs — the conservative read of an un-annotated trace."""
+    try:
+        from ..distributed.auto_parallel import get_default_mesh
+        mesh = get_default_mesh()
+    except Exception:
+        mesh = None
+    if mesh is None:
+        return []
+    return sharding_mod.check_sharding(program, mesh)
+
+
+@_diagnostic("check_hbm_footprint")
+def check_hbm_footprint(program):
+    """MEM301/MEM302 per jaxpr. Budget comes from ``PADDLE_HBM_GIB`` when
+    set (a plain CPU trace has no chip to read it from); missed-donation
+    detection needs no budget."""
+    budget = os.environ.get("PADDLE_HBM_GIB")
+    return memory_mod.check_hbm(
+        program, budget_gib=float(budget) if budget else None)

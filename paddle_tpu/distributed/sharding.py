@@ -51,11 +51,15 @@ def _sharding_mesh_axis(group: Optional[Group]):
 
 
 def _divisible_dim(shape, degree):
-    """First dim the axis degree divides (dim0 preferred), else None."""
-    for d, size in enumerate(shape):
-        if size % degree == 0 and size >= degree:
-            return d
-    return None
+    """First dim the axis degree divides (dim0 preferred), else None.
+
+    Delegates to ``analysis.sharding.divisible_dim`` — the static SH201/
+    SH204 checks and the runtime placement policy must agree on which dim
+    a parameter shards over (lazy import: analysis loads after this
+    package in ``paddle_tpu/__init__``).
+    """
+    from ..analysis.sharding import divisible_dim
+    return divisible_dim(shape, degree)
 
 
 def _placements(mesh, axis, shard_dim):

@@ -16,7 +16,9 @@ gateway (``gateway.*``: routing affinity hits, per-tenant sheds,
 requeues off dead replicas, end-to-end TTFT/TPOT — dump with
 ``tools/telemetry_dump.py --prefix gateway.``), collectives
 (bytes/count/latency per op), the hapi training loop (step time,
-tokens/sec, MFU), and the Pallas flash-attention autotune cache.
+tokens/sec, MFU), the Pallas flash-attention autotune cache, and the
+static-analysis passes (``analysis.findings{rule=...}`` — every DF/SH/MEM
+diagnostic pass counts its findings by rule here).
 """
 from __future__ import annotations
 

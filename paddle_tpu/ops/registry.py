@@ -223,6 +223,21 @@ def get_alias(op_name: str) -> Optional[dict]:
     return entry.get("alias") if entry else None
 
 
+def donatable_aliases() -> Dict[str, dict]:
+    """Ops whose alias metadata permits true buffer donation (output can
+    reuse the input buffer byte-for-byte: shape AND dtype preserved).
+
+    Consumed by ``analysis.memory`` — the liveness-based peak-HBM
+    estimator credits an output against a dying same-layout input exactly
+    when the producing op appears here (MEM302 flags the donation the
+    caller forgot to request).
+    """
+    return {name: entry["alias"] for name, entry in OP_REGISTRY.items()
+            if entry.get("alias")
+            and entry["alias"].get("preserves_shape")
+            and entry["alias"].get("preserves_dtype")}
+
+
 def _wrap_outputs(out, stop_gradient):
     leaves, treedef = jax.tree_util.tree_flatten(out)
     wrapped = [_wrap_out_leaf(l, stop_gradient) for l in leaves]

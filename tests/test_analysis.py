@@ -1,12 +1,15 @@
 """Static-analysis subsystem (paddle_tpu.analysis).
 
-Both engines, one flagging and one passing fixture per rule:
-  DF001..DF006 — jaxpr dataflow analyses / registry alias audit
-  TS101..TS104 — AST trace-safety lint
+All four engines, one flagging and one passing fixture per rule:
+  DF001..DF006  — jaxpr dataflow analyses / registry alias audit
+  TS101..TS105  — AST trace-safety lint
+  SH201..SH204  — SPMD shard-safety (jaxpr propagation + PLAN_7B audit)
+  MEM301/MEM302 — liveness peak-HBM budgeting (jaxpr + plan + serving)
 plus the pass-registry integration (diagnostic passes via apply_pass),
-the suppression/baseline machinery, and the tier-1 lint gate
-(``pytest -m lint``) that runs tools/tpu_lint.py over the shipped tree
-with a <10s runtime guard.
+the observability findings counters, the suppression/baseline machinery,
+and the tier-1 lint gate (``pytest -m lint``) that runs tools/tpu_lint.py
+over the shipped tree (paddle_tpu/, examples/, tools/, benchmarks/) AND
+the tools/shard_check.py PLAN_7B gate with a combined <10s runtime guard.
 """
 import json
 import os
@@ -126,6 +129,31 @@ def test_df004_passes_identical_rank_schedules():
     mk = lambda: _rank_jaxpr(
         lambda v: lax.psum(v, "i") + lax.pmax(v, "i"), 1.0)
     assert analysis.check_collective_order([mk(), mk()]) == []
+
+
+def test_df004_flags_four_rank_missing_mid_sequence_collective():
+    # three ranks run psum; pmax; psum — rank2 skips the mid pmax and
+    # goes straight to its second psum: divergence at collective #1
+    full = lambda: _rank_jaxpr(
+        lambda v: lax.psum(lax.pmax(lax.psum(v, "i"), "i"), "i"), 1.0)
+    missing = _rank_jaxpr(
+        lambda v: lax.psum(lax.psum(v, "i"), "i"), 1.0)
+    names = ["r0", "r1", "r2", "r3"]
+    fs = analysis.check_collective_order(
+        [full(), full(), missing, full()], rank_names=names)
+    assert "DF004" in _rules(fs)
+    hits = [f for f in fs if f.rule == "DF004"]
+    assert len(hits) == 1                      # only the deviant rank
+    assert hits[0].extra["ranks"] == ["r0", "r2"]
+    assert hits[0].extra["index"] == 1         # mid-sequence, not #0
+    assert "pmax" in hits[0].message
+
+
+def test_df004_passes_identical_four_rank_schedules():
+    mk = lambda: _rank_jaxpr(
+        lambda v: lax.psum(lax.pmax(lax.psum(v, "i"), "i"), "i"), 1.0)
+    assert analysis.check_collective_order(
+        [mk() for _ in range(4)], rank_names=list("abcd")) == []
 
 
 def test_df004_flags_divergent_cond_branches():
@@ -427,10 +455,305 @@ def test_baseline_roundtrip(tmp_path):
 def test_rule_catalog_is_stable():
     assert set(findings_mod.RULES) >= {
         "DF001", "DF002", "DF003", "DF004", "DF005", "DF006",
-        "TS101", "TS102", "TS103", "TS104"}
+        "TS101", "TS102", "TS103", "TS104", "TS105",
+        "SH201", "SH202", "SH203", "SH204", "MEM301", "MEM302"}
     for rule, meta in findings_mod.RULES.items():
         assert meta["severity"] in ("error", "warning")
         assert meta["doc"]
+    assert findings_mod.RULES["SH201"]["severity"] == "error"
+    assert findings_mod.RULES["MEM301"]["severity"] == "error"
+
+
+# ---------------------------------------------------------------------------
+# TS105 — fresh closure capture (silent recompile-per-call)
+# ---------------------------------------------------------------------------
+
+TS105_BAD = """
+import numpy as np
+import jax
+
+def make_step(scale):
+    table = np.array([1.0, 2.0, 3.0])
+    @jax.jit
+    def step(x):
+        return x * table * scale
+    return step
+"""
+
+TS105_CTOR_BAD = """
+import numpy as np
+import jax
+
+def make_step():
+    mask = np.tril(np.ones((4, 4)))
+    def step(x):
+        return x * mask
+    return jax.jit(step)
+"""
+
+TS105_GOOD_MODULE_SCOPE = """
+import numpy as np
+import jax
+
+TABLE = np.array([1.0, 2.0, 3.0])
+
+def make_step(scale):
+    @jax.jit
+    def step(x):
+        return x * TABLE * scale
+    return step
+"""
+
+TS105_GOOD_ARGUMENT = """
+import numpy as np
+import jax
+
+def make_step():
+    table = np.array([1.0, 2.0, 3.0])
+    @jax.jit
+    def step(x, table):
+        return x * table
+    return step
+"""
+
+
+def test_ts105_flags_fresh_capture_in_decorated_closure():
+    fs = [f for f in ast_lint.lint_source(TS105_BAD) if f.rule == "TS105"]
+    assert len(fs) == 1
+    assert "table" in fs[0].message and "recompile" in fs[0].message
+
+
+def test_ts105_flags_fresh_capture_via_jit_ctor():
+    assert "TS105" in _rules(ast_lint.lint_source(TS105_CTOR_BAD))
+
+
+def test_ts105_passes_module_scope_and_argument():
+    assert ast_lint.lint_source(TS105_GOOD_MODULE_SCOPE) == []
+    assert ast_lint.lint_source(TS105_GOOD_ARGUMENT) == []
+
+
+def test_ts105_suppressed_on_enclosing_def_line():
+    src = TS105_BAD.replace("def make_step(scale):",
+                            "def make_step(scale):  # tpu-lint: disable=TS105")
+    assert "TS105" not in _rules(ast_lint.lint_source(src))
+
+
+# ---------------------------------------------------------------------------
+# SH201..SH204 — SPMD shard-safety (jaxpr propagation)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.analysis import memory as memory_mod  # noqa: E402
+from paddle_tpu.analysis import sharding as sharding_mod  # noqa: E402
+
+
+def _load_plan():
+    with open(os.path.join(REPO, "PLAN_7B.json")) as fh:
+        return json.load(fh)
+
+
+def _load_roofline():
+    with open(os.path.join(REPO, "ROOFLINE.json")) as fh:
+        return json.load(fh)
+
+
+def test_sh201_flags_non_divisible_input_and_passes_divisible():
+    closed = jax.make_jaxpr(lambda x: x * 2.0)(jnp.ones((3, 4)))
+    fs = analysis.check_sharding(closed, {"x": 2}, in_specs=[("x", None)])
+    assert "SH201" in _rules(fs)
+    assert all(f.severity == "error" for f in fs if f.rule == "SH201")
+    closed2 = jax.make_jaxpr(lambda x: x * 2.0)(jnp.ones((4, 4)))
+    assert analysis.check_sharding(
+        closed2, {"x": 2}, in_specs=[("x", None)]) == []
+
+
+def test_sh202_flags_one_sided_contraction_and_passes_matched():
+    fn = lambda x, w: x @ w
+    closed = jax.make_jaxpr(fn)(jnp.ones((8, 16)), jnp.ones((16, 4)))
+    fs = analysis.check_sharding(
+        closed, {"x": 4}, in_specs=[(None, "x"), (None, None)])
+    assert "SH202" in _rules(fs)
+    assert any("all-gather" in f.message for f in fs)
+    # both operands sharded on the contraction dim: Partial out, no gather
+    assert analysis.check_sharding(
+        closed, {"x": 4}, in_specs=[(None, "x"), ("x", None)]) == []
+
+
+def test_sh202_flags_elementwise_placement_disagreement():
+    fn = lambda a, b: a + b
+    closed = jax.make_jaxpr(fn)(jnp.ones((8, 8)), jnp.ones((8, 8)))
+    fs = analysis.check_sharding(
+        closed, {"x": 2, "y": 2}, in_specs=[("x", None), ("y", None)])
+    assert "SH202" in _rules(fs)
+    assert analysis.check_sharding(
+        closed, {"x": 2}, in_specs=[("x", None), ("x", None)]) == []
+
+
+def test_sh202_propagation_resolves_partial_through_psum():
+    def fn(x, w):
+        return lax.psum(x @ w, "i")
+    closed = jax.make_jaxpr(fn, axis_env=[("i", 4)])(
+        jnp.ones((8, 16)), jnp.ones((16, 4)))
+    res = analysis.propagate_placements(
+        closed, {"i": 4}, in_specs=[(None, "i"), ("i", None)])
+    out_var = closed.jaxpr.outvars[0]
+    assert res.var_specs[out_var].partial == frozenset()
+    assert res.collective_bytes > 0
+
+
+def test_sh203_flags_over_budget_and_passes_generous():
+    closed = jax.make_jaxpr(
+        lambda v: lax.psum(v, "i"), axis_env=[("i", 2)])(
+        jnp.ones((1024, 1024)))
+    fs = analysis.check_sharding(
+        closed, {"i": 2}, collective_budget_bytes=10.0)
+    assert "SH203" in _rules(fs)
+    assert analysis.check_sharding(
+        closed, {"i": 2}, collective_budget_bytes=1e12) == []
+
+
+def test_sh203_plan_level_roofline_budget():
+    plan, roof = _load_plan(), _load_roofline()
+    # the shipped plan is compute-bound under the real roofline
+    assert [f for f in analysis.check_plan_sharding(plan, roofline=roof)
+            if f.rule == "SH203"] == []
+    # a starved interconnect makes every variant ICI-bound
+    starved = dict(roof, peak_ici=1e9)
+    fs = analysis.check_plan_sharding(plan, roofline=starved)
+    assert {f.extra["variant"] for f in fs if f.rule == "SH203"} \
+        == {"s2", "s3", "s3_full"}
+
+
+def test_sh204_flags_replicated_param_and_passes_sharded():
+    params = {"w": ((4096, 4096), None),      # big, divisible, replicated
+              "ln": ((4096,), None)}          # small: below min_bytes
+    fs = analysis.check_fsdp_replication(params, {"z": 16}, "z")
+    assert [f.rule for f in fs] == ["SH204"]
+    assert fs[0].extra["param"] == "w"
+    sharded = {"w": ((4096, 4096), ("z", None))}
+    assert analysis.check_fsdp_replication(sharded, {"z": 16}, "z") == []
+
+
+def test_divisible_dim_is_single_sourced():
+    from paddle_tpu.distributed.sharding import _divisible_dim
+    for shape, deg in [((7, 8), 4), ((16, 3), 4), ((5, 7), 2), ((8,), 8)]:
+        assert _divisible_dim(shape, deg) \
+            == analysis.divisible_dim(shape, deg)
+
+
+# ---------------------------------------------------------------------------
+# MEM301/MEM302 — liveness peak-HBM (jaxpr level)
+# ---------------------------------------------------------------------------
+
+def test_mem301_flags_tiny_budget_and_passes_generous():
+    closed = jax.make_jaxpr(lambda x: jnp.tanh(x) @ x.T)(
+        jnp.ones((256, 256)))
+    fs = memory_mod.check_hbm(closed, budget_gib=1e-6)
+    assert "MEM301" in _rules(fs)
+    assert all(f.severity == "error" for f in fs if f.rule == "MEM301")
+    fs = memory_mod.check_hbm(closed, budget_gib=64.0, donate=(0,))
+    assert "MEM301" not in _rules(fs)
+
+
+def test_mem302_flags_missing_donation_and_passes_donated():
+    # x (4 MiB) dies at exp, whose registry alias metadata permits reuse
+    closed = jax.make_jaxpr(lambda x: jnp.exp(x))(jnp.ones((1024, 1024)))
+    fs = memory_mod.check_hbm(closed)
+    assert [f.rule for f in fs] == ["MEM302"]
+    assert "donate" in fs[0].message
+    assert memory_mod.check_hbm(closed, donate=(0,)) == []
+
+
+def test_peak_hbm_estimate_credits_donated_reuse():
+    closed = jax.make_jaxpr(lambda x: jnp.exp(x))(
+        jnp.ones((1024, 1024), jnp.float32))
+    plain = memory_mod.peak_hbm_estimate(closed)
+    donated = memory_mod.peak_hbm_estimate(closed, donate=(0,))
+    mib = 1 << 20
+    assert plain["peak_bytes"] == 8 * mib      # input + fresh output
+    assert donated["peak_bytes"] == 4 * mib    # output reuses the input
+    assert plain["missed_donations"] and not donated["missed_donations"]
+
+
+# ---------------------------------------------------------------------------
+# MEM301/MEM302 + SH201 — plan-level gate (PLAN_7B.json)
+# ---------------------------------------------------------------------------
+
+def test_plan_memory_shipped_variants_pass():
+    plan = _load_plan()
+    rows = []
+    fs = memory_mod.check_plan_memory(plan, rows=rows)
+    # documented-infeasible baselines (fits_v5e_16gib: false) are not
+    # errors; the MEM302 headroom pointer to s3_full is expected
+    assert not findings_mod.has_errors(fs)
+    assert {f.rule for f in fs} <= {"MEM302"}
+    by_name = {r["variant"]: r for r in rows}
+    assert by_name["s3_full"]["fits"]
+    # the recorded-bytes model reproduces the recorded live GiB
+    assert abs(by_name["s2"]["live_gib"] - 47.384) < 0.01
+    assert abs(by_name["s3_full"]["live_gib"] - 12.141) < 0.01
+
+
+def test_mem301_flags_oversubscribed_s2_at_batch_64():
+    plan = _load_plan()
+    fs = memory_mod.check_plan_memory(plan, batch=64)
+    flagged = {f.extra["variant"] for f in fs if f.rule == "MEM301"}
+    assert "s2" in flagged
+    assert findings_mod.has_errors(fs)
+    s2 = [f for f in fs if f.rule == "MEM301"
+          and f.extra["variant"] == "s2"][0]
+    assert s2.extra["live_gib"] > 100          # 4x activations over 47 GiB
+
+
+def test_mem302_plan_points_at_fitting_sibling():
+    plan = _load_plan()
+    fs = memory_mod.check_plan_memory(plan)
+    sibs = {f.extra["variant"]: f.extra["sibling"] for f in fs
+            if f.rule == "MEM302"}
+    assert sibs == {"s2": "s3_full", "s3": "s3_full"}
+
+
+def test_plan_sharding_shipped_mesh_passes_and_mesh7_flags_sh201():
+    plan, roof = _load_plan(), _load_roofline()
+    assert analysis.check_plan_sharding(plan, roofline=roof) == []
+    fs = analysis.check_plan_sharding(plan, mesh_size=7)
+    assert "SH201" in _rules(fs)
+    flagged = {f.extra["param"] for f in fs if f.rule == "SH201"}
+    assert "embed" in flagged and "wq" in flagged
+
+
+def test_serving_buckets_shipped_pass_and_flag_paths():
+    plan = _load_plan()
+    rep = memory_mod.serving_bucket_report(plan)
+    assert rep["findings"] == []
+    assert all(r["fits"] for r in rep["rows"])
+    assert max(r["bucket"] for r in rep["rows"]) == 2048
+    # tiny budget: KV cache blows through it -> MEM301
+    rep = memory_mod.serving_bucket_report(plan, hbm_gib=0.5)
+    assert "MEM301" in {f.rule for f in rep["findings"]}
+    # 7 chips cannot split 32 attention heads -> SH201
+    rep = memory_mod.serving_bucket_report(plan, mesh_size=7)
+    assert "SH201" in {f.rule for f in rep["findings"]}
+
+
+# ---------------------------------------------------------------------------
+# observability: analysis.findings{rule=...} counters
+# ---------------------------------------------------------------------------
+
+def test_analysis_passes_feed_metrics_registry():
+    from paddle_tpu.observability import get_registry
+    def fn(x):
+        dead = paddle.exp(x) * 3.0
+        return paddle.tanh(x)
+    prog = ir.IrProgram.trace(fn, _tensor((3, 4)))
+    fam = get_registry().counter(
+        "analysis.findings",
+        "findings emitted by static-analysis passes, by rule",
+        labelnames=("rule",))
+    expected = len(analysis.check_dead_code(prog))
+    assert expected >= 1
+    before = fam.labels(rule="DF002").value
+    ir.apply_pass(prog, "check_dead_code")
+    assert fam.labels(rule="DF002").value == before + expected
 
 
 # ---------------------------------------------------------------------------
@@ -443,15 +766,57 @@ def _run_cli(*args, cwd=REPO):
          *args], cwd=cwd, capture_output=True, text=True)
 
 
+def _run_shard_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "shard_check.py"),
+         *args], cwd=cwd, capture_output=True, text=True)
+
+
 @pytest.mark.lint
 @pytest.mark.quick
 def test_lint_gate_shipped_tree_is_clean_and_fast():
     t0 = time.monotonic()
-    proc = _run_cli("paddle_tpu", "examples")
+    proc = _run_cli("paddle_tpu", "examples", "tools", "benchmarks")
     elapsed = time.monotonic() - t0
     assert proc.returncode == 0, proc.stdout + proc.stderr
     # runtime guard: the gate must never threaten the tier-1 timeout
     assert elapsed < 10.0, f"lint gate took {elapsed:.1f}s"
+
+
+@pytest.mark.lint
+@pytest.mark.quick
+def test_shard_check_gate_shipped_plan_is_clean_and_fast():
+    t0 = time.monotonic()
+    proc = _run_shard_cli()
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "s3_full" in proc.stdout
+    assert elapsed < 10.0, f"shard_check gate took {elapsed:.1f}s"
+
+
+def test_shard_check_cli_flags_oversubscribed_batch():
+    proc = _run_shard_cli("--batch", "64", "--json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    flagged = {f["extra"]["variant"] for f in payload["findings"]
+               if f["rule"] == "MEM301"}
+    assert "s2" in flagged
+
+
+def test_shard_check_cli_flags_non_divisible_mesh():
+    proc = _run_shard_cli("--mesh", "7", "--json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert any(f["rule"] == "SH201" for f in payload["findings"])
+
+
+def test_shard_check_cli_what_if_budget_passes():
+    # a 64 GiB chip swallows every shipped variant -> exit 0, no MEM302
+    proc = _run_shard_cli("--hbm-gib", "64", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert all(r["fits"] for r in payload["variants"])
 
 
 def test_cli_flags_errors_nonzero_and_emits_json(tmp_path):

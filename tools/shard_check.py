@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Static SPMD shard-safety + HBM-footprint gate (SH/MEM rules).
+
+    python tools/shard_check.py                        # gate PLAN_7B.json
+    python tools/shard_check.py --json                 # machine output
+    python tools/shard_check.py --mesh 7               # what-if mesh
+    python tools/shard_check.py --batch 64             # what-if batch
+    python tools/shard_check.py --hbm-gib 32 --strict
+
+Evaluates every training variant of PLAN_7B.json (SH201 axis
+divisibility, SH203 collectives vs the ROOFLINE.json interconnect
+budget, SH204 FSDP replication waste, MEM301 per-chip HBM budget,
+MEM302 remat/donation headroom) plus the gateway serving buckets
+(TP weights + per-rung KV cache). Variants the plan already records as
+infeasible (``fits_v5e_16gib: false``) are documented baselines, not
+errors — overriding --batch/--seq/--hbm-gib re-opens the check.
+
+Exit status: 0 when no ERROR-severity findings survive the baseline;
+1 otherwise (--strict fails on warnings too). Same Finding/baseline
+machinery as tpu_lint; deliberately does NOT import the paddle_tpu
+package (and therefore not jax) — the rule engine (analysis/sharding.py,
+analysis/memory.py, analysis/findings.py) is stdlib-only and loaded
+straight off the source tree, so the tier-1 gate runs in well under a
+second.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_ANALYSIS_DIR = os.path.join(_REPO, "paddle_tpu", "analysis")
+sys.path.insert(0, _ANALYSIS_DIR)
+
+import findings as findings_mod  # noqa: E402  (stdlib-only, loaded directly)
+import memory as memory_mod      # noqa: E402
+import sharding as sharding_mod  # noqa: E402
+
+DEFAULT_PLAN = os.path.join(_REPO, "PLAN_7B.json")
+DEFAULT_ROOFLINE = os.path.join(_REPO, "ROOFLINE.json")
+DEFAULT_BASELINE = os.path.join(_HERE, "shard_check_baseline.json")
+
+
+def _load_json(path):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shard_check",
+        description="paddle_tpu SPMD shard-safety + HBM budget gate "
+                    "(SH/MEM rules)")
+    ap.add_argument("--plan", default=DEFAULT_PLAN,
+                    help="PLAN_7B.json to gate (default: repo root)")
+    ap.add_argument("--roofline", default=DEFAULT_ROOFLINE,
+                    help="ROOFLINE.json for the SH203 interconnect budget "
+                         "(pass 'none' to skip SH203)")
+    ap.add_argument("--mesh", type=int, default=None,
+                    help="override the mesh size (chips)")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="override the per-variant global batch "
+                         "(re-opens documented-infeasible variants)")
+    ap.add_argument("--seq", type=int, default=None,
+                    help="override the sequence length")
+    ap.add_argument("--hbm-gib", type=float, default=None,
+                    help="override hbm_per_chip_gib")
+    ap.add_argument("--max-serving-batch", type=int, default=8,
+                    help="concurrent sequences priced per serving bucket")
+    ap.add_argument("--no-serving", action="store_true",
+                    help="skip the gateway serving-bucket audit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings + tables as JSON")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule IDs to restrict to")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of accepted findings "
+                         "(default: tools/shard_check_baseline.json; "
+                         "pass 'none' to disable)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too, and error even "
+                         "on documented-infeasible variants")
+    args = ap.parse_args(argv)
+
+    plan = _load_json(args.plan)
+    plan_name = os.path.basename(args.plan)
+    roofline = None
+    if args.roofline and args.roofline.lower() != "none" \
+            and os.path.exists(args.roofline):
+        roofline = _load_json(args.roofline)
+
+    mesh_n = args.mesh or sharding_mod.plan_mesh_size(plan)
+    results = []
+    rows: list = []
+
+    results.extend(sharding_mod.check_plan_sharding(
+        plan, mesh_size=mesh_n, roofline=roofline, file=plan_name))
+    results.extend(memory_mod.check_plan_memory(
+        plan, hbm_gib=args.hbm_gib, batch=args.batch, seq=args.seq,
+        strict=args.strict, rows=rows, file=plan_name))
+
+    serving = {"rows": [], "findings": []}
+    if not args.no_serving:
+        serving = memory_mod.serving_bucket_report(
+            plan, mesh_size=mesh_n, hbm_gib=args.hbm_gib,
+            max_batch=args.max_serving_batch, file=plan_name)
+        results.extend(serving["findings"])
+
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        results = [f for f in results if f.rule in wanted]
+
+    if args.write_baseline:
+        path = (args.baseline if args.baseline.lower() != "none"
+                else DEFAULT_BASELINE)
+        findings_mod.write_baseline(results, path)
+        print(f"wrote {len(results)} finding(s) to {path}")
+        return 0
+
+    if args.baseline.lower() != "none":
+        baseline = findings_mod.load_baseline(args.baseline)
+        if baseline:
+            results = findings_mod.apply_baseline(results, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "mesh": mesh_n,
+            "variants": rows,
+            "serving": serving["rows"],
+            "findings": [f.to_dict() for f in results],
+            "summary": findings_mod.summarize(results)}, indent=2))
+    else:
+        print(f"mesh: {mesh_n} chips; plan: {plan_name}")
+        for r in rows:
+            mark = "ok  " if r["fits"] else "OVER"
+            print(f"  [{mark}] train {r['variant']:<8} batch {r['batch']:>3}"
+                  f" seq {r['seq']:>5} remat={str(r['remat']):<9}"
+                  f" {r['live_gib']:>8.3f} GiB ({r['basis']})")
+        for r in serving["rows"]:
+            mark = "ok  " if r["fits"] else "OVER"
+            print(f"  [{mark}] serve bucket seq {r['bucket']:>5} x"
+                  f" {r['max_batch']:>2} seqs {r['live_gib']:>8.3f} GiB"
+                  f" (weights {r['weights_gib']} + kv {r['kv_gib']})")
+        for f in results:
+            print(f)
+        print(findings_mod.summarize(results))
+
+    if findings_mod.has_errors(results):
+        return 1
+    if args.strict and results:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
