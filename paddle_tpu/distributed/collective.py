@@ -158,6 +158,18 @@ def _maybe_init_multihost():
     host, port = coord.rsplit(":", 1)
     coord_addr = os.environ.get("JAX_COORDINATOR_ADDRESS",
                                 f"{host}:{int(port) + 1}")
+    # the CPU PJRT client has no cross-process collectives of its own —
+    # without gloo every multi-process CPU-proxy run dies at the first
+    # collective with "Multiprocess computations aren't implemented on
+    # the CPU backend". Must be set BEFORE the backend is created, so
+    # key off the platform request rather than jax.default_backend().
+    platforms = (os.environ.get("JAX_PLATFORMS")
+                 or getattr(jax.config, "jax_platforms", None) or "")
+    if "cpu" in platforms.split(","):
+        try:
+            jax.config.update("jax_cpu_enable_gloo_collectives", True)
+        except Exception:
+            pass  # flag absent on this jaxlib: keep the TPU path intact
     try:
         # num_processes/process_id must be explicit: jax only reads the
         # coordinator address from env, not the process counts
@@ -284,6 +296,10 @@ def _watched(name):
                 nb = getattr(getattr(t, "_data", t), "nbytes", 0)
                 if nb:
                     bytes_c.labels(op=name).inc(int(nb))
+            from ..observability import fleet as _fleet
+            # fleet enter BEFORE the fault point: a kill_rank here leaves
+            # the enter-without-exit signature in the victim's shard/ring
+            tok = _fleet.on_collective_enter(name)
             from ..resilience.chaos import fault_point
             fault_point("collective.enter")  # chaos drills; no-op unarmed
             t0 = _time.perf_counter()
@@ -295,6 +311,7 @@ def _watched(name):
                     return fn(*args, **kwargs)
             finally:
                 seconds.labels(op=name).observe(_time.perf_counter() - t0)
+                _fleet.on_collective_exit(tok, name)
         return wrapper
     return deco
 

@@ -315,6 +315,8 @@ class Gateway:
                 self._requeue_from(rep)
         finished = self._poll()
         self._update_gauges()
+        from ...observability.fleet import autospool_tick
+        autospool_tick()   # rank-sharded metrics spool; no-op unarmed
         return finished
 
     def _expire_queued(self):

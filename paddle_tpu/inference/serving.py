@@ -403,6 +403,8 @@ class _BatcherBase:
             self.health.on_step_error()
             raise
         self.health.on_step_ok(len(self._pending))
+        from ..observability.fleet import autospool_tick
+        autospool_tick()   # rank-sharded metrics spool; no-op unarmed
         return finished
 
     def _pick(self, logits_np):

@@ -9,6 +9,11 @@ platform/monitor.h STATS_INT + the host profiler, fused):
     profiler's chrome-trace recorder and span-duration histograms.
   * ``export`` — Prometheus text format + JSONL snapshots
     (``tools/telemetry_dump.py`` is the CLI over these).
+  * ``fleet`` — rank-sharded telemetry spools under
+    ``PADDLE_TELEMETRY_DIR`` + cross-rank aggregation with typed
+    straggler/desync/missing-rank findings (``telemetry_dump --fleet``).
+  * ``flight`` — crash-surviving per-rank binary ring journal, replayed
+    by ``tools/blackbox.py postmortem``.
 
 Instrumented out of the box: serving batchers (queue depth, admissions,
 preemptions, TTFT / per-token latency), the multi-replica serving
@@ -22,8 +27,13 @@ diagnostic pass counts its findings by rule here).
 """
 from __future__ import annotations
 
-from . import export, metrics, roofline_attr, slo, trace_context, tracing
+from . import (export, fleet, flight, metrics, roofline_attr, slo,
+               trace_context, tracing)
 from .export import load_jsonl, render_prometheus, write_jsonl
+from .fleet import (FleetAggregator, FleetFinding, ProcessIdentity,
+                    TelemetrySpool, get_spool, process_identity)
+from .flight import (FlightRecorder, build_postmortem, flight_record,
+                     get_flight, read_ring)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
 from .slo import SLO, Alert, BurnWindow, SLOMonitor, default_gateway_slos
@@ -34,7 +44,11 @@ from .tracing import (Span, attach_context, capture_context, current_span,
 
 __all__ = [
     "metrics", "tracing", "export", "trace_context", "roofline_attr",
-    "slo",
+    "slo", "fleet", "flight",
+    "FleetAggregator", "FleetFinding", "ProcessIdentity",
+    "TelemetrySpool", "get_spool", "process_identity",
+    "FlightRecorder", "build_postmortem", "flight_record", "get_flight",
+    "read_ring",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Span", "span", "current_span", "span_path", "capture_context",
     "attach_context", "traced",

@@ -1,0 +1,582 @@
+"""Fleet telemetry plane: rank-sharded spools + cross-rank aggregation.
+
+Every observability surface below this module is per-process; this is
+the layer that makes a multi-process world debuggable (MegaScale-style
+per-worker monitoring + straggler attribution, PAPERS.md):
+
+  * **Process identity** — ``rank`` / ``world_size`` / ``host``
+    resolved once from the launcher's env (``PADDLE_TRAINER_ID`` /
+    ``PADDLE_TRAINERS_NUM``); ``metrics.MetricsRegistry`` stamps the
+    rank as a default label on every series when a distributed env is
+    detected (and stays byte-identical when it is not).
+  * **Per-rank spool** — when ``PADDLE_TELEMETRY_DIR`` is set, each
+    process appends metrics snapshots, finished trace spans, and
+    collective enter/exit events to its own ``rank<r>.jsonl`` shard
+    (append + flush per line: a killed rank's shard is complete up to
+    the moment of death). Serving/gateway step loops call
+    ``autospool_tick`` so long-running engines snapshot periodically
+    without user code.
+  * **FleetAggregator** — merges shards into one fleet view (counters
+    summed, histograms bucket-merged, gauges kept per-rank, spans
+    unioned onto the wall clock) and reconstructs a per-collective
+    cross-rank timeline with typed findings: ``straggler`` (arrival
+    skew over threshold, with ``collective.skew_seconds{op}`` p50/p99
+    gauges), ``desync`` (ranks entering different collectives — the
+    runtime twin of the DF004 static lint), and ``missing_rank`` (a
+    shard stops mid-collective). ``tools/telemetry_dump.py --fleet``
+    is the CLI over all of it.
+
+The hard-crash sibling is ``flight.py`` (binary ring journal); this
+module is the high-volume, human-readable plane.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ProcessIdentity", "process_identity", "telemetry_dir",
+           "TelemetrySpool", "get_spool", "spool_enabled", "reset_spool",
+           "spool_metrics", "spool_event", "autospool_tick",
+           "on_collective_enter", "on_collective_exit",
+           "FleetFinding", "FleetAggregator",
+           "DEFAULT_STRAGGLER_THRESHOLD_S"]
+
+DEFAULT_STRAGGLER_THRESHOLD_S = 0.25
+
+
+# -- process identity --------------------------------------------------------
+
+@dataclass(frozen=True)
+class ProcessIdentity:
+    rank: int
+    world_size: int
+    host: str
+    pid: int
+
+    @property
+    def distributed(self) -> bool:
+        return self.world_size > 1
+
+
+_IDENT: List[Optional[ProcessIdentity]] = [None]
+
+
+def process_identity() -> ProcessIdentity:
+    """This process's fleet identity, resolved once from the launcher
+    env (rank 0 of a world of 1 when standalone)."""
+    ident = _IDENT[0]
+    if ident is None:
+        ident = ProcessIdentity(
+            rank=int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0),
+            world_size=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")
+                           or 1),
+            host=socket.gethostname(),
+            pid=os.getpid())
+        _IDENT[0] = ident
+    return ident
+
+
+def telemetry_dir() -> Optional[str]:
+    return os.environ.get("PADDLE_TELEMETRY_DIR") or None
+
+
+# -- per-rank spool ----------------------------------------------------------
+
+class TelemetrySpool:
+    """Append-only JSONL shard for ONE process (``rank<r>.jsonl``).
+
+    Every line is flushed as written — a crashed rank's shard parses
+    clean up to its last complete line (the reader tolerates one torn
+    tail line). The first line is a ``meta`` record carrying the
+    identity the aggregator joins on.
+    """
+
+    def __init__(self, dirpath: str,
+                 identity: Optional[ProcessIdentity] = None):
+        self.identity = identity or process_identity()
+        os.makedirs(dirpath, exist_ok=True)
+        self.path = os.path.join(
+            dirpath, f"rank{self.identity.rank:05d}.jsonl")
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a")
+        self.write({"kind": "meta", "rank": self.identity.rank,
+                    "world_size": self.identity.world_size,
+                    "host": self.identity.host, "pid": self.identity.pid,
+                    "t": time.time()})
+
+    def write(self, obj: dict) -> None:
+        line = json.dumps(obj, separators=(",", ":"))
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def metrics_snapshot(self) -> None:
+        from .metrics import get_registry
+        self.write({"kind": "metrics", "t": time.time(),
+                    "series": get_registry().snapshot()})
+
+    def span(self, span_dict: dict, wall_end: float) -> None:
+        dur = span_dict.get("duration_s") or 0.0
+        self.write({"kind": "span", "t": wall_end - dur,
+                    "t_end": wall_end, **span_dict})
+
+    def collective(self, phase: str, op: str, seq: int,
+                   t: Optional[float] = None,
+                   dur: Optional[float] = None) -> None:
+        rec = {"kind": "collective", "phase": phase, "op": op,
+               "seq": seq, "t": time.time() if t is None else t}
+        if dur is not None:
+            rec["dur"] = dur
+        self.write(rec)
+
+    def event(self, name: str, **fields) -> None:
+        self.write({"kind": "event", "name": name, "t": time.time(),
+                    **fields})
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+_UNPROBED = object()
+_SPOOL = _UNPROBED   # _UNPROBED | None | TelemetrySpool
+_SPOOL_LOCK = threading.Lock()
+
+
+def get_spool() -> Optional[TelemetrySpool]:
+    """This process's spool (lazily opened under PADDLE_TELEMETRY_DIR);
+    None when spooling is disarmed."""
+    global _SPOOL
+    sp = _SPOOL
+    if sp is not _UNPROBED:
+        return sp
+    with _SPOOL_LOCK:
+        if _SPOOL is not _UNPROBED:
+            return _SPOOL
+        d = telemetry_dir()
+        if not d:
+            _SPOOL = None
+            return None
+        try:
+            _SPOOL = TelemetrySpool(d)
+        except OSError:
+            _SPOOL = None
+        return _SPOOL
+
+
+def spool_enabled() -> bool:
+    return get_spool() is not None
+
+
+def reset_spool() -> None:
+    """Close + drop the cached spool AND identity so the next use
+    re-reads the env (tests)."""
+    global _SPOOL
+    with _SPOOL_LOCK:
+        if _SPOOL not in (None, _UNPROBED):
+            _SPOOL.close()
+        _SPOOL = _UNPROBED
+        _IDENT[0] = None
+        _TICK[0] = 0.0
+
+
+def spool_metrics() -> None:
+    sp = get_spool()
+    if sp is not None:
+        sp.metrics_snapshot()
+
+
+def spool_event(name: str, **fields) -> None:
+    sp = get_spool()
+    if sp is not None:
+        sp.event(name, **fields)
+
+
+_TICK = [0.0]
+
+
+def autospool_tick(min_interval: Optional[float] = None) -> bool:
+    """Rate-limited metrics snapshot for long-running loops (serving /
+    gateway steps call this each tick). Returns True when a snapshot
+    was written. Disarmed: one cached-global check."""
+    if _SPOOL is None:
+        return False
+    sp = get_spool()
+    if sp is None:
+        return False
+    iv = (min_interval if min_interval is not None else
+          float(os.environ.get("PADDLE_TELEMETRY_INTERVAL", "1.0")))
+    now = time.monotonic()
+    if now - _TICK[0] < iv:
+        return False
+    _TICK[0] = now
+    sp.metrics_snapshot()
+    return True
+
+
+# -- collective instrumentation (called from distributed.collective) ---------
+
+_COLL_SEQ = [0]
+_COLL_LOCK = threading.Lock()
+
+
+def on_collective_enter(op: str) -> Optional[Tuple[int, float]]:
+    """Record this rank ENTERING a collective (spool + flight ring).
+    Returns the (seq, t_enter) token ``on_collective_exit`` needs, or
+    None when both channels are disarmed. Runs BEFORE the chaos fault
+    point so a kill_rank mid-collective leaves the tell-tale
+    enter-without-exit in the victim's shard and ring."""
+    sp = get_spool()
+    from .flight import get_flight
+    fl = get_flight()
+    if sp is None and fl is None:
+        return None
+    with _COLL_LOCK:
+        _COLL_SEQ[0] += 1
+        seq = _COLL_SEQ[0]
+    t = time.time()
+    if sp is not None:
+        sp.collective("enter", op, seq, t)
+    if fl is not None:
+        fl.record("collective_enter", wall_t=t, op=op, seq=seq)
+    return seq, t
+
+
+def on_collective_exit(token: Optional[Tuple[int, float]],
+                       op: str) -> None:
+    if token is None:
+        return
+    seq, t0 = token
+    t = time.time()
+    sp = get_spool()
+    if sp is not None:
+        sp.collective("exit", op, seq, t, dur=t - t0)
+    from .flight import get_flight
+    fl = get_flight()
+    if fl is not None:
+        fl.record("collective_exit", wall_t=t, op=op, seq=seq)
+
+
+# -- aggregation -------------------------------------------------------------
+
+@dataclass
+class FleetFinding:
+    """One typed cross-rank diagnosis from the collective timeline."""
+    kind: str                       # straggler | desync | missing_rank
+    op: str
+    seq: int
+    rank: Optional[int] = None      # the implicated rank
+    skew_s: Optional[float] = None
+    detail: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        out = {"kind": self.kind, "op": self.op, "seq": self.seq,
+               "rank": self.rank, "detail": dict(self.detail)}
+        if self.skew_s is not None:
+            out["skew_s"] = self.skew_s
+        return out
+
+    def __str__(self):
+        bits = [f"{self.kind}: op={self.op} seq={self.seq}"]
+        if self.rank is not None:
+            bits.append(f"rank={self.rank}")
+        if self.skew_s is not None:
+            bits.append(f"skew={self.skew_s:.3f}s")
+        return " ".join(bits)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _bucket_quantile(bounds: List[float], counts: List[int], q: float,
+                     mx: Optional[float]) -> Optional[float]:
+    """Quantile estimate from merged cumulative buckets (upper-bound
+    convention; the +Inf tail resolves to the merged max)."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    target = q * total
+    cum = 0
+    for bound, c in zip(bounds, counts):
+        cum += c
+        if cum >= target:
+            return bound
+    return mx if mx is not None else bounds[-1]
+
+
+class _RankShard:
+    """One parsed rank<r>.jsonl file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.meta: dict = {}
+        self.snapshots: List[dict] = []      # metrics records, in order
+        self.spans: List[dict] = []
+        self.collectives: List[dict] = []
+        self.events: List[dict] = []
+        self.records: List[dict] = []        # everything, append order
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue   # torn tail line from a crashed writer
+                self.records.append(obj)
+                k = obj.get("kind")
+                if k == "meta":
+                    self.meta = obj
+                elif k == "metrics":
+                    self.snapshots.append(obj)
+                elif k == "span":
+                    self.spans.append(obj)
+                elif k == "collective":
+                    self.collectives.append(obj)
+                elif k == "event":
+                    self.events.append(obj)
+        self.rank = int(self.meta.get("rank", -1))
+
+    @property
+    def latest_series(self) -> List[dict]:
+        return self.snapshots[-1]["series"] if self.snapshots else []
+
+
+class FleetAggregator:
+    """Merge every rank shard under one telemetry dir into a fleet view."""
+
+    def __init__(self, dirpath: str):
+        import glob
+        self.dir = dirpath
+        self.shards: Dict[int, _RankShard] = {}
+        for path in sorted(glob.glob(os.path.join(dirpath,
+                                                  "rank*.jsonl"))):
+            try:
+                shard = _RankShard(path)
+            except OSError:
+                continue
+            if shard.rank >= 0:
+                self.shards[shard.rank] = shard
+
+    def ranks(self) -> List[int]:
+        return sorted(self.shards)
+
+    def identities(self) -> Dict[int, dict]:
+        return {r: s.meta for r, s in sorted(self.shards.items())}
+
+    # -- metric merge --------------------------------------------------------
+    def fleet_series(self) -> List[dict]:
+        """One merged series list: counters summed across ranks,
+        histograms bucket-merged, gauges kept per-rank (a point-in-time
+        value has no meaningful cross-rank sum), plus fleet meta gauges
+        and the ``collective.skew_seconds{op}`` p50/p99 skew gauges."""
+        counters: Dict[Tuple, dict] = {}
+        hists: Dict[Tuple, dict] = {}
+        out: List[dict] = []
+        for rank, shard in sorted(self.shards.items()):
+            for s in shard.latest_series:
+                labels = dict(s.get("labels") or {})
+                labels.pop("rank", None)
+                key = (s["name"],
+                       tuple(sorted(labels.items())))
+                if s["type"] == "counter":
+                    ent = counters.get(key)
+                    if ent is None:
+                        counters[key] = ent = {
+                            "name": s["name"], "type": "counter",
+                            "labels": labels, "value": 0, "ranks": []}
+                    ent["value"] += s.get("value", 0)
+                    ent["ranks"].append(rank)
+                elif s["type"] == "histogram":
+                    ent = hists.get(key)
+                    if ent is None or \
+                            ent["buckets"] != list(s.get("buckets") or []):
+                        if ent is not None:
+                            # bucket bounds diverged across ranks: keep
+                            # the first merge and emit this one per-rank
+                            out.append({**s, "labels": {
+                                **labels, "rank": str(rank)}})
+                            continue
+                        hists[key] = ent = {
+                            "name": s["name"], "type": "histogram",
+                            "labels": labels,
+                            "buckets": list(s.get("buckets") or []),
+                            "bucket_counts": [0] * len(
+                                s.get("bucket_counts") or []),
+                            "count": 0, "sum": 0.0,
+                            "min": None, "max": None, "ranks": []}
+                    bc = s.get("bucket_counts") or []
+                    if len(ent["bucket_counts"]) < len(bc):
+                        ent["bucket_counts"] += [0] * (
+                            len(bc) - len(ent["bucket_counts"]))
+                    for i, c in enumerate(bc):
+                        ent["bucket_counts"][i] += c
+                    ent["count"] += s.get("count", 0)
+                    ent["sum"] += s.get("sum", 0.0)
+                    for fld, pick in (("min", min), ("max", max)):
+                        v = s.get(fld)
+                        if v is not None:
+                            ent[fld] = (v if ent[fld] is None
+                                        else pick(ent[fld], v))
+                    ent["ranks"].append(rank)
+                else:   # gauges (and external natives): per-rank truth
+                    out.append({**s, "labels": {**labels,
+                                                "rank": str(rank)}})
+        for ent in hists.values():
+            bounds, bc = ent["buckets"], ent["bucket_counts"]
+            ent["quantiles"] = {
+                f"p{int(q * 100)}": _bucket_quantile(bounds, bc, q,
+                                                     ent["max"])
+                for q in (0.5, 0.95, 0.99)}
+        out.extend(counters.values())
+        out.extend(hists.values())
+        out.append({"name": "fleet.ranks_reporting", "type": "gauge",
+                    "labels": {}, "value": float(len(self.shards)),
+                    "peak": float(len(self.shards))})
+        for op, skews in sorted(self._skews_by_op().items()):
+            srt = sorted(skews)
+            for q, qn in ((0.5, "p50"), (0.99, "p99")):
+                qv = _quantile(srt, q)
+                if qv is None:
+                    continue
+                out.append({"name": "collective.skew_seconds",
+                            "type": "gauge",
+                            "labels": {"op": op, "quantile": qn},
+                            "value": qv, "peak": max(srt)})
+        return out
+
+    # -- spans ---------------------------------------------------------------
+    def spans(self) -> List[dict]:
+        """Every rank's finished spans unioned onto the wall clock
+        (sorted by start time, rank attached)."""
+        out = []
+        for rank, shard in sorted(self.shards.items()):
+            for sp in shard.spans:
+                out.append({**sp, "rank": rank})
+        out.sort(key=lambda s: (s.get("t", 0.0), s.get("rank", 0)))
+        return out
+
+    # -- collective timeline + findings --------------------------------------
+    def collective_timeline(self) -> List[dict]:
+        """Per-collective cross-rank view, ordered by seq: which op each
+        rank entered at that position, and when it entered/exited."""
+        by_seq: Dict[int, dict] = {}
+        for rank, shard in sorted(self.shards.items()):
+            for c in shard.collectives:
+                seq = c.get("seq")
+                ent = by_seq.setdefault(seq, {
+                    "seq": seq, "op_by_rank": {}, "enter": {},
+                    "exit": {}})
+                if c.get("phase") == "enter":
+                    ent["op_by_rank"][rank] = c.get("op")
+                    ent["enter"][rank] = c.get("t")
+                else:
+                    ent["exit"][rank] = c.get("t")
+        return [by_seq[s] for s in sorted(by_seq)]
+
+    def _skews_by_op(self) -> Dict[str, List[float]]:
+        skews: Dict[str, List[float]] = {}
+        for ent in self.collective_timeline():
+            ops = set(ent["op_by_rank"].values())
+            if len(ops) != 1 or len(ent["enter"]) < 2:
+                continue
+            ts = list(ent["enter"].values())
+            skews.setdefault(ops.pop(), []).append(max(ts) - min(ts))
+        return skews
+
+    def findings(self, straggler_threshold_s: Optional[float] = None
+                 ) -> List[FleetFinding]:
+        """Typed cross-rank diagnoses from the merged timeline."""
+        thresh = (straggler_threshold_s if straggler_threshold_s
+                  is not None else float(os.environ.get(
+                      "PADDLE_FLEET_SKEW_THRESHOLD",
+                      str(DEFAULT_STRAGGLER_THRESHOLD_S))))
+        out: List[FleetFinding] = []
+        timeline = self.collective_timeline()
+        for ent in timeline:
+            ops = ent["op_by_rank"]
+            distinct = set(ops.values())
+            if len(distinct) > 1:
+                # runtime twin of the DF004 static lint: the ranks'
+                # programs diverged. Implicate the minority op's ranks.
+                by_op: Dict[str, List[int]] = {}
+                for r, op in ops.items():
+                    by_op.setdefault(op, []).append(r)
+                minority_op = min(by_op, key=lambda o: len(by_op[o]))
+                out.append(FleetFinding(
+                    kind="desync", op=minority_op, seq=ent["seq"],
+                    rank=by_op[minority_op][0],
+                    detail={"op_by_rank": {str(r): o for r, o
+                                           in sorted(ops.items())}}))
+                continue
+            if len(ent["enter"]) >= 2 and distinct:
+                ts = ent["enter"]
+                skew = max(ts.values()) - min(ts.values())
+                if skew >= thresh:
+                    slowest = max(ts, key=lambda r: ts[r])
+                    out.append(FleetFinding(
+                        kind="straggler", op=next(iter(distinct)),
+                        seq=ent["seq"], rank=slowest, skew_s=skew,
+                        detail={"enter_t": {str(r): t for r, t
+                                            in sorted(ts.items())}}))
+        # missing-rank: a rank left a collective ENTER unmatched and then
+        # went SILENT (its shard's last write trails the fleet's last
+        # write by > silence threshold). The silence clause is what
+        # separates the dead rank from survivors blocked in the same
+        # collective: a watchdog-aborted survivor also ends on an open
+        # enter, but it kept writing until moments before the fleet's
+        # final record.
+        silence_s = float(os.environ.get(
+            "PADDLE_FLEET_SILENCE_THRESHOLD", "1.0"))
+
+        def _last_t(shard: _RankShard) -> float:
+            return max((r.get("t_end") or r.get("t") or 0.0
+                        for r in shard.records), default=0.0)
+
+        fleet_last_t = max((_last_t(s) for s in self.shards.values()),
+                           default=0.0)
+        for rank, shard in sorted(self.shards.items()):
+            exits = {c.get("seq") for c in shard.collectives
+                     if c.get("phase") == "exit"}
+            open_enters = [c for c in shard.collectives
+                           if c.get("phase") == "enter"
+                           and c.get("seq") not in exits]
+            if not open_enters:
+                continue
+            last_open = max(open_enters,
+                            key=lambda c: c.get("seq") or 0)
+            gap = fleet_last_t - _last_t(shard)
+            if gap >= silence_s:
+                out.append(FleetFinding(
+                    kind="missing_rank", op=last_open.get("op"),
+                    seq=last_open.get("seq"), rank=rank,
+                    detail={"last_t": _last_t(shard),
+                            "fleet_last_t": fleet_last_t,
+                            "silent_for_s": gap}))
+        return out
+
+    def summary(self) -> dict:
+        findings = self.findings()
+        return {
+            "dir": self.dir,
+            "ranks": self.ranks(),
+            "world_size": max((s.meta.get("world_size", 1)
+                               for s in self.shards.values()),
+                              default=0),
+            "collectives": len(self.collective_timeline()),
+            "spans": sum(len(s.spans) for s in self.shards.values()),
+            "findings": [f.to_dict() for f in findings],
+        }

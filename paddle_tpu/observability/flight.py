@@ -1,0 +1,289 @@
+"""Crash-surviving flight recorder: a fixed-size binary ring journal.
+
+When chaos ``kill_rank`` (or a real crash) takes a process down with
+``os._exit``, everything buffered in userspace dies with it — the
+metrics registry, the trace recorder, half-written log lines. This
+module is the black box that survives: a pre-allocated fixed-geometry
+ring file per rank where every event lands via one unbuffered
+``os.pwrite`` (page cache persists across process death; only a kernel
+panic loses it), so ``tools/blackbox.py postmortem`` can replay the last
+N events of every rank — including the killed one — after the fact.
+
+File layout (all little-endian)::
+
+    header (64 B):  magic "PTFLIGHT" | version u32 | slot_size u32 |
+                    nslots u32 | epoch u32 | rank i32 | pad
+    slot  (slot_size B, nslots of them):
+                    seq u64 | epoch u32 | len u32 | wall_t f64 |
+                    crc32 u32 | payload (JSON, truncated to fit)
+
+Appends are O(1): slot index = ``seq % nslots``; no cursor is persisted.
+Reopen recovers the cursor by scanning for the max valid seq (O(N) once)
+and bumps + fsyncs the epoch header, so events from before and after a
+restart stay distinguishable while seq keeps one total order.
+
+Events recorded by the instrumented seams: span open/close
+(``trace_context``), collective enter/exit (``distributed.collective``),
+chaos injections — written BEFORE the fault executes, so a kill_rank is
+the victim's last journal entry (``resilience.chaos``) — and checkpoint
+commits (``resilience.checkpoint_manager``).
+
+Armed iff ``PADDLE_TELEMETRY_DIR`` is set (one cached check per event
+when disarmed); the ring lives at ``<dir>/flight-rank<r>.ring``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+__all__ = ["FlightRecorder", "get_flight", "flight_record",
+           "read_ring", "build_postmortem", "reset_flight"]
+
+_MAGIC = b"PTFLIGHT"
+_VERSION = 1
+_HDR = struct.Struct("<8sIIIIi")          # magic, ver, slot, nslots, epoch, rank
+_HDR_SIZE = 64
+_SLOT_HDR = struct.Struct("<QIIdI")       # seq, epoch, len, wall_t, crc
+_DEFAULT_SLOTS = 2048
+_DEFAULT_SLOT_SIZE = 256
+
+
+class FlightRecorder:
+    """One rank's ring journal (open for appending)."""
+
+    def __init__(self, path: str, slots: int = _DEFAULT_SLOTS,
+                 slot_size: int = _DEFAULT_SLOT_SIZE, rank: int = 0):
+        if slot_size <= _SLOT_HDR.size + 2:
+            raise ValueError(f"slot_size {slot_size} too small")
+        self.path = path
+        self._lock = threading.Lock()
+        existing = os.path.exists(path) and os.path.getsize(path) >= _HDR_SIZE
+        self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        if existing:
+            hdr = os.pread(self._fd, _HDR_SIZE, 0)
+            magic, ver, fss, fns, epoch, frank = _HDR.unpack(
+                hdr[:_HDR.size])
+            if magic != _MAGIC or ver != _VERSION:
+                raise ValueError(f"{path}: not a flight ring "
+                                 f"(magic={magic!r} ver={ver})")
+            # adopt the file's geometry — a reopened ring keeps its shape
+            self.slot_size, self.nslots = fss, fns
+            self.rank = rank if rank is not None else frank
+            self.epoch = epoch + 1
+            self._seq = self._recover_seq()
+        else:
+            self.slot_size, self.nslots = int(slot_size), int(slots)
+            self.rank = rank
+            self.epoch = 0
+            self._seq = 0
+            os.ftruncate(self._fd,
+                         _HDR_SIZE + self.nslots * self.slot_size)
+        self._write_header()          # epoch header, fsync'd
+
+    def _write_header(self):
+        hdr = _HDR.pack(_MAGIC, _VERSION, self.slot_size, self.nslots,
+                        self.epoch, self.rank)
+        os.pwrite(self._fd, hdr.ljust(_HDR_SIZE, b"\0"), 0)
+        os.fsync(self._fd)
+
+    def _recover_seq(self) -> int:
+        top = 0
+        for i in range(self.nslots):
+            raw = os.pread(self._fd, _SLOT_HDR.size,
+                           _HDR_SIZE + i * self.slot_size)
+            if len(raw) < _SLOT_HDR.size:
+                continue
+            seq, _ep, ln, _t, crc = _SLOT_HDR.unpack(raw)
+            if ln == 0 or ln > self.slot_size - _SLOT_HDR.size:
+                continue
+            top = max(top, seq + 1)
+        return top
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    def record(self, kind: str, wall_t: Optional[float] = None,
+               **fields) -> int:
+        """Append one event; returns its seq. One pwrite, no fsync —
+        page-cache durability is exactly the survive-``os._exit`` bar."""
+        import time
+        t = time.time() if wall_t is None else wall_t
+        cap = self.slot_size - _SLOT_HDR.size
+        payload = json.dumps({"kind": kind, **fields},
+                             separators=(",", ":")).encode()
+        if len(payload) > cap:
+            payload = json.dumps(
+                {"kind": kind, "truncated": True},
+                separators=(",", ":")).encode()[:cap]
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        slot = _SLOT_HDR.pack(seq, self.epoch, len(payload), t, crc) \
+            + payload
+        os.pwrite(self._fd, slot, _HDR_SIZE + (seq % self.nslots)
+                  * self.slot_size)
+        return seq
+
+    def events(self) -> List[dict]:
+        """Every valid event currently in the ring, seq-ordered. Each
+        dict carries ``_seq``/``_epoch``/``_t`` bookkeeping beside the
+        recorded payload fields."""
+        return read_ring(self.path)
+
+    def close(self):
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+
+def read_ring(path: str) -> List[dict]:
+    """Read a ring file (no recorder needed — the post-mortem path).
+    Corrupt/empty slots are skipped, never raised: a half-written slot
+    from the moment of death must not hide the rest of the journal."""
+    out: List[dict] = []
+    with open(path, "rb") as f:
+        hdr = f.read(_HDR_SIZE)
+        if len(hdr) < _HDR.size:
+            return out
+        magic, ver, slot_size, nslots, epoch, rank = _HDR.unpack(
+            hdr[:_HDR.size])
+        if magic != _MAGIC or ver != _VERSION:
+            raise ValueError(f"{path}: not a flight ring")
+        for i in range(nslots):
+            f.seek(_HDR_SIZE + i * slot_size)
+            raw = f.read(slot_size)
+            if len(raw) < _SLOT_HDR.size:
+                continue
+            seq, ep, ln, t, crc = _SLOT_HDR.unpack(raw[:_SLOT_HDR.size])
+            if ln == 0 or ln > slot_size - _SLOT_HDR.size:
+                continue
+            payload = raw[_SLOT_HDR.size:_SLOT_HDR.size + ln]
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                continue
+            try:
+                obj = json.loads(payload.decode())
+            except ValueError:
+                continue
+            obj["_seq"] = seq
+            obj["_epoch"] = ep
+            obj["_t"] = t
+            obj["_rank"] = rank
+            out.append(obj)
+    out.sort(key=lambda e: e["_seq"])
+    return out
+
+
+# -- process-wide recorder (armed by PADDLE_TELEMETRY_DIR) -------------------
+
+_UNPROBED = object()
+_REC = _UNPROBED   # _UNPROBED | None (disabled) | FlightRecorder
+_REC_LOCK = threading.Lock()
+
+
+def _resolve_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+
+
+def get_flight() -> Optional[FlightRecorder]:
+    """This process's ring (created lazily under PADDLE_TELEMETRY_DIR);
+    None when telemetry is disarmed."""
+    global _REC
+    rec = _REC
+    if rec is not _UNPROBED:
+        return rec
+    with _REC_LOCK:
+        if _REC is not _UNPROBED:
+            return _REC
+        d = os.environ.get("PADDLE_TELEMETRY_DIR")
+        if not d:
+            _REC = None
+            return None
+        os.makedirs(d, exist_ok=True)
+        rank = _resolve_rank()
+        slots = int(os.environ.get("PADDLE_FLIGHT_SLOTS",
+                                   str(_DEFAULT_SLOTS)))
+        try:
+            _REC = FlightRecorder(
+                os.path.join(d, f"flight-rank{rank:05d}.ring"),
+                slots=slots, rank=rank)
+        except OSError:
+            _REC = None
+        return _REC
+
+
+def flight_record(kind: str, **fields) -> None:
+    """Record an event on this process's ring; no-op when disarmed
+    (one cached-global check)."""
+    rec = _REC
+    if rec is _UNPROBED:
+        rec = get_flight()
+    if rec is not None:
+        rec.record(kind, **fields)
+
+
+def reset_flight() -> None:
+    """Drop the cached recorder so the next event re-probes the env
+    (tests re-point PADDLE_TELEMETRY_DIR between cases)."""
+    global _REC
+    with _REC_LOCK:
+        if _REC not in (None, _UNPROBED):
+            _REC.close()
+        _REC = _UNPROBED
+
+
+# -- post-mortem reconstruction ----------------------------------------------
+
+def build_postmortem(dirpath: str,
+                     last_seconds: Optional[float] = None) -> dict:
+    """Replay every surviving ring under `dirpath` into one cross-rank
+    record: a wall-clock-ordered timeline plus a per-rank verdict (last
+    event, and whether the rank looks like it died mid-collective — an
+    unexited ``collective_enter``/``chaos`` as the final entry)."""
+    ranks: Dict[int, dict] = {}
+    timeline: List[dict] = []
+    import glob
+    for path in sorted(glob.glob(os.path.join(dirpath,
+                                              "flight-rank*.ring"))):
+        try:
+            events = read_ring(path)
+        except (OSError, ValueError) as e:
+            ranks[-1] = {"file": path, "error": str(e)}
+            continue
+        if not events:
+            continue
+        rank = events[0]["_rank"]
+        if last_seconds is not None:
+            horizon = max(e["_t"] for e in events) - last_seconds
+            events = [e for e in events if e["_t"] >= horizon]
+        last = events[-1]
+        open_colls = {}
+        for e in events:
+            if e.get("kind") == "collective_enter":
+                open_colls[e.get("seq")] = e
+            elif e.get("kind") == "collective_exit":
+                open_colls.pop(e.get("seq"), None)
+        died_in = (last if last.get("kind") in
+                   ("collective_enter", "chaos") else None)
+        ranks[rank] = {
+            "file": path,
+            "events": len(events),
+            "epochs": sorted({e["_epoch"] for e in events}),
+            "last_event": last,
+            "open_collectives": sorted(open_colls),
+            "suspect_death": ({"kind": last.get("kind"),
+                               "op": last.get("op"),
+                               "point": last.get("point"),
+                               "fault": last.get("fault")}
+                              if died_in is not None else None),
+        }
+        timeline.extend(events)
+    timeline.sort(key=lambda e: (e["_t"], e["_rank"], e["_seq"]))
+    return {"dir": dirpath, "ranks": {str(r): v for r, v
+                                      in sorted(ranks.items())},
+            "timeline": timeline}

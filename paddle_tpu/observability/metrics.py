@@ -24,6 +24,7 @@ plain dicts that ``observability.export`` serializes.
 from __future__ import annotations
 
 import bisect
+import os
 import random
 import threading
 import zlib
@@ -383,6 +384,34 @@ class MetricsRegistry:
         self._lock = threading.RLock()
         self._entries: Dict[str, object] = {}
         self._kinds: Dict[str, str] = {}
+        self._default_labels: Optional[Dict[str, str]] = None
+
+    # -- registry-wide default labels ---------------------------------------
+    # Stamped onto every snapshot series (explicit series labels win).
+    # Unset, they resolve from the distributed env at snapshot time:
+    # {"rank": <PADDLE_TRAINER_ID>} in a multi-process world, {} when
+    # world_size == 1 — single-process output stays byte-identical.
+    def set_default_labels(self, **labels: str) -> None:
+        with self._lock:
+            self._default_labels = {k: str(v) for k, v in labels.items()}
+
+    def clear_default_labels(self) -> None:
+        """Back to env-resolved defaults (tests)."""
+        with self._lock:
+            self._default_labels = None
+
+    def default_labels(self) -> Dict[str, str]:
+        with self._lock:
+            if self._default_labels is not None:
+                return dict(self._default_labels)
+        try:
+            world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+        except ValueError:
+            world = 1
+        if world > 1:
+            return {"rank": os.environ.get("PADDLE_TRAINER_ID", "0")
+                    or "0"}
+        return {}
 
     # -- registration (idempotent; kind mismatch is an error) ---------------
     def _get_or_make(self, name: str, kind: str, help: str,
@@ -466,6 +495,10 @@ class MetricsRegistry:
                 out.append({"name": key, "type": "gauge", "labels": {},
                             "value": float(cur), "peak": float(pk),
                             "external": True})
+        defaults = self.default_labels()
+        if defaults:
+            for s in out:
+                s["labels"] = {**defaults, **(s["labels"] or {})}
         return out
 
     def reset(self) -> None:

@@ -49,6 +49,12 @@ def enabled() -> bool:
     return os.environ.get("PADDLE_TRACE", "1") != "0"
 
 
+def _flight_record(kind: str, **fields) -> None:
+    # one cached-global check when the flight ring is disarmed
+    from .flight import flight_record
+    flight_record(kind, **fields)
+
+
 def _trace_metrics():
     from .metrics import get_registry
     reg = get_registry()
@@ -77,6 +83,8 @@ class TraceSpan:
         self.start_ns = time.perf_counter_ns()
         self.end_ns: Optional[int] = None
         self.tags: Dict[str, object] = dict(tags or {})
+        _flight_record("span_open", name=name, trace_id=trace_id,
+                       span_id=self.span_id)
 
     @property
     def open(self) -> bool:
@@ -96,6 +104,8 @@ class TraceSpan:
             return self
         self.end_ns = time.perf_counter_ns()
         self.tags.update(tags)
+        _flight_record("span_close", name=self.name,
+                       trace_id=self.trace_id, span_id=self.span_id)
         get_recorder().record(self)
         spans_c, _, span_h = _trace_metrics()
         spans_c.inc()
@@ -203,13 +213,36 @@ class TraceRecorder:
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=max(1, capacity))
         self._dropped = 0
+        self._drop_warned = False
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted from the bounded ring since the last clear()."""
+        return self._dropped
+
+    @property
+    def capacity(self) -> int:
+        return self._spans.maxlen
 
     def record(self, span: TraceSpan) -> None:
+        warn = False
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self._dropped += 1
                 _trace_metrics()[1].inc()
+                if not self._drop_warned:
+                    self._drop_warned = warn = True
             self._spans.append(span)
+        if warn:
+            import logging
+            logging.getLogger(__name__).warning(
+                "trace recorder full (capacity=%d): spans are being "
+                "dropped; raise PADDLE_TRACE_CAP or export more often",
+                self._spans.maxlen)
+        from .fleet import get_spool
+        sp = get_spool()
+        if sp is not None:
+            sp.span(span.to_dict(), time.time())
 
     def spans(self, trace_id: Optional[str] = None) -> List[TraceSpan]:
         with self._lock:
@@ -229,6 +262,7 @@ class TraceRecorder:
         with self._lock:
             self._spans.clear()
             self._dropped = 0
+            self._drop_warned = False
 
     # -- export --------------------------------------------------------------
     def to_chrome(self, trace_id: Optional[str] = None) -> dict:
@@ -254,7 +288,9 @@ class TraceRecorder:
                  "tid": tid, "args": {"name": f"trace {t}"}}
                 for t, tid in tid_of.items()]
         return {"traceEvents": meta + events,
-                "displayTimeUnit": "ms"}
+                "displayTimeUnit": "ms",
+                "metadata": {"dropped_spans": self._dropped,
+                             "capacity": self._spans.maxlen}}
 
     def export_chrome(self, path: str,
                       trace_id: Optional[str] = None) -> str:

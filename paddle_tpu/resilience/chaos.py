@@ -188,6 +188,13 @@ class ChaosRegistry:
         if spec is None:
             return None
         _registry_metrics().labels(point=point, kind=spec.kind).inc()
+        # journal the injection BEFORE executing it: for kill_rank this
+        # is the victim's last flight-ring entry — the post-mortem smoking
+        # gun ("fault" key, not "kind": that slot names the event type)
+        from ..observability.fleet import spool_event
+        from ..observability.flight import flight_record
+        flight_record("chaos", point=point, fault=spec.kind)
+        spool_event("chaos", point=point, fault=spec.kind)
         if spec.kind == "delay":
             time.sleep(spec.delay_s)
             return None

@@ -189,6 +189,8 @@ class CheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
+        from ..observability.flight import flight_record
+        flight_record("checkpoint_commit", step=step)
         self._apply_retention()
 
     def _apply_retention(self):

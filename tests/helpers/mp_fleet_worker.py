@@ -1,0 +1,108 @@
+"""Fleet-telemetry kill drill worker (3 ranks, CPU).
+
+Launched by paddle_tpu.distributed.launch with the telemetry dir as
+argv[1]. Every rank spools metrics/spans/collective enter-exit to its
+rank shard and journals to its flight ring. The script then makes the
+fleet misbehave on purpose:
+
+  * step 2: rank 1 sleeps before entering the all_reduce — an arrival
+    skew the aggregator must flag as a ``straggler``;
+  * step 4: chaos ``kill_rank`` takes rank 2 down with ``os._exit`` ON
+    ENTRY to the collective (enter spooled, no exit; the chaos event is
+    the last thing in its ring) — the ``missing_rank`` signature;
+  * ranks 0/1 hit the dead collective: gloo surfaces the dead peer as
+    an immediate transport error, which they catch, journal as a
+    ``peer_failure``, then keep their shard warm past the
+    missing-rank silence threshold before exiting 0 so the launcher
+    stays green and the shards stay parseable. The collective
+    watchdog rides along as a backstop in case the transport error
+    never surfaces (a genuinely hung peer instead of a dead one).
+
+The parent test aggregates the shards and replays the rings.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    os.environ["PADDLE_TELEMETRY_DIR"] = sys.argv[1]
+
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.observability.fleet import (spool_event,
+                                                spool_metrics)
+    from paddle_tpu.observability.flight import flight_record
+    from paddle_tpu.observability.trace_context import new_trace
+    from paddle_tpu.resilience import arm_scenario
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    n = dist.get_world_size()
+    assert jax.process_count() == n
+    print(f"MPFLEET_START rank={rank}/{n}", flush=True)
+
+    def abort_on_desync(report):
+        frozen = [r for r, s in report.get("peers_behind", {}).items()
+                  if s < report["seq"]] + report.get("peers_missing", [])
+        if report.get("kind") != "stuck" or not frozen:
+            return
+        print(f"MPFLEET_WATCHDOG rank={rank} frozen={frozen}",
+              flush=True)
+        spool_event("watchdog_abort", frozen=list(frozen),
+                    seq=report["seq"])
+        flight_record("watchdog_abort", frozen=list(frozen))
+        os._exit(0)  # survivors exit clean; shards stay parseable
+
+    wd = dist.enable_collective_watchdog(timeout=4.0, poll=0.5,
+                                         on_desync=abort_on_desync)
+    assert wd is not None
+
+    # 5th collective.enter hit (= step 4 below) kills rank 2 on entry
+    arm_scenario("collective.enter:kill_rank:rank=2,after=4,exit_code=0")
+
+    # spans + snapshots are written per step so every rank's shard holds
+    # them BEFORE the kill; nothing after the loop runs in this drill
+    ctx = new_trace("fleet_drill", rank=rank)
+    for step in range(8):
+        if rank == 1 and step == 2:
+            time.sleep(0.6)  # straggle into this collective
+        sp = ctx.begin("step", step=step)
+        t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+        try:
+            dist.all_reduce(t)
+        except Exception as e:
+            # gloo reports the killed peer as a transport error; an
+            # uncaught raise would drag the survivors through the JAX
+            # coordination-service fatal (nonzero exit, ~60s heartbeat
+            # wait). Catch it, journal it, and hold the shard open past
+            # the silence threshold so the dead rank's gap is
+            # measurable against live survivors.
+            sp.end()
+            print(f"MPFLEET_PEERDOWN rank={rank} step={step}",
+                  flush=True)
+            spool_event("peer_failure", step=step,
+                        error=type(e).__name__)
+            flight_record("peer_failure", step=step)
+            time.sleep(2.4)
+            spool_metrics()
+            spool_event("survivor_exit", step=step)
+            os._exit(0)  # skip atexit: no distributed shutdown barrier
+        sp.end()
+        np.testing.assert_allclose(
+            t.numpy(), np.full((4,), n * (n + 1) / 2))
+        spool_metrics()
+        if step == 3 and rank == 2:
+            print("MPFLEET_VICTIM_ALIVE rank=2 step=3", flush=True)
+    # unreachable when the drill works: the kill fires at step 4 and the
+    # survivors watchdog-abort. The parent test asserts this marker is
+    # ABSENT to prove the fault actually fired.
+    ctx.finish(steps=8)
+    print(f"MPFLEET_OK rank={rank}/{n}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

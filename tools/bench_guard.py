@@ -33,6 +33,7 @@ from typing import List, Optional
 
 DEFAULT_TOLERANCE = 0.10
 DEFAULT_WINDOW = 4
+DEFAULT_RELAY_WINDOW = 4
 
 
 def discover(dirpath: str) -> List[dict]:
@@ -159,6 +160,50 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
     return report
 
 
+def _relay_state(rec: dict) -> str:
+    """One round's TPU-relay verdict. bench.py ≥ round 6 stamps a
+    top-level ``relay`` field; older artifacts are derived from
+    ``detail`` (tpu=true → ok, a fallback note → unreachable); rounds
+    that produced no usable bench line count as ``round_failed``."""
+    if "_skip" in rec:
+        return "round_failed"
+    relay = rec.get("relay")
+    if isinstance(relay, str) and relay:
+        return relay
+    det = rec.get("detail") or {}
+    if det.get("tpu"):
+        return "ok"
+    if det.get("fallback"):
+        return "unreachable"
+    return "unknown"
+
+
+def check_relay(dirpath: str,
+                window: int = DEFAULT_RELAY_WINDOW) -> dict:
+    """Fail when the last ``window`` rounds ALL ran without the TPU
+    relay (relay != "ok") — CPU-fallback rounds must not silently
+    accumulate into a fake trajectory."""
+    records = discover(dirpath)
+    states = [{"round": r["_round"], "relay": _relay_state(r)}
+              for r in records]
+    ok_rounds = [s["round"] for s in states if s["relay"] == "ok"]
+    report = {
+        "dir": dirpath,
+        "window": window,
+        "rounds": states,
+        "last_ok_round": ok_rounds[-1] if ok_rounds else None,
+        "status": "pass",
+    }
+    if not states:
+        report["status"] = "no_history"
+        return report
+    tail = states[-window:]
+    if len(tail) >= window and all(s["relay"] != "ok" for s in tail):
+        report["status"] = "relay_wedged"
+        report["tail"] = tail
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="bench-trajectory regression gate")
@@ -176,7 +221,31 @@ def main(argv=None) -> int:
                          "(default 4)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full report as JSON")
+    ap.add_argument("--relay", action="store_true",
+                    help="gate the TPU relay instead of the trajectory: "
+                         "exit 1 when the last --relay-window rounds "
+                         "ALL report relay != ok (wedged relay "
+                         "accumulating CPU-fallback rounds)")
+    ap.add_argument("--relay-window", type=int,
+                    default=DEFAULT_RELAY_WINDOW,
+                    help="consecutive not-ok rounds that trip --relay "
+                         f"(default {DEFAULT_RELAY_WINDOW})")
     args = ap.parse_args(argv)
+
+    if args.relay:
+        report = check_relay(args.dir, window=args.relay_window)
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            trend = " ".join(f"r{s['round']:02d}={s['relay']}"
+                             for s in report["rounds"])
+            print(f"  relay trend: {trend or '(no history)'}")
+            last_ok = report["last_ok_round"]
+            print(f"  last ok round: "
+                  f"{'r%02d' % last_ok if last_ok is not None else 'never'}")
+            print(f"bench_guard --relay: {report['status'].upper()} "
+                  f"(window {report['window']}, dir {report['dir']})")
+        return 1 if report["status"] == "relay_wedged" else 0
 
     report = run_check(args.dir, tolerance=args.tolerance,
                        window=args.window)

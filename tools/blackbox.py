@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Flight-recorder black box: replay crash-surviving ring journals.
+
+After a chaos drill (or a real crash) every rank leaves a
+``flight-rank<r>.ring`` under ``PADDLE_TELEMETRY_DIR`` — including the
+ranks that died with ``os._exit``. This CLI replays all surviving rings
+into one wall-clock-ordered cross-rank narrative of the final moments,
+with a per-rank verdict (last event; whether the rank looks like it died
+mid-collective or mid-fault).
+
+    python tools/blackbox.py postmortem --dir /tmp/telemetry
+    python tools/blackbox.py postmortem --dir /tmp/telemetry --json
+    python tools/blackbox.py postmortem --last-seconds 5
+
+Exit code 0 always (forensics, not a gate); see tools/telemetry_dump.py
+--fleet for the metrics/findings side of the same directory.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.observability.flight import build_postmortem  # noqa: E402
+
+
+def _fmt_event(e: dict) -> str:
+    extras = {k: v for k, v in e.items()
+              if not k.startswith("_") and k != "kind"}
+    detail = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+    return (f"  t={e['_t']:.6f} rank={e['_rank']} "
+            f"seq={e['_seq']:<6d} {e.get('kind', '?'):<18s} {detail}")
+
+
+def render_text(pm: dict) -> str:
+    lines = [f"# flight-recorder postmortem: {pm['dir']}"]
+    if not pm["ranks"]:
+        lines.append("(no flight rings found)")
+        return "\n".join(lines)
+    lines.append("")
+    lines.append("## per-rank verdicts")
+    for rank, info in sorted(pm["ranks"].items(),
+                             key=lambda kv: int(kv[0])
+                             if kv[0].lstrip("-").isdigit() else 0):
+        if "error" in info:
+            lines.append(f"rank {rank}: UNREADABLE ({info['error']})")
+            continue
+        last = info["last_event"]
+        verdict = "clean"
+        sd = info.get("suspect_death")
+        if sd is not None:
+            what = sd.get("op") or sd.get("point") or sd.get("fault")
+            verdict = f"SUSPECT DEATH mid-{sd['kind']}" + (
+                f" ({what})" if what else "")
+        elif info.get("open_collectives"):
+            verdict = ("open collectives at end: "
+                       f"{info['open_collectives']}")
+        lines.append(
+            f"rank {rank}: {info['events']} events "
+            f"(epochs {info['epochs']}), last="
+            f"{last.get('kind')}@t={last['_t']:.6f} -> {verdict}")
+    lines.append("")
+    lines.append("## cross-rank timeline (wall-clock order)")
+    for e in pm["timeline"]:
+        lines.append(_fmt_event(e))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="blackbox.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    pm = sub.add_parser("postmortem",
+                        help="replay ring journals into a narrative")
+    pm.add_argument("--dir", default=os.environ.get(
+        "PADDLE_TELEMETRY_DIR"),
+        help="telemetry dir holding flight-rank*.ring "
+             "(default: $PADDLE_TELEMETRY_DIR)")
+    pm.add_argument("--json", action="store_true",
+                    help="emit the raw postmortem dict as JSON")
+    pm.add_argument("--last-seconds", type=float, default=None,
+                    help="only events within this window of each "
+                         "rank's final event")
+    args = ap.parse_args(argv)
+    if not args.dir:
+        ap.error("--dir required (or set PADDLE_TELEMETRY_DIR)")
+    result = build_postmortem(args.dir, last_seconds=args.last_seconds)
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(render_text(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

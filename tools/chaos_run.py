@@ -187,6 +187,16 @@ def main(argv=None) -> int:
             print(f"{mode:12} {spec}")
         return 0
 
+    # every drill is self-forensic: the flight recorder journals spans,
+    # chaos injections, and checkpoint commits into PADDLE_TELEMETRY_DIR
+    # (a temp dir unless the operator pointed it somewhere durable), and
+    # each drill leaves a postmortem artifact beside its report
+    tele_dir = os.environ.get("PADDLE_TELEMETRY_DIR")
+    if not tele_dir:
+        tele_dir = tempfile.mkdtemp(prefix="chaos_telemetry_")
+        os.environ["PADDLE_TELEMETRY_DIR"] = tele_dir
+    print(f"[chaos] telemetry dir: {tele_dir}")
+
     modes = list(DRILLS) if args.mode == "all" else [args.mode]
     failures = 0
     for mode in modes:
@@ -199,9 +209,28 @@ def main(argv=None) -> int:
         except AssertionError as exc:
             failures += 1
             print(f"[chaos:{mode}] FAILED — {exc}")
+        _write_postmortem(tele_dir, mode)
     print("-- telemetry --")
     _print_telemetry()
     return 1 if failures else 0
+
+
+def _write_postmortem(tele_dir: str, mode: str) -> None:
+    import json
+
+    from paddle_tpu.observability.flight import build_postmortem
+    try:
+        pm = build_postmortem(tele_dir)
+    except Exception as exc:  # forensics must not flip a drill verdict
+        print(f"[chaos:{mode}] postmortem unavailable: {exc}")
+        return
+    path = os.path.join(tele_dir, f"postmortem_{mode}.json")
+    with open(path, "w") as f:
+        json.dump(pm, f, indent=2, default=str)
+    n_events = sum(v.get("events", 0) for v in pm["ranks"].values()
+                   if isinstance(v, dict))
+    print(f"[chaos:{mode}] postmortem: {path} "
+          f"({len(pm['ranks'])} rank(s), {n_events} ring events)")
 
 
 if __name__ == "__main__":

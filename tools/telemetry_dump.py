@@ -106,9 +106,41 @@ def main(argv=None) -> int:
                     help="append an SLO burn-rate summary (default "
                          "gateway TTFT/TPOT objectives, polled over the "
                          "live registry) as JSON after the dump")
+    ap.add_argument("--fleet", metavar="DIR", default=None,
+                    help="merge the per-rank telemetry shards under DIR "
+                         "(written when PADDLE_TELEMETRY_DIR is set) "
+                         "into one fleet view: counters summed, "
+                         "histograms merged, gauges per-rank, plus "
+                         "collective skew gauges and typed straggler/"
+                         "desync/missing-rank findings")
     args = ap.parse_args(argv)
 
     from paddle_tpu.observability import export as _export
+
+    if args.fleet:
+        if args.snapshot or args.slo or args.format == "chrome":
+            ap.error("--fleet renders rank shards; it composes only "
+                     "with --format prometheus/jsonl and --prefix")
+        import json
+        from paddle_tpu.observability.fleet import FleetAggregator
+        agg = FleetAggregator(args.fleet)
+        series = agg.fleet_series()
+        if args.prefix:
+            series = [s for s in series
+                      if s["name"].startswith(args.prefix)]
+        if args.format == "prometheus":
+            text = _export.render_prometheus(series=series)
+        else:
+            text = "".join(json.dumps(s) + "\n" for s in series)
+        text += (f"# fleet ranks {agg.ranks()}\n")
+        for f in agg.findings():
+            text += "# fleet finding " + json.dumps(f.to_dict()) + "\n"
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
 
     if args.format == "chrome":
         if args.snapshot:
@@ -152,6 +184,14 @@ def main(argv=None) -> int:
 
     if args.format == "prometheus":
         text = _export.render_prometheus(series=series)
+        if not args.snapshot:
+            # drops are silent in the series themselves; surface them
+            from paddle_tpu.observability import get_recorder
+            dropped = get_recorder().dropped
+            if dropped > 0:
+                text += (f"# trace.dropped_spans {dropped} "
+                         f"(capacity {get_recorder().capacity}; raise "
+                         f"PADDLE_TRACE_CAP or export more often)\n")
         if args.out:
             with open(args.out, "w") as f:
                 f.write(text)
