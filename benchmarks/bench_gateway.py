@@ -14,6 +14,15 @@ Same JSON contract as bench.py: ONE stdout line
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": ...}
 vs_baseline stays 0.0 — the reference publishes no gateway figure to
 normalize against (BASELINE.md).
+
+A second, SHARED-PREFIX workload (K system prompts × N tenants × M
+requests, seeded) drives paged replicas with the radix prefix cache +
+KV-aware affinity routing on vs off, measuring prefix hit-rate and
+steady-state TTFT (the cache-warming cold prefills run before the
+measured window, like the compile warm-up above). Its bench line lands
+in ``BENCH_GATEWAY_r<NN>.json`` at the repo root — the gateway lane of
+``tools/bench_guard.py``'s trajectory gate, separate from the train
+lane by filename prefix.
 """
 import json
 import os
@@ -55,6 +64,160 @@ def _drive(gw, rng, vocab, ctx, n_requests, new_toks):
     dt = time.perf_counter() - t0
     s = gw.stats()
     return s["delivered_tokens"] / dt, s
+
+
+def _build_paged_gateway(model, n_replicas, max_batch, s_max, n_pages,
+                         block_size, compile, prefix_cache):
+    from paddle_tpu.inference.gateway import Gateway
+    from paddle_tpu.inference.serving import PagedContinuousBatcher
+    gw = Gateway(policy="affinity")
+    for i in range(n_replicas):
+        gw.add_replica(f"r{i}", PagedContinuousBatcher(
+            model, max_batch=max_batch, s_max=s_max,
+            block_size=block_size, n_pages=n_pages, compile=compile,
+            policy="ondemand", prefix_cache=prefix_cache,
+            prompt_buckets="pow2"))
+    return gw
+
+
+def _shared_prefix_prompts(rng, vocab, n_sys, sys_len, n_requests,
+                           tail_lo, tail_hi):
+    """Deterministic shared-prefix workload: each request is one of
+    ``n_sys`` system prompts plus a per-request tail (round-robin over
+    the system prompts, so every one stays warm)."""
+    sys_prompts = [rng.randint(0, vocab, (sys_len,))
+                   for _ in range(n_sys)]
+    prompts = []
+    for i in range(n_requests):
+        tail = rng.randint(0, vocab,
+                           (int(rng.randint(tail_lo, tail_hi)),))
+        prompts.append(np.concatenate([sys_prompts[i % n_sys], tail]))
+    return sys_prompts, prompts
+
+
+def _cache_totals(gw):
+    hit = miss = 0
+    for rep in gw.pool.replicas():
+        c = getattr(rep.batcher, "prefix_cache", None)
+        if c is not None:
+            hit += c.hit_tokens
+            miss += c.miss_tokens
+    return hit, miss
+
+
+def _drive_prompts(gw, prompts, new_toks, max_steps=200000):
+    """Submit ``prompts``, drive to completion, and harvest per-request
+    TTFT from the gateway's own request records BEFORE popping them —
+    registry histograms are process-cumulative, so an on-vs-off
+    comparison inside one process must not read them."""
+    t0 = time.perf_counter()
+    gids = [gw.submit(p, new_toks, tenant=f"t{i % 4}")
+            for i, p in enumerate(prompts)]
+    for _ in range(max_steps):
+        gw.step()
+        if not gw._has_work():
+            break
+    dt = time.perf_counter() - t0
+    ttfts, toks = [], 0
+    for g in gids:
+        req = gw._finished[g]
+        ttfts.append(req.first_token_t - req.submit_t)
+        toks += len(req.delivered)
+        gw.pop_result(g)
+    return toks / dt, ttfts
+
+
+def _p99(xs):
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def _shared_prefix_bench(model, vocab, on_tpu, compile):
+    """Prefix cache on vs off over the same seeded workload; returns the
+    gateway-lane detail dict. Sized so the CPU proxy finishes fast."""
+    if on_tpu:
+        n_sys, sys_len, n_req, tails = 4, 128, 24, (16, 48)
+        max_batch, s_max, n_pages, bs, new_toks = 4, 512, 160, 16, 12
+    else:
+        n_sys, sys_len, n_req, tails = 3, 96, 18, (8, 24)
+        max_batch, s_max, n_pages, bs, new_toks = 4, 192, 96, 16, 6
+    out = {"system_prompts": n_sys, "system_len": sys_len,
+           "requests": n_req, "new_tokens": new_toks}
+    runs = {}
+    for label, cache_on in (("on", True), ("off", False)):
+        rng = np.random.RandomState(7)   # identical workload both runs
+        sys_prompts, prompts = _shared_prefix_prompts(
+            rng, vocab, n_sys, sys_len, n_req, *tails)
+        gw = _build_paged_gateway(model, 2, max_batch, s_max, n_pages,
+                                  bs, compile, cache_on)
+        # warm phase: compile warm-up + the K cold system-prompt
+        # prefills (cache population) stay OUT of the measured window.
+        # Tails span the pow2 suffix rungs so the cache-on path's
+        # NARROW suffix-prefill executables (dec_base append mode at
+        # widths bucket(tail)) are compiled before measurement, same as
+        # the cache-off path's full-width prefill.
+        warm_tails = (tails[0], (tails[0] + tails[1]) // 2, tails[1])
+        for sp in sys_prompts:
+            for wt in warm_tails:
+                gw.submit(np.concatenate(
+                    [sp, rng.randint(0, vocab, (wt,))]), 4,
+                    tenant="warmup")
+        gw.run_until_done()
+        hit0, miss0 = _cache_totals(gw)
+        rate, ttfts = _drive_prompts(gw, prompts, new_toks)
+        hit1, miss1 = _cache_totals(gw)
+        runs[label] = {"rate": rate, "ttfts": ttfts,
+                       "hit": hit1 - hit0, "miss": miss1 - miss0}
+        for rep in gw.pool.replicas():
+            rep.batcher.audit_pages()   # pages_leaked must stay 0
+    hit, miss = runs["on"]["hit"], runs["on"]["miss"]
+    out["prefix_hit_rate"] = round(hit / max(hit + miss, 1), 4)
+    out["ttft_p99_ms_cache_on"] = round(_p99(runs["on"]["ttfts"]) * 1e3, 3)
+    out["ttft_p99_ms_cache_off"] = round(_p99(runs["off"]["ttfts"]) * 1e3, 3)
+    out["ttft_p99_improvement"] = round(
+        1.0 - _p99(runs["on"]["ttfts"]) / max(_p99(runs["off"]["ttfts"]),
+                                              1e-9), 4)
+    out["shared_tokens_per_s_cache_on"] = round(runs["on"]["rate"], 2)
+    out["shared_tokens_per_s_cache_off"] = round(runs["off"]["rate"], 2)
+
+    # control: NO shared prefix — the cache must not tax the miss path
+    ctl = {}
+    for label, cache_on in (("on", True), ("off", False)):
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, vocab,
+                               (sys_len + int(rng.randint(*tails)),))
+                   for _ in range(n_req)]
+        gw = _build_paged_gateway(model, 2, max_batch, s_max, n_pages,
+                                  bs, compile, cache_on)
+        gw.submit(rng.randint(0, vocab, (sys_len,)), 4, tenant="warmup")
+        gw.run_until_done()
+        rate, _ = _drive_prompts(gw, prompts, new_toks)
+        ctl[label] = round(rate, 2)
+    out["no_shared_tokens_per_s_cache_on"] = ctl["on"]
+    out["no_shared_tokens_per_s_cache_off"] = ctl["off"]
+    return out
+
+
+def _gateway_round_path():
+    """Next BENCH_GATEWAY_r<NN>.json slot: continue the gateway lane if
+    it exists, else start it at the train lane's current round so the
+    two trajectories roughly align."""
+    import glob
+    import re
+    rounds = []
+    for pat, rx in (("BENCH_GATEWAY_r*.json",
+                     r"BENCH_GATEWAY_r(\d+)\.json$"),):
+        for p in glob.glob(os.path.join(_REPO_DIR, pat)):
+            m = re.search(rx, os.path.basename(p))
+            if m:
+                rounds.append(int(m.group(1)))
+    if not rounds:
+        for p in glob.glob(os.path.join(_REPO_DIR, "BENCH_r*.json")):
+            m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+            if m:
+                rounds.append(int(m.group(1)) - 1)
+    n = (max(rounds) + 1) if rounds else 0
+    return os.path.join(_REPO_DIR, f"BENCH_GATEWAY_r{n:02d}.json")
 
 
 def main():
@@ -108,6 +271,24 @@ def main():
                                          else round(v * 1e3, 3))
     detail["completions"] = headline_stats["completions"]
     detail["requeued"] = headline_stats["requeued"]
+
+    with paddle.no_grad():
+        shared = _shared_prefix_bench(model, cfg.vocab_size, on_tpu,
+                                      compile)
+    detail["shared_prefix"] = shared
+    gw_line = {
+        "metric": "gateway_shared_prefix_tokens_per_sec",
+        "value": shared["shared_tokens_per_s_cache_on"],
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "detail": dict(shared, tpu=on_tpu),
+    }
+    try:
+        with open(_gateway_round_path(), "w") as f:
+            json.dump(gw_line, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass  # artifact write must never sink the bench number
     if on_tpu:
         detail["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                               time.gmtime())

@@ -119,6 +119,10 @@ class _GatewayStats:
             "gateway.inflight", "requests placed on replicas right now")
         self.ttft_h = reg.histogram(
             "gateway.ttft_seconds", "gateway submit to first token")
+        self.ttft_rung_h = reg.histogram(
+            "gateway.ttft_seconds_by_rung",
+            "gateway submit to first token, by resolved prompt rung",
+            labelnames=("rung",))
         self.tpot_h = reg.histogram(
             "gateway.tpot_seconds", "per-token latency after the first")
         self.reset()
@@ -459,7 +463,13 @@ class Gateway:
         now = _time.perf_counter()
         if req.first_token_t is None and toks:
             req.first_token_t = now
-            self._tele.ttft_h.observe(now - req.submit_t)
+            ttft = now - req.submit_t
+            self._tele.ttft_h.observe(ttft)
+            if req.bucket is not None:
+                # rung-labeled twin (the unlabeled series stays — slo.py
+                # and the benches consume it by exact name)
+                self._tele.ttft_rung_h.labels(
+                    rung=str(req.bucket)).observe(ttft)
         req.delivered.extend(toks)
         self._tele.tokens += len(toks)
         self._tele.tokens_c.inc(len(toks))

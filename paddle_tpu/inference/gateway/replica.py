@@ -61,6 +61,16 @@ class Replica:
         # affinity policy's proxy for "compile cache is warm here"
         self.warm_buckets: Set[int] = set()
 
+    # -- the KV-aware routing surface -----------------------------------------
+    def prefix_summary(self) -> Optional[Dict[str, object]]:
+        """Hashed radix-tree advertisement for KV-aware routing
+        (``{"block_size": B, "hashes": {chain_hash: depth}}``), or None
+        when the batcher runs without a prefix cache. In a multi-process
+        deployment this is the payload a replica would gossip to the
+        gateway; in-process the router just reads it live."""
+        cache = getattr(self.batcher, "prefix_cache", None)
+        return cache.summary() if cache is not None else None
+
     # -- load/capacity the router reads --------------------------------------
     @property
     def load(self) -> int:

@@ -5,18 +5,22 @@ Placement policies pick a replica for each dispatchable request:
   * ``least_loaded``      — fewest in-flight requests (queued + active);
     the goodput-oriented default (DistServe/Splitwise-style placement
     degenerates to this when every replica runs the same phase mix).
-  * ``affinity``          — session stickiness first (follow-up turns
-    land on the replica holding the warm KV/compile state), then
-    prompt-BUCKET warmth (a replica that already compiled this
-    ``perf.buckets`` prefill rung is preferred — route to the warm
-    executable, not a cold one), falling back to least-loaded.
+  * ``affinity``          — KV-aware placement first (the replica whose
+    radix prefix cache advertises the deepest cached prefix of this
+    prompt wins — shared-prefix prefill becomes a page lookup there),
+    then session stickiness (follow-up turns land on the replica
+    holding the warm KV/compile state), then prompt-BUCKET warmth (a
+    replica that already compiled this ``perf.buckets`` prefill rung is
+    preferred — route to the warm executable, not a cold one), falling
+    back to least-loaded.
   * ``weighted_rr``       — smooth weighted round-robin over replica
     weights (heterogeneous pools: a 2x-capacity replica takes 2x the
     requests).
 
-Routing decisions are instrumented: ``gateway.route.affinity_hit`` when
-a session/bucket match carried the decision, ``gateway.route.fallback``
-when the affinity policy had to fall back.
+Routing decisions are instrumented: ``gateway.route.prefix_hit`` when a
+cached-prefix match carried the decision, ``gateway.route.affinity_hit``
+when a session/bucket match did, ``gateway.route.fallback`` when the
+affinity policy had to fall back.
 
 The dispatch queue is TWO-LEVEL (interactive=0 above batch=1) with an
 anti-starvation share: every ``low_share``-th dispatch serves the low
@@ -45,7 +49,10 @@ def _route_metrics():
                         "dispatches placed by session/bucket affinity"),
             reg.counter("gateway.route.fallback",
                         "affinity dispatches that fell back to "
-                        "least-loaded"))
+                        "least-loaded"),
+            reg.counter("gateway.route.prefix_hit",
+                        "dispatches placed on the replica advertising "
+                        "the deepest cached prompt prefix"))
 
 
 def _queue_wait_h():
@@ -99,14 +106,25 @@ class WeightedRoundRobinPolicy(RoutePolicy):
 
 
 class SessionAffinityPolicy(RoutePolicy):
-    """Session stickiness, then prompt-bucket warmth, then fallback.
+    """Cached-prefix depth, then session stickiness, then prompt-bucket
+    warmth, then fallback.
 
-    A follow-up turn (same ``session_id``) routes to the replica that
-    served the session before — its paged KV pages and compiled prefill
-    signatures for the conversation are warm. Requests without a sticky
-    session prefer a replica whose compile cache already holds the
-    prompt's ``perf.buckets`` rung (``Replica.warm_buckets``, recorded at
-    dispatch). Both count ``gateway.route.affinity_hit``; a miss counts
+    KV-aware placement comes FIRST: replicas running a radix prefix
+    cache advertise hashed chain summaries (``Replica.prefix_summary``),
+    and the policy computes the request prompt's own chain hashes
+    (``inference.prefix_cache.chain_hashes``) to find the replica that
+    already holds the deepest prefix of this prompt — landing there
+    turns the shared-system-prompt prefill into a page-table lookup,
+    which dominates any compile-cache warmth. Ties break by (load,
+    name); hits count ``gateway.route.prefix_hit``.
+
+    Then the classic tiers: a follow-up turn (same ``session_id``)
+    routes to the replica that served the session before — its paged KV
+    pages and compiled prefill signatures for the conversation are warm.
+    Requests without a sticky session prefer a replica whose compile
+    cache already holds the prompt's ``perf.buckets`` rung
+    (``Replica.warm_buckets``, recorded at dispatch). Both count
+    ``gateway.route.affinity_hit``; a miss counts
     ``gateway.route.fallback`` and defers to the fallback policy.
     """
 
@@ -116,8 +134,47 @@ class SessionAffinityPolicy(RoutePolicy):
         self.fallback = fallback or LeastLoadedPolicy()
         self._sessions: Dict[str, str] = {}     # session_id -> replica name
 
+    @staticmethod
+    def _prefix_tokens(req, summary, chains: Dict[int, List[int]]) -> int:
+        """Tokens of ``req.prompt`` already cached per ``summary``.
+        ``chains`` memoizes the prompt's chain hashes per block size so
+        an N-replica pool hashes the prompt once, not N times."""
+        bs = summary.get("block_size")
+        hashes = summary.get("hashes")
+        if not bs or not hashes:
+            return 0
+        chain = chains.get(bs)
+        if chain is None:
+            from ..prefix_cache import chain_hashes
+            prompt = getattr(req, "prompt", None)
+            chain = (chain_hashes(prompt, bs)
+                     if prompt is not None else [])
+            chains[bs] = chain
+        depth = 0
+        for h in chain:
+            # chained hashing: a depth-d node implies its whole ancestor
+            # chain, so the first miss ends the longest common prefix
+            if h not in hashes:
+                break
+            depth += 1
+        return depth * bs
+
     def select(self, req, candidates: Sequence):
-        hit_c, fb_c = _route_metrics()
+        hit_c, fb_c, px_c = _route_metrics()
+        chains: Dict[int, List[int]] = {}
+        best, best_tokens = None, 0
+        for r in candidates:
+            summary = getattr(r, "prefix_summary", lambda: None)()
+            if not summary:
+                continue
+            t = self._prefix_tokens(req, summary, chains)
+            if t > best_tokens or (t == best_tokens and t > 0 and
+                                   (r.load, r.name) <
+                                   (best.load, best.name)):
+                best, best_tokens = r, t
+        if best_tokens > 0:
+            px_c.inc()
+            return best
         by_name = {r.name: r for r in candidates}
         sid = getattr(req, "session_id", None)
         if sid is not None and self._sessions.get(sid) in by_name:

@@ -1,0 +1,274 @@
+"""Cross-request radix prefix index over the paged KV pool.
+
+SGLang's RadixAttention observation, applied to the PagedContinuousBatcher:
+a million-user workload shares a handful of system prompts, so the KV rows
+for those shared prefixes are recomputed on every admission unless someone
+remembers which physical pages already hold them. This module is that
+memory — a radix tree at BLOCK granularity (one node == one full
+``block_size``-token block == one physical page), host-side only:
+
+  * ``match(tokens)``   — longest cached prefix as a node path; admission
+    points the slot's block-table entries at those pages and prefills only
+    the suffix (``paged_prefill_into``'s ``dec_base`` append mode).
+  * ``pin``/``unpin``   — per-node refcounts. A page referenced by a live
+    slot is never evicted; release decrements and stamps LRU recency.
+  * ``insert``          — after prefill, the request's full prompt blocks
+    are adopted into the tree (page ownership moves from the slot to the
+    cache), so the NEXT request with this prefix hits.
+  * ``evict(n)``        — LRU eviction of unpinned LEAF nodes under page
+    pressure; returns the freed physical page ids to the batcher's pool.
+    Interior nodes are protected while any descendant lives (a child's
+    rows attend the whole prefix, so ancestors must stay resident).
+
+Only FULL blocks are cached: a partially-filled page is still being
+appended to by its owner and cannot be shared. Generated tokens are
+cacheable too — a preempted/failed-over request resumes with
+``prompt ⧺ generated`` as its admission ids, and re-matching those blocks
+is exactly what makes failover re-prefill cheap.
+
+Routing support: every node carries a chain hash
+(``h_i = H(h_{i-1}, block_tokens)``); ``summary()`` exposes the hash set
+so gateway replicas can advertise WHAT they have cached without shipping
+token arrays, and ``chain_hashes()`` lets the router compute a request's
+chain once and find the deepest advertised match per replica. Hashes are
+a routing hint only — correctness never depends on them (the tree itself
+compares real token blocks).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["RadixPrefixCache", "chain_hashes"]
+
+_ROOT_HASH = 0
+
+
+def _block_hash(parent_hash: int, block: Tuple[int, ...]) -> int:
+    """Stable 64-bit chain hash of one block given its parent's hash."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(int(parent_hash).to_bytes(8, "little", signed=False))
+    h.update(np.asarray(block, np.int64).tobytes())
+    return int.from_bytes(h.digest(), "little")
+
+
+def chain_hashes(tokens, block_size: int) -> List[int]:
+    """Chain hashes of every FULL block prefix of ``tokens`` — the
+    request-side half of the replica prefix-summary protocol."""
+    toks = np.asarray(tokens, np.int64).reshape(-1)
+    out: List[int] = []
+    h = _ROOT_HASH
+    for i in range(len(toks) // block_size):
+        blk = tuple(int(t) for t in
+                    toks[i * block_size:(i + 1) * block_size])
+        h = _block_hash(h, blk)
+        out.append(h)
+    return out
+
+
+class _Node:
+    __slots__ = ("key", "page", "parent", "children", "ref", "last_use",
+                 "hash", "depth")
+
+    def __init__(self, key: Tuple[int, ...], page: int, parent, hash_: int,
+                 depth: int):
+        self.key = key              # the block's tokens
+        self.page = page            # physical pool row holding its KV
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.ref = 0                # live slots using this node
+        self.last_use = 0           # LRU stamp (monotonic tick)
+        self.hash = hash_
+        self.depth = depth          # blocks from root (root excluded)
+
+    def __repr__(self):            # pragma: no cover - debug aid
+        return (f"_Node(depth={self.depth}, page={self.page}, "
+                f"ref={self.ref}, kids={len(self.children)})")
+
+
+class RadixPrefixCache:
+    """Block-granular radix tree mapping token-block chains to pages."""
+
+    def __init__(self, block_size: int):
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.block_size = block_size
+        self._root = _Node((), -1, None, _ROOT_HASH, 0)
+        self._tick = 0
+        self._nodes = 0
+        # cumulative counters (the batcher mirrors them into serving.*)
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self.evictions = 0
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _touch(self, node: _Node):
+        self._tick += 1
+        node.last_use = self._tick
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    @property
+    def cached_pages(self) -> int:
+        return self._nodes
+
+    def pages(self) -> List[int]:
+        """Every physical page the cache owns (the audit surface)."""
+        out: List[int] = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            out.append(n.page)
+            stack.extend(n.children.values())
+        return out
+
+    def evictable_pages(self) -> int:
+        """Pages evict() could free right now: nodes whose SUBTREE holds
+        no pinned node (an unpinned chain frees bottom-up)."""
+        def free_below(n: _Node) -> int:
+            total = 0
+            for c in n.children.values():
+                sub = free_below(c)
+                if sub < 0 or c.ref > 0:
+                    return -1 if n is not self._root else total
+                total += sub + 1
+            return total
+        # count subtrees that are entirely unpinned
+        total = 0
+        for c in self._root.children.values():
+            sub = self._count_unpinned(c)
+            total += sub
+        return total
+
+    def _count_unpinned(self, n: _Node) -> int:
+        """Nodes in n's subtree removable by repeated unpinned-leaf
+        eviction: the node itself counts only if it and everything below
+        it is unpinned (a pinned descendant protects the whole chain)."""
+        total = 0
+        all_free = n.ref == 0
+        for c in n.children.values():
+            sub = self._count_unpinned(c)
+            total += sub
+            if c.ref > 0 or sub < self._subtree_size(c):
+                all_free = False
+        return total + (1 if all_free else 0)
+
+    def _subtree_size(self, n: _Node) -> int:
+        return 1 + sum(self._subtree_size(c) for c in n.children.values())
+
+    # -- the serving hot path ------------------------------------------------
+    def _blocks(self, tokens) -> List[Tuple[int, ...]]:
+        toks = np.asarray(tokens, np.int64).reshape(-1)
+        return [tuple(int(t) for t in
+                      toks[i * self.block_size:(i + 1) * self.block_size])
+                for i in range(len(toks) // self.block_size)]
+
+    def match(self, tokens, max_blocks: Optional[int] = None) -> List[_Node]:
+        """Longest cached prefix of ``tokens`` as the node path (root
+        excluded), capped at ``max_blocks``. Does NOT pin — the caller
+        pins the path it actually uses."""
+        path: List[_Node] = []
+        node = self._root
+        for blk in self._blocks(tokens):
+            if max_blocks is not None and len(path) >= max_blocks:
+                break
+            child = node.children.get(blk)
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        return path
+
+    def pin(self, nodes: Iterable[_Node]):
+        for n in nodes:
+            n.ref += 1
+            self._touch(n)
+
+    def unpin(self, nodes: Iterable[_Node]):
+        for n in nodes:
+            if n.ref <= 0:
+                raise RuntimeError(
+                    "prefix-cache refcount underflow: unpin of an "
+                    "already-free node (double release)")
+            n.ref -= 1
+            self._touch(n)
+
+    def insert(self, tokens, pages: Sequence[int],
+               start_block: int, n_blocks: int) -> List[_Node]:
+        """Adopt blocks [start_block, n_blocks) of ``tokens`` into the
+        tree. ``pages[i]`` is the physical page holding block i's rows
+        (the slot's block-table row). New nodes take ownership of their
+        page and start pinned (ref=1, held by the inserting slot); blocks
+        already present are SKIPPED — the slot keeps its private copy and
+        the tree keeps its own page (neither is pinned here). Returns the
+        newly created (adopted) nodes."""
+        blocks = self._blocks(tokens)[:n_blocks]
+        node = self._root
+        created: List[_Node] = []
+        for i, blk in enumerate(blocks):
+            child = node.children.get(blk)
+            if child is None:
+                if i < start_block:
+                    # the caller said blocks < start_block are already in
+                    # the tree (its matched path); a hole here means the
+                    # match and insert disagree about tree state
+                    raise RuntimeError(
+                        "prefix-cache insert: matched prefix missing "
+                        "from the tree (match/insert raced?)")
+                child = _Node(blk, int(pages[i]), node,
+                              _block_hash(node.hash, blk), i + 1)
+                child.ref = 1
+                node.children[blk] = child
+                self._nodes += 1
+                created.append(child)
+            self._touch(child)
+            node = child
+        return created
+
+    def evict(self, n_pages: int) -> List[int]:
+        """Free up to ``n_pages`` pages by removing LRU unpinned leaves
+        (bottom-up, so an idle chain frees deepest-first). Returns the
+        freed physical page ids."""
+        freed: List[int] = []
+        while len(freed) < n_pages:
+            victim = self._lru_unpinned_leaf()
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            self._nodes -= 1
+            self.evictions += 1
+            freed.append(victim.page)
+        return freed
+
+    def _lru_unpinned_leaf(self) -> Optional[_Node]:
+        best: Optional[_Node] = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if not n.children and n.ref == 0:
+                if best is None or n.last_use < best.last_use:
+                    best = n
+            stack.extend(n.children.values())
+        return best
+
+    # -- the routing surface -------------------------------------------------
+    def summary(self) -> Dict[str, object]:
+        """Hashed prefix advertisement for the gateway router:
+        ``{"block_size": B, "hashes": {chain_hash: depth_blocks}}``."""
+        hashes: Dict[int, int] = {}
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            hashes[n.hash] = n.depth
+            stack.extend(n.children.values())
+        return {"block_size": self.block_size, "hashes": hashes}
+
+    def stats(self) -> Dict[str, int]:
+        return {"nodes": self._nodes,
+                "cached_pages": self._nodes,
+                "hit_tokens": self.hit_tokens,
+                "miss_tokens": self.miss_tokens,
+                "evictions": self.evictions}

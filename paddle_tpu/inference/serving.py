@@ -588,11 +588,16 @@ class ContinuousBatcher(_BatcherBase):
             if self._prompt_ladder is not None:
                 bucket = self._prompt_ladder.bucket(n)
                 if bucket != n:
+                    # labeled by resolved rung so telemetry_dump can
+                    # attribute waste per bucket without re-deriving the
+                    # ladder
                     from ..observability.metrics import get_registry
                     get_registry().counter(
                         "serving.bucket_pad_waste",
                         "pad tokens admission added to reach the prompt "
-                        "bucket").inc(bucket - n)
+                        "bucket",
+                        labelnames=("rung",)).labels(
+                            rung=str(bucket)).inc(bucket - n)
                     prompt = np.concatenate(
                         [prompt, np.zeros(bucket - n, prompt.dtype)])
                 n_valid = paddle.to_tensor(np.full((1, 1), n, np.int32))
@@ -713,11 +718,49 @@ class PagedContinuousBatcher(_BatcherBase):
                  seed: Optional[int] = None,
                  decode_block: Optional[int] = None,
                  max_queue_depth: Optional[int] = None,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 prefix_cache: bool = False,
+                 prompt_buckets=None,
+                 draft_model=None, draft_k: int = 4):
         import paddle_tpu as paddle
 
         if policy not in ("reserve", "ondemand"):
             raise ValueError(f"unknown policy {policy!r}")
+        if prefix_cache and cache_quant:
+            raise ValueError(
+                "prefix_cache shares pages across requests; dynamic "
+                "cachekv quant scales are per-request, so a shared page "
+                "would replay with the wrong scales — use static "
+                "calibration or disable one")
+        if prefix_cache and fused_admission:
+            raise ValueError(
+                "prefix_cache is not supported with fused_admission "
+                "(the fused chunk streams the FULL prompt at a fixed "
+                "offset grid; a cached-prefix suffix start would need a "
+                "second executable per offset)")
+        if draft_model is not None:
+            if do_sample:
+                raise ValueError("speculative decoding is greedy-only "
+                                 "(draft_model requires do_sample=False)")
+            if decode_block:
+                raise ValueError("draft_model and decode_block are both "
+                                 "decode-dispatch amortizers; pick one")
+            if fused_admission:
+                raise ValueError("draft_model is not supported with "
+                                 "fused_admission")
+            if cache_quant:
+                raise ValueError("draft_model is not supported with "
+                                 "dynamic cachekv quant")
+            if prefill_chunk:
+                raise ValueError("draft_model is not supported with "
+                                 "prefill_chunk (the draft pool would "
+                                 "need its own chunk executables)")
+            if draft_k < 1:
+                raise ValueError("draft_k must be >= 1")
+            if draft_model.config.vocab_size != model.config.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.config.vocab_size} != "
+                    f"target vocab {model.config.vocab_size}")
         if decode_block is not None:
             if decode_block < 2:
                 raise ValueError("decode_block must be >= 2 (1 is the "
@@ -787,6 +830,37 @@ class PagedContinuousBatcher(_BatcherBase):
         self._admit_order: List[int] = []           # slots, oldest first
         self._last_tok = np.zeros((max_batch,), np.int64)
 
+        # cross-request radix prefix reuse (SGLang RadixAttention shape):
+        # admission matches the longest cached FULL-block prefix, points
+        # the slot's block-table front at the cached pages, and prefills
+        # only the suffix; the tree pins pages under live slots and
+        # LRU-evicts unpinned chains back into the free list on pressure
+        self.prefix_cache = None
+        self._slot_nodes: Dict[int, list] = {}
+        if prefix_cache:
+            from .prefix_cache import RadixPrefixCache
+            self.prefix_cache = RadixPrefixCache(block_size)
+        # optional admission ladder: the suffix prefill pads up shared
+        # rungs (O(#buckets) prefill signatures, same lever as the dense
+        # batcher's prompt_buckets); None keeps exact-length prefill
+        from ..perf.buckets import resolve_ladder
+        self._prompt_ladder = resolve_ladder(prompt_buckets, hi=s_max)
+        from ..observability.metrics import get_registry as _get_reg
+        _reg = _get_reg()
+        self._prefix_hit_c = _reg.counter(
+            "serving.prefix_hit_tokens",
+            "prompt tokens served from the radix prefix cache")
+        self._prefix_miss_c = _reg.counter(
+            "serving.prefix_miss_tokens",
+            "prompt tokens actually prefilled (no cached prefix)")
+        self._prefix_evict_c = _reg.counter(
+            "serving.prefix_evictions",
+            "prefix-cache pages LRU-evicted under page pressure")
+        self._pages_leaked_g = _reg.gauge(
+            "serving.pages_leaked",
+            "pages unaccounted for by free-list + block tables + prefix "
+            "cache (an OOM-much-later bug if ever nonzero)")
+
         self.cache_quant = cache_quant
         pool = model.paged_alloc(
             n_pages + 1, block_size,
@@ -814,6 +888,61 @@ class PagedContinuousBatcher(_BatcherBase):
                 for _ in range(cfg.num_hidden_layers)]
             self._state["cache_scales"] = None  # filled by _sync_tables
             self._scales_dirty = True
+
+        # in-batcher speculative decoding (the _speculative_loop recipe,
+        # batched): the DRAFT pool mirrors the target pool's geometry and
+        # SHARES self._bt, so one block table names both models' pages.
+        # Per round: batched draft catch-up append (ends at each slot's
+        # pending token -> proposal 1), k-1 draft decode steps, then ONE
+        # target verify pass scoring pending + all k proposals; accept
+        # the longest matching prefix + the target's correction. Output
+        # is the target's greedy sequence token for token — the draft
+        # only ever changes HOW MANY tokens a dispatch yields.
+        self.draft_model = draft_model
+        self.draft_k = draft_k
+        self.spec_stats = {"rounds": 0, "proposed": 0, "matched": 0,
+                           "fallback_steps": 0}
+        if draft_model is not None:
+            self._check_window(draft_model.config, s_max)
+            dpool = draft_model.paged_alloc(n_pages + 1, block_size)
+            self._ddec = np.zeros((max_batch,), np.int32)
+            self._dstate = {
+                "layers": dpool,
+                "block_tables": paddle.to_tensor(self._bt),
+                "dec_lens": paddle.to_tensor(self._ddec),
+                "block_size": block_size,
+                "capacity": self.blocks_per_seq * block_size,
+                "zeros_b": self._state["zeros_b"],
+                "ones_b": self._state["ones_b"],
+                "cu_b": self._state["cu_b"],
+            }
+
+            def _verify_body(ids, layers, bt, dec):
+                return model.paged_prefill_into(
+                    ids, layers, bt, block_size, dec_base=dec,
+                    logits_all=True)
+
+            def _catchup_body(ids, layers, bt, dec, at):
+                return draft_model.paged_prefill_into(
+                    ids, layers, bt, block_size, dec_base=dec,
+                    logits_at=at)
+            if compile:
+                from .. import jit
+                self._dstep_fn = jit.to_static(
+                    draft_model.paged_decode_step, donate_args=(1,))
+                self._verify_fn = jit.to_static(_verify_body,
+                                                donate_args=(1,))
+                self._catchup_fn = jit.to_static(_catchup_body,
+                                                 donate_args=(1,))
+            else:
+                self._dstep_fn = draft_model.paged_decode_step
+                self._verify_fn = _verify_body
+                self._catchup_fn = _catchup_body
+            # catch-up width varies per round (1-2 steady state, wide
+            # after fallback rounds); pad it up a pow2 ladder so the
+            # catch-up executable count stays O(log s_max)
+            from ..perf.buckets import BucketLadder
+            self._cu_ladder = BucketLadder.pow2(hi=s_max)
         self.prefill_chunk = prefill_chunk
         self.fused_admission = fused_admission
         self._admitting: Optional[dict] = None
@@ -909,13 +1038,20 @@ class PagedContinuousBatcher(_BatcherBase):
 
     def _alloc_pages_row(self, row: np.ndarray, upto_row: int) -> bool:
         """Grow a block-table row (a view into self._bt or a detached
-        admission row) so rows [0, upto_row) are backed. Returns False
-        (allocating nothing) if the pool can't cover it."""
+        admission row) so rows [0, upto_row) are backed. A dry free list
+        LRU-evicts unpinned prefix-cache chains first (cached-but-idle
+        pages are reclaimable capacity, not occupancy). Returns False
+        (allocating nothing) if even that can't cover it."""
         need_blocks = self._pages_for(upto_row)
         have = int(np.sum(row != self._scratch))
         grow = need_blocks - have
         if grow <= 0:
             return True
+        if grow > len(self._free_pages) and self.prefix_cache is not None:
+            freed = self.prefix_cache.evict(grow - len(self._free_pages))
+            if freed:
+                self._free_pages.extend(freed)
+                self._prefix_evict_c.inc(len(freed))
         if grow > len(self._free_pages):
             return False
         for b in range(have, need_blocks):
@@ -925,15 +1061,35 @@ class PagedContinuousBatcher(_BatcherBase):
     def _alloc_pages(self, slot: int, upto_row: int) -> bool:
         return self._alloc_pages_row(self._bt[slot], upto_row)
 
-    def _release_row(self, row: np.ndarray):
+    def _available_pages(self) -> int:
+        """Pages an allocation could obtain right now: the free list plus
+        whatever the prefix cache would surrender to eviction."""
+        n = len(self._free_pages)
+        if self.prefix_cache is not None:
+            n += self.prefix_cache.evictable_pages()
+        return n
+
+    def _release_row(self, row: np.ndarray, keep=()):
+        """Reset a block-table row to scratch, returning its pages to the
+        free list — except ``keep`` (pages the prefix cache owns: the
+        cache's refcounts, not this row, decide their lifetime)."""
         for b in range(self.blocks_per_seq):
             if row[b] != self._scratch:
-                self._free_pages.append(int(row[b]))
+                if int(row[b]) not in keep:
+                    self._free_pages.append(int(row[b]))
                 row[b] = self._scratch
 
     def _release_slot(self, slot: int):
-        self._release_row(self._bt[slot])
+        keep = ()
+        if self.prefix_cache is not None:
+            nodes = self._slot_nodes.pop(slot, None)
+            if nodes:
+                self.prefix_cache.unpin(nodes)
+                keep = {n.page for n in nodes}
+        self._release_row(self._bt[slot], keep)
         self._dec[slot] = 0
+        if self.draft_model is not None:
+            self._ddec[slot] = 0
         if self.cache_quant:
             for layer in self._scales_np:
                 for k in layer:
@@ -941,6 +1097,46 @@ class PagedContinuousBatcher(_BatcherBase):
             self._scales_dirty = True
         self._free_slots.append(slot)
         self._admit_order.remove(slot)
+        self.audit_pages()
+
+    def audit_pages(self) -> int:
+        """Set-reconcile the page pool after every release: free list ∪
+        block-table rows ∪ prefix-cache pages must cover range(n_pages)
+        exactly once (block-table ∩ cache overlap is the POINT — shared
+        prefixes — but free ∩ anything is a double-free). Publishes
+        ``serving.pages_leaked`` and raises on any anomaly, so a leak
+        fails the releasing operation instead of surfacing as OOM much
+        later. Returns the leak count (always 0 on the non-raising
+        path)."""
+        free_set = set(self._free_pages)
+        used = set()
+        for slot in range(self.max_batch):
+            for b in self._bt[slot]:
+                if b != self._scratch:
+                    used.add(int(b))
+        adm = self._admitting
+        if adm is not None:
+            for b in adm["row"]:
+                if b != self._scratch:
+                    used.add(int(b))
+        cache_pages = set()
+        if self.prefix_cache is not None:
+            cp = self.prefix_cache.pages()
+            cache_pages = set(cp)
+            if len(cache_pages) != len(cp):
+                raise RuntimeError("page accounting bug: prefix cache "
+                                   "holds a page in two nodes")
+        leaked = set(range(self.n_pages)) - free_set - used - cache_pages
+        self._pages_leaked_g.set(len(leaked))
+        if len(free_set) != len(self._free_pages):
+            raise RuntimeError("page accounting bug: free list holds a "
+                               "page twice")
+        double = free_set & (used | cache_pages)
+        if leaked or double:
+            raise RuntimeError(
+                f"page accounting bug: leaked={sorted(leaked)} "
+                f"free-but-used={sorted(double)}")
+        return 0
 
     @property
     def free_page_count(self) -> int:
@@ -957,6 +1153,11 @@ class PagedContinuousBatcher(_BatcherBase):
             worst = max(worst, min(
                 -(-worst // self.prefill_chunk) * self.prefill_chunk,
                 self.blocks_per_seq * self.block_size))
+        elif self._prompt_ladder is not None:
+            # same hazard as chunk padding: the ladder can round a
+            # resume-length prompt past the timeline
+            worst = max(worst, min(self._prompt_ladder.bucket(worst),
+                                   self.blocks_per_seq * self.block_size))
         pages = self._pages_for(worst)
         if pages > self.n_pages:
             raise ValueError(f"request needs {pages} pages but the pool "
@@ -973,21 +1174,46 @@ class PagedContinuousBatcher(_BatcherBase):
             req = self._pending[0]
             # a preempted request resumes from prompt ⧺ generated; chunked
             # prefill pads to the chunk width (capacity-clamped)
-            ids_np, L, _padded, upto = self._admission_plan(req)
-            need = self._pages_for(upto)
-            if need > len(self._free_pages):
+            ids_full = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int64)]) \
+                if req.tokens else req.prompt
+            matched = []
+            if self.prefix_cache is not None:
+                # cap at (L-1)//bs blocks: at least one suffix token must
+                # prefill — the first generated token needs logits, and a
+                # fully-cached prompt has none to offer
+                matched = self.prefix_cache.match(
+                    ids_full,
+                    max_blocks=(len(ids_full) - 1) // self.block_size)
+                if matched:
+                    # pin BEFORE the availability gate: the gate may
+                    # admit on the promise of evicting OTHER chains, and
+                    # eviction must not be able to take these pages
+                    self.prefix_cache.pin(matched)
+            m_rows = len(matched) * self.block_size
+            ids_np, L, padded_len, upto = self._admission_plan(req, m_rows)
+            need = self._pages_for(upto) - len(matched)
+            if need > len(self._free_pages) + (
+                    self.prefix_cache.evictable_pages()
+                    if self.prefix_cache is not None else 0):
+                if matched:
+                    self.prefix_cache.unpin(matched)
                 break
             self._pending.pop(0)
             slot = self._free_slots.pop(0)
+            if matched:
+                self._bt[slot, :len(matched)] = [n.page for n in matched]
             if not self._alloc_pages(slot, upto):
                 raise RuntimeError("page accounting bug: admission gate "
                                    "passed but allocation failed")
             self._trace_admit_begin(req)
             self._trace_prefill_begin(req)
             bt_row = paddle.to_tensor(self._bt[slot:slot + 1])
+            S = L - m_rows
             with paddle.no_grad():
                 if self.prefill_chunk:
-                    logits = self._prefill_chunked(ids_np, bt_row, slot)
+                    logits = self._prefill_chunked(ids_np[m_rows:], bt_row,
+                                                   slot, dec0=m_rows)
                 elif self.cache_quant:
                     ids = paddle.to_tensor(ids_np[None, :])
                     logits, self._state["layers"], seq_scales = \
@@ -995,14 +1221,60 @@ class PagedContinuousBatcher(_BatcherBase):
                             ids, self._state["layers"], bt_row,
                             self.block_size, dynamic_cache_scales=True)
                     self._store_slot_scales(slot, seq_scales)
+                elif m_rows or self._prompt_ladder is not None:
+                    # suffix prefill: append S real tokens after the
+                    # m_rows cached rows, padded up to the resolved rung
+                    # (pad rows sit past the timeline — stale until
+                    # decode overwrites them, never read before that)
+                    pad_s = padded_len - m_rows
+                    if pad_s != S:
+                        self._count_pad_waste(pad_s, pad_s - S)
+                    sfx = np.zeros((pad_s,), np.int64)
+                    sfx[:S] = ids_np[m_rows:]
+                    logits, self._state["layers"] = \
+                        self.model.paged_prefill_into(
+                            paddle.to_tensor(sfx[None, :]),
+                            self._state["layers"], bt_row,
+                            self.block_size,
+                            dec_base=paddle.to_tensor(
+                                np.array([m_rows], np.int32)),
+                            logits_at=paddle.to_tensor(
+                                np.array([S - 1], np.int32)))
                 else:
                     ids = paddle.to_tensor(ids_np[None, :])
                     logits, self._state["layers"] = \
                         self.model.paged_prefill_into(
                             ids, self._state["layers"], bt_row,
                             self.block_size)
+                if self.draft_model is not None:
+                    # mirror the suffix into the DRAFT pool (same block-
+                    # table row, its own physical pages); cached pages
+                    # already hold this prefix's draft rows — every page
+                    # enters the tree through an admission that wrote
+                    # both pools
+                    dfx = np.zeros((max(S, 1),), np.int64)
+                    dfx[:S] = ids_np[m_rows:]
+                    _dl, self._dstate["layers"] = \
+                        self.draft_model.paged_prefill_into(
+                            paddle.to_tensor(dfx[None, :]),
+                            self._dstate["layers"], bt_row,
+                            self.block_size,
+                            dec_base=paddle.to_tensor(
+                                np.array([m_rows], np.int32)),
+                            logits_at=paddle.to_tensor(
+                                np.array([0], np.int32)))
+                    self._ddec[slot] = L
+            if self.prefix_cache is not None:
+                self._prefix_hit_c.inc(m_rows)
+                self._prefix_miss_c.inc(S)
+                self.prefix_cache.hit_tokens += m_rows
+                self.prefix_cache.miss_tokens += S
+                new_nodes = self.prefix_cache.insert(
+                    ids_np, self._bt[slot], len(matched),
+                    L // self.block_size)
+                self._slot_nodes[slot] = list(matched) + new_nodes
             self._trace_prefill_end(req, prompt_tokens=len(ids_np),
-                                    pages=need)
+                                    pages=need, prefix_hit=m_rows)
             tok = int(self._pick(np.asarray(logits._data))[0])
             req.slot = slot
             req.tokens.append(tok)
@@ -1017,7 +1289,14 @@ class PagedContinuousBatcher(_BatcherBase):
                 finished.append(req.rid)
         return finished
 
-    def _prefill_chunked(self, ids_np, bt_row, slot):
+    def _count_pad_waste(self, rung: int, waste: int):
+        from ..observability.metrics import get_registry
+        get_registry().counter(
+            "serving.bucket_pad_waste",
+            "pad tokens admission added to reach the prompt bucket",
+            labelnames=("rung",)).labels(rung=str(rung)).inc(waste)
+
+    def _prefill_chunked(self, ids_np, bt_row, slot, dec0: int = 0):
         """Feed the prompt through fixed-width append chunks (ONE compiled
         executable for every prompt length). The tail chunk is zero-padded;
         pad rows land past the true timeline and are overwritten by decode
@@ -1034,12 +1313,17 @@ class PagedContinuousBatcher(_BatcherBase):
         exactly the unchunked dynamic path, token-for-token; longer
         prompts derive their scales from the first chunk's rows, the same
         first-window semantics the reference's serving stack uses when
-        scales must exist before the whole prompt has been seen."""
+        scales must exist before the whole prompt has been seen.
+
+        ``dec0``: cached-prefix offset — ``ids_np`` is the SUFFIX and the
+        chunks append after ``dec0`` existing rows (prefix-cache hits;
+        always 0 on the quantized path, which is gated off prefix reuse).
+        """
         import paddle_tpu as paddle
         C = self.prefill_chunk
         L = len(ids_np)
         cap = self.blocks_per_seq * self.block_size
-        padded_len = min(-(-L // C) * C, cap)
+        padded_len = min(-(-L // C) * C, cap - dec0)
         padded = np.zeros((padded_len,), np.int64)
         padded[:L] = ids_np
         dec = 0
@@ -1052,7 +1336,7 @@ class PagedContinuousBatcher(_BatcherBase):
             has_last = 0 <= (L - 1) - dec < w
             at = (L - 1) - dec if has_last else 0
             ids_t = paddle.to_tensor(padded[None, dec:dec + w])
-            dec_t = paddle.to_tensor(np.array([dec], np.int32))
+            dec_t = paddle.to_tensor(np.array([dec0 + dec], np.int32))
             at_t = paddle.to_tensor(np.array([at], np.int32))
             if not self.cache_quant:
                 lg, self._state["layers"] = self._chunk_fn(
@@ -1208,16 +1492,28 @@ class PagedContinuousBatcher(_BatcherBase):
     def _has_work(self) -> bool:
         return bool(self._pending or self._slot_req or self._admitting)
 
-    def _admission_plan(self, req: Request):
+    def _admission_plan(self, req: Request, m_rows: int = 0):
         """The ONE home of the resume-ids / chunk-padding / page-budget
-        arithmetic (used by synchronous admission and the fused path)."""
+        arithmetic (used by synchronous admission and the fused path).
+        ``m_rows`` is the cached-prefix row count: only the SUFFIX is
+        prefilled, so chunk/ladder padding applies to the suffix and is
+        clamped to the capacity left after the cached rows (pad rows past
+        capacity would clip-index the block table and corrupt the last
+        real page)."""
         ids_np = np.concatenate(
             [req.prompt, np.asarray(req.tokens, np.int64)]) \
             if req.tokens else req.prompt
         L = len(ids_np)
-        padded_len = (min(-(-L // self.prefill_chunk) * self.prefill_chunk,
-                          self.blocks_per_seq * self.block_size)
-                      if self.prefill_chunk else L)
+        S = L - m_rows
+        cap = self.blocks_per_seq * self.block_size
+        if self.prefill_chunk:
+            pad_s = min(-(-S // self.prefill_chunk) * self.prefill_chunk,
+                        cap - m_rows)
+        elif self._prompt_ladder is not None:
+            pad_s = min(self._prompt_ladder.bucket(S), cap - m_rows)
+        else:
+            pad_s = S
+        padded_len = m_rows + pad_s
         if self.policy == "reserve":
             upto = max(padded_len, L + req.max_new_tokens - len(req.tokens))
         else:
@@ -1262,6 +1558,7 @@ class PagedContinuousBatcher(_BatcherBase):
         self._admitting = None
         self._trace_close(adm["req"], preempted=1)
         self._tele.on_preempt()
+        self.audit_pages()
 
     def _fused_chunk_inputs(self):
         import paddle_tpu as paddle
@@ -1367,6 +1664,9 @@ class PagedContinuousBatcher(_BatcherBase):
         import paddle_tpu as paddle
         if not self._slot_req:
             return
+        if self.draft_model is not None \
+                and self._speculative_tail(finished):
+            return
         if self.decode_block and not self._pending \
                 and self._admitting is None \
                 and self._block_backed(self.decode_block):
@@ -1413,7 +1713,7 @@ class PagedContinuousBatcher(_BatcherBase):
             plan.append((slot, upto))
         if self.policy != "ondemand":
             return True                # reserve backed everything upfront
-        if need > len(self._free_pages):
+        if need > self._available_pages():
             return False
         for slot, upto in plan:
             if not self._alloc_pages(slot, upto):   # pragma: no cover
@@ -1456,6 +1756,138 @@ class PagedContinuousBatcher(_BatcherBase):
                 self._last_tok[slot] = tok
                 if self._maybe_finish(req, tok):
                     finished.append(req.rid)
+
+    # -- in-batcher speculative decoding ------------------------------------
+    def _sync_draft_tables(self):
+        import paddle_tpu as paddle
+        self._dstate["block_tables"] = paddle.to_tensor(self._bt)
+        self._dstate["dec_lens"] = paddle.to_tensor(self._ddec)
+        self._dstate["block_size"] = self.block_size
+        self._dstate["capacity"] = self.blocks_per_seq * self.block_size
+
+    @staticmethod
+    def _argmax_b(logits) -> np.ndarray:
+        return np.asarray(logits._data).argmax(-1)
+
+    def _speculative_tail(self, finished: List[int]) -> bool:
+        """One batched draft/verify round for every active slot; returns
+        False (nothing ran) when this round must fall back to the plain
+        per-step path, which keeps sole ownership of preemption policy.
+
+        Invariants (the _speculative_loop contract, per slot): the TARGET
+        pool holds rows for prompt + tokens[:-1] (``_dec``; tokens[-1] is
+        pending), the DRAFT pool holds correct rows for the first
+        ``_ddec`` positions. The round appends the draft's catch-up
+        (``seq[_ddec:]``, ending at the pending token — its last logits
+        are proposal 1), runs k-1 draft steps, then the target scores
+        pending + all k proposals in ONE verify pass; each slot accepts
+        its longest matching prefix plus the target's own correction, so
+        output is the target's greedy sequence token for token."""
+        import paddle_tpu as paddle
+        reqs = list(self._slot_req.items())
+        k = min(self.draft_k,
+                min(r.max_new_tokens - len(r.tokens)
+                    for _, r in reqs) - 1)
+        if k < 1:
+            # some slot has budget for exactly one token: a k-wide round
+            # would overshoot it, so take one plain step instead
+            self.spec_stats["fallback_steps"] += 1
+            return False
+        cap = self.blocks_per_seq * self.block_size
+        cus = {slot: int(self._dec[slot]) - int(self._ddec[slot]) + 1
+               for slot, _ in reqs}
+        W = self._cu_ladder.bucket(max(cus.values()))
+        for slot, _ in reqs:
+            # both pools write rows through dec+k; catch-up pad rows
+            # reach ddec+W-1 — past-capacity writes would clip-index the
+            # block table onto the last REAL page
+            if int(self._dec[slot]) + k + 1 > cap \
+                    or int(self._ddec[slot]) + W > cap:
+                self.spec_stats["fallback_steps"] += 1
+                return False
+        if self.policy == "ondemand":
+            # probe-then-alloc over ALL slots (the _block_backed rule): a
+            # declined round must not strand pages it already moved
+            plan = []
+            need = 0
+            for slot, _ in reqs:
+                upto = int(self._dec[slot]) + k + 1
+                have = int(np.sum(self._bt[slot] != self._scratch))
+                need += max(0, self._pages_for(upto) - have)
+                plan.append((slot, upto))
+            if need > self._available_pages():
+                self.spec_stats["fallback_steps"] += 1
+                return False
+            for slot, upto in plan:
+                if not self._alloc_pages(slot, upto):  # pragma: no cover
+                    raise RuntimeError("page accounting bug: speculative "
+                                       "probe passed but allocation "
+                                       "failed")
+        self._step_prologue()
+        t0 = _time.perf_counter()
+        B = self.max_batch
+        cu_ids = np.zeros((B, W), np.int64)
+        cu_at = np.zeros((B,), np.int32)
+        dbase = np.zeros((B,), np.int32)
+        for slot, req in reqs:
+            seq = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int64)])
+            lo = int(self._ddec[slot])
+            cu = seq[lo:]                       # ends at the pending token
+            cu_ids[slot, :len(cu)] = cu
+            cu_at[slot] = len(cu) - 1
+            dbase[slot] = lo
+        with paddle.no_grad():
+            self._sync_draft_tables()
+            dl, self._dstate["layers"] = self._catchup_fn(
+                paddle.to_tensor(cu_ids), self._dstate["layers"],
+                self._dstate["block_tables"], paddle.to_tensor(dbase),
+                paddle.to_tensor(cu_at))
+            props = [self._argmax_b(dl)]        # [B] proposal 1
+            for slot, _ in reqs:
+                self._ddec[slot] = int(self._dec[slot]) + 1
+            self._dstate["dec_lens"] = paddle.to_tensor(self._ddec)
+            tok = props[0]
+            for _ in range(k - 1):
+                dlg, self._dstate = self._dstep_fn(
+                    paddle.to_tensor(tok.astype(np.int64)), self._dstate)
+                tok = self._argmax_b(dlg)
+                props.append(tok)
+            ids_v = np.zeros((B, k + 1), np.int64)
+            for slot, _ in reqs:
+                ids_v[slot, 0] = self._last_tok[slot]
+                for i in range(k):
+                    ids_v[slot, 1 + i] = props[i][slot]
+            vlogits, self._state["layers"] = self._verify_fn(
+                paddle.to_tensor(ids_v), self._state["layers"],
+                self._state["block_tables"],
+                paddle.to_tensor(self._dec.copy()))
+        g = np.asarray(vlogits._data).argmax(-1)          # [B, k+1]
+        total = 0
+        for slot, req in reqs:
+            pv = [int(props[i][slot]) for i in range(k)]
+            j = 0
+            while j < k and pv[j] == int(g[slot, j]):
+                j += 1
+            acc = pv[:j] + [int(g[slot, j])]
+            self.spec_stats["proposed"] += k
+            self.spec_stats["matched"] += j
+            old_dec = int(self._dec[slot])
+            self._dec[slot] = old_dec + len(acc)
+            self._ddec[slot] = min(old_dec + k, old_dec + len(acc))
+            for t in acc:
+                if req.finished:
+                    break            # EOS mid-round: discard the rest
+                req.tokens.append(int(t))
+                self._tele.on_token(req)
+                self._last_tok[slot] = int(t)
+                total += 1
+                if self._maybe_finish(req, int(t)):
+                    finished.append(req.rid)
+        self.spec_stats["rounds"] += 1
+        self._tele.on_decode_time(_time.perf_counter() - t0,
+                                  tokens=total)
+        return True
 
     # -- the engine ---------------------------------------------------------
     def _step_impl(self) -> List[int]:

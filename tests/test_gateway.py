@@ -284,6 +284,101 @@ def test_gateway_replica_death_requeues_token_exact(lm):
     assert s["completions"] == 4 and s["failures"] == 0
 
 
+def test_affinity_policy_prefers_deepest_cached_prefix():
+    """KV-aware tier: the replica advertising the deepest chain-hash
+    match wins over session/bucket warmth and load order."""
+    from paddle_tpu.inference.gateway import SessionAffinityPolicy
+    from paddle_tpu.inference.prefix_cache import RadixPrefixCache
+    from paddle_tpu.observability.metrics import get_registry
+
+    class FakeRep:
+        def __init__(self, name, cache, load=0):
+            self.name, self._cache, self.load = name, cache, load
+            self.warm_buckets = set()
+
+        def prefix_summary(self):
+            return None if self._cache is None else self._cache.summary()
+
+    deep = RadixPrefixCache(4)
+    deep.insert(np.arange(8), [0, 1], 0, 2)         # 2 cached blocks
+    shallow = RadixPrefixCache(4)
+    shallow.insert(np.arange(4), [0], 0, 1)         # 1 cached block
+    reps = [FakeRep("a", shallow), FakeRep("b", deep, load=5),
+            FakeRep("c", None)]
+    pol = SessionAffinityPolicy()
+
+    class Req:
+        prompt = np.arange(12)
+        session_id = "sticky"
+        bucket = None
+    pol._sessions["sticky"] = "a"                   # stickiness says a…
+    px = get_registry().counter("gateway.route.prefix_hit", "t")
+    before = px.value
+    assert pol.select(Req(), reps).name == "b"      # …prefix depth wins
+    assert px.value - before == 1
+    # no cached prefix anywhere -> the classic tiers take over (session)
+    class Cold:
+        prompt = np.arange(100, 112)
+        session_id = "sticky"
+        bucket = None
+    assert pol.select(Cold(), reps).name == "a"
+
+
+def test_gateway_failover_with_speculation_reprefixes(lm):
+    """Round-13 drill: paged replicas with the radix prefix cache AND a
+    draft model attached; chaos kills one mid-decode. The lost/dup-token
+    guard must hold (token-exact results), the requeued requests must
+    re-match their cached prefix on the survivor, and no page may leak."""
+    from paddle_tpu.inference.serving import PagedContinuousBatcher
+    from paddle_tpu.models.gpt import GPT2ForCausalLM
+    from paddle_tpu.observability.metrics import get_registry
+
+    paddle.seed(42)
+    draft = GPT2ForCausalLM(lm.config)              # disagreeing draft
+    draft.eval()
+    rng = np.random.RandomState(21)
+    shared = rng.randint(0, 128, (16,)).astype(np.int64)   # 2 blocks of 8
+    prompts = [np.concatenate([shared, t])
+               for t in _prompts(22, (5, 7, 6, 9))]
+    refs = [_ref(lm, p, 16) for p in prompts]
+
+    def paged(seed_tag):
+        return PagedContinuousBatcher(
+            lm, max_batch=2, s_max=64, block_size=8, n_pages=32,
+            compile=False, prefix_cache=True, draft_model=draft,
+            draft_k=3)
+
+    gw = Gateway(policy="affinity")
+    gw.add_replica("r0", paged("r0"))
+    gw.add_replica("r1", paged("r1"))
+    gids = [gw.submit(p, 16) for p in prompts]
+    arm_scenario("seed=0; serving.step:transient_error:after=6,count=3")
+    dead = None
+    for _ in range(2000):
+        gw.step()
+        dead = next((r for r in gw.pool.replicas() if not r.alive), None)
+        if dead is not None:
+            break
+    assert dead is not None, "chaos never killed a replica"
+    survivor = next(r for r in gw.pool.replicas() if r.alive)
+    hits_before = survivor.batcher.prefix_cache.hit_tokens
+    for _ in range(2000):
+        if not gw._has_work():
+            break
+        gw.step()
+    s = gw.stats()
+    assert s["requeued"] > 0 and s["failures"] == 0
+    # zero lost/duplicated tokens: exact output through spec + failover
+    # (the gateway's accounting guard would have raised on divergence)
+    for g, ref in zip(gids, refs):
+        assert np.array_equal(gw.pop_result(g), ref)
+    # requeued requests re-matched the shared prefix on the survivor
+    assert survivor.batcher.prefix_cache.hit_tokens > hits_before
+    assert survivor.batcher.spec_stats["rounds"] > 0
+    survivor.batcher.audit_pages()
+    assert get_registry().gauge("serving.pages_leaked", "t").value == 0
+
+
 # -- streaming ----------------------------------------------------------------
 
 def test_gateway_streaming_delivery_and_backpressure(lm):

@@ -169,7 +169,10 @@ def test_serving_pad_waste_metric_counts():
                      num_attention_heads=4, max_position_embeddings=64)
     m = GPT2ForCausalLM(cfg)
     m.eval()
-    waste = get_registry().counter("serving.bucket_pad_waste", "test")
+    # rung-labeled since round 13: waste is attributable per resolved
+    # bucket without re-deriving the ladder
+    waste = get_registry().counter("serving.bucket_pad_waste", "test",
+                                   labelnames=("rung",)).labels(rung="8")
     before = waste.value
     bat = ContinuousBatcher(m, max_batch=2, s_max=32, compile=False)
     bat.submit(np.arange(1, 6), max_new_tokens=2)   # len 5 -> bucket 8: +3

@@ -36,13 +36,20 @@ DEFAULT_WINDOW = 4
 DEFAULT_RELAY_WINDOW = 4
 
 
-def discover(dirpath: str) -> List[dict]:
-    """Ordered bench records: ``BENCH_r*.json`` sorted by round number.
+def discover(dirpath: str, prefix: str = "BENCH_r") -> List[dict]:
+    """Ordered bench records: ``{prefix}*.json`` sorted by round number.
     Each returned dict is the PARSED bench line plus ``_round``/``_file``
-    bookkeeping; unusable rounds appear with ``_skip`` set (reason)."""
+    bookkeeping; unusable rounds appear with ``_skip`` set (reason).
+    The default prefix is the train lane; the gateway lane lives in
+    ``BENCH_GATEWAY_r*.json`` (bench_gateway.py writes it) and is pulled
+    in by ``run_check`` with its own prefix — the two globs are disjoint
+    so the relay gate (train-lane-only by construction) never sees
+    gateway rounds."""
     out: List[dict] = []
-    for path in sorted(glob.glob(os.path.join(dirpath, "BENCH_r*.json"))):
-        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    rx = re.compile(re.escape(prefix) + r"(\d+)\.json$")
+    for path in sorted(glob.glob(os.path.join(dirpath,
+                                              prefix + "*.json"))):
+        m = rx.search(os.path.basename(path))
         if not m:
             continue
         rnd = int(m.group(1))
@@ -88,7 +95,9 @@ def split_series(records: List[dict]) -> dict:
         if "_skip" in r:
             continue
         hw = "tpu" if r.get("detail", {}).get("tpu") else "cpu"
-        key = (r.get("metric", "unknown"), hw)
+        metric = r.get("metric", "unknown")
+        lane = r.get("_lane")
+        key = (f"{lane}:{metric}" if lane else metric, hw)
         series.setdefault(key, []).append(r)
     return series
 
@@ -139,11 +148,17 @@ def check_series(points: List[dict], tolerance: float,
 def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
               window: int = DEFAULT_WINDOW) -> dict:
     records = discover(dirpath)
+    gw_records = discover(dirpath, prefix="BENCH_GATEWAY_r")
+    for r in gw_records:
+        r["_lane"] = "gateway"
+    records = records + gw_records
     report = {
         "dir": dirpath,
         "tolerance": tolerance,
         "window": window,
-        "skipped": [{"round": r["_round"], "reason": r["_skip"]}
+        "skipped": [{"round": r["_round"],
+                     "lane": r.get("_lane", "train"),
+                     "reason": r["_skip"]}
                     for r in records if "_skip" in r],
         "series": {},
         "status": "pass",

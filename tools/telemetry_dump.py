@@ -113,7 +113,17 @@ def main(argv=None) -> int:
                          "histograms merged, gauges per-rank, plus "
                          "collective skew gauges and typed straggler/"
                          "desync/missing-rank findings")
+    ap.add_argument("--prefix-stats", action="store_true",
+                    help="with --fleet: append a radix prefix-cache "
+                         "summary (hit/miss tokens, hit rate, "
+                         "evictions, KV-aware route hits) derived from "
+                         "the fleet-summed serving.prefix_* and "
+                         "gateway.route.prefix_hit counters")
     args = ap.parse_args(argv)
+
+    if args.prefix_stats and not args.fleet:
+        ap.error("--prefix-stats summarizes the fleet view; "
+                 "use it with --fleet DIR")
 
     from paddle_tpu.observability import export as _export
 
@@ -135,6 +145,22 @@ def main(argv=None) -> int:
         text += (f"# fleet ranks {agg.ranks()}\n")
         for f in agg.findings():
             text += "# fleet finding " + json.dumps(f.to_dict()) + "\n"
+        if args.prefix_stats:
+            sums = {}
+            for s in agg.fleet_series():
+                if s.get("type") == "counter":
+                    sums[s["name"]] = sums.get(s["name"], 0) \
+                        + s.get("value", 0)
+            hit = sums.get("serving.prefix_hit_tokens", 0)
+            miss = sums.get("serving.prefix_miss_tokens", 0)
+            text += "# fleet prefix-stats " + json.dumps({
+                "hit_tokens": hit,
+                "miss_tokens": miss,
+                "hit_rate": round(hit / max(hit + miss, 1), 4),
+                "evictions": sums.get("serving.prefix_evictions", 0),
+                "route_prefix_hits": sums.get(
+                    "gateway.route.prefix_hit", 0),
+            }) + "\n"
         if args.out:
             with open(args.out, "w") as fh:
                 fh.write(text)
