@@ -1,0 +1,156 @@
+"""Multi-chip SPMD mesh benchmark: the scaled-down PLAN_7B trained
+through the runtime mesh layer (``distributed.mesh.MeshRuntime``).
+
+Headline number = sharded train tokens/sec of the fused donating
+TrainStep compiled with the 2x2 ``(fsdp, tensor)`` mesh plan (ZeRO-3
+storage sharding, gather-at-use) on the CPU proxy's forced device grid
+— on TPU the same code spans real chips. detail carries what the lane
+actually gates:
+
+  * ``memory``: the runtime/static live-bytes cross-check — XLA's
+    measured per-chip resident state vs ``analysis/memory.py``'s
+    prediction (``state_ratio`` must sit within 10%) plus the
+    liveness-walk peak soundness bound;
+  * ``comm_bytes_by_axis``: the analytic per-step collective volume the
+    roofline attribution splits the MFU gap with;
+  * the single-device reference rate for context (NOT a gate — 4
+    virtual CPU devices share the same cores, so the proxy's sharded
+    rate measures overhead, not speedup).
+
+Same JSON contract as bench.py: ONE stdout line
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": ...}
+vs_baseline stays 0.0 — the reference publishes no multi-chip figure.
+
+The bench line also lands in ``MULTICHIP_r<NN>.json`` at the repo root:
+the multichip lane of ``tools/bench_guard.py``'s trajectory gate,
+disjoint from the train (``BENCH_r*``) and gateway
+(``BENCH_GATEWAY_r*``) lanes by filename prefix. (Rounds r01-r05 of
+this prefix predate the lane and hold raw dry-run wrappers; the guard
+skips them as unparsable history rather than gating on them.)
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MESH_AXES = {"data": 1, "fsdp": 2, "tensor": 2}
+WARMUP_STEPS = 2
+TIMED_STEPS = 8
+
+
+def _make_model(on_tpu):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2752, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048)
+        batch, seq = 8, 512
+    else:
+        # the scaled-down PLAN_7B the analysis tests price (same shape
+        # family, every dim divisible by the 2x2 mesh)
+        cfg = LlamaConfig(vocab_size=2000, hidden_size=256,
+                          intermediate_size=688, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=512)
+        batch, seq = 2, 64
+    return LlamaForCausalLM(cfg), cfg, batch, seq
+
+
+def _build_step(model, plan):
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu import jit as jit_mod
+
+    opt = optim.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+    def fn(ids, labels):
+        out = model(ids)
+        logits = out[0] if isinstance(out, (tuple, list)) else out
+        return paddle.nn.functional.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+
+    return jit_mod.TrainStep(fn, opt, mesh_plan=plan)
+
+
+def _rate(step, ids, labels, batch, seq):
+    for _ in range(WARMUP_STEPS):
+        step(ids, labels)
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        loss = step(ids, labels)
+    float(np.asarray(loss._data))           # block on the last step
+    dt = time.perf_counter() - t0
+    return batch * seq * TIMED_STEPS / dt
+
+
+def _round_path():
+    """Next MULTICHIP_r<NN>.json slot (continues the existing lane)."""
+    import glob
+    import re
+    rounds = [0]
+    for p in glob.glob(os.path.join(_REPO_DIR, "MULTICHIP_r*.json")):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            rounds.append(int(m.group(1)) + 1)
+    return os.path.join(_REPO_DIR, f"MULTICHIP_r{max(rounds):02d}.json")
+
+
+def main():
+    import jax
+    from paddle_tpu.distributed.mesh import MeshRuntime
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    model, cfg, batch, seq = _make_model(on_tpu)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       size=(batch, seq)))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                          size=(batch, seq)))
+
+    rt = MeshRuntime(MESH_AXES)
+    plan = rt.train_plan(budget_gib=16.0)
+    step = _build_step(model, plan)
+    sharded_rate = _rate(step, ids, labels, batch, seq)
+    memory = step.mesh_memory_report(ids, labels)
+
+    ref_model, _, _, _ = _make_model(on_tpu)
+    ref_rate = _rate(_build_step(ref_model, None), ids, labels, batch, seq)
+
+    detail = {
+        "tpu": on_tpu,
+        "mesh": dict(rt.axes),
+        "n_devices": rt.size,
+        "params": ref_model.num_params(),
+        "batch": batch,
+        "seq": seq,
+        "timed_steps": TIMED_STEPS,
+        "single_device_tokens_per_s": round(ref_rate, 2),
+        "memory": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in memory.items()},
+        "comm_bytes_by_axis": {k: round(v, 1) for k, v in
+                               plan.collective_bytes_by_axis().items()},
+    }
+    line = {
+        "metric": "multichip_sharded_train_tokens_per_sec",
+        "value": round(sharded_rate, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "detail": detail,
+    }
+    try:
+        with open(_round_path(), "w") as f:
+            json.dump(line, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass  # artifact write must never sink the bench number
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

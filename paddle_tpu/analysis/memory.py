@@ -76,7 +76,9 @@ def _aval_bytes(aval) -> int:
 # Jaxpr-level liveness walk
 # ---------------------------------------------------------------------------
 
-def peak_hbm_estimate(program, donate: Sequence[int] = ()) -> dict:
+def peak_hbm_estimate(program, donate: Sequence[int] = (),
+                      invar_shards: Optional[Sequence[int]] = None,
+                      default_shards: int = 1) -> dict:
     """Estimate peak live HBM bytes of one jaxpr execution.
 
     Returns ``{"peak_bytes", "input_bytes", "output_bytes", "timeline",
@@ -86,6 +88,13 @@ def peak_hbm_estimate(program, donate: Sequence[int] = ()) -> dict:
     ``live + out_bytes - reuse_credit`` where the credit applies when a
     same-shape/dtype input dies at that equation and the primitive's
     registry alias metadata marks it donation-safe.
+
+    Sharded per-chip mode (the runtime mesh gate): ``invar_shards`` is a
+    per-invar shard degree (parallel to the jaxpr's invars) dividing that
+    input's resident bytes, and ``default_shards`` divides every
+    equation-produced buffer (the data-parallel degree activations shard
+    over). Constvars and unlisted invars stay whole — replicated. The
+    defaults reproduce the original whole-program accounting bit-for-bit.
     """
     from .dataflow import _closed  # lazy: pulls in jax
     try:
@@ -111,9 +120,23 @@ def peak_hbm_estimate(program, donate: Sequence[int] = ()) -> dict:
     donated_vars = {v for i, v in enumerate(jaxpr.invars) if i in donate}
     invar_index = {v: i for i, v in enumerate(jaxpr.invars)}
 
+    divisor: Dict = {}
+    if invar_shards is not None:
+        for v, d in zip(jaxpr.invars, invar_shards):
+            divisor[v] = max(1, int(d))
+    boundary = set(jaxpr.invars) | set(jaxpr.constvars)
+
+    def _vb(v) -> int:
+        nb = _aval_bytes(v.aval)
+        if v in divisor:
+            return nb // divisor[v]
+        if v in boundary:
+            return nb
+        return nb // max(1, int(default_shards))
+
     live = 0
     for v in list(jaxpr.invars) + list(jaxpr.constvars):
-        live += _aval_bytes(v.aval)
+        live += _vb(v)
     input_bytes = live
 
     peak = live
@@ -122,12 +145,12 @@ def peak_hbm_estimate(program, donate: Sequence[int] = ()) -> dict:
 
     for i, eqn in enumerate(jaxpr.eqns):
         prim = str(eqn.primitive)
-        out_bytes = sum(_aval_bytes(o.aval) for o in eqn.outvars
+        out_bytes = sum(_vb(o) for o in eqn.outvars
                         if not isinstance(o, DropVar))
         dying = [v for v in dict.fromkeys(
                      x for x in eqn.invars if isinstance(x, Var))
                  if last_use.get(v) == i]
-        dying_bytes = sum(_aval_bytes(v.aval) for v in dying)
+        dying_bytes = sum(_vb(v) for v in dying)
 
         credit = 0
         alias = _alias_for_prim(prim, donation_ops)
@@ -141,18 +164,18 @@ def peak_hbm_estimate(program, donate: Sequence[int] = ()) -> dict:
                     continue
                 reusable = v not in invar_index or v in donated_vars
                 if reusable:
-                    credit = _aval_bytes(v.aval)
+                    credit = _vb(v)
                     out_layouts.remove(layout)
                 else:
                     missed.append({
                         "invar": invar_index[v], "eqn": i,
                         "primitive": prim,
-                        "bytes": _aval_bytes(v.aval)})
+                        "bytes": _vb(v)})
         peak = max(peak, live + out_bytes - credit)
         live += out_bytes - dying_bytes
         timeline.append((i, live))
 
-    output_bytes = sum(_aval_bytes(v.aval) for v in jaxpr.outvars
+    output_bytes = sum(_vb(v) for v in jaxpr.outvars
                        if isinstance(v, Var))
     return {"peak_bytes": peak, "input_bytes": input_bytes,
             "output_bytes": output_bytes, "timeline": timeline,

@@ -292,10 +292,30 @@ def _watched(name):
             calls, bytes_c, seconds = _coll_metrics()
             calls.labels(op=name).inc()
             t = next((a for a in args if hasattr(a, "shape")), None)
+            nb = 0
             if t is not None:
                 nb = getattr(getattr(t, "_data", t), "nbytes", 0)
                 if nb:
                     bytes_c.labels(op=name).inc(int(nb))
+            # per-mesh-axis twins, ONLY under an armed mesh.axis_scope:
+            # single-process output stays byte-identical (the twin
+            # families are never even created without a scope)
+            from .mesh import current_axis_label
+            axis = current_axis_label()
+            if axis is not None:
+                from ..observability.metrics import get_registry
+                reg = get_registry()
+                reg.counter("collective_axis_calls_total",
+                            "collective invocations by op and mesh axis",
+                            labelnames=("op", "axis")).labels(
+                                op=name, axis=axis).inc()
+                if nb:
+                    reg.counter(
+                        "collective_axis_bytes_total",
+                        "tensor payload bytes entering collectives by op "
+                        "and mesh axis",
+                        labelnames=("op", "axis")).labels(
+                            op=name, axis=axis).inc(int(nb))
             from ..observability import fleet as _fleet
             # fleet enter BEFORE the fault point: a kill_rank here leaves
             # the enter-without-exit signature in the victim's shard/ring

@@ -1,0 +1,796 @@
+"""Runtime SPMD mesh layer: the bridge from the static shard plan to
+real ``jax.sharding`` programs.
+
+``analysis/sharding.py`` + ``tools/shard_check.py`` can statically cost
+every PLAN_7B variant, but until this module the runtime was
+single-chip. ``MeshRuntime`` materializes a ``jax.sharding.Mesh`` with
+the named ``(data, fsdp, tensor)`` axes from either an explicit axis
+dict or the launcher env (single-process multi-device AND multi-process
+gloo worlds both work), and translates the plan's shard-policy mirror
+(``analysis.sharding.plan_shard_dim`` / ``divisible_dim`` — the single
+source of truth the static checks use) into real ``NamedSharding``s:
+
+* **training** (``train_plan``): parameters/masters/optimizer state
+  shard their plan dim over ``fsdp`` (ZeRO stage-3 storage sharding,
+  with a second divisible dim over ``tensor``); activations/batch shard
+  over the ``data`` axis. The fused donating TrainStep consumes the
+  plan via ``jit``'s ``in_shardings``/``out_shardings``
+  (``hapi.Model.prepare(jit=True, plan=...)``).
+* **serving** (``shard_serving``): a batcher becomes a tensor-parallel
+  shard group — weights ``P(None, 'tensor')`` (column-parallel: every
+  collective is a gather, no cross-shard reduction, so greedy decoding
+  stays token-exact), KV caches/pages sharded on the heads dim. Member
+  death surfaces as a non-retryable ``TPMemberDied`` that rides the
+  gateway's existing retry-then-declare-dead + token-exact requeue
+  machinery.
+
+Every mesh program is **gated at runtime by the same SH/MEM analyzer**
+the static plane uses: a spec whose shard dim does not divide refuses
+with SH201, a step whose predicted per-chip live bytes exceed the HBM
+budget refuses with MEM301 (``MeshProgramRejected`` carries the
+findings), and ``measured_live_bytes`` reads the compiled executable's
+buffer assignment so the runtime and ``analysis/memory.py`` verify each
+other. ``describe()`` dumps the exact specs for
+``tools/shard_check.py --from-runtime``.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "MeshRuntime", "TrainMeshPlan", "ShardGroup", "MeshProgramRejected",
+    "TPMemberDied", "current_axis_label", "axis_scope",
+]
+
+#: canonical axis order; size-1 axes are kept in the mesh so specs can
+#: always name them (a size-1 axis shards nothing and costs nothing)
+AXIS_ORDER = ("data", "fsdp", "tensor")
+
+GIB = 1024 ** 3
+
+
+class MeshProgramRejected(RuntimeError):
+    """A mesh program the SH/MEM analyzer refuses to run.
+
+    ``findings`` holds the ``analysis.findings.Finding`` objects; the
+    message leads with the rule codes (SH201, MEM301, ...) so callers
+    and logs see the same identifiers the static gate prints.
+    """
+
+    def __init__(self, findings):
+        self.findings = list(findings)
+        codes = ",".join(sorted({f.rule for f in self.findings}))
+        detail = "; ".join(str(f) for f in self.findings[:4])
+        super().__init__(f"[{codes}] mesh program refused: {detail}")
+
+
+class TPMemberDied(RuntimeError):
+    """A member of a tensor-parallel shard group is gone. Deliberately
+    NOT retryable: the member held 1/N of the weights and KV — the whole
+    group must be declared dead and its requests requeued token-exact
+    onto survivors (the gateway's existing failure machinery)."""
+
+
+# -- per-axis collective telemetry context ----------------------------------
+# Eager collectives run through distributed.collective._watched, whose
+# counters are labeled by op only. When a mesh axis scope is active the
+# wrapper ALSO feeds axis-labeled twins; with no scope armed (every
+# single-process run today) nothing new is emitted, keeping existing
+# output byte-identical.
+
+_AXIS_LABEL = threading.local()
+
+
+def current_axis_label() -> Optional[str]:
+    return getattr(_AXIS_LABEL, "axis", None)
+
+
+@contextlib.contextmanager
+def axis_scope(axis: str):
+    """Label collectives issued inside the scope with a mesh axis name."""
+    prev = current_axis_label()
+    _AXIS_LABEL.axis = axis
+    try:
+        yield
+    finally:
+        _AXIS_LABEL.axis = prev
+
+
+def _analysis_sharding():
+    from ..analysis import sharding as _s
+    return _s
+
+
+def _analysis_memory():
+    from ..analysis import memory as _m
+    return _m
+
+
+def _mesh_gauges():
+    from ..observability.metrics import get_registry
+    reg = get_registry()
+    return (reg.gauge("mesh.live_bytes_measured",
+                      "per-chip live bytes of the latest compiled mesh "
+                      "program (XLA buffer assignment)"),
+            reg.gauge("mesh.live_bytes_predicted",
+                      "per-chip live bytes analysis/memory.py predicts "
+                      "for the same program"),
+            reg.gauge("mesh.live_bytes_agreement",
+                      "measured / predicted per-chip live bytes"))
+
+
+class MeshRuntime:
+    """Named-axis device mesh + the plan -> NamedSharding policies."""
+
+    def __init__(self, axes: Optional[Dict[str, int]] = None,
+                 devices: Optional[Sequence] = None):
+        devs = list(devices if devices is not None else jax.devices())
+        if axes is None:
+            axes = {"data": len(devs), "fsdp": 1, "tensor": 1}
+        norm: Dict[str, int] = {}
+        for name in AXIS_ORDER:
+            norm[name] = int(axes.get(name, 1))
+        extra = set(axes) - set(AXIS_ORDER)
+        if extra:
+            raise ValueError(f"unknown mesh axes {sorted(extra)}; "
+                             f"this runtime names {AXIS_ORDER}")
+        size = int(np.prod(list(norm.values())))
+        if size < 1 or size > len(devs):
+            raise ValueError(
+                f"mesh {norm} needs {size} device(s) but only "
+                f"{len(devs)} are visible")
+        self.axes = norm
+        shape = tuple(norm[a] for a in AXIS_ORDER)
+        grid = np.array(devs[:size], dtype=object).reshape(shape)
+        self.mesh = Mesh(grid, AXIS_ORDER)
+        self.size = size
+
+    # -- construction ---------------------------------------------------------
+    @classmethod
+    def from_env(cls, default_tensor: int = 1) -> "MeshRuntime":
+        """Build the mesh from the launcher env.
+
+        ``PADDLE_MESH_SHAPE`` ("data:1,fsdp:2,tensor:2") wins. Otherwise
+        a multi-process world (``PADDLE_TRAINERS_NUM`` > 1) initializes
+        the distributed runtime first (gloo on the CPU proxy) and spans
+        every global device; single-process spans the local devices.
+        The default split puts everything on ``data`` except an optional
+        trailing ``tensor`` degree.
+        """
+        spec = os.environ.get("PADDLE_MESH_SHAPE")
+        if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+            from .collective import init_parallel_env
+            init_parallel_env()   # PJRT distributed runtime + gloo + store
+        if spec:
+            axes: Dict[str, int] = {}
+            for part in spec.split(","):
+                name, _, deg = part.partition(":")
+                axes[name.strip()] = int(deg or 1)
+            return cls(axes)
+        n = len(jax.devices())
+        t = default_tensor if n % max(default_tensor, 1) == 0 else 1
+        return cls({"data": n // max(t, 1), "fsdp": 1, "tensor": t})
+
+    @property
+    def multiprocess(self) -> bool:
+        return jax.process_count() > 1
+
+    def axis_size(self, name: str) -> int:
+        return self.axes.get(name, 1)
+
+    def spec(self):
+        """The static mirror (``analysis.sharding.MeshSpec``)."""
+        return _analysis_sharding().MeshSpec(self.axes)
+
+    def process_mesh(self):
+        """ProcessMesh wrapper (fleet/auto_parallel interop)."""
+        from .auto_parallel import ProcessMesh
+        return ProcessMesh(None, _jax_mesh=self.mesh)
+
+    def named_sharding(self, spec_dims: Sequence) -> NamedSharding:
+        """``spec_dims``: per-tensor-dim axis name / tuple / None."""
+        return NamedSharding(self.mesh, PartitionSpec(*spec_dims))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    # -- the plan policy mirror ----------------------------------------------
+    def train_param_spec(self, shape: Sequence[int],
+                         name: str = "") -> Tuple:
+        """PLAN_7B placement for one parameter: the plan's declared dim
+        (``plan_shard_dim``: norms replicate, 2D dim0, 3D dim1) shards
+        over ``fsdp``; the next divisible dim shards over ``tensor``.
+        Falls back through ``divisible_dim`` exactly like the static
+        SH204 check, replicating when nothing divides."""
+        s = _analysis_sharding()
+        ndim = len(shape)
+        spec: List[Optional[str]] = [None] * ndim
+        f, t = self.axis_size("fsdp"), self.axis_size("tensor")
+        if ndim < 2 or (name and name.startswith("ln")):
+            return tuple(spec)
+        primary = s.plan_shard_dim(name or "w", shape)
+        if f > 1:
+            if primary is None or shape[primary] % f:
+                primary = s.divisible_dim(shape, f)
+            if primary is not None:
+                spec[primary] = "fsdp"
+        if t > 1:
+            for d in range(ndim - 1, -1, -1):   # prefer the trailing dim
+                if spec[d] is None and shape[d] % t == 0 and shape[d] >= t:
+                    spec[d] = "tensor"
+                    break
+        return tuple(spec)
+
+    def batch_spec(self, shape: Sequence[int],
+                   data_axes: Sequence[str] = ("data",)) -> Tuple:
+        """Batch placement: dim0 over the data-parallel axes when it
+        divides, else replicated (an indivisible batch is a gate error
+        only when the caller declared it sharded)."""
+        spec: List[Optional[object]] = [None] * len(shape)
+        axes = tuple(a for a in data_axes if self.axis_size(a) > 1)
+        if not shape or not axes:
+            return tuple(spec)
+        deg = int(np.prod([self.axis_size(a) for a in axes]))
+        if deg > 1 and shape[0] % deg == 0:
+            spec[0] = axes[0] if len(axes) == 1 else tuple(axes)
+        return tuple(spec)
+
+    def serving_weight_spec(self, shape: Sequence[int],
+                            name: str = "") -> Tuple:
+        """Serving TP placement: ``P(None, 'tensor')`` for matrices
+        (column-parallel — gathers only, never a cross-shard reduction,
+        so greedy decode stays token-exact), replicate vectors/norms."""
+        t = self.axis_size("tensor")
+        ndim = len(shape)
+        spec: List[Optional[str]] = [None] * ndim
+        if t <= 1 or ndim < 2:
+            return tuple(spec)
+        d = ndim - 1                       # trailing (output/feature) dim
+        if shape[d] % t == 0 and shape[d] >= t:
+            spec[d] = "tensor"
+        return tuple(spec)
+
+    def serving_cache_spec(self, ndim: int, heads_dim: int) -> Tuple:
+        """KV caches/pages shard the heads dim over ``tensor``."""
+        spec: List[Optional[str]] = [None] * ndim
+        if self.axis_size("tensor") > 1:
+            spec[heads_dim] = "tensor"
+        return tuple(spec)
+
+    # -- placement ------------------------------------------------------------
+    def place(self, value, spec_dims: Sequence):
+        """Commit a host/device array to the mesh under ``spec_dims``.
+
+        Single-process: plain ``device_put``. Multi-process: every rank
+        holds the full host value (deterministic init), so the global
+        array is assembled shard-by-shard via ``make_array_from_callback``
+        — the only portable way to build an array spanning
+        non-addressable devices.
+        """
+        sharding = self.named_sharding(spec_dims)
+        if (isinstance(value, jax.Array)
+                and getattr(value, "sharding", None) is not None
+                and set(value.sharding.device_set)
+                == set(self.mesh.devices.flat)):
+            # already mesh-resident (e.g. a previous step's output): jit
+            # reshards if the spec differs; np.asarray would fail on a
+            # multi-host array anyway
+            return value
+        if not self.multiprocess:
+            return jax.device_put(value, sharding)
+        host = np.asarray(value)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    # -- the runtime SH/MEM gate ----------------------------------------------
+    def gate_specs(self, entries: Sequence[Tuple[str, Sequence[int],
+                                                 Sequence]],
+                   file: str = "<runtime>") -> None:
+        """SH201 for every (name, shape, spec) about to be placed; raise
+        ``MeshProgramRejected`` on any error finding — same rule, same
+        code, same message shape as the static gate."""
+        s = _analysis_sharding()
+        mesh_spec = self.spec()
+        findings = []
+        for name, shape, spec in entries:
+            findings.extend(s.check_spec_divisibility(
+                name, tuple(shape), tuple(spec), mesh_spec, file=file))
+        if findings:
+            raise MeshProgramRejected(findings)
+
+    def gate_memory(self, predicted_bytes: float,
+                    budget_gib: Optional[float],
+                    file: str = "<runtime>") -> None:
+        """MEM301 when the predicted per-chip live bytes exceed the HBM
+        budget — refused BEFORE compiling, like the static plan gate."""
+        if budget_gib is None or predicted_bytes <= budget_gib * GIB:
+            return
+        from ..analysis.findings import ERROR, Finding
+        raise MeshProgramRejected([Finding(
+            "MEM301",
+            f"mesh program needs {predicted_bytes / GIB:.3f} GiB/chip "
+            f"but the budget is {budget_gib:.3f} GiB — OOM before "
+            "step 1",
+            file=file, severity=ERROR,
+            extra={"peak_bytes": predicted_bytes,
+                   "budget_gib": budget_gib})])
+
+    # -- the training plan ----------------------------------------------------
+    def train_plan(self, *, budget_gib: Optional[float] = None,
+                   data_axes: Sequence[str] = ("data",),
+                   zero3_gather: bool = True,
+                   param_names: Optional[Dict[int, str]] = None
+                   ) -> "TrainMeshPlan":
+        return TrainMeshPlan(self, budget_gib=budget_gib,
+                             data_axes=tuple(data_axes),
+                             zero3_gather=zero3_gather,
+                             param_names=param_names or {})
+
+    # -- serving: tensor-parallel shard group ---------------------------------
+    def shard_serving(self, batcher, group_name: str = "tp"
+                      ) -> "ShardGroup":
+        """Turn a batcher into a tensor-parallel shard group: weights
+        ``P(None,'tensor')``, dense KV caches (and paged pools) sharded
+        on the heads dim. Gated by SH201 (head divisibility) first.
+        Returns the ``ShardGroup`` (also attached as
+        ``batcher.shard_group`` — the batcher's step heartbeats it)."""
+        cfg = batcher.model.config
+        t = self.axis_size("tensor")
+        entries = [("num_attention_heads", (cfg.num_attention_heads,),
+                    ("tensor",)),
+                   ("num_key_value_heads",
+                    (getattr(cfg, "num_key_value_heads", None)
+                     or cfg.num_attention_heads,), ("tensor",))]
+        self.gate_specs(entries, file="<serving>")
+
+        placed = {}
+        for pname, p in batcher.model.named_parameters():
+            spec = self.serving_weight_spec(tuple(p.shape), name=pname)
+            if any(a is not None for a in spec):
+                p._data = self.place(p._data, spec)
+                placed[pname] = {"shape": list(p.shape),
+                                 "dtype": str(p._data.dtype),
+                                 "spec": list(spec)}
+        # dense KV cache [L, 2, B, kvh, s_max, d]: heads dim 3
+        caches = getattr(batcher, "_caches", None)
+        if caches is not None and getattr(caches, "ndim", 0) == 6:
+            batcher._caches._data = self.place(
+                caches._data, self.serving_cache_spec(6, 3))
+        # paged pool per layer [n_pages+1, H, bs, D]: heads dim 1
+        pool = getattr(batcher, "_pool", None)
+        if pool is not None:
+            for i, page in enumerate(getattr(pool, "k", []) or []):
+                pool.k[i] = self.place(page, self.serving_cache_spec(4, 1))
+            for i, page in enumerate(getattr(pool, "v", []) or []):
+                pool.v[i] = self.place(page, self.serving_cache_spec(4, 1))
+        group = ShardGroup(group_name, self, axis="tensor",
+                           placed_params=placed)
+        batcher.shard_group = group
+        return group
+
+    # -- memory cross-check ---------------------------------------------------
+    @staticmethod
+    def measured_live_bytes(compiled) -> Optional[dict]:
+        """Per-chip byte accounting of a compiled executable, from XLA's
+        own buffer assignment. ``peak_bytes`` is
+        ``args + temp + max(0, out - aliased)`` — the exact formula the
+        recorded ``PLAN_7B.json`` footprints use; ``argument_bytes`` is
+        the resident state (what stays live between steps). None when
+        the backend exposes no memory analysis."""
+        try:
+            ma = compiled.memory_analysis()
+            args = int(ma.argument_size_in_bytes)
+            out = int(ma.output_size_in_bytes)
+            alias = int(ma.alias_size_in_bytes)
+            temp = int(ma.temp_size_in_bytes)
+        except Exception:
+            return None
+        return {"argument_bytes": args, "output_bytes": out,
+                "alias_bytes": alias, "temp_bytes": temp,
+                "peak_bytes": args + temp + max(0, out - alias)}
+
+    def verify_live_bytes(self, measured: dict, predicted: dict,
+                          tolerance: float = 0.10,
+                          peak_slack: float = 1.05) -> dict:
+        """The runtime/static memory cross-check, two-sided:
+
+        * **state** — XLA's resident argument bytes must agree with the
+          spec-derived prediction within ``tolerance``. This is the
+          bytes-per-chip claim the plan's sharding math makes (the term
+          that dominates every PLAN_7B footprint), and both sides count
+          the same buffers, so agreement is tight.
+        * **peak** — the liveness walk does not model XLA fusion, so its
+          peak is a deliberate upper bound; the check is SOUNDNESS
+          (``measured <= predicted * peak_slack``), i.e. the static
+          MEM301 gate never under-predicts what the chip will hold.
+
+        Publishes the ``mesh.live_bytes_*`` gauges; the caller decides
+        whether a miss is fatal."""
+        m_state = float(measured["argument_bytes"])
+        p_state = float(predicted["predicted_state_bytes"]) or 1.0
+        m_peak = float(measured["peak_bytes"])
+        p_peak = float(predicted["predicted_peak_bytes"]) or 1.0
+        ratio = m_state / p_state
+        m_g, p_g, a_g = _mesh_gauges()
+        m_g.set(m_state)
+        p_g.set(p_state)
+        a_g.set(ratio)
+        return {"measured_state_bytes": int(m_state),
+                "predicted_state_bytes": p_state,
+                "state_ratio": ratio,
+                "within_tolerance": abs(ratio - 1.0) <= tolerance,
+                "measured_peak_bytes": int(m_peak),
+                "predicted_peak_bytes": p_peak,
+                "peak_ratio": m_peak / p_peak,
+                "peak_bound_sound": m_peak <= p_peak * peak_slack}
+
+    # -- interop with the ZeRO runtime (distributed/sharding.py) --------------
+    @staticmethod
+    def sharding_axis(group=None):
+        """The (mesh, axis) the group-sharded (ZeRO) runtime shards
+        over: the hybrid topology's 'sharding' axis when fleet armed
+        one, else the given/world group's own axis. Single home for the
+        derivation ``distributed/sharding.py`` used to duplicate."""
+        from .fleet.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+            return hcg.mesh, "sharding"
+        from .collective import init_parallel_env
+        g = group or init_parallel_env()
+        return g.mesh, g.axis_name
+
+    # -- the runtime -> static handoff ----------------------------------------
+    def describe(self, train_plan: Optional["TrainMeshPlan"] = None,
+                 serving: Optional["ShardGroup"] = None,
+                 budget_gib: Optional[float] = None) -> dict:
+        """JSON-able dump of the EXACT specs this runtime will execute,
+        for ``tools/shard_check.py --from-runtime`` (closes the
+        static/runtime drift hole: CI lints what runs, not a mirror)."""
+        out = {
+            "kind": "mesh_runtime",
+            "mesh": dict(self.axes),
+            "n_devices": self.size,
+            "multiprocess": self.multiprocess,
+            "hbm_per_chip_gib": budget_gib,
+            "params": {},
+        }
+        if train_plan is not None:
+            out["params"].update(train_plan.describe_params())
+            out["batch"] = train_plan.describe_batch()
+            if budget_gib is None:
+                out["hbm_per_chip_gib"] = train_plan.budget_gib
+            report = getattr(train_plan, "memory_report", None)
+            if report:
+                out["memory"] = {k: float(v) for k, v in report.items()
+                                 if isinstance(v, (int, float))}
+        if serving is not None:
+            out["serving"] = serving.describe()
+        return out
+
+
+class TrainMeshPlan:
+    """The shardings one fused TrainStep compiles with.
+
+    Built by ``MeshRuntime.train_plan``; consumed by
+    ``jit.TrainStep(mesh_plan=...)``:
+
+    * ``register_params`` fixes the param order and derives every spec;
+    * ``gate()`` runs the SH201 divisibility check over the derived
+      specs plus the MEM301 budget check against the liveness-walk
+      prediction (``analysis.memory.peak_hbm_estimate`` with the specs'
+      shard divisors) — refusal raises ``MeshProgramRejected``;
+    * ``step_shardings`` yields the ``in_shardings``/``out_shardings``
+      pytrees matching the pure step's signature;
+    * ``place_state``/``place_batch`` commit live buffers;
+    * ``collective_bytes_by_axis`` is the analytic per-axis comm volume
+      of one step (feeds the roofline attribution split).
+
+    ``zero3_gather=True`` keeps compute numerically identical to a
+    single device: parameters live sharded (storage) and are constrained
+    replicated at use, so XLA all-gathers them and frees the copies —
+    the documented stage-3 semantics — and no cross-shard reduction
+    reorders any sum.
+    """
+
+    def __init__(self, runtime: MeshRuntime, budget_gib=None,
+                 data_axes=("data",), zero3_gather=True, param_names=None):
+        self.runtime = runtime
+        self.budget_gib = budget_gib
+        self.data_axes = tuple(data_axes)
+        self.zero3_gather = zero3_gather
+        self._names: Dict[int, str] = dict(param_names or {})
+        self._param_specs: List[Tuple] = []
+        self._param_shapes: List[Tuple[int, ...]] = []
+        self._param_dtypes: List[str] = []
+        self._batch_specs: List[Tuple] = []
+        self._batch_shapes: List[Tuple[int, ...]] = []
+        self.gated = False
+        self.memory_report: Optional[dict] = None
+
+    # -- registration ---------------------------------------------------------
+    def _name_of(self, i: int, p) -> str:
+        return self._names.get(i) or getattr(p, "name", None) or f"p{i}"
+
+    def register_params(self, params) -> None:
+        self._param_specs = []
+        self._param_shapes = []
+        self._param_dtypes = []
+        for i, p in enumerate(params):
+            shape = tuple(int(d) for d in p.shape)
+            self._param_shapes.append(shape)
+            self._param_dtypes.append(str(getattr(p, "dtype", "float32")))
+            self._param_specs.append(self.runtime.train_param_spec(
+                shape, name=self._name_of(i, p)))
+
+    def register_batch(self, batch_arrays) -> None:
+        self._batch_shapes = [tuple(int(d) for d in getattr(b, "shape", ()))
+                              for b in batch_arrays]
+        self._batch_specs = [self.runtime.batch_spec(s, self.data_axes)
+                             for s in self._batch_shapes]
+
+    # -- gate -----------------------------------------------------------------
+    def gate(self, jaxpr=None, donate: Sequence[int] = (),
+             invar_specs=None) -> None:
+        entries = [(f"param:{i}", s, spec) for i, (s, spec) in
+                   enumerate(zip(self._param_shapes, self._param_specs))]
+        entries += [(f"batch:{i}", s, spec) for i, (s, spec) in
+                    enumerate(zip(self._batch_shapes, self._batch_specs))]
+        self.runtime.gate_specs(entries, file="<train_plan>")
+        if jaxpr is not None:
+            predicted = self.predict_live_bytes(jaxpr, donate=donate,
+                                                invar_specs=invar_specs)
+            self.memory_report = dict(predicted,
+                                      budget_gib=self.budget_gib)
+            self.runtime.gate_memory(predicted["predicted_peak_bytes"],
+                                     self.budget_gib,
+                                     file="<train_plan>")
+        self.gated = True
+
+    def predict_live_bytes(self, jaxpr, donate: Sequence[int] = (),
+                           invar_specs=None) -> dict:
+        """analysis/memory.py's liveness walk, per-chip: each invar's
+        bytes divide by its shard degree; intermediates divide by the
+        data-parallel degree (activations shard on batch).
+        ``predicted_state_bytes`` (the resident inputs) is exact by
+        construction; ``predicted_peak_bytes`` is a fusion-blind upper
+        bound — see ``MeshRuntime.verify_live_bytes``."""
+        mem = _analysis_memory()
+        spec = self.runtime.spec()
+        shards = None
+        if invar_specs is not None:
+            shards = [max(1, int(round(1.0 / _shard_fraction(
+                spec, s)))) for s in invar_specs]
+        dp = int(np.prod([self.runtime.axis_size(a)
+                          for a in self.data_axes])) or 1
+        est = mem.peak_hbm_estimate(jaxpr, donate=donate,
+                                    invar_shards=shards,
+                                    default_shards=dp)
+        return {"predicted_peak_bytes": float(est["peak_bytes"]),
+                "predicted_state_bytes": float(est["input_bytes"])}
+
+    # -- sharding pytrees -----------------------------------------------------
+    def param_sharding(self, i: int) -> NamedSharding:
+        return self.runtime.named_sharding(self._param_specs[i])
+
+    def state_sharding(self, i: int, leaf_shape) -> NamedSharding:
+        """Optimizer-state leaf: param-shaped accumulators inherit the
+        param's placement; anything else (scalars) replicates."""
+        if tuple(leaf_shape) == self._param_shapes[i]:
+            return self.param_sharding(i)
+        return self.runtime.replicated
+
+    def batch_sharding(self, j: int) -> NamedSharding:
+        return self.runtime.named_sharding(self._batch_specs[j])
+
+    def step_shardings(self, p_arrays, masters, opt_states, extra_arrays,
+                       other_grads_in, batch, n_extra_out=None):
+        """(in_shardings, out_shardings) matching the pure step
+        ``(p, masters, opt_states, extra, other_grads, rng, lr, *batch)
+        -> (loss, new_p, new_masters, new_states, new_extra,
+            new_other_grads, new_key)``. ``n_extra_out`` is the mutated
+        subset of ``extra`` the step returns (defaults to all)."""
+        rep = self.runtime.replicated
+        p_sh = [self.param_sharding(i) for i in range(len(p_arrays))]
+        m_sh = [None if m is None else self.param_sharding(i)
+                for i, m in enumerate(masters)]
+        st_sh = [{k: self.state_sharding(i, getattr(v, "shape", ()))
+                  for k, v in st.items()}
+                 for i, st in enumerate(opt_states)]
+        ex_sh = [rep for _ in extra_arrays]
+        og_sh = [None if g is None else rep for g in other_grads_in]
+        self.register_batch(batch)
+        b_sh = [self.batch_sharding(j) for j in range(len(batch))]
+        in_sh = (p_sh, m_sh, st_sh, ex_sh, og_sh, rep, rep, *b_sh)
+        n_out = len(extra_arrays) if n_extra_out is None else n_extra_out
+        out_sh = (rep, p_sh, m_sh, st_sh, [rep] * n_out,
+                  [rep] * len(other_grads_in), rep)
+        return in_sh, out_sh
+
+    @staticmethod
+    def flat_invar_specs(in_shardings) -> List[Tuple]:
+        """Flatten an ``in_shardings`` pytree to per-invar spec tuples,
+        aligned with the traced jaxpr's invars (None entries are empty
+        pytree nodes on both sides, so they drop out identically)."""
+        import jax.tree_util as jtu
+        return [tuple(s.spec) for s in jtu.tree_leaves(in_shardings)]
+
+    # -- in-step constraints --------------------------------------------------
+    def constrain_param_for_use(self, i: int, arr):
+        """Inside the step: gather the stored shard for compute (stage-3
+        semantics) when ``zero3_gather``; otherwise leave placement to
+        GSPMD propagation."""
+        if not self.zero3_gather:
+            return arr
+        return jax.lax.with_sharding_constraint(
+            arr, self.runtime.replicated)
+
+    def constrain_grad(self, i: int, grad):
+        """Backward: land the grad on the param's placement so the
+        update runs on shards. In gather-at-use (exact) mode the grad is
+        first pinned replicated: without the pin GSPMD propagates the
+        shard constraint INTO the producing op (e.g. the embedding-grad
+        scatter-add), repartitioning its accumulation order — a 1-ulp
+        drift that breaks bitwise equality with the single-device step.
+        Pinned, the full grad completes identically and the reshard is
+        an exact slice."""
+        if self.zero3_gather:
+            grad = jax.lax.with_sharding_constraint(
+                grad, self.runtime.replicated)
+        return jax.lax.with_sharding_constraint(
+            grad, self.param_sharding(i))
+
+    # -- placement ------------------------------------------------------------
+    def place_state(self, params, masters, opt_states):
+        """Commit params (+ masters + optimizer accumulators) to their
+        sharded residence. Runs AFTER the eager discovery step (eager
+        ops cannot touch non-addressable shards in a multi-process
+        world)."""
+        for i, p in enumerate(params):
+            p._data = self.runtime.place(p._data, self._param_specs[i])
+        placed_masters = []
+        for i, m in enumerate(masters):
+            placed_masters.append(
+                None if m is None
+                else self.runtime.place(m, self._param_specs[i]))
+        placed_states = []
+        for i, st in enumerate(opt_states):
+            placed_states.append({
+                k: self.runtime.place(
+                    v, self._param_specs[i]
+                    if tuple(getattr(v, "shape", ())) ==
+                    self._param_shapes[i] else
+                    (None,) * len(getattr(v, "shape", ())))
+                for k, v in st.items()})
+        return placed_masters, placed_states
+
+    def place_batch(self, batch_arrays):
+        self.register_batch(batch_arrays)
+        return [self.runtime.place(b, self._batch_specs[j])
+                for j, b in enumerate(batch_arrays)]
+
+    # -- per-axis collective accounting ---------------------------------------
+    def collective_bytes_by_axis(self) -> Dict[str, float]:
+        """Analytic per-chip collective bytes of ONE step, by axis:
+        stage-3 all-gathers each sharded param twice (forward + backward
+        re-gather) and reduce-scatters its grad — ``(N-1)/N`` of the
+        bytes move, attributed to every axis the spec names (the same
+        model as ``analysis.sharding.plan_step_collective_bytes``,
+        resolved per-param so mixed placements price correctly)."""
+        s = _analysis_sharding()
+        out: Dict[str, float] = {}
+        for shape, dtype, spec in zip(self._param_shapes,
+                                      self._param_dtypes,
+                                      self._param_specs):
+            axes = {a for d in spec if d is not None
+                    for a in (d if isinstance(d, tuple) else (d,))}
+            if not axes:
+                continue
+            nb = s.nbytes(shape, dtype)
+            for a in axes:
+                n = self.runtime.axis_size(a)
+                if n <= 1:
+                    continue
+                frac = (n - 1) / n
+                gathers = 2.0 if self.zero3_gather else 1.0
+                out[a] = out.get(a, 0.0) + (gathers + 1.0) * nb * frac
+        dp_axes = [a for a in self.data_axes if self.runtime.axis_size(a) > 1]
+        if dp_axes and any(any(d is not None for d in sp)
+                           for sp in self._batch_specs):
+            # data-parallel grad psum: every param's full grad bytes
+            grad_nb = sum(s.nbytes(sh, dt) for sh, dt in
+                          zip(self._param_shapes, self._param_dtypes))
+            for a in dp_axes:
+                n = self.runtime.axis_size(a)
+                out[a] = out.get(a, 0.0) + 2.0 * grad_nb * (n - 1) / n
+        return out
+
+    # -- describe -------------------------------------------------------------
+    def describe_params(self) -> dict:
+        return {f"param:{i}" if not self._names.get(i) else self._names[i]:
+                {"shape": list(s), "dtype": d, "spec": list(spec)}
+                for i, (s, d, spec) in enumerate(
+                    zip(self._param_shapes, self._param_dtypes,
+                        self._param_specs))}
+
+    def describe_batch(self) -> list:
+        return [{"shape": list(s), "spec": list(spec)}
+                for s, spec in zip(self._batch_shapes, self._batch_specs)]
+
+
+def _shard_fraction(mesh_spec, spec_dims) -> float:
+    deg = 1
+    for d in spec_dims:
+        for a in (d if isinstance(d, tuple) else (d,) if d else ()):
+            deg *= mesh_spec.axes.get(a, 1)
+    return 1.0 / max(deg, 1)
+
+
+class ShardGroup:
+    """A tensor-parallel serving shard group: one logical replica whose
+    weights/KV live split over the mesh's ``tensor`` axis. One member
+    per device on that axis; a dead member means the whole group cannot
+    step (its shard is gone) — ``heartbeat()`` raises the non-retryable
+    ``TPMemberDied`` the pool turns into declare-dead + token-exact
+    requeue. The ``serving.tp_member`` chaos point injects member
+    failures for drills."""
+
+    def __init__(self, name: str, runtime: MeshRuntime,
+                 axis: str = "tensor", placed_params=None):
+        self.name = name
+        self.runtime = runtime
+        self.axis = axis
+        self.members = [f"{name}/{axis}{i}"
+                        for i in range(runtime.axis_size(axis))]
+        self.placed_params = dict(placed_params or {})
+        self._dead: List[str] = []
+
+    @property
+    def degree(self) -> int:
+        return len(self.members)
+
+    @property
+    def failed_members(self) -> List[str]:
+        return list(self._dead)
+
+    def fail_member(self, member: str, reason: str = "") -> None:
+        if member not in self.members:
+            raise ValueError(f"{member!r} is not in {self.members}")
+        if member not in self._dead:
+            self._dead.append(member)
+            from ..observability.metrics import get_registry
+            get_registry().counter(
+                "mesh.tp_member_deaths",
+                "tensor-parallel shard-group members declared dead",
+                labelnames=("group",)).labels(group=self.name).inc()
+
+    def heartbeat(self) -> None:
+        """Called by the batcher at every step. Chaos faults at
+        ``serving.tp_member`` mark the last member dead; any dead member
+        makes the group unsteppable."""
+        from ..resilience.chaos import fault_point
+        try:
+            fault_point("serving.tp_member")
+        except Exception as exc:
+            self.fail_member(self.members[-1], reason=str(exc))
+        if self._dead:
+            raise TPMemberDied(
+                f"shard group {self.name!r}: member(s) "
+                f"{self._dead} dead — {self.degree}-way tensor-parallel "
+                "weights/KV are incomplete; declare the group dead and "
+                "requeue its requests")
+
+    def describe(self) -> dict:
+        return {"group": self.name, "axis": self.axis,
+                "members": list(self.members),
+                "failed": list(self._dead),
+                "params": {k: (dict(v) if isinstance(v, dict) else list(v))
+                           for k, v in self.placed_params.items()}}

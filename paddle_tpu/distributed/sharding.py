@@ -38,16 +38,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
 from .auto_parallel import Replicate, Shard, shard_tensor
-from .collective import Group, init_parallel_env
-from .fleet.topology import get_hybrid_communicate_group
-
-
-def _sharding_mesh_axis(group: Optional[Group]):
-    hcg = get_hybrid_communicate_group()
-    if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
-        return hcg.mesh, "sharding"
-    g = group or init_parallel_env()
-    return g.mesh, g.axis_name
+from .collective import Group
+from .mesh import MeshRuntime
 
 
 def _divisible_dim(shape, degree):
@@ -105,7 +97,10 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
             "group_sharded_parallel: buffer_max_size/segment_size are no-ops "
             "on the XLA backend (buffer assignment already coalesces "
             "gradient storage)", stacklevel=2)
-    mesh, axis = _sharding_mesh_axis(group)
+    # the mesh runtime owns the "which mesh/axis does ZeRO shard over"
+    # derivation (hybrid 'sharding' axis when fleet armed one, else the
+    # given/world group's own axis)
+    mesh, axis = MeshRuntime.sharding_axis(group)
     degree = mesh.get_dim_size(axis)
 
     # parameters: stage 3 shards them over the axis; else replicate

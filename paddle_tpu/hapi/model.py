@@ -59,7 +59,7 @@ class Model:
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None, jit=False):
+                amp_configs=None, jit=False, plan=None):
         """``jit=True`` compiles forward + backward + optimizer update into
         ONE fused XLA executable (``paddle_tpu.jit.TrainStep``) with the
         param/master/opt-state buffers DONATED by default — XLA updates
@@ -67,7 +67,17 @@ class Model:
         audit is consulted first; any finding downgrades to non-donating.
         ``train_batch`` falls back to the eager tape whenever the fused
         step can't serve the call (metrics that need forward outputs, an
-        armed step guard, gradient accumulation)."""
+        armed step guard, gradient accumulation).
+
+        ``plan`` (a ``distributed.mesh.TrainMeshPlan``, from
+        ``MeshRuntime.train_plan``) compiles the fused step SPMD: state
+        lives sharded per the plan, the runtime SH/MEM gate vets the
+        program before compile, and per-axis collective bytes feed the
+        roofline gap attribution. Requires ``jit=True``."""
+        if plan is not None and not jit:
+            raise ValueError("prepare(plan=...) requires jit=True — the "
+                             "mesh plan shards the FUSED train step")
+        self._mesh_plan = plan
         self._optimizer = optimizer
         if loss is not None and not (isinstance(loss, Layer)
                                      or callable(loss)):
@@ -174,7 +184,8 @@ class Model:
             else None
         self._fused_n_in = n_in
         self._train_step = jit_mod.TrainStep(
-            loss_fn, self._optimizer, amp=amp, donate=donate)
+            loss_fn, self._optimizer, amp=amp, donate=donate,
+            mesh_plan=getattr(self, "_mesh_plan", None))
         return self._train_step
 
     def _train_batch_fused(self, inputs, labels):
@@ -252,9 +263,22 @@ class Model:
             # join against ROOFLINE.json: publishes roofline.mfu_gap and
             # the per-phase gap attribution (no-op without the file)
             from ..observability import roofline_attr
+            comm_by_axis = None
+            mp = getattr(self, "_mesh_plan", None)
+            if mp is not None:
+                comm_by_axis = mp.collective_bytes_by_axis() or None
+                if comm_by_axis:
+                    axis_bytes = reg.counter(
+                        "collective.axis_bytes_total",
+                        "analytic per-step collective bytes of the "
+                        "compiled SPMD train step, by mesh axis",
+                        labelnames=("axis",))
+                    for ax, nb in comm_by_axis.items():
+                        axis_bytes.labels(axis=ax).inc(nb)
             roofline_attr.observe_train_step(
                 dt, observed_mfu=mfu, tokens=tokens or None,
-                params=self._param_count_estimate())
+                params=self._param_count_estimate(),
+                comm_bytes_by_axis=comm_by_axis)
 
     def _param_count_estimate(self) -> Optional[int]:
         """Cached trainable-parameter count (roofline config matching)."""

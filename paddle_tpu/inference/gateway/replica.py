@@ -61,6 +61,25 @@ class Replica:
         # affinity policy's proxy for "compile cache is warm here"
         self.warm_buckets: Set[int] = set()
 
+    @property
+    def shard_group(self):
+        """The batcher's tensor-parallel ``distributed.mesh.ShardGroup``
+        when it serves as one logical TP replica (weights/KV split over
+        the mesh's tensor axis), else None. A member death there raises
+        the non-retryable TPMemberDied from the batcher's step — the
+        pool's ordinary fatal path declares the WHOLE group dead."""
+        return getattr(self.batcher, "shard_group", None)
+
+    def describe(self) -> Dict[str, object]:
+        d: Dict[str, object] = {
+            "name": self.name, "alive": self.alive,
+            "draining": self.draining, "load": self.load,
+        }
+        group = self.shard_group
+        if group is not None:
+            d["shard_group"] = group.describe()
+        return d
+
     # -- the KV-aware routing surface -----------------------------------------
     def prefix_summary(self) -> Optional[Dict[str, object]]:
         """Hashed radix-tree advertisement for KV-aware routing
@@ -98,8 +117,11 @@ class Replica:
                 and self.health.state != HealthState.UNREADY)
 
     def __repr__(self):
+        group = self.shard_group
+        tp = (f", tp={group.name}x{group.degree}"
+              if group is not None else "")
         return (f"Replica({self.name!r}, load={self.load}, "
-                f"alive={self.alive}, draining={self.draining})")
+                f"alive={self.alive}, draining={self.draining}{tp})")
 
 
 class ReplicaPool:

@@ -398,6 +398,13 @@ class _BatcherBase:
         try:
             from ..resilience.chaos import fault_point
             fault_point("serving.step")
+            group = getattr(self, "shard_group", None)
+            if group is not None:
+                # tensor-parallel shard group: a dead member means this
+                # engine's weights/KV shard is gone — TPMemberDied is
+                # non-retryable by design (the gateway declares the
+                # whole group dead and requeues token-exact)
+                group.heartbeat()
             finished = self._step_impl()
         except Exception:
             self.health.on_step_error()

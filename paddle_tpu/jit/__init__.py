@@ -553,16 +553,24 @@ class TrainStep:
     pure update (optimizer.py `_update`).
     """
 
-    def __init__(self, train_fn: Callable, optimizer, amp=None, donate=True):
+    def __init__(self, train_fn: Callable, optimizer, amp=None, donate=True,
+                 mesh_plan=None):
         """donate=True donates the param/master/opt-state device buffers to
         each compiled step (XLA updates them in place — halves HBM for the
         update). Tensors aliasing those buffers from BEFORE the step (e.g. a
         `.detach()` snapshot of a weight) become invalid afterwards and raise
-        loudly on use; pass donate=False to keep old buffers alive."""
+        loudly on use; pass donate=False to keep old buffers alive.
+
+        mesh_plan (a ``distributed.mesh.TrainMeshPlan``) compiles the step
+        SPMD: params/masters/optimizer state live sharded per the plan's
+        ``in_shardings``/``out_shardings``, grads are constrained onto the
+        param placement, and the program is refused (SH201/MEM301) by the
+        runtime gate before any compile."""
         self._fn = train_fn
         self._opt = optimizer
         self._amp = amp  # optional paddle_tpu.amp.auto_cast factory kwargs
         self._donate = donate
+        self._mesh_plan = mesh_plan
         self._cache: Dict[Any, dict] = {}
 
     def __call__(self, *args):
@@ -660,6 +668,9 @@ class TrainStep:
 
         clip = opt._grad_clip
         fn = self._loss_fn
+        mesh_plan = self._mesh_plan
+        if mesh_plan is not None:
+            mesh_plan.register_params(params)
 
         def pure(p_arrays, masters, opt_states, extra_arrays, other_grads_in,
                  rng_key, lr, *batch):
@@ -668,8 +679,11 @@ class TrainStep:
             saved_o = [(t, t._grad) for t in other_grad_ts]
             saved_key = gen.get_state()
             try:
-                for p, a in zip(params, p_arrays):
-                    p._data = a
+                for i, (p, a) in enumerate(zip(params, p_arrays)):
+                    # stage-3 storage sharding: the stored shard gathers
+                    # to its compute placement at use
+                    p._data = (a if mesh_plan is None
+                               else mesh_plan.constrain_param_for_use(i, a))
                     p._grad = None
                 for t, a in zip(extra, extra_arrays):
                     t._data = a
@@ -682,6 +696,12 @@ class TrainStep:
                 _engine.run_backward([loss_t], [None])
                 grads = [None if p._grad is None else p._grad._data
                          for p in params]
+                if mesh_plan is not None:
+                    # land each grad on its param's placement so XLA
+                    # scatters instead of keeping a full copy per chip
+                    grads = [g if g is None
+                             else mesh_plan.constrain_grad(i, g)
+                             for i, g in enumerate(grads)]
                 gs = getattr(opt, "_group_sharded", None)
                 if gs is not None:
                     # ZeRO stage-2/3: constrain grads Shard(0) over the
@@ -734,14 +754,56 @@ class TrainStep:
         # Donate params/masters/opt-state buffers: every one is fully
         # replaced after the step, so XLA reuses their HBM in place (halves
         # steady-state memory for the update).
-        compiled = jax.jit(
-            pure, donate_argnums=(0, 1, 2) if self._donate else ())
+        donate_argnums = (0, 1, 2) if self._donate else ()
+        if mesh_plan is None:
+            compiled = jax.jit(pure, donate_argnums=donate_argnums)
+        else:
+            p_arrays = [p._data for p in params]
+            masters_l = [opt._master_weights.get(id(p)) if um else None
+                         for p, um in zip(params, use_master)]
+            opt_states_l = [{n: opt._accumulators[n][id(p)]
+                             for n in opt._state_names()} for p in params]
+            extra_arrays = [t._data for t in extra]
+            other_grads_in = [None if t._grad is None else t._grad._data
+                              for t in other_grad_ts]
+            batch_arrs = [a._data if _is_tensor(a) else a for a in args]
+            lr0 = jnp.asarray(opt.get_lr(), jnp.float32)
+            in_sh, out_sh = mesh_plan.step_shardings(
+                p_arrays, masters_l, opt_states_l, extra_arrays,
+                other_grads_in, batch_arrs, n_extra_out=len(extra_mut))
+            # runtime SH201/MEM301 gate over the ACTUAL step jaxpr and the
+            # exact specs it will compile with — refuses before any XLA time
+            jaxpr = jax.make_jaxpr(pure)(
+                p_arrays, masters_l, opt_states_l, extra_arrays,
+                other_grads_in, gen.get_state(), lr0, *batch_arrs)
+            n_donated = len(jax.tree_util.tree_leaves(
+                (p_arrays, masters_l, opt_states_l)))
+            mesh_plan.gate(jaxpr=jaxpr,
+                           donate=tuple(range(n_donated)) if self._donate
+                           else (),
+                           invar_specs=mesh_plan.flat_invar_specs(in_sh))
+            # commit state to its sharded residence (AFTER the eager
+            # discovery step: eager ops cannot touch non-addressable
+            # shards in a multi-process world)
+            placed_masters, placed_states = mesh_plan.place_state(
+                params, masters_l, opt_states_l)
+            for p, um, m in zip(params, use_master, placed_masters):
+                if um:
+                    opt._master_weights[id(p)] = m
+            for p, st in zip(params, placed_states):
+                for name, v in st.items():
+                    opt._accumulators[name][id(p)] = v
+            compiled = jax.jit(pure, donate_argnums=donate_argnums,
+                               in_shardings=in_sh, out_shardings=out_sh)
         return {"compiled": compiled, "params": params, "extra": extra,
                 "extra_mut": extra_mut, "other_grad_ts": other_grad_ts,
                 "use_master": use_master, "rng_used": rng_used,
                 "first_loss": loss.detach()}
 
-    def _run(self, entry, args):
+    def _assemble(self, entry, args):
+        """The compiled step's live argument tuple, exactly as one
+        invocation passes it (shared by ``_run`` and the mesh
+        memory-measurement path)."""
         opt = self._opt
         gen = _random.default_generator()
         params = entry["params"]
@@ -762,10 +824,57 @@ class TrainStep:
                           for t in entry["other_grad_ts"]]
         batch = [a._data if _is_tensor(a) else a for a in args]
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        rng_key = gen.get_state()
+        mp = self._mesh_plan
+        if mp is not None:
+            # commit per-step host inputs to the mesh (a multi-process
+            # world cannot auto-commit host arrays to a global sharding;
+            # state args are already mesh-resident from _build)
+            batch = mp.place_batch(batch)
+            place = mp.runtime.place
+            extra_arrays = [place(a, ()) for a in extra_arrays]
+            other_grads_in = [None if g is None else place(g, ())
+                              for g in other_grads_in]
+            lr = place(lr, ())
+            rng_key = place(rng_key, ())
+        return (p_arrays, masters, opt_states, extra_arrays,
+                other_grads_in, rng_key, lr, *batch)
+
+    def mesh_memory_report(self, *args, tolerance: float = 0.10):
+        """Runtime/static memory cross-check for the compiled SPMD step.
+
+        AOT-compiles the cached step at the live state's shapes, reads
+        XLA's OWN per-chip buffer assignment, and verifies it against the
+        liveness-walk prediction the gate used (gauges
+        ``mesh.live_bytes_{measured,predicted,agreement}``). Returns the
+        report dict, or None when there is no mesh plan / the backend
+        exposes no memory analysis. Call after at least one step."""
+        mp = self._mesh_plan
+        if mp is None or not self._cache:
+            return None
+        from ..distributed.mesh import MeshRuntime
+        entry = (self._cache.get(_sig_of(args, {})) if args
+                 else next(iter(self._cache.values())))
+        if entry is None or entry.get("first_loss") is not None:
+            return None
+        call_args = self._assemble(entry, args) if args else None
+        if call_args is None:
+            return None
+        exe = entry["compiled"].lower(*call_args).compile()
+        measured = MeshRuntime.measured_live_bytes(exe)
+        predicted = mp.memory_report
+        if measured is None or not predicted:
+            return None
+        return mp.runtime.verify_live_bytes(measured, predicted,
+                                            tolerance=tolerance)
+
+    def _run(self, entry, args):
+        opt = self._opt
+        gen = _random.default_generator()
+        params = entry["params"]
+        use_master = entry["use_master"]
         (loss, new_p, new_masters, new_states, new_extra, new_other_grads,
-         new_key) = entry["compiled"](p_arrays, masters, opt_states,
-                                      extra_arrays, other_grads_in,
-                                      gen.get_state(), lr, *batch)
+         new_key) = entry["compiled"](*self._assemble(entry, args))
         for p, a in zip(params, new_p):
             p._data = a
         for p, um, m in zip(params, use_master, new_masters):

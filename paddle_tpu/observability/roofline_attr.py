@@ -115,8 +115,18 @@ def _gauges():
 def observe_train_step(step_s: float, observed_mfu: float,
                        tokens: Optional[int] = None,
                        params: Optional[int] = None,
-                       config: Optional[str] = None) -> Optional[dict]:
+                       config: Optional[str] = None,
+                       comm_bytes_by_axis: Optional[Dict[str, float]] = None
+                       ) -> Optional[dict]:
     """Join one train-step timing against the roofline; publish gauges.
+
+    ``comm_bytes_by_axis`` (from the SPMD mesh plan: analytic per-step
+    collective bytes by mesh axis) splits the overhead fraction further
+    into per-axis communication phases ``comm:{axis}``, each priced at
+    the roofline's interconnect bandwidth — overhead then means "not
+    compute, not HBM, not mandated collectives". Omitted (every
+    single-chip caller), the published series are exactly the previous
+    three phases — byte-identical output.
 
     Returns the attribution dict (also useful to callers/tests), or
     None when no roofline model is available.
@@ -136,6 +146,17 @@ def observe_train_step(step_s: float, observed_mfu: float,
     compute_frac = min(1.0, tc / step_s)
     memory_frac = min(1.0 - compute_frac, max(0.0, tm - tc) / step_s)
     overhead_frac = max(0.0, (step_s - t_ideal) / step_s)
+    comm_fracs: Dict[str, float] = {}
+    if comm_bytes_by_axis:
+        from ..analysis.sharding import ici_bytes_per_s
+        bw = ici_bytes_per_s(roofline)
+        for axis, nb in sorted(comm_bytes_by_axis.items()):
+            if bw <= 0 or nb <= 0:
+                continue
+            # mandated comm time, capped by what overhead has left
+            frac = min(max(0.0, overhead_frac), (nb / bw) / step_s)
+            comm_fracs[axis] = frac
+            overhead_frac = max(0.0, overhead_frac - frac)
     g = _gauges()
     g["observed"].set(float(observed_mfu))
     g["ceiling"].set(ceiling)
@@ -144,11 +165,16 @@ def observe_train_step(step_s: float, observed_mfu: float,
     g["attr"].labels(phase="compute").set(compute_frac)
     g["attr"].labels(phase="memory").set(memory_frac)
     g["attr"].labels(phase="overhead").set(overhead_frac)
-    return {"config": cfg.get("config"), "mfu_ceiling": ceiling,
-            "mfu_gap": ceiling - float(observed_mfu),
-            "bound": cfg.get("bound"),
-            "compute_frac": compute_frac, "memory_frac": memory_frac,
-            "overhead_frac": overhead_frac}
+    for axis, frac in comm_fracs.items():
+        g["attr"].labels(phase=f"comm:{axis}").set(frac)
+    out = {"config": cfg.get("config"), "mfu_ceiling": ceiling,
+           "mfu_gap": ceiling - float(observed_mfu),
+           "bound": cfg.get("bound"),
+           "compute_frac": compute_frac, "memory_frac": memory_frac,
+           "overhead_frac": overhead_frac}
+    if comm_fracs:
+        out["comm_fracs"] = comm_fracs
+    return out
 
 
 def observe_serving_step(step_s: float, tokens: int,

@@ -284,6 +284,51 @@ def test_gateway_replica_death_requeues_token_exact(lm):
     assert s["completions"] == 4 and s["failures"] == 0
 
 
+def test_gateway_tp_shard_group_member_death_requeues_token_exact(lm):
+    """Tensor-parallel flavor of the failover drill: replica r0 is a
+    2-way TP shard group (weights P(None,'tensor'), KV sharded on
+    heads). Chaos kills ONE group member mid-decode; the batcher's
+    heartbeat raises the non-retryable TPMemberDied, the pool declares
+    the WHOLE group dead (a member held 1/2 of the weights), and every
+    in-flight request resumes token-exact on the plain survivor."""
+    from paddle_tpu.distributed.mesh import MeshRuntime
+    from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+
+    # a private model instance: shard_serving re-places its weights on
+    # the mesh, which must not leak into the module-scoped fixture
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64,
+                     dropout=0.0)
+    tp_lm = GPT2ForCausalLM(cfg)
+    tp_lm.eval()
+
+    prompts = _prompts(6, (5, 9, 7, 11))
+    refs = [_ref(lm, p, 10) for p in prompts]
+    gw = Gateway(policy="least_loaded")
+    b0 = _batcher(tp_lm)
+    group = MeshRuntime({"tensor": 2}).shard_serving(b0, group_name="tp0")
+    gw.add_replica("r0", b0)
+    gw.add_replica("r1", _batcher(lm))
+    rep0 = gw.pool.get("r0")
+    assert rep0.shard_group is group and "tp=tp0x2" in repr(rep0)
+
+    gids = [gw.submit(p, 10) for p in prompts]
+    arm_scenario("seed=0; serving.tp_member:transient_error:after=6,count=1")
+    for _ in range(1000):
+        if not gw._has_work():
+            break
+        gw.step()
+    s = gw.stats()
+    assert s["requeued"] > 0
+    assert [r.name for r in gw.pool.replicas() if not r.alive] == ["r0"]
+    assert group.failed_members == ["tp0/tensor1"]
+    assert rep0.describe()["shard_group"]["failed"] == ["tp0/tensor1"]
+    for g, ref in zip(gids, refs):
+        assert np.array_equal(gw.pop_result(g), ref)  # zero lost/dup tokens
+    assert s["completions"] == 4 and s["failures"] == 0
+
+
 def test_affinity_policy_prefers_deepest_cached_prefix():
     """KV-aware tier: the replica advertising the deepest chain-hash
     match wins over session/bucket warmth and load order."""

@@ -396,6 +396,37 @@ def test_bench_guard_fails_synthetic_regression(tmp_path):
     assert rep["series"][tpu_key]["status"] == "insufficient_history"
 
 
+def test_bench_guard_multichip_lane_disjoint(tmp_path):
+    """MULTICHIP_r*.json is its own lane: pre-lane dry-run wrappers
+    (rounds without a parsed bench line) skip cleanly, the series gates
+    independently, and train-lane history is never consulted."""
+    (tmp_path / "MULTICHIP_r05.json").write_text(json.dumps(
+        {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+         "tail": "dryrun_multichip(8): OK"}))
+    hist = [350.0, 362.0, 371.0, 380.0]
+    for i, v in enumerate(hist, start=6):
+        (tmp_path / f"MULTICHIP_r{i:02d}.json").write_text(json.dumps(
+            {"metric": "multichip_sharded_train_tokens_per_sec",
+             "value": v, "unit": "tokens/s",
+             "detail": {"tpu": False}}))
+    ok = _guard(["--check", "--dir", str(tmp_path), "--json"])
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    report = json.loads(ok.stdout)
+    key = "multichip:multichip_sharded_train_tokens_per_sec/cpu"
+    assert report["series"][key]["status"] == "pass"
+    assert list(report["series"]) == [key]   # no train/gateway bleed
+    assert any(s["lane"] == "multichip" and s["round"] == 5
+               for s in report["skipped"])
+    # a 20% sharded-rate drop gates this lane like any other
+    (tmp_path / "MULTICHIP_r10.json").write_text(json.dumps(
+        {"metric": "multichip_sharded_train_tokens_per_sec",
+         "value": 0.8 * hist[-1], "unit": "tokens/s",
+         "detail": {"tpu": False}}))
+    bad = _guard(["--check", "--dir", str(tmp_path), "--json"])
+    assert bad.returncode == 1
+    assert json.loads(bad.stdout)["series"][key]["status"] == "regression"
+
+
 def test_telemetry_dump_chrome_and_slo_flags():
     """Flag plumbing only (--no-workload keeps it fast)."""
     tool = os.path.join(REPO, "tools", "telemetry_dump.py")

@@ -41,10 +41,12 @@ def discover(dirpath: str, prefix: str = "BENCH_r") -> List[dict]:
     Each returned dict is the PARSED bench line plus ``_round``/``_file``
     bookkeeping; unusable rounds appear with ``_skip`` set (reason).
     The default prefix is the train lane; the gateway lane lives in
-    ``BENCH_GATEWAY_r*.json`` (bench_gateway.py writes it) and is pulled
-    in by ``run_check`` with its own prefix — the two globs are disjoint
-    so the relay gate (train-lane-only by construction) never sees
-    gateway rounds."""
+    ``BENCH_GATEWAY_r*.json`` (bench_gateway.py writes it) and the
+    multichip lane in ``MULTICHIP_r*.json`` (bench_multichip.py) — both
+    pulled in by ``run_check`` with their own prefixes. The globs are
+    disjoint, so the relay gate (train-lane-only by construction) never
+    sees gateway/multichip rounds, and pre-lane MULTICHIP artifacts
+    (raw dry-run wrappers without a parsed bench line) skip cleanly."""
     out: List[dict] = []
     rx = re.compile(re.escape(prefix) + r"(\d+)\.json$")
     for path in sorted(glob.glob(os.path.join(dirpath,
@@ -151,7 +153,10 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
     gw_records = discover(dirpath, prefix="BENCH_GATEWAY_r")
     for r in gw_records:
         r["_lane"] = "gateway"
-    records = records + gw_records
+    mc_records = discover(dirpath, prefix="MULTICHIP_r")
+    for r in mc_records:
+        r["_lane"] = "multichip"
+    records = records + gw_records + mc_records
     report = {
         "dir": dirpath,
         "tolerance": tolerance,

@@ -49,6 +49,71 @@ def _load_json(path):
         return json.load(fh)
 
 
+def _check_runtime_dump(dump, hbm_gib=None, file="<runtime>"):
+    """Gate a ``MeshRuntime.describe()`` JSON dump — the EXACT specs a
+    live mesh program executes (not the PLAN mirror). Re-runs SH201 over
+    every param spec (train + serving shard-group) and MEM301 over the
+    per-chip byte accounting, so CI lints what runs.
+
+    Returns (findings, rows) where rows is one per-chip byte summary.
+    """
+    results = []
+    mesh = dump.get("mesh") or {}
+    mesh_spec = sharding_mod.MeshSpec.from_any(mesh)
+    budget = hbm_gib if hbm_gib is not None else dump.get("hbm_per_chip_gib")
+
+    def _frac(spec):
+        deg = 1
+        for d in spec:
+            for a in (d if isinstance(d, (list, tuple)) else
+                      (d,) if d else ()):
+                deg *= mesh_spec.axes.get(a, 1)
+        return 1.0 / max(deg, 1)
+
+    per_chip = 0.0
+    n_params = 0
+    entries = dict(dump.get("params") or {})
+    serving = dump.get("serving") or {}
+    for k, v in (serving.get("params") or {}).items():
+        if isinstance(v, dict):           # runtime dumps carry shapes
+            entries.setdefault(f"serving:{k}", v)
+    for name, ent in entries.items():
+        if not isinstance(ent, dict) or "shape" not in ent:
+            continue
+        shape = tuple(ent["shape"])
+        spec = tuple(tuple(d) if isinstance(d, list) else d
+                     for d in ent.get("spec", ()))
+        results.extend(sharding_mod.check_spec_divisibility(
+            name, shape, spec, mesh_spec, file=file))
+        per_chip += sharding_mod.nbytes(
+            shape, ent.get("dtype", "float32")) * _frac(spec)
+        n_params += 1
+    for ent in dump.get("batch") or []:
+        spec = tuple(tuple(d) if isinstance(d, list) else d
+                     for d in ent.get("spec", ()))
+        per_chip += sharding_mod.nbytes(
+            tuple(ent["shape"]), ent.get("dtype", "float32")) * _frac(spec)
+
+    # prefer the runtime's own liveness-walk prediction (counts masters/
+    # optimizer state/transients); fall back to the raw param accounting
+    memory = dump.get("memory") or {}
+    peak = memory.get("predicted_peak_bytes") or per_chip
+    gib = 1024.0 ** 3
+    if budget is not None and peak > budget * gib:
+        results.append(findings_mod.Finding(
+            "MEM301",
+            f"runtime mesh program needs {peak / gib:.3f} GiB/chip but "
+            f"hbm_per_chip_gib is {budget:.3f} — OOM before step 1",
+            file=file, severity=findings_mod.ERROR,
+            extra={"peak_bytes": peak, "budget_gib": budget}))
+    rows = [{"mesh": dict(mesh), "n_params": n_params,
+             "param_bytes_per_chip": per_chip,
+             "predicted_peak_bytes": peak,
+             "hbm_per_chip_gib": budget,
+             "fits": budget is None or peak <= budget * gib}]
+    return results, rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="shard_check",
@@ -56,6 +121,10 @@ def main(argv=None) -> int:
                     "(SH/MEM rules)")
     ap.add_argument("--plan", default=DEFAULT_PLAN,
                     help="PLAN_7B.json to gate (default: repo root)")
+    ap.add_argument("--from-runtime", default=None, metavar="DUMP",
+                    help="gate a MeshRuntime.describe() JSON dump (the "
+                         "specs a live mesh program executes) instead of "
+                         "the PLAN mirror; '-' reads stdin")
     ap.add_argument("--roofline", default=DEFAULT_ROOFLINE,
                     help="ROOFLINE.json for the SH203 interconnect budget "
                          "(pass 'none' to skip SH203)")
@@ -87,6 +156,37 @@ def main(argv=None) -> int:
                     help="exit non-zero on warnings too, and error even "
                          "on documented-infeasible variants")
     args = ap.parse_args(argv)
+
+    if args.from_runtime:
+        dump = (json.load(sys.stdin) if args.from_runtime == "-"
+                else _load_json(args.from_runtime))
+        results, rows = _check_runtime_dump(
+            dump, hbm_gib=args.hbm_gib,
+            file=(os.path.basename(args.from_runtime)
+                  if args.from_runtime != "-" else "<stdin>"))
+        if args.rules:
+            wanted = {r.strip().upper() for r in args.rules.split(",")}
+            results = [f for f in results if f.rule in wanted]
+        if args.json:
+            print(json.dumps({
+                "mode": "from-runtime",
+                "runtime": rows,
+                "findings": [f.to_dict() for f in results],
+                "summary": findings_mod.summarize(results)}, indent=2))
+        else:
+            for r in rows:
+                mark = "ok  " if r["fits"] else "OVER"
+                gib = 1024.0 ** 3
+                print(f"  [{mark}] runtime mesh {r['mesh']} "
+                      f"{r['n_params']} params "
+                      f"{r['predicted_peak_bytes'] / gib:.3f} GiB/chip "
+                      f"(budget {r['hbm_per_chip_gib']})")
+            for f in results:
+                print(f)
+            print(findings_mod.summarize(results))
+        if findings_mod.has_errors(results):
+            return 1
+        return 1 if (args.strict and results) else 0
 
     plan = _load_json(args.plan)
     plan_name = os.path.basename(args.plan)
