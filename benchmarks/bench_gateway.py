@@ -164,10 +164,23 @@ def _shared_prefix_bench(model, vocab, on_tpu, compile):
                     tenant="warmup")
         gw.run_until_done()
         hit0, miss0 = _cache_totals(gw)
+        # goodput attribution over the measured window only: snapshot
+        # the recorder's trace ids so warmup/cold prefills stay out
+        from paddle_tpu.observability.ledger import ledger_from_waterfalls
+        from paddle_tpu.observability.trace_context import get_recorder
+        from paddle_tpu.observability.waterfall import build_waterfalls
+        rec = get_recorder()
+        pre_ids = set(rec.trace_ids())
         rate, ttfts = _drive_prompts(gw, prompts, new_toks)
         hit1, miss1 = _cache_totals(gw)
+        meas_spans = [s for s in rec.spans()
+                      if s.trace_id not in pre_ids]
+        led = ledger_from_waterfalls(build_waterfalls(meas_spans))
         runs[label] = {"rate": rate, "ttfts": ttfts,
-                       "hit": hit1 - hit0, "miss": miss1 - miss0}
+                       "hit": hit1 - hit0, "miss": miss1 - miss0,
+                       "ledger": led.summary()}
+        if label == "on":
+            led.publish()   # ledger.* series join the telemetry snapshot
         for rep in gw.pool.replicas():
             rep.batcher.audit_pages()   # pages_leaked must stay 0
     hit, miss = runs["on"]["hit"], runs["on"]["miss"]
@@ -179,6 +192,17 @@ def _shared_prefix_bench(model, vocab, on_tpu, compile):
                                               1e-9), 4)
     out["shared_tokens_per_s_cache_on"] = round(runs["on"]["rate"], 2)
     out["shared_tokens_per_s_cache_off"] = round(runs["off"]["rate"], 2)
+    # trace-derived goodput (observability.ledger over the measured
+    # window's waterfalls): cache-on must spend a larger fraction of its
+    # chip-seconds on non-waste — bench_guard gates this like throughput
+    for label in ("on", "off"):
+        ls = runs[label]["ledger"]
+        out[f"goodput_frac_cache_{label}"] = round(ls["goodput_frac"], 4)
+        out[f"prefill_chip_s_cache_{label}"] = round(
+            ls["by_phase"].get("prefill", 0.0), 4)
+    out["waste_seconds_cache_on"] = {
+        k: round(v, 4)
+        for k, v in runs["on"]["ledger"]["waste_seconds"].items()}
 
     # control: NO shared prefix — the cache must not tax the miss path
     ctl = {}

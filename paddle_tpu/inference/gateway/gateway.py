@@ -270,7 +270,7 @@ class Gateway:
             # (queue/admit/prefill/decode/stream) shares this trace_id,
             # including after a requeue off a dead replica
             req.trace = _trace.new_trace("gateway.request", gid=gid,
-                                         tenant=tenant)
+                                         tenant=tenant, rung=req.bucket)
             req.spans["queue"] = req.trace.begin("queue",
                                                  priority=req.priority)
         self._requests[gid] = req
@@ -372,6 +372,19 @@ class Gateway:
         qs = req.spans.pop("queue", None)
         if qs is not None:
             qs.end(replica=rep.name, attempt=req.attempts + 1)
+        if req.trace is not None:
+            # baggage merges into every span begun from here on: batcher
+            # spans name the replica (and TP shard members) serving them
+            # — after a requeue the NEXT assignment overwrites these, so
+            # post-failover spans carry the survivor
+            req.trace.baggage["replica"] = rep.name
+            group = rep.shard_group
+            if group is not None:
+                req.trace.baggage["tp_group"] = group.name
+                req.trace.baggage["tp_members"] = ",".join(group.members)
+            else:
+                req.trace.baggage.pop("tp_group", None)
+                req.trace.baggage.pop("tp_members", None)
         req.rid = rep.batcher.submit(ids, req.remaining,
                                      deadline_s=budget,
                                      trace=req.trace)

@@ -45,6 +45,18 @@ def _step_seconds_h():
         labelnames=("replica",))
 
 
+def _member_step_seconds_h():
+    # per-shard-member view of the same step: a tensor-parallel group
+    # used to show up as one opaque replica — this names the mesh
+    # members that actually held chips for the step (member == replica
+    # name for a plain single-engine replica)
+    from ...observability.metrics import get_registry
+    return get_registry().histogram(
+        "replica.step_seconds",
+        "wall time of one engine step per shard-group member",
+        labelnames=("replica", "member"))
+
+
 class Replica:
     """One serving engine in the pool."""
 
@@ -198,8 +210,15 @@ class ReplicaPool:
         try:
             rids = self.step_retry.call(rep.batcher.step,
                                         point=f"gateway.step.{rep.name}")
-            _step_seconds_h().labels(replica=rep.name).observe(
-                _time.perf_counter() - t0)
+            elapsed = _time.perf_counter() - t0
+            _step_seconds_h().labels(replica=rep.name).observe(elapsed)
+            group = rep.shard_group
+            members = ([m for m in group.members
+                        if m not in group.failed_members]
+                       if group is not None else [rep.name])
+            mh = _member_step_seconds_h()
+            for member in members:
+                mh.labels(replica=rep.name, member=member).observe(elapsed)
             return "ok", rids
         except RetryGiveUp as exc:
             self._kill(rep)

@@ -313,13 +313,29 @@ class _BatcherBase:
     # batchers, tracing disabled): one attribute check per event.
     def _trace_admit_begin(self, req: Request):
         if req.trace is not None:
-            req.spans["admit"] = req.trace.begin("admit",
-                                                 engine=self._engine)
+            tags = {"engine": self._engine}
+            group = getattr(self, "shard_group", None)
+            if group is not None:
+                # tensor-parallel group: name the members so the
+                # waterfall shows WHICH shards this admit rode on
+                tags["tp_group"] = group.name
+                tags["tp_members"] = ",".join(group.members)
+            req.spans["admit"] = req.trace.begin("admit", **tags)
 
     def _trace_prefill_begin(self, req: Request):
         if req.trace is not None:
+            tags = {}
+            if req.tokens:
+                # preemption resume: this prefill recomputes KV the
+                # eviction threw away (prompt + already-decoded tokens)
+                tags["evict_recompute"] = 1
+            elif req.trace.baggage.get("requeued"):
+                # failover survivor: the prompt re-prefill duplicates
+                # work the dead replica already did — the ledger costs
+                # this interval as waste.requeue_recompute
+                tags["requeue_recompute"] = 1
             req.spans["prefill"] = req.trace.begin(
-                "prefill", parent=req.spans.get("admit"))
+                "prefill", parent=req.spans.get("admit"), **tags)
 
     def _trace_prefill_end(self, req: Request, **tags):
         sp = req.spans.pop("prefill", None)
@@ -1281,7 +1297,8 @@ class PagedContinuousBatcher(_BatcherBase):
                     L // self.block_size)
                 self._slot_nodes[slot] = list(matched) + new_nodes
             self._trace_prefill_end(req, prompt_tokens=len(ids_np),
-                                    pages=need, prefix_hit=m_rows)
+                                    pages=need, prefix_hit=m_rows,
+                                    padded_to=padded_len)
             tok = int(self._pick(np.asarray(logits._data))[0])
             req.slot = slot
             req.tokens.append(tok)
@@ -1879,6 +1896,17 @@ class PagedContinuousBatcher(_BatcherBase):
             acc = pv[:j] + [int(g[slot, j])]
             self.spec_stats["proposed"] += k
             self.spec_stats["matched"] += j
+            sp = req.spans.get("decode")
+            if sp is not None:
+                # per-request accept accounting on the OPEN decode span
+                # (before _maybe_finish can close it): the goodput
+                # ledger prices rejected draft tokens from these
+                tg = sp.tags
+                tg["spec_proposed"] = int(tg.get("spec_proposed")
+                                          or 0) + k
+                tg["spec_matched"] = int(tg.get("spec_matched")
+                                         or 0) + j
+                tg["spec_rounds"] = int(tg.get("spec_rounds") or 0) + 1
             old_dec = int(self._dec[slot])
             self._dec[slot] = old_dec + len(acc)
             self._ddec[slot] = min(old_dec + k, old_dec + len(acc))

@@ -14,6 +14,12 @@ platform/monitor.h STATS_INT + the host profiler, fused):
     straggler/desync/missing-rank findings (``telemetry_dump --fleet``).
   * ``flight`` — crash-surviving per-rank binary ring journal, replayed
     by ``tools/blackbox.py postmortem``.
+  * ``waterfall`` / ``ledger`` / ``anomaly`` — the attribution layer:
+    per-request critical-path waterfalls reconstructed from recorded
+    spans, the fleet goodput ledger (chip-seconds by tenant/rung/phase
+    with typed waste categories), and streaming EWMA/MAD detectors over
+    per-replica TTFT/TPOT/queue-depth emitting ``FleetFinding``s
+    (``tools/trace_analyze.py`` is the CLI over all three).
 
 Instrumented out of the box: serving batchers (queue depth, admissions,
 preemptions, TTFT / per-token latency), the multi-replica serving
@@ -27,11 +33,13 @@ diagnostic pass counts its findings by rule here).
 """
 from __future__ import annotations
 
-from . import (export, fleet, flight, metrics, roofline_attr, slo,
-               trace_context, tracing)
+from . import (anomaly, export, fleet, flight, ledger, metrics,
+               roofline_attr, slo, trace_context, tracing, waterfall)
+from .anomaly import AnomalyDetector, GatewayProbe
 from .export import load_jsonl, render_prometheus, write_jsonl
 from .fleet import (FleetAggregator, FleetFinding, ProcessIdentity,
                     TelemetrySpool, get_spool, process_identity)
+from .ledger import GoodputLedger, ledger_from_waterfalls
 from .flight import (FlightRecorder, build_postmortem, flight_record,
                      get_flight, read_ring)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -41,10 +49,17 @@ from .trace_context import (TraceContext, TraceRecorder, TraceSpan,
                             get_recorder, new_trace)
 from .tracing import (Span, attach_context, capture_context, current_span,
                       span, span_path, traced)
+from .waterfall import (Waterfall, build_waterfalls,
+                        critical_path_summary, render_waterfall,
+                        waterfalls_from_fleet, waterfalls_from_recorder)
 
 __all__ = [
     "metrics", "tracing", "export", "trace_context", "roofline_attr",
-    "slo", "fleet", "flight",
+    "slo", "fleet", "flight", "waterfall", "ledger", "anomaly",
+    "Waterfall", "build_waterfalls", "waterfalls_from_recorder",
+    "waterfalls_from_fleet", "critical_path_summary", "render_waterfall",
+    "GoodputLedger", "ledger_from_waterfalls",
+    "AnomalyDetector", "GatewayProbe",
     "FleetAggregator", "FleetFinding", "ProcessIdentity",
     "TelemetrySpool", "get_spool", "process_identity",
     "FlightRecorder", "build_postmortem", "flight_record", "get_flight",

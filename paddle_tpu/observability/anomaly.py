@@ -1,0 +1,171 @@
+"""Streaming anomaly detection over per-replica serving latencies.
+
+The fleet plane (``observability.fleet``) diagnoses *collective*
+pathologies — stragglers, desyncs, missing ranks — as typed
+``FleetFinding``s. This module adds the *serving-side* detectors the
+future auto-remediator (ROADMAP item 5) consumes from the SAME stream:
+robust EWMA/MAD change detection over per-replica TTFT, TPOT and
+queue-depth series, emitting ``FleetFinding``s with kinds
+``ttft_spike`` / ``tpot_spike`` / ``queue_depth_spike`` so one consumer
+format covers both planes.
+
+Detection is deliberately robust, not Gaussian: the baseline is the
+rolling **median**, the scale is the **MAD** (median absolute
+deviation, floored at a fraction of the median so a perfectly quiet
+series cannot divide by ~zero), and a sample fires only after a warmup
+of ``min_samples`` observations. An EWMA of the series rides along in
+every finding's detail for the remediator's trend view. Everything is
+deterministic given the observation sequence — chaos drills assert on
+it.
+
+Feeds:
+
+- ``AnomalyDetector.observe(metric, key, value)`` — the raw streaming
+  core (any metric name / series key),
+- ``AnomalyDetector.observe_waterfalls(wfs)`` — offline: derive
+  per-replica TTFT/TPOT observations from reconstructed
+  ``observability.waterfall`` waterfalls (trace-only postmortems),
+- ``GatewayProbe(gw)`` — online: wraps the gateway pool's
+  ``step_replica`` so every engine step feeds a per-replica step-time
+  series ("TPOT proxy": one batched step yields one token per active
+  request) plus the gateway queue depth, with zero gateway code
+  changes.
+"""
+from __future__ import annotations
+
+import time as _time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .fleet import FleetFinding
+
+__all__ = ["AnomalyDetector", "GatewayProbe"]
+
+DEFAULT_THRESHOLD = 6.0       # robust z-score that fires a finding
+DEFAULT_MIN_SAMPLES = 8       # warmup before a series may fire
+DEFAULT_WINDOW = 64           # rolling median/MAD window
+DEFAULT_EWMA_ALPHA = 0.3
+MAD_FLOOR_FRAC = 0.05         # scale floor: 5% of |median|
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class _Track:
+    __slots__ = ("window", "ewma", "count")
+
+    def __init__(self, window: int):
+        self.window: Deque[float] = deque(maxlen=window)
+        self.ewma: Optional[float] = None
+        self.count = 0
+
+
+class AnomalyDetector:
+    """Streaming robust spike detector; findings accumulate on
+    ``self.findings`` in observation order."""
+
+    def __init__(self, threshold: float = DEFAULT_THRESHOLD,
+                 min_samples: int = DEFAULT_MIN_SAMPLES,
+                 window: int = DEFAULT_WINDOW,
+                 ewma_alpha: float = DEFAULT_EWMA_ALPHA):
+        self.threshold = float(threshold)
+        self.min_samples = int(min_samples)
+        self.window = int(window)
+        self.ewma_alpha = float(ewma_alpha)
+        self.findings: List[FleetFinding] = []
+        self._tracks: Dict[Tuple[str, str], _Track] = {}
+        self._seq = 0
+
+    def observe(self, metric: str, key: str,
+                value: float) -> Optional[FleetFinding]:
+        """Feed one sample of ``metric`` for series ``key`` (a replica
+        name, a gateway id...). Returns the finding when this sample is
+        anomalous vs the series' own history, else None."""
+        value = float(value)
+        track = self._tracks.setdefault((metric, key),
+                                        _Track(self.window))
+        finding = None
+        if track.count >= self.min_samples and track.window:
+            med = _median(list(track.window))
+            mad = _median([abs(x - med) for x in track.window]) * 1.4826
+            scale = max(mad, MAD_FLOOR_FRAC * abs(med), 1e-12)
+            score = (value - med) / scale
+            if score >= self.threshold:
+                self._seq += 1
+                finding = FleetFinding(
+                    kind=f"{metric}_spike", op=metric, seq=self._seq,
+                    skew_s=value - med,
+                    detail={"key": key, "value": value, "baseline": med,
+                            "mad": mad, "score": score,
+                            "ewma": track.ewma, "n": track.count})
+                self.findings.append(finding)
+        track.window.append(value)
+        track.count += 1
+        track.ewma = value if track.ewma is None else (
+            self.ewma_alpha * value
+            + (1.0 - self.ewma_alpha) * track.ewma)
+        return finding
+
+    def baseline(self, metric: str, key: str) -> Optional[dict]:
+        track = self._tracks.get((metric, key))
+        if track is None or not track.window:
+            return None
+        med = _median(list(track.window))
+        return {"median": med, "ewma": track.ewma, "n": track.count}
+
+    def observe_waterfalls(self, wfs) -> List[FleetFinding]:
+        """Offline feed: per-replica TTFT/TPOT derived from
+        reconstructed waterfalls, in request start order. The series key
+        is the replica that served the (final) decode."""
+        out: List[FleetFinding] = []
+        for wf in sorted(wfs, key=lambda w: w.t0_ns):
+            key = wf.replicas[-1] if wf.replicas else "unknown"
+            if wf.ttft_s > 0.0:
+                f = self.observe("ttft", key, wf.ttft_s)
+                if f is not None:
+                    out.append(f)
+            tpot = wf.tpot_s
+            if tpot is not None:
+                f = self.observe("tpot", key, tpot)
+                if f is not None:
+                    out.append(f)
+        return out
+
+
+class GatewayProbe:
+    """Online feed: instrument a live ``Gateway`` so every replica step
+    lands in the detector while traffic runs.
+
+    Wraps ``gw.pool.step_replica`` (restored by ``close()``): the wall
+    time of one engine step is the per-replica TPOT proxy — a batched
+    step emits one token per active request, so a replica whose steps
+    suddenly take N x its own median (e.g. the failover survivor
+    absorbing a dead replica's re-prefills) fires ``tpot_spike`` naming
+    that replica in ``detail["key"]``.
+    """
+
+    def __init__(self, gw, detector: Optional[AnomalyDetector] = None):
+        self.gw = gw
+        self.detector = detector or AnomalyDetector()
+        self._orig = gw.pool.step_replica
+        gw.pool.step_replica = self._stepped
+
+    def _stepped(self, rep):
+        t0 = _time.perf_counter()
+        out = self._orig(rep)
+        self.detector.observe("tpot", rep.name,
+                              _time.perf_counter() - t0)
+        self.detector.observe("queue_depth", "gateway",
+                              float(len(self.gw._queue)))
+        return out
+
+    @property
+    def findings(self) -> List[FleetFinding]:
+        return self.detector.findings
+
+    def close(self):
+        """Unhook; the detector (and its findings) stay readable."""
+        self.gw.pool.step_replica = self._orig

@@ -1,0 +1,209 @@
+"""Fleet-wide goodput ledger: chip-seconds attributed by cause.
+
+Aggregates per-request ``Waterfall``s (``observability.waterfall``) into
+the accounting ROADMAP items 4/5 need: every span's *self time* is
+charged to ``{tenant, rung, phase}`` (self time, so nested spans never
+double-bill an interval), chip phases (admit/prefill/decode — time an
+engine actually held the accelerator) are separated from wait phases
+(queue/stream/gateway overhead), and chip time that produced nothing a
+user received is itemized into explicit **waste categories**:
+
+- ``bucket_pad``              — prefill rows burned on bucket-ladder
+                                padding (``padded_to`` vs real
+                                ``prompt_tokens``, prefix hits excluded
+                                from the computed width),
+- ``requeue_recompute``       — the survivor's duplicated prompt
+                                re-prefill after a token-exact failover
+                                (prefill spans tagged
+                                ``requeue_recompute=1``),
+- ``evicted_prefix_recompute``— re-prefill of prompt+tokens after a
+                                preemption evicted the request's KV
+                                (``evict_recompute=1``),
+- ``speculation_rejected``    — the share of decode spent scoring
+                                draft tokens the verifier rejected
+                                (``spec_proposed``/``spec_matched``
+                                tags on the decode span),
+- ``recompile``               — XLA compile seconds pulled from the
+                                ``compile.elapsed`` series (opt-in via
+                                ``add_recompile_from_registry``; compile
+                                time is process-wide, not per-trace).
+
+``goodput_frac`` = 1 - waste/chip. Invariant the drills assert: total
+charged seconds equal the summed span self time — nothing the traces
+saw goes missing and nothing is counted twice. ``publish()`` mirrors
+the ledger into the metrics registry as ``ledger.goodput_frac``,
+``ledger.waste_seconds{category}`` and
+``ledger.chip_seconds{tenant,rung,phase}`` series so exporters,
+bench_gateway artifacts and the future remediator all read one source.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+from .waterfall import Waterfall
+
+__all__ = ["WASTE_CATEGORIES", "CHIP_PHASES", "GoodputLedger",
+           "ledger_from_waterfalls"]
+
+WASTE_CATEGORIES = ("bucket_pad", "requeue_recompute",
+                    "evicted_prefix_recompute", "speculation_rejected",
+                    "recompile")
+# span names that hold an engine (chip time); everything else is wait
+# or gateway overhead — charged, reported, but outside goodput_frac
+CHIP_PHASES = frozenset({"admit", "prefill", "decode"})
+
+
+class GoodputLedger:
+    """Mutable accumulator: ``add()`` waterfalls, read ``summary()``."""
+
+    def __init__(self):
+        self.requests = 0
+        self.incomplete = 0
+        self.charged_s = 0.0          # every span self-second, any phase
+        self.chip_s = 0.0             # admit/prefill/decode self-seconds
+        self.waste: Dict[str, float] = {c: 0.0 for c in WASTE_CATEGORIES}
+        self.by_key: Dict[Tuple[str, str, str], float] = {}
+
+    # -- charging --------------------------------------------------------------
+    def add(self, wf: Waterfall) -> "GoodputLedger":
+        self.requests += 1
+        if wf.incomplete:
+            self.incomplete += 1
+        tenant = wf.tenant if wf.tenant is not None else "unknown"
+        rung = "-" if wf.rung is None else str(wf.rung)
+        for seg in wf.segments:
+            key = (tenant, rung, seg.name)
+            self.by_key[key] = self.by_key.get(key, 0.0) + seg.self_s
+            self.charged_s += seg.self_s
+            if seg.name not in CHIP_PHASES:
+                continue
+            self.chip_s += seg.self_s
+            cat, w = self._waste_of(seg)
+            if cat is not None and w > 0.0:
+                self.waste[cat] += min(w, seg.self_s)
+        return self
+
+    def add_all(self, wfs: Iterable[Waterfall]) -> "GoodputLedger":
+        for wf in wfs:
+            self.add(wf)
+        return self
+
+    @staticmethod
+    def _waste_of(seg) -> Tuple[Optional[str], float]:
+        t = seg.tags
+        if seg.name == "prefill":
+            if t.get("requeue_recompute"):
+                return "requeue_recompute", seg.self_s
+            if t.get("evict_recompute"):
+                return "evicted_prefix_recompute", seg.self_s
+            padded = t.get("padded_to")
+            prompt = t.get("prompt_tokens")
+            if padded and prompt and padded > prompt:
+                # pad rows over the rows prefill actually computed
+                # (prefix-cache hits were never computed at all)
+                computed = max(int(padded) - int(t.get("prefix_hit") or 0),
+                               1)
+                frac = (int(padded) - int(prompt)) / computed
+                return "bucket_pad", seg.self_s * frac
+        elif seg.name == "decode":
+            proposed = int(t.get("spec_proposed") or 0)
+            if proposed > 0:
+                rejected = max(proposed - int(t.get("spec_matched") or 0),
+                               0)
+                rounds = int(t.get("spec_rounds") or 0)
+                # the verify pass scores proposed+rounds positions per
+                # covered token; the rejected share bought nothing
+                frac = rejected / max(proposed + rounds, 1)
+                return "speculation_rejected", seg.self_s * frac
+        return None, 0.0
+
+    def add_recompile_from_registry(self, registry=None) -> float:
+        """Charge XLA compile wall time (the ``compile.elapsed``
+        histogram the jit layer feeds) as ``recompile`` waste. Returns
+        the seconds added. Compile time is process-wide — it joins both
+        the chip total and the waste column so goodput_frac stays a
+        fraction of all accounted chip time."""
+        if registry is None:
+            from .metrics import get_registry
+            registry = get_registry()
+        secs = 0.0
+        for series in registry.snapshot():
+            if series.get("name") == "compile.elapsed":
+                secs += float(series.get("sum") or 0.0)
+        if secs > 0.0:
+            self.waste["recompile"] += secs
+            self.chip_s += secs
+            self.charged_s += secs
+        return secs
+
+    # -- reading ---------------------------------------------------------------
+    @property
+    def waste_s(self) -> float:
+        return sum(self.waste.values())
+
+    @property
+    def goodput_frac(self) -> float:
+        if self.chip_s <= 0.0:
+            return 1.0
+        return max(0.0, 1.0 - self.waste_s / self.chip_s)
+
+    def summary(self) -> dict:
+        by_phase: Dict[str, float] = {}
+        by_tenant: Dict[str, float] = {}
+        by_rung: Dict[str, float] = {}
+        for (tenant, rung, phase), s in self.by_key.items():
+            by_phase[phase] = by_phase.get(phase, 0.0) + s
+            by_tenant[tenant] = by_tenant.get(tenant, 0.0) + s
+            by_rung[rung] = by_rung.get(rung, 0.0) + s
+        return {
+            "requests": self.requests,
+            "incomplete": self.incomplete,
+            "charged_seconds": self.charged_s,
+            "chip_seconds": self.chip_s,
+            "goodput_seconds": max(self.chip_s - self.waste_s, 0.0),
+            "goodput_frac": self.goodput_frac,
+            "waste_seconds": dict(self.waste),
+            "by_phase": dict(sorted(by_phase.items(),
+                                    key=lambda kv: -kv[1])),
+            "by_tenant": dict(sorted(by_tenant.items(),
+                                     key=lambda kv: -kv[1])),
+            "by_rung": dict(sorted(by_rung.items(),
+                                   key=lambda kv: -kv[1])),
+            "attribution": [
+                {"tenant": t, "rung": r, "phase": p,
+                 "seconds": s}
+                for (t, r, p), s in sorted(self.by_key.items(),
+                                           key=lambda kv: -kv[1])],
+        }
+
+    def publish(self, registry=None) -> None:
+        """Mirror the ledger into the metrics registry (gauges, so a
+        re-publish after more traffic just moves the needle)."""
+        if registry is None:
+            from .metrics import get_registry
+            registry = get_registry()
+        registry.gauge(
+            "ledger.goodput_frac",
+            "fraction of accounted chip-seconds that were not waste",
+        ).set(self.goodput_frac)
+        waste_g = registry.gauge(
+            "ledger.waste_seconds",
+            "chip-seconds lost, by cause",
+            labelnames=("category",))
+        for cat, s in self.waste.items():
+            waste_g.labels(category=cat).set(s)
+        chip_g = registry.gauge(
+            "ledger.chip_seconds",
+            "span self-seconds charged by tenant/rung/phase",
+            labelnames=("tenant", "rung", "phase"))
+        for (tenant, rung, phase), s in self.by_key.items():
+            chip_g.labels(tenant=tenant, rung=rung, phase=phase).set(s)
+
+
+def ledger_from_waterfalls(wfs: Iterable[Waterfall],
+                           recompile_from_registry: bool = False
+                           ) -> GoodputLedger:
+    led = GoodputLedger().add_all(wfs)
+    if recompile_from_registry:
+        led.add_recompile_from_registry()
+    return led

@@ -1,0 +1,394 @@
+"""Per-request latency waterfalls reconstructed from trace spans.
+
+The tracing layer (``trace_context``) records what happened —
+queue/admit/prefill/decode/stream spans minted at ``Gateway.submit`` and
+closed as the request moves gateway -> router -> replica -> batcher.
+This module turns those flat span records into *attribution*: one
+``Waterfall`` per trace with
+
+- ordered segments on a common timebase (offsets relative to the root),
+- per-phase totals (queue wait, admission, prefill adjusted for prefix
+  hits, per-token decode, speculation-verify share, requeue overhead
+  after a failover),
+- the **critical path**: at every instant the deepest open span owns the
+  wall clock, so each span is credited only its *self time* (time not
+  covered by a deeper child) and the ordered owner sequence is the
+  critical path through the stack,
+- an explicit ``incomplete`` flag instead of an exception when the
+  record set is torn (a crashed rank's fleet spool missing exit
+  records, a trace whose root never closed): partial waterfalls still
+  render, they just say so.
+
+Span sources are interchangeable: live ``TraceRecorder`` spans, a JSONL
+export, or fleet-spool records from ``FleetAggregator.spans()`` (same
+dict shape plus ``kind``/``t``/``rank`` bookkeeping). The goodput
+ledger (``observability.ledger``) and ``tools/trace_analyze.py`` both
+consume the waterfalls built here.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+__all__ = [
+    "Segment", "Waterfall", "build_waterfalls", "waterfalls_from_recorder",
+    "waterfalls_from_fleet", "critical_path_summary", "render_waterfall",
+]
+
+ROOT_SPAN = "gateway.request"
+
+
+def _coerce(span) -> Optional[dict]:
+    """Normalize one span record: a live ``TraceSpan``, a recorder/JSONL
+    dict, or a fleet-spool record (span dict + ``kind``/``t``/``rank``).
+    Returns None for records that are not spans at all; open spans
+    (``end_ns`` None) come back with ``_open`` set so the builder can
+    flag the trace incomplete instead of raising."""
+    if hasattr(span, "to_dict"):
+        d = span.to_dict()
+    elif isinstance(span, dict):
+        d = span
+    else:
+        return None
+    if d.get("kind") not in (None, "span"):
+        return None
+    tid = d.get("trace_id")
+    sid = d.get("span_id")
+    if tid is None or sid is None:
+        return None
+    start = d.get("start_ns")
+    end = d.get("end_ns")
+    if start is None:
+        # wall-clock-only record (foreign exporter): fall back to t/t_end
+        t = d.get("t")
+        if t is None:
+            return None
+        start = int(float(t) * 1e9)
+        te = d.get("t_end")
+        end = None if te is None else int(float(te) * 1e9)
+    return {
+        "trace_id": tid,
+        "span_id": sid,
+        "parent_id": d.get("parent_id"),
+        "name": d.get("name", "?"),
+        "start_ns": int(start),
+        "end_ns": None if end is None else int(end),
+        "tags": dict(d.get("tags") or {}),
+        "rank": d.get("rank"),
+        "_open": end is None,
+    }
+
+
+@dataclass
+class Segment:
+    """One span placed on the waterfall: offsets are seconds relative to
+    the trace start; ``self_s`` is the span's critical-path credit (time
+    no deeper span was open)."""
+    name: str
+    span_id: str
+    parent_id: Optional[str]
+    start_s: float
+    duration_s: float
+    self_s: float
+    depth: int
+    tags: Dict[str, object] = field(default_factory=dict)
+    rank: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "span_id": self.span_id,
+             "parent_id": self.parent_id,
+             "start_s": round(self.start_s, 9),
+             "duration_s": round(self.duration_s, 9),
+             "self_s": round(self.self_s, 9), "depth": self.depth,
+             "tags": self.tags}
+        if self.rank is not None:
+            d["rank"] = self.rank
+        return d
+
+
+@dataclass
+class Waterfall:
+    """One request's reconstructed timeline + phase attribution."""
+    trace_id: str
+    gid: Optional[int]
+    tenant: Optional[str]
+    rung: Optional[int]
+    t0_ns: int
+    total_s: float
+    segments: List[Segment]
+    critical_path: List[dict]          # ordered {name, span_id, self_s}
+    phases: Dict[str, dict]            # name -> {seconds, self_seconds, count}
+    tokens: Optional[int]
+    requeues: int
+    incomplete: bool
+    replicas: List[str]
+
+    # -- derived attribution ---------------------------------------------------
+    def phase_seconds(self, name: str, self_time: bool = False) -> float:
+        ph = self.phases.get(name)
+        if ph is None:
+            return 0.0
+        return ph["self_seconds"] if self_time else ph["seconds"]
+
+    @property
+    def queue_wait_s(self) -> float:
+        return self.phase_seconds("queue")
+
+    @property
+    def prefill_s(self) -> float:
+        return self.phase_seconds("prefill")
+
+    @property
+    def ttft_s(self) -> float:
+        """Submit to end of (last) prefill — the trace-side TTFT proxy."""
+        ends = [s.start_s + s.duration_s for s in self.segments
+                if s.name == "prefill"]
+        return max(ends) if ends else 0.0
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Per-token decode latency: decode span time over tokens."""
+        dec = self.phase_seconds("decode")
+        if not dec or not self.tokens:
+            return None
+        return dec / max(int(self.tokens), 1)
+
+    def _prefill_tag_sum(self, key: str) -> int:
+        return sum(int(s.tags.get(key) or 0) for s in self.segments
+                   if s.name == "prefill")
+
+    @property
+    def prompt_tokens(self) -> int:
+        return self._prefill_tag_sum("prompt_tokens")
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        """Prompt rows served from the radix prefix cache (the prefill
+        spans' ``prefix_hit`` tags): the rows prefill did NOT compute."""
+        return self._prefill_tag_sum("prefix_hit")
+
+    @property
+    def spec_rejected_tokens(self) -> int:
+        segs = [s for s in self.segments if s.name == "decode"]
+        prop = sum(int(s.tags.get("spec_proposed") or 0) for s in segs)
+        match = sum(int(s.tags.get("spec_matched") or 0) for s in segs)
+        return max(prop - match, 0)
+
+    @property
+    def requeue_overhead_s(self) -> float:
+        """Extra time a failover cost this request: work interrupted on
+        the dead replica, the re-queue wait, and the survivor's
+        duplicated re-prefill (``requeue_recompute=1``)."""
+        out = 0.0
+        for s in self.segments:
+            t = s.tags
+            if t.get("interrupted") or t.get("requeue_recompute") \
+                    or (s.name == "queue" and t.get("requeued")):
+                out += s.duration_s
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id, "gid": self.gid,
+            "tenant": self.tenant, "rung": self.rung,
+            "total_s": round(self.total_s, 9),
+            "incomplete": self.incomplete,
+            "tokens": self.tokens, "requeues": self.requeues,
+            "replicas": self.replicas,
+            "ttft_s": round(self.ttft_s, 9),
+            "tpot_s": (None if self.tpot_s is None
+                       else round(self.tpot_s, 9)),
+            "queue_wait_s": round(self.queue_wait_s, 9),
+            "prefill_s": round(self.prefill_s, 9),
+            "prompt_tokens": self.prompt_tokens,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "spec_rejected_tokens": self.spec_rejected_tokens,
+            "requeue_overhead_s": round(self.requeue_overhead_s, 9),
+            "phases": self.phases,
+            "critical_path": self.critical_path,
+            "segments": [s.to_dict() for s in self.segments],
+        }
+
+
+def _depths(spans: List[dict]) -> Dict[str, int]:
+    by_id = {s["span_id"]: s for s in spans}
+    memo: Dict[str, int] = {}
+
+    def depth(sid: str) -> int:
+        if sid in memo:
+            return memo[sid]
+        memo[sid] = 0  # cycle guard (malformed input)
+        parent = by_id[sid]["parent_id"]
+        d = 0 if parent is None else (
+            depth(parent) + 1 if parent in by_id else 1)
+        memo[sid] = d
+        return d
+
+    return {sid: depth(sid) for sid in by_id}
+
+
+def _build_one(trace_id: str, raw: List[dict]) -> Waterfall:
+    incomplete = any(s["_open"] for s in raw)
+    spans = [s for s in raw if not s["_open"]]
+    ids = {s["span_id"] for s in spans}
+    roots = [s for s in spans if s["parent_id"] is None]
+    root = min(roots, key=lambda s: s["start_ns"]) if roots else None
+    if root is None or any(s["parent_id"] is not None
+                           and s["parent_id"] not in ids for s in spans):
+        # torn record set: a crashed process never spooled the exit
+        # records, so parents (often the root itself) are missing
+        incomplete = True
+    if not spans:
+        return Waterfall(trace_id, None, None, None, 0, 0.0, [], [], {},
+                         None, 0, True, [])
+    spans.sort(key=lambda s: (s["start_ns"], s["end_ns"]))
+    t0 = root["start_ns"] if root is not None \
+        else min(s["start_ns"] for s in spans)
+    t1 = root["end_ns"] if root is not None \
+        else max(s["end_ns"] for s in spans)
+    depth = _depths(spans)
+
+    # critical path: sweep the elementary intervals between span
+    # boundaries; each interval is owned by the deepest (then latest-
+    # started) span covering it — that owner's self time
+    bounds = sorted({b for s in spans for b in (s["start_ns"], s["end_ns"])})
+    self_ns: Dict[str, int] = {s["span_id"]: 0 for s in spans}
+    owners: List[tuple] = []          # (a_ns, b_ns, span)
+    for a, b in zip(bounds, bounds[1:]):
+        if b <= a:
+            continue
+        active = [s for s in spans
+                  if s["start_ns"] <= a and s["end_ns"] >= b]
+        if not active:
+            continue
+        own = max(active, key=lambda s: (depth[s["span_id"]],
+                                         s["start_ns"]))
+        self_ns[own["span_id"]] += b - a
+        if owners and owners[-1][2] is own and owners[-1][1] == a:
+            owners[-1] = (owners[-1][0], b, own)
+        else:
+            owners.append((a, b, own))
+    critical_path = [{"name": s["name"], "span_id": s["span_id"],
+                      "self_s": (b - a) / 1e9}
+                     for a, b, s in owners]
+
+    segments = [Segment(
+        name=s["name"], span_id=s["span_id"], parent_id=s["parent_id"],
+        start_s=(s["start_ns"] - t0) / 1e9,
+        duration_s=(s["end_ns"] - s["start_ns"]) / 1e9,
+        self_s=self_ns[s["span_id"]] / 1e9,
+        depth=depth[s["span_id"]], tags=s["tags"], rank=s["rank"],
+    ) for s in spans]
+
+    phases: Dict[str, dict] = {}
+    for seg in segments:
+        ph = phases.setdefault(seg.name, {"seconds": 0.0,
+                                          "self_seconds": 0.0, "count": 0})
+        ph["seconds"] += seg.duration_s
+        ph["self_seconds"] += seg.self_s
+        ph["count"] += 1
+
+    rtags = root["tags"] if root is not None else {}
+    tokens = rtags.get("tokens")
+    if tokens is None:
+        toks = [s.tags.get("tokens") for s in segments
+                if s.name == "decode" and s.tags.get("tokens") is not None]
+        tokens = toks[-1] if toks else None
+    replicas: List[str] = []
+    for s in segments:
+        r = s.tags.get("replica")
+        if r is not None and r not in replicas:
+            replicas.append(r)
+    return Waterfall(
+        trace_id=trace_id,
+        gid=rtags.get("gid"),
+        tenant=rtags.get("tenant"),
+        rung=rtags.get("rung"),
+        t0_ns=t0,
+        total_s=max(t1 - t0, 0) / 1e9,
+        segments=segments,
+        critical_path=critical_path,
+        phases=phases,
+        tokens=None if tokens is None else int(tokens),
+        requeues=sum(1 for s in segments if s.name == "requeue"),
+        incomplete=incomplete,
+        replicas=replicas,
+    )
+
+
+def build_waterfalls(spans: Iterable) -> List[Waterfall]:
+    """Group span records by trace and reconstruct one ``Waterfall`` per
+    trace, ordered by trace start. Never raises on torn input — partial
+    traces come back with ``incomplete=True``."""
+    groups: Dict[str, List[dict]] = {}
+    for s in spans:
+        d = _coerce(s)
+        if d is None:
+            continue
+        groups.setdefault(d["trace_id"], []).append(d)
+    out = [_build_one(tid, ss) for tid, ss in groups.items()]
+    out.sort(key=lambda w: w.t0_ns)
+    return out
+
+
+def waterfalls_from_recorder(recorder=None) -> List[Waterfall]:
+    """Waterfalls for every trace in the (default) live recorder."""
+    if recorder is None:
+        from .trace_context import get_recorder
+        recorder = get_recorder()
+    return build_waterfalls(recorder.spans())
+
+
+def waterfalls_from_fleet(dirpath: str) -> List[Waterfall]:
+    """Waterfalls from a fleet telemetry spool directory — the offline
+    path: rank shards are parsed tolerant of torn tails, so a crashed
+    rank degrades to partial (``incomplete``) waterfalls."""
+    from .fleet import FleetAggregator
+    return build_waterfalls(FleetAggregator(dirpath).spans())
+
+
+def critical_path_summary(waterfalls: Iterable[Waterfall]) -> Dict[str, float]:
+    """Aggregate critical-path self-seconds by span name across many
+    requests — 'where does the fleet's request wall clock actually go'."""
+    out: Dict[str, float] = {}
+    for wf in waterfalls:
+        for hop in wf.critical_path:
+            out[hop["name"]] = out.get(hop["name"], 0.0) + hop["self_s"]
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+
+def _fmt_s(x: float) -> str:
+    return f"{x * 1e3:.2f}ms" if x < 1.0 else f"{x:.3f}s"
+
+
+def render_waterfall(wf: Waterfall, width: int = 48) -> str:
+    """Fixed-width text waterfall (one bar per segment, offsets to
+    scale) + the critical path — shared by trace_analyze and
+    telemetry_dump --waterfall."""
+    head = (f"trace {wf.trace_id} gid={wf.gid} tenant={wf.tenant} "
+            f"total={_fmt_s(wf.total_s)} tokens={wf.tokens}")
+    if wf.requeues:
+        head += f" requeues={wf.requeues}"
+    if wf.incomplete:
+        head += " [INCOMPLETE]"
+    lines = [head]
+    span = max(wf.total_s, 1e-9)
+    for seg in wf.segments:
+        a = int(round(seg.start_s / span * width))
+        n = max(1, int(round(seg.duration_s / span * width)))
+        a = min(a, width - 1)
+        n = min(n, width - a)
+        bar = " " * a + "#" * n + " " * (width - a - n)
+        label = "  " * min(seg.depth, 4) + seg.name
+        extra = ""
+        for k in ("replica", "prefix_hit", "interrupted",
+                  "requeue_recompute", "preempted"):
+            if seg.tags.get(k) is not None:
+                extra += f" {k}={seg.tags[k]}"
+        lines.append(f"  {label:<18s}|{bar}| "
+                     f"{_fmt_s(seg.duration_s)}"
+                     f" (self {_fmt_s(seg.self_s)}){extra}")
+    path = " -> ".join(f"{h['name']}:{_fmt_s(h['self_s'])}"
+                       for h in wf.critical_path)
+    lines.append(f"  critical path: {path or '(none)'}")
+    return "\n".join(lines)

@@ -794,6 +794,36 @@ def test_shard_check_gate_shipped_plan_is_clean_and_fast():
     assert elapsed < 10.0, f"shard_check gate took {elapsed:.1f}s"
 
 
+@pytest.mark.lint
+@pytest.mark.quick
+def test_trace_analyze_gate_demo_workload_attributes_cleanly():
+    """The attribution CLI is part of the lint lane: trace_analyze
+    --json over the gateway demo workload must produce complete
+    waterfalls, a balanced goodput ledger, and no findings parse
+    errors — the smoke gate for the observability.{waterfall,ledger,
+    anomaly} stack."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_analyze.py"),
+         "--json", "--top", "3"], cwd=REPO, capture_output=True,
+        text=True)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["n_traces"] >= 3 and payload["incomplete"] == 0
+    assert payload["requests"] and payload["requests"][0]["critical_path"]
+    led = payload["ledger"]
+    assert led["chip_seconds"] > 0.0 and 0.0 < led["goodput_frac"] <= 1.0
+    assert set(led["waste_seconds"]) == {
+        "bucket_pad", "requeue_recompute", "evicted_prefix_recompute",
+        "speculation_rejected", "recompile"}
+    assert {"prefill", "decode"} <= set(led["by_phase"])
+    assert {"prefill", "decode"} <= set(payload["critical_path_summary"])
+    # in-process demo + analysis; generous vs the 10s lint budget
+    # because this one boots jax AND runs serving traffic
+    assert elapsed < 30.0, f"trace_analyze gate took {elapsed:.1f}s"
+
+
 def test_shard_check_cli_flags_oversubscribed_batch():
     proc = _run_shard_cli("--batch", "64", "--json")
     assert proc.returncode == 1

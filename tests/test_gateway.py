@@ -278,6 +278,23 @@ def test_gateway_replica_death_requeues_token_exact(lm):
     assert s["requeued"] > 0
     alive = [r for r in gw.pool.replicas() if r.alive]
     assert len(alive) == 1                   # exactly one casualty
+    # the duplicated-work interval is tagged: the survivor's prompt
+    # re-prefill carries requeue_recompute=1 (the interrupted spans mark
+    # what was cut short; THIS marks what gets paid twice), and the
+    # goodput ledger prices it as waste.requeue_recompute
+    from paddle_tpu.observability import (build_waterfalls, get_recorder,
+                                          ledger_from_waterfalls)
+    tids = {gw._finished[g].trace.trace_id for g in gids
+            if gw._finished[g].trace is not None}
+    wfs = [w for w in build_waterfalls(get_recorder().spans())
+           if w.trace_id in tids]
+    recomputes = [seg for w in wfs for seg in w.segments
+                  if seg.tags.get("requeue_recompute")]
+    assert recomputes and all(seg.name == "prefill" for seg in recomputes)
+    assert all(seg.tags.get("replica") == alive[0].name
+               for seg in recomputes)        # charged to the survivor
+    led = ledger_from_waterfalls(wfs)
+    assert led.waste["requeue_recompute"] > 0.0
     for g, ref in zip(gids, refs):
         assert np.array_equal(gw.pop_result(g), ref)  # zero lost/dup tokens
     assert streamed == [int(t) for t in refs[0][len(prompts[0]):]]

@@ -156,7 +156,24 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
     mc_records = discover(dirpath, prefix="MULTICHIP_r")
     for r in mc_records:
         r["_lane"] = "multichip"
-    records = records + gw_records + mc_records
+    # synthesize the goodput series from the gateway lane's embedded
+    # ledger (detail.goodput_frac_cache_on, written by bench_gateway
+    # since round 15): goodput regressions gate exactly like
+    # throughput. Older artifacts without the field simply contribute
+    # no point (insufficient_history until two rounds carry it).
+    goodput_records = []
+    for r in gw_records:
+        if "_skip" in r:
+            continue
+        gp = (r.get("detail") or {}).get("goodput_frac_cache_on")
+        if isinstance(gp, (int, float)):
+            goodput_records.append({
+                "metric": "gateway_goodput_frac", "value": float(gp),
+                "unit": "frac",
+                "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
+                "_round": r["_round"], "_file": r["_file"],
+                "_lane": "gateway"})
+    records = records + gw_records + mc_records + goodput_records
     report = {
         "dir": dirpath,
         "tolerance": tolerance,

@@ -113,6 +113,14 @@ def main(argv=None) -> int:
                          "histograms merged, gauges per-rank, plus "
                          "collective skew gauges and typed straggler/"
                          "desync/missing-rank findings")
+    ap.add_argument("--waterfall", metavar="RID", default=None,
+                    help="render the latency waterfall + critical path "
+                         "for one request (gateway gid or trace id), "
+                         "from the live recorder or --fleet DIR")
+    ap.add_argument("--ledger", action="store_true",
+                    help="append the goodput ledger summary "
+                         "(chip-seconds by tenant/rung/phase + waste "
+                         "categories) built from the same spans")
     ap.add_argument("--prefix-stats", action="store_true",
                     help="with --fleet: append a radix prefix-cache "
                          "summary (hit/miss tokens, hit rate, "
@@ -126,6 +134,50 @@ def main(argv=None) -> int:
                  "use it with --fleet DIR")
 
     from paddle_tpu.observability import export as _export
+
+    if args.waterfall is not None or args.ledger:
+        # attribution views (observability.waterfall / .ledger): spans
+        # come from --fleet DIR when given, else the live recorder.
+        # Handled BEFORE the plain --fleet path so that path's output
+        # stays byte-identical when these flags are absent.
+        if args.snapshot or args.format == "chrome":
+            ap.error("--waterfall/--ledger read trace spans (live "
+                     "recorder or --fleet DIR), not a metrics snapshot")
+        import json
+        from paddle_tpu.observability.waterfall import (
+            render_waterfall, waterfalls_from_fleet,
+            waterfalls_from_recorder)
+        if args.fleet:
+            wfs = waterfalls_from_fleet(args.fleet)
+        else:
+            if not args.no_workload:
+                _demo_workload()
+            wfs = waterfalls_from_recorder()
+        text = ""
+        if args.waterfall is not None:
+            rid = args.waterfall
+            match = [w for w in wfs
+                     if str(w.gid) == rid or w.trace_id == rid]
+            if not match:
+                sys.stderr.write(f"no trace matches rid/trace-id "
+                                 f"{rid!r} ({len(wfs)} trace(s) "
+                                 f"available)\n")
+                return 1
+            text += "\n\n".join(render_waterfall(w)
+                                for w in match) + "\n"
+        if args.ledger:
+            from paddle_tpu.observability.ledger import \
+                ledger_from_waterfalls
+            led = ledger_from_waterfalls(wfs)
+            led.publish()
+            text += ("# goodput ledger\n"
+                     + json.dumps(led.summary(), indent=2) + "\n")
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
 
     if args.fleet:
         if args.snapshot or args.slo or args.format == "chrome":

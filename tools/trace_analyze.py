@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Attribution CLI over recorded request traces: slow-request
+waterfalls, the fleet critical-path summary, and the goodput/waste
+ledger (text or ``--json``).
+
+Span sources, in precedence order:
+
+  * ``--jsonl PATH``  — a span export (``TraceRecorder.export_jsonl``
+    or any JSONL of span dicts),
+  * ``--fleet DIR``   — a fleet telemetry spool (rank shards; torn
+    tails and crashed ranks degrade to partial waterfalls flagged
+    ``incomplete``, never an error),
+  * default          — run the same in-process demo workload
+    ``telemetry_dump`` uses (a ContinuousBatcher + a 2-replica
+    gateway) and analyze the live recorder; ``--no-workload`` skips
+    the traffic and reads whatever this process already recorded.
+
+Output: the top-N slowest request waterfalls (critical path per
+request), the aggregate critical-path self-time by span name, the
+goodput ledger summary (chip-seconds by tenant/rung/phase plus the
+waste taxonomy: bucket_pad / requeue_recompute /
+evicted_prefix_recompute / speculation_rejected / recompile), and any
+streaming anomaly findings (per-replica TTFT/TPOT spikes) derived from
+the same traces. The lint lane runs ``trace_analyze.py --json`` over
+the demo workload as a smoke gate; bench_gateway embeds the same
+ledger numbers in ``BENCH_GATEWAY_r*.json`` for bench_guard.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_jsonl_spans(path: str):
+    spans = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                spans.append(json.loads(line))
+            except ValueError:
+                continue               # torn tail line — keep going
+    return spans
+
+
+def analyze(waterfalls, top: int = 5) -> dict:
+    """The full attribution payload for a set of waterfalls."""
+    from paddle_tpu.observability.anomaly import AnomalyDetector
+    from paddle_tpu.observability.ledger import ledger_from_waterfalls
+    from paddle_tpu.observability.waterfall import critical_path_summary
+
+    ledger = ledger_from_waterfalls(waterfalls)
+    detector = AnomalyDetector()
+    detector.observe_waterfalls(waterfalls)
+    slowest = sorted(waterfalls, key=lambda w: -w.total_s)[:top]
+    return {
+        "n_traces": len(waterfalls),
+        "incomplete": sum(1 for w in waterfalls if w.incomplete),
+        "requests": [w.to_dict() for w in slowest],
+        "critical_path_summary": critical_path_summary(waterfalls),
+        "ledger": ledger.summary(),
+        "findings": [f.to_dict() for f in detector.findings],
+    }
+
+
+def _render_text(payload: dict, waterfalls, top: int) -> str:
+    from paddle_tpu.observability.waterfall import render_waterfall
+    lines = [f"# {payload['n_traces']} trace(s), "
+             f"{payload['incomplete']} incomplete — "
+             f"top {min(top, payload['n_traces'])} by wall time"]
+    slowest = sorted(waterfalls, key=lambda w: -w.total_s)[:top]
+    for wf in slowest:
+        lines.append("")
+        lines.append(render_waterfall(wf))
+    lines.append("")
+    lines.append("# critical-path self time by span")
+    for name, s in payload["critical_path_summary"].items():
+        lines.append(f"  {name:<18s} {s * 1e3:10.2f}ms")
+    led = payload["ledger"]
+    lines.append("")
+    lines.append(f"# goodput ledger: chip={led['chip_seconds'] * 1e3:.2f}ms "
+                 f"goodput_frac={led['goodput_frac']:.4f}")
+    for cat, s in led["waste_seconds"].items():
+        lines.append(f"  waste.{cat:<26s} {s * 1e3:10.2f}ms")
+    for row in led["attribution"][:10]:
+        lines.append(f"  {row['tenant']}/{row['rung']}/{row['phase']:<10s} "
+                     f"{row['seconds'] * 1e3:10.2f}ms")
+    if payload["findings"]:
+        lines.append("")
+        lines.append("# anomaly findings")
+        for f in payload["findings"]:
+            lines.append(f"  {f['kind']} key={f['detail'].get('key')} "
+                         f"score={f['detail'].get('score', 0):.1f}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--top", type=int, default=5,
+                    help="slow requests to render (default 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full payload as JSON")
+    ap.add_argument("--jsonl", metavar="PATH", default=None,
+                    help="analyze a span JSONL export instead of the "
+                         "live recorder")
+    ap.add_argument("--fleet", metavar="DIR", default=None,
+                    help="analyze a fleet telemetry spool directory")
+    ap.add_argument("--no-workload", action="store_true",
+                    help="live mode without the demo workload")
+    ap.add_argument("--out", metavar="PATH", default=None,
+                    help="write here instead of stdout")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability.waterfall import (build_waterfalls,
+                                                    waterfalls_from_fleet)
+    if args.jsonl:
+        wfs = build_waterfalls(_load_jsonl_spans(args.jsonl))
+    elif args.fleet:
+        wfs = waterfalls_from_fleet(args.fleet)
+    else:
+        if not args.no_workload:
+            import telemetry_dump
+            telemetry_dump._demo_workload()
+        from paddle_tpu.observability.waterfall import \
+            waterfalls_from_recorder
+        wfs = waterfalls_from_recorder()
+
+    payload = analyze(wfs, top=args.top)
+    text = (json.dumps(payload, indent=2) + "\n" if args.json
+            else _render_text(payload, wfs, args.top))
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
