@@ -81,6 +81,31 @@ def _shard_index_to_offset(index, shape) -> Tuple[Tuple[int, ...], ...]:
     return tuple(offset), tuple(local)
 
 
+def encode_stored_array(data: np.ndarray) -> np.ndarray:
+    """ml_dtypes arrays (bf16/fp8) store as raw bits; the logical dtype
+    rides the metadata. Identity for every numpy-native dtype."""
+    if data.dtype.kind not in "fiub":
+        return data.view(np.uint16 if data.dtype.itemsize == 2
+                         else np.uint8)
+    return data
+
+
+def decode_stored_array(data: np.ndarray, stored_dtype) -> np.ndarray:
+    """Undo ``encode_stored_array`` given the logical dtype."""
+    if data.dtype != stored_dtype:
+        return data.view(stored_dtype)
+    return data
+
+
+def pack_npz(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Serialize a chunk dict to npz bytes (one buffer, ready for an
+    atomic/torn-write-instrumented publish)."""
+    import io as _io
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
 def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, async_save: bool = False):
     """distributed.checkpoint.save_state_dict (save_state_dict.py:104)."""
@@ -104,11 +129,8 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
                 continue  # replicas store once (reference dedups by rank)
             seen.add(offset)
             cid = Metadata.chunk_id(key, offset)
-            data = np.asarray(shard.data)
-            if data.dtype.kind not in "fiub":  # ml_dtypes (bf16/fp8): store
-                data = data.view(np.uint16 if data.dtype.itemsize == 2
-                                 else np.uint8)  # raw bits; logical dtype
-            arrays[cid] = data                   # rides the metadata
+            data = encode_stored_array(np.asarray(shard.data))
+            arrays[cid] = data
             tmeta.chunks.append(LocalTensorMetadata(
                 global_offset=offset, local_shape=local,
                 dtype=str(arr.dtype), checksum=chunk_crc(data)))
@@ -121,12 +143,9 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
     meta_name = f"metadata.{jax.process_index()}.pkl"
 
     def write():
-        import io as _io
-        buf = _io.BytesIO()
-        np.savez(buf, **arrays)
         # data first, metadata last: a crash between the two leaves a
         # data file no metadata references — dead bytes, not corruption
-        _atomic_write(os.path.join(path, fname), buf.getvalue())
+        _atomic_write(os.path.join(path, fname), pack_npz(arrays))
         _atomic_write(os.path.join(path, meta_name), pickle.dumps(meta))
 
     if async_save:
@@ -237,6 +256,14 @@ def _np_dtype(name: str) -> np.dtype:
     except TypeError:
         import ml_dtypes
         return np.dtype(getattr(ml_dtypes, name))
+
+
+# public reuse surface: the elastic sharded checkpoint layer
+# (resilience/sharded_checkpoint.py) and the mesh placement path
+# (distributed/mesh.py::place_from_shards) run the SAME chunk math
+shard_index_to_offset = _shard_index_to_offset
+overlap_slices = _overlap
+np_dtype = _np_dtype
 
 
 def _assemble(target_arr, tmeta, key, reader):

@@ -56,6 +56,7 @@ class Model:
         self._train_step = None
         self._fused_n_in = None
         self._pending_eager_grads = False
+        self._resume_replay = False
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -196,9 +197,70 @@ class Model:
         lbls = [y if isinstance(y, Tensor) else Tensor(np.asarray(y))
                 for y in _to_list(labels)]
         step = self._ensure_train_step(len(ins))
-        loss = step(*(ins + lbls))
+        args = ins + lbls
+        if self._resume_replay:
+            # TrainStep's discovery pass doubles as a REAL eager step, and
+            # the eager optimizer update is not bitwise-identical to the
+            # fused XLA one (different reassociation). An uninterrupted run
+            # takes that eager step at step 1; a resumed run would take it
+            # at the first post-restore step, forking the trajectory by an
+            # ulp. Replay instead: snapshot restored state, let the
+            # discovery build+compile, roll the state back (re-placed onto
+            # the compiled step's shardings), and run the SAME batch through
+            # the compiled path — every post-restore step is then the exact
+            # executable the uninterrupted run used.
+            self._resume_replay = False
+            if not step._cache:
+                snap = self._replay_snapshot()
+                step(*args)  # discovery + compile; its update is discarded
+                self._replay_rollback(snap)
+        loss = step(*args)
         self._observe_train_step(_time.perf_counter() - t0, inputs)
         return self._wrap_loss(loss, [])
+
+    def _replay_snapshot(self):
+        """Everything the discovery pass mutates: live model tensors,
+        optimizer accumulators/masters/step count, and the RNG key."""
+        from ..core import random as _random
+        opt = self._optimizer
+        return {
+            "tensors": [(t, t._data, t._grad)
+                        for t in self.network._state_dict_raw().values()],
+            "accs": {name: dict(store)
+                     for name, store in opt._accumulators.items()},
+            "masters": dict(opt._master_weights),
+            "step_count": opt._step_count,
+            "rng": _random.default_generator().get_state(),
+        }
+
+    @staticmethod
+    def _place_like(old, cur):
+        """Re-commit a snapshot array onto the sharding its slot now has
+        (the build placed state onto the mesh plan; the compiled step's
+        in_shardings reject anything else). device_put is bitwise."""
+        import jax
+        if old is cur or not isinstance(cur, jax.Array) \
+                or not isinstance(old, jax.Array) \
+                or getattr(cur, "sharding", None) is None \
+                or old.shape != cur.shape:
+            return old
+        return jax.device_put(old, cur.sharding)
+
+    def _replay_rollback(self, snap):
+        from ..core import random as _random
+        opt = self._optimizer
+        for t, data, grad in snap["tensors"]:
+            t._data = self._place_like(data, t._data)
+            t._grad = grad
+        for name, store in snap["accs"].items():
+            cur = opt._accumulators.setdefault(name, {})
+            for pid, arr in store.items():
+                cur[pid] = self._place_like(arr, cur.get(pid, arr))
+        for pid, arr in snap["masters"].items():
+            opt._master_weights[pid] = self._place_like(
+                arr, opt._master_weights.get(pid, arr))
+        opt._step_count = snap["step_count"]
+        _random.default_generator().set_state(snap["rng"])
 
     # -- resilience ----------------------------------------------------------
     def _checkpoint_state(self):
@@ -214,6 +276,41 @@ class Model:
         CheckpointManager (atomic, checksummed, retained)."""
         return manager.save(self._checkpoint_state(), step,
                             blocking=blocking)
+
+    def resume_from(self, manager, runtime=None):
+        """Restore the newest VALID checkpoint into the live model (and
+        optimizer) and return its step, or None when the root holds no
+        restorable step. Works with both manager flavors; for a
+        ``ShardedCheckpointManager`` the restore is elastic — the
+        checkpoint re-places under ``runtime`` (default: the prepared
+        mesh plan's runtime), whatever mesh it was saved on. Optimizer
+        state is pushed back through ``set_state_dict`` because
+        ``Optimizer.state_dict()`` hands out fresh wrappers — filling
+        those in place would not reach the live accumulators."""
+        opt = self._optimizer
+        if opt is not None and self._ckpt_include_optimizer:
+            # a freshly-built optimizer creates accumulators lazily on
+            # its first step; materialize them NOW (and the fp32 masters
+            # multi_precision will want) so the checkpoint's moment/
+            # master keys have live targets to restore into
+            import jax.numpy as jnp
+            for p in opt._parameter_list:
+                opt._create_accumulators_for(p)
+                if opt._multi_precision and p.dtype != jnp.float32:
+                    opt._master_weight(p)
+        sd = self._checkpoint_state()
+        if runtime is None:
+            runtime = getattr(getattr(self, "_mesh_plan", None),
+                              "runtime", None)
+        step = manager.restore_latest(sd, runtime=runtime)
+        if step is not None and self._optimizer is not None \
+                and "opt" in sd:
+            self._optimizer.set_state_dict(sd["opt"])
+        if step is not None:
+            # the next fused train_batch must not let the discovery pass's
+            # eager update touch the restored state (see _train_batch_fused)
+            self._resume_replay = True
+        return step
 
     def enable_step_guard(self, rollback_after: Optional[int] = None,
                           checkpoint_manager=None,
@@ -375,14 +472,32 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
-            prefetch_to_device=True):
+            prefetch_to_device=True, checkpoint=None, checkpoint_freq=1,
+            resume=True):
         """model.py fit analog.
 
         ``prefetch_to_device`` (default on) double-buffers host-to-device
         transfers for loaders fit constructs itself: batch N+1 lands on
         device while step N runs. Pass a pre-built DataLoader to control
-        prefetching yourself."""
+        prefetching yourself.
+
+        ``checkpoint`` (a resilience ``CheckpointManager`` or
+        ``ShardedCheckpointManager``) turns on periodic checkpointing:
+        every ``checkpoint_freq`` global steps the model (+ optimizer)
+        state publishes asynchronously (at most one save in flight; the
+        next save joins the previous, so a failed publish surfaces as a
+        crash whose restart falls back to the last committed step), and
+        a final blocking save captures the end state. With ``resume``
+        (default) fit first restores the newest valid step — elastically,
+        under the prepared mesh plan's runtime — and fast-forwards the
+        loader past the batches that step already consumed, so an
+        interrupted run continues the SAME trajectory."""
         assert self._prepared, "call prepare() first"
+        start_step = 0
+        if checkpoint is not None and resume:
+            restored = self.resume_from(checkpoint)
+            if restored is not None:
+                start_step = int(restored)
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers, drop_last=drop_last,
                                    prefetch=prefetch_to_device)
@@ -396,7 +511,8 @@ class Model:
                                 metrics=self._metrics_name())
         self.stop_training = False
         cbks.on_train_begin({})
-        iters_done = 0
+        iters_done = start_step
+        to_skip = start_step
         logs = {}
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch, {})
@@ -404,6 +520,11 @@ class Model:
                 m.reset()
             pending_grads = False
             for step, batch in enumerate(loader):
+                if to_skip > 0:
+                    # resume fast-forward: these batches trained before
+                    # the restored checkpoint was taken
+                    to_skip -= 1
+                    continue
                 cbks.on_train_batch_begin(step, {})
                 ins, lbls = self._split_batch(batch)
                 update = ((step + 1) % accumulate_grad_batches == 0)
@@ -412,6 +533,11 @@ class Model:
                 logs = self._merge_logs(res)
                 cbks.on_train_batch_end(step, logs)
                 iters_done += 1
+                if checkpoint is not None \
+                        and iters_done % checkpoint_freq == 0:
+                    checkpoint.wait()      # join the previous async save
+                    self.save_checkpoint(checkpoint, iters_done,
+                                         blocking=False)
                 if num_iters is not None and iters_done >= num_iters:
                     self.stop_training = True
                 if self.stop_training:
@@ -427,6 +553,13 @@ class Model:
                 self._run_eval(eval_loader, cbks)
             if self.stop_training:
                 break
+        if checkpoint is not None:
+            checkpoint.wait()
+            if iters_done > start_step \
+                    and (iters_done % checkpoint_freq != 0
+                         or checkpoint.latest_step() != iters_done):
+                self.save_checkpoint(checkpoint, iters_done,
+                                     blocking=True)
         cbks.on_train_end(logs)
 
     def _run_eval(self, loader, cbks, num_iters=None):

@@ -263,23 +263,37 @@ def build_postmortem(dirpath: str,
             events = [e for e in events if e["_t"] >= horizon]
         last = events[-1]
         open_colls = {}
+        open_ckpts = {}
         for e in events:
-            if e.get("kind") == "collective_enter":
+            kind = e.get("kind")
+            if kind == "collective_enter":
                 open_colls[e.get("seq")] = e
-            elif e.get("kind") == "collective_exit":
+            elif kind == "collective_exit":
                 open_colls.pop(e.get("seq"), None)
-        died_in = (last if last.get("kind") in
-                   ("collective_enter", "chaos") else None)
+            elif kind == "ckpt.save_begin":
+                open_ckpts[e.get("step")] = e
+            elif kind in ("ckpt.shard_ack", "ckpt.commit",
+                          "ckpt.ack_timeout"):
+                # this rank's part of the save is over (acked, published,
+                # or aborted) — only a begin with none of these is torn
+                open_ckpts.pop(e.get("step"), None)
+        died_in = (last if (last.get("kind") in
+                            ("collective_enter", "chaos")
+                            or (str(last.get("kind", "")).startswith("ckpt.")
+                                and open_ckpts))
+                   else None)
         ranks[rank] = {
             "file": path,
             "events": len(events),
             "epochs": sorted({e["_epoch"] for e in events}),
             "last_event": last,
             "open_collectives": sorted(open_colls),
+            "open_checkpoints": sorted(open_ckpts),
             "suspect_death": ({"kind": last.get("kind"),
                                "op": last.get("op"),
                                "point": last.get("point"),
-                               "fault": last.get("fault")}
+                               "fault": last.get("fault"),
+                               "step": last.get("step")}
                               if died_in is not None else None),
         }
         timeline.extend(events)

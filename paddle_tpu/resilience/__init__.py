@@ -23,24 +23,29 @@ Everything reports through ``paddle_tpu.observability``
 """
 from __future__ import annotations
 
-from . import chaos, checkpoint_manager, recovery, retry
+from . import chaos, checkpoint_manager, recovery, retry, sharded_checkpoint
 from .chaos import (ChaosError, ChaosRegistry, FaultSpec,
                     TransientChaosError, TornWrite, arm_from_env,
                     arm_scenario, disarm, fault_point, get_chaos,
                     parse_scenario, torn_write_bytes)
-from .checkpoint_manager import (COMMITTED_MARKER, CheckpointManager,
-                                 validate_checkpoint)
+from .checkpoint_manager import (COMMITTED_MARKER, CheckpointFinding,
+                                 CheckpointManager, validate_checkpoint)
 from .recovery import (DeadlineExceeded, HealthState, HealthStateMachine,
                        Overloaded, StepGuard)
 from .retry import DEFAULT_RETRYABLE, RetryGiveUp, RetryPolicy
+from .sharded_checkpoint import (AckTimeout, ShardedCheckpointManager,
+                                 validate_sharded_checkpoint)
 
 __all__ = [
     "chaos", "retry", "checkpoint_manager", "recovery",
+    "sharded_checkpoint",
     "ChaosError", "TransientChaosError", "TornWrite", "FaultSpec",
     "ChaosRegistry", "get_chaos", "fault_point", "arm_scenario",
     "arm_from_env", "disarm", "parse_scenario", "torn_write_bytes",
     "RetryPolicy", "RetryGiveUp", "DEFAULT_RETRYABLE",
     "CheckpointManager", "COMMITTED_MARKER", "validate_checkpoint",
+    "CheckpointFinding", "ShardedCheckpointManager", "AckTimeout",
+    "validate_sharded_checkpoint",
     "StepGuard", "Overloaded", "DeadlineExceeded", "HealthState",
     "HealthStateMachine",
 ]

@@ -6,6 +6,10 @@ to attribute) — here a registry of NAMED fault points woven into the
 hot seams of this codebase:
 
   * ``checkpoint.write``  — sharded checkpoint file writes (save_load.py)
+  * ``checkpoint.shard_write`` — one rank's shard-chunk/ack writes in the
+    two-phase elastic save (resilience/sharded_checkpoint.py)
+  * ``checkpoint.publish`` — rank 0's manifest + COMMITTED publish after
+    it observed every shard ack (the phase-2 seam)
   * ``collective.enter``  — eager collective entry (collective.py)
   * ``serving.step``      — continuous-batcher step (inference/serving.py)
   * ``kv.request``        — launcher master-KV requests (controllers.py)
@@ -56,7 +60,8 @@ FAULT_KINDS = ("delay", "transient_error", "torn_write", "nan_grad",
 
 # the seams instrumented today (open set — arming an unknown point is
 # allowed so new seams can be drilled before this list catches up)
-KNOWN_POINTS = ("checkpoint.write", "collective.enter", "serving.step",
+KNOWN_POINTS = ("checkpoint.write", "checkpoint.shard_write",
+                "checkpoint.publish", "collective.enter", "serving.step",
                 "kv.request", "dataloader.next", "train.step")
 
 

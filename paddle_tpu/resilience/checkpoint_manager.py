@@ -33,15 +33,81 @@ import pickle
 import shutil
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from .retry import RetryPolicy
 
-__all__ = ["CheckpointManager", "COMMITTED_MARKER", "validate_checkpoint"]
+__all__ = ["CheckpointManager", "COMMITTED_MARKER", "validate_checkpoint",
+           "CheckpointFinding", "write_committed_marker"]
 
 COMMITTED_MARKER = "COMMITTED"
 _STEP_PREFIX = "step_"
 _TMP_PREFIX = ".tmp-"
+
+
+@dataclass
+class CheckpointFinding:
+    """One typed restore-time diagnosis — the checkpoint analog of
+    ``observability.fleet.FleetFinding``. ``restore_latest`` emits one
+    per checkpoint it DISCARDS on the way to the newest valid step, so
+    a fallback is never silent: the finding names what was wrong
+    (``uncommitted`` / ``checksum_mismatch`` / ``missing_ack`` /
+    ``missing_shard`` / ``unreadable`` / ``torn_step``) and which step
+    was skipped."""
+    kind: str
+    step: int
+    reason: str
+    detail: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "step": self.step,
+                "reason": self.reason, "detail": dict(self.detail)}
+
+    def __str__(self):
+        return f"{self.kind}: step={self.step} {self.reason}"
+
+
+def classify_invalid_reason(reason: str) -> str:
+    """Map a ``validate_checkpoint`` reason string onto a finding kind."""
+    if "torn step" in reason:
+        return "torn_step"
+    if "COMMITTED" in reason:
+        return "uncommitted"
+    if "checksum" in reason:
+        return "checksum_mismatch"
+    if "unreadable" in reason:
+        return "unreadable"
+    if "ack" in reason:
+        return "missing_ack"
+    if "shard file" in reason or "MANIFEST" in reason:
+        return "missing_shard"
+    return "invalid"
+
+
+def write_committed_marker(dirpath: str, step: int,
+                           extra: Optional[dict] = None,
+                           chaos_point: Optional[str] = None) -> str:
+    """The ONE terminal-marker writer both checkpoint managers share:
+    the marker is fsync'd and (when ``chaos_point`` names a seam)
+    written through the chaos torn-write plumbing so publish drills can
+    tear it. Any directory carrying the marker holds a complete file
+    set — writing it is the commit point."""
+    marker = os.path.join(dirpath, COMMITTED_MARKER)
+    payload = dict(extra or {})
+    payload["step"] = step
+    data = json.dumps(payload).encode()
+    if chaos_point is not None:
+        from .chaos import torn_write_bytes
+        tmp = marker + ".tmp"
+        torn_write_bytes(tmp, data, point=chaos_point)
+        os.replace(tmp, marker)
+    else:
+        with open(marker, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+    return marker
 
 
 def validate_checkpoint(path: str) -> Tuple[bool, str]:
@@ -109,6 +175,9 @@ class CheckpointManager:
         self._errors: List[BaseException] = []
         self._tmp_seq = 0
         self.invalid_skipped = 0      # corrupt checkpoints seen by restore
+        #: typed CheckpointFinding records for every checkpoint a restore
+        #: DISCARDED (newest first); cleared at each restore_latest call
+        self.findings: List[CheckpointFinding] = []
 
     # -- directory layout ---------------------------------------------------
     def _step_dir(self, step: int) -> str:
@@ -177,11 +246,7 @@ class CheckpointManager:
                             point="checkpoint.write")
             # terminal marker: written LAST inside the temp dir, so any
             # directory carrying it holds a complete file set
-            marker = os.path.join(tmp, COMMITTED_MARKER)
-            with open(marker, "w") as f:
-                json.dump({"step": step}, f)
-                f.flush()
-                os.fsync(f.fileno())
+            write_committed_marker(tmp, step)
             with self._lock:
                 if os.path.exists(final):
                     shutil.rmtree(final)   # idempotent re-save of a step
@@ -196,8 +261,19 @@ class CheckpointManager:
     def _apply_retention(self):
         with self._lock:
             steps = self.steps()
-            for s in steps[:-self.keep_last]:
-                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            # only COMMITTED steps count toward keep_last: a run that
+            # tears several saves in a row must not age out its last
+            # good checkpoint. Torn/uncommitted dirs older than the
+            # retention horizon are swept with it; newer ones stay (the
+            # next restore's findings name them).
+            committed = [s for s in steps if os.path.exists(
+                os.path.join(self._step_dir(s), COMMITTED_MARKER))]
+            if len(committed) >= self.keep_last:
+                horizon = committed[-self.keep_last]
+                for s in steps:
+                    if s < horizon:
+                        shutil.rmtree(self._step_dir(s),
+                                      ignore_errors=True)
             # sweep temp debris from crashed saves of THIS root
             for d in glob.glob(os.path.join(self.root, _TMP_PREFIX + "*")):
                 try:
@@ -211,27 +287,55 @@ class CheckpointManager:
     def validate(self, step: int) -> Tuple[bool, str]:
         return validate_checkpoint(self._step_dir(step))
 
-    def restore_latest(self, state_dict: Dict) -> Optional[int]:
+    def restore_latest(self, state_dict: Dict, **kwargs) -> Optional[int]:
         """Fill `state_dict` in place from the newest VALID checkpoint;
         returns its step, or None when no valid checkpoint exists.
-        Corrupt/uncommitted newer checkpoints are skipped (counted)."""
-        from ..distributed.checkpoint.save_load import load_state_dict
+        Corrupt/uncommitted newer checkpoints are skipped — each skip is
+        a typed ``CheckpointFinding`` on ``self.findings`` (plus a
+        flight-recorder ``ckpt.skip`` event and the
+        ``checkpoint_invalid_total`` counter), never a silent fallback."""
         restore_h, invalid_c, recoveries_c = self._metrics()
-        skipped = 0
+        self.findings = []
         for step in reversed(self.steps()):
             ok, reason = self.validate(step)
             if not ok:
-                skipped += 1
-                self.invalid_skipped += 1
-                invalid_c.inc()
+                self._record_skip(step, reason, invalid_c)
                 continue
             t0 = time.perf_counter()
-            load_state_dict(state_dict, self._step_dir(step))
-            restore_h.observe(time.perf_counter() - t0)
-            if skipped:
+            self._do_restore(state_dict, step, **kwargs)
+            dt = time.perf_counter() - t0
+            restore_h.observe(dt)
+            self._dotted_restore_seconds().observe(dt)
+            from ..observability.flight import flight_record
+            flight_record("ckpt.restore", step=step,
+                          skipped=len(self.findings))
+            if self.findings:
                 recoveries_c.labels(kind="checkpoint_fallback").inc()
             return step
         return None
+
+    def _do_restore(self, state_dict: Dict, step: int, **kwargs) -> None:
+        """Layout-specific load of one validated step (subclass seam)."""
+        from ..distributed.checkpoint.save_load import load_state_dict
+        load_state_dict(state_dict, self._step_dir(step))
+
+    def _classify_skip(self, step: int, reason: str) -> CheckpointFinding:
+        return CheckpointFinding(kind=classify_invalid_reason(reason),
+                                 step=step, reason=reason)
+
+    def _record_skip(self, step: int, reason: str, invalid_c) -> None:
+        finding = self._classify_skip(step, reason)
+        self.findings.append(finding)
+        self.invalid_skipped += 1
+        invalid_c.inc()
+        from ..observability.flight import flight_record
+        flight_record("ckpt.skip", step=step, ckpt_kind=finding.kind)
+
+    def _dotted_restore_seconds(self):
+        from ..observability.metrics import get_registry
+        return get_registry().histogram(
+            "checkpoint.restore_seconds",
+            "restore_latest wall time (validated step load)")
 
     def _metrics(self):
         from ..observability.metrics import get_registry

@@ -1,0 +1,112 @@
+"""Per-rank worker for the elastic sharded-checkpoint drill.
+
+Two phases, selected by ``MP_CKPT_PHASE``:
+
+``save`` — launched by paddle_tpu.distributed.launch as 2 processes x 2
+CPU devices forming a 2x2 ``(fsdp, tensor)`` gloo mesh. Every rank
+trains 3 fused hapi steps, publishes a two-phase sharded checkpoint
+(per-rank shards + acks, rank 0's manifest + COMMITTED), trains one
+more step (the reference loss the restore must reproduce), then arms a
+``checkpoint.shard_write:kill_rank:rank=1`` scenario and saves again:
+rank 1 dies mid-shard-write, rank 0's ack wait times out, and the step
+must be left TORN (no COMMITTED) rather than half-published.
+
+``restore`` — a plain SINGLE process (no launcher, one device). The
+restart restores the newest committed step from the mesh-spanning
+checkpoint — elastically, onto a world a quarter the size — and the
+continuation loss must be bitwise-identical to the loss the 2x2 world
+computed before the kill.
+"""
+import os
+
+import numpy as np
+
+ROOT = os.environ.get("MP_CKPT_ROOT", "/tmp/mp_ckpt_root")
+
+
+def _build(plan):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as optim
+    from paddle_tpu.hapi import Model
+    paddle.seed(7)
+    m = Model(nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2)))
+    m.prepare(optimizer=optim.AdamW(learning_rate=1e-2,
+                                    parameters=m.parameters()),
+              loss=nn.CrossEntropyLoss(), jit=True, plan=plan)
+    return m
+
+
+def _batches():
+    rng = np.random.RandomState(0)
+    return (rng.randn(4, 8).astype(np.float32),
+            rng.randint(0, 2, (4,)).astype(np.int64))
+
+
+def _steps(m, n):
+    x, y = _batches()
+    return [float(np.asarray(m.train_batch([x], [y])[0]))
+            for _ in range(n)]
+
+
+def phase_save():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.mesh import MeshRuntime
+    from paddle_tpu.resilience import (AckTimeout, ShardedCheckpointManager,
+                                       arm_scenario, disarm)
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    dist.init_parallel_env()
+    rt = MeshRuntime.from_env()
+    assert rt.multiprocess and rt.axes == {"data": 1, "fsdp": 2,
+                                           "tensor": 2}, rt.axes
+
+    m = _build(rt.train_plan(budget_gib=16.0))
+    losses = _steps(m, 3)
+    mgr = ShardedCheckpointManager(ROOT, runtime=rt, ack_timeout=10.0)
+    m.save_checkpoint(mgr, step=3)
+    losses += _steps(m, 1)  # the loss the elastic restart must reproduce
+    print(f"MPCKPT_SAVE_OK rank={rank}/{world} losses={losses}",
+          flush=True)
+
+    # chaos: rank 1 dies on its first step-4 shard write; rank 0 must
+    # time out on the missing ack and leave the step torn, not publish.
+    # exit_code=0 because the launcher SIGTERMs every peer within ~1s of
+    # a nonzero exit — rank 0 needs to survive its own ack timeout
+    arm_scenario("seed=0; checkpoint.shard_write:kill_rank:rank=1,"
+                 "count=1,exit_code=0")
+    try:
+        m.save_checkpoint(mgr, step=4)
+        raise AssertionError(
+            f"rank {rank}: the half-dead save published step 4")
+    except AckTimeout as exc:
+        print(f"MPCKPT_TORN rank={rank} step=4 ({exc})", flush=True)
+    finally:
+        disarm()
+
+
+def phase_restore():
+    from paddle_tpu.resilience import ShardedCheckpointManager
+
+    m = _build(None)  # one process, one device: a quarter of the world
+    mgr = ShardedCheckpointManager(ROOT)
+    step = m.resume_from(mgr)
+    assert step == 3, f"restore fell back to {step}, want 3"
+    kinds = [f.kind for f in mgr.findings]
+    assert "torn_step" in kinds, \
+        f"torn step 4 produced no typed finding (got {kinds})"
+    losses = _steps(m, 1)
+    print(f"MPCKPT_RESTORE_OK step={step} findings={kinds} "
+          f"losses={losses}", flush=True)
+
+
+if __name__ == "__main__":
+    if os.environ.get("MP_CKPT_PHASE") == "restore":
+        phase_restore()
+    else:
+        phase_save()
+        # rank 1 is dead by design, so the jax.distributed shutdown
+        # barrier at interpreter exit can never complete — the
+        # coordination client would abort the process (exit 250) while
+        # waiting for it. The drill is over; leave without the barrier.
+        os._exit(0)

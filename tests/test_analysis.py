@@ -824,6 +824,24 @@ def test_trace_analyze_gate_demo_workload_attributes_cleanly():
     assert elapsed < 30.0, f"trace_analyze gate took {elapsed:.1f}s"
 
 
+@pytest.mark.lint
+@pytest.mark.quick
+def test_ckpt_inspect_gate_selftest_is_clean_and_fast():
+    """tools/ckpt_inspect.py rides the lint lane: its --selftest builds
+    a synthetic checkpoint root (one sound step, one torn step, then a
+    corrupted payload) with hand-crafted npy bytes and asserts its own
+    verdicts — stdlib only, no jax import, so it stays within the 10s
+    lint budget."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_inspect.py"),
+         "--selftest"], cwd=REPO, capture_output=True, text=True)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "selftest" in (proc.stdout + proc.stderr).lower()
+    assert elapsed < 10.0, f"ckpt_inspect selftest took {elapsed:.1f}s"
+
+
 def test_shard_check_cli_flags_oversubscribed_batch():
     proc = _run_shard_cli("--batch", "64", "--json")
     assert proc.returncode == 1

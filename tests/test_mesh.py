@@ -300,3 +300,72 @@ def test_multiprocess_mesh_train_loss_exact(tmp_path):
             f"rank {r} did not finish\n{logs[-4000:]}")
     # every rank converged on the SAME loss trajectory
     assert len({ln.split("losses=")[1] for ln in marks}) == 1, marks
+
+
+@pytest.mark.slow
+@pytest.mark.ckpt
+def test_multiprocess_elastic_checkpoint_survives_rank_kill(tmp_path):
+    """Save under a process-spanning 2x2 mesh, chaos-kill rank 1 mid
+    shard write on the NEXT save (rank 0 must time out on the missing
+    ack and leave the step torn), then restart as ONE process on ONE
+    device: the restore must fall back to the committed step with a
+    typed torn_step finding and continue on the 2x2 world's exact loss
+    trajectory."""
+    worker = os.path.join(REPO, "tests", "helpers", "mp_ckpt_worker.py")
+    root = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PADDLE_MESH_SHAPE"] = "data:1,fsdp:2,tensor:2"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["MP_CKPT_ROOT"] = root
+    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+         worker],
+        capture_output=True, text=True, timeout=420, cwd=REPO, env=env)
+    logs = ""
+    log_root = tmp_path / "logs"
+    if log_root.exists():
+        for f in sorted(log_root.iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()
+    assert proc.returncode == 0, (
+        f"launch failed rc={proc.returncode}\nstdout:{proc.stdout[-2000:]}\n"
+        f"stderr:{proc.stderr[-2000:]}\nlogs:{logs[-4000:]}")
+    saves = [ln for ln in logs.splitlines() if "MPCKPT_SAVE_OK" in ln]
+    assert any("rank=0/2" in ln for ln in saves), logs[-4000:]
+    assert any("MPCKPT_TORN rank=0 step=4" in ln
+               for ln in logs.splitlines()), logs[-4000:]
+    # the loss the 2x2 world computed right after the committed save
+    ref_losses = json.loads(saves[0].split("losses=")[1])
+    ref_step4 = ref_losses[3]
+
+    # the torn step is on disk exactly as the crash left it; the
+    # offline inspector must flag it and still name step 3 sound
+    ins = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_inspect.py"),
+         root, "--json"], capture_output=True, text=True, timeout=60,
+        cwd=REPO)
+    assert ins.returncode == 2, ins.stdout + ins.stderr
+    report = json.loads(ins.stdout)
+    assert report["latest_sound"] == 3, report
+
+    env_r = dict(env)
+    env_r.pop("PADDLE_MESH_SHAPE")
+    env_r["MP_CKPT_PHASE"] = "restore"
+    proc_r = subprocess.run([sys.executable, worker], capture_output=True,
+                            text=True, timeout=420, cwd=REPO, env=env_r)
+    assert proc_r.returncode == 0, (
+        f"restore phase rc={proc_r.returncode}\n"
+        f"stdout:{proc_r.stdout[-2000:]}\nstderr:{proc_r.stderr[-2000:]}")
+    restored = [ln for ln in proc_r.stdout.splitlines()
+                if "MPCKPT_RESTORE_OK" in ln]
+    assert restored and "torn_step" in restored[0], proc_r.stdout[-2000:]
+    got_step4 = json.loads(restored[0].split("losses=")[1])[0]
+    assert got_step4 == ref_step4, (
+        f"elastic restart diverged: {got_step4!r} vs the 2x2 world's "
+        f"{ref_step4!r}")

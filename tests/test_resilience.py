@@ -271,6 +271,58 @@ def test_restore_latest_none_when_empty(tmp_path):
     assert mgr.latest_step() is None
 
 
+@pytest.mark.ckpt
+def test_restore_latest_emits_typed_findings(tmp_path):
+    """A fallback is never silent: every step restore_latest discards on
+    the way down leaves a typed CheckpointFinding naming what was wrong
+    and which step was skipped, newest first."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=5)
+    old = _state(7.0)
+    mgr.save(old, step=1)
+    mgr.save(_state(8.0), step=2)
+    step2 = os.path.join(str(tmp_path), "step_000000000002")
+    data = [f for f in os.listdir(step2) if f.startswith("data_")][0]
+    p = os.path.join(step2, data)
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    mgr.save(_state(9.0), step=3)
+    os.remove(os.path.join(str(tmp_path), "step_000000000003", "COMMITTED"))
+
+    target = _fill_zeros_like(old)
+    assert mgr.restore_latest(target) == 1
+    np.testing.assert_array_equal(target["w"].numpy(), old["w"].numpy())
+    assert [f.step for f in mgr.findings] == [3, 2]
+    kinds = [f.kind for f in mgr.findings]
+    assert kinds[0] == "uncommitted"
+    assert kinds[1] in ("checksum_mismatch", "unreadable")
+    for f in mgr.findings:
+        d = f.to_dict()
+        assert d["reason"] and d["kind"] == f.kind and d["step"] == f.step
+    # findings are PER RESTORE: a second call re-diagnoses from scratch
+    assert mgr.restore_latest(_fill_zeros_like(old)) == 1
+    assert [f.step for f in mgr.findings] == [3, 2]
+
+
+@pytest.mark.ckpt
+def test_retention_only_counts_committed_steps(tmp_path):
+    """Torn/uncommitted step dirs must not age the last GOOD checkpoint
+    out of the keep-last window — only committed steps advance the
+    retention horizon."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=2)
+    mgr.save(_state(1.0), step=1)
+    for s in (2, 3):
+        mgr.save(_state(float(s)), step=s)
+        os.remove(os.path.join(
+            str(tmp_path), f"step_{s:012d}", "COMMITTED"))
+    mgr.save(_state(4.0), step=4)
+    # steps 2 and 3 are junk: with only two committed steps (1, 4) the
+    # horizon must not pass step 1
+    assert 1 in mgr.steps() and 4 in mgr.steps()
+    target = _fill_zeros_like(_state(0.0))
+    assert mgr.restore_latest(target) == 4
+
+
 def test_retention_keeps_last_n(tmp_path):
     mgr = CheckpointManager(str(tmp_path), keep_last=2)
     for s in (1, 2, 3, 4):

@@ -1,0 +1,374 @@
+"""Elastic mesh-sharded checkpointing (resilience.sharded_checkpoint).
+
+The acceptance bars:
+  * two-phase commit: per-rank shard chunks + CRC + ``SHARD_OK`` acks
+    (phase 1), rank 0's MANIFEST.json and COMMITTED only after every
+    ack arrived (phase 2) — a crash anywhere before the marker leaves
+    the step torn, never half-published;
+  * elastic restore: state saved on a 2x2 ``(fsdp, tensor)`` mesh
+    restores onto 1x4, 4x1, and a single device, and the CONTINUED
+    loss trajectory is bitwise-identical to uninterrupted training;
+  * every discarded step on the restore path is a typed
+    ``CheckpointFinding`` (torn_step / missing_ack / uncommitted /
+    checksum_mismatch), never a silent fallback;
+  * ``tools/ckpt_inspect.py`` reaches the same verdicts offline.
+
+The process-spanning variant (2 real processes, rank 1 chaos-killed
+mid-shard-write) lives in tests/test_mesh.py.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.distributed.mesh import MeshRuntime
+from paddle_tpu.hapi import Model
+from paddle_tpu.resilience import (AckTimeout, ShardedCheckpointManager,
+                                   TornWrite, arm_scenario, disarm,
+                                   validate_sharded_checkpoint)
+
+pytestmark = pytest.mark.ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _state(fill):
+    return {"w": paddle.to_tensor(
+        np.full((4, 6), fill, np.float32)),
+        "nested": {"b": paddle.to_tensor(
+            np.arange(8, dtype=np.float32))},
+        "meta": {"epoch": int(fill), "note": "drill"}}
+
+
+def _zeros():
+    # restore fills the leaves the target declares, so the placeholder
+    # dict mirrors the saved structure
+    return {"w": paddle.to_tensor(np.zeros((4, 6), np.float32)),
+            "nested": {"b": paddle.to_tensor(
+                np.zeros(8, np.float32))},
+            "meta": {"epoch": -1, "note": ""}}
+
+
+def _step_dir(root, step):
+    return os.path.join(str(root), f"step_{step:012d}")
+
+
+# -- two-phase layout ---------------------------------------------------------
+
+def test_two_phase_layout_and_roundtrip(tmp_path):
+    mgr = ShardedCheckpointManager(str(tmp_path), ack_timeout=5)
+    src = _state(3.0)
+    mgr.save(src, step=7)
+    d = _step_dir(tmp_path, 7)
+    names = sorted(os.listdir(d))
+    assert "MANIFEST.json" in names and "COMMITTED" in names
+    assert "SHARD_OK.rank00000" in names
+    assert any(n.startswith("shard-rank00000-") for n in names)
+    man = json.load(open(os.path.join(d, "MANIFEST.json")))
+    assert man["step"] == 7 and man["world_size"] == 1
+    assert set(man["tensors"]) == {"w", "nested.b"}
+    for entry in man["tensors"].values():
+        for ch in entry["chunks"]:
+            assert {"file", "cid", "offset", "shape", "crc"} <= set(ch)
+    assert man["extra"]["meta.epoch"] == 3
+    ok, reason = validate_sharded_checkpoint(d)
+    assert ok, reason
+
+    target = _zeros()
+    mgr2 = ShardedCheckpointManager(str(tmp_path))
+    assert mgr2.restore_latest(target) == 7
+    np.testing.assert_array_equal(target["w"].numpy(), src["w"].numpy())
+    np.testing.assert_array_equal(target["nested"]["b"].numpy(),
+                                  src["nested"]["b"].numpy())
+    assert target["meta"]["epoch"] == 3 and target["meta"]["note"] == "drill"
+    assert mgr2.findings == []
+
+
+def test_async_save_publishes_and_wait_reraises(tmp_path):
+    mgr = ShardedCheckpointManager(str(tmp_path), ack_timeout=5)
+    mgr.save(_state(1.0), step=1, blocking=False)
+    mgr.wait()
+    ok, reason = mgr.validate(1)
+    assert ok, reason
+    arm_scenario("seed=0; checkpoint.publish:torn_write:offset=16,count=1")
+    mgr.save(_state(2.0), step=2, blocking=False)
+    with pytest.raises(TornWrite):
+        mgr.wait()
+    disarm()
+    assert mgr.latest_step() == 1 or not os.path.exists(
+        os.path.join(_step_dir(tmp_path, 2), "COMMITTED"))
+
+
+def test_ack_timeout_leaves_step_torn(tmp_path):
+    """Rank 0 of a declared 2-rank world never sees rank 1's ack: the
+    save must abort typed (AckTimeout) without publishing, and the next
+    restore must fall back over the torn step with a finding."""
+    good = ShardedCheckpointManager(str(tmp_path), ack_timeout=5)
+    good.save(_state(1.0), step=1)
+    mgr = ShardedCheckpointManager(str(tmp_path), rank=0, world_size=2,
+                                   ack_timeout=0.3, poll_interval=0.02)
+    with pytest.raises(AckTimeout):
+        mgr.save(_state(2.0), step=2)
+    assert not os.path.exists(os.path.join(_step_dir(tmp_path, 2),
+                                           "COMMITTED"))
+    target = _zeros()
+    back = ShardedCheckpointManager(str(tmp_path))
+    assert back.restore_latest(target) == 1
+    kinds = [f.kind for f in back.findings]
+    assert kinds and kinds[0] in ("missing_ack", "torn_step"), kinds
+
+
+# -- chaos drills over the seams ---------------------------------------------
+
+def test_torn_shard_write_classified_torn_step(tmp_path):
+    mgr = ShardedCheckpointManager(str(tmp_path), ack_timeout=5)
+    mgr.save(_state(1.0), step=1)
+    arm_scenario("seed=0; checkpoint.shard_write:torn_write:offset=8,"
+                 "count=1")
+    with pytest.raises(TornWrite):
+        mgr.save(_state(2.0), step=2)
+    disarm()
+    ok, reason = validate_sharded_checkpoint(_step_dir(tmp_path, 2))
+    assert not ok and "torn" in reason, reason
+    target = _zeros()
+    back = ShardedCheckpointManager(str(tmp_path))
+    assert back.restore_latest(target) == 1
+    assert [f.kind for f in back.findings] == ["torn_step"]
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 6), 1.0, np.float32))
+
+
+def test_fallback_chain_emits_one_typed_finding_per_bad_step(tmp_path):
+    mgr = ShardedCheckpointManager(str(tmp_path), keep_last=10,
+                                   ack_timeout=5)
+    mgr.save(_state(1.0), step=10)
+    for s in (20, 30, 40):
+        mgr.save(_state(float(s)), step=s)
+    # step 20: strip manifest AND marker -> torn (shards but no publish)
+    os.remove(os.path.join(_step_dir(tmp_path, 20), "MANIFEST.json"))
+    os.remove(os.path.join(_step_dir(tmp_path, 20), "COMMITTED"))
+    # step 30: delete the ack a committed manifest references
+    os.remove(os.path.join(_step_dir(tmp_path, 30), "SHARD_OK.rank00000"))
+    # step 40: flip a byte inside the shard payload -> checksum/unreadable
+    d40 = _step_dir(tmp_path, 40)
+    shard = [n for n in os.listdir(d40) if n.startswith("shard-")][0]
+    p = os.path.join(d40, shard)
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) - 8] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+
+    target = _zeros()
+    back = ShardedCheckpointManager(str(tmp_path))
+    assert back.restore_latest(target) == 10
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 6), 1.0, np.float32))
+    assert [f.step for f in back.findings] == [40, 30, 20]
+    kinds = [f.kind for f in back.findings]
+    assert kinds[0] in ("checksum_mismatch", "unreadable", "missing_shard")
+    assert kinds[1] == "missing_ack"
+    assert kinds[2] == "torn_step"
+
+
+def test_ckpt_inspect_cli_agrees_with_restore(tmp_path):
+    mgr = ShardedCheckpointManager(str(tmp_path), ack_timeout=5)
+    mgr.save(_state(1.0), step=1)
+    arm_scenario("seed=0; checkpoint.publish:torn_write:offset=16,count=1")
+    with pytest.raises(TornWrite):
+        mgr.save(_state(2.0), step=2)
+    disarm()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_inspect.py"),
+         str(tmp_path), "--json"], capture_output=True, text=True,
+        timeout=60, cwd=REPO)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["latest_sound"] == 1
+    bad = [s for s in report["steps"] if not s["ok"]]
+    assert len(bad) == 1 and "COMMITTED" in bad[0]["reason"] \
+        or (bad and "torn" in bad[0]["reason"]), report
+
+
+def test_postmortem_names_rank_dead_mid_checkpoint_save(tmp_path):
+    """A rank whose last ring entry is an unacked ckpt.save_begin died
+    inside the two-phase save window; build_postmortem must call it a
+    suspect death with the step, while a rank that acked (or aborted on
+    ack_timeout) walks free."""
+    from paddle_tpu.observability.flight import FlightRecorder, \
+        build_postmortem
+    r0 = FlightRecorder(str(tmp_path / "flight-rank00000.ring"),
+                        slots=8, slot_size=256, rank=0)
+    r0.record("ckpt.save_begin", step=4, rank=0)
+    r0.record("ckpt.shard_ack", step=4, rank=0)
+    r0.record("ckpt.ack_timeout", step=4, waited=["rank00001"])
+    r0.close()
+    r1 = FlightRecorder(str(tmp_path / "flight-rank00001.ring"),
+                        slots=8, slot_size=256, rank=1)
+    r1.record("ckpt.save_begin", step=4, rank=1)
+    r1.close()  # chaos kill between shard write and ack
+    pm = build_postmortem(str(tmp_path))
+    assert pm["ranks"]["0"]["suspect_death"] is None
+    assert pm["ranks"]["0"]["open_checkpoints"] == []
+    v = pm["ranks"]["1"]["suspect_death"]
+    assert v is not None and v["kind"] == "ckpt.save_begin" \
+        and v["step"] == 4
+    assert pm["ranks"]["1"]["open_checkpoints"] == [4]
+
+
+# -- dtype fidelity -----------------------------------------------------------
+
+def test_bf16_raw_bit_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    src = {"h": paddle.to_tensor(
+        jnp.asarray(np.linspace(-3, 3, 16, dtype=np.float32),
+                    jnp.bfloat16))}
+    mgr = ShardedCheckpointManager(str(tmp_path), ack_timeout=5)
+    mgr.save(src, step=1)
+    target = {"h": paddle.to_tensor(jnp.zeros(16, jnp.bfloat16))}
+    back = ShardedCheckpointManager(str(tmp_path))
+    assert back.restore_latest(target) == 1
+    assert target["h"].numpy().dtype == src["h"].numpy().dtype
+    assert bytes(target["h"].numpy().tobytes()) == \
+        bytes(src["h"].numpy().tobytes())
+
+
+# -- elastic rescale-on-restore ----------------------------------------------
+
+def _build_model(plan):
+    paddle.seed(11)
+    m = Model(nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2)))
+    m.prepare(optimizer=optim.AdamW(learning_rate=1e-2,
+                                    parameters=m.parameters()),
+              loss=nn.CrossEntropyLoss(), jit=True, plan=plan)
+    return m
+
+
+def _train(m, n):
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randint(0, 2, size=(4,)).astype(np.int64)
+    return [float(np.asarray(m.train_batch([x], [y])[0]))
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def rescale_run(tmp_path_factory):
+    """One 2x2 reference trajectory + a committed mid-run checkpoint,
+    shared by every rescale target."""
+    rt = MeshRuntime({"data": 1, "fsdp": 2, "tensor": 2})
+    full = _train(_build_model(rt.train_plan(budget_gib=16.0)), 6)
+    root = str(tmp_path_factory.mktemp("rescale") / "ckpt")
+    m = _build_model(rt.train_plan(budget_gib=16.0))
+    first = _train(m, 3)
+    m.save_checkpoint(
+        ShardedCheckpointManager(root, runtime=rt, ack_timeout=5), step=3)
+    return {"root": root, "full": full, "first": first}
+
+
+@pytest.mark.parametrize("axes", [
+    {"data": 1, "fsdp": 1, "tensor": 4},
+    {"data": 1, "fsdp": 4, "tensor": 1},
+    None,
+])
+def test_rescale_restore_continues_bitwise(rescale_run, axes):
+    """Save on 2x2 (fsdp, tensor), restore on a DIFFERENT world, keep
+    training: the combined trajectory must equal uninterrupted training
+    bit for bit. This is the elastic contract — mesh shape is a
+    placement choice, the checkpoint pins the math."""
+    if axes is None:
+        plan, rt = None, None
+    else:
+        rt = MeshRuntime(axes)
+        plan = rt.train_plan(budget_gib=16.0)
+    m = _build_model(plan)
+    mgr = ShardedCheckpointManager(rescale_run["root"])
+    assert m.resume_from(mgr, runtime=rt) == 3
+    rest = _train(m, 3)
+    assert rescale_run["first"] + rest == rescale_run["full"], (
+        f"resumed-on-{axes} trajectory diverged:\n"
+        f"  uninterrupted: {rescale_run['full']}\n"
+        f"  resumed:       {rescale_run['first'] + rest}")
+
+
+def test_step_guard_rolls_back_past_torn_async_save(tmp_path):
+    """The async-window fault story: a background save tears at
+    publish, divergence strikes, and the StepGuard rollback must land
+    on the previous COMMITTED step — the torn step is skipped with a
+    typed finding, never half-restored."""
+    m = _build_model(None)
+    mgr = ShardedCheckpointManager(str(tmp_path / "g"), ack_timeout=5)
+    guard = m.enable_step_guard(rollback_after=2, checkpoint_manager=mgr)
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randint(0, 2, size=(4,)).astype(np.int64)
+    m.train_batch([x], [y])
+    m.save_checkpoint(mgr, step=1)
+    golden = {k: v.numpy().copy()
+              for k, v in m.network.state_dict().items()}
+    m.train_batch([x], [y])  # drift past the committed step
+    arm_scenario("seed=0; checkpoint.publish:torn_write:offset=16,count=1")
+    try:
+        m.save_checkpoint(mgr, step=2, blocking=False)
+        with pytest.raises(TornWrite):
+            mgr.wait()
+    finally:
+        disarm()
+    arm_scenario("seed=0; train.step:nan_grad:count=2")
+    m.train_batch([x], [y])
+    m.train_batch([x], [y])
+    disarm()
+    assert guard.rollbacks == 1
+    now = {k: v.numpy() for k, v in m.network.state_dict().items()}
+    for k in golden:
+        np.testing.assert_array_equal(now[k], golden[k])
+    assert any(f.step == 2 and f.kind in ("torn_step", "uncommitted")
+               for f in mgr.findings), [f.to_dict() for f in mgr.findings]
+
+
+def test_fit_auto_resume_is_bitwise(tmp_path):
+    """Model.fit(checkpoint=...) end to end, single device: train 2
+    epochs with periodic saves, rebuild, fit to 3 epochs — the resumed
+    run restores, fast-forwards the loader, and lands exactly on the
+    uninterrupted trajectory."""
+
+    class DS:
+        def __init__(self, n=8):
+            r = np.random.RandomState(5)
+            self.x = r.randn(n, 8).astype(np.float32)
+            self.y = r.randint(0, 2, size=(n, 1)).astype(np.int64)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def run_fit(m, ck, epochs):
+        seen = []
+        orig = m.train_batch
+
+        def spy(ins, lbls=None, update=True):
+            out = orig(ins, lbls, update)
+            v = out[0] if isinstance(out, (list, tuple)) else out
+            while isinstance(v, (list, tuple)):
+                v = v[0]
+            seen.append(float(v))
+            return out
+
+        m.train_batch = spy
+        m.fit(DS(), batch_size=4, epochs=epochs, shuffle=False, verbose=0,
+              checkpoint=ck)
+        return seen
+
+    full = run_fit(_build_model(None), None, 3)
+    root = str(tmp_path / "fitck")
+    a = run_fit(_build_model(None),
+                ShardedCheckpointManager(root, ack_timeout=5), 2)
+    b = run_fit(_build_model(None),
+                ShardedCheckpointManager(root, ack_timeout=5), 3)
+    assert a + b == full, (a, b, full)
+    assert len(b) == len(full) - len(a)  # resumed work, not repeated

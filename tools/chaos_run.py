@@ -26,6 +26,9 @@ import sys
 import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the elastic drill saves on a 2x2 (fsdp, tensor) mesh; give the CPU
+# backend enough virtual devices before jax initializes
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -33,6 +36,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 DEFAULT_SCENARIOS = {
     "checkpoint": ("seed=0; checkpoint.write:torn_write:offset=64,"
                    "after=1,count=1"),
+    "ckpt_elastic": ("seed=0; checkpoint.publish:torn_write:offset=32,"
+                     "count=1"),
     "train": "seed=0; train.step:nan_grad:after=1,count=2",
     "serve": "seed=0; serving.step:transient_error:count=2",
 }
@@ -70,6 +75,84 @@ def _drill_checkpoint(scenario: str) -> str:
         np.testing.assert_array_equal(target["w"].numpy(),
                                       golden["w"].numpy())
     return "torn save at an arbitrary offset; prior step restored bit-exact"
+
+
+def _drill_ckpt_elastic(scenario: str) -> str:
+    """Two-phase sharded save torn at the publish seam, then an ELASTIC
+    restore on a different mesh: the torn step must never show a
+    COMMITTED marker, restore_latest must fall back to the previous
+    committed step with a typed finding, continuation must be bitwise
+    on the reference trajectory, and ckpt_inspect must flag the torn
+    step with a nonzero verdict."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.mesh import MeshRuntime
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.resilience import (ShardedCheckpointManager, TornWrite,
+                                       arm_scenario, disarm)
+    import ckpt_inspect
+
+    def build(plan):
+        paddle.seed(7)
+        m = Model(nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                nn.Linear(16, 2)))
+        m.prepare(optimizer=optimizer.AdamW(learning_rate=1e-2,
+                                            parameters=m.parameters()),
+                  loss=nn.CrossEntropyLoss(), jit=True, plan=plan)
+        return m
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randint(0, 2, (4,)).astype(np.int64)
+
+    def steps(m, n):
+        return [float(np.asarray(m.train_batch([x], [y])[0]))
+                for _ in range(n)]
+
+    rt_save = MeshRuntime({"data": 1, "fsdp": 2, "tensor": 2})
+    reference = steps(build(rt_save.train_plan(budget_gib=16.0)), 4)
+
+    with tempfile.TemporaryDirectory(prefix="chaos_ckpt_elastic_") as root:
+        m = build(rt_save.train_plan(budget_gib=16.0))
+        before = steps(m, 2)
+        mgr = ShardedCheckpointManager(root, runtime=rt_save, ack_timeout=5)
+        m.save_checkpoint(mgr, step=2)
+
+        arm_scenario(scenario)
+        torn = False
+        try:
+            m.save_checkpoint(mgr, step=3)
+        except TornWrite as exc:
+            torn = True
+            print(f"  injected: {exc}")
+        finally:
+            disarm()
+        assert torn, "scenario did not tear the publish — nothing drilled"
+        torn_dir = os.path.join(root, "step_000000000003")
+        assert os.path.isdir(torn_dir) and not os.path.exists(
+            os.path.join(torn_dir, "COMMITTED")), \
+            "a torn publish left a COMMITTED marker"
+
+        report = ckpt_inspect.inspect_root(root)
+        assert not report["ok"] and report["latest_sound"] == 2, report
+
+        # elastic restore: same state, DIFFERENT mesh (1x4)
+        rt_new = MeshRuntime({"data": 1, "fsdp": 1, "tensor": 4})
+        m2 = build(rt_new.train_plan(budget_gib=16.0))
+        mgr2 = ShardedCheckpointManager(root, runtime=rt_new, ack_timeout=5)
+        step = m2.resume_from(mgr2, runtime=rt_new)
+        assert step == 2, f"restore fell back to {step}, want 2"
+        kinds = [f.kind for f in mgr2.findings]
+        assert "torn_step" in kinds or "uncommitted" in kinds, \
+            f"no typed finding for the torn step (got {kinds})"
+        after = steps(m2, 2)
+        assert before + after == reference, \
+            (f"rescaled continuation diverged: {before + after} "
+             f"vs {reference}")
+    return (f"publish torn at step 3, inspector latest_sound=2, "
+            f"findings {kinds}, 2x2 -> 1x4 restore continued bitwise")
 
 
 def _drill_train(scenario: str) -> str:
@@ -152,8 +235,9 @@ def _drill_serve(scenario: str) -> str:
             f"{b.health.state}")
 
 
-DRILLS = {"checkpoint": _drill_checkpoint, "train": _drill_train,
-          "serve": _drill_serve}
+DRILLS = {"checkpoint": _drill_checkpoint,
+          "ckpt_elastic": _drill_ckpt_elastic,
+          "train": _drill_train, "serve": _drill_serve}
 
 
 def _print_telemetry():
