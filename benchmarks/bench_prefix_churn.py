@@ -1,0 +1,206 @@
+"""Tiered radix KV cache benchmark: durable prefix hit rates under churn.
+
+The workload is built to NOT fit on the device: a working set of K
+shared prefixes whose pages total ~4x the device page pool, re-referenced
+with a Zipf popularity skew (seeded — the stream replays exactly). A
+device-only radix cache churns: every admission evicts someone else's
+chain, so re-references mostly re-prefill. With the host tier armed
+(``host_kv_gib``), eviction DEMOTES chains to pinned host DRAM instead of
+dropping them, and a re-reference promotes them back with an async
+``device_put`` overlapped with decode — the hit rate becomes durable.
+
+Headline number = the tiered run's measured-window hit rate
+(hit_tokens / (hit+miss)); detail carries the device-only control run on
+the SAME stream, promotion-latency p50/p99 from the serving histogram,
+demotion/promotion traffic, the decode-overlap evidence (steps that ran
+with a promotion in flight / per-step p99 wall time for both runs — a
+promotion stall would show as a tiered-only spike), token-exactness of
+tiered vs device-only outputs, and the zero-leak audits.
+
+Bench line lands in ``BENCH_PREFIX_r<NN>.json`` at the repo root — the
+``prefix:`` lane of ``tools/bench_guard.py`` (the tiered hit rate gates
+directly; promotion p99 gates as an inverse rate series).
+
+Same JSON contract as bench.py: ONE stdout line
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": ...}
+vs_baseline stays 0.0 — the reference publishes no comparable figure.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BLOCK_SIZE = 16
+PREFIX_BLOCKS = 3                  # 48-token shared prefixes
+N_PREFIXES = 32                    # working set: 96 prefix pages ...
+N_PAGES = 22                       # ... over a 22-page device pool (~4x)
+MAX_BATCH = 2
+S_MAX = 96
+TAIL_TOKENS = 5                    # unique per-request suffix
+NEW_TOKENS = 4
+N_REQUESTS = 96                    # measured Zipf draws
+ZIPF_A = 0.5
+
+
+def _model():
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=128, hidden_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     max_position_embeddings=128, dropout=0.0)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _workload(vocab):
+    """(prefixes, measured request prompts) — one seeded stream shared
+    by both runs so the comparison is request-for-request."""
+    rng = np.random.RandomState(0)
+    prefixes = [rng.randint(0, vocab, (BLOCK_SIZE * PREFIX_BLOCKS,))
+                for _ in range(N_PREFIXES)]
+    # Zipf over prefix ranks: p_i ~ 1/i^a, truncated to the working set
+    w = 1.0 / np.arange(1, N_PREFIXES + 1) ** ZIPF_A
+    w /= w.sum()
+    picks = rng.choice(N_PREFIXES, size=N_REQUESTS, p=w)
+    prompts = [np.concatenate([prefixes[p],
+                               rng.randint(0, vocab, (TAIL_TOKENS,))])
+               for p in picks]
+    return prefixes, prompts
+
+
+def _run_stream(model, prefixes, prompts, host_kv_gib):
+    """Warm the cache with one pass over the working set, then drive the
+    measured Zipf stream through a manual step loop (per-step wall
+    times + promotion-overlap accounting). Returns the measured-window
+    hit rate, outputs, and the run's cache/step evidence."""
+    from paddle_tpu.inference.serving import PagedContinuousBatcher
+    bt = PagedContinuousBatcher(
+        model, max_batch=MAX_BATCH, s_max=S_MAX, block_size=BLOCK_SIZE,
+        n_pages=N_PAGES, compile=False, policy="ondemand",
+        prefix_cache=True, host_kv_gib=host_kv_gib)
+    try:
+        for pre in prefixes:                       # cold first touches
+            bt.submit(pre, NEW_TOKENS)
+        bt.run_until_done(max_steps=60000)
+        base = bt.prefix_cache.stats()
+
+        rids = [bt.submit(p, NEW_TOKENS) for p in prompts]
+        step_times, steps, overlap_steps = [], 0, 0
+        while bt._has_work():
+            promo_pending = getattr(bt, "_promo", None) is not None
+            decoding = bool(bt._slot_req)
+            t0 = time.perf_counter()
+            bt.step()
+            step_times.append(time.perf_counter() - t0)
+            steps += 1
+            if promo_pending and decoding:
+                overlap_steps += 1
+            if steps > 60000:
+                raise RuntimeError("churn stream did not drain")
+        outs = [bt.pop_result(r) for r in rids]
+
+        st = bt.prefix_cache.stats()
+        hit = st["hit_tokens"] - base["hit_tokens"]
+        miss = st["miss_tokens"] - base["miss_tokens"]
+        free_after = bt.audit_pages()              # raises on any leak
+        times = np.sort(np.asarray(step_times))
+        return {
+            "hit_rate": round(hit / max(hit + miss, 1), 4),
+            "hit_tokens": int(hit), "miss_tokens": int(miss),
+            "cache": {k: int(v) for k, v in st.items()},
+            "outs": outs,
+            "steps": steps, "overlap_steps": overlap_steps,
+            "step_p50_ms": round(
+                float(times[len(times) // 2]) * 1e3, 3),
+            "step_p99_ms": round(
+                float(times[min(len(times) - 1,
+                                int(len(times) * 0.99))]) * 1e3, 3),
+            "free_pages_after": int(free_after),
+        }
+    finally:
+        bt.close()
+
+
+def _prefix_round_path():
+    import glob
+    import re
+    rounds = []
+    for p in glob.glob(os.path.join(_REPO_DIR, "BENCH_PREFIX_r*.json")):
+        m = re.search(r"BENCH_PREFIX_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            rounds.append(int(m.group(1)))
+    n = (max(rounds) + 1) if rounds else 0
+    return os.path.join(_REPO_DIR, f"BENCH_PREFIX_r{n:02d}.json")
+
+
+def main():
+    on_tpu = False
+    try:
+        import jax
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        pass
+    model, cfg = _model()
+    prefixes, prompts = _workload(cfg.vocab_size)
+
+    with paddle.no_grad():
+        dev = _run_stream(model, prefixes, prompts, host_kv_gib=None)
+        tiered = _run_stream(model, prefixes, prompts, host_kv_gib=0.25)
+
+    token_exact = (len(dev["outs"]) == len(tiered["outs"]) and all(
+        np.array_equal(a, b)
+        for a, b in zip(dev["outs"], tiered["outs"])))
+
+    from paddle_tpu.observability import get_registry
+    h = get_registry().histogram("serving.prefix_promotion_seconds")
+    promo_ms = {}
+    for q, tag in ((0.5, "p50"), (0.99, "p99")):
+        v = h.quantile(q)
+        promo_ms[tag] = None if v is None else round(v * 1e3, 3)
+
+    detail = {
+        "tpu": on_tpu,
+        "device_pool_pages": N_PAGES,
+        "working_set_pages": N_PREFIXES * PREFIX_BLOCKS,
+        "prefixes": N_PREFIXES, "requests": N_REQUESTS,
+        "zipf_a": ZIPF_A,
+        "device_only_hit_rate": dev["hit_rate"],
+        "tiered_hit_rate": tiered["hit_rate"],
+        "token_exact": bool(token_exact),
+        "promotion_latency_p50_ms": promo_ms["p50"],
+        "promotion_latency_p99_ms": promo_ms["p99"],
+        "promotions": tiered["cache"]["promotions"],
+        "promotion_failures": tiered["cache"]["promotion_failures"],
+        "demotions": tiered["cache"]["demotions"],
+        "demoted_bytes": tiered["cache"]["demoted_bytes"],
+        "overlap_steps": tiered["overlap_steps"],
+        "tiered_steps": tiered["steps"],
+        "device_only_steps": dev["steps"],
+        "step_p99_ms_device_only": dev["step_p99_ms"],
+        "step_p99_ms_tiered": tiered["step_p99_ms"],
+        "audit_clean": True,       # _run_stream raised otherwise
+    }
+    line = {
+        "metric": "prefix_churn_hit_rate",
+        "value": tiered["hit_rate"],
+        "unit": "frac",
+        "vs_baseline": 0.0,
+        "detail": detail,
+    }
+    try:
+        with open(_prefix_round_path(), "w") as f:
+            json.dump(line, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass  # artifact write must never sink the bench number
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import time as _time
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["RoutePolicy", "LeastLoadedPolicy", "SessionAffinityPolicy",
            "WeightedRoundRobinPolicy", "resolve_policy", "DispatchQueue",
@@ -135,14 +135,18 @@ class SessionAffinityPolicy(RoutePolicy):
         self._sessions: Dict[str, str] = {}     # session_id -> replica name
 
     @staticmethod
-    def _prefix_tokens(req, summary, chains: Dict[int, List[int]]) -> int:
-        """Tokens of ``req.prompt`` already cached per ``summary``.
-        ``chains`` memoizes the prompt's chain hashes per block size so
-        an N-replica pool hashes the prompt once, not N times."""
+    def _prefix_tokens(req, summary,
+                       chains: Dict[int, List[int]]) -> Tuple[int, int]:
+        """(total cached tokens, device-resident tokens) of ``req.prompt``
+        per ``summary``. ``chains`` memoizes the prompt's chain hashes per
+        block size so an N-replica pool hashes the prompt once, not N
+        times. Tiered replicas advertise per-hash residency under
+        ``"tiers"``; summaries without it (untiered, or a pre-tier
+        replica) count everything as device-resident."""
         bs = summary.get("block_size")
         hashes = summary.get("hashes")
         if not bs or not hashes:
-            return 0
+            return 0, 0
         chain = chains.get(bs)
         if chain is None:
             from ..prefix_cache import chain_hashes
@@ -150,29 +154,38 @@ class SessionAffinityPolicy(RoutePolicy):
             chain = (chain_hashes(prompt, bs)
                      if prompt is not None else [])
             chains[bs] = chain
-        depth = 0
+        tiers = summary.get("tiers") or {}
+        depth = dev_depth = 0
         for h in chain:
             # chained hashing: a depth-d node implies its whole ancestor
             # chain, so the first miss ends the longest common prefix
             if h not in hashes:
                 break
             depth += 1
-        return depth * bs
+            # device depth only grows while contiguous from the root
+            # (residency is monotone down the chain, so the first
+            # off-device block ends it)
+            if dev_depth == depth - 1 and tiers.get(h, "device") == "device":
+                dev_depth = depth
+        return depth * bs, dev_depth * bs
 
     def select(self, req, candidates: Sequence):
         hit_c, fb_c, px_c = _route_metrics()
         chains: Dict[int, List[int]] = {}
-        best, best_tokens = None, 0
+        best, best_key = None, (0, 0)
         for r in candidates:
             summary = getattr(r, "prefix_summary", lambda: None)()
             if not summary:
                 continue
-            t = self._prefix_tokens(req, summary, chains)
-            if t > best_tokens or (t == best_tokens and t > 0 and
-                                   (r.load, r.name) <
-                                   (best.load, best.name)):
-                best, best_tokens = r, t
-        if best_tokens > 0:
+            key = self._prefix_tokens(req, summary, chains)
+            # deepest total match first (a host-resident block beats a
+            # recompute — promotion is a memcpy, prefill is flops), then
+            # prefer the replica holding more of it ON DEVICE
+            if key > best_key or (key == best_key and key[0] > 0 and
+                                  (r.load, r.name) <
+                                  (best.load, best.name)):
+                best, best_key = r, key
+        if best_key[0] > 0:
             px_c.inc()
             return best
         by_name = {r.name: r for r in candidates}

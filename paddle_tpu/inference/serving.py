@@ -13,6 +13,7 @@ no per-occupancy recompilation ever happens.
 """
 from __future__ import annotations
 
+import os as _os
 import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -743,6 +744,10 @@ class PagedContinuousBatcher(_BatcherBase):
                  max_queue_depth: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
                  prefix_cache: bool = False,
+                 host_kv_gib: Optional[float] = None,
+                 disk_kv_dir: Optional[str] = None,
+                 disk_kv_gib: Optional[float] = None,
+                 promo_timeout_s: float = 5.0,
                  prompt_buckets=None,
                  draft_model=None, draft_k: int = 4):
         import paddle_tpu as paddle
@@ -860,9 +865,40 @@ class PagedContinuousBatcher(_BatcherBase):
         # LRU-evicts unpinned chains back into the free list on pressure
         self.prefix_cache = None
         self._slot_nodes: Dict[int, list] = {}
+        # tiered KV: one in-flight promotion record (FIFO head only — the
+        # batcher is single-threaded, so only the head request can wait),
+        # an rid denylist for requests whose promotion already failed
+        # (they fall back to full prefill, never retry), and the async
+        # device_put worker that stages host blobs off the critical path
+        self._promo = None
+        self._promo_denied: set = set()
+        self._promoter = None
+        self.promo_timeout_s = promo_timeout_s
+        self._demoted_seen = 0      # cache.demoted_bytes already countered
         if prefix_cache:
-            from .prefix_cache import RadixPrefixCache
-            self.prefix_cache = RadixPrefixCache(block_size)
+            from .prefix_cache import RadixPrefixCache, HostTier, DiskTier
+            host_gib = (host_kv_gib if host_kv_gib is not None else
+                        float(_os.environ.get("PADDLE_KV_HOST_GIB", "0")
+                              or 0.0))
+            host_tier = None
+            if host_gib > 0:
+                ddir = disk_kv_dir or _os.environ.get("PADDLE_KV_DISK_DIR")
+                nxt = None
+                if ddir:
+                    dgib = (disk_kv_gib if disk_kv_gib is not None else
+                            float(_os.environ.get("PADDLE_KV_DISK_GIB",
+                                                  "16") or 16.0))
+                    nxt = DiskTier(ddir, int(dgib * (1 << 30)))
+                host_tier = HostTier(int(host_gib * (1 << 30)),
+                                     next_tier=nxt)
+            self.prefix_cache = RadixPrefixCache(
+                block_size, host_tier=host_tier,
+                spill=self._read_page_blob if host_tier is not None
+                else None)
+            if host_tier is not None:
+                from ..perf.prefetch import AsyncLoader
+                self._promoter = AsyncLoader(
+                    depth=2, name="paddle_tpu_kv_promoter")
         # optional admission ladder: the suffix prefill pads up shared
         # rungs (O(#buckets) prefill signatures, same lever as the dense
         # batcher's prompt_buckets); None keeps exact-length prefill
@@ -883,6 +919,26 @@ class PagedContinuousBatcher(_BatcherBase):
             "serving.pages_leaked",
             "pages unaccounted for by free-list + block tables + prefix "
             "cache (an OOM-much-later bug if ever nonzero)")
+        self._tier_hit_c = _reg.counter(
+            "serving.prefix_tier_hit_tokens",
+            "cached prompt tokens served, by the tier they were resident "
+            "in at match time", labelnames=("tier",))
+        self._promote_h = _reg.histogram(
+            "serving.prefix_promotion_seconds",
+            "host->device prefix promotion latency (submit to install)")
+        self._promo_c = _reg.counter(
+            "serving.prefix_promotions",
+            "prefix pages promoted host/disk -> device")
+        self._promo_fail_c = _reg.counter(
+            "serving.prefix_promotion_failures",
+            "promotions that failed/timed out/lost the page race "
+            "(admission degraded to full prefill)")
+        self._demote_bytes_c = _reg.counter(
+            "serving.prefix_demoted_bytes",
+            "KV bytes spilled device -> host tier on eviction")
+        self._host_bytes_g = _reg.gauge(
+            "serving.kv_host_bytes",
+            "bytes currently held by the host KV tier")
 
         self.cache_quant = cache_quant
         pool = model.paged_alloc(
@@ -1071,10 +1127,7 @@ class PagedContinuousBatcher(_BatcherBase):
         if grow <= 0:
             return True
         if grow > len(self._free_pages) and self.prefix_cache is not None:
-            freed = self.prefix_cache.evict(grow - len(self._free_pages))
-            if freed:
-                self._free_pages.extend(freed)
-                self._prefix_evict_c.inc(len(freed))
+            self._evict_cache_pages(grow - len(self._free_pages))
         if grow > len(self._free_pages):
             return False
         for b in range(have, need_blocks):
@@ -1091,6 +1144,147 @@ class PagedContinuousBatcher(_BatcherBase):
         if self.prefix_cache is not None:
             n += self.prefix_cache.evictable_pages()
         return n
+
+    # -- tiered KV: demotion + async promotion ------------------------------
+    def _evict_cache_pages(self, n: int) -> List[int]:
+        """Reclaim up to n pages from the prefix cache (demoting to the
+        host tier when one is attached), mirroring the demoted-byte
+        delta into the counter."""
+        freed = self.prefix_cache.evict(n)
+        if freed:
+            self._free_pages.extend(freed)
+            self._prefix_evict_c.inc(len(freed))
+        d = self.prefix_cache.demoted_bytes - self._demoted_seen
+        if d:
+            self._demote_bytes_c.inc(d)
+            self._demoted_seen = self.prefix_cache.demoted_bytes
+        return freed
+
+    def _read_page_blob(self, node):
+        """The cache's spill callback: read one node's KV rows off the
+        pool back to pinned host numpy (on the CPU proxy this is a plain
+        copy; on TPU the same call is the D2H readback). The draft pool
+        shares the block table, so its rows spill alongside — promotion
+        must restore BOTH pools for the page to be reusable."""
+        page = int(node.page)
+        blob = {"t": [(np.asarray(kc._data[page]).copy(),
+                       np.asarray(vc._data[page]).copy())
+                      for kc, vc in self._state["layers"]]}
+        if self.draft_model is not None:
+            blob["d"] = [(np.asarray(kc._data[page]).copy(),
+                          np.asarray(vc._data[page]).copy())
+                         for kc, vc in self._dstate["layers"]]
+        return blob
+
+    def _start_promotion(self, req, dev: list, tail: list) -> bool:
+        """Submit the off-device tail of ``req``'s matched path to the
+        async device_put worker. Pins the WHOLE path (device prefix too:
+        eviction must not demote what the request is about to use) and
+        reserves one target page per tail node up front, so a completed
+        transfer always has somewhere to land. False (nothing pinned,
+        nothing reserved) if pages can't be found or chaos says no —
+        the caller degrades to device-prefix-only prefill."""
+        from ..resilience.chaos import fault_point
+        from .prefix_cache import blob_nbytes
+        try:
+            fault_point("kv.host_promote")
+        except Exception:
+            self._promo_fail_c.inc()
+            self.prefix_cache.promotion_failures += 1
+            self._promo_denied.add(req.rid)
+            return False
+        pinned = dev + tail
+        self.prefix_cache.pin(pinned)
+        need = len(tail)
+        if need > len(self._free_pages):
+            self._evict_cache_pages(need - len(self._free_pages))
+        if need > len(self._free_pages):
+            self.prefix_cache.unpin(pinned)
+            return False
+        pages = [self._free_pages.pop() for _ in range(need)]
+        try:
+            blobs = [self.prefix_cache.node_blob(n) for n in tail]
+            fut = self._promoter.submit(blobs)
+        except Exception:
+            self._free_pages.extend(pages)
+            self.prefix_cache.unpin(pinned)
+            self._promo_fail_c.inc()
+            self.prefix_cache.promotion_failures += 1
+            self._promo_denied.add(req.rid)
+            return False
+        t0 = _time.perf_counter()
+        self._promo = {"req": req, "nodes": tail, "pinned": pinned,
+                       "pages": pages,
+                       "nbytes": [blob_nbytes(b) for b in blobs],
+                       "src_tiers": [n.residency for n in tail],
+                       "future": fut, "t0": t0,
+                       "deadline": t0 + self.promo_timeout_s}
+        for n in tail:
+            n.promo = self._promo
+        return True
+
+    def _cancel_promotion(self, deny: bool):
+        """Abandon the in-flight promotion: reserved pages back to the
+        pool, path unpinned. ``deny`` marks it a FAILURE (timeout/error/
+        lost the page race) — the request won't retry and full-prefills
+        instead; deny=False is the benign head-changed path."""
+        promo, self._promo = self._promo, None
+        for n in promo["nodes"]:
+            n.promo = None
+        self.prefix_cache.unpin(promo["pinned"])
+        self._free_pages.extend(promo["pages"])
+        if deny:
+            self._promo_fail_c.inc()
+            self.prefix_cache.promotion_failures += 1
+            self._promo_denied.add(promo["req"].rid)
+
+    def _poll_promotion(self) -> str:
+        """Advance the in-flight promotion: 'pending' while the transfer
+        runs (decode steps keep going — that's the overlap), 'ok' after
+        the staged arrays are installed into the pool at this step
+        boundary, 'failed' on error/timeout (reserved pages reclaimed).
+        Install happens HERE, on the main thread, because compiled decode
+        steps donate and replace the pool arrays every step — a
+        background thread could write into a donated buffer."""
+        promo = self._promo
+        fut = promo["future"]
+        if not fut.done():
+            if _time.perf_counter() < promo["deadline"]:
+                return "pending"
+            self._cancel_promotion(deny=True)
+            return "failed"
+        try:
+            staged = fut.result()
+        except Exception:
+            self._cancel_promotion(deny=True)
+            return "failed"
+        for node, page, blob, nb in zip(promo["nodes"], promo["pages"],
+                                        staged, promo["nbytes"]):
+            for li, (k_s, v_s) in enumerate(blob["t"]):
+                kc, vc = self._state["layers"][li]
+                kc._data = kc._data.at[page].set(k_s)
+                vc._data = vc._data.at[page].set(v_s)
+            if self.draft_model is not None and "d" in blob:
+                for li, (k_s, v_s) in enumerate(blob["d"]):
+                    kc, vc = self._dstate["layers"][li]
+                    kc._data = kc._data.at[page].set(k_s)
+                    vc._data = vc._data.at[page].set(v_s)
+            self.prefix_cache.promote_node(node, page, nb)
+        for n in promo["nodes"]:
+            n.promo = None
+        self.prefix_cache.unpin(promo["pinned"])
+        self._promote_h.observe(_time.perf_counter() - promo["t0"])
+        self._promo_c.inc(len(promo["nodes"]))
+        self._promo_installed_rows = len(promo["nodes"]) * self.block_size
+        self._promo_src_tiers = list(promo["src_tiers"])
+        self._promo = None
+        return "ok"
+
+    def close(self):
+        """Retire the async promotion worker (idempotent; the worker is
+        a daemon thread, so skipping this only delays cleanup)."""
+        if self._promoter is not None:
+            self._promoter.close()
 
     def _release_row(self, row: np.ndarray, keep=()):
         """Reset a block-table row to scratch, returning its pages to the
@@ -1142,6 +1336,9 @@ class PagedContinuousBatcher(_BatcherBase):
             for b in adm["row"]:
                 if b != self._scratch:
                     used.add(int(b))
+        if self._promo is not None:
+            # pages reserved for an in-flight promotion are spoken for
+            used.update(int(p) for p in self._promo["pages"])
         cache_pages = set()
         if self.prefix_cache is not None:
             cp = self.prefix_cache.pages()
@@ -1159,6 +1356,11 @@ class PagedContinuousBatcher(_BatcherBase):
             raise RuntimeError(
                 f"page accounting bug: leaked={sorted(leaked)} "
                 f"free-but-used={sorted(double)}")
+        if self.prefix_cache is not None:
+            # cross-tier half of the audit: host/disk blob byte
+            # accounting must reconcile exactly too
+            rep = self.prefix_cache.audit_tiers()
+            self._host_bytes_g.set(rep.get("host_bytes", 0))
         return 0
 
     @property
@@ -1193,6 +1395,12 @@ class PagedContinuousBatcher(_BatcherBase):
         the way the reference's serving queue does."""
         import paddle_tpu as paddle
         finished = []
+        if self._promo is not None and (
+                not self._pending
+                or self._pending[0] is not self._promo["req"]):
+            # the promotion's request left the head (expired, requeued):
+            # benign cancel, pages back
+            self._cancel_promotion(deny=False)
         while self._pending and self._free_slots:
             req = self._pending[0]
             # a preempted request resumes from prompt ⧺ generated; chunked
@@ -1201,13 +1409,44 @@ class PagedContinuousBatcher(_BatcherBase):
                 [req.prompt, np.asarray(req.tokens, np.int64)]) \
                 if req.tokens else req.prompt
             matched = []
+            promoted_rows = 0
+            src_tiers: List[str] = []
             if self.prefix_cache is not None:
                 # cap at (L-1)//bs blocks: at least one suffix token must
                 # prefill — the first generated token needs logits, and a
                 # fully-cached prompt has none to offer
-                matched = self.prefix_cache.match(
-                    ids_full,
-                    max_blocks=(len(ids_full) - 1) // self.block_size)
+                cap_blocks = (len(ids_full) - 1) // self.block_size
+                matched = self.prefix_cache.match(ids_full,
+                                                  max_blocks=cap_blocks)
+                dev, tail = self.prefix_cache.split_device(matched)
+                if self._promo is not None:
+                    st = self._poll_promotion()
+                    if st == "pending":
+                        if not self._slot_req:
+                            # nothing to overlap with: don't hot-spin the
+                            # step loop while the transfer lands
+                            _time.sleep(500e-6)
+                        break
+                    # ok: the tail is device-resident now; failed: the
+                    # tail stays off-device and is skipped below — either
+                    # way re-split the fresh tree state
+                    matched = self.prefix_cache.match(ids_full,
+                                                      max_blocks=cap_blocks)
+                    dev, tail = self.prefix_cache.split_device(matched)
+                    matched = dev
+                    if st == "ok":
+                        promoted_rows = self._promo_installed_rows
+                        src_tiers = self._promo_src_tiers
+                elif (tail and self._promoter is not None
+                        and req.rid not in self._promo_denied):
+                    if self._start_promotion(req, dev, tail):
+                        break     # decode steps continue while it flies
+                    matched = dev
+                else:
+                    # off-device tail unusable (no promoter, or this
+                    # request already burned its promotion): prefill it
+                    # fresh — insert() upgrades the stale nodes in place
+                    matched = dev
                 if matched:
                     # pin BEFORE the availability gate: the gate may
                     # admit on the promise of evicting OTHER chains, and
@@ -1223,6 +1462,7 @@ class PagedContinuousBatcher(_BatcherBase):
                     self.prefix_cache.unpin(matched)
                 break
             self._pending.pop(0)
+            self._promo_denied.discard(req.rid)
             slot = self._free_slots.pop(0)
             if matched:
                 self._bt[slot, :len(matched)] = [n.page for n in matched]
@@ -1292,13 +1532,23 @@ class PagedContinuousBatcher(_BatcherBase):
                 self._prefix_miss_c.inc(S)
                 self.prefix_cache.hit_tokens += m_rows
                 self.prefix_cache.miss_tokens += S
+                self._tier_hit_c.labels(tier="device").inc(
+                    m_rows - promoted_rows)
+                for t in src_tiers:
+                    self._tier_hit_c.labels(tier=t).inc(self.block_size)
+                self.prefix_cache.host_hit_tokens += promoted_rows
                 new_nodes = self.prefix_cache.insert(
                     ids_np, self._bt[slot], len(matched),
                     L // self.block_size)
                 self._slot_nodes[slot] = list(matched) + new_nodes
-            self._trace_prefill_end(req, prompt_tokens=len(ids_np),
-                                    pages=need, prefix_hit=m_rows,
-                                    padded_to=padded_len)
+            end_tags = dict(prompt_tokens=len(ids_np), pages=need,
+                            prefix_hit=m_rows, padded_to=padded_len)
+            if promoted_rows:
+                # the ledger splits evicted_prefix_recompute pricing on
+                # this: a promoted resume repaid its eviction from the
+                # host tier, not by recomputing
+                end_tags["host_promoted"] = promoted_rows
+            self._trace_prefill_end(req, **end_tags)
             tok = int(self._pick(np.asarray(logits._data))[0])
             req.slot = slot
             req.tokens.append(tok)
@@ -1500,6 +1750,12 @@ class PagedContinuousBatcher(_BatcherBase):
             if slot not in self._slot_req:
                 continue
             while not self._alloc_pages(slot, int(self._dec[slot]) + 1):
+                if self._promo is not None:
+                    # an in-flight promotion loses the race to live
+                    # decode: reclaim its reserved pages before touching
+                    # any live request (its admission full-prefills)
+                    self._cancel_promotion(deny=True)
+                    continue
                 if self._preempt_latest(protect=slot):
                     continue
                 if self._admitting is not None:

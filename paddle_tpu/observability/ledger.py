@@ -18,7 +18,12 @@ user received is itemized into explicit **waste categories**:
                                 ``requeue_recompute=1``),
 - ``evicted_prefix_recompute``— re-prefill of prompt+tokens after a
                                 preemption evicted the request's KV
-                                (``evict_recompute=1``),
+                                (``evict_recompute=1``; split by
+                                repayment path in
+                                ``evicted_prefix_split`` — a
+                                ``host_promoted`` resume restored its
+                                prefix from the KV host tier and only
+                                re-prefilled the residual suffix),
 - ``speculation_rejected``    — the share of decode spent scoring
                                 draft tokens the verifier rejected
                                 (``spec_proposed``/``spec_matched``
@@ -62,6 +67,12 @@ class GoodputLedger:
         self.charged_s = 0.0          # every span self-second, any phase
         self.chip_s = 0.0             # admit/prefill/decode self-seconds
         self.waste: Dict[str, float] = {c: 0.0 for c in WASTE_CATEGORIES}
+        # evicted_prefix_recompute, split by HOW the eviction was repaid:
+        # "host_promoted" resumes pulled the prefix back from the KV host
+        # tier (waste = only the residual suffix re-prefill), "recomputed"
+        # ones re-prefilled the whole thing. Sums to the category total.
+        self.evicted_split: Dict[str, float] = {"host_promoted": 0.0,
+                                                "recomputed": 0.0}
         self.by_key: Dict[Tuple[str, str, str], float] = {}
 
     # -- charging --------------------------------------------------------------
@@ -80,7 +91,13 @@ class GoodputLedger:
             self.chip_s += seg.self_s
             cat, w = self._waste_of(seg)
             if cat is not None and w > 0.0:
-                self.waste[cat] += min(w, seg.self_s)
+                w = min(w, seg.self_s)
+                self.waste[cat] += w
+                if cat == "evicted_prefix_recompute":
+                    path = ("host_promoted"
+                            if seg.tags.get("host_promoted") else
+                            "recomputed")
+                    self.evicted_split[path] += w
         return self
 
     def add_all(self, wfs: Iterable[Waterfall]) -> "GoodputLedger":
@@ -163,6 +180,7 @@ class GoodputLedger:
             "goodput_seconds": max(self.chip_s - self.waste_s, 0.0),
             "goodput_frac": self.goodput_frac,
             "waste_seconds": dict(self.waste),
+            "evicted_prefix_split": dict(self.evicted_split),
             "by_phase": dict(sorted(by_phase.items(),
                                     key=lambda kv: -kv[1])),
             "by_tenant": dict(sorted(by_tenant.items(),
@@ -192,6 +210,13 @@ class GoodputLedger:
             labelnames=("category",))
         for cat, s in self.waste.items():
             waste_g.labels(category=cat).set(s)
+        split_g = registry.gauge(
+            "ledger.evicted_prefix_seconds",
+            "evicted_prefix_recompute waste by repayment path "
+            "(host_promoted vs recomputed)",
+            labelnames=("path",))
+        for path, s in self.evicted_split.items():
+            split_g.labels(path=path).set(s)
         chip_g = registry.gauge(
             "ledger.chip_seconds",
             "span self-seconds charged by tenant/rung/phase",

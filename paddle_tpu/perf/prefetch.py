@@ -19,6 +19,11 @@ Observability: ``prefetch.batches`` (batches staged), ``prefetch.buffered``
 (current queue depth, with peak), ``prefetch.wait`` (seconds the consumer
 blocked — nonzero p95 means the pipeline is host-bound), and
 ``prefetch.transfer`` (per-batch transfer+convert seconds).
+
+``AsyncLoader`` is the third piece: a bounded background ``device_put``
+worker returning ``TransferFuture``s — the promotion lane the tiered KV
+cache uses to land host-spilled prefix blocks back on device while decode
+steps keep running (``prefetch.async_loads`` / ``prefetch.async_load_seconds``).
 """
 from __future__ import annotations
 
@@ -27,7 +32,8 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
-__all__ = ["DevicePrefetcher", "coalesced_device_put"]
+__all__ = ["DevicePrefetcher", "AsyncLoader", "TransferFuture",
+           "coalesced_device_put"]
 
 
 def coalesced_device_put(batch, device=None):
@@ -96,6 +102,7 @@ class DevicePrefetcher:
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
         self._err: Optional[BaseException] = None
         self._closed = False
+        self._retired = False   # feeder thread confirmed exited
         self._batches, self._buffered, self._wait, self._xfer = _metrics()
         self._thread = threading.Thread(
             target=self._feed, daemon=True, name="paddle_tpu_prefetcher")
@@ -147,23 +154,144 @@ class DevicePrefetcher:
         self._buffered.add(-1)
         return item
 
-    def close(self):
-        """Stop the feeder and drop buffered batches (safe to call twice)."""
+    def close(self, timeout: float = 2.0):
+        """Stop the feeder, drop buffered batches, and retire the thread.
+
+        Idempotent and bounded: a feeder blocked mid-``put`` on a full
+        queue is woken by repeatedly draining until it observes
+        ``_closed`` and exits — a single drain (the old behavior) could
+        leave it parked for one more full batch if the source iterator
+        produced between the drain and the join. Total wait <= timeout;
+        a transfer wedged inside ``device_put`` past that is abandoned to
+        its daemon thread.
+        """
+        if self._retired:
+            return
         self._closed = True
-        drained = 0
+        deadline = time.perf_counter() + timeout
         while True:
-            try:
-                item = self._q.get_nowait()
-            except queue_mod.Empty:
-                break
-            if item is not self._SENTINEL:
-                drained += 1
-        if drained:
-            self._buffered.add(-drained)
-        self._thread.join(timeout=2.0)
+            drained = 0
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if item is not self._SENTINEL:
+                    drained += 1
+            if drained:
+                self._buffered.add(-drained)
+            self._thread.join(timeout=0.05)
+            if not self._thread.is_alive():
+                self._retired = True
+                return
+            if time.perf_counter() >= deadline:
+                return
 
     def __del__(self):  # pragma: no cover — best-effort cleanup
         try:
             self.close()
+        except Exception:
+            pass
+
+
+class TransferFuture:
+    """Completion handle for one AsyncLoader transfer (threading.Event
+    based — ``done()`` is the poll the batcher's admission loop uses)."""
+
+    __slots__ = ("_ev", "_result", "_exc")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("transfer not complete")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def _set(self, value):
+        self._result = value
+        self._ev.set()
+
+    def _fail(self, exc: BaseException):
+        self._exc = exc
+        self._ev.set()
+
+
+class AsyncLoader:
+    """Background host-to-device stager: the promotion lane of the tiered
+    KV cache (and any other caller that wants ``device_put`` off the
+    critical path). ``submit(pytree_of_numpy)`` returns a
+    :class:`TransferFuture`; a daemon worker runs ``jax.device_put`` on
+    the whole pytree in one call, blocks until the arrays are resident,
+    and completes the future. The queue is bounded (``depth``, default 2:
+    double buffering) so a burst of submissions backpressures instead of
+    pinning unbounded host memory.
+    """
+
+    def __init__(self, depth: int = 2, device=None,
+                 name: str = "paddle_tpu_kv_promoter"):
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
+        self._device = device
+        self._closed = False
+        from ..observability.metrics import get_registry
+        reg = get_registry()
+        self._loads = reg.counter(
+            "prefetch.async_loads", "pytrees staged to device by AsyncLoader")
+        self._load_h = reg.histogram(
+            "prefetch.async_load_seconds",
+            "AsyncLoader per-submit device_put + ready seconds")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def _run(self):
+        import jax
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, payload = item
+            try:
+                t0 = time.perf_counter()
+                staged = jax.device_put(payload, self._device)
+                for leaf in jax.tree_util.tree_leaves(staged):
+                    leaf.block_until_ready()
+                self._load_h.observe(time.perf_counter() - t0)
+                self._loads.inc()
+                fut._set(staged)
+            except BaseException as e:  # noqa: BLE001 — surfaced via future
+                fut._fail(e)
+
+    def submit(self, payload) -> TransferFuture:
+        if self._closed:
+            raise RuntimeError("AsyncLoader is closed")
+        fut = TransferFuture()
+        self._q.put((fut, payload))
+        return fut
+
+    def close(self, timeout: float = 2.0):
+        """Idempotent bounded shutdown (pending futures still complete if
+        the worker drains them before the sentinel)."""
+        if self._closed:
+            self._thread.join(timeout=timeout)
+            return
+        self._closed = True
+        try:
+            self._q.put_nowait(None)
+        except queue_mod.Full:
+            # worker is busy; it will see the sentinel once it drains
+            self._q.put(None)
+        self._thread.join(timeout=timeout)
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close(timeout=0.2)
         except Exception:
             pass

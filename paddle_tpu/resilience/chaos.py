@@ -13,6 +13,12 @@ hot seams of this codebase:
   * ``collective.enter``  — eager collective entry (collective.py)
   * ``serving.step``      — continuous-batcher step (inference/serving.py)
   * ``kv.request``        — launcher master-KV requests (controllers.py)
+  * ``kv.host_demote``    — spilling an evicted prefix block's KV rows to
+    the host tier (inference/prefix_cache.py; a failure drops the chain
+    instead of demoting — pages stay clean)
+  * ``kv.host_promote``   — submitting a host->device prefix promotion
+    (inference/serving.py; a failure degrades the admission to full
+    prefill, token-exact)
   * ``dataloader.next``   — batch delivery (io/dataloader.py)
   * ``train.step``        — hapi train_batch (hapi/model.py)
 
@@ -62,7 +68,8 @@ FAULT_KINDS = ("delay", "transient_error", "torn_write", "nan_grad",
 # allowed so new seams can be drilled before this list catches up)
 KNOWN_POINTS = ("checkpoint.write", "checkpoint.shard_write",
                 "checkpoint.publish", "collective.enter", "serving.step",
-                "kv.request", "dataloader.next", "train.step")
+                "kv.request", "kv.host_demote", "kv.host_promote",
+                "dataloader.next", "train.step")
 
 
 class ChaosError(RuntimeError):

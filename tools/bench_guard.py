@@ -41,12 +41,14 @@ def discover(dirpath: str, prefix: str = "BENCH_r") -> List[dict]:
     Each returned dict is the PARSED bench line plus ``_round``/``_file``
     bookkeeping; unusable rounds appear with ``_skip`` set (reason).
     The default prefix is the train lane; the gateway lane lives in
-    ``BENCH_GATEWAY_r*.json`` (bench_gateway.py writes it) and the
-    multichip lane in ``MULTICHIP_r*.json`` (bench_multichip.py) — both
-    pulled in by ``run_check`` with their own prefixes. The globs are
-    disjoint, so the relay gate (train-lane-only by construction) never
-    sees gateway/multichip rounds, and pre-lane MULTICHIP artifacts
-    (raw dry-run wrappers without a parsed bench line) skip cleanly."""
+    ``BENCH_GATEWAY_r*.json`` (bench_gateway.py writes it), the
+    multichip lane in ``MULTICHIP_r*.json`` (bench_multichip.py) and
+    the KV-tier churn lane in ``BENCH_PREFIX_r*.json``
+    (bench_prefix_churn.py) — all pulled in by ``run_check`` with their
+    own prefixes. The globs are disjoint, so the relay gate
+    (train-lane-only by construction) never sees the other lanes'
+    rounds, and pre-lane MULTICHIP artifacts (raw dry-run wrappers
+    without a parsed bench line) skip cleanly."""
     out: List[dict] = []
     rx = re.compile(re.escape(prefix) + r"(\d+)\.json$")
     for path in sorted(glob.glob(os.path.join(dirpath,
@@ -173,7 +175,27 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
                 "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
                 "_round": r["_round"], "_file": r["_file"],
                 "_lane": "gateway"})
-    records = records + gw_records + mc_records + goodput_records
+    px_records = discover(dirpath, prefix="BENCH_PREFIX_r")
+    for r in px_records:
+        r["_lane"] = "prefix"
+    # the churn bench's headline value is the TIERED durable hit rate;
+    # promotion latency gates as an INVERSE series (promotions/s from
+    # detail.promotion_latency_p99_ms) because the band is a lower
+    # bound — a latency blowup shows up as the rate collapsing.
+    promo_records = []
+    for r in px_records:
+        if "_skip" in r:
+            continue
+        p99 = (r.get("detail") or {}).get("promotion_latency_p99_ms")
+        if isinstance(p99, (int, float)) and p99 > 0:
+            promo_records.append({
+                "metric": "prefix_promotion_p99_rate",
+                "value": 1000.0 / float(p99), "unit": "promotions/s",
+                "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
+                "_round": r["_round"], "_file": r["_file"],
+                "_lane": "prefix"})
+    records = (records + gw_records + mc_records + goodput_records
+               + px_records + promo_records)
     report = {
         "dir": dirpath,
         "tolerance": tolerance,

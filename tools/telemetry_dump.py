@@ -124,9 +124,11 @@ def main(argv=None) -> int:
     ap.add_argument("--prefix-stats", action="store_true",
                     help="with --fleet: append a radix prefix-cache "
                          "summary (hit/miss tokens, hit rate, "
-                         "evictions, KV-aware route hits) derived from "
-                         "the fleet-summed serving.prefix_* and "
-                         "gateway.route.prefix_hit counters")
+                         "evictions, KV-aware route hits, per-tier hit "
+                         "tokens, host demotion/promotion traffic and "
+                         "promotion-latency p50/p99) derived from the "
+                         "fleet-summed serving.prefix_* and "
+                         "gateway.route.prefix_hit series")
     args = ap.parse_args(argv)
 
     if args.prefix_stats and not args.fleet:
@@ -199,20 +201,46 @@ def main(argv=None) -> int:
             text += "# fleet finding " + json.dumps(f.to_dict()) + "\n"
         if args.prefix_stats:
             sums = {}
+            by_tier = {}
+            promo_q = {}
             for s in agg.fleet_series():
                 if s.get("type") == "counter":
                     sums[s["name"]] = sums.get(s["name"], 0) \
                         + s.get("value", 0)
+                    if s["name"] == "serving.prefix_tier_hit_tokens":
+                        t = (s.get("labels") or {}).get("tier", "?")
+                        by_tier[t] = by_tier.get(t, 0) \
+                            + s.get("value", 0)
+                elif s.get("type") == "histogram" and \
+                        s["name"] == "serving.prefix_promotion_seconds":
+                    promo_q = s.get("quantiles") or {}
             hit = sums.get("serving.prefix_hit_tokens", 0)
             miss = sums.get("serving.prefix_miss_tokens", 0)
-            text += "# fleet prefix-stats " + json.dumps({
+            stats = {
                 "hit_tokens": hit,
                 "miss_tokens": miss,
                 "hit_rate": round(hit / max(hit + miss, 1), 4),
                 "evictions": sums.get("serving.prefix_evictions", 0),
                 "route_prefix_hits": sums.get(
                     "gateway.route.prefix_hit", 0),
-            }) + "\n"
+            }
+            if by_tier:
+                # tiered KV columns only when a host tier reported:
+                # untiered fleets keep the legacy line byte-identical
+                stats["hit_tokens_by_tier"] = dict(sorted(
+                    by_tier.items()))
+                stats["promotions"] = sums.get(
+                    "serving.prefix_promotions", 0)
+                stats["promotion_failures"] = sums.get(
+                    "serving.prefix_promotion_failures", 0)
+                stats["demoted_bytes"] = sums.get(
+                    "serving.prefix_demoted_bytes", 0)
+                for q in ("p50", "p99"):
+                    v = promo_q.get(q)
+                    if v is not None:
+                        stats[f"promotion_latency_{q}_ms"] = round(
+                            v * 1e3, 3)
+            text += "# fleet prefix-stats " + json.dumps(stats) + "\n"
         if args.out:
             with open(args.out, "w") as fh:
                 fh.write(text)
