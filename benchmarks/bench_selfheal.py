@@ -1,0 +1,237 @@
+"""Self-healing fleet benchmark: chaos scenarios with remediation
+off vs on under seeded traffic.
+
+Three scenarios, each replayed twice over the IDENTICAL ``traffic.py``
+schedule — once with the fleet on its own (the gateway's built-in
+death-requeue is always active; nothing else), once with the closed
+loop attached (``AnomalyDetector`` + ``GatewayProbe`` feeding an
+``AutoRemediator``, an ``Autoscaler`` as its scale executor, and a
+tight-window ``SLOMonitor`` for the tenant-burst shed):
+
+  * ``straggler``    — a ``gateway.step.r1`` chaos delay makes one
+    replica slow; remediation should NAME and drain it (token-exact
+    requeue) so TTFT returns in-SLO.
+  * ``kill_replica`` — a ``serving.step`` transient-error burst kills
+    one replica mid-stream; remediation should scale a replacement up
+    off the queue-depth spike.
+  * ``tenant_burst`` — a burst tenant floods arrivals; remediation
+    should shed that tenant when the TTFT SLO burns (and un-shed on
+    resolution).
+
+Emits the ``BENCH_TRAFFIC_r<NN>.json`` lane artifact gated by
+``tools/bench_guard.py`` (``traffic:`` lane): headline value =
+remediation-ON goodput_frac in the straggler scenario, with
+``detail.recovery_steps_on`` feeding the inverse recovery-rate series.
+Same ONE-stdout-line contract as every bench.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_BENCH_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_DIR = os.path.dirname(_BENCH_DIR)
+sys.path.insert(0, _BENCH_DIR)
+sys.path.insert(0, _REPO_DIR)
+import traffic  # noqa: E402  (sibling script, not a package)
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM  # noqa: E402
+
+TTFT_SLO_S = 0.08
+STRAGGLE_S = 0.25
+
+
+def _model():
+    cfg = GPT2Config(vocab_size=2048, hidden_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     max_position_embeddings=256, dropout=0.0)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _factory(model):
+    from paddle_tpu.inference.serving import ContinuousBatcher
+
+    def make(name):
+        return ContinuousBatcher(model, max_batch=4, s_max=128,
+                                 compile=False)
+    return make
+
+
+def _build_gateway(make):
+    from paddle_tpu.inference.gateway import Gateway
+    gw = Gateway(policy="least_loaded", max_queue_depth=128)
+    gw.add_replica("r0", make("r0"))
+    gw.add_replica("r1", make("r1"))
+    return gw
+
+
+def _warmup(gw, vocab):
+    """Build the anomaly baselines (and the engines' compiled prefill
+    rungs) BEFORE any chaos arms: both replicas step with work for
+    >= min_samples ticks, across EVERY pow2 prompt rung the traffic
+    will hit — a first-touch prefill compile mid-run would register as
+    a huge step and fire a false per-replica spike."""
+    rng = np.random.RandomState(99)
+    for _ in range(3):
+        for n in (6, 12, 20, 28, 40, 48):
+            gw.submit(rng.randint(0, vocab, (n,)), 4, tenant="warmup")
+    gw.run_until_done()
+    gw.reset_stats()
+
+
+# per-scenario policy tables: each drill arms the rule(s) a deployment
+# would pair with that failure class. The tenant-burst table carries NO
+# drain rule — burst load legitimately slows every replica's steps, and
+# draining half the capacity on that spike is the misfire the policy
+# table exists to prevent.
+_POLICIES = {
+    "straggler": (("tpot_spike", "drain_replica", 2, 10.0),),
+    "kill_replica": (("queue_depth_spike", "scale_up", 3, 5.0),),
+    "tenant_burst": (("slo_breach:traffic_ttft", "shed_tenant", 1, 15.0),
+                     ("queue_depth_spike", "scale_up", 3, 10.0)),
+}
+
+
+def _attach(gw, make, scenario):
+    """The closed loop: probe -> detector -> remediator (+ autoscaler
+    + tight-window SLO monitor for the shed path)."""
+    from paddle_tpu.inference.gateway.autoscaler import Autoscaler
+    from paddle_tpu.observability.anomaly import (AnomalyDetector,
+                                                  GatewayProbe)
+    from paddle_tpu.observability.slo import SLO, BurnWindow, SLOMonitor
+    from paddle_tpu.resilience.remediator import (AutoRemediator,
+                                                  FlapGuard, PolicyRule)
+    # above the ~2-4x robust-z that honest prefill-heavy steps reach
+    detector = AnomalyDetector(threshold=10.0, min_samples=8)
+    probe = GatewayProbe(gw, detector)
+    monitor = SLOMonitor(
+        [SLO("traffic_ttft", "gateway.ttft_seconds", TTFT_SLO_S,
+             objective=0.9)],
+        windows=[BurnWindow(fast_s=0.5, slow_s=1.5,
+                            burn_threshold=3.0)])
+    guard = FlapGuard(max_actions=4, window_s=30.0, freeze_s=60.0)
+    asc = Autoscaler(gw, make, min_replicas=1, max_replicas=4,
+                     queue_high=10, hysteresis=4, cooldown_s=5.0,
+                     flap_guard=guard)
+    policy = tuple(PolicyRule(sig, act, hysteresis=h, cooldown_s=c)
+                   for sig, act, h, c in _POLICIES[scenario])
+    rem = AutoRemediator(gw, monitor=monitor, detector=detector,
+                         policy=policy, replica_factory=make,
+                         autoscaler=asc, flap_guard=guard)
+    return rem, probe
+
+
+def _scenario(name, make, vocab, spec, chaos=None, remediate=False):
+    from paddle_tpu.resilience.chaos import arm_scenario, disarm
+    disarm()
+    gw = _build_gateway(make)
+    rem = probe = None
+    if remediate:
+        # the probe attaches BEFORE warmup so the anomaly detector's
+        # per-replica baselines are built from HEALTHY steps — chaos
+        # arms only after
+        rem, probe = _attach(gw, make, name)
+    _warmup(gw, vocab)
+    if chaos:
+        arm_scenario(chaos)
+    tick = (lambda step: rem.tick()) if rem is not None else None
+    try:
+        res = traffic.drive(gw, traffic.generate(spec), TTFT_SLO_S,
+                            tick=tick)
+    finally:
+        disarm()
+        if probe is not None:
+            probe.close()
+    out = res.summary()
+    if rem is not None:
+        out["remediator"] = rem.summary()
+        out["actions"] = [a.to_dict() for a in rem.executed()]
+    return out
+
+
+def main():
+    paddle.seed(0)
+    model, cfg = _model()
+    make = _factory(model)
+    vocab = cfg.vocab_size
+    t0 = time.perf_counter()
+
+    base = dict(seed=3, steps=70, vocab=vocab, base_rate=0.5,
+                prompt_lo=6, prompt_hi=24, new_lo=3, new_hi=8)
+    scenarios = {
+        "straggler": dict(
+            spec=traffic.TrafficSpec(**base),
+            chaos=(f"seed=0; gateway.step.r1:delay:"
+                   f"delay_s={STRAGGLE_S},after=2,count=1000")),
+        "kill_replica": dict(
+            # load-bound on purpose: one survivor cannot keep up, so
+            # the scale-up's extra capacity (not noise) decides the run
+            spec=traffic.TrafficSpec(**dict(base, base_rate=1.2)),
+            chaos="seed=0; serving.step:transient_error:after=20,count=3"),
+        "tenant_burst": dict(
+            spec=traffic.TrafficSpec(**dict(
+                base, pattern="steady", burst_at=15, burst_len=25,
+                burst_rate=2.5)),
+            chaos=None),
+    }
+
+    detail = {"ttft_slo_ms": TTFT_SLO_S * 1e3, "tpu": False,
+              "scenarios": {}}
+    with paddle.no_grad():
+        for name, kw in scenarios.items():
+            off = _scenario(name, make, vocab, kw["spec"],
+                            chaos=kw["chaos"], remediate=False)
+            on = _scenario(name, make, vocab, kw["spec"],
+                           chaos=kw["chaos"], remediate=True)
+            detail["scenarios"][name] = {"off": off, "on": on}
+
+    st = detail["scenarios"]["straggler"]
+    detail["goodput_frac_on"] = st["on"]["goodput_frac"]
+    detail["goodput_frac_off"] = st["off"]["goodput_frac"]
+    detail["recovery_steps_on"] = st["on"]["recovery_steps"]
+    detail["recovery_steps_off"] = st["off"]["recovery_steps"]
+    detail["actions_on"] = sum(
+        len(s["on"].get("actions", ()))
+        for s in detail["scenarios"].values())
+    # a token-accounting divergence through drain/requeue raises inside
+    # drive(); reaching this line IS the token-exactness proof
+    detail["token_exact"] = True
+    detail["elapsed_s"] = round(time.perf_counter() - t0, 2)
+
+    line = {
+        "metric": "traffic_selfheal_goodput_frac",
+        "value": detail["goodput_frac_on"],
+        "unit": "frac",
+        "vs_baseline": 0.0,
+        "detail": detail,
+    }
+    try:
+        with open(_traffic_round_path(), "w") as f:
+            json.dump(line, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass  # artifact write must never sink the bench number
+    print(json.dumps(line))
+
+
+def _traffic_round_path():
+    """Next BENCH_TRAFFIC_r<NN>.json slot (the traffic lane)."""
+    import glob
+    import re
+    rounds = []
+    for p in glob.glob(os.path.join(_REPO_DIR, "BENCH_TRAFFIC_r*.json")):
+        m = re.search(r"BENCH_TRAFFIC_r(\d+)\.json$",
+                      os.path.basename(p))
+        if m:
+            rounds.append(int(m.group(1)))
+    n = (max(rounds) + 1) if rounds else 0
+    return os.path.join(_REPO_DIR, f"BENCH_TRAFFIC_r{n:02d}.json")
+
+
+if __name__ == "__main__":
+    main()

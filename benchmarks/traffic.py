@@ -1,0 +1,256 @@
+"""Seed-deterministic serving traffic: the workload half of the
+self-healing harness.
+
+A ``TrafficSpec`` describes a workload the way a serving fleet sees
+one — diurnal or bursty arrival rates, mixed tenants with mixed
+priorities, mixed prompt lengths, a shared-prefix population (K system
+prompts a fraction of requests reuse), sticky sessions — and
+``generate(spec)`` expands it into per-step arrival lists that are a
+pure function of ``spec.seed``. Every chaos comparison in
+``bench_selfheal.py`` and ``tests/test_selfheal.py`` replays the SAME
+schedule with remediation off vs on, so the only difference between
+the two runs is the control loop under test.
+
+``drive(gw, arrivals, ttft_slo_s, tick=...)`` is the matching load
+loop: submit each step's arrivals (typed sheds are counted, not
+raised), advance the gateway one tick, invoke the caller's hook (where
+the remediator/autoscaler tick), and record per-step and per-request
+outcomes. The result carries the two numbers the self-heal acceptance
+gate cares about:
+
+  * ``goodput_frac`` — completions within the TTFT SLO over ALL
+    offered requests (sheds and failures count against goodput);
+  * ``first_breach_step`` / ``last_breach_step`` — the SLO incident
+    window in steps; ``recovery_steps`` is its length, i.e. how long
+    the fleet took to get from the first out-of-SLO completion back to
+    (and staying) in-SLO.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["TrafficSpec", "TrafficRequest", "TrafficResult",
+           "generate", "drive"]
+
+
+@dataclass(frozen=True)
+class TrafficSpec:
+    """One deterministic workload description (see module docstring)."""
+
+    seed: int = 0
+    steps: int = 120
+    vocab: int = 2048
+    base_rate: float = 0.4          # expected arrivals per step
+    pattern: str = "diurnal"        # diurnal | bursty | steady
+    period: int = 80                # diurnal cycle length, steps
+    swing: float = 0.5              # diurnal amplitude (frac of base)
+    burst_at: Optional[int] = None  # bursty: burst window start step
+    burst_len: int = 20
+    burst_rate: float = 2.0         # arrivals/step inside the burst
+    burst_tenant: str = "burst"
+    tenants: Tuple[Tuple[str, float], ...] = (
+        ("interactive", 0.7), ("batch", 0.3))
+    prompt_lo: int = 8
+    prompt_hi: int = 40
+    new_lo: int = 4
+    new_hi: int = 12
+    n_shared: int = 3               # shared-prefix population size
+    shared_len: int = 24
+    shared_frac: float = 0.5        # frac of requests reusing a prefix
+    session_frac: float = 0.3       # frac carrying a sticky session id
+    n_sessions: int = 8
+
+
+@dataclass
+class TrafficRequest:
+    """One scheduled arrival."""
+
+    at_step: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    tenant: str
+    priority: str
+    session_id: Optional[str] = None
+
+
+def _rate_at(spec: TrafficSpec, t: int) -> float:
+    rate = spec.base_rate
+    if spec.pattern == "diurnal":
+        rate *= 1.0 + spec.swing * np.sin(2.0 * np.pi * t / spec.period)
+    if spec.pattern == "bursty" or spec.burst_at is not None:
+        if spec.burst_at is not None and \
+                spec.burst_at <= t < spec.burst_at + spec.burst_len:
+            rate += spec.burst_rate
+    return max(0.0, rate)
+
+
+def generate(spec: TrafficSpec) -> List[List[TrafficRequest]]:
+    """Per-step arrival lists, a pure function of ``spec`` (one seeded
+    RNG drives arrivals, tenants, lengths, and prefixes in a fixed
+    draw order)."""
+    rng = np.random.RandomState(spec.seed)
+    shared = [rng.randint(0, spec.vocab, (spec.shared_len,))
+              for _ in range(spec.n_shared)]
+    names = [t for t, _ in spec.tenants]
+    weights = np.asarray([w for _, w in spec.tenants], float)
+    weights = weights / weights.sum()
+    out: List[List[TrafficRequest]] = []
+    for t in range(spec.steps):
+        n = int(rng.poisson(_rate_at(spec, t)))
+        in_burst = (spec.burst_at is not None
+                    and spec.burst_at <= t < spec.burst_at
+                    + spec.burst_len)
+        batch: List[TrafficRequest] = []
+        for _ in range(n):
+            # burst arrivals beyond the base rate belong to the burst
+            # tenant (the noisy neighbor the shed policy should name)
+            if in_burst and rng.random_sample() > \
+                    spec.base_rate / max(_rate_at(spec, t), 1e-9):
+                tenant = spec.burst_tenant
+            else:
+                tenant = names[int(rng.choice(len(names), p=weights))]
+            priority = "low" if tenant == "batch" else "high"
+            tail_len = int(rng.randint(spec.prompt_lo,
+                                       spec.prompt_hi + 1))
+            if rng.random_sample() < spec.shared_frac:
+                head = shared[int(rng.randint(spec.n_shared))]
+                prompt = np.concatenate(
+                    [head, rng.randint(0, spec.vocab, (tail_len,))])
+            else:
+                prompt = rng.randint(0, spec.vocab, (tail_len,))
+            sid = (f"s{int(rng.randint(spec.n_sessions))}"
+                   if rng.random_sample() < spec.session_frac else None)
+            batch.append(TrafficRequest(
+                at_step=t, prompt=prompt,
+                max_new_tokens=int(rng.randint(spec.new_lo,
+                                               spec.new_hi + 1)),
+                tenant=tenant, priority=priority, session_id=sid))
+        out.append(batch)
+    return out
+
+
+@dataclass
+class TrafficResult:
+    """Outcome of one driven schedule."""
+
+    ttft_slo_s: float
+    submitted: int = 0
+    shed: int = 0
+    completions: int = 0
+    in_slo: int = 0
+    failed: int = 0
+    ttfts: List[float] = field(default_factory=list)
+    # per-step series (index = step): queue depth, completions, worst
+    # TTFT completed that step (None when none completed)
+    queue_depth: List[int] = field(default_factory=list)
+    step_completions: List[int] = field(default_factory=list)
+    step_worst_ttft: List[Optional[float]] = field(default_factory=list)
+    first_breach_step: Optional[int] = None
+    last_breach_step: Optional[int] = None
+
+    @property
+    def offered(self) -> int:
+        return self.submitted + self.shed
+
+    @property
+    def goodput_frac(self) -> float:
+        return self.in_slo / max(self.offered, 1)
+
+    @property
+    def recovery_steps(self) -> int:
+        """Steps from the first out-of-SLO completion until the fleet
+        was back (and stayed) in-SLO; 0 when no breach ever happened."""
+        if self.first_breach_step is None:
+            return 0
+        return self.last_breach_step - self.first_breach_step + 1
+
+    def summary(self) -> Dict[str, object]:
+        return {"offered": self.offered, "submitted": self.submitted,
+                "shed": self.shed, "completions": self.completions,
+                "failed": self.failed, "in_slo": self.in_slo,
+                "goodput_frac": round(self.goodput_frac, 4),
+                "ttft_p99_ms": round(_p99(self.ttfts) * 1e3, 3)
+                if self.ttfts else None,
+                "first_breach_step": self.first_breach_step,
+                "last_breach_step": self.last_breach_step,
+                "recovery_steps": self.recovery_steps}
+
+
+def _p99(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def drive(gw, arrivals: List[List[TrafficRequest]], ttft_slo_s: float,
+          tick: Optional[Callable[[int], None]] = None,
+          max_drain_steps: int = 4000) -> TrafficResult:
+    """Run ``arrivals`` against ``gw``: one gateway step per schedule
+    step (plus drain steps until the queue empties), ``tick(step)``
+    after each — the hook where a remediator/autoscaler advances.
+    Typed rejections (quota, queue capacity, infeasible deadline) are
+    counted as sheds, not raised."""
+    res = TrafficResult(ttft_slo_s=ttft_slo_s)
+    meta: Dict[int, int] = {}           # gid -> submit step
+
+    def _submit(step_i: int, batch: List[TrafficRequest]):
+        for tr in batch:
+            try:
+                gid = gw.submit(tr.prompt, tr.max_new_tokens,
+                                tenant=tr.tenant, priority=tr.priority,
+                                session_id=tr.session_id)
+            except Exception:   # typed Overloaded / DeadlineExceeded
+                res.shed += 1
+                continue
+            meta[gid] = step_i
+            res.submitted += 1
+
+    def _harvest(step_i: int, done: List[int]):
+        worst = None
+        for gid in done:
+            req = gw._finished.get(gid)
+            if req is None or gid not in meta:
+                continue
+            res.completions += 1
+            ttft = ((req.first_token_t - req.submit_t)
+                    if req.first_token_t is not None else None)
+            if ttft is not None:
+                res.ttfts.append(ttft)
+                worst = ttft if worst is None else max(worst, ttft)
+                if ttft <= ttft_slo_s:
+                    res.in_slo += 1
+                else:
+                    if res.first_breach_step is None:
+                        res.first_breach_step = step_i
+                    res.last_breach_step = step_i
+            gw.pop_result(gid)
+            meta.pop(gid, None)
+        # requests that FAILED (deadline, attempt budget) surface on
+        # the failed map — count them so goodput sees every casualty
+        for gid in [g for g in list(meta) if g in gw._failed]:
+            res.failed += 1
+            meta.pop(gid, None)
+            gw._failed.pop(gid, None)
+        res.queue_depth.append(len(gw._queue))
+        res.step_completions.append(len(done))
+        res.step_worst_ttft.append(worst)
+
+    step_i = 0
+    for batch in arrivals:
+        _submit(step_i, batch)
+        done = gw.step()
+        if tick is not None:
+            tick(step_i)
+        _harvest(step_i, done)
+        step_i += 1
+    drained = 0
+    while gw._has_work() and drained < max_drain_steps:
+        done = gw.step()
+        if tick is not None:
+            tick(step_i)
+        _harvest(step_i, done)
+        step_i += 1
+        drained += 1
+    return res

@@ -26,6 +26,7 @@ Entry point::
     gid = gw.submit(prompt_ids, max_new_tokens=32, tenant="alice")
     out = gw.run_until_done()[gid]
 """
+from .autoscaler import Autoscaler
 from .gateway import Gateway, GatewayRequest
 from .quota import TenantQuotas, TokenBucket
 from .replica import Replica, ReplicaPool
@@ -35,7 +36,7 @@ from .router import (DispatchQueue, LeastLoadedPolicy, PRIORITY_HIGH,
 from .streaming import StreamingSession
 
 __all__ = [
-    "Gateway", "GatewayRequest",
+    "Gateway", "GatewayRequest", "Autoscaler",
     "TokenBucket", "TenantQuotas",
     "Replica", "ReplicaPool",
     "RoutePolicy", "LeastLoadedPolicy", "SessionAffinityPolicy",

@@ -185,8 +185,21 @@ class Gateway:
                     weight: float = 1.0) -> Replica:
         return self.pool.add(name, batcher, weight=weight)
 
-    def drain_replica(self, name: str):
+    def drain_replica(self, name: str, requeue: bool = False):
+        """Stop routing new work to ``name``. By default in-flight work
+        finishes on the draining replica (it keeps stepping). With
+        ``requeue`` the in-flight requests move back to the gateway
+        queue NOW and resume on survivors — token-exact from
+        ``prompt ⧺ delivered`` with the same lost/dup accounting guard
+        as the death path — so the replica empties immediately
+        (scale-down and remediation don't wait out a long decode).
+        Post-drain spans carry ``drained=1`` baggage."""
+        rep = self.pool.get(name)
         self.pool.drain(name)
+        if requeue and rep.alive and rep.load > 0:
+            if isinstance(self.router, SessionAffinityPolicy):
+                self.router.forget_replica(name)
+            self._requeue_from(rep, drained=True)
 
     def remove_replica(self, name: str, force: bool = False) -> Replica:
         """Remove ``name`` from the pool. ``force`` requeues its
@@ -394,29 +407,40 @@ class Gateway:
         self.router.on_dispatch(req, rep)
         self._tele.dispatch_c.inc()
 
-    def _requeue_from(self, rep: Replica):
+    def _requeue_from(self, rep: Replica, drained: bool = False):
         """Move every request assigned to ``rep`` back into the gateway
-        queue (head of its lane). Called on replica death and forced
-        removal. Requests that already exhausted their attempt budget
+        queue (head of its lane). Called on replica death, forced
+        removal, and requeue-drain (``drained``: the replica is ALIVE —
+        deliver its pending decoded tokens first, then withdraw the
+        batcher-side request so both engines never decode the same
+        request). Requests that already exhausted their attempt budget
         fail typed instead of cycling forever."""
         for req in [r for r in self._requests.values()
                     if r.replica == rep.name]:
             # a request that FINISHED before the death is a completion,
             # not a casualty — harvest it (its final poll may not have
-            # run yet)
+            # run yet). On a live drain, poll unconditionally: tokens a
+            # healthy engine already decoded are valid — delivering them
+            # now shrinks the survivor's recompute to exactly
+            # prompt ⧺ delivered
             breq = rep.batcher.request(req.rid)
-            if breq is not None and breq.finished:
+            if breq is not None and (breq.finished or drained):
                 self._poll_one(req, rep)
                 if req.gid not in self._requests:
                     continue
-            # close the dead replica's open batcher spans, then mark the
+            if drained:
+                rep.batcher.abort(req.rid)
+            # close the old replica's open batcher spans, then mark the
             # trace so every span begun AFTER this point carries
             # requeued=1 (baggage merges at begin time)
             if breq is not None and breq.spans:
                 _trace.end_open_spans(breq.spans, interrupted=1)
             if req.trace is not None:
                 req.trace.baggage["requeued"] = 1
+                if drained:
+                    req.trace.baggage["drained"] = 1
                 req.trace.event("requeue", replica=rep.name,
+                                drained=int(drained),
                                 delivered=len(req.delivered))
             req.replica = None
             req.rid = None

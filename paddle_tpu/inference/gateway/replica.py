@@ -208,6 +208,14 @@ class ReplicaPool:
         """
         t0 = _time.perf_counter()
         try:
+            # per-replica chaos seam: the shared ``serving.step`` point
+            # fires on whichever replica steps next, so a drill that
+            # needs to straggle ONE replica arms this name instead
+            # (e.g. ``gateway.step.r1:delay:delay_s=0.05``). An error
+            # kind here bypasses the retry policy — it models the
+            # replica's host dying, not a flaky step
+            from ...resilience.chaos import fault_point
+            fault_point(f"gateway.step.{rep.name}")
             rids = self.step_retry.call(rep.batcher.step,
                                         point=f"gateway.step.{rep.name}")
             elapsed = _time.perf_counter() - t0

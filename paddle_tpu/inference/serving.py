@@ -332,9 +332,13 @@ class _BatcherBase:
                 tags["evict_recompute"] = 1
             elif req.trace.baggage.get("requeued"):
                 # failover survivor: the prompt re-prefill duplicates
-                # work the dead replica already did — the ledger costs
-                # this interval as waste.requeue_recompute
+                # work the dead/drained replica already did — the ledger
+                # costs this interval as waste.requeue_recompute
                 tags["requeue_recompute"] = 1
+                if req.trace.baggage.get("drained"):
+                    # administrative drain, not a death — same recompute
+                    # cost, different cause
+                    tags["drain_recompute"] = 1
             req.spans["prefill"] = req.trace.begin(
                 "prefill", parent=req.spans.get("admit"), **tags)
 
@@ -523,6 +527,34 @@ class _BatcherBase:
         """The stored typed failure for ``rid`` (``DeadlineExceeded``,
         …) without raising/popping it; None while healthy."""
         return self._failed.get(rid)
+
+    def abort(self, rid: int) -> bool:
+        """Withdraw a LIVE request without recording a failure — the
+        caller re-owns it (the gateway's drain-requeue path moves the
+        request to a survivor and resumes token-exact from
+        ``prompt ⧺ delivered``). Pending requests leave the queue;
+        active ones release their slot (and cache rows); a mid-admission
+        paged request releases its pages, same mechanics as deadline
+        expiry. Returns True when something was withdrawn; False for an
+        unknown rid or a terminal request (finished results stay
+        poppable, failures stay raised by ``pop_result``)."""
+        for req in list(self._pending):
+            if req.rid == rid:
+                self._pending.remove(req)
+                return True
+        for slot, req in list(self._slot_req.items()):
+            if req.rid == rid:
+                del self._slot_req[slot]
+                self._release_slot(slot)
+                req.slot = None
+                return True
+        adm = getattr(self, "_admitting", None)
+        if adm is not None and adm["req"].rid == rid:
+            self._release_row(adm["row"])
+            self._free_slots.append(adm["slot"])
+            self._admitting = None
+            return True
+        return False
 
 
 class ContinuousBatcher(_BatcherBase):

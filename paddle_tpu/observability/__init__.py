@@ -44,7 +44,8 @@ from .flight import (FlightRecorder, build_postmortem, flight_record,
                      get_flight, read_ring)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
-from .slo import SLO, Alert, BurnWindow, SLOMonitor, default_gateway_slos
+from .slo import (SLO, Alert, BurnWindow, Resolved, SLOMonitor,
+                  default_gateway_slos)
 from .trace_context import (TraceContext, TraceRecorder, TraceSpan,
                             get_recorder, new_trace)
 from .tracing import (Span, attach_context, capture_context, current_span,
@@ -69,6 +70,7 @@ __all__ = [
     "attach_context", "traced",
     "TraceContext", "TraceSpan", "TraceRecorder", "get_recorder",
     "new_trace",
-    "SLO", "Alert", "BurnWindow", "SLOMonitor", "default_gateway_slos",
+    "SLO", "Alert", "BurnWindow", "Resolved", "SLOMonitor",
+    "default_gateway_slos",
     "render_prometheus", "write_jsonl", "load_jsonl",
 ]

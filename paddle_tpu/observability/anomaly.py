@@ -13,7 +13,11 @@ Detection is deliberately robust, not Gaussian: the baseline is the
 rolling **median**, the scale is the **MAD** (median absolute
 deviation, floored at a fraction of the median so a perfectly quiet
 series cannot divide by ~zero), and a sample fires only after a warmup
-of ``min_samples`` observations. An EWMA of the series rides along in
+of ``min_samples`` observations. Samples that FIRE enter the baseline
+window winsorized (clamped at ``median + 3 * scale``): a persistent
+fault cannot absorb itself into its own baseline and go quiet — it
+keeps firing until fixed (or until the slow, bounded winsorized
+adaptation accepts the new level as normal). An EWMA of the series rides along in
 every finding's detail for the remediator's trend view. Everything is
 deterministic given the observation sequence — chaos drills assert on
 it.
@@ -46,6 +50,11 @@ DEFAULT_MIN_SAMPLES = 8       # warmup before a series may fire
 DEFAULT_WINDOW = 64           # rolling median/MAD window
 DEFAULT_EWMA_ALPHA = 0.3
 MAD_FLOOR_FRAC = 0.05         # scale floor: 5% of |median|
+WINSOR_SIGMA = 3.0            # firing samples enter the baseline
+#                               clamped at med + 3*scale — NOT at the
+#                               firing threshold (a high threshold would
+#                               make the clamp itself an outlier big
+#                               enough to blow up a small window's MAD)
 
 
 def _median(xs: List[float]) -> float:
@@ -88,6 +97,7 @@ class AnomalyDetector:
         track = self._tracks.setdefault((metric, key),
                                         _Track(self.window))
         finding = None
+        baseline_value = value
         if track.count >= self.min_samples and track.window:
             med = _median(list(track.window))
             mad = _median([abs(x - med) for x in track.window]) * 1.4826
@@ -102,7 +112,13 @@ class AnomalyDetector:
                             "mad": mad, "score": score,
                             "ewma": track.ewma, "n": track.count})
                 self.findings.append(finding)
-        track.window.append(value)
+                # a confirmed outlier must not poison the baseline it
+                # was judged against: enter the window WINSORIZED near
+                # the baseline, so a persistent fault keeps firing
+                # (remediator hysteresis needs consecutive findings)
+                # while the baseline still adapts — slowly and boundedly
+                baseline_value = med + WINSOR_SIGMA * scale
+        track.window.append(baseline_value)
         track.count += 1
         track.ewma = value if track.ewma is None else (
             self.ewma_alpha * value

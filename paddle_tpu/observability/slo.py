@@ -20,6 +20,14 @@ Clock-injectable (tests replay deterministically); alerts are typed
 ``Alert`` records kept on the monitor AND counted in the registry
 (``slo.alerts_total{slo,severity}``), with live burn gauges
 (``slo.burn_rate{slo,window}``) for dashboards.
+
+Alerts are edge-triggered, and the OTHER edge is typed too: when a
+firing condition's burn rate falls back under threshold, the monitor
+emits a ``Resolved`` record (kept on ``.resolutions``, counted in
+``slo.resolved_total{slo,severity}``, carrying the incident duration) —
+so a consumer like the auto-remediator can distinguish an ongoing
+incident from a recovered one instead of inferring recovery from
+silence.
 """
 from __future__ import annotations
 
@@ -31,7 +39,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .metrics import Histogram, get_registry
 
-__all__ = ["SLO", "BurnWindow", "Alert", "SLOMonitor",
+__all__ = ["SLO", "BurnWindow", "Alert", "Resolved", "SLOMonitor",
            "default_gateway_slos", "DEFAULT_WINDOWS"]
 
 
@@ -92,6 +100,19 @@ class Alert:
     message: str = ""
 
 
+@dataclass
+class Resolved:
+    """The recovery edge of a previously fired alert: the burn rate fell
+    back under threshold. ``duration_s`` spans fired_at → resolved_at."""
+
+    slo: str
+    severity: str
+    fired_at: float
+    resolved_at: float
+    duration_s: float
+    message: str = ""
+
+
 def default_gateway_slos(ttft_s: float = 0.5, tpot_s: float = 0.1,
                          objective: float = 0.99) -> List[SLO]:
     """The two SLOs the gateway's admission control already speaks."""
@@ -125,12 +146,18 @@ class SLOMonitor:
         self._snaps: Dict[str, deque] = {
             s.name: deque(maxlen=max_snapshots) for s in self.slos}
         self.alerts: List[Alert] = []
-        self._active: set = set()       # (slo, severity) currently firing
+        self.resolutions: List[Resolved] = []
+        # (slo, severity) currently firing → the alert's fired_at time
+        # (a dict so the resolution edge can report incident duration)
+        self._active: Dict[Tuple[str, str], float] = {}
         self._burn_g = self._reg.gauge(
             "slo.burn_rate", "error-budget burn rate by SLO and window",
             labelnames=("slo", "window"))
         self._alerts_c = self._reg.counter(
             "slo.alerts_total", "burn-rate alerts fired",
+            labelnames=("slo", "severity"))
+        self._resolved_c = self._reg.counter(
+            "slo.resolved_total", "burn-rate alerts resolved",
             labelnames=("slo", "severity"))
 
     # -- histogram reading ----------------------------------------------------
@@ -203,7 +230,7 @@ class SLOMonitor:
                 if burn_fast >= w.burn_threshold \
                         and burn_slow >= w.burn_threshold:
                     if key not in self._active:
-                        self._active.add(key)
+                        self._active[key] = now
                         alert = Alert(
                             slo=slo.name, severity=w.severity,
                             burn_fast=burn_fast, burn_slow=burn_slow,
@@ -221,13 +248,28 @@ class SLOMonitor:
                         self._alerts_c.labels(
                             slo=slo.name, severity=w.severity).inc()
                 else:
-                    self._active.discard(key)
+                    fired_at = self._active.pop(key, None)
+                    if fired_at is not None:
+                        # recovery edge: the condition re-arms AND the
+                        # incident closes as a typed record
+                        res = Resolved(
+                            slo=slo.name, severity=w.severity,
+                            fired_at=fired_at, resolved_at=now,
+                            duration_s=now - fired_at,
+                            message=(f"{slo.name}: burn back under "
+                                     f"{w.burn_threshold}x after "
+                                     f"{now - fired_at:.1f}s"))
+                        self.resolutions.append(res)
+                        self._resolved_c.labels(
+                            slo=slo.name, severity=w.severity).inc()
         return fired
 
     def summary(self) -> dict:
         """Current state for dashboards / ``telemetry_dump --slo``."""
         out: dict = {"slos": [], "alerts": [a.__dict__ for a in
-                                            self.alerts]}
+                                            self.alerts],
+                     "resolutions": [r.__dict__ for r in
+                                     self.resolutions]}
         for slo in self.slos:
             snaps = self._snaps[slo.name]
             cur = snaps[-1] if snaps else None

@@ -23,7 +23,8 @@ Everything reports through ``paddle_tpu.observability``
 """
 from __future__ import annotations
 
-from . import chaos, checkpoint_manager, recovery, retry, sharded_checkpoint
+from . import (chaos, checkpoint_manager, recovery, remediator, retry,
+               sharded_checkpoint)
 from .chaos import (ChaosError, ChaosRegistry, FaultSpec,
                     TransientChaosError, TornWrite, arm_from_env,
                     arm_scenario, disarm, fault_point, get_chaos,
@@ -32,13 +33,18 @@ from .checkpoint_manager import (COMMITTED_MARKER, CheckpointFinding,
                                  CheckpointManager, validate_checkpoint)
 from .recovery import (DeadlineExceeded, HealthState, HealthStateMachine,
                        Overloaded, StepGuard)
+from .remediator import (ACTION_KINDS, AutoRemediator, DEFAULT_POLICY,
+                         FlapGuard, PolicyRule, RemediationAction, Signal,
+                         remediate_enabled)
 from .retry import DEFAULT_RETRYABLE, RetryGiveUp, RetryPolicy
 from .sharded_checkpoint import (AckTimeout, ShardedCheckpointManager,
                                  validate_sharded_checkpoint)
 
 __all__ = [
     "chaos", "retry", "checkpoint_manager", "recovery",
-    "sharded_checkpoint",
+    "sharded_checkpoint", "remediator",
+    "AutoRemediator", "RemediationAction", "PolicyRule", "Signal",
+    "FlapGuard", "DEFAULT_POLICY", "ACTION_KINDS", "remediate_enabled",
     "ChaosError", "TransientChaosError", "TornWrite", "FaultSpec",
     "ChaosRegistry", "get_chaos", "fault_point", "arm_scenario",
     "arm_from_env", "disarm", "parse_scenario", "torn_write_bytes",

@@ -12,6 +12,11 @@ hot seams of this codebase:
     it observed every shard ack (the phase-2 seam)
   * ``collective.enter``  — eager collective entry (collective.py)
   * ``serving.step``      — continuous-batcher step (inference/serving.py)
+  * ``gateway.step.<replica>`` — ONE named replica's engine step in the
+    gateway pool (gateway/replica.py; the shared ``serving.step`` point
+    hits whichever replica steps next — this one targets a single
+    replica, e.g. a ``delay`` makes exactly ``r1`` a straggler; error
+    kinds bypass the retry policy and kill the replica outright)
   * ``kv.request``        — launcher master-KV requests (controllers.py)
   * ``kv.host_demote``    — spilling an evicted prefix block's KV rows to
     the host tier (inference/prefix_cache.py; a failure drops the chain
@@ -65,9 +70,12 @@ FAULT_KINDS = ("delay", "transient_error", "torn_write", "nan_grad",
                "kill_rank")
 
 # the seams instrumented today (open set — arming an unknown point is
-# allowed so new seams can be drilled before this list catches up)
+# allowed so new seams can be drilled before this list catches up;
+# ``gateway.step.<replica>`` is a per-replica family, one point per
+# pool member)
 KNOWN_POINTS = ("checkpoint.write", "checkpoint.shard_write",
                 "checkpoint.publish", "collective.enter", "serving.step",
+                "gateway.step.<replica>",
                 "kv.request", "kv.host_demote", "kv.host_promote",
                 "dataloader.next", "train.step")
 

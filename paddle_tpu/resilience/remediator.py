@@ -1,0 +1,554 @@
+"""Telemetry-driven auto-remediation: alerts become actions.
+
+Rounds 10–15 built the sensing plane — multi-window SLO burn-rate
+alerts (``observability.slo``), typed fleet findings
+(``observability.fleet``), and online anomaly spikes
+(``observability.anomaly``) — but every signal terminated in a
+dashboard. This module closes the loop: an ``AutoRemediator``
+subscribes to all three streams, normalizes them into one ``Signal``
+shape, maps them through a declarative policy table
+(``PolicyRule(signal, action, hysteresis, cooldown_s)``) to typed
+``RemediationAction``s, and executes those against the gateway's own
+control surfaces:
+
+  * ``drain_replica``   — ``Gateway.drain_replica(name, requeue=True)``
+    (token-exact requeue; the straggler's in-flight work resumes on
+    survivors)
+  * ``restart_replica`` — forced remove + a fresh engine from the
+    deployment's ``replica_factory`` under the same name
+  * ``reroute_sessions``— ``SessionAffinityPolicy.forget_replica`` (sticky
+    sessions re-route on their next turn)
+  * ``shed_tenant``     — throttle the top-queued tenant's token bucket
+    (restored automatically when the triggering SLO resolves)
+  * ``scale_up`` / ``scale_down`` — delegated to an attached
+    ``gateway.autoscaler.Autoscaler`` (or a bare ``replica_factory``)
+
+A production remediator's failure mode is CAUSING the outage it is
+meant to fix, so every action is triple-gated:
+
+  1. **hysteresis** — a rule acts only after its signal fired on K
+     CONSECUTIVE ticks (one noisy spike never drains anything);
+  2. **per-(action, target) cooldown** — the same action on the same
+     target within ``cooldown_s`` is suppressed (no
+     drain → restart → drain churn on one replica);
+  3. **global flap guard** — at most ``max_actions`` executed per
+     ``window_s`` across ALL targets; breaching the budget freezes the
+     remediator for ``freeze_s``, and every further breach DOUBLES the
+     freeze (escalate-don't-oscillate: a remediator that keeps hitting
+     its budget is fighting a fire it cannot put out, and backs off for
+     a human instead of thrashing).
+
+``dry_run`` journals intent without touching the pool. The
+``PADDLE_REMEDIATE`` env var gates the whole loop at construction:
+``0``/``off`` disables execution entirely, ``dry`` forces dry-run,
+unset/``1`` leaves the constructor arguments in charge.
+
+Every decision — executed, dry-run, or suppressed and why — lands in
+the per-rank telemetry spool (``remediation`` events, the
+``telemetry_dump --actions`` timeline), the crash-surviving flight
+recorder, and ``remediator.*`` registry series.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..observability.fleet import FleetFinding, spool_event
+from ..observability.flight import flight_record
+
+__all__ = ["Signal", "PolicyRule", "RemediationAction", "FlapGuard",
+           "AutoRemediator", "DEFAULT_POLICY", "ACTION_KINDS",
+           "remediate_enabled"]
+
+ACTION_KINDS = ("drain_replica", "restart_replica", "reroute_sessions",
+                "shed_tenant", "scale_up", "scale_down")
+
+# decision outcomes a proposal can land on (journaled verbatim)
+_EXECUTED = "executed"
+_DRY_RUN = "dry_run"
+_DISABLED = "disabled"
+
+
+def remediate_enabled(default: bool = True) -> bool:
+    """The ``PADDLE_REMEDIATE`` master gate (``0``/``off``/``false``
+    disables; anything else leaves ``default`` in charge)."""
+    v = os.environ.get("PADDLE_REMEDIATE", "").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return False
+    return default
+
+
+@dataclass(frozen=True)
+class Signal:
+    """One normalized input event, whatever plane it came from.
+
+    kind: ``tpot_spike`` / ``ttft_spike`` / ``queue_depth_spike``
+    (anomaly), ``straggler`` / ``desync`` / ``missing_rank`` (fleet),
+    ``slo_breach:<slo>`` / ``slo_resolved:<slo>`` (burn-rate monitor).
+    target: the implicated replica/tenant when the source names one.
+    """
+
+    kind: str
+    target: Optional[str] = None
+    severity: str = ""
+    detail: tuple = ()          # frozen (k, v) pairs for hashability
+
+    def detail_dict(self) -> dict:
+        return dict(self.detail)
+
+
+@dataclass(frozen=True)
+class PolicyRule:
+    """One row of the declarative policy table: when ``signal`` has
+    fired on ``hysteresis`` consecutive ticks, take ``action`` (subject
+    to the per-target cooldown and the global flap guard)."""
+
+    signal: str
+    action: str
+    hysteresis: int = 2
+    cooldown_s: float = 60.0
+
+    def __post_init__(self):
+        if self.action not in ACTION_KINDS:
+            raise ValueError(f"unknown action {self.action!r} "
+                             f"(one of {ACTION_KINDS})")
+        if self.hysteresis < 1:
+            raise ValueError("hysteresis must be >= 1")
+
+
+# the default table: anomaly spikes that NAME a replica drain/reroute
+# it; queue pressure scales up; a sustained TTFT SLO burn sheds the
+# top-queued tenant (and un-sheds on resolution); a fleet missing_rank
+# restarts. Deployments override by passing their own table.
+DEFAULT_POLICY: Tuple[PolicyRule, ...] = (
+    PolicyRule("tpot_spike", "drain_replica", hysteresis=2,
+               cooldown_s=60.0),
+    PolicyRule("ttft_spike", "reroute_sessions", hysteresis=2,
+               cooldown_s=60.0),
+    PolicyRule("straggler", "drain_replica", hysteresis=2,
+               cooldown_s=60.0),
+    PolicyRule("missing_rank", "restart_replica", hysteresis=1,
+               cooldown_s=120.0),
+    PolicyRule("queue_depth_spike", "scale_up", hysteresis=3,
+               cooldown_s=90.0),
+    PolicyRule("slo_breach:gateway_ttft", "shed_tenant", hysteresis=2,
+               cooldown_s=120.0),
+)
+
+
+@dataclass
+class RemediationAction:
+    """One decided action (executed or not — ``decision`` says which)."""
+
+    kind: str
+    target: str
+    signal: str
+    decision: str               # executed | dry_run | disabled | the
+    #                             suppression reason (cooldown, flap_*,
+    #                             no_target, last_replica, no_factory)
+    reason: str
+    at: float
+    detail: dict = field(default_factory=dict)
+
+    @property
+    def executed(self) -> bool:
+        return self.decision == _EXECUTED
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "target": self.target,
+                "signal": self.signal, "decision": self.decision,
+                "reason": self.reason, "at": self.at,
+                "detail": dict(self.detail)}
+
+
+class FlapGuard:
+    """Global action budget with an escalate-don't-oscillate ladder.
+
+    At most ``max_actions`` executed actions per rolling ``window_s``.
+    A proposal over budget is rejected AND freezes the guard for
+    ``freeze_s``; every subsequent breach doubles the freeze (capped at
+    ``max_freeze_s``). A healthy stretch (no breach for a full window)
+    resets the ladder.
+    """
+
+    def __init__(self, max_actions: int = 4, window_s: float = 60.0,
+                 freeze_s: float = 120.0, max_freeze_s: float = 3600.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_actions < 1:
+            raise ValueError("max_actions must be >= 1")
+        self.max_actions = int(max_actions)
+        self.window_s = float(window_s)
+        self.freeze_s = float(freeze_s)
+        self.max_freeze_s = float(max_freeze_s)
+        self._clock = clock
+        self._times: deque = deque()
+        self._freeze_until = 0.0
+        self._last_breach = -float("inf")
+        self.escalations = 0
+
+    def _prune(self, now: float):
+        while self._times and self._times[0] <= now - self.window_s:
+            self._times.popleft()
+
+    @property
+    def frozen_until(self) -> float:
+        return self._freeze_until
+
+    def check(self, now: Optional[float] = None) -> Tuple[bool, str]:
+        """(allowed, reason-if-not). Checking over budget escalates."""
+        now = self._clock() if now is None else now
+        if now < self._freeze_until:
+            return False, "flap_frozen"
+        self._prune(now)
+        # the ladder re-arms only after a full CALM window — and frozen
+        # time is not calm (nothing could act), so calm is measured from
+        # whichever ended later: the last breach or the freeze it caused
+        if now - max(self._last_breach, self._freeze_until) \
+                > self.window_s:
+            self.escalations = 0
+        if len(self._times) >= self.max_actions:
+            self.escalations += 1
+            self._last_breach = now
+            freeze = min(self.max_freeze_s,
+                         self.freeze_s * (2 ** (self.escalations - 1)))
+            self._freeze_until = now + freeze
+            return False, "flap_budget"
+        return True, ""
+
+    def record(self, now: Optional[float] = None):
+        self._times.append(self._clock() if now is None else now)
+
+
+class AutoRemediator:
+    """The closed remediation loop over one ``Gateway``.
+
+    gw: the gateway whose pool/router/quotas the actions touch.
+    monitor: an ``observability.slo.SLOMonitor`` (polled every tick;
+    its alerts/resolutions become ``slo_breach:*`` / ``slo_resolved:*``
+    signals). detector: an ``observability.anomaly.AnomalyDetector``
+    (new findings consumed by index — pair it with a ``GatewayProbe``
+    for the online feed). fleet_findings: a zero-arg callable returning
+    ``FleetFinding``s (e.g. a bound ``FleetAggregator`` scan); consumed
+    once each by (kind, op, seq) identity. policy: the rule table
+    (default ``DEFAULT_POLICY``). replica_factory: ``name -> batcher``
+    for restart/scale actions. autoscaler: an attached
+    ``gateway.autoscaler.Autoscaler`` scale_up/scale_down delegate to.
+    dry_run: journal intent, touch nothing. clock: injectable time.
+
+    Drive ``tick()`` alongside ``gw.step()`` — it is synchronous,
+    deterministic, and cheap when nothing fires.
+    """
+
+    def __init__(self, gw, monitor=None, detector=None,
+                 fleet_findings: Optional[Callable[[], Sequence[FleetFinding]]] = None,
+                 policy: Sequence[PolicyRule] = DEFAULT_POLICY,
+                 replica_factory: Optional[Callable[[str], object]] = None,
+                 autoscaler=None, dry_run: bool = False,
+                 flap_guard: Optional[FlapGuard] = None,
+                 min_routable: int = 1,
+                 shed_factor: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic):
+        self.gw = gw
+        self.monitor = monitor
+        self.detector = detector
+        self.fleet_findings = fleet_findings
+        self.policy = list(policy)
+        self.replica_factory = replica_factory
+        self.autoscaler = autoscaler
+        self.dry_run = (True if os.environ.get(
+            "PADDLE_REMEDIATE", "").strip().lower() == "dry" else dry_run)
+        self.enabled = remediate_enabled()
+        self.flap_guard = flap_guard or FlapGuard(clock=clock)
+        self.min_routable = int(min_routable)
+        self.shed_factor = float(shed_factor)
+        self._clock = clock
+        self.actions: List[RemediationAction] = []   # every decision
+        self._alert_idx = 0
+        self._resolved_idx = 0
+        self._finding_idx = 0
+        self._fleet_seen: set = set()
+        # hysteresis counters: (rule.signal, rule.action, target) →
+        # consecutive ticks the signal fired
+        self._streak: Dict[Tuple[str, str, str], int] = {}
+        # cooldowns: (action, target) → last EXECUTED time
+        self._cooldown: Dict[Tuple[str, str], float] = {}
+        # shed_tenant undo state: tenant → original bucket
+        self._shed_orig: Dict[str, object] = {}
+        self._restart_seq = 0
+        from ..observability.metrics import get_registry
+        reg = get_registry()
+        self._signals_c = reg.counter(
+            "remediator.signals_total", "normalized input signals seen",
+            labelnames=("kind",))
+        self._actions_c = reg.counter(
+            "remediator.actions_total",
+            "remediation decisions, by action and outcome",
+            labelnames=("action", "decision"))
+        self._frozen_g = reg.gauge(
+            "remediator.frozen",
+            "1 while the flap guard has the remediator frozen")
+
+    # -- signal collection ----------------------------------------------------
+    def _collect(self, now: float) -> List[Signal]:
+        out: List[Signal] = []
+        if self.monitor is not None:
+            self.monitor.poll(now)
+            for a in self.monitor.alerts[self._alert_idx:]:
+                out.append(Signal(kind=f"slo_breach:{a.slo}",
+                                  severity=a.severity,
+                                  detail=(("burn_fast", a.burn_fast),
+                                          ("burn_slow", a.burn_slow))))
+            self._alert_idx = len(self.monitor.alerts)
+            for r in getattr(self.monitor, "resolutions", ())[
+                    self._resolved_idx:]:
+                out.append(Signal(kind=f"slo_resolved:{r.slo}",
+                                  severity=r.severity,
+                                  detail=(("duration_s", r.duration_s),)))
+            self._resolved_idx = len(self.monitor.resolutions)
+        if self.detector is not None:
+            for f in self.detector.findings[self._finding_idx:]:
+                out.append(self._from_finding(f))
+            self._finding_idx = len(self.detector.findings)
+        if self.fleet_findings is not None:
+            for f in self.fleet_findings():
+                key = (f.kind, f.op, f.seq)
+                if key in self._fleet_seen:
+                    continue
+                self._fleet_seen.add(key)
+                out.append(self._from_finding(f))
+        for s in out:
+            self._signals_c.labels(kind=s.kind).inc()
+        return out
+
+    @staticmethod
+    def _from_finding(f: FleetFinding) -> Signal:
+        # anomaly findings carry the replica/series name in
+        # detail["key"]; fleet findings implicate a rank
+        target = f.detail.get("key")
+        if target is None and f.rank is not None:
+            target = f"rank{f.rank}"
+        detail = tuple(sorted(
+            (k, v) for k, v in f.detail.items()
+            if isinstance(v, (int, float, str, bool, type(None)))))
+        return Signal(kind=f.kind, target=target, detail=detail)
+
+    # -- the decision tick ----------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> List[RemediationAction]:
+        """Collect signals, advance hysteresis, decide, execute.
+        Returns the decisions made during THIS call (executed or not)."""
+        now = self._clock() if now is None else now
+        signals = self._collect(now)
+        # worst-first: when one fault degrades several replicas at once
+        # (the straggler's survivors absorb its load and slow down too),
+        # the HIGHEST-scoring anomaly must win the action budget — not
+        # whichever replica happened to step first this tick
+        signals.sort(key=lambda s: -float(
+            s.detail_dict().get("score") or 0.0))
+        self._frozen_g.set(
+            1 if now < self.flap_guard.frozen_until else 0)
+        decided: List[RemediationAction] = []
+        fired_keys: set = set()
+        for sig in signals:
+            for rule in self.policy:
+                if rule.signal != sig.kind:
+                    continue
+                target = self._resolve_target(rule.action, sig)
+                key = (rule.signal, rule.action, target or "")
+                fired_keys.add(key)
+                streak = self._streak.get(key, 0) + 1
+                self._streak[key] = streak
+                if streak < rule.hysteresis:
+                    continue
+                act = self._propose(rule, sig, target, now)
+                decided.append(act)
+                if act.executed:
+                    self._streak[key] = 0
+            # resolution signals also un-shed outside the policy table:
+            # the shed is lifted when the incident that caused it closes
+            if sig.kind.startswith("slo_resolved:") and self._shed_orig:
+                decided.extend(self._unshed_all(sig, now))
+        # a tick where a signal did NOT fire resets its streak —
+        # hysteresis means K CONSECUTIVE firings
+        for key in [k for k in self._streak if k not in fired_keys]:
+            self._streak[key] = 0
+        self.actions.extend(decided)
+        return decided
+
+    def _resolve_target(self, action: str, sig: Signal) -> Optional[str]:
+        if action in ("drain_replica", "restart_replica",
+                      "reroute_sessions"):
+            t = sig.target
+            return t if (t is not None and t in self.gw.pool) else None
+        if action == "shed_tenant":
+            return self._top_tenant()
+        return "pool"       # scale_up / scale_down
+
+    def _top_tenant(self) -> Optional[str]:
+        """The tenant with the most queued requests — the shed target
+        when an SLO burns without a named culprit. Falls back to ALL
+        live requests when nothing is queued at this instant (a burn
+        alert can land on a tick where the backlog just dispatched)."""
+        counts: Dict[str, int] = {}
+        for req in self.gw._requests.values():
+            if req.replica is None:
+                counts[req.tenant] = counts.get(req.tenant, 0) + 1
+        if not counts:
+            for req in self.gw._requests.values():
+                counts[req.tenant] = counts.get(req.tenant, 0) + 1
+        if not counts:
+            return None
+        return max(counts.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+    # -- proposal gating + execution ------------------------------------------
+    def _propose(self, rule: PolicyRule, sig: Signal,
+                 target: Optional[str], now: float) -> RemediationAction:
+        def make(decision: str, reason: str,
+                 **detail) -> RemediationAction:
+            act = RemediationAction(
+                kind=rule.action, target=target or "", signal=sig.kind,
+                decision=decision, reason=reason, at=now, detail=detail)
+            self._journal(act)
+            return act
+
+        if target is None:
+            return make("no_target",
+                        f"{sig.kind} names no live pool member")
+        if not self.enabled:
+            return make(_DISABLED, "PADDLE_REMEDIATE=0")
+        last = self._cooldown.get((rule.action, target))
+        if last is not None and now - last < rule.cooldown_s:
+            return make("cooldown",
+                        f"{rule.action} on {target} "
+                        f"{now - last:.1f}s ago (< {rule.cooldown_s}s)")
+        if rule.action in ("drain_replica", "restart_replica") \
+                and self._would_strand(target):
+            return make("last_replica",
+                        f"{target} is the last routable replica")
+        if self.dry_run:
+            self._cooldown[(rule.action, target)] = now
+            return make(_DRY_RUN, f"would {rule.action} {target}")
+        ok, why = self.flap_guard.check(now)
+        if not ok:
+            self._frozen_g.set(1)
+            return make(why, f"flap guard rejected {rule.action} "
+                             f"(escalation {self.flap_guard.escalations})")
+        try:
+            detail = self._execute(rule.action, target, sig) or {}
+        except Exception as exc:  # noqa: BLE001 — a failed remediation
+            # must never take the control loop down with it
+            return make("error", f"{type(exc).__name__}: {exc}")
+        self.flap_guard.record(now)
+        self._cooldown[(rule.action, target)] = now
+        return make(_EXECUTED, f"{sig.kind} -> {rule.action} {target}",
+                    **detail)
+
+    def _would_strand(self, target: str) -> bool:
+        routable = [r.name for r in self.gw.pool.routable()]
+        return (target in routable
+                and len(routable) <= self.min_routable)
+
+    def _execute(self, action: str, target: str,
+                 sig: Signal) -> Optional[dict]:
+        gw = self.gw
+        if action == "drain_replica":
+            inflight = gw.pool.get(target).load
+            gw.drain_replica(target, requeue=True)
+            return {"requeued": inflight}
+        if action == "restart_replica":
+            if self.replica_factory is None:
+                raise RuntimeError("no replica_factory configured")
+            gw.remove_replica(target, force=True)
+            self._restart_seq += 1
+            gw.add_replica(target, self.replica_factory(target))
+            return {"generation": self._restart_seq}
+        if action == "reroute_sessions":
+            router = gw.router
+            if hasattr(router, "forget_replica"):
+                router.forget_replica(target)
+            return None
+        if action == "shed_tenant":
+            quotas = gw.quotas
+            orig = quotas.bucket(target)
+            if target not in self._shed_orig:
+                self._shed_orig[target] = orig
+            from ..inference.gateway.quota import TokenBucket
+            if orig is not None:
+                throttled = TokenBucket(orig.rate * self.shed_factor,
+                                        max(1.0, orig.burst
+                                            * self.shed_factor))
+            else:
+                # un-quota'd tenant: impose a tight emergency bucket
+                throttled = TokenBucket(rate=64.0, burst=256.0)
+            quotas.set_quota(target, throttled)
+            return {"factor": self.shed_factor}
+        if action in ("scale_up", "scale_down"):
+            if self.autoscaler is not None:
+                n = (self.autoscaler.scale_up(reason=sig.kind)
+                     if action == "scale_up"
+                     else self.autoscaler.scale_down(reason=sig.kind))
+                return {"replica": n}
+            if action == "scale_up":
+                if self.replica_factory is None:
+                    raise RuntimeError(
+                        "no autoscaler or replica_factory configured")
+                self._restart_seq += 1
+                name = f"auto{self._restart_seq}"
+                gw.add_replica(name, self.replica_factory(name))
+                return {"replica": name}
+            # scale_down without an autoscaler: drain the least-loaded
+            cands = sorted(gw.pool.routable(), key=lambda r: r.load)
+            if len(cands) <= self.min_routable:
+                raise RuntimeError("pool already at min_routable")
+            gw.drain_replica(cands[0].name, requeue=True)
+            return {"replica": cands[0].name}
+        raise ValueError(f"unknown action {action!r}")
+
+    def _unshed_all(self, sig: Signal,
+                    now: float) -> List[RemediationAction]:
+        out = []
+        for tenant, orig in list(self._shed_orig.items()):
+            if not self.dry_run and self.enabled:
+                if orig is None:
+                    self.gw.quotas._buckets.pop(tenant, None)
+                else:
+                    self.gw.quotas.set_quota(tenant, orig)
+            act = RemediationAction(
+                kind="shed_tenant", target=tenant, signal=sig.kind,
+                decision=_EXECUTED if (self.enabled and not self.dry_run)
+                else _DRY_RUN,
+                reason=f"restored quota on {sig.kind}", at=now,
+                detail={"restore": 1})
+            self._journal(act)
+            out.append(act)
+            del self._shed_orig[tenant]
+        return out
+
+    # -- journaling -----------------------------------------------------------
+    def _journal(self, act: RemediationAction):
+        self._actions_c.labels(action=act.kind,
+                               decision=act.decision).inc()
+        spool_event("remediation", action=act.kind, target=act.target,
+                    signal=act.signal, decision=act.decision,
+                    reason=act.reason, **{
+                        k: v for k, v in act.detail.items()
+                        if isinstance(v, (int, float, str, bool))})
+        flight_record("remediation", action=act.kind, target=act.target,
+                      decision=act.decision)
+
+    # -- introspection --------------------------------------------------------
+    def executed(self) -> List[RemediationAction]:
+        return [a for a in self.actions if a.executed]
+
+    def summary(self) -> dict:
+        by: Dict[str, Dict[str, int]] = {}
+        for a in self.actions:
+            by.setdefault(a.kind, {}).setdefault(a.decision, 0)
+            by[a.kind][a.decision] += 1
+        return {"decisions": len(self.actions),
+                "executed": len(self.executed()),
+                "by_action": by,
+                "flap_escalations": self.flap_guard.escalations,
+                "dry_run": self.dry_run, "enabled": self.enabled}

@@ -42,10 +42,11 @@ def discover(dirpath: str, prefix: str = "BENCH_r") -> List[dict]:
     bookkeeping; unusable rounds appear with ``_skip`` set (reason).
     The default prefix is the train lane; the gateway lane lives in
     ``BENCH_GATEWAY_r*.json`` (bench_gateway.py writes it), the
-    multichip lane in ``MULTICHIP_r*.json`` (bench_multichip.py) and
-    the KV-tier churn lane in ``BENCH_PREFIX_r*.json``
-    (bench_prefix_churn.py) — all pulled in by ``run_check`` with their
-    own prefixes. The globs are disjoint, so the relay gate
+    multichip lane in ``MULTICHIP_r*.json`` (bench_multichip.py), the
+    KV-tier churn lane in ``BENCH_PREFIX_r*.json``
+    (bench_prefix_churn.py), and the self-heal traffic lane in
+    ``BENCH_TRAFFIC_r*.json`` (bench_selfheal.py) — all pulled in by
+    ``run_check`` with their own prefixes. The globs are disjoint, so the relay gate
     (train-lane-only by construction) never sees the other lanes'
     rounds, and pre-lane MULTICHIP artifacts (raw dry-run wrappers
     without a parsed bench line) skip cleanly."""
@@ -194,8 +195,30 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
                 "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
                 "_round": r["_round"], "_file": r["_file"],
                 "_lane": "prefix"})
+    tr_records = discover(dirpath, prefix="BENCH_TRAFFIC_r")
+    for r in tr_records:
+        r["_lane"] = "traffic"
+    # the self-heal bench's headline value is remediation-on
+    # goodput_frac; recovery time gates as an INVERSE series
+    # (recoveries per 100 steps from detail.recovery_steps_on) for the
+    # same reason as promotion latency — the band is a lower bound, so
+    # slower recovery shows up as the rate collapsing.
+    recov_records = []
+    for r in tr_records:
+        if "_skip" in r:
+            continue
+        rs = (r.get("detail") or {}).get("recovery_steps_on")
+        if isinstance(rs, (int, float)) and rs >= 0:
+            recov_records.append({
+                "metric": "traffic_recovery_rate",
+                "value": 100.0 / max(float(rs), 1.0),
+                "unit": "recoveries/100steps",
+                "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
+                "_round": r["_round"], "_file": r["_file"],
+                "_lane": "traffic"})
     records = (records + gw_records + mc_records + goodput_records
-               + px_records + promo_records)
+               + px_records + promo_records + tr_records
+               + recov_records)
     report = {
         "dir": dirpath,
         "tolerance": tolerance,

@@ -40,6 +40,8 @@ DEFAULT_SCENARIOS = {
                      "count=1"),
     "train": "seed=0; train.step:nan_grad:after=1,count=2",
     "serve": "seed=0; serving.step:transient_error:count=2",
+    "selfheal": ("seed=0; gateway.step.r1:delay:delay_s=0.4,"
+                 "after=1,count=10000"),
 }
 
 
@@ -235,9 +237,97 @@ def _drill_serve(scenario: str) -> str:
             f"{b.health.state}")
 
 
+def _drill_selfheal(scenario: str) -> str:
+    """The closed remediation loop under the deterministic traffic
+    harness: a chaos delay makes one replica a straggler, the
+    AnomalyDetector/GatewayProbe pair names it, and the AutoRemediator
+    drains exactly that replica (token-exact requeue) — then the
+    remediation timeline is replayable with
+    ``telemetry_dump --fleet $PADDLE_TELEMETRY_DIR --actions``."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.gateway import Gateway
+    from paddle_tpu.inference.serving import ContinuousBatcher
+    from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM
+    from paddle_tpu.observability.anomaly import (AnomalyDetector,
+                                                  GatewayProbe)
+    from paddle_tpu.resilience import arm_scenario, disarm
+    from paddle_tpu.resilience.remediator import (AutoRemediator,
+                                                  FlapGuard, PolicyRule)
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks"))
+    import traffic
+
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=128,
+                     dropout=0.0)
+    lm = GPT2ForCausalLM(cfg)
+    lm.eval()
+
+    def make(name):
+        return ContinuousBatcher(lm, max_batch=8, s_max=96,
+                                 compile=False)
+
+    gw = Gateway(policy="least_loaded", max_queue_depth=128)
+    gw.add_replica("r0", make("r0"))
+    gw.add_replica("r1", make("r1"))
+    detector = AnomalyDetector(threshold=15.0, min_samples=8)
+    probe = GatewayProbe(gw, detector)
+    rem = AutoRemediator(
+        gw, detector=detector,
+        policy=(PolicyRule("tpot_spike", "drain_replica", hysteresis=2,
+                           cooldown_s=30.0),),
+        replica_factory=make,
+        flap_guard=FlapGuard(max_actions=4, window_s=30.0))
+    # healthy per-replica baselines across every pow2 prompt rung the
+    # traffic hits, BEFORE chaos arms
+    rng = np.random.RandomState(7)
+    for _ in range(8):
+        for n in (6, 10, 20, 28):
+            gw.submit(rng.randint(0, 128, (n,)), 4, tenant="warmup")
+        gw.run_until_done()
+        if all((t := detector._tracks.get(("tpot", r))) is not None
+               and t.count >= detector.min_samples + 2
+               for r in ("r0", "r1")):
+            break
+    gw.reset_stats()
+
+    arm_scenario(scenario)
+    try:
+        spec = traffic.TrafficSpec(seed=5, steps=30, vocab=128,
+                                   base_rate=0.5, prompt_lo=6,
+                                   prompt_hi=16, new_lo=5, new_hi=8,
+                                   shared_len=12)
+        res = traffic.drive(gw, traffic.generate(spec), 0.15,
+                            tick=lambda s: rem.tick())
+    finally:
+        disarm()
+        probe.close()
+
+    executed = rem.executed()
+    assert executed, "remediator never acted on the straggler"
+    assert all(a.kind == "drain_replica" and a.target == "r1"
+               for a in executed), \
+        f"wrong action(s): {[(a.kind, a.target) for a in executed]}"
+    assert res.failed == 0 and res.completions == res.submitted, \
+        "tokens lost through the drain requeue"
+    rep = gw.pool.get("r1")
+    assert rep.alive and not rep.routable(), \
+        "straggler still routable after the drill"
+    s = res.summary()
+    return (f"named + drained r1 ({len(executed)} action(s)), "
+            f"token-exact requeue ({res.completions}/{res.submitted} "
+            f"completed, 0 failed), goodput {s['goodput_frac']:.2f}; "
+            f"timeline: telemetry_dump --fleet $PADDLE_TELEMETRY_DIR "
+            f"--actions")
+
+
 DRILLS = {"checkpoint": _drill_checkpoint,
           "ckpt_elastic": _drill_ckpt_elastic,
-          "train": _drill_train, "serve": _drill_serve}
+          "train": _drill_train, "serve": _drill_serve,
+          "selfheal": _drill_selfheal}
 
 
 def _print_telemetry():

@@ -121,6 +121,12 @@ def main(argv=None) -> int:
                     help="append the goodput ledger summary "
                          "(chip-seconds by tenant/rung/phase + waste "
                          "categories) built from the same spans")
+    ap.add_argument("--actions", action="store_true",
+                    help="with --fleet: render the auto-remediation "
+                         "timeline (``remediation`` spool events the "
+                         "AutoRemediator journals: decision, action, "
+                         "target, triggering signal, reason), "
+                         "chronological across ranks")
     ap.add_argument("--prefix-stats", action="store_true",
                     help="with --fleet: append a radix prefix-cache "
                          "summary (hit/miss tokens, hit rate, "
@@ -134,8 +140,41 @@ def main(argv=None) -> int:
     if args.prefix_stats and not args.fleet:
         ap.error("--prefix-stats summarizes the fleet view; "
                  "use it with --fleet DIR")
+    if args.actions and not args.fleet:
+        ap.error("--actions renders the remediation timeline from the "
+                 "per-rank spools; use it with --fleet DIR")
 
     from paddle_tpu.observability import export as _export
+
+    if args.actions:
+        # the remediation timeline: every AutoRemediator decision
+        # (executed or suppressed-and-why) as journaled into the rank
+        # spools, chronological across the fleet
+        from paddle_tpu.observability.fleet import FleetAggregator
+        agg = FleetAggregator(args.fleet)
+        evs = [(e.get("t", 0.0), rank, e)
+               for rank, shard in sorted(agg.shards.items())
+               for e in shard.events
+               if e.get("name") == "remediation"]
+        evs.sort(key=lambda x: (x[0], x[1]))
+        n_exec = sum(1 for _, _, e in evs
+                     if e.get("decision") == "executed")
+        text = (f"# remediation timeline ({len(evs)} decision(s), "
+                f"{n_exec} executed)\n")
+        t0 = evs[0][0] if evs else 0.0
+        for t, rank, e in evs:
+            text += (f"+{t - t0:8.3f}s rank{rank} "
+                     f"{e.get('decision', '?'):10} "
+                     f"{e.get('action', '?'):16} "
+                     f"{e.get('target', '') or '-':12} "
+                     f"<- {e.get('signal', '?'):24} "
+                     f"| {e.get('reason', '')}\n")
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
 
     if args.waterfall is not None or args.ledger:
         # attribution views (observability.waterfall / .ledger): spans
