@@ -152,7 +152,13 @@ def main(scan_layers=True, size="large"):
         _, loss = model(ids, labels=labels)
         return loss
 
-    step = jit.TrainStep(loss_fn, opt)
+    # op-level observatory: capture the step executable's cost profile
+    # at its warm transitions (OPPROF_r*.json + the opprof: guard lane)
+    from paddle_tpu.observability import opprof
+    opprof.enable()
+    opprof.reset_captures()
+
+    step = jit.TrainStep(loss_fn, opt, opprof_label="bench.train_step")
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
@@ -216,6 +222,28 @@ def main(scan_layers=True, size="large"):
     if on_tpu:
         detail["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                               time.gmtime())
+    # op-level profile: split the roofline gap per op class, embed the
+    # top-k class cost table + executable fingerprint (bench_guard
+    # chains these alongside last_tpu), persist the OPPROF artifact
+    try:
+        from paddle_tpu.observability import roofline_attr
+        attr = roofline_attr.observe_train_step(
+            elapsed / iters, observed_mfu=mfu, tokens=batch * seq,
+            params=n_params)
+        gap_split = opprof.publish_gap_attribution(attr) if attr else None
+        summary = opprof.bench_summary()
+        if summary is not None:
+            detail["opprof"] = summary
+            opp_path = opprof.write_artifact(
+                _REPO_DIR, tpu=on_tpu, gap_attribution=gap_split,
+                extra={"bench_step_s": round(elapsed / iters, 5),
+                       "bench_mfu": round(mfu, 4)})
+            if opp_path:
+                detail["opprof"]["artifact"] = os.path.basename(opp_path)
+                _progress(f"op profile: {opp_path} "
+                          f"(top {summary['top_op_classes'][:2]})")
+    except Exception as e:  # profiling must never sink the bench number
+        _progress(f"op profile failed: {type(e).__name__}: {e}")
     # telemetry snapshot rides alongside (stderr + file only — stdout is
     # the one-JSON-line contract)
     try:

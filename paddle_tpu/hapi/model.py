@@ -186,7 +186,8 @@ class Model:
         self._fused_n_in = n_in
         self._train_step = jit_mod.TrainStep(
             loss_fn, self._optimizer, amp=amp, donate=donate,
-            mesh_plan=getattr(self, "_mesh_plan", None))
+            mesh_plan=getattr(self, "_mesh_plan", None),
+            opprof_label="hapi.train_step")
         return self._train_step
 
     def _train_batch_fused(self, inputs, labels):

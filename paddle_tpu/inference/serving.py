@@ -615,6 +615,10 @@ class ContinuousBatcher(_BatcherBase):
             self._step_fn = jit.to_static(model.decode_step,
                                           donate_args=(1,))
             self._prefill_fn = jit.to_static(model.prefill)
+            # opprof observatory identities for the serving executables
+            # (only meaningful on the compiled path)
+            self._step_fn._opprof_label = "serving.decode"
+            self._prefill_fn._opprof_label = "serving.prefill"
         else:
             self._step_fn = model.decode_step
             self._prefill_fn = model.prefill
@@ -1045,6 +1049,9 @@ class PagedContinuousBatcher(_BatcherBase):
                                                 donate_args=(1,))
                 self._catchup_fn = jit.to_static(_catchup_body,
                                                  donate_args=(1,))
+                self._dstep_fn._opprof_label = "serving.draft_decode"
+                self._verify_fn._opprof_label = "serving.verify"
+                self._catchup_fn._opprof_label = "serving.catchup"
             else:
                 self._dstep_fn = draft_model.paged_decode_step
                 self._verify_fn = _verify_body
@@ -1070,6 +1077,7 @@ class PagedContinuousBatcher(_BatcherBase):
                 from .. import jit
                 self._fused_fn = jit.to_static(model.paged_fused_step,
                                                donate_args=(5,))
+                self._fused_fn._opprof_label = "serving.fused"
             else:
                 self._fused_fn = model.paged_fused_step
         if compile:
@@ -1078,6 +1086,7 @@ class PagedContinuousBatcher(_BatcherBase):
             # buffer — XLA appends into it in place every step
             self._step_fn = jit.to_static(model.paged_decode_step,
                                           donate_args=(1,))
+            self._step_fn._opprof_label = "serving.paged_decode"
         else:
             self._step_fn = model.paged_decode_step
         self.decode_block = decode_block
@@ -1099,6 +1108,7 @@ class PagedContinuousBatcher(_BatcherBase):
                 from .. import jit
                 self._block_fn = jit.to_static(_block_body,
                                                donate_args=(1,))
+                self._block_fn._opprof_label = "serving.decode_block"
             else:
                 self._block_fn = _block_body
         if prefill_chunk is not None:
@@ -1114,6 +1124,7 @@ class PagedContinuousBatcher(_BatcherBase):
                 # donate the pool (arg 1) exactly like the decode step —
                 # chunked prefill must not double-buffer the cache HBM
                 self._chunk_fn = jit.to_static(_chunk, donate_args=(1,))
+                self._chunk_fn._opprof_label = "serving.paged_prefill_chunk"
             else:
                 self._chunk_fn = _chunk
             if cache_quant:
@@ -1139,6 +1150,10 @@ class PagedContinuousBatcher(_BatcherBase):
                         _chunk_dyn_first, donate_args=(1,))
                     self._chunk_dyn_rest_fn = jit.to_static(
                         _chunk_dyn_rest, donate_args=(1,))
+                    self._chunk_dyn_first_fn._opprof_label = \
+                        "serving.prefill_chunk_scales"
+                    self._chunk_dyn_rest_fn._opprof_label = \
+                        "serving.prefill_chunk_quant"
                 else:
                     self._chunk_dyn_first_fn = _chunk_dyn_first
                     self._chunk_dyn_rest_fn = _chunk_dyn_rest

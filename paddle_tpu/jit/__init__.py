@@ -407,6 +407,10 @@ class StaticFunction:
                 # first compiled execution at this signature pays the XLA
                 # compile — attribute its wall time to compile.elapsed
                 # (the signature's miss was already counted at trace time)
+                # opprof hook BEFORE the run: donated input buffers are
+                # still live here (AOT lowering only reads avals, but a
+                # deleted donated array would refuse even that)
+                self._maybe_opprof(entry, args, kwargs)
                 import time as _t
                 t0 = _t.perf_counter()
                 out = self._run_compiled(entry, args, kwargs)
@@ -428,6 +432,31 @@ class StaticFunction:
             entry["fallback"] = True
             entry.pop("compiled", None)  # free the trace
             return self._fn(*args, **kwargs)
+
+    def _maybe_opprof(self, entry, args, kwargs):
+        """Op-level cost capture of this signature's executable (opprof
+        observatory). Free unless ``observability.opprof`` is enabled;
+        never raises. Each newly-traced signature captures once — the
+        per-label capture COUNT is how recompile storms get named."""
+        from ..observability import opprof as _opprof
+        if (not _opprof.enabled() or entry.get("opprof_done")
+                or "compiled" not in entry):
+            return
+        entry["opprof_done"] = True
+        label = (getattr(self, "_opprof_label", None)
+                 or f"static.{self._fn.__name__}")
+        try:
+            gen = _random.default_generator()
+            flat = jax.tree_util.tree_flatten(
+                (args, kwargs), is_leaf=_is_tensor)[0]
+            arg_tensors = [flat[i] for i in entry["tensor_pos"]]
+            grads_in = [None if t._grad is None else t._grad._data
+                        for t in entry["grad_ts"]]
+            call = ([t._data for t in entry["state"]], grads_in,
+                    gen.get_state(), *[t._data for t in arg_tensors])
+            _opprof.maybe_capture(label, entry["compiled"], call)
+        except Exception:
+            pass
 
     def _run_compiled(self, entry, args, kwargs):
         gen = _random.default_generator()
@@ -554,7 +583,7 @@ class TrainStep:
     """
 
     def __init__(self, train_fn: Callable, optimizer, amp=None, donate=True,
-                 mesh_plan=None):
+                 mesh_plan=None, opprof_label=None):
         """donate=True donates the param/master/opt-state device buffers to
         each compiled step (XLA updates them in place — halves HBM for the
         update). Tensors aliasing those buffers from BEFORE the step (e.g. a
@@ -565,12 +594,18 @@ class TrainStep:
         SPMD: params/masters/optimizer state live sharded per the plan's
         ``in_shardings``/``out_shardings``, grads are constrained onto the
         param placement, and the program is refused (SH201/MEM301) by the
-        runtime gate before any compile."""
+        runtime gate before any compile.
+
+        opprof_label names this step's executables in the opprof
+        observatory (OPPROF artifacts / gap-attribution gauges);
+        mesh-compiled steps get a ``:mesh`` suffix."""
         self._fn = train_fn
         self._opt = optimizer
         self._amp = amp  # optional paddle_tpu.amp.auto_cast factory kwargs
         self._donate = donate
         self._mesh_plan = mesh_plan
+        self._opprof_label = ((opprof_label or "train_step")
+                              + (":mesh" if mesh_plan is not None else ""))
         self._cache: Dict[Any, dict] = {}
 
     def __call__(self, *args):
@@ -594,7 +629,11 @@ class TrainStep:
                 # the shared entry is shape-polymorphic but jax.jit still
                 # XLA-retraces at the new signature: a compile miss
                 with _cc.timed_miss():
-                    return self._run(entry, args)
+                    out = self._run(entry, args)
+                # every retrace is a fresh executable — capture it so the
+                # OPPROF diff can NAME the recompile (not just count it)
+                self._maybe_opprof(entry, args)
+                return out
             else:
                 with _cc.timed_miss():
                     entry = self._build(args)
@@ -608,6 +647,7 @@ class TrainStep:
             out = self._run(entry, args)
             _cc.observe_elapsed(_t.perf_counter() - t0)
             entry["warm"] = True
+            self._maybe_opprof(entry, args)
             return out
         _cc.note_hit()
         import time as _t
@@ -839,6 +879,21 @@ class TrainStep:
             rng_key = place(rng_key, ())
         return (p_arrays, masters, opt_states, extra_arrays,
                 other_grads_in, rng_key, lr, *batch)
+
+    def _maybe_opprof(self, entry, args):
+        """Op-level cost capture of the step executable (opprof
+        observatory). Called AFTER a run, so donated param/opt-state
+        buffers have already been replaced by their fresh outputs and
+        ``_assemble`` sees only live arrays. Free unless enabled; never
+        raises."""
+        from ..observability import opprof as _opprof
+        if not _opprof.enabled() or "compiled" not in entry:
+            return
+        try:
+            _opprof.maybe_capture(self._opprof_label, entry["compiled"],
+                                  self._assemble(entry, args))
+        except Exception:
+            pass
 
     def mesh_memory_report(self, *args, tolerance: float = 0.10):
         """Runtime/static memory cross-check for the compiled SPMD step.

@@ -20,6 +20,14 @@ platform/monitor.h STATS_INT + the host profiler, fused):
     with typed waste categories), and streaming EWMA/MAD detectors over
     per-replica TTFT/TPOT/queue-depth emitting ``FleetFinding``s
     (``tools/trace_analyze.py`` is the CLI over all three).
+  * ``opprof`` — compiled-program cost profiles: per-op/per-fusion
+    FLOPs and bytes parsed from the optimized HLO of every warm
+    executable (TrainStep, serving prefill/decode), a shared op-class
+    taxonomy (also used by ``tools/analyze_xplane.py``), per-op-class
+    MFU-gap attribution, and ``OPPROF_r*.json`` artifacts with a
+    ``diff()`` that names recompiles and fusion regressions
+    (``tools/profile_report.py`` is the CLI; the bench_guard
+    ``opprof:`` lane is the gate).
 
 Instrumented out of the box: serving batchers (queue depth, admissions,
 preemptions, TTFT / per-token latency), the multi-replica serving
@@ -33,8 +41,9 @@ diagnostic pass counts its findings by rule here).
 """
 from __future__ import annotations
 
-from . import (anomaly, export, fleet, flight, ledger, metrics,
+from . import (anomaly, export, fleet, flight, ledger, metrics, opprof,
                roofline_attr, slo, trace_context, tracing, waterfall)
+from .opprof import OpProfile, classify_op
 from .anomaly import AnomalyDetector, GatewayProbe
 from .export import load_jsonl, render_prometheus, write_jsonl
 from .fleet import (FleetAggregator, FleetFinding, ProcessIdentity,
@@ -57,6 +66,7 @@ from .waterfall import (Waterfall, build_waterfalls,
 __all__ = [
     "metrics", "tracing", "export", "trace_context", "roofline_attr",
     "slo", "fleet", "flight", "waterfall", "ledger", "anomaly",
+    "opprof", "OpProfile", "classify_op",
     "Waterfall", "build_waterfalls", "waterfalls_from_recorder",
     "waterfalls_from_fleet", "critical_path_summary", "render_waterfall",
     "GoodputLedger", "ledger_from_waterfalls",

@@ -174,6 +174,14 @@ def observe_train_step(step_s: float, observed_mfu: float,
            "overhead_frac": overhead_frac}
     if comm_fracs:
         out["comm_fracs"] = comm_fracs
+    try:
+        # refine phases into per-op-class gauges when the opprof
+        # observatory holds a train-step capture (no-op otherwise; the
+        # same must-never-take-down-a-step contract as above)
+        from . import opprof as _opprof
+        _opprof.publish_gap_attribution(out)
+    except Exception:
+        pass
     return out
 
 

@@ -29,6 +29,24 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "PROFILES_SUMMARY.json")
 
 
+def _load_opprof():
+    """The shared op taxonomy (observability/opprof.py), loaded
+    standalone from its file path: one bucket scheme for TPU xplane
+    captures and CPU cost-model profiles, without importing the
+    paddle_tpu package (this tool must stay jax-free until a capture
+    is actually parsed)."""
+    import importlib.util
+    path = os.path.join(REPO, "paddle_tpu", "observability", "opprof.py")
+    spec = importlib.util.spec_from_file_location("_opprof_standalone",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_OPPROF = _load_opprof()
+
+
 def _newest_xplane(root: str):
     files = sorted(glob.glob(os.path.join(root, "**", "*.xplane.pb"),
                              recursive=True))
@@ -36,9 +54,12 @@ def _newest_xplane(root: str):
 
 
 def _canon(name: str) -> str:
-    """Collapse op instances: 'fusion.123' -> 'fusion', drop hlo ids."""
-    name = re.sub(r"\.\d+$", "", name)
-    return re.sub(r"\d+$", "", name) or name
+    """Collapse op instances: 'fusion.123' -> 'fusion', drop hlo ids.
+
+    Delegates to the shared opprof rule with fold=False so
+    PROFILES_SUMMARY.json `top_ops_us` keys keep their historical
+    spelling; class bucketing on top comes from the same taxonomy."""
+    return _OPPROF.canon_op(name, fold=False)
 
 
 def analyze_capture(root: str, top_k: int = 12) -> dict:
@@ -86,6 +107,12 @@ def analyze_capture(root: str, top_k: int = 12) -> dict:
         for name, _s, d in evs:
             ops[_canon(name)] = ops.get(_canon(name), 0) + d
         top = sorted(ops.items(), key=lambda kv: -kv[1])[:top_k]
+        # NEW: self time bucketed by the shared op-class taxonomy —
+        # the same classes the CPU-proxy OPPROF artifacts report, so
+        # TPU capture and cost-model numbers line up bucket-for-bucket
+        classes = {c: 0 for c in _OPPROF.OP_CLASSES}
+        for n, d in ops.items():
+            classes[_OPPROF.classify_op(n)] += d
         devices.append({
             "plane": pname, "line": line_name,
             "busy_us": round(busy / 1e3, 1),
@@ -93,6 +120,8 @@ def analyze_capture(root: str, top_k: int = 12) -> dict:
             "duty_cycle": round(busy / span, 4) if span else None,
             "bubble_ratio": round(1 - busy / span, 4) if span else None,
             "top_ops_us": [(n, round(d / 1e3, 1)) for n, d in top],
+            "op_class_us": {c: round(v / 1e3, 1)
+                            for c, v in classes.items() if v},
         })
     return {"capture": os.path.basename(root.rstrip("/")),
             "xplane": os.path.relpath(path, REPO), "devices": devices}

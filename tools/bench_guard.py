@@ -45,8 +45,10 @@ def discover(dirpath: str, prefix: str = "BENCH_r") -> List[dict]:
     multichip lane in ``MULTICHIP_r*.json`` (bench_multichip.py), the
     KV-tier churn lane in ``BENCH_PREFIX_r*.json``
     (bench_prefix_churn.py), and the self-heal traffic lane in
-    ``BENCH_TRAFFIC_r*.json`` (bench_selfheal.py) — all pulled in by
-    ``run_check`` with their own prefixes. The globs are disjoint, so the relay gate
+    ``BENCH_TRAFFIC_r*.json`` (bench_selfheal.py), and the op-profile
+    lane in ``OPPROF_r*.json`` (opprof cost artifacts, synthesized
+    into inverse drift series directly in ``run_check``) — all pulled
+    in by ``run_check`` with their own prefixes. The globs are disjoint, so the relay gate
     (train-lane-only by construction) never sees the other lanes'
     rounds, and pre-lane MULTICHIP artifacts (raw dry-run wrappers
     without a parsed bench line) skip cleanly."""
@@ -216,9 +218,49 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
                 "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
                 "_round": r["_round"], "_file": r["_file"],
                 "_lane": "traffic"})
+    # op-level profile lane: OPPROF_r*.json (opprof.write_artifact —
+    # bench.py emits one per run). These are cost artifacts, not bench
+    # lines, so the series are synthesized here. The band is a LOWER
+    # bound, so both drift signals gate as inverse series: the top
+    # op-class cost share as HEADROOM (1 - share: a fusion regression
+    # concentrating cost into one class collapses the headroom) and
+    # the recompile count as 1/(1+n) (a recompile storm collapses the
+    # health). Driver dry-run wrappers ({n, cmd, rc} without a
+    # `captures` map) skip cleanly like pre-lane MULTICHIP rounds.
+    opp_records = []
+    opp_rx = re.compile(r"OPPROF_r(\d+)\.json$")
+    for path in sorted(glob.glob(os.path.join(dirpath,
+                                              "OPPROF_r*.json"))):
+        m = opp_rx.search(os.path.basename(path))
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict) or "captures" not in doc:
+            continue  # dry-run wrapper, not an opprof artifact
+        h = doc.get("headline") or {}
+        det = {"tpu": bool(doc.get("tpu"))}
+        share = h.get("top_share")
+        if isinstance(share, (int, float)):
+            opp_records.append({
+                "metric": "opprof_top_share_headroom",
+                "value": max(0.0, 1.0 - float(share)), "unit": "frac",
+                "detail": det, "_round": rnd, "_file": path,
+                "_lane": "opprof"})
+        nrec = h.get("n_recompiles")
+        if isinstance(nrec, (int, float)):
+            opp_records.append({
+                "metric": "opprof_recompile_health",
+                "value": 1.0 / (1.0 + float(nrec)), "unit": "frac",
+                "detail": det, "_round": rnd, "_file": path,
+                "_lane": "opprof"})
     records = (records + gw_records + mc_records + goodput_records
                + px_records + promo_records + tr_records
-               + recov_records)
+               + recov_records + opp_records)
     report = {
         "dir": dirpath,
         "tolerance": tolerance,

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Op-level compiled-program report (opprof observatory CLI).
+
+Two modes:
+
+  python tools/profile_report.py              # demo: live capture
+  python tools/profile_report.py --json       # same, machine-readable
+  python tools/profile_report.py --artifacts  # diff OPPROF_r*.json
+
+Demo mode compiles a tiny train step on the CPU backend with the
+opprof observatory enabled, then INJECTS a recompile (a second batch
+shape retraces the shape-polymorphic step) and reports what the
+observatory saw: per-executable op tables, op-class cost shares, the
+per-op-class roofline-gap split, and a diff between the first and the
+recompiled executable that NAMES which ops appeared / disappeared /
+changed cost — the same analysis a real recompile storm gets.
+
+Artifact mode reads the committed ``OPPROF_r*.json`` rounds (bench.py
+writes one per run) and diffs the newest pair — no jax import.
+
+Gated in the lint lane next to ``trace_analyze``: rc 0 and a non-empty
+diff are part of the contract (tests/test_opprof.py).
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_opprof():
+    """Standalone module load: artifact mode must not import jax."""
+    path = os.path.join(REPO, "paddle_tpu", "observability", "opprof.py")
+    spec = importlib.util.spec_from_file_location("_opprof_standalone",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _render_profile(opprof, prof, k=8):
+    lines = [f"== {prof.label}  [{prof.fingerprint}]"]
+    table = prof.op_class_table()
+    for cls in opprof.OP_CLASSES:
+        t = table[cls]
+        if not t["n_ops"]:
+            continue
+        lines.append(f"  {cls:>13}: share {t['cost_share']:6.3f}  "
+                     f"flops {t['flops']:12.3e}  bytes {t['bytes']:10.3e}"
+                     f"  ({t['n_ops']} ops)")
+    cu = prof.cost_units()
+    lines.append("  top ops:")
+    for r in prof.top_ops(k):
+        lines.append(f"    {cu[r['op']]:.3e}cu  {r['class']:>13}  "
+                     f"x{r['count']:<4d} {r['op']}")
+    return lines
+
+
+def _render_diff(d):
+    lines = ["== diff"]
+    for key in ("appeared", "disappeared"):
+        for op in d[key]:
+            lines.append(f"  {key}: {op}")
+    for c in d["changed"]:
+        lines.append(f"  changed: {c['op']}  share "
+                     f"{c['old_share']:.4f} -> {c['new_share']:.4f}  "
+                     f"(delta {c['delta']:+.4f})")
+    for lbl in d["fingerprint_changed"]:
+        lines.append(f"  fingerprint changed: {lbl}")
+    for lbl, g in d["recompile_growth"].items():
+        lines.append(f"  recompiles: {lbl}  {g['old']} -> {g['new']}")
+    if len(lines) == 1:
+        lines.append("  (no drift)")
+    return lines
+
+
+def _render_gap(opprof, split):
+    lines = ["== gap attribution (fraction of step, by phase x op class)"]
+    for phase, parts in split.items():
+        total = sum(parts.values())
+        tops = sorted(((c, v) for c, v in parts.items() if v > 0),
+                      key=lambda kv: -kv[1])[:3]
+        seg = "  ".join(f"{c}={v:.4f}" for c, v in tops) or "-"
+        lines.append(f"  {phase:>10} (total {total:.4f}): {seg}")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# demo mode: live capture + injected recompile
+# ---------------------------------------------------------------------------
+
+def _demo(_unused):
+    sys.path.insert(0, REPO)
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, nn, optimizer
+    # the jit hooks file captures into the PACKAGE module — use it
+    # (the standalone copy loaded for artifact mode is a distinct
+    # module object with its own registry)
+    from paddle_tpu.observability import opprof
+
+    opprof.enable()
+    opprof.reset_captures()
+    paddle.seed(0)
+
+    model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 8))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+
+    def loss_fn(x, y):
+        d = model(x) - y
+        return (d * d).mean()
+
+    step = jit.TrainStep(loss_fn, opt, opprof_label="demo.train_step")
+    rng = np.random.RandomState(0)
+
+    def batch(b):
+        return (paddle.to_tensor(rng.rand(b, 16).astype("float32")),
+                paddle.to_tensor(rng.rand(b, 8).astype("float32")))
+
+    x, y = batch(4)
+    step(x, y)   # eager discovery
+    step(x, y)   # first compiled execution -> capture #1
+    x2, y2 = batch(6)
+    step(x2, y2)  # injected recompile (shape retrace) -> capture #2
+
+    profs = opprof.get_captures()["demo.train_step"]
+    d = opprof.diff({"captures": {"demo.train_step": profs[0].to_dict()},
+                     "recompiles": {"demo.train_step": 1}},
+                    {"captures": {"demo.train_step": profs[-1].to_dict()},
+                     "recompiles": {"demo.train_step": len(profs)}},
+                    share_tol=0.0)
+    attr = {"compute_frac": 0.30, "memory_frac": 0.25,
+            "overhead_frac": 0.45}  # CPU proxy: a representative split
+    split = opprof.publish_gap_attribution(attr, profile=profs[-1])
+    return {
+        "mode": "demo",
+        "profiles": {p.fingerprint: p.to_dict() for p in profs},
+        "recompiles": opprof.recompile_counts(),
+        "top_op_classes": opprof.top_op_classes(profs[-1]),
+        "gap_attribution": split,
+        "diff": d,
+    }, profs, d, split
+
+
+# ---------------------------------------------------------------------------
+# artifact mode
+# ---------------------------------------------------------------------------
+
+def _artifacts(opprof, paths):
+    paths = paths or opprof.artifact_paths(REPO)
+    docs = [(p, opprof.load_artifact(p)) for p in paths]
+    docs = [(p, d) for p, d in docs if d is not None]
+    if not docs:
+        return {"mode": "artifacts", "error": "no OPPROF_r*.json found"}
+    newest_path, newest = docs[-1]
+    out = {
+        "mode": "artifacts",
+        "artifact": os.path.basename(newest_path),
+        "headline": newest.get("headline"),
+        "recompiles": newest.get("recompiles"),
+        "gap_attribution": newest.get("gap_attribution"),
+        "labels": sorted((newest.get("captures") or {}).keys()),
+    }
+    if len(docs) >= 2:
+        prev_path, prev = docs[-2]
+        out["vs"] = os.path.basename(prev_path)
+        out["diff"] = opprof.diff(prev, newest)
+    return out
+
+
+def main(argv):
+    opprof = _load_opprof()
+    as_json = "--json" in argv
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    if "--artifacts" in argv or args:
+        out = _artifacts(opprof, args or None)
+        if as_json:
+            print(json.dumps(out, indent=1))
+            return 0 if "error" not in out else 1
+        if "error" in out:
+            print(out["error"])
+            return 1
+        print(f"== {out['artifact']}  "
+              f"(labels: {', '.join(out['labels'])})")
+        h = out.get("headline") or {}
+        print(f"  headline: top class {h.get('top_class')} "
+              f"share {h.get('top_share')}  "
+              f"recompiles {h.get('n_recompiles')}")
+        if out.get("gap_attribution"):
+            for line in _render_gap(opprof, out["gap_attribution"]):
+                print(line)
+        if "diff" in out:
+            print(f"-- vs {out['vs']}")
+            for line in _render_diff(out["diff"]):
+                print(line)
+        return 0
+    out, profs, d, split = _demo(opprof)
+    if as_json:
+        print(json.dumps(out, indent=1))
+        return 0
+    for p in profs:
+        for line in _render_profile(opprof, p):
+            print(line)
+    for line in _render_gap(opprof, split):
+        print(line)
+    for line in _render_diff(d):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

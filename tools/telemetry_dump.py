@@ -127,6 +127,11 @@ def main(argv=None) -> int:
                          "AutoRemediator journals: decision, action, "
                          "target, triggering signal, reason), "
                          "chronological across ranks")
+    ap.add_argument("--opprof", action="store_true",
+                    help="render the newest OPPROF_r*.json op-level "
+                         "cost artifact at the repo root (per-op-class "
+                         "cost shares, gap attribution, diff vs the "
+                         "previous round) — no workload, no jax")
     ap.add_argument("--prefix-stats", action="store_true",
                     help="with --fleet: append a radix prefix-cache "
                          "summary (hit/miss tokens, hit rate, "
@@ -143,6 +148,63 @@ def main(argv=None) -> int:
     if args.actions and not args.fleet:
         ap.error("--actions renders the remediation timeline from the "
                  "per-rank spools; use it with --fleet DIR")
+
+    if args.opprof:
+        # the op-level cost view: artifacts only, so load opprof.py
+        # standalone (stdlib-only module) and skip the jax import chain
+        # entirely — and return BEFORE any other path so every existing
+        # flag combination stays byte-identical
+        import importlib.util
+        import json
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "_opprof_standalone",
+            os.path.join(repo, "paddle_tpu", "observability", "opprof.py"))
+        opprof = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(opprof)
+        paths = opprof.artifact_paths(repo)
+        docs = [(p, opprof.load_artifact(p)) for p in paths]
+        docs = [(p, d) for p, d in docs if d is not None]
+        if not docs:
+            sys.stderr.write("no OPPROF_r*.json artifacts at the repo "
+                             "root (run bench.py, or "
+                             "tools/profile_report.py for a live demo)\n")
+            return 1
+        path, doc = docs[-1]
+        text = f"# opprof {os.path.basename(path)}\n"
+        h = doc.get("headline") or {}
+        text += (f"headline: {h.get('label')} [{h.get('fingerprint')}] "
+                 f"top={h.get('top_class')}:{h.get('top_share')} "
+                 f"recompiles={h.get('n_recompiles')}\n")
+        for lbl, pd in sorted((doc.get("captures") or {}).items()):
+            prof = opprof.OpProfile.from_dict(pd)
+            text += f"== {lbl} [{prof.fingerprint}]\n"
+            table = prof.op_class_table()
+            for cls in opprof.OP_CLASSES:
+                t = table[cls]
+                if t["n_ops"]:
+                    text += (f"  {cls:>13}: share {t['cost_share']:6.3f}"
+                             f"  ({t['n_ops']} ops)\n")
+        gap = doc.get("gap_attribution")
+        if gap:
+            text += "== gap attribution (phase x op class)\n"
+            for phase, parts in gap.items():
+                tops = sorted(((c, v) for c, v in parts.items() if v > 0),
+                              key=lambda kv: -kv[1])[:3]
+                seg = "  ".join(f"{c}={v:.4f}" for c, v in tops) or "-"
+                text += (f"  {phase:>10} "
+                         f"(total {sum(parts.values()):.4f}): {seg}\n")
+        if len(docs) >= 2:
+            prev_path, prev = docs[-2]
+            d = opprof.diff(prev, doc)
+            text += (f"== diff vs {os.path.basename(prev_path)}\n"
+                     + json.dumps(d, indent=1) + "\n")
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
 
     from paddle_tpu.observability import export as _export
 
