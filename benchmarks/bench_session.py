@@ -1,0 +1,275 @@
+"""Durable-session resume benchmark: pipelined tiered promotion vs
+serial, plus the crash-resume (manifest-only) leg.
+
+The scenario is one long agentic conversation that PAUSES mid-task: its
+first turn is served, ``pause_session`` session-pins the KV chain and
+publishes the crash-safe manifest, then churn traffic demotes the
+pinned chain off the device (host tier, spilling to disk — the pin
+keeps it no lower than the last tier). The measured number is the
+RESUME: resubmitting the session's context streams the demoted chain
+back through the multi-slot promotion pipeline instead of
+re-prefilling.
+
+Three legs, one seeded workload:
+
+  * pipelined — ``promo_slots`` chunks of ``promo_chunk_blocks`` blocks
+    in flight at once (blob reads overlap device transfers);
+  * serial    — ``promo_slots=1, promo_chunk_blocks=None``, the legacy
+    single-submission promotion, same stream;
+  * crash     — a FRESH batcher sharing only the manifest store (the
+    replica died): resume resolves the manifest and full-prefills,
+    token-exact.
+
+Every resumed completion is compared bitwise against an uninterrupted
+two-turn baseline. Headline = resume goodput (session context tokens
+per second of resume wall time, pipelined); detail carries
+``time_to_resume_ms`` (the inverse-gated ``session:`` bench_guard
+series), both variants' times (min over ``REPS`` — latency, so min is
+the stable estimator), and the zero-leak audits.
+
+Bench line lands in ``BENCH_SESSION_r<NN>.json`` at the repo root — the
+``session:`` lane of ``tools/bench_guard.py``. Same JSON contract as
+bench.py: ONE stdout line; vs_baseline stays 0.0 (the reference
+publishes no comparable figure).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_DIR)
+
+import paddle_tpu as paddle                                  # noqa: E402
+from paddle_tpu.models.gpt import GPT2Config, GPT2ForCausalLM  # noqa: E402
+
+BLOCK_SIZE = 16
+SESSION_BLOCKS = 13                # 208-token first-turn prompt
+CONT_TOKENS = 7                    # the follow-up turn's new input
+NEW_TOKENS = 6
+N_PAGES = 34
+MAX_BATCH = 2
+S_MAX = 240
+CHURN_PROMPTS = 10                 # enough to cycle the pool repeatedly
+CHURN_BLOCKS = 5
+HOST_KV_GIB = 0.0008               # ~4 blocks of host tier ...
+DISK_KV_GIB = 0.05                 # ... so the chain spills to disk
+PIPE_SLOTS = 3                     # pipelined leg geometry
+PIPE_CHUNK = 5
+REPS = 4
+SID = "agent-bench"
+
+
+def _model():
+    paddle.seed(0)
+    cfg = GPT2Config(vocab_size=128, hidden_size=768,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     max_position_embeddings=256, dropout=0.0)
+    m = GPT2ForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _workload(vocab):
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, vocab, (BLOCK_SIZE * SESSION_BLOCKS,))
+    cont = rng.randint(0, vocab, (CONT_TOKENS,))
+    churn = [rng.randint(0, vocab, (BLOCK_SIZE * CHURN_BLOCKS + 3,))
+             for _ in range(CHURN_PROMPTS)]
+    return prompt, cont, churn
+
+
+def _batcher(model, store_dir, promo_slots, promo_chunk_blocks):
+    from paddle_tpu.inference.serving import PagedContinuousBatcher
+    return PagedContinuousBatcher(
+        model, max_batch=MAX_BATCH, s_max=S_MAX, block_size=BLOCK_SIZE,
+        n_pages=N_PAGES, compile=False, policy="ondemand",
+        prefix_cache=True, host_kv_gib=HOST_KV_GIB,
+        disk_kv_dir=os.path.join(store_dir, "kv_disk"),
+        disk_kv_gib=DISK_KV_GIB, promo_slots=promo_slots,
+        promo_chunk_blocks=promo_chunk_blocks,
+        session_store=os.path.join(store_dir, "sessions"))
+
+
+def _baseline(model, prompt, cont):
+    """The uninterrupted two-turn reference: same conversation, no
+    pause/churn/resume — the bitwise ground truth."""
+    from paddle_tpu.inference.serving import PagedContinuousBatcher
+    bt = PagedContinuousBatcher(
+        model, max_batch=MAX_BATCH, s_max=S_MAX, block_size=BLOCK_SIZE,
+        n_pages=N_PAGES, compile=False, policy="ondemand",
+        prefix_cache=True)
+    try:
+        r1 = bt.submit(prompt, NEW_TOKENS)
+        # results are the FULL sequence (prompt + generated) — out1
+        # is already the session context after turn one
+        out1 = bt.run_until_done(max_steps=60000)[r1]
+        r2 = bt.submit(np.concatenate([out1, cont]), NEW_TOKENS)
+        out2 = bt.run_until_done(max_steps=60000)[r2]
+        return out1, out2
+    finally:
+        bt.close()
+
+
+def _pause_churn_resume(model, store_dir, prompt, cont, churn,
+                        promo_slots, promo_chunk_blocks):
+    """One full leg: first turn -> pause (pin + publish) -> churn (the
+    pinned chain demotes to host/disk) -> timed resume through the
+    promotion stream. Returns outputs + the resume wall time."""
+    bt = _batcher(model, store_dir, promo_slots, promo_chunk_blocks)
+    try:
+        r1 = bt.submit(prompt, NEW_TOKENS)
+        out1 = bt.run_until_done(max_steps=60000)[r1]
+        published = bt.pause_session(SID, out1)
+
+        for p in churn:
+            bt.submit(p, NEW_TOKENS)
+        bt.run_until_done(max_steps=60000)
+        pinned = bt._session_pins.get(SID, [])
+        demoted = sum(1 for n in pinned if n.residency != "device")
+
+        toks = bt.resume_session(SID)
+        assert toks is not None, "manifest did not resolve"
+        # the promotion-stream wall time (submission -> last chunk
+        # installed) comes from the serving histogram: it isolates the
+        # piece the pipeline changes from prefill/decode noise
+        from paddle_tpu.observability import get_registry
+        h = get_registry().histogram("serving.prefix_promotion_seconds")
+        sum0 = h._sum
+        t0 = time.perf_counter()
+        r2 = bt.submit(np.concatenate([toks, cont]), NEW_TOKENS)
+        outs = bt.run_until_done(max_steps=60000)
+        dt = time.perf_counter() - t0
+        out2 = outs[r2]
+        free_after = bt.audit_pages()          # raises on any leak
+        st = bt.prefix_cache.stats()
+        return {"out1": out1, "out2": out2, "resume_s": dt,
+                "promo_stream_s": h._sum - sum0,
+                "published": bool(published), "pinned": len(pinned),
+                "demoted_before_resume": int(demoted),
+                "promotions": int(st["promotions"]),
+                "pin_drops": int(st["session_pin_drops"]),
+                "free_pages_after": int(free_after)}
+    finally:
+        bt.close()
+
+
+def _crash_resume(model, store_dir, cont):
+    """Replica death: a fresh batcher that shares nothing but the
+    manifest store resolves the session and full-prefills."""
+    bt = _batcher(model, store_dir, promo_slots=PIPE_SLOTS,
+                  promo_chunk_blocks=PIPE_CHUNK)
+    try:
+        toks = bt.resume_session(SID)
+        if toks is None:
+            return None
+        r = bt.submit(np.concatenate([toks, cont]), NEW_TOKENS)
+        out = bt.run_until_done(max_steps=60000)[r]
+        bt.audit_pages()
+        return out
+    finally:
+        bt.close()
+
+
+def _session_round_path():
+    import glob
+    import re
+    rounds = []
+    for p in glob.glob(os.path.join(_REPO_DIR, "BENCH_SESSION_r*.json")):
+        m = re.search(r"BENCH_SESSION_r(\d+)\.json$",
+                      os.path.basename(p))
+        if m:
+            rounds.append(int(m.group(1)))
+    n = (max(rounds) + 1) if rounds else 0
+    return os.path.join(_REPO_DIR, f"BENCH_SESSION_r{n:02d}.json")
+
+
+def main():
+    on_tpu = False
+    try:
+        import jax
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        pass
+    model, cfg = _model()
+    prompt, cont, churn = _workload(cfg.vocab_size)
+
+    with paddle.no_grad():
+        base1, base2 = _baseline(model, prompt, cont)
+        # one untimed warmup leg per geometry: first-touch trace/compile
+        # of the install scatters must not bias the first timed rep
+        for slots, csize in ((PIPE_SLOTS, PIPE_CHUNK), (1, None)):
+            with tempfile.TemporaryDirectory(prefix="bench_session_") as d:
+                _pause_churn_resume(model, d, prompt, cont, churn,
+                                    slots, csize)
+        runs = {"pipelined": [], "serial": []}
+        for _ in range(REPS):
+            for name, (slots, csize) in (
+                    ("pipelined", (PIPE_SLOTS, PIPE_CHUNK)),
+                    ("serial", (1, None))):
+                with tempfile.TemporaryDirectory(
+                        prefix="bench_session_") as d:
+                    runs[name].append(_pause_churn_resume(
+                        model, d, prompt, cont, churn, slots, csize))
+        with tempfile.TemporaryDirectory(prefix="bench_session_") as d:
+            leg = _pause_churn_resume(model, d, prompt, cont, churn,
+                                      promo_slots=PIPE_SLOTS,
+                                      promo_chunk_blocks=PIPE_CHUNK)
+            crash = _crash_resume(model, d, cont)
+
+    def _exact(leg):
+        return bool(np.array_equal(leg["out1"], base1)
+                    and np.array_equal(leg["out2"], base2))
+
+    token_exact = all(_exact(leg) for legs in runs.values()
+                      for leg in legs)
+    # latency: min over reps is the stable estimator (noise only adds)
+    t_pipe = min(leg["resume_s"] for leg in runs["pipelined"])
+    t_serial = min(leg["resume_s"] for leg in runs["serial"])
+    ps_pipe = min(leg["promo_stream_s"] for leg in runs["pipelined"])
+    ps_serial = min(leg["promo_stream_s"] for leg in runs["serial"])
+    rep = runs["pipelined"][0]
+    ctx_tokens = len(prompt) + NEW_TOKENS + CONT_TOKENS
+    goodput = ctx_tokens / max(t_pipe, 1e-9)
+
+    detail = {
+        "tpu": on_tpu,
+        "session_blocks": SESSION_BLOCKS,
+        "context_tokens": ctx_tokens,
+        "published": rep["published"],
+        "pinned_blocks": rep["pinned"],
+        "demoted_before_resume": rep["demoted_before_resume"],
+        "promotions": rep["promotions"],
+        "session_pin_drops": rep["pin_drops"],
+        "time_to_resume_ms": round(t_pipe * 1e3, 3),
+        "time_to_resume_ms_pipelined": round(t_pipe * 1e3, 3),
+        "time_to_resume_ms_serial": round(t_serial * 1e3, 3),
+        "promo_stream_ms_pipelined": round(ps_pipe * 1e3, 3),
+        "promo_stream_ms_serial": round(ps_serial * 1e3, 3),
+        "pipelined_beats_serial": bool(ps_pipe < ps_serial),
+        "token_exact": token_exact,
+        "crash_resume_exact": bool(
+            crash is not None and np.array_equal(crash, base2)),
+        "audit_clean": True,       # _pause_churn_resume raised otherwise
+    }
+    line = {
+        "metric": "session_resume_goodput",
+        "value": round(goodput, 3),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "detail": detail,
+    }
+    try:
+        with open(_session_round_path(), "w") as f:
+            json.dump(line, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass  # artifact write must never sink the bench number
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

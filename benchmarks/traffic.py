@@ -62,6 +62,19 @@ class TrafficSpec:
     shared_frac: float = 0.5        # frac of requests reusing a prefix
     session_frac: float = 0.3       # frac carrying a sticky session id
     n_sessions: int = 8
+    # agentic multi-turn population: a fraction of arrivals are
+    # conversations that PAUSE after each turn (tool call, human think
+    # time) and come back ``gap`` steps later with a continuation.
+    # 0.0 keeps legacy schedules byte-identical — the agentic branch
+    # draws from the RNG only when enabled, so every seed published
+    # before this field existed still expands to the same schedule.
+    agentic_frac: float = 0.0
+    agentic_turns_lo: int = 1       # follow-up turns per conversation
+    agentic_turns_hi: int = 3
+    agentic_gap_lo: int = 2         # pause length between turns, steps
+    agentic_gap_hi: int = 6
+    agentic_cont_lo: int = 4        # continuation prompt tokens per turn
+    agentic_cont_hi: int = 10
 
 
 @dataclass
@@ -74,6 +87,13 @@ class TrafficRequest:
     tenant: str
     priority: str
     session_id: Optional[str] = None
+    # agentic conversations: every follow-up turn is pre-drawn at
+    # generate() time (gap, continuation tokens, decode budget) so the
+    # whole multi-turn exchange is a pure function of the seed
+    turns_left: int = 0
+    resume_gaps: Tuple[int, ...] = ()
+    cont_tokens: Tuple[np.ndarray, ...] = ()
+    turn_new: Tuple[int, ...] = ()
 
 
 def _rate_at(spec: TrafficSpec, t: int) -> float:
@@ -94,6 +114,7 @@ def generate(spec: TrafficSpec) -> List[List[TrafficRequest]]:
     rng = np.random.RandomState(spec.seed)
     shared = [rng.randint(0, spec.vocab, (spec.shared_len,))
               for _ in range(spec.n_shared)]
+    agentic_seq = 0
     names = [t for t, _ in spec.tenants]
     weights = np.asarray([w for _, w in spec.tenants], float)
     weights = weights / weights.sum()
@@ -123,11 +144,41 @@ def generate(spec: TrafficSpec) -> List[List[TrafficRequest]]:
                 prompt = rng.randint(0, spec.vocab, (tail_len,))
             sid = (f"s{int(rng.randint(spec.n_sessions))}"
                    if rng.random_sample() < spec.session_frac else None)
+            turns = 0
+            gaps: Tuple[int, ...] = ()
+            conts: Tuple[np.ndarray, ...] = ()
+            turn_new: Tuple[int, ...] = ()
+            # every agentic draw lives behind this gate: with
+            # agentic_frac == 0 the RNG stream is untouched and legacy
+            # schedules replay byte-identically
+            if spec.agentic_frac > 0.0 and \
+                    rng.random_sample() < spec.agentic_frac:
+                turns = int(rng.randint(spec.agentic_turns_lo,
+                                        spec.agentic_turns_hi + 1))
+                gaps = tuple(int(rng.randint(spec.agentic_gap_lo,
+                                             spec.agentic_gap_hi + 1))
+                             for _ in range(turns))
+                conts = tuple(
+                    rng.randint(0, spec.vocab,
+                                (int(rng.randint(spec.agentic_cont_lo,
+                                                 spec.agentic_cont_hi
+                                                 + 1)),))
+                    for _ in range(turns))
+                turn_new = tuple(int(rng.randint(spec.new_lo,
+                                                 spec.new_hi + 1))
+                                 for _ in range(turns))
+                # agentic conversations own a dedicated session-id
+                # space: pause/resume must not collide with the sticky
+                # single-turn session population
+                sid = f"agent{agentic_seq}"
+                agentic_seq += 1
             batch.append(TrafficRequest(
                 at_step=t, prompt=prompt,
                 max_new_tokens=int(rng.randint(spec.new_lo,
                                                spec.new_hi + 1)),
-                tenant=tenant, priority=priority, session_id=sid))
+                tenant=tenant, priority=priority, session_id=sid,
+                turns_left=turns, resume_gaps=gaps, cont_tokens=conts,
+                turn_new=turn_new))
         out.append(batch)
     return out
 
@@ -150,6 +201,13 @@ class TrafficResult:
     step_worst_ttft: List[Optional[float]] = field(default_factory=list)
     first_breach_step: Optional[int] = None
     last_breach_step: Optional[int] = None
+    # agentic multi-turn accounting: every resumed completion is
+    # audited — its prompt must extend the session's prior context
+    # (prefix integrity), and when the caller supplies ``exact_ref``
+    # its tokens must match the uninterrupted reference bitwise
+    resumed: int = 0
+    resume_exact: int = 0
+    resume_mismatch: int = 0
 
     @property
     def offered(self) -> int:
@@ -176,7 +234,10 @@ class TrafficResult:
                 if self.ttfts else None,
                 "first_breach_step": self.first_breach_step,
                 "last_breach_step": self.last_breach_step,
-                "recovery_steps": self.recovery_steps}
+                "recovery_steps": self.recovery_steps,
+                "resumed": self.resumed,
+                "resume_exact": self.resume_exact,
+                "resume_mismatch": self.resume_mismatch}
 
 
 def _p99(xs: Sequence[float]) -> float:
@@ -186,14 +247,32 @@ def _p99(xs: Sequence[float]) -> float:
 
 def drive(gw, arrivals: List[List[TrafficRequest]], ttft_slo_s: float,
           tick: Optional[Callable[[int], None]] = None,
-          max_drain_steps: int = 4000) -> TrafficResult:
+          max_drain_steps: int = 4000,
+          exact_ref: Optional[Callable[[np.ndarray, int],
+                                       Sequence[int]]] = None
+          ) -> TrafficResult:
     """Run ``arrivals`` against ``gw``: one gateway step per schedule
-    step (plus drain steps until the queue empties), ``tick(step)``
-    after each — the hook where a remediator/autoscaler advances.
-    Typed rejections (quota, queue capacity, infeasible deadline) are
-    counted as sheds, not raised."""
+    step (plus drain steps until the queue AND pending agentic
+    follow-ups empty), ``tick(step)`` after each — the hook where a
+    remediator/autoscaler advances. Typed rejections (quota, queue
+    capacity, infeasible deadline) are counted as sheds, not raised.
+
+    Agentic conversations (``TrafficRequest.turns_left > 0``) pause
+    after each completed turn — the gateway's ``pause_session``
+    session-pins the KV chain and publishes the durable manifest when a
+    store is attached — and come back ``resume_gaps[i]`` steps later
+    via ``resume_session`` (falling back to a plain ``submit`` of the
+    recorded context on gateways without session support). Every
+    resumed completion is audited: the resumed prompt must extend the
+    session's prior context bitwise, and ``exact_ref(prompt, max_new)``
+    (when given — typically a solo reference generate, returning the
+    FULL ``prompt ⧺ completion`` sequence) must reproduce the delivered
+    sequence exactly."""
     res = TrafficResult(ttft_slo_s=ttft_slo_s)
-    meta: Dict[int, int] = {}           # gid -> submit step
+    # gid -> (submit step, request, turn index; -1 = opening turn)
+    meta: Dict[int, Tuple[int, TrafficRequest, int]] = {}
+    followups: Dict[int, List[Tuple[TrafficRequest, int]]] = {}
+    sess_ctx: Dict[str, np.ndarray] = {}    # sid -> prompt + delivered
 
     def _submit(step_i: int, batch: List[TrafficRequest]):
         for tr in batch:
@@ -204,8 +283,35 @@ def drive(gw, arrivals: List[List[TrafficRequest]], ttft_slo_s: float,
             except Exception:   # typed Overloaded / DeadlineExceeded
                 res.shed += 1
                 continue
-            meta[gid] = step_i
+            meta[gid] = (step_i, tr, -1)
             res.submitted += 1
+
+    def _resume(step_i: int, tr: TrafficRequest, turn: int):
+        cont = tr.cont_tokens[turn]
+        mnt = tr.turn_new[turn]
+        sid = tr.session_id
+        try:
+            if hasattr(gw, "resume_session") and sid in sess_ctx:
+                gid = gw.resume_session(
+                    sid, new_tokens=cont, max_new_tokens=mnt,
+                    tenant=tr.tenant, priority=tr.priority,
+                    fallback_tokens=sess_ctx[sid])
+            else:
+                base = sess_ctx.get(sid)
+                prompt = (np.concatenate([base, cont])
+                          if base is not None else cont)
+                gid = gw.submit(prompt, mnt, tenant=tr.tenant,
+                                priority=tr.priority, session_id=sid)
+        except Exception:       # shed follow-ups count like any shed
+            res.shed += 1
+            return
+        meta[gid] = (step_i, tr, turn)
+        res.submitted += 1
+        res.resumed += 1
+
+    def _due(step_i: int):
+        for tr, turn in followups.pop(step_i, []):
+            _resume(step_i, tr, turn)
 
     def _harvest(step_i: int, done: List[int]):
         worst = None
@@ -213,6 +319,7 @@ def drive(gw, arrivals: List[List[TrafficRequest]], ttft_slo_s: float,
             req = gw._finished.get(gid)
             if req is None or gid not in meta:
                 continue
+            _, tr, turn = meta[gid]
             res.completions += 1
             ttft = ((req.first_token_t - req.submit_t)
                     if req.first_token_t is not None else None)
@@ -225,6 +332,38 @@ def drive(gw, arrivals: List[List[TrafficRequest]], ttft_slo_s: float,
                     if res.first_breach_step is None:
                         res.first_breach_step = step_i
                     res.last_breach_step = step_i
+            sid = tr.session_id
+            if sid is not None and tr.turns_left > 0:
+                prompt = np.asarray(req.prompt, np.int64).reshape(-1)
+                delivered = np.asarray(req.delivered, np.int64)
+                if turn >= 0:   # a resumed turn: audit it
+                    prior = sess_ctx.get(sid)
+                    ok = (prior is not None
+                          and len(prompt) >= len(prior)
+                          and bool(np.array_equal(prompt[:len(prior)],
+                                                  prior)))
+                    if ok and exact_ref is not None:
+                        # exact_ref follows the repo-wide generate
+                        # convention: it returns the FULL sequence
+                        # (prompt ⧺ completion), so compare full vs full
+                        want = np.asarray(
+                            exact_ref(prompt, req.max_new_tokens),
+                            np.int64)
+                        got = np.concatenate([prompt, delivered])
+                        ok = bool(np.array_equal(got, want))
+                    if ok:
+                        res.resume_exact += 1
+                    else:
+                        res.resume_mismatch += 1
+                sess_ctx[sid] = np.concatenate([prompt, delivered])
+                if turn + 1 < tr.turns_left:
+                    if hasattr(gw, "pause_session"):
+                        # pin + publish; a torn publish returns False
+                        # and the later resume falls back — that
+                        # degradation is exactly what the audit checks
+                        gw.pause_session(sid)
+                    at = step_i + 1 + tr.resume_gaps[turn + 1]
+                    followups.setdefault(at, []).append((tr, turn + 1))
             gw.pop_result(gid)
             meta.pop(gid, None)
         # requests that FAILED (deadline, attempt budget) surface on
@@ -239,6 +378,7 @@ def drive(gw, arrivals: List[List[TrafficRequest]], ttft_slo_s: float,
 
     step_i = 0
     for batch in arrivals:
+        _due(step_i)
         _submit(step_i, batch)
         done = gw.step()
         if tick is not None:
@@ -246,7 +386,8 @@ def drive(gw, arrivals: List[List[TrafficRequest]], ttft_slo_s: float,
         _harvest(step_i, done)
         step_i += 1
     drained = 0
-    while gw._has_work() and drained < max_drain_steps:
+    while (gw._has_work() or followups) and drained < max_drain_steps:
+        _due(step_i)
         done = gw.step()
         if tick is not None:
             tick(step_i)

@@ -52,7 +52,11 @@ def _route_metrics():
                         "least-loaded"),
             reg.counter("gateway.route.prefix_hit",
                         "dispatches placed on the replica advertising "
-                        "the deepest cached prompt prefix"))
+                        "the deepest cached prompt prefix"),
+            reg.counter("gateway.route.session_resume",
+                        "returning sessions whose sticky replica was "
+                        "gone, resolved to a new replica (prefix depth "
+                        "or fallback)"))
 
 
 def _queue_wait_h():
@@ -170,7 +174,15 @@ class SessionAffinityPolicy(RoutePolicy):
         return depth * bs, dev_depth * bs
 
     def select(self, req, candidates: Sequence):
-        hit_c, fb_c, px_c = _route_metrics()
+        hit_c, fb_c, px_c, sr_c = _route_metrics()
+        sid = getattr(req, "session_id", None)
+        # a RESUMED session whose sticky replica vanished (death,
+        # rescale) resolves like any other request — prefix depth finds
+        # a survivor holding the chain, else fallback full-prefills —
+        # but the resolution is counted: it's the durable-resume path
+        orphan_session = (sid is not None
+                          and getattr(req, "resumed", False)
+                          and self._sessions.get(sid) is None)
         chains: Dict[int, List[int]] = {}
         best, best_key = None, (0, 0)
         for r in candidates:
@@ -187,9 +199,10 @@ class SessionAffinityPolicy(RoutePolicy):
                 best, best_key = r, key
         if best_key[0] > 0:
             px_c.inc()
+            if orphan_session:
+                sr_c.inc()
             return best
         by_name = {r.name: r for r in candidates}
-        sid = getattr(req, "session_id", None)
         if sid is not None and self._sessions.get(sid) in by_name:
             hit_c.inc()
             return by_name[self._sessions[sid]]
@@ -198,8 +211,12 @@ class SessionAffinityPolicy(RoutePolicy):
             warm = [r for r in candidates if bucket in r.warm_buckets]
             if warm:
                 hit_c.inc()
+                if orphan_session:
+                    sr_c.inc()
                 return min(warm, key=lambda r: (r.load, r.name))
         fb_c.inc()
+        if orphan_session:
+            sr_c.inc()
         return self.fallback.select(req, candidates)
 
     def on_dispatch(self, req, replica):
@@ -216,6 +233,11 @@ class SessionAffinityPolicy(RoutePolicy):
         candidate filter forever."""
         for sid in [s for s, n in self._sessions.items() if n == name]:
             del self._sessions[sid]
+
+    def forget_session(self, session_id: str):
+        """Drop one session's stickiness (released/expired sessions must
+        not keep steering traffic at their old replica)."""
+        self._sessions.pop(session_id, None)
 
 
 _POLICIES = {

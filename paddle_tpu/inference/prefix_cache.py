@@ -255,7 +255,7 @@ def _unskeletonize(skel, flat: Dict[str, np.ndarray]):
 
 class _Node:
     __slots__ = ("key", "page", "parent", "children", "ref", "last_use",
-                 "hash", "depth", "residency", "promo")
+                 "hash", "depth", "residency", "promo", "spin")
 
     def __init__(self, key: Tuple[int, ...], page: int, parent, hash_: int,
                  depth: int):
@@ -269,10 +269,11 @@ class _Node:
         self.depth = depth          # blocks from root (root excluded)
         self.residency = "device"
         self.promo = None           # in-flight promotion record, if any
+        self.spin = 0               # session pins (durable-session holds)
 
     def __repr__(self):            # pragma: no cover - debug aid
         return (f"_Node(depth={self.depth}, page={self.page}, "
-                f"ref={self.ref}, tier={self.residency}, "
+                f"ref={self.ref}, spin={self.spin}, tier={self.residency}, "
                 f"kids={len(self.children)})")
 
 
@@ -310,6 +311,9 @@ class RadixPrefixCache:
         self.promoted_bytes = 0
         self.promotion_failures = 0
         self.upgrades = 0          # off-device nodes re-adopted via insert
+        self.session_pin_drops = 0  # session-pinned nodes lost anyway
+        #   (untiered eviction or a failed spill: chaos/OOM wins; the
+        #   session manifest's full-prefill fallback keeps correctness)
         # cached routing advertisement (satellite: invalidate on mutation)
         self._summary_cache: Optional[Dict[str, object]] = None
         self._dirty = True
@@ -409,6 +413,25 @@ class RadixPrefixCache:
             n.ref -= 1
             self._touch(n)
 
+    def session_pin(self, nodes: Iterable[_Node]):
+        """Durable-session hold: unlike ``pin`` (which freezes pages on
+        device), a session pin lets churn demote the chain device -> host
+        -> disk but forbids dropping it out of the LAST tier — a paused
+        session stays promotable (or at worst disk-resident) until
+        ``session_unpin``. No effect on page accounting."""
+        for n in nodes:
+            n.spin += 1
+            self._touch(n)
+
+    def session_unpin(self, nodes: Iterable[_Node]):
+        for n in nodes:
+            if n.spin <= 0:
+                raise RuntimeError(
+                    "prefix-cache session-pin underflow: session_unpin of "
+                    "an unpinned node (double release)")
+            n.spin -= 1
+            self._touch(n)
+
     def insert(self, tokens, pages: Sequence[int],
                start_block: int, n_blocks: int) -> List[_Node]:
         """Adopt blocks [start_block, n_blocks) of ``tokens`` into the
@@ -479,6 +502,8 @@ class RadixPrefixCache:
             else:
                 # untiered: victim has no children at all (no device child
                 # by the rule, no off-device child without a tier)
+                if victim.spin > 0:
+                    self.session_pin_drops += 1
                 del victim.parent.children[victim.key]
                 self._nodes -= 1
                 self._dev_nodes -= 1
@@ -535,7 +560,7 @@ class RadixPrefixCache:
         after overflow eviction the blob cannot fit."""
         nbytes = blob_nbytes(blob)
         while tier.used_bytes + nbytes > tier.capacity_bytes:
-            v = self._lru_tier_evictable(tier.name)
+            v = self._lru_tier_evictable(tier)
             if v is None:
                 break
             self._evict_from_tier(v, tier)
@@ -545,18 +570,29 @@ class RadixPrefixCache:
         node.residency = tier.name
         return True
 
-    def _lru_tier_evictable(self, tier_name: str) -> Optional[_Node]:
-        """LRU node of ``tier_name`` whose demotion keeps residency
-        monotone: no pinned/promoting state and no child in the SAME tier
-        (deeper children already sit in a lower tier or are gone)."""
+    def _lru_tier_evictable(self, tier) -> Optional[_Node]:
+        """LRU node of ``tier`` whose demotion keeps residency monotone:
+        no pinned/promoting state and no child in the SAME tier (deeper
+        children already sit in a lower tier or are gone). When the tier
+        has no ``next_tier`` eviction means DROP, so session-pinned nodes
+        (``spin > 0``) are skipped there — churn can cascade a paused
+        session down the tier chain but never out of the last tier."""
+        last = tier.next_tier is None
         best: Optional[_Node] = None
         stack = list(self._root.children.values())
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
-            if n.residency != tier_name or n.ref > 0 or n.promo is not None:
+            if n.residency != tier.name or n.ref > 0 or n.promo is not None:
                 continue
-            if any(c.residency == tier_name for c in n.children.values()):
+            if last and n.spin > 0:
+                continue
+            if id(n) not in tier:
+                # mid-transition: _demote/_evict_from_tier flip residency
+                # before _store lands the blob — the node being stored
+                # must not be picked as its own overflow victim
+                continue
+            if any(c.residency == tier.name for c in n.children.values()):
                 continue
             if best is None or n.last_use < best.last_use:
                 best = n
@@ -594,6 +630,8 @@ class RadixPrefixCache:
                 self._dev_nodes -= 1
             else:
                 self._discard_blob(n)
+            if n.spin > 0:
+                self.session_pin_drops += 1
             self._nodes -= 1
         del node.parent.children[node.key]
         self._invalidate()
@@ -689,6 +727,16 @@ class RadixPrefixCache:
                 f"{sorted(by_tier)}")
         return report
 
+    def session_pinned_nodes(self) -> int:
+        count = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.spin > 0:
+                count += 1
+        return count
+
     def stats(self) -> Dict[str, int]:
         host = self.host_tier
         disk = host.next_tier if host is not None else None
@@ -705,6 +753,8 @@ class RadixPrefixCache:
                 "promoted_bytes": self.promoted_bytes,
                 "promotion_failures": self.promotion_failures,
                 "upgrades": self.upgrades,
+                "session_pinned_nodes": self.session_pinned_nodes(),
+                "session_pin_drops": self.session_pin_drops,
                 "host_nodes": len(host) if host is not None else 0,
                 "host_bytes": host.used_bytes if host is not None else 0,
                 "disk_nodes": len(disk) if disk is not None else 0,

@@ -784,12 +784,20 @@ class PagedContinuousBatcher(_BatcherBase):
                  disk_kv_dir: Optional[str] = None,
                  disk_kv_gib: Optional[float] = None,
                  promo_timeout_s: float = 5.0,
+                 promo_slots: int = 2,
+                 promo_chunk_blocks: Optional[int] = 4,
+                 session_store=None,
                  prompt_buckets=None,
                  draft_model=None, draft_k: int = 4):
         import paddle_tpu as paddle
 
         if policy not in ("reserve", "ondemand"):
             raise ValueError(f"unknown policy {policy!r}")
+        if promo_slots < 1:
+            raise ValueError("promo_slots must be >= 1")
+        if promo_chunk_blocks is not None and promo_chunk_blocks < 1:
+            raise ValueError("promo_chunk_blocks must be >= 1 (or None "
+                             "for one whole-tail chunk)")
         if prefix_cache and cache_quant:
             raise ValueError(
                 "prefix_cache shares pages across requests; dynamic "
@@ -901,16 +909,31 @@ class PagedContinuousBatcher(_BatcherBase):
         # LRU-evicts unpinned chains back into the free list on pressure
         self.prefix_cache = None
         self._slot_nodes: Dict[int, list] = {}
-        # tiered KV: one in-flight promotion record (FIFO head only — the
+        # tiered KV: one in-flight promotion STREAM (FIFO head only — the
         # batcher is single-threaded, so only the head request can wait),
-        # an rid denylist for requests whose promotion already failed
-        # (they fall back to full prefill, never retry), and the async
-        # device_put worker that stages host blobs off the critical path
+        # pipelined as a bounded multi-chunk queue through the async
+        # device_put worker: up to ``promo_slots`` chunks of
+        # ``promo_chunk_blocks`` blocks are in flight at once, completed
+        # chunks install in order at step boundaries while later chunks
+        # (and decode) keep running. ``_promo_denied`` is an rid denylist
+        # for requests whose promotion already failed (they fall back to
+        # full prefill, never retry).
         self._promo = None
         self._promo_denied: set = set()
         self._promoter = None
         self.promo_timeout_s = promo_timeout_s
+        self.promo_slots = promo_slots
+        self.promo_chunk_blocks = promo_chunk_blocks
         self._demoted_seen = 0      # cache.demoted_bytes already countered
+        # durable sessions: session id -> session-pinned node chain (spin
+        # refs survive demotion; see prefix_cache.session_pin) and the
+        # shared manifest store that makes a pause resumable on ANY
+        # replica
+        from .session_store import SessionStore
+        self.session_store = (SessionStore(session_store)
+                              if isinstance(session_store, str)
+                              else session_store)
+        self._session_pins: Dict[str, list] = {}
         if prefix_cache:
             from .prefix_cache import RadixPrefixCache, HostTier, DiskTier
             host_gib = (host_kv_gib if host_kv_gib is not None else
@@ -934,7 +957,9 @@ class PagedContinuousBatcher(_BatcherBase):
             if host_tier is not None:
                 from ..perf.prefetch import AsyncLoader
                 self._promoter = AsyncLoader(
-                    depth=2, name="paddle_tpu_kv_promoter")
+                    depth=max(2, promo_slots),
+                    name="paddle_tpu_kv_promoter",
+                    workers=max(1, promo_slots))
         # optional admission ladder: the suffix prefill pads up shared
         # rungs (O(#buckets) prefill signatures, same lever as the dense
         # batcher's prompt_buckets); None keeps exact-length prefill
@@ -1223,16 +1248,50 @@ class PagedContinuousBatcher(_BatcherBase):
                          for kc, vc in self._dstate["layers"]]
         return blob
 
+    def _submit_promo_chunk(self, promo) -> bool:
+        """Move one waiting chunk into flight. Its blobs are read off
+        their tier IN THE WORKER (a callable payload — the loader
+        materializes it before the device_put), so a later chunk's
+        host/disk reads overlap an earlier chunk's main-thread install
+        and, on a real accelerator, the in-flight DMA. Safe because
+        every stream node carries ``node.promo`` and a pin for the
+        duration: the evictors skip it, so its tier blob cannot move
+        under the worker. A read error fails the chunk's future and the
+        poller cancels the stream; False here only means the submit
+        itself failed (loader closed/draining)."""
+        from .prefix_cache import blob_nbytes
+        chunk = promo["waiting"].pop(0)
+        nodes = list(chunk["nodes"])
+        cache = self.prefix_cache
+
+        def _read():
+            blobs = [cache.node_blob(n) for n in nodes]
+            # worker-side write, published to the main thread by the
+            # future's Event — read only after done()
+            chunk["nbytes"] = [blob_nbytes(b) for b in blobs]
+            return blobs
+
+        try:
+            chunk["future"] = self._promoter.submit(_read)
+        except Exception:
+            promo["waiting"].insert(0, chunk)
+            return False
+        promo["chunks"].append(chunk)
+        return True
+
     def _start_promotion(self, req, dev: list, tail: list) -> bool:
-        """Submit the off-device tail of ``req``'s matched path to the
-        async device_put worker. Pins the WHOLE path (device prefix too:
+        """Open a pipelined promotion stream for the off-device tail of
+        ``req``'s matched path. Pins the WHOLE path (device prefix too:
         eviction must not demote what the request is about to use) and
         reserves one target page per tail node up front, so a completed
-        transfer always has somewhere to land. False (nothing pinned,
-        nothing reserved) if pages can't be found or chaos says no —
-        the caller degrades to device-prefix-only prefill."""
+        transfer always has somewhere to land. The tail is split into
+        ``promo_chunk_blocks``-block chunks with up to ``promo_slots``
+        in flight through the async worker at once; ``promo_slots=1``
+        with ``promo_chunk_blocks=None`` reproduces the old serial
+        single-slot behavior. False (nothing pinned, nothing reserved)
+        if pages can't be found or chaos says no — the caller degrades
+        to device-prefix-only prefill."""
         from ..resilience.chaos import fault_point
-        from .prefix_cache import blob_nbytes
         try:
             fault_point("kv.host_promote")
         except Exception:
@@ -1249,32 +1308,44 @@ class PagedContinuousBatcher(_BatcherBase):
             self.prefix_cache.unpin(pinned)
             return False
         pages = [self._free_pages.pop() for _ in range(need)]
-        try:
-            blobs = [self.prefix_cache.node_blob(n) for n in tail]
-            fut = self._promoter.submit(blobs)
-        except Exception:
-            self._free_pages.extend(pages)
-            self.prefix_cache.unpin(pinned)
-            self._promo_fail_c.inc()
-            self.prefix_cache.promotion_failures += 1
-            self._promo_denied.add(req.rid)
-            return False
+        csize = self.promo_chunk_blocks or len(tail)
         t0 = _time.perf_counter()
-        self._promo = {"req": req, "nodes": tail, "pinned": pinned,
-                       "pages": pages,
-                       "nbytes": [blob_nbytes(b) for b in blobs],
-                       "src_tiers": [n.residency for n in tail],
-                       "future": fut, "t0": t0,
-                       "deadline": t0 + self.promo_timeout_s}
+        promo = {"req": req, "pinned": pinned,
+                 # nodes/pages below shrink as chunks install — they are
+                 # the NOT-YET-INSTALLED remainder (audit + cancel view)
+                 "nodes": list(tail), "pages": list(pages),
+                 "chunks": [],    # in flight, FIFO
+                 "waiting": [{"nodes": tail[i:i + csize],
+                              "pages": pages[i:i + csize],
+                              "src_tiers": [n.residency
+                                            for n in tail[i:i + csize]]}
+                             for i in range(0, len(tail), csize)],
+                 "t0": t0, "deadline": t0 + self.promo_timeout_s,
+                 "installed_rows": 0, "src_tiers": []}
+        while promo["waiting"] and len(promo["chunks"]) < self.promo_slots:
+            if not self._submit_promo_chunk(promo):
+                for ch in promo["chunks"] + promo["waiting"]:
+                    self._free_pages.extend(ch["pages"])
+                self.prefix_cache.unpin(pinned)
+                self._promo_fail_c.inc()
+                self.prefix_cache.promotion_failures += 1
+                self._promo_denied.add(req.rid)
+                # in-flight chunks are orphaned to the worker; their
+                # staged arrays are dropped on arrival (no install record)
+                return False
+        self._promo = promo
         for n in tail:
-            n.promo = self._promo
+            n.promo = promo
         return True
 
     def _cancel_promotion(self, deny: bool):
-        """Abandon the in-flight promotion: reserved pages back to the
-        pool, path unpinned. ``deny`` marks it a FAILURE (timeout/error/
-        lost the page race) — the request won't retry and full-prefills
-        instead; deny=False is the benign head-changed path."""
+        """Abandon the promotion stream: every NOT-yet-installed chunk's
+        reserved pages go back to the pool, the path is unpinned. Chunks
+        already installed stay — they are cache-owned device pages now
+        (a partial promotion just deepens the device prefix). ``deny``
+        marks it a FAILURE (timeout/error/lost the page race) — the
+        request won't retry and full-prefills instead; deny=False is the
+        benign head-changed path."""
         promo, self._promo = self._promo, None
         for n in promo["nodes"]:
             n.promo = None
@@ -1285,28 +1356,13 @@ class PagedContinuousBatcher(_BatcherBase):
             self.prefix_cache.promotion_failures += 1
             self._promo_denied.add(promo["req"].rid)
 
-    def _poll_promotion(self) -> str:
-        """Advance the in-flight promotion: 'pending' while the transfer
-        runs (decode steps keep going — that's the overlap), 'ok' after
-        the staged arrays are installed into the pool at this step
-        boundary, 'failed' on error/timeout (reserved pages reclaimed).
-        Install happens HERE, on the main thread, because compiled decode
-        steps donate and replace the pool arrays every step — a
-        background thread could write into a donated buffer."""
-        promo = self._promo
-        fut = promo["future"]
-        if not fut.done():
-            if _time.perf_counter() < promo["deadline"]:
-                return "pending"
-            self._cancel_promotion(deny=True)
-            return "failed"
-        try:
-            staged = fut.result()
-        except Exception:
-            self._cancel_promotion(deny=True)
-            return "failed"
-        for node, page, blob, nb in zip(promo["nodes"], promo["pages"],
-                                        staged, promo["nbytes"]):
+    def _install_chunk(self, promo, chunk, staged):
+        """Land one completed chunk's staged arrays in the pool and hand
+        its pages to the cache. Main thread only: compiled decode steps
+        donate and replace the pool arrays every step — a background
+        thread could write into a donated buffer."""
+        for node, page, blob, nb in zip(chunk["nodes"], chunk["pages"],
+                                        staged, chunk["nbytes"]):
             for li, (k_s, v_s) in enumerate(blob["t"]):
                 kc, vc = self._state["layers"][li]
                 kc._data = kc._data.at[page].set(k_s)
@@ -1317,12 +1373,53 @@ class PagedContinuousBatcher(_BatcherBase):
                     kc._data = kc._data.at[page].set(k_s)
                     vc._data = vc._data.at[page].set(v_s)
             self.prefix_cache.promote_node(node, page, nb)
-        for n in promo["nodes"]:
-            n.promo = None
+            node.promo = None
+        promo["installed_rows"] += len(chunk["nodes"]) * self.block_size
+        promo["src_tiers"].extend(chunk["src_tiers"])
+        remaining = set(id(n) for n in chunk["nodes"])
+        promo["nodes"] = [n for n in promo["nodes"]
+                          if id(n) not in remaining]
+        drop = set(chunk["pages"])
+        promo["pages"] = [p for p in promo["pages"] if p not in drop]
+
+    def _poll_promotion(self) -> str:
+        """Advance the promotion stream: 'pending' while transfers run
+        (decode steps keep going — that's the overlap), 'ok' once every
+        chunk has installed at a step boundary, 'failed' on error/
+        timeout (remaining reserved pages reclaimed; chunks already
+        installed stay, deepening the device prefix). Each completed
+        chunk refreshes the deadline — the timeout bounds PROGRESS, not
+        total stream time, so a long cold resume isn't penalized for its
+        length."""
+        promo = self._promo
+        while promo["chunks"]:
+            head = promo["chunks"][0]
+            fut = head["future"]
+            if not fut.done():
+                if _time.perf_counter() < promo["deadline"]:
+                    return "pending"
+                self._cancel_promotion(deny=True)
+                return "failed"
+            try:
+                staged = fut.result()
+            except Exception:
+                self._cancel_promotion(deny=True)
+                return "failed"
+            self._install_chunk(promo, head, staged)
+            promo["chunks"].pop(0)
+            promo["deadline"] = _time.perf_counter() + self.promo_timeout_s
+            while (promo["waiting"]
+                   and len(promo["chunks"]) < self.promo_slots):
+                if not self._submit_promo_chunk(promo):
+                    self._cancel_promotion(deny=True)
+                    return "failed"
+        if promo["waiting"]:           # pragma: no cover — defensive
+            self._cancel_promotion(deny=True)
+            return "failed"
         self.prefix_cache.unpin(promo["pinned"])
         self._promote_h.observe(_time.perf_counter() - promo["t0"])
-        self._promo_c.inc(len(promo["nodes"]))
-        self._promo_installed_rows = len(promo["nodes"]) * self.block_size
+        self._promo_c.inc(promo["installed_rows"] // self.block_size)
+        self._promo_installed_rows = promo["installed_rows"]
         self._promo_src_tiers = list(promo["src_tiers"])
         self._promo = None
         return "ok"
@@ -1413,6 +1510,92 @@ class PagedContinuousBatcher(_BatcherBase):
     @property
     def free_page_count(self) -> int:
         return len(self._free_pages)
+
+    # -- durable sessions ---------------------------------------------------
+    def _session_gauge(self):
+        if not hasattr(self, "_session_pin_g"):
+            from ..observability.metrics import get_registry
+            self._session_pin_g = get_registry().gauge(
+                "session.pinned_blocks",
+                "prefix-cache blocks currently held by session pins")
+        return self._session_pin_g
+
+    def model_identity(self) -> str:
+        from .session_store import model_identity
+        return model_identity(self.model)
+
+    def pin_session(self, session_id: str, token_ids) -> int:
+        """Session-pin the cached chain covering ``token_ids``' full
+        blocks (replacing any previous pin for this id): churn may demote
+        the chain to host/disk but can no longer drop it out of the last
+        tier, so a resume finds it promotable. Local-only — durability
+        across replicas is the manifest's job (``pause_session``).
+        Returns the number of pinned blocks."""
+        if self.prefix_cache is None:
+            return 0
+        self.unpin_session(session_id)
+        path = self.prefix_cache.match(token_ids)
+        if path:
+            self.prefix_cache.session_pin(path)
+            self._session_pins[session_id] = path
+        self._session_gauge().set(
+            sum(len(p) for p in self._session_pins.values()))
+        from ..observability.fleet import spool_event
+        spool_event("session", op="pin", session=session_id,
+                    blocks=len(path))
+        return len(path)
+
+    def unpin_session(self, session_id: str) -> bool:
+        nodes = self._session_pins.pop(session_id, None)
+        if not nodes:
+            return False
+        self.prefix_cache.session_unpin(nodes)
+        self._session_gauge().set(
+            sum(len(p) for p in self._session_pins.values()))
+        return True
+
+    def release_sessions(self):
+        """Drop every local session pin (manifests are untouched — the
+        sessions stay resumable elsewhere). The close/remove path."""
+        for sid in list(self._session_pins):
+            self.unpin_session(sid)
+
+    def pause_session(self, session_id: str, token_ids) -> bool:
+        """Pause a conversation: pin its chain locally AND publish the
+        crash-safe manifest (id -> chain hashes + tokens + model identity)
+        to the shared store, so ANY replica can resume it later. True iff
+        the manifest published atomically; on a torn publish (chaos, IO)
+        the chain stays pinned locally — a same-replica resume still
+        rides the cache, a cross-replica one falls back to re-prefill."""
+        self.pin_session(session_id, token_ids)
+        if self.session_store is None:
+            return False
+        from .session_store import SessionManifest
+        toks = np.asarray(token_ids, np.int64).reshape(-1)
+        m = SessionManifest(session_id=session_id,
+                            token_ids=[int(t) for t in toks],
+                            block_size=self.block_size,
+                            model=self.model_identity())
+        return self.session_store.publish(m)
+
+    def resume_session(self, session_id: str):
+        """Resolve a paused session to the token ids to resubmit
+        (``prompt ⧺ generated`` of the paused turn — submitting them plus
+        the new turn re-matches the pinned chain and streams the tiered
+        promotion). ``None`` when the manifest is missing/torn/corrupt or
+        the model identity changed (typed finding in the store; the
+        caller full-prefills from its own context — token-exact either
+        way)."""
+        if self.session_store is None:
+            return None
+        m = self.session_store.load(session_id,
+                                    expect_model=self.model_identity())
+        if m is None:
+            return None
+        # a block_size mismatch only invalidates the manifest's chain
+        # hashes (a routing hint); the tokens stay good — the radix tree
+        # matches raw token blocks, so resume correctness is unaffected
+        return np.asarray(m.token_ids, np.int64)
 
     # -- request lifecycle --------------------------------------------------
     def _validate(self, prompt: np.ndarray, max_new_tokens: int):

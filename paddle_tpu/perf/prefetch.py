@@ -33,7 +33,7 @@ import time
 from typing import Callable, Iterable, Optional
 
 __all__ = ["DevicePrefetcher", "AsyncLoader", "TransferFuture",
-           "coalesced_device_put"]
+           "TransferCancelled", "coalesced_device_put"]
 
 
 def coalesced_device_put(batch, device=None):
@@ -194,6 +194,14 @@ class DevicePrefetcher:
             pass
 
 
+class TransferCancelled(RuntimeError):
+    """The transfer was still queued (never issued to the device) when its
+    AsyncLoader closed. Distinct from a transfer *failure*: no
+    ``device_put`` ever ran for this payload, so the caller's host-side
+    source of truth is untouched and a clean fallback (re-prefill,
+    re-promotion on another replica) is always available."""
+
+
 class TransferFuture:
     """Completion handle for one AsyncLoader transfer (threading.Event
     based — ``done()`` is the poll the batcher's admission loop uses)."""
@@ -233,10 +241,17 @@ class AsyncLoader:
     and completes the future. The queue is bounded (``depth``, default 2:
     double buffering) so a burst of submissions backpressures instead of
     pinning unbounded host memory.
+
+    A *callable* payload is invoked by the worker to materialize the
+    real pytree first — the hook the pipelined promotion stream uses to
+    pull host/disk blob READS off the critical path too, so a later
+    chunk's read overlaps an earlier chunk's main-thread install.
+    Errors from the callable fail the future exactly like transfer
+    errors.
     """
 
     def __init__(self, depth: int = 2, device=None,
-                 name: str = "paddle_tpu_kv_promoter"):
+                 name: str = "paddle_tpu_kv_promoter", workers: int = 1):
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
         self._device = device
         self._closed = False
@@ -247,9 +262,20 @@ class AsyncLoader:
         self._load_h = reg.histogram(
             "prefetch.async_load_seconds",
             "AsyncLoader per-submit device_put + ready seconds")
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=name)
-        self._thread.start()
+        self._cancelled = reg.counter(
+            "prefetch.async_cancelled",
+            "queued transfers cancelled (never issued) by AsyncLoader.close")
+        # a small pool (workers > 1) lets independent submissions'
+        # callable payloads materialize concurrently — the pipelined
+        # promotion stream reads its chunks' blobs in parallel. Each
+        # future still completes independently; callers that need order
+        # (the chunk FIFO) impose it themselves.
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"{name}-{i}" if workers > 1 else name)
+            for i in range(max(1, workers))]
+        for t in self._threads:
+            t.start()
 
     def _run(self):
         import jax
@@ -258,8 +284,18 @@ class AsyncLoader:
             if item is None:
                 return
             fut, payload = item
+            if self._closed:
+                # drain mode: the item was queued but never issued. Fail
+                # it typed instead of touching the device — a draining
+                # replica must not device_put after drain begins.
+                self._cancelled.inc()
+                fut._fail(TransferCancelled(
+                    "AsyncLoader closed before transfer was issued"))
+                continue
             try:
                 t0 = time.perf_counter()
+                if callable(payload):
+                    payload = payload()
                 staged = jax.device_put(payload, self._device)
                 for leaf in jax.tree_util.tree_leaves(staged):
                     leaf.block_until_ready()
@@ -277,18 +313,40 @@ class AsyncLoader:
         return fut
 
     def close(self, timeout: float = 2.0):
-        """Idempotent bounded shutdown (pending futures still complete if
-        the worker drains them before the sentinel)."""
+        """Idempotent bounded shutdown with deterministic queued-cancel.
+
+        Transfers already *issued* (the worker is inside ``device_put``)
+        complete normally; everything still sitting in the queue when
+        close begins is failed with :class:`TransferCancelled` — never
+        issued. The queue is drained here AND every worker double-checks
+        ``_closed`` after every ``get`` so an item a worker races us to
+        is cancelled on its side; at most one transfer per worker can
+        slip through, and only if it was already dequeued before
+        ``_closed`` was set (i.e. it was in flight, which is allowed to
+        land).
+        """
+        deadline = time.perf_counter() + timeout
         if self._closed:
-            self._thread.join(timeout=timeout)
+            for t in self._threads:
+                t.join(timeout=max(0.0, deadline - time.perf_counter()))
             return
         self._closed = True
-        try:
-            self._q.put_nowait(None)
-        except queue_mod.Full:
-            # worker is busy; it will see the sentinel once it drains
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue_mod.Empty:
+                break
+            if item is None:
+                continue
+            fut, _ = item
+            self._cancelled.inc()
+            fut._fail(TransferCancelled(
+                "AsyncLoader closed before transfer was issued"))
+        for _ in self._threads:
+            # blocking put is safe: workers in drain mode consume fast
             self._q.put(None)
-        self._thread.join(timeout=timeout)
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.perf_counter()))
 
     def __del__(self):  # pragma: no cover — best-effort cleanup
         try:

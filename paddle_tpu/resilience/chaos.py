@@ -24,6 +24,13 @@ hot seams of this codebase:
   * ``kv.host_promote``   — submitting a host->device prefix promotion
     (inference/serving.py; a failure degrades the admission to full
     prefill, token-exact)
+  * ``kv.session_publish`` — the session-manifest atomic publish
+    (inference/session_store.py; ``torn_write`` crashes the writer
+    mid-manifest — only a ``.tmp`` no reader trusts is left behind, the
+    previous manifest, if any, stays sound)
+  * ``kv.session_resume`` — the manifest load at session resume
+    (inference/session_store.py; a failure degrades the resume to full
+    re-prefill from the caller's context, token-exact)
   * ``dataloader.next``   — batch delivery (io/dataloader.py)
   * ``train.step``        — hapi train_batch (hapi/model.py)
 
@@ -77,6 +84,7 @@ KNOWN_POINTS = ("checkpoint.write", "checkpoint.shard_write",
                 "checkpoint.publish", "collective.enter", "serving.step",
                 "gateway.step.<replica>",
                 "kv.request", "kv.host_demote", "kv.host_promote",
+                "kv.session_publish", "kv.session_resume",
                 "dataloader.next", "train.step")
 
 

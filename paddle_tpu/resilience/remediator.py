@@ -454,9 +454,14 @@ class AutoRemediator:
                  sig: Signal) -> Optional[dict]:
         gw = self.gw
         if action == "drain_replica":
-            inflight = gw.pool.get(target).load
+            rep = gw.pool.get(target)
+            inflight = rep.load
+            # durable sessions ride the drain untouched: the gateway
+            # preserves the replica's session pins (its tiered chains
+            # stay resumable) and manifests live in the shared store
+            pins = len(getattr(rep.batcher, "_session_pins", {}) or {})
             gw.drain_replica(target, requeue=True)
-            return {"requeued": inflight}
+            return {"requeued": inflight, "sessions_preserved": pins}
         if action == "restart_replica":
             if self.replica_factory is None:
                 raise RuntimeError("no replica_factory configured")

@@ -842,6 +842,25 @@ def test_ckpt_inspect_gate_selftest_is_clean_and_fast():
     assert elapsed < 10.0, f"ckpt_inspect selftest took {elapsed:.1f}s"
 
 
+@pytest.mark.lint
+@pytest.mark.quick
+def test_session_inspect_gate_selftest_is_clean_and_fast():
+    """tools/session_inspect.py rides the lint lane: its --selftest
+    builds a synthetic session root (sound, torn-publish debris, token
+    bit-rot under stale CRCs, chain-hash drift under a re-sealed
+    document CRC) and asserts every verdict — stdlib only, no
+    numpy/jax import, so it stays within the 10s lint budget."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "session_inspect.py"),
+         "--selftest"], cwd=REPO, capture_output=True, text=True)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "selftest" in (proc.stdout + proc.stderr).lower()
+    assert elapsed < 10.0, f"session_inspect selftest took {elapsed:.1f}s"
+
+
 def test_shard_check_cli_flags_oversubscribed_batch():
     proc = _run_shard_cli("--batch", "64", "--json")
     assert proc.returncode == 1

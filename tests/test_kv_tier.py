@@ -288,7 +288,7 @@ def test_async_loader_future_and_idempotent_close():
     np.testing.assert_array_equal(np.asarray(out[0]), payload[0])
     ld.close()
     ld.close()                                 # second close is a no-op
-    assert not ld._thread.is_alive()
+    assert not any(t.is_alive() for t in ld._threads)
     with pytest.raises(RuntimeError):
         ld.submit(payload)
 

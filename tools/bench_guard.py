@@ -44,11 +44,12 @@ def discover(dirpath: str, prefix: str = "BENCH_r") -> List[dict]:
     ``BENCH_GATEWAY_r*.json`` (bench_gateway.py writes it), the
     multichip lane in ``MULTICHIP_r*.json`` (bench_multichip.py), the
     KV-tier churn lane in ``BENCH_PREFIX_r*.json``
-    (bench_prefix_churn.py), and the self-heal traffic lane in
-    ``BENCH_TRAFFIC_r*.json`` (bench_selfheal.py), and the op-profile
-    lane in ``OPPROF_r*.json`` (opprof cost artifacts, synthesized
-    into inverse drift series directly in ``run_check``) — all pulled
-    in by ``run_check`` with their own prefixes. The globs are disjoint, so the relay gate
+    (bench_prefix_churn.py), the self-heal traffic lane in
+    ``BENCH_TRAFFIC_r*.json`` (bench_selfheal.py), the durable-session
+    resume lane in ``BENCH_SESSION_r*.json`` (bench_session.py), and
+    the op-profile lane in ``OPPROF_r*.json`` (opprof cost artifacts,
+    synthesized into inverse drift series directly in ``run_check``) —
+    all pulled in by ``run_check`` with their own prefixes. The globs are disjoint, so the relay gate
     (train-lane-only by construction) never sees the other lanes'
     rounds, and pre-lane MULTICHIP artifacts (raw dry-run wrappers
     without a parsed bench line) skip cleanly."""
@@ -218,6 +219,26 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
                 "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
                 "_round": r["_round"], "_file": r["_file"],
                 "_lane": "traffic"})
+    se_records = discover(dirpath, prefix="BENCH_SESSION_r")
+    for r in se_records:
+        r["_lane"] = "session"
+    # the session bench's headline value is resume goodput (resumed
+    # tokens/s through the pipelined promotion stream); time-to-resume
+    # gates as an INVERSE series (resumes/s from
+    # detail.time_to_resume_ms) because the band is a lower bound — a
+    # resume-latency blowup shows up as the rate collapsing.
+    ttr_records = []
+    for r in se_records:
+        if "_skip" in r:
+            continue
+        ttr = (r.get("detail") or {}).get("time_to_resume_ms")
+        if isinstance(ttr, (int, float)) and ttr > 0:
+            ttr_records.append({
+                "metric": "session_resume_rate",
+                "value": 1000.0 / float(ttr), "unit": "resumes/s",
+                "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
+                "_round": r["_round"], "_file": r["_file"],
+                "_lane": "session"})
     # op-level profile lane: OPPROF_r*.json (opprof.write_artifact —
     # bench.py emits one per run). These are cost artifacts, not bench
     # lines, so the series are synthesized here. The band is a LOWER
@@ -260,7 +281,8 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
                 "_lane": "opprof"})
     records = (records + gw_records + mc_records + goodput_records
                + px_records + promo_records + tr_records
-               + recov_records + opp_records)
+               + recov_records + se_records + ttr_records
+               + opp_records)
     report = {
         "dir": dirpath,
         "tolerance": tolerance,

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Offline session-manifest inspector: the durable-resume audit tool.
+
+    python tools/session_inspect.py /path/to/session_root
+    python tools/session_inspect.py /path/to/session_root --json
+    python tools/session_inspect.py --selftest
+
+Walks every ``*.json`` manifest a ``SessionStore`` published under the
+root and re-verifies the whole durability contract with nothing but the
+stdlib: the whole-document crc32, every per-block crc32 (over the
+block's packed little-endian int64 token bytes), and a from-scratch
+recompute of the ordered chain hashes (``blake2b(digest_size=8)`` over
+``parent_hash_8B_le || token_bytes``) against the recorded entries.
+``.tmp`` debris — a publish that crashed between the temp write and the
+``os.replace`` — is reported as torn. Exit codes: 0 every manifest is
+sound, 2 at least one is torn/corrupt/drifted, 1 usage or I/O error.
+
+Deliberately stdlib-only (``struct.pack("<q", t)`` reproduces
+``np.asarray(tokens, np.int64).tobytes()`` byte-for-byte): this is the
+tool an operator runs on the shared session volume from a box with no
+numpy/jax, and the lint lane imports it under the same constraint.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import struct
+import sys
+import zlib
+
+
+def pack_tokens(tokens) -> bytes:
+    """Little-endian int64 token bytes — what the store's CRCs and
+    chain hashes consumed."""
+    return b"".join(struct.pack("<q", int(t)) for t in tokens)
+
+
+def chain_hashes(tokens, block_size: int):
+    """Recompute the ordered chain hashes for ``tokens`` exactly as
+    ``inference.prefix_cache.chain_hashes`` does, stdlib-only."""
+    out = []
+    parent = 0
+    for i in range(len(tokens) // block_size):
+        blk = tokens[i * block_size:(i + 1) * block_size]
+        h = hashlib.blake2b(parent.to_bytes(8, "little")
+                            + pack_tokens(blk), digest_size=8)
+        parent = int.from_bytes(h.digest(), "little")
+        out.append(parent)
+    return out
+
+
+def inspect_manifest(path: str) -> dict:
+    """One manifest file -> {path, session, ok, reason, blocks, tokens}."""
+    out = {"path": path, "session": None, "ok": True, "reason": "",
+           "blocks": 0, "tokens": 0}
+
+    def bad(reason):
+        out["ok"] = False
+        out["reason"] = reason
+        return out
+
+    try:
+        with open(path, "rb") as f:
+            doc = json.loads(f.read())
+    except (OSError, ValueError) as e:
+        return bad(f"unreadable: {e}")
+    out["session"] = doc.get("session_id")
+    body = {k: v for k, v in doc.items() if k != "crc"}
+    want = zlib.crc32(json.dumps(body, sort_keys=True).encode()) \
+        & 0xFFFFFFFF
+    if doc.get("crc") != want:
+        return bad(f"document checksum mismatch "
+                   f"({doc.get('crc')} != {want})")
+    tokens = doc.get("tokens", [])
+    bs = int(doc.get("block_size", 0) or 0)
+    if bs < 1 or len(tokens) != doc.get("n_tokens"):
+        return bad("token count / block size fields inconsistent")
+    out["tokens"] = len(tokens)
+    chain = chain_hashes(tokens, bs)
+    entries = doc.get("blocks", [])
+    if len(entries) != len(chain):
+        return bad(f"{len(entries)} block entries != {len(chain)} "
+                   f"full blocks")
+    for i, (h, entry) in enumerate(zip(chain, entries)):
+        blk = tokens[i * bs:(i + 1) * bs]
+        crc = zlib.crc32(pack_tokens(blk)) & 0xFFFFFFFF
+        if entry.get("crc") != crc:
+            return bad(f"block {i} checksum mismatch "
+                       f"({entry.get('crc')} != {crc})")
+        if entry.get("h") != f"{h:016x}":
+            return bad(f"block {i} chain-hash drift "
+                       f"({entry.get('h')} != {h:016x})")
+        out["blocks"] += 1
+    return out
+
+
+def inspect_root(root: str) -> dict:
+    reports = []
+    torn = []
+    for name in sorted(os.listdir(root)):
+        full = os.path.join(root, name)
+        if name.endswith(".json.tmp"):
+            torn.append({"path": full, "session": None, "ok": False,
+                         "reason": "torn publish: .tmp debris (crash "
+                                   "between write and rename)",
+                         "blocks": 0, "tokens": 0})
+        elif name.endswith(".json"):
+            reports.append(inspect_manifest(full))
+    reports.extend(torn)
+    return {"root": root,
+            "manifests": reports,
+            "ok": all(r["ok"] for r in reports),
+            "sound": sum(1 for r in reports if r["ok"])}
+
+
+def print_table(report: dict) -> None:
+    print(f"session root: {report['root']}")
+    if not report["manifests"]:
+        print("  (no manifests)")
+        return
+    print(f"  {'file':44} {'session':16} {'blocks':>6} {'tokens':>6}"
+          f"  status")
+    for r in report["manifests"]:
+        status = "OK" if r["ok"] else f"BAD: {r['reason']}"
+        print(f"  {os.path.basename(r['path']):44} "
+              f"{str(r['session']):16} {r['blocks']:>6} "
+              f"{r['tokens']:>6}  {status}")
+    print(f"  sound manifests: {report['sound']}"
+          f"/{len(report['manifests'])}")
+
+
+def _selftest() -> int:
+    """Build a synthetic root (sound, torn, doc-corrupt, entry-corrupt)
+    with nothing but the stdlib, then check every verdict."""
+    import tempfile
+
+    def encode(sid, tokens, bs):
+        chain = chain_hashes(tokens, bs)
+        blocks = [{"h": f"{h:016x}",
+                   "crc": zlib.crc32(pack_tokens(
+                       tokens[i * bs:(i + 1) * bs])) & 0xFFFFFFFF}
+                  for i, h in enumerate(chain)]
+        body = {"version": 1, "session_id": sid, "model": "m:00000000",
+                "block_size": bs, "last_activity": 1.0,
+                "n_tokens": len(tokens), "tokens": tokens,
+                "blocks": blocks}
+        body["crc"] = zlib.crc32(
+            json.dumps(body, sort_keys=True).encode()) & 0xFFFFFFFF
+        return json.dumps(body, sort_keys=True).encode()
+
+    with tempfile.TemporaryDirectory(prefix="session_inspect_self_") \
+            as root:
+        tokens = [(7 * i + 3) % 101 for i in range(20)]
+        with open(os.path.join(root, "good.00000001.json"), "wb") as f:
+            f.write(encode("good", tokens, 4))
+        with open(os.path.join(root, "torn.00000002.json.tmp"),
+                  "wb") as f:
+            f.write(encode("torn", tokens, 4)[:30])  # mid-write crash
+        doc = json.loads(encode("bitrot", tokens, 4))
+        doc["tokens"][3] ^= 1   # flip a token, keep every recorded crc
+        with open(os.path.join(root, "bitrot.00000003.json"),
+                  "wb") as f:
+            f.write(json.dumps(doc, sort_keys=True).encode())
+        rep = inspect_root(root)
+        by_sid = {r["session"]: r for r in rep["manifests"]
+                  if r["session"]}
+        assert by_sid["good"]["ok"] and by_sid["good"]["blocks"] == 5, \
+            by_sid["good"]
+        assert not by_sid["bitrot"]["ok"] \
+            and "checksum" in by_sid["bitrot"]["reason"], by_sid["bitrot"]
+        torn = [r for r in rep["manifests"] if r["path"].endswith(".tmp")]
+        assert torn and "torn" in torn[0]["reason"], torn
+        assert not rep["ok"] and rep["sound"] == 1, rep
+        # entry-level corruption: keep the doc crc honest but drift one
+        # block's recorded chain hash
+        doc = json.loads(encode("drift", tokens, 4))
+        doc["blocks"][2]["h"] = "0" * 16
+        body = {k: v for k, v in doc.items() if k != "crc"}
+        doc["crc"] = zlib.crc32(
+            json.dumps(body, sort_keys=True).encode()) & 0xFFFFFFFF
+        p = os.path.join(root, "drift.00000004.json")
+        with open(p, "wb") as f:
+            f.write(json.dumps(doc, sort_keys=True).encode())
+        r = inspect_manifest(p)
+        assert not r["ok"] and "drift" in r["reason"], r
+    print("session_inspect selftest OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?", help="session store root")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="validate the inspector against a synthetic "
+                         "root and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.root:
+        ap.error("root is required (or --selftest)")
+    if not os.path.isdir(args.root):
+        print(f"error: {args.root!r} is not a directory",
+              file=sys.stderr)
+        return 1
+    report = inspect_root(args.root)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        print_table(report)
+    return 0 if report["ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,6 +127,12 @@ def main(argv=None) -> int:
                          "AutoRemediator journals: decision, action, "
                          "target, triggering signal, reason), "
                          "chronological across ranks")
+    ap.add_argument("--sessions", action="store_true",
+                    help="with --fleet: render the durable-session "
+                         "timeline (``session`` spool events: pin/"
+                         "pause/publish/load/resume/release, drain "
+                         "preservation, typed manifest findings), "
+                         "chronological across ranks")
     ap.add_argument("--opprof", action="store_true",
                     help="render the newest OPPROF_r*.json op-level "
                          "cost artifact at the repo root (per-op-class "
@@ -148,6 +154,9 @@ def main(argv=None) -> int:
     if args.actions and not args.fleet:
         ap.error("--actions renders the remediation timeline from the "
                  "per-rank spools; use it with --fleet DIR")
+    if args.sessions and not args.fleet:
+        ap.error("--sessions renders the durable-session timeline from "
+                 "the per-rank spools; use it with --fleet DIR")
 
     if args.opprof:
         # the op-level cost view: artifacts only, so load opprof.py
@@ -231,6 +240,42 @@ def main(argv=None) -> int:
                      f"{e.get('target', '') or '-':12} "
                      f"<- {e.get('signal', '?'):24} "
                      f"| {e.get('reason', '')}\n")
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
+
+    if args.sessions:
+        # the durable-session timeline: every pin/pause/publish/load/
+        # resume/release plus drain preservation and typed manifest
+        # findings, as journaled into the rank spools, chronological
+        # across the fleet — handled like --actions (early return) so
+        # every existing flag combination stays byte-identical
+        from paddle_tpu.observability.fleet import FleetAggregator
+        agg = FleetAggregator(args.fleet)
+        evs = [(e.get("t", 0.0), rank, e)
+               for rank, shard in sorted(agg.shards.items())
+               for e in shard.events
+               if e.get("name") == "session"]
+        evs.sort(key=lambda x: (x[0], x[1]))
+        n_find = sum(1 for _, _, e in evs if e.get("op") == "finding")
+        text = (f"# session timeline ({len(evs)} event(s), "
+                f"{n_find} finding(s))\n")
+        t0 = evs[0][0] if evs else 0.0
+        for t, rank, e in evs:
+            extra = " ".join(
+                f"{k}={e[k]}" for k in ("replica", "blocks", "tokens",
+                                        "source", "gid", "finding",
+                                        "sessions", "deleted")
+                if k in e)
+            text += (f"+{t - t0:8.3f}s rank{rank} "
+                     f"{e.get('op', '?'):14} "
+                     f"{e.get('session', '') or '-':16} "
+                     f"{extra}"
+                     + (f" | {e['detail']}" if e.get("detail") else "")
+                     + "\n")
         if args.out:
             with open(args.out, "w") as fh:
                 fh.write(text)
