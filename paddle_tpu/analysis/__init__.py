@@ -20,6 +20,13 @@ Four rule families over three IRs (rule catalog in ``findings.RULES``):
   walk with donation credits from the op registry's alias metadata per
   jaxpr, recorded-bytes scaling per PLAN_7B variant, and KV-cache
   pricing per gateway serving bucket. CLI: ``python tools/shard_check.py``.
+* **CC rules** (``concurrency.py``) audit the serving fleet's lock
+  discipline: a whole-repo lock-acquisition graph flags lock-order
+  cycles (CC401), blocking calls under a lock (CC402), callbacks invoked
+  while holding a lock (CC403), and unguarded shared-state mutation
+  (CC404); the runtime witness (``utils.locks``) records observed
+  acquisition order and hold times (CC405/CC406).
+  CLI: ``python tools/race_check.py``.
 
 DF/SH/MEM analyses are registered as read-only *diagnostic passes* in the
 static.ir pass registry (``passes.py``) — ``apply_pass(prog,
@@ -45,8 +52,12 @@ from .sharding import (MeshSpec, ShardSpec, check_fsdp_replication,
                        interconnect_budget, propagate_placements)
 from .memory import (check_hbm, check_plan_memory, peak_hbm_estimate,
                      serving_bucket_report, variant_live_gib)
+from .concurrency import (analyze_paths as check_concurrency,
+                          analyze_source as check_concurrency_source,
+                          audit_witness, audit_witness_paths)
 from . import passes as _passes  # registers the diagnostic passes
-from .passes import DIAGNOSTIC_PASS_NAMES, record_findings
+from .passes import (DIAGNOSTIC_PASS_NAMES, check_lock_discipline,
+                     check_lock_witness, record_findings)
 
 __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "has_errors", "summarize",
@@ -59,6 +70,9 @@ __all__ = [
     "interconnect_budget",
     "peak_hbm_estimate", "check_hbm", "variant_live_gib",
     "check_plan_memory", "serving_bucket_report",
+    "check_concurrency", "check_concurrency_source",
+    "audit_witness", "audit_witness_paths",
+    "check_lock_discipline", "check_lock_witness",
     "DIAGNOSTIC_PASS_NAMES", "record_findings",
 ]
 

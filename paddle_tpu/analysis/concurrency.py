@@ -1,0 +1,713 @@
+"""Concurrency-safety lint (CC* rules): whole-repo lock-acquisition
+graph over stdlib ``ast`` — no jax import, loaded standalone by
+``tools/race_check.py`` exactly like ``ast_lint``.
+
+The model: every named lock in the corpus gets a stable identity —
+module-level ``NAME = threading.Lock()`` becomes ``mod.py::NAME``,
+``self.NAME = threading.Lock()`` (or TracedLock/RLock/Condition) inside
+class ``C`` becomes ``C.NAME``. Each function is walked with a
+held-locks stack (``with lock:`` spans, plus coarse ``.acquire()``/
+``.release()`` pairs); what a function *may* acquire is propagated
+through a heuristically-resolved call graph (self-methods through the
+class and its corpus bases, bare names through the module, otherwise a
+globally-unique method name) to a fixpoint. From that:
+
+* CC401 lock-order-cycle — the same pair of locks observed in both
+  orders at two sites (directly or through calls).
+* CC402 blocking-call-under-lock — sleep / thread join / device_put /
+  block_until_ready / future result / event wait / queue.get / file IO
+  while at least one named lock is held (one call-graph level deep).
+  ``cond.wait()`` while holding ``cond`` itself is exempt.
+* CC403 lock-held-across-callback — a parameter / ``on_*`` /
+  ``*_callbacks`` / ``*hooks`` callable invoked with a lock held.
+* CC404 unguarded-shared-mutation — an attribute written under a lock
+  at one site and with no lock at another (outside __init__).
+
+CC405/CC406 are the runtime witness rules (``utils/locks.py``); this
+module only *audits* their JSON dumps (:func:`audit_witness`), so chaos
+drill artifacts can be checked offline by ``race_check --witness``.
+
+Heuristic by design — precision tuning happens through inline
+``# tpu-lint: disable=CC402`` suppressions and the checked-in
+``tools/race_check_baseline.json``.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+try:
+    from .findings import Finding, is_suppressed, parse_suppressions
+    from .ast_lint import iter_py_files, _dotted
+except ImportError:  # standalone import by tools/race_check.py
+    from findings import (Finding, is_suppressed,  # type: ignore
+                          parse_suppressions)
+    from ast_lint import iter_py_files, _dotted  # type: ignore
+
+__all__ = ["analyze_source", "analyze_sources", "analyze_paths",
+           "audit_witness", "audit_witness_paths"]
+
+# -- lock identification ------------------------------------------------------
+
+_LOCK_CTOR_LAST = {"Lock", "RLock", "TracedLock", "TracedRLock", "Condition"}
+_LOCKISH_RE = re.compile(r"lock|mutex|cond|sem", re.I)
+_THREADISH_RE = re.compile(r"thread|proc|worker", re.I)
+_EVENTISH_RE = re.compile(r"ev$|event|cond|done|ready|stop|barrier", re.I)
+_CB_RE = re.compile(r"^on_[a-z0-9_]*$|callbacks?$|hooks?$|_cb$|^cb$|"
+                    r"^callback$|^hook$")
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+#: dotted-call bases that are never corpus functions — unique-method-name
+#: resolution must not claim os.path.join for a repo method named 'join'
+_STDLIBISH = {"os", "sys", "time", "json", "np", "numpy", "jax", "jnp",
+              "threading", "queue", "queue_mod", "shutil", "pickle", "re",
+              "math", "random", "logging", "subprocess", "socket", "struct",
+              "collections", "itertools", "functools", "ast", "io", "ctypes",
+              "hashlib", "zlib", "tempfile", "warnings", "signal"}
+
+
+def _is_lock_ctor(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func)
+    return bool(name) and name.rsplit(".", 1)[-1] in _LOCK_CTOR_LAST
+
+
+def _blocking_op(node: ast.Call) -> Optional[str]:
+    """Dotted name of a blocking operation, or None."""
+    name = _dotted(node.func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    base = name.rsplit(".", 1)[0] if "." in name else ""
+    kwargs = {k.arg for k in node.keywords if k.arg}
+    if name in ("time.sleep", "sleep", "open", "os.fsync", "os.replace",
+                "json.dump", "pickle.dump"):
+        return name
+    if last in ("device_put", "block_until_ready"):
+        return name
+    if last == "result":
+        return name
+    if last == "join":
+        if "timeout" in kwargs or _THREADISH_RE.search(base):
+            return name
+        return None
+    if last == "wait":
+        if "timeout" in kwargs or _EVENTISH_RE.search(base):
+            return name
+        return None
+    if last == "get":
+        lb = base.rsplit(".", 1)[-1]
+        if lb == "q" or lb.endswith("_q") or "queue" in lb.lower():
+            return name
+        return None
+    return None
+
+
+# -- corpus model -------------------------------------------------------------
+
+class _ClassInfo:
+    __slots__ = ("name", "bases", "lock_attrs", "methods")
+
+    def __init__(self, name: str, bases: List[str]):
+        self.name = name
+        self.bases = bases
+        self.lock_attrs: Set[str] = set()     # attrs assigned a lock ctor
+        self.methods: Dict[str, str] = {}     # method name -> func qualname
+
+
+class _FuncInfo:
+    __slots__ = ("qualname", "modkey", "cls", "name", "lineno", "params",
+                 "acquires", "calls", "blocking", "blocking_direct",
+                 "callback_calls", "attr_writes")
+
+    def __init__(self, qualname, modkey, cls, name, lineno, params):
+        self.qualname = qualname
+        self.modkey = modkey
+        self.cls = cls                        # _ClassInfo or None
+        self.name = name
+        self.lineno = lineno
+        self.params = params                  # set of parameter names
+        #: (lock_id, line, held_tuple)
+        self.acquires: List[Tuple[str, int, tuple]] = []
+        #: (callee_dotted, line, held_tuple, is_self_call)
+        self.calls: List[Tuple[str, int, tuple, bool]] = []
+        #: (op_name, line, held_tuple) — held nonempty
+        self.blocking: List[Tuple[str, int, tuple]] = []
+        #: blocking op names anywhere in the body (for 1-level propagation)
+        self.blocking_direct: Set[str] = set()
+        #: (callee_text, line, held_tuple) — held nonempty
+        self.callback_calls: List[Tuple[str, int, tuple]] = []
+        #: (attr, line, held_tuple) — self.attr stores
+        self.attr_writes: List[Tuple[str, int, tuple]] = []
+
+
+class _Corpus:
+    def __init__(self):
+        self.sources: Dict[str, str] = {}
+        self.lines: Dict[str, List[str]] = {}
+        self.module_locks: Dict[str, Set[str]] = {}      # modkey -> names
+        self.classes: Dict[str, _ClassInfo] = {}         # class name -> info
+        self.functions: Dict[str, _FuncInfo] = {}        # qualname -> info
+        self.mod_funcs: Dict[Tuple[str, str], str] = {}  # (modkey,nm)->qn
+        self.by_name: Dict[str, List[str]] = {}          # nm -> [qualnames]
+
+    def line_text(self, modkey: str, ln: int) -> str:
+        lines = self.lines.get(modkey, ())
+        return lines[ln - 1] if 0 < ln <= len(lines) else ""
+
+    def class_lock_attr(self, cls: Optional[_ClassInfo],
+                        attr: str) -> Optional[str]:
+        """Resolve self.<attr> to the defining class's lock id, walking
+        corpus bases."""
+        seen = set()
+        stack = [cls] if cls is not None else []
+        while stack:
+            c = stack.pop()
+            if c is None or c.name in seen:
+                continue
+            seen.add(c.name)
+            if attr in c.lock_attrs:
+                return f"{c.name}.{attr}"
+            stack.extend(self.classes.get(b) for b in c.bases)
+        return None
+
+    def resolve_call(self, info: _FuncInfo, callee: str,
+                     is_self: bool) -> Optional[str]:
+        last = callee.rsplit(".", 1)[-1]
+        if callee.split(".", 1)[0] in _STDLIBISH:
+            return None
+        if is_self and info.cls is not None:
+            seen: Set[str] = set()
+            stack = [info.cls]
+            while stack:
+                c = stack.pop()
+                if c is None or c.name in seen:
+                    continue
+                seen.add(c.name)
+                if last in c.methods:
+                    return c.methods[last]
+                stack.extend(self.classes.get(b) for b in c.bases)
+            return None
+        if "." not in callee:
+            qn = self.mod_funcs.get((info.modkey, callee))
+            if qn:
+                return qn
+        cands = self.by_name.get(last, ())
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+
+# -- per-function walker ------------------------------------------------------
+
+class _BodyWalker(ast.NodeVisitor):
+    """Walks one function body tracking the held-locks stack."""
+
+    def __init__(self, corpus: _Corpus, info: _FuncInfo):
+        self.corpus = corpus
+        self.info = info
+        self.held: List[Tuple[str, int]] = []   # (lock_id, acquire line)
+        self.local_locks: Set[str] = set()      # local vars bound to locks
+        self.cb_vars: Set[str] = set()          # loop vars over callbacks
+
+    # -- lock-expression resolution ---------------------------------------
+    def _lock_id(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.corpus.module_locks.get(self.info.modkey, ()):
+                return f"{self.info.modkey}::{expr.id}"
+            if expr.id in self.local_locks:
+                return f"{self.info.qualname}::{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = _dotted(expr.value)
+            attr = expr.attr
+            if base == "self":
+                rid = self.corpus.class_lock_attr(self.info.cls, attr)
+                if rid:
+                    return rid
+                if _LOCKISH_RE.search(attr):
+                    cname = self.info.cls.name if self.info.cls else "?"
+                    return f"{cname}.{attr}"
+                return None
+            # non-self attribute: unique defining class, else lockish name
+            owners = [c.name for c in self.corpus.classes.values()
+                      if attr in c.lock_attrs]
+            if len(owners) == 1:
+                return f"{owners[0]}.{attr}"
+            if _LOCKISH_RE.search(attr):
+                return f"*.{attr}"
+        return None
+
+    def _held_ids(self) -> tuple:
+        return tuple(h[0] for h in self.held)
+
+    # -- visitors ----------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        pushed = 0
+        for item in node.items:
+            lid = self._lock_id(item.context_expr)
+            if lid is not None:
+                self.info.acquires.append(
+                    (lid, item.context_expr.lineno, self._held_ids()))
+                self.held.append((lid, item.context_expr.lineno))
+                pushed += 1
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_For(self, node: ast.For):
+        it = _dotted(node.iter)
+        if it and _CB_RE.search(it.rsplit(".", 1)[-1]):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    self.cb_vars.add(n.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        if _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.local_locks.add(t.id)
+        self._note_attr_writes(node.targets, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._note_attr_writes([node.target], node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._note_attr_writes([node.target], node.lineno)
+        self.generic_visit(node)
+
+    def _note_attr_writes(self, targets, line: int):
+        for t in targets:
+            for n in ast.walk(t):
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, (ast.Store,))
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"
+                        and not _LOCKISH_RE.search(n.attr)):
+                    self.info.attr_writes.append(
+                        (n.attr, line, self._held_ids()))
+
+    def visit_Call(self, node: ast.Call):
+        held = self._held_ids()
+        name = _dotted(node.func)
+        if name:
+            last = name.rsplit(".", 1)[-1]
+            base = name.rsplit(".", 1)[0] if "." in name else ""
+            # explicit .acquire(): coarse — held until .release() or end
+            if last == "acquire" and isinstance(node.func, ast.Attribute):
+                lid = self._lock_id(node.func.value)
+                if lid is not None:
+                    self.info.acquires.append((lid, node.lineno, held))
+                    self.held.append((lid, node.lineno))
+            elif last == "release" and isinstance(node.func, ast.Attribute):
+                lid = self._lock_id(node.func.value)
+                if lid is not None:
+                    for i in range(len(self.held) - 1, -1, -1):
+                        if self.held[i][0] == lid:
+                            del self.held[i]
+                            break
+            op = _blocking_op(node)
+            if op is not None:
+                self.info.blocking_direct.add(op)
+                if held:
+                    # cond.wait() while holding cond itself is the normal
+                    # condition-variable protocol, not a CC402
+                    base_lid = (self._lock_id(node.func.value)
+                                if isinstance(node.func, ast.Attribute)
+                                else None)
+                    if base_lid is None or base_lid not in held:
+                        self.info.blocking.append((op, node.lineno, held))
+            if held and self._is_callback(node):
+                self.info.callback_calls.append((name, node.lineno, held))
+            is_self = name.startswith("self.")
+            if last not in ("acquire", "release"):
+                self.info.calls.append((name, node.lineno, held, is_self))
+        self.generic_visit(node)
+
+    def _is_callback(self, node: ast.Call) -> bool:
+        func = node.func
+        if isinstance(func, ast.Name):
+            nm = func.id
+            if nm in self.cb_vars:
+                return True
+            return nm in self.info.params and bool(_CB_RE.search(nm))
+        if isinstance(func, ast.Attribute):
+            return bool(_CB_RE.search(func.attr))
+        return False
+
+
+# -- corpus construction ------------------------------------------------------
+
+def _collect_module(corpus: _Corpus, modkey: str, tree: ast.Module):
+    corpus.module_locks.setdefault(modkey, set())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    corpus.module_locks[modkey].add(t.id)
+
+    def reg_func(fn, cls: Optional[_ClassInfo]):
+        qual = (f"{modkey}::{cls.name}.{fn.name}" if cls
+                else f"{modkey}::{fn.name}")
+        a = fn.args
+        params = {p.arg for p in (list(a.posonlyargs) + list(a.args)
+                                  + list(a.kwonlyargs))} - {"self", "cls"}
+        info = _FuncInfo(qual, modkey, cls, fn.name, fn.lineno, params)
+        corpus.functions[qual] = info
+        corpus.by_name.setdefault(fn.name, []).append(qual)
+        if cls is None:
+            corpus.mod_funcs[(modkey, fn.name)] = qual
+        else:
+            cls.methods.setdefault(fn.name, qual)
+        return info
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            reg_func(node, None)
+        elif isinstance(node, ast.ClassDef):
+            cinfo = corpus.classes.setdefault(
+                node.name,
+                _ClassInfo(node.name, [_dotted(b).rsplit(".", 1)[-1]
+                                       for b in node.bases if _dotted(b)]))
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    reg_func(sub, cinfo)
+                elif isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            cinfo.lock_attrs.add(t.id)
+            # self.X = Lock() anywhere in the class body
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Assign)
+                        and _is_lock_ctor(sub.value)):
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            cinfo.lock_attrs.add(t.attr)
+
+
+def _walk_functions(corpus: _Corpus, modkey: str, tree: ast.Module):
+    def run(fn, cls):
+        qual = (f"{modkey}::{cls.name}.{fn.name}" if cls
+                else f"{modkey}::{fn.name}")
+        info = corpus.functions.get(qual)
+        if info is None:
+            return
+        walker = _BodyWalker(corpus, info)
+        for stmt in fn.body:
+            walker.visit(stmt)
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            run(node, None)
+        elif isinstance(node, ast.ClassDef):
+            cinfo = corpus.classes.get(node.name)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    run(sub, cinfo)
+
+
+def _named(lock_id: str) -> bool:
+    """Module/class-level locks participate in cross-site analysis;
+    function-local locks (``qualname::var``, two ``::``) are per-call
+    and do not."""
+    parts = lock_id.split("::")
+    if len(parts) == 1:
+        return True                       # class-attr lock: "C.attr"
+    return len(parts) == 2 and parts[0].endswith(".py")
+
+
+# -- the CC401..CC404 analyses ------------------------------------------------
+
+def _fixpoint_acquires(corpus: _Corpus) -> Dict[str, Set[str]]:
+    may: Dict[str, Set[str]] = {
+        qn: {a[0] for a in f.acquires if _named(a[0])}
+        for qn, f in corpus.functions.items()}
+    for _ in range(24):
+        changed = False
+        for qn, f in corpus.functions.items():
+            for callee, _, _, is_self in f.calls:
+                target = corpus.resolve_call(f, callee, is_self)
+                if target is None:
+                    continue
+                add = may.get(target, set()) - may[qn]
+                if add:
+                    may[qn] |= add
+                    changed = True
+        if not changed:
+            break
+    return may
+
+
+def _analyze_corpus(corpus: _Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    may = _fixpoint_acquires(corpus)
+
+    def modkey_of(qn: str) -> str:
+        return qn.split("::", 1)[0]
+
+    def emit(rule, modkey, line, message, **extra):
+        findings.append(Finding(
+            rule=rule, message=message, file=modkey, line=line,
+            source_line=corpus.line_text(modkey, line),
+            extra=extra or {}))
+
+    # -- edge collection for CC401 ---------------------------------------
+    #: (held, acquired) -> list of (modkey, line, via)
+    edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+
+    def add_edge(a, b, modkey, line, via=""):
+        if a == b:
+            return
+        sites = edges.setdefault((a, b), [])
+        if len(sites) < 8:
+            sites.append((modkey, line, via))
+
+    for qn, f in corpus.functions.items():
+        mk = modkey_of(qn)
+        for lid, line, held in f.acquires:
+            if not _named(lid):
+                continue
+            for h in held:
+                if _named(h):
+                    add_edge(h, lid, mk, line)
+        for callee, line, held, is_self in f.calls:
+            if not held:
+                continue
+            target = corpus.resolve_call(f, callee, is_self)
+            if target is None:
+                continue
+            for lid in may.get(target, ()):
+                if lid in held:
+                    continue
+                for h in held:
+                    if _named(h):
+                        add_edge(h, lid, mk, line, via=callee)
+
+    reported_pairs: Set[Tuple[str, str]] = set()
+    for (a, b), sites in sorted(edges.items()):
+        if (b, a) not in edges:
+            continue
+        pair = tuple(sorted((a, b)))
+        if pair in reported_pairs:
+            continue
+        reported_pairs.add(pair)
+        for (mk, line, via), (ra, rb) in (
+                (sites[0], (a, b)), (edges[(b, a)][0], (b, a))):
+            omk, oline, ovia = (edges[(rb, ra)][0])
+            via_txt = f" (via {via})" if via else ""
+            emit("CC401", mk, line,
+                 f"lock order cycle: '{rb}' acquired while holding "
+                 f"'{ra}'{via_txt}, but the opposite order is taken at "
+                 f"{omk}:{oline}" + (f" (via {ovia})" if ovia else ""),
+                 locks=list(pair))
+
+    # -- CC402: blocking under lock (direct + one call level) ------------
+    for qn, f in corpus.functions.items():
+        mk = modkey_of(qn)
+        for op, line, held in f.blocking:
+            if not any(_named(h) for h in held):
+                continue
+            emit("CC402", mk, line,
+                 f"blocking call '{op}' while holding "
+                 f"{', '.join(repr(h) for h in held if _named(h))} — "
+                 "every contender stalls for the full blocking latency",
+                 op=op, locks=[h for h in held if _named(h)])
+        for callee, line, held, is_self in f.calls:
+            if not any(_named(h) for h in held):
+                continue
+            target = corpus.resolve_call(f, callee, is_self)
+            if target is None or target == qn:
+                continue
+            t = corpus.functions[target]
+            if t.blocking_direct:
+                ops = ", ".join(sorted(t.blocking_direct))
+                emit("CC402", mk, line,
+                     f"call to '{callee}' under "
+                     f"{', '.join(repr(h) for h in held if _named(h))} "
+                     f"performs blocking op(s): {ops}",
+                     op=ops, via=callee,
+                     locks=[h for h in held if _named(h)])
+
+    # -- CC403: callback invoked under lock ------------------------------
+    for qn, f in corpus.functions.items():
+        mk = modkey_of(qn)
+        for callee, line, held in f.callback_calls:
+            named_held = [h for h in held if _named(h)]
+            if not named_held:
+                continue
+            emit("CC403", mk, line,
+                 f"callback '{callee}' invoked while holding "
+                 f"{', '.join(repr(h) for h in named_held)} — it can "
+                 "re-enter the owner or block arbitrarily long",
+                 callback=callee, locks=named_held)
+
+    # -- CC404: unguarded shared mutation --------------------------------
+    #: (class, attr) -> {"guarded": [...], "bare": [...]}
+    writes: Dict[Tuple[str, str], Dict[str, list]] = {}
+    for qn, f in corpus.functions.items():
+        if f.cls is None:
+            continue
+        mk = modkey_of(qn)
+        for attr, line, held in f.attr_writes:
+            rec = writes.setdefault((f.cls.name, attr),
+                                    {"guarded": [], "bare": []})
+            if any(_named(h) for h in held):
+                rec["guarded"].append((mk, line, qn))
+            elif f.name not in _INIT_METHODS:
+                rec["bare"].append((mk, line, qn, f.name))
+    for (cname, attr), rec in sorted(writes.items()):
+        if not rec["guarded"] or not rec["bare"]:
+            continue
+        gmk, gline, _ = rec["guarded"][0]
+        for mk, line, qn, meth in rec["bare"]:
+            emit("CC404", mk, line,
+                 f"'self.{attr}' written without a lock in "
+                 f"{cname}.{meth}, but lock-guarded at {gmk}:{gline} — "
+                 "the guard is advisory unless every mutation takes it",
+                 attr=f"{cname}.{attr}")
+
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# -- public API ---------------------------------------------------------------
+
+def analyze_sources(sources: Dict[str, str],
+                    apply_suppressions: bool = True) -> List[Finding]:
+    """Analyze a corpus given as {path: source}. Cross-module rules see
+    the whole dict at once."""
+    corpus = _Corpus()
+    trees: Dict[str, ast.Module] = {}
+    findings: List[Finding] = []
+    for path, src in sources.items():
+        corpus.sources[path] = src
+        corpus.lines[path] = src.splitlines()
+        try:
+            trees[path] = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="CC402", severity="error",
+                message=f"syntax error: {e.msg}", file=path,
+                line=e.lineno or 0))
+    for path, tree in trees.items():
+        _collect_module(corpus, path, tree)
+    for path, tree in trees.items():
+        _walk_functions(corpus, path, tree)
+    findings.extend(_analyze_corpus(corpus))
+    if apply_suppressions:
+        supp = {p: parse_suppressions(s) for p, s in sources.items()}
+        kept = []
+        for f in findings:
+            per_line, file_wide = supp.get(f.file, ({}, set()))
+            if not is_suppressed(f, per_line, file_wide):
+                kept.append(f)
+        findings = kept
+    return findings
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   apply_suppressions: bool = True) -> List[Finding]:
+    return analyze_sources({path: source},
+                           apply_suppressions=apply_suppressions)
+
+
+def analyze_paths(paths: Iterable[str],
+                  root: Optional[str] = None) -> List[Finding]:
+    sources: Dict[str, str] = {}
+    for p in iter_py_files(paths):
+        rel = os.path.relpath(p, root).replace(os.sep, "/") if root else p
+        with open(p, encoding="utf-8", errors="replace") as fh:
+            sources[rel] = fh.read()
+    return analyze_sources(sources)
+
+
+# -- witness-dump audit (CC405/CC406 offline) ---------------------------------
+
+def audit_witness(data: dict, path: str = "<witness>") -> List[Finding]:
+    """Findings from a ``dump_witness()`` JSON artifact: recorded runtime
+    findings pass through; order inversions and over-budget sites are
+    re-derived from the raw edges/site stats as a consistency net."""
+    findings: List[Finding] = []
+    recorded_pairs: Set[tuple] = set()
+    recorded_406: Set[tuple] = set()
+    for f in data.get("findings", ()):
+        findings.append(Finding(
+            rule=f.get("rule", "CC405"), message=f.get("message", ""),
+            file=f.get("file", path), line=int(f.get("line", 0) or 0),
+            source_line=f.get("site", ""),
+            extra={"witness": path}))
+        if f.get("rule") == "CC405" and f.get("locks"):
+            recorded_pairs.add(tuple(sorted(f["locks"])))
+        if f.get("rule") == "CC406":
+            recorded_406.add((f.get("site", ""), f.get("kind", "")))
+
+    edges = {(e["from"], e["to"]): e for e in data.get("edges", ())}
+    for (a, b) in sorted(edges):
+        if a >= b or (b, a) not in edges:
+            continue
+        pair = (a, b)
+        if pair in recorded_pairs:
+            continue
+        e1, e2 = edges[(a, b)], edges[(b, a)]
+        findings.append(Finding(
+            rule="CC405",
+            message=f"witnessed order inversion: '{b}' after '{a}' at "
+                    f"{e1['site']} but '{a}' after '{b}' at {e2['site']}",
+            file=path, line=0, source_line=e1["site"],
+            extra={"locks": list(pair), "witness": path}))
+
+    budget_s = float(data.get("budget_ms", 200.0)) / 1000.0
+    for key, stats in sorted((data.get("sites") or {}).items()):
+        lock, _, site = key.partition("@")
+        for kind in ("hold", "wait"):
+            st = stats.get(kind) or {}
+            if st.get("max", 0.0) > budget_s and (
+                    (site, kind) not in recorded_406):
+                findings.append(Finding(
+                    rule="CC406",
+                    message=f"lock '{lock}' {kind} max "
+                            f"{st['max'] * 1e3:.1f}ms at {site} exceeds "
+                            f"the {budget_s * 1e3:.0f}ms budget",
+                    file=path, line=0, source_line=site,
+                    extra={"lock": lock, "kind": kind, "witness": path}))
+    return findings
+
+
+def audit_witness_paths(paths: Iterable[str]) -> List[Finding]:
+    """Audit one or more witness dumps; directories are scanned for
+    ``witness_*.json``."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.startswith("witness") and f.endswith(".json")))
+        else:
+            files.append(p)
+    findings: List[Finding] = []
+    for f in files:
+        try:
+            with open(f) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError) as e:
+            findings.append(Finding(
+                rule="CC405", severity="error",
+                message=f"unreadable witness dump: {e}", file=f, line=0))
+            continue
+        findings.extend(audit_witness(data, path=f))
+    return findings

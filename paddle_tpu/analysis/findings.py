@@ -98,6 +98,36 @@ RULES: Dict[str, dict] = {
                       "dies at an alias-eligible op without being "
                       "donated, or a sibling remat/sharding variant at "
                       "the same batch fits the budget."),
+    "CC401": dict(severity=ERROR, name="lock-order-cycle",
+                  doc="two sites acquire the same pair of locks in "
+                      "opposite order (propagated through the call "
+                      "graph) — the classic ABBA deadlock; pick one "
+                      "canonical order and stick to it."),
+    "CC402": dict(severity=WARNING, name="blocking-call-under-lock",
+                  doc="a blocking operation (device_put / thread join / "
+                      "sleep / file IO / queue.get) runs while a lock is "
+                      "held; every other thread contending on that lock "
+                      "stalls for the full blocking latency."),
+    "CC403": dict(severity=WARNING, name="lock-held-across-callback",
+                  doc="a user/chaos callback is invoked with a private "
+                      "lock held; the callback can re-enter the owning "
+                      "object (self-deadlock) or block arbitrarily long "
+                      "while holding it."),
+    "CC404": dict(severity=WARNING, name="unguarded-shared-mutation",
+                  doc="an attribute is written under a lock at some "
+                      "sites but mutated with no lock held at another "
+                      "(outside __init__) — the guard is advisory, not "
+                      "a guarantee."),
+    "CC405": dict(severity=ERROR, name="witnessed-order-inversion",
+                  doc="the runtime lock-order witness observed the same "
+                      "pair of TracedLocks acquired in both orders "
+                      "(PADDLE_LOCK_WITNESS=1): a real interleaving away "
+                      "from deadlock, not a static may-alias guess."),
+    "CC406": dict(severity=WARNING, name="lock-hold-over-budget",
+                  doc="a TracedLock was held (or waited on) longer than "
+                      "the hold budget (PADDLE_LOCK_BUDGET_MS); hot-path "
+                      "sections must stay microseconds — move the slow "
+                      "work outside the critical section."),
 }
 
 

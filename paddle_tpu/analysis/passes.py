@@ -94,3 +94,44 @@ def check_hbm_footprint(program):
     budget = os.environ.get("PADDLE_HBM_GIB")
     return memory_mod.check_hbm(
         program, budget_gib=float(budget) if budget else None)
+
+
+# -- concurrency (CC4xx) ------------------------------------------------------
+# Registered in the pass registry but deliberately NOT in
+# DIAGNOSTIC_PASS_NAMES: the lock passes look at the repo source tree /
+# process-wide witness state, not the traced program, so running them on
+# every analyze() call would make unrelated program diagnostics depend on
+# ambient thread activity. Invoke explicitly (or use tools/race_check.py).
+
+@functools.lru_cache(maxsize=1)
+def _repo_lock_findings():
+    import os as _os
+    from . import concurrency as concurrency_mod
+    root = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    roots = [p for p in (_os.path.join(root, "paddle_tpu"),
+                         _os.path.join(root, "tools"))
+             if _os.path.isdir(p)]
+    return tuple(concurrency_mod.analyze_paths(roots, root=root))
+
+
+def check_lock_discipline(program=None):
+    """CC401–CC404 over the repo source tree (cached — the tree does not
+    change mid-process). The ``program`` argument is accepted and ignored
+    so the pass fits the registry's call shape."""
+    findings = list(_repo_lock_findings())
+    record_findings(findings, source="check_lock_discipline")
+    return findings
+
+
+def check_lock_witness(program=None):
+    """CC405/CC406 accumulated by the runtime lock witness in THIS
+    process (empty when ``PADDLE_LOCK_WITNESS`` is off)."""
+    from ..utils.locks import witness_findings
+    findings = witness_findings()
+    record_findings(findings, source="check_lock_witness")
+    return findings
+
+
+register_pass("check_lock_discipline", analysis=True)(check_lock_discipline)
+register_pass("check_lock_witness", analysis=True)(check_lock_witness)

@@ -212,7 +212,11 @@ class CollectiveWatchdog:
                     self._enter_ts = time.time()  # re-arm, don't spam
                 return None
         if report is not None:
-            self._poison = report
+            # CC404: reset()/enter() read-and-clear _poison under _lock
+            # from the app thread; this runs on the watchdog thread — a
+            # bare write here can resurrect a report reset() just cleared.
+            with self._lock:
+                self._poison = report
             self.on_desync(report)
         return report
 

@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ...observability.metrics import Histogram, get_registry
 from ...resilience.remediator import FlapGuard
+from ...utils.locks import TracedLock
 
 __all__ = ["Autoscaler"]
 
@@ -64,6 +65,12 @@ class Autoscaler:
         self.flap_guard = flap_guard or FlapGuard(clock=clock)
         self._clock = clock
         self._reg = get_registry()
+        # tick-state lock: guards the pressure streaks and cooldown stamp
+        # against off-thread observers. Never held across scale_up/
+        # scale_down (they call into the gateway pool, which may take
+        # Gateway._admit) — the only cross-object lock order is
+        # Autoscaler._tick -> Gateway._admit.
+        self._tick_lock = TracedLock("Autoscaler._tick")
         self._up_streak = 0
         self._down_streak = 0
         self._last_action_t = -float("inf")
@@ -113,44 +120,49 @@ class Autoscaler:
         self._size_g.set(len(routable))
         depth = len(self.gw._queue)
         ttft_hot = self._ttft_pressure()
-        if depth >= self.queue_high or ttft_hot:
-            self._up_streak += 1
-            self._down_streak = 0
-        elif depth <= self.queue_low and all(
-                r.free_slots > 0 for r in routable):
-            self._down_streak += 1
-            self._up_streak = 0
-        else:
-            self._up_streak = 0
-            self._down_streak = 0
-        if now - self._last_action_t < self.cooldown_s:
-            return None
+        with self._tick_lock:
+            if depth >= self.queue_high or ttft_hot:
+                self._up_streak += 1
+                self._down_streak = 0
+            elif depth <= self.queue_low and all(
+                    r.free_slots > 0 for r in routable):
+                self._down_streak += 1
+                self._up_streak = 0
+            else:
+                self._up_streak = 0
+                self._down_streak = 0
+            if now - self._last_action_t < self.cooldown_s:
+                return None
         if self._up_streak >= self.hysteresis \
                 and len(routable) < self.max_replicas:
             ok, why = self.flap_guard.check(now)
             if not ok:
                 self._journal("scale_up", "", why, now,
                               depth=depth, ttft_hot=int(ttft_hot))
-                self._up_streak = 0
+                with self._tick_lock:
+                    self._up_streak = 0
                 return None
             name = self.scale_up(
                 reason="queue" if depth >= self.queue_high else "ttft",
                 now=now)
             if name is not None:
                 self.flap_guard.record(now)
-                self._up_streak = 0
+                with self._tick_lock:
+                    self._up_streak = 0
                 return f"scale_up:{name}"
         if self._down_streak >= self.hysteresis \
                 and len(routable) > self.min_replicas:
             ok, why = self.flap_guard.check(now)
             if not ok:
                 self._journal("scale_down", "", why, now, depth=depth)
-                self._down_streak = 0
+                with self._tick_lock:
+                    self._down_streak = 0
                 return None
             name = self.scale_down(reason="idle", now=now)
             if name is not None:
                 self.flap_guard.record(now)
-                self._down_streak = 0
+                with self._tick_lock:
+                    self._down_streak = 0
                 return f"scale_down:{name}"
         return None
 

@@ -175,6 +175,14 @@ class Gateway:
         self.quotas = (quotas if isinstance(quotas, TenantQuotas)
                        else TenantQuotas(quotas))
         self._queue = DispatchQueue(low_share=low_share)
+        # admit lock: serializes off-thread submitters (an RPC frontend)
+        # against the control loop's dispatch/expire queue harvest.
+        # Held only for queue/bookkeeping spans — never across a replica
+        # step or a batcher submit (those block on device work; see
+        # CC402). Lock order when nested elsewhere is always
+        # Gateway._admit -> Batcher._intake, never the reverse.
+        from ...utils.locks import TracedRLock
+        self._admit = TracedRLock("Gateway._admit")
         self._max_queue_depth = max_queue_depth
         self._default_deadline_s = default_deadline_s
         self.max_request_attempts = max_request_attempts
@@ -269,44 +277,46 @@ class Gateway:
             raise Overloaded(
                 f"tenant {tenant!r} quota exhausted "
                 f"(cost {cost} tokens)")
-        if self._max_queue_depth is not None \
-                and len(self._queue) >= self._max_queue_depth:
-            self._tele.shed += 1
-            self._tele.shed_c.inc()
-            raise Overloaded(
-                f"gateway queue at capacity "
-                f"({len(self._queue)}/{self._max_queue_depth})")
-        budget = deadline_s if deadline_s is not None \
-            else self._default_deadline_s
-        if budget is not None and not self._feasible(max_new_tokens,
-                                                     budget):
-            self._tele.infeasible += 1
-            self._tele.infeasible_c.inc()
-            raise DeadlineExceeded(
-                f"deadline {budget:.3f}s infeasible for "
-                f"{max_new_tokens} tokens at the current latency "
-                f"estimate")
-        now = _time.perf_counter()
-        gid = self._next_gid
-        self._next_gid += 1
-        req = GatewayRequest(
-            gid=gid, tenant=tenant, prompt=prompt,
-            max_new_tokens=max_new_tokens, priority=pr,
-            session_id=session_id,
-            bucket=(self._ladder.bucket(len(prompt))
-                    if self._ladder is not None else None),
-            submit_t=now,
-            deadline_t=None if budget is None else now + budget)
-        if _trace.enabled():
-            # one trace per request, minted HERE: every downstream span
-            # (queue/admit/prefill/decode/stream) shares this trace_id,
-            # including after a requeue off a dead replica
-            req.trace = _trace.new_trace("gateway.request", gid=gid,
-                                         tenant=tenant, rung=req.bucket)
-            req.spans["queue"] = req.trace.begin("queue",
-                                                 priority=req.priority)
-        self._requests[gid] = req
-        self._queue.push(req)
+        with self._admit:
+            if self._max_queue_depth is not None \
+                    and len(self._queue) >= self._max_queue_depth:
+                self._tele.shed += 1
+                self._tele.shed_c.inc()
+                raise Overloaded(
+                    f"gateway queue at capacity "
+                    f"({len(self._queue)}/{self._max_queue_depth})")
+            budget = deadline_s if deadline_s is not None \
+                else self._default_deadline_s
+            if budget is not None and not self._feasible(max_new_tokens,
+                                                         budget):
+                self._tele.infeasible += 1
+                self._tele.infeasible_c.inc()
+                raise DeadlineExceeded(
+                    f"deadline {budget:.3f}s infeasible for "
+                    f"{max_new_tokens} tokens at the current latency "
+                    f"estimate")
+            now = _time.perf_counter()
+            gid = self._next_gid
+            self._next_gid += 1
+            req = GatewayRequest(
+                gid=gid, tenant=tenant, prompt=prompt,
+                max_new_tokens=max_new_tokens, priority=pr,
+                session_id=session_id,
+                bucket=(self._ladder.bucket(len(prompt))
+                        if self._ladder is not None else None),
+                submit_t=now,
+                deadline_t=None if budget is None else now + budget)
+            if _trace.enabled():
+                # one trace per request, minted HERE: every downstream
+                # span (queue/admit/prefill/decode/stream) shares this
+                # trace_id, including after a requeue off a dead replica
+                req.trace = _trace.new_trace("gateway.request", gid=gid,
+                                             tenant=tenant,
+                                             rung=req.bucket)
+                req.spans["queue"] = req.trace.begin(
+                    "queue", priority=req.priority)
+            self._requests[gid] = req
+            self._queue.push(req)
         self._tele.requests += 1
         self._tele.requests_c.inc()
         self._tele.queue_depth_g.set(len(self._queue))
@@ -357,10 +367,13 @@ class Gateway:
 
     def _expire_queued(self):
         now = _time.perf_counter()
-        for req in [r for r in self._requests.values()
-                    if r.replica is None and r.deadline_t is not None
-                    and now > r.deadline_t]:
-            self._queue.remove(req)
+        with self._admit:
+            expired = [r for r in self._requests.values()
+                       if r.replica is None and r.deadline_t is not None
+                       and now > r.deadline_t]
+            for req in expired:
+                self._queue.remove(req)
+        for req in expired:
             self._fail(req, DeadlineExceeded(
                 f"request {req.gid} expired in the gateway queue"))
 
@@ -373,28 +386,37 @@ class Gateway:
             # batched decode can't pause one slot); decode continues
             _stream_backpressure()
             return
-        while len(self._queue):
-            req = self._queue.peek()
-            need = len(req.prompt) + len(req.delivered) + req.remaining
-            cands = [r for r in self.pool.routable()
-                     if r.free_slots > 0 and need <= r.batcher.s_max]
-            if not cands:
-                break
-            rep = self.router.select(req, cands)
-            self._queue.pop()
+        while True:
+            # queue inspection + pop under the admit lock; the actual
+            # assignment (which enters the replica batcher's submit and
+            # may do real work) runs with it released
+            with self._admit:
+                if not len(self._queue):
+                    break
+                req = self._queue.peek()
+                need = (len(req.prompt) + len(req.delivered)
+                        + req.remaining)
+                cands = [r for r in self.pool.routable()
+                         if r.free_slots > 0 and need <= r.batcher.s_max]
+                if not cands:
+                    break
+                rep = self.router.select(req, cands)
+                self._queue.pop()
             try:
                 self._assign(req, rep)
             except Overloaded:
                 # replica-side queue rejected it after our capacity
                 # check (a tiny batcher max_queue_depth): keep it ours
-                self._queue.push_front(req)
+                with self._admit:
+                    self._queue.push_front(req)
                 break
 
     def _assign(self, req: GatewayRequest, rep: Replica):
         now = _time.perf_counter()
         budget = None if req.deadline_t is None else req.deadline_t - now
         if budget is not None and budget <= 0:
-            self._queue.remove(req)
+            with self._admit:
+                self._queue.remove(req)
             self._fail(req, DeadlineExceeded(
                 f"request {req.gid} expired before dispatch"))
             return
@@ -470,7 +492,8 @@ class Gateway:
                     f"{self.max_request_attempts} dispatch attempts "
                     f"(replicas kept dying under it)"))
                 continue
-            self._queue.push_front(req)
+            with self._admit:
+                self._queue.push_front(req)
             if req.trace is not None:
                 req.spans["queue"] = req.trace.begin("queue",
                                                      priority=req.priority)

@@ -213,7 +213,16 @@ class _BatcherBase:
         self._pending: List[Request] = []
         self._finished: Dict[int, Request] = {}
         self._failed: Dict[int, Exception] = {}
-        self._next_rid = 0
+        self._next_rid = 0  # tpu-lint: disable=CC404 (ctor-time init)
+        # intake lock: serializes submit-side producers (a fronting RPC
+        # layer may call submit/cancel off-thread) against the step
+        # loop's queue harvest. Slot/device/cache state stays step-loop-
+        # owned and is deliberately NOT under this lock — holding it
+        # across prefill/decode would block every submitter for a full
+        # device dispatch (CC402). Reentrant: submit and the step loop
+        # both nest _expire_pending.
+        from ..utils.locks import TracedRLock
+        self._intake = TracedRLock("Batcher._intake")
         self._max_queue_depth = max_queue_depth
         self._default_deadline_s = default_deadline_s
         # serving observability (reference analog: the predictor's
@@ -289,24 +298,32 @@ class _BatcherBase:
         # check: a dead-on-arrival queue entry must not cause a shed
         # (shed and deadline_expired stay disjoint per request)
         self._expire_pending()
-        if self._max_queue_depth is not None \
-                and len(self._pending) >= self._max_queue_depth:
+        shed_depth = None
+        with self._intake:
+            if self._max_queue_depth is not None \
+                    and len(self._pending) >= self._max_queue_depth:
+                shed_depth = len(self._pending)
+            else:
+                rid = self._next_rid
+                self._next_rid += 1
+                budget = deadline_s if deadline_s is not None \
+                    else self._default_deadline_s
+                now = _time.perf_counter()
+                self._pending.append(Request(
+                    rid, prompt, max_new_tokens, submit_t=now,
+                    deadline_t=None if budget is None else now + budget,
+                    trace=trace))
+                depth = len(self._pending)
+        # telemetry/health callbacks run OUTSIDE _intake (CC403): they
+        # can re-enter the batcher or block on an exporter.
+        if shed_depth is not None:
             from ..resilience.recovery import Overloaded
             self._tele.on_shed()
             self.health.on_shed()
             raise Overloaded(
                 f"pending queue at capacity "
-                f"({len(self._pending)}/{self._max_queue_depth})")
-        rid = self._next_rid
-        self._next_rid += 1
-        budget = deadline_s if deadline_s is not None \
-            else self._default_deadline_s
-        now = _time.perf_counter()
-        self._pending.append(Request(
-            rid, prompt, max_new_tokens, submit_t=now,
-            deadline_t=None if budget is None else now + budget,
-            trace=trace))
-        self._tele.on_submit(len(self._pending))
+                f"({shed_depth}/{self._max_queue_depth})")
+        self._tele.on_submit(depth)
         return rid
 
     # -- request-trace hooks (observability.trace_context) -------------------
@@ -374,9 +391,14 @@ class _BatcherBase:
         pushing a live request into a shed."""
         from ..resilience.recovery import DeadlineExceeded
         now = _time.perf_counter()
-        for req in [r for r in self._pending
-                    if r.deadline_t is not None and now > r.deadline_t]:
-            self._pending.remove(req)
+        with self._intake:
+            expired = [r for r in self._pending
+                       if r.deadline_t is not None and now > r.deadline_t]
+            for req in expired:
+                self._pending.remove(req)
+        # fail/notify outside _intake: _fail closes the request trace and
+        # on_deadline_expired is a telemetry callback (CC403)
+        for req in expired:
             self._fail(req, DeadlineExceeded(
                 f"request {req.rid} expired while queued"))
             self._tele.on_deadline_expired()
@@ -538,10 +560,11 @@ class _BatcherBase:
         expiry. Returns True when something was withdrawn; False for an
         unknown rid or a terminal request (finished results stay
         poppable, failures stay raised by ``pop_result``)."""
-        for req in list(self._pending):
-            if req.rid == rid:
-                self._pending.remove(req)
-                return True
+        with self._intake:
+            for req in list(self._pending):
+                if req.rid == rid:
+                    self._pending.remove(req)
+                    return True
         for slot, req in list(self._slot_req.items()):
             if req.rid == rid:
                 del self._slot_req[slot]
@@ -639,8 +662,11 @@ class ContinuousBatcher(_BatcherBase):
         prefill token)."""
         import paddle_tpu as paddle
         finished = []
-        while self._pending and self._free:
-            req = self._pending.pop(0)
+        while True:
+            with self._intake:
+                if not (self._pending and self._free):
+                    break
+                req = self._pending.pop(0)
             slot = self._free.pop(0)
             self._trace_admit_begin(req)
             prompt = req.prompt
@@ -1691,7 +1717,8 @@ class PagedContinuousBatcher(_BatcherBase):
                 if matched:
                     self.prefix_cache.unpin(matched)
                 break
-            self._pending.pop(0)
+            with self._intake:
+                self._pending.pop(0)
             self._promo_denied.discard(req.rid)
             slot = self._free_slots.pop(0)
             if matched:
@@ -1966,7 +1993,8 @@ class PagedContinuousBatcher(_BatcherBase):
             req = self._slot_req.pop(slot)
             req.slot = None
             self._release_slot(slot)
-            self._pending.insert(0, req)
+            with self._intake:
+                self._pending.insert(0, req)
             self._trace_close(req, preempted=1)
             self._tele.on_preempt()
             return True
@@ -2040,7 +2068,8 @@ class PagedContinuousBatcher(_BatcherBase):
         ids_np, L, padded_len, upto = self._admission_plan(req)
         if self._pages_for(upto) > len(self._free_pages):
             return False
-        self._pending.pop(0)
+        with self._intake:
+            self._pending.pop(0)
         slot = self._free_slots.pop(0)
         row = np.full((self.blocks_per_seq,), self._scratch, np.int32)
         if not self._alloc_pages_row(row, upto):

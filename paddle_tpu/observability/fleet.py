@@ -39,6 +39,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.locks import TracedLock
+
 __all__ = ["ProcessIdentity", "process_identity", "telemetry_dir",
            "TelemetrySpool", "get_spool", "spool_enabled", "reset_spool",
            "spool_metrics", "spool_event", "autospool_tick",
@@ -102,7 +104,7 @@ class TelemetrySpool:
         os.makedirs(dirpath, exist_ok=True)
         self.path = os.path.join(
             dirpath, f"rank{self.identity.rank:05d}.jsonl")
-        self._lock = threading.Lock()
+        self._lock = TracedLock("TelemetrySpool._lock")
         self._f = open(self.path, "a")
         self.write({"kind": "meta", "rank": self.identity.rank,
                     "world_size": self.identity.world_size,
@@ -147,7 +149,7 @@ class TelemetrySpool:
 
 _UNPROBED = object()
 _SPOOL = _UNPROBED   # _UNPROBED | None | TelemetrySpool
-_SPOOL_LOCK = threading.Lock()
+_SPOOL_LOCK = TracedLock("fleet._SPOOL_LOCK")
 
 
 def get_spool() -> Optional[TelemetrySpool]:
@@ -224,7 +226,7 @@ def autospool_tick(min_interval: Optional[float] = None) -> bool:
 # -- collective instrumentation (called from distributed.collective) ---------
 
 _COLL_SEQ = [0]
-_COLL_LOCK = threading.Lock()
+_COLL_LOCK = TracedLock("fleet._COLL_LOCK")
 
 
 def on_collective_enter(op: str) -> Optional[Tuple[int, float]]:

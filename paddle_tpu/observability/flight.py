@@ -40,6 +40,8 @@ import threading
 import zlib
 from typing import Dict, List, Optional
 
+from ..utils.locks import TracedLock
+
 __all__ = ["FlightRecorder", "get_flight", "flight_record",
            "read_ring", "build_postmortem", "reset_flight"]
 
@@ -60,7 +62,7 @@ class FlightRecorder:
         if slot_size <= _SLOT_HDR.size + 2:
             raise ValueError(f"slot_size {slot_size} too small")
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = TracedLock("FlightRecorder._lock")
         existing = os.path.exists(path) and os.path.getsize(path) >= _HDR_SIZE
         self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
         if existing:
@@ -183,7 +185,7 @@ def read_ring(path: str) -> List[dict]:
 
 _UNPROBED = object()
 _REC = _UNPROBED   # _UNPROBED | None (disabled) | FlightRecorder
-_REC_LOCK = threading.Lock()
+_REC_LOCK = TracedLock("flight._REC_LOCK")
 
 
 def _resolve_rank() -> int:

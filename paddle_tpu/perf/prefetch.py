@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from ..utils.locks import TracedLock
+
 __all__ = ["DevicePrefetcher", "AsyncLoader", "TransferFuture",
            "TransferCancelled", "coalesced_device_put"]
 
@@ -101,6 +103,10 @@ class DevicePrefetcher:
                           else (lambda b: coalesced_device_put(b, device)))
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
         self._err: Optional[BaseException] = None
+        # intake lock: guards _closed/_retired flips and close()'s drain
+        # bursts. Never held across a join deadline or a blocking queue
+        # op — the lock-witness hold accounting asserts this (CC402/CC406).
+        self._intake = TracedLock("DevicePrefetcher._intake")
         self._closed = False
         self._retired = False   # feeder thread confirmed exited
         self._batches, self._buffered, self._wait, self._xfer = _metrics()
@@ -146,7 +152,8 @@ class DevicePrefetcher:
         item = self._q.get()
         self._wait.observe(time.perf_counter() - t0)
         if item is self._SENTINEL:
-            self._closed = True
+            with self._intake:
+                self._closed = True
             if self._err is not None:
                 err, self._err = self._err, None
                 raise err
@@ -165,24 +172,31 @@ class DevicePrefetcher:
         a transfer wedged inside ``device_put`` past that is abandoned to
         its daemon thread.
         """
-        if self._retired:
-            return
-        self._closed = True
+        with self._intake:
+            if self._retired:
+                return
+            self._closed = True
         deadline = time.perf_counter() + timeout
         while True:
-            drained = 0
-            while True:
-                try:
-                    item = self._q.get_nowait()
-                except queue_mod.Empty:
-                    break
-                if item is not self._SENTINEL:
-                    drained += 1
+            # drain burst under the intake lock; the join deadline below
+            # is awaited with the lock RELEASED (hold-time accounting in
+            # the witness proves it) so a concurrent submitter/consumer
+            # is never stalled behind our wait on the feeder thread
+            with self._intake:
+                drained = 0
+                while True:
+                    try:
+                        item = self._q.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if item is not self._SENTINEL:
+                        drained += 1
             if drained:
                 self._buffered.add(-drained)
             self._thread.join(timeout=0.05)
             if not self._thread.is_alive():
-                self._retired = True
+                with self._intake:
+                    self._retired = True
                 return
             if time.perf_counter() >= deadline:
                 return
@@ -254,6 +268,11 @@ class AsyncLoader:
                  name: str = "paddle_tpu_kv_promoter", workers: int = 1):
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
         self._device = device
+        # intake lock: serializes submit-vs-close on the _closed flag and
+        # close()'s queued-cancel drain. Deliberately NOT held across the
+        # bounded queue put in submit() or the worker joins in close() —
+        # the witness hold accounting (test_perf) asserts the invariant.
+        self._intake = TracedLock("AsyncLoader._intake")
         self._closed = False
         from ..observability.metrics import get_registry
         reg = get_registry()
@@ -306,9 +325,13 @@ class AsyncLoader:
                 fut._fail(e)
 
     def submit(self, payload) -> TransferFuture:
-        if self._closed:
-            raise RuntimeError("AsyncLoader is closed")
-        fut = TransferFuture()
+        with self._intake:
+            if self._closed:
+                raise RuntimeError("AsyncLoader is closed")
+            fut = TransferFuture()
+        # the bounded (possibly blocking) put happens with the intake
+        # lock released; a close() racing in here is handled by the
+        # workers' drain-mode double-check, which cancels the item typed
         self._q.put((fut, payload))
         return fut
 
@@ -326,24 +349,29 @@ class AsyncLoader:
         land).
         """
         deadline = time.perf_counter() + timeout
-        if self._closed:
+        with self._intake:
+            already = self._closed
+            if not already:
+                self._closed = True
+                while True:
+                    try:
+                        item = self._q.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if item is None:
+                        continue
+                    fut, _ = item
+                    self._cancelled.inc()
+                    fut._fail(TransferCancelled(
+                        "AsyncLoader closed before transfer was issued"))
+        if already:
             for t in self._threads:
                 t.join(timeout=max(0.0, deadline - time.perf_counter()))
             return
-        self._closed = True
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except queue_mod.Empty:
-                break
-            if item is None:
-                continue
-            fut, _ = item
-            self._cancelled.inc()
-            fut._fail(TransferCancelled(
-                "AsyncLoader closed before transfer was issued"))
         for _ in self._threads:
-            # blocking put is safe: workers in drain mode consume fast
+            # blocking put is safe: workers in drain mode consume fast.
+            # Runs AFTER the intake lock is dropped — the join deadline
+            # below must never be awaited while holding it (CC402).
             self._q.put(None)
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.perf_counter()))

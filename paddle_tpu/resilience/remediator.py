@@ -58,6 +58,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..observability.fleet import FleetFinding, spool_event
 from ..observability.flight import flight_record
+from ..utils.locks import TracedLock
 
 __all__ = ["Signal", "PolicyRule", "RemediationAction", "FlapGuard",
            "AutoRemediator", "DEFAULT_POLICY", "ACTION_KINDS",
@@ -270,6 +271,12 @@ class AutoRemediator:
         self._resolved_idx = 0
         self._finding_idx = 0
         self._fleet_seen: set = set()
+        # tick-state lock: guards the hysteresis streaks and the action
+        # journal against an off-thread reader (summary()/executed() from
+        # a telemetry poller). Never held across _propose/_execute — those
+        # call into the gateway, and the only cross-object lock order is
+        # AutoRemediator._tick -> Gateway._admit.
+        self._tick_lock = TracedLock("AutoRemediator._tick")
         # hysteresis counters: (rule.signal, rule.action, target) →
         # consecutive ticks the signal fired
         self._streak: Dict[Tuple[str, str, str], int] = {}
@@ -358,23 +365,26 @@ class AutoRemediator:
                 target = self._resolve_target(rule.action, sig)
                 key = (rule.signal, rule.action, target or "")
                 fired_keys.add(key)
-                streak = self._streak.get(key, 0) + 1
-                self._streak[key] = streak
+                with self._tick_lock:
+                    streak = self._streak.get(key, 0) + 1
+                    self._streak[key] = streak
                 if streak < rule.hysteresis:
                     continue
                 act = self._propose(rule, sig, target, now)
                 decided.append(act)
                 if act.executed:
-                    self._streak[key] = 0
+                    with self._tick_lock:
+                        self._streak[key] = 0
             # resolution signals also un-shed outside the policy table:
             # the shed is lifted when the incident that caused it closes
             if sig.kind.startswith("slo_resolved:") and self._shed_orig:
                 decided.extend(self._unshed_all(sig, now))
         # a tick where a signal did NOT fire resets its streak —
         # hysteresis means K CONSECUTIVE firings
-        for key in [k for k in self._streak if k not in fired_keys]:
-            self._streak[key] = 0
-        self.actions.extend(decided)
+        with self._tick_lock:
+            for key in [k for k in self._streak if k not in fired_keys]:
+                self._streak[key] = 0
+            self.actions.extend(decided)
         return decided
 
     def _resolve_target(self, action: str, sig: Signal) -> Optional[str]:
@@ -545,15 +555,18 @@ class AutoRemediator:
 
     # -- introspection --------------------------------------------------------
     def executed(self) -> List[RemediationAction]:
-        return [a for a in self.actions if a.executed]
+        with self._tick_lock:
+            return [a for a in self.actions if a.executed]
 
     def summary(self) -> dict:
         by: Dict[str, Dict[str, int]] = {}
-        for a in self.actions:
+        with self._tick_lock:
+            actions = list(self.actions)
+        for a in actions:
             by.setdefault(a.kind, {}).setdefault(a.decision, 0)
             by[a.kind][a.decision] += 1
-        return {"decisions": len(self.actions),
-                "executed": len(self.executed()),
+        return {"decisions": len(actions),
+                "executed": sum(1 for a in actions if a.executed),
                 "by_action": by,
                 "flap_escalations": self.flap_guard.escalations,
                 "dry_run": self.dry_run, "enabled": self.enabled}

@@ -11,9 +11,13 @@ import warnings
 
 from . import unique_name
 from .flops import flops
+from .locks import (LockOrderInversion, TracedLock, TracedRLock,
+                    witness_enabled)
 
 __all__ = ["unique_name", "deprecated", "try_import", "require_version",
-           "flops", "run_check"]
+           "flops", "run_check",
+           "TracedLock", "TracedRLock", "LockOrderInversion",
+           "witness_enabled"]
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = "",

@@ -1,0 +1,397 @@
+"""TracedLock / TracedRLock — the runtime half of the CC concurrency
+rules (analysis/concurrency.py is the static half).
+
+Drop-in ``threading.Lock``/``RLock`` factories, env-gated by
+``PADDLE_LOCK_WITNESS``:
+
+  * off (unset/``0``, the default): the factory returns a **raw**
+    ``threading.Lock``/``RLock`` object — not a wrapper — so the hot
+    path pays nothing beyond one factory call at construction time.
+  * ``1``/``on``/``record``: every acquire/release is recorded into a
+    process-wide :class:`LockWitness` — per-thread acquisition chains
+    feed a lock-order graph; a cycle in the *observed* order (the same
+    pair of locks taken in both orders, possibly through intermediates)
+    records **CC405 witnessed-order-inversion**. Hold or wait times over
+    the budget (``PADDLE_LOCK_BUDGET_MS``, default 200) record **CC406**
+    and every acquire feeds ``lock.wait_seconds{site}`` /
+    ``lock.hold_seconds{site}`` histograms in the metrics registry.
+  * ``strict``/``raise``: additionally raise :class:`LockOrderInversion`
+    at the acquire site that closed the cycle (the just-acquired lock is
+    released first, so the raise leaves no lock held).
+
+``dump_witness(path)`` writes the JSON audit format that
+``tools/chaos_run.py`` spools as ``witness_<mode>.json`` and that
+``tools/race_check.py --witness`` / ``telemetry_dump --locks`` read.
+
+Stdlib-only at import time; the metrics registry is imported lazily and
+failures are swallowed (witnessing must never take the workload down).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["TracedLock", "TracedRLock", "LockWitness", "LockOrderInversion",
+           "witness_enabled", "witness_strict", "get_witness",
+           "reset_witness", "witness_report", "dump_witness",
+           "witness_findings"]
+
+_ON = {"1", "on", "true", "yes", "record", "strict", "raise"}
+_STRICT = {"strict", "raise"}
+
+#: per-site samples kept for the p50/p99 in the dump (bounded)
+_MAX_SAMPLES = 512
+#: CC406 findings are aggregated per site, never repeated
+_DEFAULT_BUDGET_MS = 200.0
+
+
+def witness_enabled() -> bool:
+    return os.environ.get("PADDLE_LOCK_WITNESS", "0").lower() in _ON
+
+
+def witness_strict() -> bool:
+    return os.environ.get("PADDLE_LOCK_WITNESS", "0").lower() in _STRICT
+
+
+def _budget_s() -> float:
+    try:
+        return float(os.environ.get("PADDLE_LOCK_BUDGET_MS",
+                                    _DEFAULT_BUDGET_MS)) / 1000.0
+    except ValueError:
+        return _DEFAULT_BUDGET_MS / 1000.0
+
+
+class LockOrderInversion(RuntimeError):
+    """Strict-mode CC405: this acquire closed a cycle in the observed
+    lock-order graph. The offending lock was released before raising."""
+
+
+def _site() -> str:
+    """'pkg/mod.py:lineno' of the nearest frame outside this module."""
+    f = sys._getframe(1)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:  # pragma: no cover — defensive
+        return "<unknown>:0"
+    path = f.f_code.co_filename.replace(os.sep, "/")
+    for anchor in ("paddle_tpu/", "tools/", "benchmarks/", "tests/"):
+        i = path.rfind("/" + anchor)
+        if i >= 0:
+            path = path[i + 1:]
+            break
+    else:
+        path = os.path.basename(path)
+    return f"{path}:{f.f_lineno}"
+
+
+class _SiteStats:
+    """count/total/max + a bounded sample reservoir (deterministic:
+    first _MAX_SAMPLES kept, later samples fold into count/total/max —
+    good enough for a p99 over a drill)."""
+
+    __slots__ = ("count", "total", "max", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.samples: List[float] = []
+
+    def add(self, v: float):
+        self.count += 1
+        self.total += v
+        if v > self.max:
+            self.max = v
+        if len(self.samples) < _MAX_SAMPLES:
+            self.samples.append(v)
+
+    def quantile(self, q: float) -> float:
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "total": round(self.total, 6),
+                "max": round(self.max, 6),
+                "p50": round(self.quantile(0.50), 6),
+                "p99": round(self.quantile(0.99), 6)}
+
+
+class LockWitness:
+    """Process-wide lock-order witness: observed acquisition edges,
+    per-site wait/hold accounting, and the CC405/CC406 findings derived
+    from them. All methods are thread-safe (guarded by a raw lock —
+    the witness must not witness itself)."""
+
+    def __init__(self, budget_s: Optional[float] = None):
+        self._mu = threading.Lock()
+        self.budget_s = _budget_s() if budget_s is None else budget_s
+        #: (held_lock, acquired_lock) -> {"site", "count"}
+        self.edges: Dict[Tuple[str, str], dict] = {}
+        #: (lock, site) -> _SiteStats
+        self.holds: Dict[Tuple[str, str], _SiteStats] = {}
+        self.waits: Dict[Tuple[str, str], _SiteStats] = {}
+        self.findings: List[dict] = []
+        self._inversions_seen: set = set()
+        self._budget_seen: set = set()
+
+    # -- order graph ---------------------------------------------------------
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS: a path src -> ... -> dst in the observed edge graph."""
+        succ: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            succ.setdefault(a, []).append(b)
+        stack = [(src, [src])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in succ.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def record_acquired(self, name: str, site: str, wait_s: float,
+                        held: List[Tuple[str, str]]) -> Optional[dict]:
+        """Called with the lock freshly acquired. ``held`` is the thread's
+        outer chain as (lock, site) pairs. Returns a CC405 finding dict if
+        this acquire closed a cycle (caller decides whether to raise)."""
+        inversion = None
+        with self._mu:
+            self.waits.setdefault((name, site), _SiteStats()).add(wait_s)
+            if wait_s > self.budget_s:
+                self._over_budget(name, site, wait_s, kind="wait")
+            for h_name, h_site in held:
+                if h_name == name:
+                    continue
+                key = (h_name, name)
+                ent = self.edges.get(key)
+                if ent is None:
+                    # adding h->name: a pre-existing name->..->h path
+                    # means the new edge closes a cycle
+                    back = self._path(name, h_name)
+                    self.edges[key] = {"site": site, "count": 1}
+                    if back is not None:
+                        pair = tuple(sorted((h_name, name)))
+                        if pair not in self._inversions_seen:
+                            self._inversions_seen.add(pair)
+                            other = self.edges.get(
+                                (back[0], back[1]), {}).get("site", "?")
+                            inversion = self._finding(
+                                "CC405", site,
+                                f"lock order inversion: '{name}' acquired "
+                                f"while holding '{h_name}' at {site}, but "
+                                f"the opposite order {' -> '.join(back)} "
+                                f"was observed at {other}",
+                                locks=sorted(pair), cycle=back + [name])
+                else:
+                    ent["count"] += 1
+        return inversion
+
+    def record_released(self, name: str, site: str, hold_s: float):
+        with self._mu:
+            self.holds.setdefault((name, site), _SiteStats()).add(hold_s)
+            if hold_s > self.budget_s:
+                self._over_budget(name, site, hold_s, kind="hold")
+
+    # -- findings ------------------------------------------------------------
+    def _finding(self, rule: str, site: str, message: str, **extra) -> dict:
+        file, _, line = site.rpartition(":")
+        f = {"rule": rule, "message": message, "file": file or site,
+             "line": int(line) if line.isdigit() else 0, "site": site}
+        f.update(extra)
+        self.findings.append(f)
+        return f
+
+    def _over_budget(self, name: str, site: str, v: float, kind: str):
+        key = (name, site, kind)
+        if key in self._budget_seen:
+            return
+        self._budget_seen.add(key)
+        self._finding(
+            "CC406", site,
+            f"lock '{name}' {kind} of {v * 1e3:.1f}ms at {site} exceeds "
+            f"the {self.budget_s * 1e3:.0f}ms budget — move the slow work "
+            "outside the critical section",
+            lock=name, kind=kind, seconds=round(v, 6))
+
+    # -- accessors -----------------------------------------------------------
+    def max_hold(self, lock_name: str) -> float:
+        """Max observed hold across all sites of ``lock_name`` (seconds) —
+        the hold-time accounting close() assertions use."""
+        with self._mu:
+            return max((s.max for (n, _), s in self.holds.items()
+                        if n == lock_name), default=0.0)
+
+    def report(self) -> dict:
+        with self._mu:
+            return {
+                "version": 1,
+                "enabled": witness_enabled(),
+                "budget_ms": round(self.budget_s * 1e3, 3),
+                "edges": [{"from": a, "to": b, "site": e["site"],
+                           "count": e["count"]}
+                          for (a, b), e in sorted(self.edges.items())],
+                "sites": {
+                    f"{n}@{s}": {"wait": self.waits[(n, s)].to_dict()
+                                 if (n, s) in self.waits else None,
+                                 "hold": self.holds[(n, s)].to_dict()
+                                 if (n, s) in self.holds else None}
+                    for (n, s) in sorted(set(self.waits) | set(self.holds))},
+                "findings": list(self.findings),
+            }
+
+
+_WITNESS = LockWitness()
+_tls = threading.local()
+
+
+def get_witness() -> LockWitness:
+    return _WITNESS
+
+
+def reset_witness(budget_s: Optional[float] = None) -> LockWitness:
+    """Fresh witness (tests / per-drill isolation). Locks already
+    constructed keep reporting — they look the witness up per call."""
+    global _WITNESS
+    _WITNESS = LockWitness(budget_s=budget_s)
+    return _WITNESS
+
+
+def witness_report() -> dict:
+    return _WITNESS.report()
+
+
+def dump_witness(path: str) -> dict:
+    rep = _WITNESS.report()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(rep, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return rep
+
+
+def witness_findings():
+    """Recorded CC405/CC406 findings as ``analysis.Finding`` objects when
+    the catalog is importable, else the raw dicts."""
+    raw = list(_WITNESS.findings)
+    try:
+        from ..analysis.findings import Finding
+    except Exception:
+        return raw
+    return [Finding(rule=f["rule"], message=f["message"], file=f["file"],
+                    line=f["line"], source_line=f.get("site", ""),
+                    extra={k: v for k, v in f.items()
+                           if k not in ("rule", "message", "file", "line")})
+            for f in raw]
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class _WitnessLock:
+    """Recording wrapper around a raw lock. Only ever constructed when
+    the witness is on — the off path hands out raw lock objects."""
+
+    __slots__ = ("_lock", "name", "_reentrant")
+
+    def __init__(self, raw, name: str, reentrant: bool):
+        self._lock = raw
+        self.name = name
+        self._reentrant = reentrant
+
+    # -- plumbing ------------------------------------------------------------
+    def locked(self):
+        return self._lock.locked() if hasattr(self._lock, "locked") else False
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        site = _site()
+        st = _stack()
+        if self._reentrant:
+            for ent in st:
+                if ent[0] is self:            # reentrant re-acquire: no
+                    got = self._lock.acquire(blocking, timeout)
+                    if got:
+                        ent[3] += 1           # edge, no fresh hold window
+                    return got
+        t0 = time.perf_counter()
+        got = self._lock.acquire(blocking, timeout)
+        if not got:
+            return got
+        wait = time.perf_counter() - t0
+        held = [(e[0].name, e[1]) for e in st]
+        inv = _WITNESS.record_acquired(self.name, site, wait, held)
+        self._observe("lock.wait_seconds", site, wait)
+        st.append([self, site, time.perf_counter(), 1])
+        if inv is not None and witness_strict():
+            st.pop()
+            self._lock.release()
+            raise LockOrderInversion(inv["message"])
+        return got
+
+    def release(self):
+        st = _stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] is self:
+                st[i][3] -= 1
+                if st[i][3] == 0:
+                    _, site, t_acq, _ = st.pop(i)
+                    hold = time.perf_counter() - t_acq
+                    _WITNESS.record_released(self.name, site, hold)
+                    self._observe("lock.hold_seconds", site, hold)
+                break
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def _observe(self, metric: str, site: str, v: float):
+        try:
+            from ..observability.metrics import get_registry
+            get_registry().histogram(
+                metric, "TracedLock %s by acquire site"
+                        % metric.split(".")[-1],
+                labelnames=("site",)).labels(site=site).observe(v)
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return f"<TracedLock {self.name!r} at {id(self):#x}>"
+
+
+def TracedLock(name: str = ""):
+    """``threading.Lock`` when PADDLE_LOCK_WITNESS is off (raw object,
+    zero overhead), a witness-recording wrapper when on. ``name`` is the
+    stable identity in the order graph; default: the construction site."""
+    if not witness_enabled():
+        return threading.Lock()
+    return _WitnessLock(threading.Lock(), name or f"lock@{_site()}",
+                        reentrant=False)
+
+
+def TracedRLock(name: str = ""):
+    """Reentrant variant: nested re-acquires by the owning thread add no
+    order edges and no fresh hold window."""
+    if not witness_enabled():
+        return threading.RLock()
+    return _WitnessLock(threading.RLock(), name or f"rlock@{_site()}",
+                        reentrant=True)

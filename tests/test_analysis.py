@@ -456,7 +456,8 @@ def test_rule_catalog_is_stable():
     assert set(findings_mod.RULES) >= {
         "DF001", "DF002", "DF003", "DF004", "DF005", "DF006",
         "TS101", "TS102", "TS103", "TS104", "TS105",
-        "SH201", "SH202", "SH203", "SH204", "MEM301", "MEM302"}
+        "SH201", "SH202", "SH203", "SH204", "MEM301", "MEM302",
+        "CC401", "CC402", "CC403", "CC404", "CC405", "CC406"}
     for rule, meta in findings_mod.RULES.items():
         assert meta["severity"] in ("error", "warning")
         assert meta["doc"]
@@ -904,4 +905,397 @@ def test_cli_baseline_accepts_known_findings(tmp_path):
                     cwd=str(tmp_path))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     proc = _run_cli("--baseline", str(base), str(bad), cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# CC401-CC404 — static lock-discipline rules (analysis/concurrency.py)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.analysis import concurrency  # noqa: E402
+
+
+CC401_BAD = """
+import threading
+A = threading.Lock()
+B = threading.Lock()
+
+def forward():
+    with A:
+        with B:
+            pass
+
+def backward():
+    with B:
+        with A:
+            pass
+"""
+
+CC401_GOOD = """
+import threading
+A = threading.Lock()
+B = threading.Lock()
+
+def forward():
+    with A:
+        with B:
+            pass
+
+def backward():
+    with A:
+        with B:
+            pass
+"""
+
+CC401_TRANSITIVE_BAD = """
+import threading
+A = threading.Lock()
+B = threading.Lock()
+
+def inner():
+    with B:
+        pass
+
+def forward():
+    with A:
+        inner()          # A -> B through the call graph
+
+def backward():
+    with B:
+        with A:
+            pass
+"""
+
+
+def test_cc401_flags_lock_order_cycle():
+    assert "CC401" in _rules(concurrency.analyze_source(CC401_BAD, "m.py"))
+
+
+def test_cc401_passes_consistent_order():
+    assert "CC401" not in _rules(concurrency.analyze_source(CC401_GOOD, "m.py"))
+
+
+def test_cc401_sees_acquisitions_through_the_call_graph():
+    fs = concurrency.analyze_source(CC401_TRANSITIVE_BAD, "m.py")
+    assert "CC401" in _rules(fs)
+
+
+CC402_BAD = """
+import threading
+import time
+LOCK = threading.Lock()
+
+def slow_path():
+    with LOCK:
+        time.sleep(0.5)
+"""
+
+CC402_GOOD = """
+import threading
+import time
+LOCK = threading.Lock()
+
+def slow_path():
+    with LOCK:
+        x = 1
+    time.sleep(0.5)
+"""
+
+
+def test_cc402_flags_blocking_call_under_lock():
+    assert "CC402" in _rules(concurrency.analyze_source(CC402_BAD, "m.py"))
+
+
+def test_cc402_passes_blocking_call_outside_lock():
+    assert "CC402" not in _rules(concurrency.analyze_source(CC402_GOOD, "m.py"))
+
+
+CC403_BAD = """
+import threading
+
+class Owner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._callbacks = []
+
+    def fire(self):
+        with self._lock:
+            for cb in self._callbacks:
+                cb("event")
+"""
+
+CC403_GOOD = """
+import threading
+
+class Owner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._callbacks = []
+
+    def fire(self):
+        with self._lock:
+            cbs = list(self._callbacks)
+        for cb in cbs:
+            cb("event")
+"""
+
+
+def test_cc403_flags_callback_under_lock():
+    assert "CC403" in _rules(concurrency.analyze_source(CC403_BAD))
+
+
+def test_cc403_passes_callback_after_snapshot():
+    assert "CC403" not in _rules(concurrency.analyze_source(CC403_GOOD))
+
+
+CC404_BAD = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def sneak(self):
+        self._n = 0          # bare write to lock-guarded state
+"""
+
+CC404_GOOD = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def reset(self):
+        with self._lock:
+            self._n = 0
+"""
+
+
+def test_cc404_flags_unguarded_write_to_guarded_state():
+    fs = concurrency.analyze_source(CC404_BAD)
+    assert "CC404" in _rules(fs)
+    assert any("sneak" in f.message for f in fs if f.rule == "CC404")
+
+
+def test_cc404_passes_when_every_write_is_guarded():
+    assert "CC404" not in _rules(concurrency.analyze_source(CC404_GOOD))
+
+
+def test_cc404_exempts_init_time_writes():
+    # __init__ constructs the state the lock will guard — not a race
+    fs = concurrency.analyze_source(CC404_GOOD)
+    assert not any(f.line <= 7 for f in fs if f.rule == "CC404")
+
+
+def test_cc_suppression_comment_is_honored():
+    src = CC402_BAD.replace("time.sleep(0.5)",
+                            "time.sleep(0.5)  # tpu-lint: disable=CC402")
+    assert "CC402" not in _rules(concurrency.analyze_source(src, "m.py"))
+
+
+def test_cc_rules_have_catalog_severities():
+    assert findings_mod.RULES["CC401"]["severity"] == "error"
+    assert findings_mod.RULES["CC405"]["severity"] == "error"
+    assert findings_mod.RULES["CC402"]["severity"] == "warning"
+
+
+# ---------------------------------------------------------------------------
+# CC405/CC406 — the runtime lock witness (utils/locks.py)
+# ---------------------------------------------------------------------------
+
+
+def _fresh_witness(monkeypatch, budget_s=None, value="1"):
+    from paddle_tpu.utils import locks
+    monkeypatch.setenv("PADDLE_LOCK_WITNESS", value)
+    return locks.reset_witness(budget_s=budget_s)
+
+
+def test_cc405_two_thread_inversion_drill(monkeypatch):
+    """The seeded deadlock drill: thread 1 takes A then B, thread 2
+    takes B then A (run to completion sequentially, so the drill can
+    never actually deadlock) — the witness MUST record the CC405 order
+    inversion."""
+    import threading
+
+    from paddle_tpu.utils import locks
+    _fresh_witness(monkeypatch)
+    a, b = locks.TracedLock("drill.A"), locks.TracedLock("drill.B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    for fn in (forward, backward):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    found = [f for f in locks.get_witness().findings
+             if f["rule"] == "CC405"]
+    assert found, "inversion not witnessed"
+    assert {"drill.A", "drill.B"} == set(found[0]["locks"])
+    # and the typed Finding surface sees it too
+    assert "CC405" in {f.rule for f in locks.witness_findings()}
+
+
+def test_cc405_consistent_order_twin_stays_silent(monkeypatch):
+    import threading
+
+    from paddle_tpu.utils import locks
+    _fresh_witness(monkeypatch)
+    a, b = locks.TracedLock("twin.A"), locks.TracedLock("twin.B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    for _ in range(2):
+        t = threading.Thread(target=forward)
+        t.start()
+        t.join()
+    assert not locks.get_witness().findings
+
+
+def test_cc405_strict_mode_raises_and_releases(monkeypatch):
+    from paddle_tpu.utils import locks
+    _fresh_witness(monkeypatch, value="strict")
+    a, b = locks.TracedLock("strict.A"), locks.TracedLock("strict.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(locks.LockOrderInversion):
+            a.acquire()
+    # the refused acquisition must not leave either lock held
+    assert a.acquire(timeout=0.1)
+    a.release()
+
+
+def test_cc406_over_budget_hold_is_recorded(monkeypatch):
+    from paddle_tpu.utils import locks
+    _fresh_witness(monkeypatch, budget_s=0.005)
+    lk = locks.TracedLock("budget.L")
+    with lk:
+        time.sleep(0.02)
+    w = locks.get_witness()
+    assert any(f["rule"] == "CC406" for f in w.findings)
+    assert w.max_hold("budget.L") >= 0.005
+
+
+def test_witness_dump_roundtrips_through_audit(tmp_path, monkeypatch):
+    import threading
+
+    from paddle_tpu.utils import locks
+    _fresh_witness(monkeypatch)
+    a, b = locks.TracedLock("rt.A"), locks.TracedLock("rt.B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    for fn in (forward, backward):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    path = tmp_path / "witness_test.json"
+    locks.dump_witness(str(path))
+    fs = concurrency.audit_witness_paths([str(tmp_path)])
+    assert "CC405" in _rules(fs)
+
+
+def test_witness_off_hands_out_raw_locks(monkeypatch):
+    """The <1%% overhead guard, proven structurally: with the witness
+    off the factories return RAW threading primitives — the hot path
+    pays literally zero instrumentation."""
+    import threading
+
+    from paddle_tpu.utils import locks
+    monkeypatch.delenv("PADDLE_LOCK_WITNESS", raising=False)
+    assert type(locks.TracedLock("x")) is type(threading.Lock())
+    assert type(locks.TracedRLock("x")) is type(threading.RLock())
+    assert not locks.witness_enabled()
+
+
+@pytest.mark.quick
+def test_witness_off_overhead_under_one_percent(monkeypatch):
+    """Belt to the structural suspenders: time a serving-step-shaped
+    critical section (dict bookkeeping under a lock) with a plain
+    threading.Lock vs a witness-off TracedLock. Identical types, so
+    the budget only needs to absorb timer noise."""
+    import threading
+
+    from paddle_tpu.utils import locks
+    monkeypatch.delenv("PADDLE_LOCK_WITNESS", raising=False)
+
+    def drive(lk, n=20000):
+        state = {}
+        t0 = time.perf_counter()
+        for i in range(n):
+            with lk:
+                state[i & 63] = i
+        return time.perf_counter() - t0
+
+    raw, traced = threading.Lock(), locks.TracedLock("serve.step")
+    drive(raw), drive(traced)                      # warm both paths
+    t_raw = min(drive(raw) for _ in range(3))
+    t_traced = min(drive(traced) for _ in range(3))
+    # same type -> same cost; 25% headroom swallows scheduler noise in
+    # a shared CI box while still catching any accidental wrapper
+    assert t_traced < t_raw * 1.25, (t_raw, t_traced)
+
+
+@pytest.mark.lint
+@pytest.mark.quick
+def test_race_check_gate_shipped_tree_is_clean_and_fast():
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "race_check.py"),
+         "paddle_tpu", "tools", "benchmarks"],
+        cwd=REPO, capture_output=True, text=True)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # runtime guard: the gate must never threaten the tier-1 timeout
+    assert elapsed < 10.0, f"race_check gate took {elapsed:.1f}s"
+
+
+def test_race_check_cli_flags_cycle_and_respects_baseline(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(CC401_BAD)
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "race_check.py"),
+             *args], cwd=str(tmp_path), capture_output=True, text=True)
+
+    proc = run("--json", "--baseline", "none", str(bad))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert any(f["rule"] == "CC401" for f in payload["findings"])
+    base = tmp_path / "base.json"
+    proc = run("--write-baseline", "--baseline", str(base), str(bad))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = run("--baseline", str(base), str(bad))
     assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -297,3 +297,76 @@ def test_dataloader_tail_batch_bucketing():
     *_, (xb, yb) = iter(DataLoader(data, batch_size=4,
                                    batch_buckets="pow2"))
     assert yb.numpy().tolist() == [8, 9, 10, 10]
+
+
+def test_async_loader_close_during_inflight_transfer(monkeypatch):
+    """close() while a transfer is IN FLIGHT: the issued transfer is
+    allowed to land, queued-but-unissued work is cancelled typed, and —
+    the lock-discipline invariant close() documents — the intake lock
+    is never held across the worker-join deadline. The witness's
+    hold-time accounting proves the last part: with a payload that
+    stalls the worker ~0.2s, a close() that awaited the join under
+    ``AsyncLoader._intake`` would show a comparable max hold."""
+    import threading
+
+    from paddle_tpu.perf.prefetch import AsyncLoader, TransferCancelled
+    from paddle_tpu.utils import locks
+
+    monkeypatch.setenv("PADDLE_LOCK_WITNESS", "1")
+    locks.reset_witness()
+    ld = AsyncLoader(depth=4, workers=1)
+    entered = threading.Event()
+
+    def slow_payload():
+        entered.set()
+        time.sleep(0.2)
+        return {"x": np.ones(2, dtype="float32")}
+
+    inflight = ld.submit(slow_payload)
+    assert entered.wait(2.0), "worker never picked up the transfer"
+    queued = ld.submit({"y": np.zeros(2, dtype="float32")})
+    ld.close(timeout=2.0)
+
+    got = inflight.result(timeout=2.0)
+    np.testing.assert_array_equal(np.asarray(got["x"]), np.ones(2))
+    with pytest.raises(TransferCancelled):
+        queued.result(timeout=2.0)
+
+    held = locks.get_witness().max_hold("AsyncLoader._intake")
+    assert held < 0.1, (
+        f"intake lock held {held:.3f}s — close() awaited the worker "
+        f"join (or the in-flight transfer) while holding it")
+
+
+def test_device_prefetcher_close_during_inflight_transfer(monkeypatch):
+    """close() while the feeder is INSIDE a transfer: close must return
+    within its bound, retire cleanly once the transfer lands, and — per
+    the intake-lock discipline — never await the feeder join while
+    holding ``DevicePrefetcher._intake`` (witness hold accounting)."""
+    import threading
+
+    from paddle_tpu.perf.prefetch import DevicePrefetcher
+    from paddle_tpu.utils import locks
+
+    monkeypatch.setenv("PADDLE_LOCK_WITNESS", "1")
+    locks.reset_witness()
+    entered = threading.Event()
+
+    def slow_transfer(batch):
+        entered.set()
+        time.sleep(0.2)
+        return batch
+
+    batches = [{"x": np.full(2, i, dtype="float32")} for i in range(8)]
+    pf = DevicePrefetcher(iter(batches), depth=1, transfer=slow_transfer)
+    assert entered.wait(2.0), "feeder never started a transfer"
+    t0 = time.perf_counter()
+    pf.close(timeout=2.0)
+    assert time.perf_counter() - t0 < 2.0
+    assert pf._retired and not pf._thread.is_alive()
+    pf.close()  # idempotent after retirement
+
+    held = locks.get_witness().max_hold("DevicePrefetcher._intake")
+    assert held < 0.1, (
+        f"intake lock held {held:.3f}s — close() awaited the feeder "
+        f"join while holding it")

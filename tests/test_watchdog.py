@@ -161,3 +161,68 @@ def test_stale_attempt_peer_benign_then_escalates():
     assert report is not None and report["kind"] == "stuck", report
     assert report["peers_stale_attempt"] == [1]
     assert 1 in report["peers_missing"]
+
+
+def test_poison_write_is_lock_guarded_against_reset_race():
+    """Regression (CC404): ``check_once`` runs on the watchdog thread
+    and used to write ``_poison`` bare; ``reset()`` read-and-clears it
+    under ``_lock`` on the app thread, so a report could resurrect one
+    reset() had just cleared. The write now happens under the lock —
+    proven here by interposing on the instance lock and recording
+    whether it was held at the moment ``_poison`` was assigned."""
+    import threading
+
+    _, a, b = _pair(timeout=999)
+    a.enter("all_reduce", "x")
+    b.enter("broadcast", "x")
+
+    held_at_write = []
+
+    class _SpyLock:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __enter__(self):
+            self._inner.acquire()
+            return self
+
+        def __exit__(self, *exc):
+            self._inner.release()
+            return False
+
+    spy = _SpyLock(threading.Lock())
+
+    orig_setattr = CollectiveWatchdog.__setattr__
+
+    def spying_setattr(self_, name, value):
+        if name == "_poison" and value is not None:
+            held_at_write.append(spy._inner.locked())
+        orig_setattr(self_, name, value)
+
+    a._lock = spy
+    CollectiveWatchdog.__setattr__ = spying_setattr
+    try:
+        report = a.check_once()
+    finally:
+        CollectiveWatchdog.__setattr__ = orig_setattr
+    assert report is not None and report["kind"] == "mismatch"
+    assert held_at_write == [True], \
+        "_poison written without holding _lock (reset() race reopened)"
+    # and the poisoned state still round-trips through reset()
+    with pytest.raises(DesyncError):
+        a.enter("all_reduce", "x")
+    assert a.reset() == report
+    a.enter("all_reduce", "x")  # clean after reset
+
+
+def test_watchdog_source_is_cc404_clean():
+    """The static rule that found the race keeps guarding the fix."""
+    import os
+
+    from paddle_tpu.analysis import concurrency
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "distributed",
+        "watchdog.py")
+    with open(src) as fh:
+        fs = concurrency.analyze_source(fh.read(), "watchdog.py")
+    assert "CC404" not in {f.rule for f in fs}

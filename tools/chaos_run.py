@@ -354,7 +354,14 @@ def main(argv=None) -> int:
                          "canonical drill, or $PADDLE_CHAOS if set)")
     ap.add_argument("--list", action="store_true",
                     help="print the default scenarios and exit")
+    ap.add_argument("--witness", action="store_true",
+                    help="arm the lock-order witness (PADDLE_LOCK_WITNESS"
+                         "=1) and dump witness_<mode>.json per drill into "
+                         "the telemetry dir for race_check --witness")
     args = ap.parse_args(argv)
+
+    if args.witness:
+        os.environ.setdefault("PADDLE_LOCK_WITNESS", "1")
 
     if args.list:
         for mode, spec in DEFAULT_SCENARIOS.items():
@@ -377,6 +384,7 @@ def main(argv=None) -> int:
         scenario = (args.scenario or os.environ.get("PADDLE_CHAOS")
                     or DEFAULT_SCENARIOS[mode])
         print(f"[chaos:{mode}] scenario: {scenario}")
+        _witness_reset()
         try:
             outcome = DRILLS[mode](scenario)
             print(f"[chaos:{mode}] RECOVERED — {outcome}")
@@ -384,9 +392,32 @@ def main(argv=None) -> int:
             failures += 1
             print(f"[chaos:{mode}] FAILED — {exc}")
         _write_postmortem(tele_dir, mode)
+        _write_witness(tele_dir, mode)
     print("-- telemetry --")
     _print_telemetry()
     return 1 if failures else 0
+
+
+def _witness_reset() -> None:
+    """Per-drill isolation: one drill's observed lock order must not
+    leak CC405 edges into the next drill's dump."""
+    from paddle_tpu.utils.locks import reset_witness, witness_enabled
+    if witness_enabled():
+        reset_witness()
+
+
+def _write_witness(tele_dir: str, mode: str) -> None:
+    from paddle_tpu.utils.locks import dump_witness, witness_enabled
+    if not witness_enabled():
+        return
+    path = os.path.join(tele_dir, f"witness_{mode}.json")
+    try:
+        dump_witness(path)
+    except Exception as exc:  # forensics must not flip a drill verdict
+        print(f"[chaos:{mode}] witness dump unavailable: {exc}")
+        return
+    print(f"[chaos:{mode}] lock witness: {path} "
+          f"(audit: tools/race_check.py --witness {tele_dir})")
 
 
 def _write_postmortem(tele_dir: str, mode: str) -> None:

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Concurrency-safety linter CLI (CC* rules of paddle_tpu.analysis).
+
+Static half — the whole-repo lock-acquisition graph:
+
+    python tools/race_check.py paddle_tpu tools benchmarks   # text report
+    python tools/race_check.py --json paddle_tpu             # machine output
+    python tools/race_check.py --write-baseline paddle_tpu tools benchmarks
+    python tools/race_check.py --rules CC401,CC402 paddle_tpu
+
+Dynamic half — audit lock-witness dumps recorded by a run with
+``PADDLE_LOCK_WITNESS=1`` (see ``paddle_tpu/utils/locks.py`` and the
+``tools/chaos_run.py`` witness leg):
+
+    python tools/race_check.py --witness /tmp/chaos_out       # dir scan
+    python tools/race_check.py --witness witness_kill.json    # one dump
+
+Exit status: 0 when no ERROR-severity findings survive suppressions and
+the baseline; 1 otherwise (CC401 lock-order cycles and CC405 witnessed
+inversions are errors; CC402/403/404/406 are warnings — use --strict to
+fail on those too).
+
+Deliberately does NOT import the paddle_tpu package (and therefore not
+jax): the rule engine (analysis/concurrency.py, analysis/findings.py)
+is stdlib-only and loaded straight off the source tree, so the tier-1
+lint gate runs in a couple of seconds.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_ANALYSIS_DIR = os.path.join(_REPO, "paddle_tpu", "analysis")
+sys.path.insert(0, _ANALYSIS_DIR)
+
+import concurrency   # noqa: E402  (stdlib-only modules, loaded directly)
+import findings as findings_mod  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(_HERE, "race_check_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="race_check",
+        description="paddle_tpu concurrency-safety linter (CC rules)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of accepted findings "
+                         "(default: tools/race_check_baseline.json; "
+                         "pass 'none' to disable)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule IDs to restrict to")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--witness", action="append", default=[],
+                    metavar="PATH",
+                    help="audit a lock-witness dump (witness_*.json) or "
+                         "a directory of them for CC405/CC406 "
+                         "(repeatable; combines with static paths)")
+    args = ap.parse_args(argv)
+
+    if not args.paths and not args.witness:
+        ap.error("no paths given (and no --witness)")
+
+    paths = [p if os.path.isabs(p) else os.path.join(os.getcwd(), p)
+             for p in args.paths]
+    results = concurrency.analyze_paths(paths, root=os.getcwd())
+
+    if args.witness:
+        results.extend(concurrency.audit_witness_paths(args.witness))
+
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        results = [f for f in results if f.rule in wanted]
+
+    if args.write_baseline:
+        path = (args.baseline if args.baseline.lower() != "none"
+                else DEFAULT_BASELINE)
+        findings_mod.write_baseline(results, path)
+        print(f"wrote {len(results)} finding(s) to {path}")
+        return 0
+
+    if args.baseline.lower() != "none":
+        baseline = findings_mod.load_baseline(args.baseline)
+        if baseline:
+            results = findings_mod.apply_baseline(results, baseline)
+
+    if args.json:
+        print(json.dumps({"findings": [f.to_dict() for f in results],
+                          "summary": findings_mod.summarize(results)},
+                         indent=2))
+    else:
+        for f in results:
+            print(f)
+        print(findings_mod.summarize(results))
+
+    if findings_mod.has_errors(results):
+        return 1
+    if args.strict and results:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,12 @@ def main(argv=None) -> int:
                          "cost artifact at the repo root (per-op-class "
                          "cost shares, gap attribution, diff vs the "
                          "previous round) — no workload, no jax")
+    ap.add_argument("--locks", metavar="DIR", default=None,
+                    help="render the lock-contention table (top sites "
+                         "by wait/hold p99, plus any CC405/CC406 "
+                         "findings) from the witness_*.json dumps a "
+                         "PADDLE_LOCK_WITNESS=1 run left under DIR — "
+                         "no workload, no jax")
     ap.add_argument("--prefix-stats", action="store_true",
                     help="with --fleet: append a radix prefix-cache "
                          "summary (hit/miss tokens, hit rate, "
@@ -208,6 +214,61 @@ def main(argv=None) -> int:
             d = opprof.diff(prev, doc)
             text += (f"== diff vs {os.path.basename(prev_path)}\n"
                      + json.dumps(d, indent=1) + "\n")
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
+
+    if args.locks:
+        # the lock-contention view: witness_*.json artifacts only, so no
+        # paddle_tpu/jax import — early return keeps every existing flag
+        # combination byte-identical (same pattern as --opprof)
+        import glob
+        import json
+        files = ([args.locks] if os.path.isfile(args.locks) else
+                 sorted(glob.glob(os.path.join(args.locks,
+                                               "witness*.json"))))
+        if not files:
+            sys.stderr.write(f"no witness_*.json under {args.locks} "
+                             "(run with PADDLE_LOCK_WITNESS=1, e.g. "
+                             "tools/chaos_run.py --witness)\n")
+            return 1
+        rows = []   # (wait_p99, hold_p99, site, dump, wait, hold)
+        findings = []
+        edges = 0
+        for path in files:
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except (OSError, ValueError) as exc:
+                sys.stderr.write(f"unreadable witness dump {path}: "
+                                 f"{exc}\n")
+                return 1
+            tag = os.path.basename(path)
+            edges += len(doc.get("edges", ()))
+            for site, st in (doc.get("sites") or {}).items():
+                w, h = st.get("wait", {}), st.get("hold", {})
+                rows.append((w.get("p99", 0.0), h.get("p99", 0.0),
+                             site, tag, w, h))
+            for f in doc.get("findings", ()):
+                findings.append((tag, f))
+        rows.sort(key=lambda r: (-max(r[0], r[1]), r[2]))
+        text = (f"# lock witness ({len(files)} dump(s), {len(rows)} "
+                f"site(s), {edges} observed edge(s), "
+                f"{len(findings)} finding(s))\n")
+        text += (f"{'site':56} {'acq':>6} {'wait_p99':>10} "
+                 f"{'hold_p99':>10} {'hold_max':>10}  dump\n")
+        for wp, hp, site, tag, w, h in rows[:30]:
+            text += (f"{site[:56]:56} {h.get('count', 0):>6} "
+                     f"{wp * 1e3:>8.3f}ms {hp * 1e3:>8.3f}ms "
+                     f"{h.get('max', 0.0) * 1e3:>8.3f}ms  {tag}\n")
+        if len(rows) > 30:
+            text += f"... {len(rows) - 30} more site(s) elided\n"
+        for tag, f in findings:
+            text += (f"!! [{f.get('rule', '?')}] {tag}: "
+                     f"{f.get('message', '')}\n")
         if args.out:
             with open(args.out, "w") as fh:
                 fh.write(text)
