@@ -182,12 +182,15 @@ def _scatter_paged(kc, vc, bt, seq_of, pos, kt, vt, block_size,
     int8 and cache HBM halves vs bf16 (quarters vs fp32).
     """
     if k_quant is not None:
-        kt = jnp.clip(jnp.round(kt.astype(jnp.float32)
-                                * _per_token_scale(k_quant, seq_of)),
-                      -127, 127).astype(jnp.int8)
-        vt = jnp.clip(jnp.round(vt.astype(jnp.float32)
-                                * _per_token_scale(v_quant, seq_of)),
-                      -127, 127).astype(jnp.int8)
+        # named scope so opprof's "quant" op-class can attribute the
+        # encode cost in compiled-program profiles
+        with jax.named_scope("cachekv_quant"):
+            kt = jnp.clip(jnp.round(kt.astype(jnp.float32)
+                                    * _per_token_scale(k_quant, seq_of)),
+                          -127, 127).astype(jnp.int8)
+            vt = jnp.clip(jnp.round(vt.astype(jnp.float32)
+                                    * _per_token_scale(v_quant, seq_of)),
+                          -127, 127).astype(jnp.int8)
     phys = bt[seq_of, pos // block_size]
     off = pos % block_size
     return (kc.at[phys, :, off].set(kt.astype(kc.dtype)),
@@ -207,10 +210,14 @@ def _gather_paged(kc, vc, bt, heads, k_dequant=None, v_dequant=None,
     gk = jnp.moveaxis(gk, 2, 1).reshape(bsz, heads, s_kv, hd)
     gv = jnp.moveaxis(gv, 2, 1).reshape(bsz, heads, s_kv, hd)
     if k_dequant is not None:
-        scale_k = _per_seq_scale(k_dequant, bsz)
-        scale_v = _per_seq_scale(v_dequant, bsz)
-        gk = (gk.astype(jnp.float32) * scale_k).astype(out_dtype)
-        gv = (gv.astype(jnp.float32) * scale_v).astype(out_dtype)
+        # named scope mirrors _scatter_paged's cachekv_quant: the decode
+        # path's inline dequant (XLA fuses it into the attention matmul)
+        # shows up as the "quant" op-class in opprof
+        with jax.named_scope("cachekv_dequant"):
+            scale_k = _per_seq_scale(k_dequant, bsz)
+            scale_v = _per_seq_scale(v_dequant, bsz)
+            gk = (gk.astype(jnp.float32) * scale_k).astype(out_dtype)
+            gv = (gv.astype(jnp.float32) * scale_v).astype(out_dtype)
     return gk, gv, s_kv
 
 

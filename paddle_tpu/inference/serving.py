@@ -798,6 +798,8 @@ class PagedContinuousBatcher(_BatcherBase):
                  policy: str = "reserve",
                  prefill_chunk: Optional[int] = None,
                  cache_quant: Optional[str] = None,
+                 kv_quant: Optional[str] = None,
+                 tier_quant: Optional[str] = None,
                  fused_admission: bool = False,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: Optional[float] = None,
@@ -874,6 +876,41 @@ class PagedContinuousBatcher(_BatcherBase):
             raise ValueError(f"unknown cache_quant {cache_quant!r} "
                              f"(use None or 'dynamic_int8'; static int8 "
                              f"comes from model.calibrate_cachekv_int8)")
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"unknown kv_quant {kv_quant!r} "
+                             f"(use None or 'int8')")
+        if kv_quant:
+            # the explicit contract layer over the static-calibration
+            # path: pages store int8 + the model's calibrated per-head
+            # scales, dequantized inline at attention time (XLA fuses
+            # the dequant into the matmul)
+            if cache_quant:
+                raise ValueError(
+                    "kv_quant='int8' (static calibrated pages) and "
+                    "cache_quant (dynamic per-request scales) are two "
+                    "quantizers for the same pool; pick one")
+            if getattr(model, "_cachekv_scales", None) is None:
+                raise ValueError(
+                    "kv_quant='int8' needs static per-head cache scales: "
+                    "run model.calibrate_cachekv_int8(sample_ids) before "
+                    "constructing the batcher")
+            if draft_model is not None:
+                raise ValueError(
+                    "kv_quant is not supported with draft_model (the "
+                    "draft pool would need its own calibration pass)")
+        if tier_quant not in (None, "int8"):
+            raise ValueError(f"unknown tier_quant {tier_quant!r} "
+                             f"(use None or 'int8')")
+        if tier_quant:
+            if not prefix_cache:
+                raise ValueError(
+                    "tier_quant quantizes demoted host/disk tier blobs — "
+                    "it needs prefix_cache=True (with a host tier)")
+            if getattr(model, "_cachekv_scales", None) is not None:
+                raise ValueError(
+                    "tier_quant is redundant with calibrated int8 pages: "
+                    "an int8 pool already spills int8 blobs natively "
+                    "(and re-quantizing int8 codes would lose bits)")
         if cache_quant and prefill_chunk == 1:
             # a 1-token first chunk is decode-shaped (enc == 0,
             # this == 1): the op's scale opt-in guard rejects it, so fail
@@ -1026,11 +1063,48 @@ class PagedContinuousBatcher(_BatcherBase):
         self._host_bytes_g = _reg.gauge(
             "serving.kv_host_bytes",
             "bytes currently held by the host KV tier")
+        self._kv_quant_g = _reg.gauge(
+            "serving.kv_quant_enabled",
+            "1 when the paged KV pool stores int8 pages (static "
+            "calibrated scales), else 0")
+        self._kv_quant_saved_g = _reg.gauge(
+            "serving.kv_quant_bytes_saved",
+            "pool bytes saved by int8 KV pages vs the model fp dtype")
+        self._spill_raw_c = _reg.counter(
+            "serving.prefix_spill_raw_bytes",
+            "pre-quantization KV bytes demoted to the host tier "
+            "(what the spill WOULD cost stored raw)")
+        self._spill_blob_c = _reg.counter(
+            "serving.prefix_spill_blob_bytes",
+            "as-stored KV bytes demoted to the host tier (int8+scales "
+            "when tier_quant is on; equals raw otherwise)")
+        self._dequant_h = _reg.histogram(
+            "quant.dequant_seconds",
+            "main-thread blob dequantize time when installing promoted "
+            "tier chunks (the overhead tier_quant pays on promotion)")
 
         self.cache_quant = cache_quant
+        self.kv_quant = kv_quant
+        self.tier_quant = tier_quant
         pool = model.paged_alloc(
             n_pages + 1, block_size,
             cache_dtype="int8" if cache_quant else None)
+        # paged_alloc auto-allocates int8 pages whenever the model
+        # carries calibrated static scales — kv_quant='int8' is the
+        # explicit contract (validated above), but the gauge reflects
+        # the pool as actually allocated either way
+        pool_int8 = bool(cache_quant) or (
+            getattr(model, "_cachekv_scales", None) is not None)
+        self._kv_quant_g.set(1 if pool_int8 else 0)
+        if pool_int8:
+            elems = sum(int(np.prod(kc.shape)) + int(np.prod(vc.shape))
+                        for kc, vc in pool)
+            try:
+                fp_itemsize = np.dtype(
+                    getattr(cfg, "dtype", "float32") or "float32").itemsize
+            except TypeError:   # bfloat16-family names numpy can't parse
+                fp_itemsize = 2
+            self._kv_quant_saved_g.set(elems * max(0, fp_itemsize - 1))
         self._state = {
             "layers": pool,
             "block_tables": paddle.to_tensor(self._bt),
@@ -1258,20 +1332,75 @@ class PagedContinuousBatcher(_BatcherBase):
             self._demoted_seen = self.prefix_cache.demoted_bytes
         return freed
 
+    @staticmethod
+    def _quant_page(arr):
+        """Per-head symmetric int8 quantization of one KV page row
+        [H, block, D]: returns (int8 codes, float32 dequant scale
+        [H, 1, 1]). amax==0 heads keep scale 1.0 so all-zero padding
+        round-trips exactly."""
+        a = np.asarray(arr, np.float32)
+        amax = np.abs(a).max(axis=(1, 2), keepdims=True)
+        scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+        q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+        return q, scale
+
+    def _quant_rows(self, rows):
+        """Quantize a list of per-layer (k_page, v_page) rows into the
+        tier-blob twin lists (int8 pages, per-head scales)."""
+        pages, scales = [], []
+        for k, v in rows:
+            k8, ks = self._quant_page(k)
+            v8, vs = self._quant_page(v)
+            pages.append((k8, v8))
+            scales.append((ks, vs))
+        return pages, scales
+
+    @staticmethod
+    def _dequant_rows(pages, scales):
+        return [(k8.astype(np.float32) * ks, v8.astype(np.float32) * vs)
+                for (k8, v8), (ks, vs) in zip(pages, scales)]
+
     def _read_page_blob(self, node):
         """The cache's spill callback: read one node's KV rows off the
         pool back to pinned host numpy (on the CPU proxy this is a plain
         copy; on TPU the same call is the D2H readback). The draft pool
         shares the block table, so its rows spill alongside — promotion
-        must restore BOTH pools for the page to be reusable."""
+        must restore BOTH pools for the page to be reusable.
+
+        With ``tier_quant='int8'`` the fp rows demote as int8 codes plus
+        per-head scales (the ``q`` tag marks the blob; ``_install_chunk``
+        dequantizes on promotion), roughly halving what a chain costs the
+        host/disk byte budget. An int8 pool (static calibration) never
+        takes this path — its pages spill int8 natively and reinstall
+        verbatim."""
+        from .prefix_cache import blob_nbytes
         page = int(node.page)
-        blob = {"t": [(np.asarray(kc._data[page]).copy(),
-                       np.asarray(vc._data[page]).copy())
-                      for kc, vc in self._state["layers"]]}
+        rows = [(np.asarray(kc._data[page]).copy(),
+                 np.asarray(vc._data[page]).copy())
+                for kc, vc in self._state["layers"]]
+        drows = None
         if self.draft_model is not None:
-            blob["d"] = [(np.asarray(kc._data[page]).copy(),
-                          np.asarray(vc._data[page]).copy())
-                         for kc, vc in self._dstate["layers"]]
+            drows = [(np.asarray(kc._data[page]).copy(),
+                      np.asarray(vc._data[page]).copy())
+                     for kc, vc in self._dstate["layers"]]
+        raw = blob_nbytes(rows) + (blob_nbytes(drows) if drows else 0)
+        if self.tier_quant:
+            # the "ts"/"ds" scale keys ARE the quantized-blob tag (a
+            # string marker would poison the promotion device_put — the
+            # loader ships the whole pytree and every leaf must be a
+            # JAX-typable array)
+            pages, scales = self._quant_rows(rows)
+            blob = {"t": pages, "ts": scales}
+            if drows is not None:
+                dpages, dscales = self._quant_rows(drows)
+                blob["d"] = dpages
+                blob["ds"] = dscales
+        else:
+            blob = {"t": rows}
+            if drows is not None:
+                blob["d"] = drows
+        self._spill_raw_c.inc(raw)
+        self._spill_blob_c.inc(blob_nbytes(blob))
         return blob
 
     def _submit_promo_chunk(self, promo) -> bool:
@@ -1389,6 +1518,15 @@ class PagedContinuousBatcher(_BatcherBase):
         thread could write into a donated buffer."""
         for node, page, blob, nb in zip(chunk["nodes"], chunk["pages"],
                                         staged, chunk["nbytes"]):
+            if isinstance(blob, dict) and blob.get("ts") is not None:
+                # tier_quant blob: decode int8+scale back to fp before
+                # the pool scatter. Timed — this is the promotion-side
+                # cost tier_quant pays, and the ledger prices it.
+                tq0 = _time.perf_counter()
+                blob = {"t": self._dequant_rows(blob["t"], blob["ts"]),
+                        **({"d": self._dequant_rows(blob["d"], blob["ds"])}
+                           if "d" in blob else {})}
+                self._dequant_h.observe(_time.perf_counter() - tq0)
             for li, (k_s, v_s) in enumerate(blob["t"]):
                 kc, vc = self._state["layers"][li]
                 kc._data = kc._data.at[page].set(k_s)

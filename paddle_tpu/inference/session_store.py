@@ -58,7 +58,11 @@ def model_identity(model) -> str:
     """Stable identity string for resume-compatibility checks: the model
     class plus the config fields that change logits. Two processes
     serving the same architecture/shape agree; a vocab or depth change
-    does not."""
+    does not. Calibrated cachekv-int8 scales fold into the identity too:
+    a chain of int8 pages is only replayable under the SAME scales, so
+    calibration drift between pause and resume must conservatively
+    degrade to a full re-prefill rather than dequantize with the wrong
+    scales."""
     cfg = getattr(model, "config", None)
     if cfg is None:
         return type(model).__name__
@@ -66,6 +70,16 @@ def model_identity(model) -> str:
     sig = ",".join(f"{k}={fields[k]!r}" for k in sorted(fields)
                    if not k.startswith("_"))
     h = zlib.crc32(sig.encode()) & 0xFFFFFFFF
+    scales = getattr(model, "_cachekv_scales", None)
+    if scales is not None:
+        import numpy as _np  # local: keep the module header stdlib-only
+        q = 0
+        for layer in scales:
+            for k in sorted(layer):
+                q = zlib.crc32(
+                    _np.ascontiguousarray(
+                        _np.asarray(layer[k], _np.float32)).tobytes(), q)
+        return f"{type(model).__name__}:{h:08x}:q{q & 0xFFFFFFFF:08x}"
     return f"{type(model).__name__}:{h:08x}"
 
 

@@ -31,7 +31,14 @@ user received is itemized into explicit **waste categories**:
 - ``recompile``               — XLA compile seconds pulled from the
                                 ``compile.elapsed`` series (opt-in via
                                 ``add_recompile_from_registry``; compile
-                                time is process-wide, not per-trace).
+                                time is process-wide, not per-trace),
+- ``dequant``                 — main-thread blob dequantize seconds paid
+                                installing quantized tier promotions,
+                                pulled from the ``quant.dequant_seconds``
+                                series (opt-in via
+                                ``add_dequant_from_registry``; the
+                                capacity tier_quant buys is NOT free and
+                                this is its price, visible).
 
 ``goodput_frac`` = 1 - waste/chip. Invariant the drills assert: total
 charged seconds equal the summed span self time — nothing the traces
@@ -52,7 +59,7 @@ __all__ = ["WASTE_CATEGORIES", "CHIP_PHASES", "GoodputLedger",
 
 WASTE_CATEGORIES = ("bucket_pad", "requeue_recompute",
                     "evicted_prefix_recompute", "speculation_rejected",
-                    "recompile")
+                    "recompile", "dequant")
 # span names that hold an engine (chip time); everything else is wait
 # or gateway overhead — charged, reported, but outside goodput_frac
 CHIP_PHASES = frozenset({"admit", "prefill", "decode"})
@@ -149,6 +156,25 @@ class GoodputLedger:
                 secs += float(series.get("sum") or 0.0)
         if secs > 0.0:
             self.waste["recompile"] += secs
+            self.chip_s += secs
+            self.charged_s += secs
+        return secs
+
+    def add_dequant_from_registry(self, registry=None) -> float:
+        """Charge tier-blob dequantize time (the ``quant.dequant_seconds``
+        histogram the batcher's promotion install feeds) as ``dequant``
+        waste. Same shape as :meth:`add_recompile_from_registry`: the
+        time is process-wide main-thread work outside any request span,
+        so it joins both the chip total and the waste column."""
+        if registry is None:
+            from .metrics import get_registry
+            registry = get_registry()
+        secs = 0.0
+        for series in registry.snapshot():
+            if series.get("name") == "quant.dequant_seconds":
+                secs += float(series.get("sum") or 0.0)
+        if secs > 0.0:
+            self.waste["dequant"] += secs
             self.chip_s += secs
             self.charged_s += secs
         return secs

@@ -70,7 +70,7 @@ __all__ = [
 # The shared bucket scheme. Order is significant: it is the tie-break
 # and display order everywhere (reports, gauges, artifacts).
 OP_CLASSES = ("matmul", "attention", "collective", "elementwise",
-              "reduce", "data-movement", "other")
+              "reduce", "data-movement", "quant", "other")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -119,6 +119,13 @@ _ELEMENTWISE = _TRANSCENDENTAL | {
     "square", "erf", "erfc", "erf-inv", "logistic"}
 _ATTENTION_HINTS = ("flash", "attention", "attn", "mha",
                     "scaled-dot-product", "softmax")
+# serving-quantization scopes (decode_attention's cachekv_quant /
+# cachekv_dequant, _ConvertedLinear's weight_dequant). Checked BEFORE
+# the attention hints: the inline cache dequant lives inside the
+# attention computation, and "how much am I paying to (de)quantize" is
+# exactly the attribution the quant lane needs split out.
+_QUANT_HINTS = ("cachekv-quant", "cachekv-dequant", "weight-dequant",
+                "quantize", "dequant")
 
 
 def canon_op(name: str, fold: bool = True) -> str:
@@ -145,6 +152,9 @@ def classify_op(name: str, path: str = "") -> str:
     are different optimization targets)."""
     c = canon_op(name).lower()
     ctx = (path or "").lower().replace("_", "-")
+    if any(h in ctx for h in _QUANT_HINTS) \
+            or any(h in c for h in _QUANT_HINTS):
+        return "quant"
     if any(h in ctx for h in _ATTENTION_HINTS) \
             or any(h in c for h in _ATTENTION_HINTS):
         return "attention"

@@ -79,6 +79,30 @@ class AbsmaxObserver(BaseObserver):
         return np.float32(self._max if self._max > 0 else 1.0)
 
 
+class ChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel absmax (observers/channel_wise abs_max analog):
+    one scale per ``axis`` slice. The serving-grade weight observer —
+    per-tensor absmax lets one outlier column starve every other
+    channel of int8 codes."""
+
+    def __init__(self, quant_bits=8, axis=-1):
+        super().__init__(quant_bits)
+        self.axis = axis
+        self._max = None
+
+    def observe(self, x):
+        arr = np.abs(np.asarray(x._data if isinstance(x, Tensor) else x))
+        red = tuple(i for i in range(arr.ndim)
+                    if i != (self.axis % arr.ndim))
+        cur = arr.max(axis=red) if red else arr
+        self._max = cur if self._max is None else np.maximum(self._max, cur)
+
+    def scales(self):
+        if self._max is None:
+            return np.float32(1.0)
+        return np.where(self._max > 0, self._max, 1.0).astype(np.float32)
+
+
 class EMAObserver(BaseObserver):
     """Exponential-moving-average absmax (observers/ema.py analog)."""
 
@@ -113,7 +137,14 @@ class HistObserver(BaseObserver):
     def observe(self, x):
         arr = np.abs(np.asarray(x._data if isinstance(x, Tensor) else x))
         hi = float(arr.max(initial=0.0))
-        if self._hist is None:
+        if self._hist is None or self._edges[-1] <= 1e-8:
+            # An all-zero first batch pins the edges to the degenerate
+            # [0, 1e-8] range; every later re-bin then collapses the
+            # accumulated mass into bin 0 and the zero mass dominates
+            # the percentile CDF (scales() returns ~1e-8 regardless of
+            # the real data). Zero batches carry no range information,
+            # so keep (re)initializing until the first nonzero batch
+            # fixes the range.
             self._edges = np.linspace(0, max(hi, 1e-8), self.bins_count + 1)
             self._hist = np.histogram(arr, bins=self._edges)[0].astype(
                 np.float64)
@@ -127,7 +158,10 @@ class HistObserver(BaseObserver):
             self._hist += np.histogram(arr, bins=self._edges)[0]
 
     def scales(self):
-        if self._hist is None or self._hist.sum() == 0:
+        # degenerate edges mean only zero batches so far: no range
+        # information, so report the neutral scale instead of ~1e-8
+        if self._hist is None or self._hist.sum() == 0 \
+                or self._edges[-1] <= 1e-8:
             return np.float32(1.0)
         cdf = np.cumsum(self._hist) / self._hist.sum()
         idx = int(np.searchsorted(cdf, self.percent))
@@ -332,20 +366,34 @@ class _ConvertedLinear(Layer):
                 if qlayer.weight_quanter else 8)
         qmax = float(2 ** (bits - 1) - 1)
         w = np.asarray(qlayer.weight._data)
-        scale = (float(qlayer.weight_quanter.scales())
-                 if qlayer.weight_quanter else float(np.abs(w).max() or 1.0))
+        scale = np.asarray(
+            qlayer.weight_quanter.scales() if qlayer.weight_quanter
+            else (np.abs(w).max() or 1.0), np.float32)
+        scale = np.maximum(scale, 1e-12)
         self.w_int8 = Tensor(np.clip(np.round(w / scale * qmax), -qmax,
                                      qmax).astype(np.int8))
-        self.scale = float(scale)
+        # per-tensor scales stay a plain float (the historical export
+        # contract); channel-wise observers hand back a [out] vector
+        self.scale = float(scale) if scale.ndim == 0 else Tensor(scale)
         self._qmax = qmax
+        self._dq = (self.scale / qmax if isinstance(self.scale, float)
+                    else Tensor((scale / qmax).astype(np.float32)))
         self.bias = qlayer.bias
         self.act_scale = (float(qlayer.activation_quanter.scales())
                           if qlayer.activation_quanter else None)
 
     def forward(self, x):
         from ..ops.linalg import matmul
-        w = self.w_int8.astype("float32") * (self.scale / self._qmax)
+        # dequant AFTER the matmul: scales are per-tensor or per-OUTPUT-
+        # channel, so (x @ w8) * s == x @ (w8 * s) exactly — and the
+        # elementwise dequant shrinks from O(in*out) weight elements per
+        # call to O(batch*out) accumulator elements. The named scope
+        # feeds opprof's "quant" op-class attribution.
+        with jax.named_scope("weight_dequant"):
+            w = self.w_int8.astype("float32")
         out = matmul(x, w)
+        with jax.named_scope("weight_dequant"):
+            out = out * self._dq
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -366,8 +414,123 @@ def convert(model: Layer, inplace=False) -> Layer:
     return model
 
 
-__all__ = ["QuantConfig", "QAT", "PTQ", "convert", "quant_dequant",
-           "BaseObserver", "AbsmaxObserver", "EMAObserver", "HistObserver",
+def _quant_metrics():
+    from ..observability.metrics import get_registry
+    reg = get_registry()
+    return (reg.counter("quant.layers_quantized",
+                        "Linear layers serving int8 weights"),
+            reg.counter("quant.layers_fallback",
+                        "Linear layers kept fp (calibration error over "
+                        "the bound)"),
+            reg.counter("quant.weight_bytes_saved",
+                        "parameter bytes removed by int8 serving weights"),
+            reg.histogram("quant.layer_rel_err",
+                          "per-layer output rel-error of int8 vs fp "
+                          "weights on the calibration probe"))
+
+
+def serving_quantize(model: Layer, err_bound: float = 0.02,
+                     probe_batch: int = 8, seed: int = 0, mesh=None,
+                     channelwise: bool = True,
+                     inplace: bool = False) -> Layer:
+    """int8 serving weights via the PTQ ``convert()`` scales, with a
+    per-layer fp fallback.
+
+    Every plain ``nn.Linear`` is converted to the inference-form
+    :class:`_ConvertedLinear` (``w_int8`` + absmax scales — the exact
+    layers ``PTQ(...).convert()`` bakes; ``channelwise=True`` observes
+    with :class:`ChannelAbsmaxObserver` for per-output-channel scales,
+    ``False`` keeps the per-tensor absmax), **unless** the
+    layer's output on a seeded calibration probe deviates from the fp
+    layer by more than ``err_bound`` — outlier-heavy layers then stay
+    fp instead of silently degrading quality. The error is the max
+    over output units of the relative L2 deviation (small units floored
+    at 1% of the largest): whole-tensor norms would let one huge
+    outlier column mask the starvation of every other unit, and plain
+    weight-reconstruction error (~1/254 for absmax int8 always) never
+    trips any bound — per-unit output error is what makes the fallback
+    real.
+
+    ``mesh``: an optional :class:`~paddle_tpu.distributed.mesh.MeshRuntime`
+    — accepted layers get ``w_int8`` committed under
+    ``mesh.serving_weight_spec`` (same column-parallel trailing-dim
+    placement as the fp weights, so tensor-parallel serving stays
+    token-exact).
+
+    Returns the (copied unless ``inplace``) model; the decision record
+    lives in ``model._serving_quant_report``:
+    ``{"layers": {path: {"rel_err", "mae", "quantized"}},
+    "layers_quantized", "layers_fallback", "bytes_saved",
+    "err_bound"}``. ``quant.*`` metrics mirror the counts.
+    """
+    from ..nn.common import Linear
+    if not inplace:
+        model = copy.deepcopy(model)
+    quant_c, fallback_c, bytes_c, err_h = _quant_metrics()
+    report = {"layers": {}, "layers_quantized": 0, "layers_fallback": 0,
+              "bytes_saved": 0, "err_bound": float(err_bound)}
+
+    def _walk(m, prefix):
+        for name, child in list(m.named_children()):
+            path = f"{prefix}.{name}" if prefix else name
+            # exact type: Linear subclasses may carry forward semantics
+            # the converted layer would drop
+            if type(child) is Linear:
+                obs = (ChannelAbsmaxObserver() if channelwise
+                       else AbsmaxObserver())
+                q = QuantedLinear(child, None, obs)
+                q.weight_quanter.observe(child.weight)
+                conv = _ConvertedLinear(q)
+                rng = np.random.RandomState(
+                    (seed + zlib_crc(path)) % (2 ** 31))
+                x = rng.randn(probe_batch, child.in_features).astype(
+                    np.float32)
+                w = np.asarray(child.weight._data, np.float32)
+                sc = (np.asarray(conv.scale._data)
+                      if isinstance(conv.scale, Tensor) else conv.scale)
+                wdq = (np.asarray(conv.w_int8._data, np.float32)
+                       * (sc / conv._qmax))
+                ref, out = x @ w, x @ wdq
+                coln = np.linalg.norm(ref, axis=0)
+                floor = max(0.01 * float(coln.max(initial=0.0)), 1e-12)
+                rel = float((np.linalg.norm(out - ref, axis=0)
+                             / np.maximum(coln, floor)).max(initial=0.0))
+                mae = float(np.abs(out - ref).mean())
+                err_h.observe(rel)
+                ok = rel <= err_bound
+                report["layers"][path] = {"rel_err": rel, "mae": mae,
+                                          "quantized": bool(ok)}
+                if ok:
+                    if mesh is not None:
+                        w8 = np.asarray(conv.w_int8._data)
+                        spec = mesh.serving_weight_spec(w8.shape, path)
+                        conv.w_int8 = Tensor(mesh.place(w8, spec))
+                    w_bytes = np.asarray(child.weight._data).nbytes
+                    saved = w_bytes - np.asarray(conv.w_int8._data).nbytes
+                    report["bytes_saved"] += int(saved)
+                    report["layers_quantized"] += 1
+                    quant_c.inc()
+                    bytes_c.inc(max(int(saved), 0))
+                    m.add_sublayer(name, conv)
+                else:
+                    report["layers_fallback"] += 1
+                    fallback_c.inc()
+            else:
+                _walk(child, path)
+
+    def zlib_crc(s):
+        import zlib
+        return zlib.crc32(s.encode()) & 0xFFFFFFFF
+
+    _walk(model, "")
+    model._serving_quant_report = report
+    return model
+
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "convert", "serving_quantize",
+           "quant_dequant",
+           "BaseObserver", "AbsmaxObserver", "ChannelAbsmaxObserver",
+           "EMAObserver", "HistObserver",
            "FakeQuanterWithAbsMaxObserver",
            "FakeQuanterWithAbsMaxObserverLayer", "QuantedLinear",
            "QuantedConv2D"]

@@ -817,7 +817,7 @@ def test_trace_analyze_gate_demo_workload_attributes_cleanly():
     assert led["chip_seconds"] > 0.0 and 0.0 < led["goodput_frac"] <= 1.0
     assert set(led["waste_seconds"]) == {
         "bucket_pad", "requeue_recompute", "evicted_prefix_recompute",
-        "speculation_rejected", "recompile"}
+        "speculation_rejected", "recompile", "dequant"}
     assert {"prefill", "decode"} <= set(led["by_phase"])
     assert {"prefill", "decode"} <= set(payload["critical_path_summary"])
     # in-process demo + analysis; generous vs the 10s lint budget

@@ -132,7 +132,7 @@ def test_taxonomy_stability_and_shared_with_analyze_xplane():
     # the bucket scheme is closed and ordered
     assert opprof.OP_CLASSES == ("matmul", "attention", "collective",
                                  "elementwise", "reduce",
-                                 "data-movement", "other")
+                                 "data-movement", "quant", "other")
     expect = {
         "dot_general": "matmul", "convolution": "matmul",
         "all_reduce": "collective", "reduce-scatter": "collective",
@@ -150,6 +150,15 @@ def test_taxonomy_stability_and_shared_with_analyze_xplane():
     assert opprof.classify_op("dot_general",
                               "decoder/flash_attention/dot") == "attention"
     assert opprof.classify_op("fusion.7", "mha/softmax") == "attention"
+    # quant scopes win over BOTH the opcode and an enclosing attention
+    # scope: the inline cache dequant lives inside the attention calc,
+    # and its cost is the quant lane's attribution target
+    assert opprof.classify_op("convert.3",
+                              "decoder/cachekv_dequant/convert") == "quant"
+    assert opprof.classify_op("multiply",
+                              "mha/cachekv_quant/mul") == "quant"
+    assert opprof.classify_op("fusion.2",
+                              "model/weight_dequant/mul") == "quant"
     # analyze_xplane delegates to the SAME module: identical buckets,
     # and its _canon keeps the historical (fold=False) key spelling
     import importlib.util

@@ -46,8 +46,10 @@ def discover(dirpath: str, prefix: str = "BENCH_r") -> List[dict]:
     KV-tier churn lane in ``BENCH_PREFIX_r*.json``
     (bench_prefix_churn.py), the self-heal traffic lane in
     ``BENCH_TRAFFIC_r*.json`` (bench_selfheal.py), the durable-session
-    resume lane in ``BENCH_SESSION_r*.json`` (bench_session.py), and
-    the op-profile lane in ``OPPROF_r*.json`` (opprof cost artifacts,
+    resume lane in ``BENCH_SESSION_r*.json`` (bench_session.py), the
+    serving-quantization lane in ``BENCH_QUANT_r*.json``
+    (bench_quant.py), and the op-profile lane in ``OPPROF_r*.json``
+    (opprof cost artifacts,
     synthesized into inverse drift series directly in ``run_check``) —
     all pulled in by ``run_check`` with their own prefixes. The globs are disjoint, so the relay gate
     (train-lane-only by construction) never sees the other lanes'
@@ -239,6 +241,28 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
                 "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
                 "_round": r["_round"], "_file": r["_file"],
                 "_lane": "session"})
+    qt_records = discover(dirpath, prefix="BENCH_QUANT_r")
+    for r in qt_records:
+        r["_lane"] = "quant"
+    # the quant bench's headline value is int8-weights decode tokens/s;
+    # the greedy token-match rate vs the fp arm gates as a SECOND series
+    # (detail.token_match_rate) so a quantizer quality regression fails
+    # as loudly as a speed one. The band is a lower bound, which is the
+    # right direction for a match rate. Driver dry-run wrappers (rc != 0
+    # or no parsed line) are already ``_skip`` records from discover and
+    # contribute no point.
+    match_records = []
+    for r in qt_records:
+        if "_skip" in r:
+            continue
+        tm = (r.get("detail") or {}).get("token_match_rate")
+        if isinstance(tm, (int, float)):
+            match_records.append({
+                "metric": "quant_token_match_rate", "value": float(tm),
+                "unit": "frac",
+                "detail": {"tpu": (r.get("detail") or {}).get("tpu")},
+                "_round": r["_round"], "_file": r["_file"],
+                "_lane": "quant"})
     # op-level profile lane: OPPROF_r*.json (opprof.write_artifact —
     # bench.py emits one per run). These are cost artifacts, not bench
     # lines, so the series are synthesized here. The band is a LOWER
@@ -282,7 +306,7 @@ def run_check(dirpath: str, tolerance: float = DEFAULT_TOLERANCE,
     records = (records + gw_records + mc_records + goodput_records
                + px_records + promo_records + tr_records
                + recov_records + se_records + ttr_records
-               + opp_records)
+               + qt_records + match_records + opp_records)
     report = {
         "dir": dirpath,
         "tolerance": tolerance,

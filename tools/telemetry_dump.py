@@ -410,6 +410,7 @@ def main(argv=None) -> int:
             sums = {}
             by_tier = {}
             promo_q = {}
+            gauges = {}
             for s in agg.fleet_series():
                 if s.get("type") == "counter":
                     sums[s["name"]] = sums.get(s["name"], 0) \
@@ -418,6 +419,9 @@ def main(argv=None) -> int:
                         t = (s.get("labels") or {}).get("tier", "?")
                         by_tier[t] = by_tier.get(t, 0) \
                             + s.get("value", 0)
+                elif s.get("type") == "gauge":
+                    gauges[s["name"]] = gauges.get(s["name"], 0) \
+                        + s.get("value", 0)
                 elif s.get("type") == "histogram" and \
                         s["name"] == "serving.prefix_promotion_seconds":
                     promo_q = s.get("quantiles") or {}
@@ -447,6 +451,21 @@ def main(argv=None) -> int:
                     if v is not None:
                         stats[f"promotion_latency_{q}_ms"] = round(
                             v * 1e3, 3)
+            blob = sums.get("serving.prefix_spill_blob_bytes", 0)
+            if blob:
+                # quantized-spill columns only when spill traffic
+                # reported the new counters: legacy fleets (and runs
+                # with no demotions) keep the line byte-identical.
+                # compression = what the demoted chains WOULD cost raw
+                # over what they cost as stored (≈3.9x with
+                # tier_quant='int8' + per-head scales, 1.0 untouched)
+                raw = sums.get("serving.prefix_spill_raw_bytes", 0)
+                stats["spill_raw_bytes"] = raw
+                stats["spill_blob_bytes"] = blob
+                stats["spill_compression"] = round(raw / max(blob, 1), 2)
+                if "serving.kv_host_bytes" in gauges:
+                    stats["host_blob_bytes"] = gauges[
+                        "serving.kv_host_bytes"]
             text += "# fleet prefix-stats " + json.dumps(stats) + "\n"
         if args.out:
             with open(args.out, "w") as fh:
